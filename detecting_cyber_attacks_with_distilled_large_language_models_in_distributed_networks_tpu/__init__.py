@@ -1,0 +1,30 @@
+"""fedtpu — TPU-native federated DDoS detection with distilled LLMs.
+
+A brand-new JAX/XLA/pjit/Pallas framework with the capabilities of the reference
+system ``Detecting_Cyber_Attacks_with_Distilled_Large_Language_Models_in_Distributed_Networks``
+(three laptop processes shipping gzip-pickled PyTorch state dicts over hand-rolled
+TCP — see reference client1.py / server.py):
+
+* N federated clients fine-tune a DistilBERT binary DDoS classifier on per-client
+  partitions of CICIDS2017 flow records rendered as English sentences.
+* FedAvg weight aggregation between local-training phases is an XLA collective
+  (mean over a ``clients`` mesh axis) — no server process, no serialization on
+  the round path.
+* Per-client local-vs-aggregated evaluation, metrics CSVs, plots,
+  checkpoint/warm-start, fault-tolerant rounds, cross-host demo mode.
+
+Import as::
+
+    import detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu as fedtpu
+"""
+
+__version__ = "0.1.0"
+
+from .config import (  # noqa: F401
+    DataConfig,
+    FedConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+    ExperimentConfig,
+)

@@ -1,0 +1,235 @@
+"""Typed configuration system.
+
+The reference has no config system at all — module-level constants and literals
+scattered through three scripts (reference client1.py:22-23, server.py:10-13;
+bs=16 / max_len=128 / lr=2e-5 / epochs=3 at client1.py:27,365-372,379-380), and
+scaling to N clients means copy-pasting ``clientN.py`` with a new hard-coded
+seed.  Here every knob is a dataclass field and per-client identity is derived
+(``client_id -> seed``), never copy-pasted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Transformer encoder + classification head.
+
+    Defaults reproduce DistilBERT-base-uncased (6 layers, 768 hidden, 12 heads,
+    3072 FFN, learned positions, post-LayerNorm, exact GELU) which the reference
+    loads via HF ``DistilBertModel.from_pretrained`` (reference client1.py:56),
+    plus the reference's classifier head: CLS pooling -> Dropout(0.3) ->
+    Linear(768, 2) (reference client1.py:57-58,62-64).
+    """
+
+    vocab_size: int = 30522
+    max_len: int = 128
+    dim: int = 768
+    n_layers: int = 6
+    n_heads: int = 12
+    hidden_dim: int = 3072
+    dropout: float = 0.1
+    attention_dropout: float = 0.1
+    head_dropout: float = 0.3
+    n_classes: int = 2
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    pad_token_id: int = 0
+    # "bf16" activations keep the MXU fed; params/optimizer stay fp32.
+    compute_dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    # "dot" (XLA fused attention), "flash" (Pallas kernel), "ring"
+    # (sequence-parallel ring attention over a mesh axis).
+    attention_impl: str = "dot"
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        if self.dim % self.n_heads:
+            raise ValueError(f"dim={self.dim} not divisible by n_heads={self.n_heads}")
+        return self.dim // self.n_heads
+
+    def replace(self, **kw: Any) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)
+
+    @classmethod
+    def distilbert_base(cls, **kw: Any) -> "ModelConfig":
+        return cls(**kw)
+
+    @classmethod
+    def bert_base(cls, **kw: Any) -> "ModelConfig":
+        """BERT-base-sized scale-up encoder (BASELINE.json config 4)."""
+        kw.setdefault("n_layers", 12)
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw: Any) -> "ModelConfig":
+        """Small config for tests / CI on CPU."""
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("max_len", 32)
+        kw.setdefault("dim", 32)
+        kw.setdefault("n_layers", 2)
+        kw.setdefault("n_heads", 2)
+        kw.setdefault("hidden_dim", 64)
+        kw.setdefault("compute_dtype", "float32")
+        return cls(**kw)
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """CICIDS2017-style flow CSV -> text -> token arrays.
+
+    Mirrors reference semantics: ``±inf -> NaN -> column-mean`` imputation and a
+    ``frac`` sample with a per-client seed (reference client1.py:84-93, seed 42;
+    client2.py:79-88, seed 43), 60/20/20 split via two chained train_test_split
+    calls (reference client1.py:365-366), label map ``'DDoS' -> 1 else 0``
+    (reference client1.py:91).
+    """
+
+    csv_path: str = "CICIDS2017.csv"
+    data_fraction: float = 0.1
+    seed_base: int = 42  # client i uses seed_base + i  (42, 43, ... — matches reference)
+    val_fraction: float = 0.2
+    test_fraction: float = 0.2
+    label_column: str = "Label"
+    positive_label: str = "DDoS"
+    max_len: int = 128
+    batch_size: int = 16
+    eval_batch_size: int = 16
+    # "sample"  — reference behavior: independent frac-sample per client seed
+    #             (overlap between clients possible, as in the reference).
+    # "disjoint" — equal disjoint shards.
+    # "dirichlet" — non-IID label-skew partition (BASELINE.json config 3).
+    partition: str = "sample"
+    dirichlet_alpha: float = 0.5
+    vocab_path: str | None = None
+    drop_remainder: bool = True
+
+    def client_seed(self, client_id: int) -> int:
+        return self.seed_base + client_id
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Local-training hyperparameters (reference client1.py:370,379-380)."""
+
+    learning_rate: float = 2e-5
+    epochs_per_round: int = 3
+    weight_decay: float = 0.0
+    grad_accum_steps: int = 1
+    max_grad_norm: float | None = None
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+    seed: int = 0
+    log_every: int = 100
+
+
+@dataclass(frozen=True)
+class FedConfig:
+    """Federated-round structure.
+
+    The reference runs exactly one FedAvg round per invocation with exactly
+    ``NUM_CLIENTS=2`` clients and an unweighted mean (reference server.py:13,
+    67-79); multi-round is re-running with warm start (client1.py:375-377).
+    Here rounds and client count are first-class, aggregation may be weighted
+    by client sample counts, and dropped clients are masked out of the mean
+    instead of hanging the round (reference behavior: accept-loop hangs until
+    timeout, server.py:69-71,124-132).
+    """
+
+    num_clients: int = 2
+    rounds: int = 1
+    weighted: bool = False
+    # Minimum fraction of clients that must survive a round for aggregation
+    # to proceed (masked mean over survivors); reference requires all.
+    min_client_fraction: float = 1.0
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout.
+
+    axes: ``clients`` — federated replicas (FedAvg collective rides this axis);
+    ``data`` — per-client batch parallelism (grad psum rides this axis).
+    A 1-sized axis is dropped from the physical mesh automatically.
+    """
+
+    clients: int = 2
+    data: int = 1
+    axis_names: tuple[str, str] = ("clients", "data")
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    model: ModelConfig = field(default_factory=ModelConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    fed: FedConfig = field(default_factory=FedConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    output_dir: str = "outputs"
+    checkpoint_dir: str | None = None
+
+    def __post_init__(self) -> None:
+        if self.fed.num_clients != self.mesh.clients:
+            raise ValueError(
+                f"fed.num_clients={self.fed.num_clients} != mesh.clients="
+                f"{self.mesh.clients}; use ExperimentConfig.for_clients(n)"
+            )
+        if self.data.max_len != self.model.max_len:
+            raise ValueError(
+                f"data.max_len={self.data.max_len} != model.max_len="
+                f"{self.model.max_len}: tokenized sequences must match the "
+                "position-embedding table"
+            )
+
+    @classmethod
+    def for_clients(cls, num_clients: int, data_parallel: int = 1, **kw: Any) -> "ExperimentConfig":
+        """Consistent config for an N-client fleet on a clients×data mesh."""
+        kw.setdefault("fed", FedConfig(num_clients=num_clients))
+        kw.setdefault(
+            "mesh", MeshConfig(clients=num_clients, data=data_parallel)
+        )
+        if kw["fed"].num_clients != num_clients:
+            kw["fed"] = dataclasses.replace(kw["fed"], num_clients=num_clients)
+        return cls(**kw)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "ExperimentConfig":
+        sections = {
+            "model": ModelConfig,
+            "data": DataConfig,
+            "train": TrainConfig,
+            "fed": FedConfig,
+            "mesh": MeshConfig,
+        }
+        scalars = ("output_dir", "checkpoint_dir")
+        unknown_top = set(d) - set(sections) - set(scalars)
+        if unknown_top:
+            raise ValueError(f"unknown config sections: {sorted(unknown_top)}")
+
+        def _mk(tp, key):
+            sub = dict(d.get(key, {}))
+            names = {f.name for f in dataclasses.fields(tp)}
+            unknown = set(sub) - names
+            if unknown:
+                raise ValueError(f"unknown {key} config keys: {sorted(unknown)}")
+            # JSON round-trips tuples as lists; restore tuple-typed fields so
+            # frozen dataclasses stay hashable and equality survives to_dict().
+            for k, v in sub.items():
+                if isinstance(v, list):
+                    sub[k] = tuple(v)
+            return tp(**sub)
+
+        kw: dict[str, Any] = {key: _mk(tp, key) for key, tp in sections.items()}
+        for scalar in scalars:
+            if scalar in d:
+                kw[scalar] = d[scalar]
+        return cls(**kw)

@@ -1,0 +1,12 @@
+from .textualize import FLOW_TEXT_COLUMNS, flow_to_text, texts_from_dataframe  # noqa: F401
+from .cicids import (  # noqa: F401
+    ClientSplits,
+    SplitArrays,
+    load_client_frame,
+    load_flow_csv,
+    make_all_client_splits,
+    make_client_splits,
+    partition_indices,
+    train_val_test_split,
+)
+from .synthetic import make_synthetic_flows, write_synthetic_csv  # noqa: F401

@@ -1,0 +1,51 @@
+"""Test harness: fake an 8-device CPU mesh before JAX backend init.
+
+The TPU analogue of a fake backend (SURVEY.md §4): multi-client federation is
+validated on virtual CPU devices; real-TPU runs happen in bench.py only.
+
+NOTE: this environment's sitecustomize force-registers a TPU ('axon') platform
+and overwrites JAX_PLATFORMS, so env vars alone don't stick — the config must
+be updated post-import, pre-backend-init.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass  # backend already initialized (e.g. single-test rerun) — tests skip if <8
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]
+
+
+@pytest.fixture(scope="session")
+def synthetic_csv(tmp_path_factory):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+        write_synthetic_csv,
+    )
+
+    path = tmp_path_factory.mktemp("data") / "flows.csv"
+    write_synthetic_csv(str(path), n_rows=1200, seed=7)
+    return str(path)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

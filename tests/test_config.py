@@ -1,0 +1,59 @@
+"""Config system tests."""
+
+import pytest
+
+import detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu as fedtpu
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+    ExperimentConfig,
+    FedConfig,
+    MeshConfig,
+    ModelConfig,
+)
+
+
+def test_defaults_are_reference_hyperparams():
+    cfg = ExperimentConfig()
+    assert cfg.model.dim == 768 and cfg.model.n_layers == 6 and cfg.model.n_heads == 12
+    assert cfg.model.head_dropout == 0.3 and cfg.model.n_classes == 2
+    assert cfg.data.batch_size == 16 and cfg.data.max_len == 128
+    assert cfg.data.data_fraction == 0.1 and cfg.data.seed_base == 42
+    assert cfg.train.learning_rate == 2e-5 and cfg.train.epochs_per_round == 3
+    assert cfg.fed.num_clients == 2 and cfg.fed.rounds == 1
+
+
+def test_client_seed_derivation_matches_reference():
+    cfg = fedtpu.DataConfig()
+    assert cfg.client_seed(0) == 42  # client1.py:89
+    assert cfg.client_seed(1) == 43  # client2.py:84
+
+
+def test_round_trip_and_tuple_restore():
+    import json
+
+    cfg = ExperimentConfig.for_clients(4, data_parallel=2)
+    d = json.loads(json.dumps(cfg.to_dict()))
+    cfg2 = ExperimentConfig.from_dict(d)
+    assert cfg2 == cfg
+    hash(cfg2.mesh)  # tuple restored -> still hashable
+
+
+def test_from_dict_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="learning_rte"):
+        ExperimentConfig.from_dict({"train": {"learning_rte": 1e-4}})
+    with pytest.raises(ValueError, match="sections"):
+        ExperimentConfig.from_dict({"trian": {}})
+
+
+def test_inconsistent_config_rejected():
+    with pytest.raises(ValueError, match="num_clients"):
+        ExperimentConfig(fed=FedConfig(num_clients=8))
+    with pytest.raises(ValueError, match="max_len"):
+        ExperimentConfig(model=ModelConfig(max_len=256))
+    cfg = ExperimentConfig.for_clients(8)
+    assert cfg.mesh.clients == 8 and cfg.fed.num_clients == 8
+
+
+def test_bert_base_preset():
+    m = ModelConfig.bert_base()
+    assert m.n_layers == 12 and m.dim == 768
+    assert ModelConfig.tiny().head_dim == 16

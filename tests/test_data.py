@@ -1,0 +1,141 @@
+"""Data layer tests: textualization parity, imputation, partitioning, splits."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu as fedtpu
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+    flow_to_text,
+    load_flow_csv,
+    make_client_splits,
+    make_synthetic_flows,
+    partition_indices,
+    texts_from_dataframe,
+    train_val_test_split,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.cicids import (
+    sample_client_frame,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.textualize import (
+    labels_from_dataframe,
+)
+
+DataConfig = fedtpu.DataConfig
+
+
+def _reference_template(row):
+    # Independent transcription of the reference template (client1.py:68-81)
+    # used as the expected value; flow_to_text must match byte-for-byte.
+    return (
+        f"Destination port is {row['Destination Port']}. "
+        f"Flow duration is {row['Flow Duration']} microseconds. "
+        f"Total forward packets are {row['Total Fwd Packets']}. "
+        f"Total backward packets are {row['Total Backward Packets']}. "
+        f"Total length of forward packets is {row['Total Length of Fwd Packets']} bytes. "
+        f"Total length of backward packets is {row['Total Length of Bwd Packets']} bytes. "
+        f"Maximum forward packet length is {row['Fwd Packet Length Max']}. "
+        f"Minimum forward packet length is {row['Fwd Packet Length Min']}. "
+        f"Flow bytes per second is {row['Flow Bytes/s']}. "
+        f"Flow packets per second is {row['Flow Packets/s']}."
+    )
+
+
+def test_flow_to_text_matches_reference_template():
+    df = make_synthetic_flows(50, seed=3, inf_fraction=0, nan_fraction=0)
+    expected = df.apply(_reference_template, axis=1).tolist()
+    got_rowwise = [flow_to_text(row) for _, row in df.iterrows()]
+    got_vectorized = texts_from_dataframe(df)
+    assert got_rowwise == expected
+    assert got_vectorized == expected
+
+
+def test_texts_from_dataframe_empty():
+    df = make_synthetic_flows(5, seed=0).iloc[0:0]
+    assert texts_from_dataframe(df) == []
+
+
+def test_load_flow_csv_imputes_like_reference(tmp_path):
+    df = make_synthetic_flows(300, seed=1, inf_fraction=0.05, nan_fraction=0.05)
+    p = tmp_path / "x.csv"
+    df.to_csv(p, index=False)
+    loaded = load_flow_csv(str(p))
+    num = loaded.select_dtypes(include=[np.number])
+    assert np.isfinite(num.to_numpy()).all()
+    # Reference order: ±inf -> NaN first, then fillna with the post-replacement
+    # column mean (client1.py:87-88).
+    raw = pd.read_csv(p).replace([np.inf, -np.inf], np.nan)
+    expected = raw.fillna(raw.mean(numeric_only=True))
+    pd.testing.assert_frame_equal(loaded, expected, check_like=True)
+
+
+def test_sample_partition_matches_pandas_sample():
+    df = make_synthetic_flows(500, seed=2, inf_fraction=0, nan_fraction=0)
+    cfg = DataConfig(data_fraction=0.1, seed_base=42)
+    c0 = sample_client_frame(df, 0.1, cfg.client_seed(0))
+    c1 = sample_client_frame(df, 0.1, cfg.client_seed(1))
+    pd.testing.assert_frame_equal(c0, df.sample(frac=0.1, random_state=42))
+    pd.testing.assert_frame_equal(c1, df.sample(frac=0.1, random_state=43))
+    assert not c0.index.equals(c1.index)
+
+
+def test_split_matches_sklearn():
+    from sklearn.model_selection import train_test_split
+
+    for n in (100, 101, 4515, 22573):
+        tr, va, te = train_val_test_split(n, seed=42)
+        items = list(range(n))
+        X_train, X_temp = train_test_split(items, test_size=0.4, random_state=42)
+        X_val, X_test = train_test_split(X_temp, test_size=0.5, random_state=42)
+        assert list(tr) == X_train
+        assert list(va) == X_val
+        assert list(te) == X_test
+
+
+def test_split_disjoint_and_complete():
+    tr, va, te = train_val_test_split(1000, seed=7)
+    all_idx = np.concatenate([tr, va, te])
+    assert len(np.unique(all_idx)) == 1000
+
+
+def test_disjoint_partition():
+    labels = np.zeros(1000, dtype=np.int32)
+    cfg = DataConfig(partition="disjoint", data_fraction=0.5)
+    parts = partition_indices(labels, 4, cfg)
+    assert len(parts) == 4
+    flat = np.concatenate(parts)
+    assert len(np.unique(flat)) == len(flat)  # disjoint
+    for p in parts:
+        assert len(p) == 125  # 1000/4 * 0.5
+
+
+def test_dirichlet_partition_skews_labels():
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 2, size=2000).astype(np.int32)
+    cfg = DataConfig(partition="dirichlet", data_fraction=1.0, dirichlet_alpha=0.1, seed_base=1)
+    parts = partition_indices(labels, 4, cfg)
+    flat = np.concatenate(parts)
+    assert len(np.unique(flat)) == len(flat)
+    fracs = [labels[p].mean() if len(p) else 0.5 for p in parts]
+    assert max(fracs) - min(fracs) > 0.2  # alpha=0.1 => strong skew
+
+
+def test_make_client_splits_end_to_end(synthetic_csv):
+    df = load_flow_csv(synthetic_csv)
+    cfg = DataConfig(data_fraction=0.5, seed_base=42)
+    s0 = make_client_splits(df, 0, 2, cfg)
+    s1 = make_client_splits(df, 1, 2, cfg)
+    n = len(s0.train) + len(s0.val) + len(s0.test)
+    assert n == int(len(df) * 0.5)
+    assert abs(len(s0.train) / n - 0.6) < 0.01
+    assert s0.train.texts[0] != s1.train.texts[0]  # different client seeds
+    assert set(np.unique(s0.train.labels)) <= {0, 1}
+    # Deterministic: same config -> same split.
+    s0b = make_client_splits(df, 0, 2, cfg)
+    assert s0.train.texts == s0b.train.texts
+    assert (s0.train.labels == s0b.train.labels).all()
+
+
+def test_labels_positive_map():
+    df = pd.DataFrame({"Label": ["BENIGN", "DDoS", "PortScan", "DDoS"]})
+    np.testing.assert_array_equal(labels_from_dataframe(df), [0, 1, 0, 1])
