@@ -10,3 +10,19 @@ from .cicids import (  # noqa: F401
     train_val_test_split,
 )
 from .synthetic import make_synthetic_flows, write_synthetic_csv  # noqa: F401
+from .tokenizer import (  # noqa: F401
+    WordPieceTokenizer,
+    basic_tokenize,
+    build_domain_vocab,
+    default_tokenizer,
+)
+from .pipeline import (  # noqa: F401
+    TokenizedClient,
+    TokenizedSplit,
+    batch_iterator,
+    num_batches,
+    pad_split_to_batch,
+    stack_clients,
+    tokenize_client,
+    tokenize_split,
+)

@@ -64,9 +64,19 @@ def partition_indices(
     n = len(labels)
     rng = np.random.default_rng(cfg.seed_base)
     if cfg.partition == "disjoint":
+        # data_fraction is per-dataset (same convention as 'sample' and
+        # 'dirichlet'): each client gets frac*n rows, disjoint across clients.
+        if cfg.data_fraction * num_clients > 1.0 + 1e-9:
+            raise ValueError(
+                f"disjoint partition infeasible: data_fraction="
+                f"{cfg.data_fraction} x {num_clients} clients > 1"
+            )
         perm = rng.permutation(n)
-        shards = np.array_split(perm, num_clients)
-        return [s[: max(1, int(len(s) * cfg.data_fraction))] for s in shards]
+        per_client = max(1, int(n * cfg.data_fraction))
+        return [
+            perm[cid * per_client : (cid + 1) * per_client]
+            for cid in range(num_clients)
+        ]
     if cfg.partition == "dirichlet":
         out: list[list[np.ndarray]] = [[] for _ in range(num_clients)]
         for cls in np.unique(labels):

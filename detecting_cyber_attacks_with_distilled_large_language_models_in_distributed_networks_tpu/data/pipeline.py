@@ -1,0 +1,130 @@
+"""Host-side pipeline: texts -> static-shape token arrays -> batch streams.
+
+The reference re-tokenizes every sample on every epoch inside
+``Dataset.__getitem__`` on the host (reference client1.py:36-50) and feeds
+bs=16 via a torch DataLoader (client1.py:370-372). Here everything is
+tokenized once into ``[N, max_len]`` int32 arrays; epochs are host-side
+permutations over device-ready numpy, so the accelerator never waits on
+Python string work.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from .cicids import ClientSplits, SplitArrays
+from .tokenizer import WordPieceTokenizer
+
+
+@dataclass
+class TokenizedSplit:
+    input_ids: np.ndarray  # [N, L] int32
+    attention_mask: np.ndarray  # [N, L] int32
+    labels: np.ndarray  # [N] int32
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+    def take(self, idx: np.ndarray) -> "TokenizedSplit":
+        return TokenizedSplit(
+            self.input_ids[idx], self.attention_mask[idx], self.labels[idx]
+        )
+
+
+@dataclass
+class TokenizedClient:
+    client_id: int
+    train: TokenizedSplit
+    val: TokenizedSplit
+    test: TokenizedSplit
+
+
+def tokenize_split(
+    split: SplitArrays, tok: WordPieceTokenizer, max_len: int
+) -> TokenizedSplit:
+    enc = tok.batch_encode(split.texts, max_len=max_len)
+    return TokenizedSplit(
+        enc["input_ids"], enc["attention_mask"], split.labels.astype(np.int32)
+    )
+
+
+def tokenize_client(
+    splits: ClientSplits, tok: WordPieceTokenizer, max_len: int
+) -> TokenizedClient:
+    return TokenizedClient(
+        splits.client_id,
+        tokenize_split(splits.train, tok, max_len),
+        tokenize_split(splits.val, tok, max_len),
+        tokenize_split(splits.test, tok, max_len),
+    )
+
+
+def batch_iterator(
+    split: TokenizedSplit,
+    batch_size: int,
+    *,
+    shuffle: bool = False,
+    seed: int | None = None,
+    drop_remainder: bool = True,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Epoch over one split. With ``drop_remainder`` every batch has the same
+    static shape (one XLA compilation); the final short batch of the
+    reference's DataLoader would retrigger compilation on TPU."""
+    n = len(split)
+    order = np.arange(n)
+    if shuffle:
+        np.random.default_rng(seed).shuffle(order)
+    stop = n - (n % batch_size) if drop_remainder else n
+    for i in range(0, stop, batch_size):
+        idx = order[i : i + batch_size]
+        yield {
+            "input_ids": split.input_ids[idx],
+            "attention_mask": split.attention_mask[idx],
+            "labels": split.labels[idx],
+        }
+
+
+def num_batches(n: int, batch_size: int, drop_remainder: bool = True) -> int:
+    return n // batch_size if drop_remainder else -(-n // batch_size)
+
+
+def pad_split_to_batch(
+    split: TokenizedSplit, batch_size: int, pad_id: int = 0
+) -> tuple[TokenizedSplit, np.ndarray]:
+    """Pad a split with PAD rows up to a batch multiple; returns the padded
+    split plus a ``[N_padded]`` validity mask. Used for eval, where every
+    example must be counted exactly once with static shapes. ``pad_id`` must
+    be the tokenizer's pad id (index of ``[PAD]`` in the active vocab)."""
+    n = len(split)
+    n_pad = (-n) % batch_size
+    if n_pad == 0:
+        return split, np.ones(n, dtype=np.int32)
+    pad_rows = np.full(
+        (n_pad, split.input_ids.shape[1]), pad_id, dtype=split.input_ids.dtype
+    )
+    zero_mask = np.zeros((n_pad, split.input_ids.shape[1]), dtype=split.attention_mask.dtype)
+    padded = TokenizedSplit(
+        np.concatenate([split.input_ids, pad_rows]),
+        np.concatenate([split.attention_mask, zero_mask]),
+        np.concatenate([split.labels, np.zeros(n_pad, dtype=split.labels.dtype)]),
+    )
+    valid = np.concatenate([np.ones(n, np.int32), np.zeros(n_pad, np.int32)])
+    return padded, valid
+
+
+def stack_clients(
+    clients: Sequence[TokenizedSplit], n_rows: int | None = None
+) -> TokenizedSplit:
+    """Stack per-client splits into ``[C, N, ...]`` arrays with a common N
+    (min across clients unless given) — the feed format for the stacked
+    federated train step, where axis 0 shards over the ``clients`` mesh axis."""
+    if n_rows is None:
+        n_rows = min(len(c) for c in clients)
+    return TokenizedSplit(
+        np.stack([c.input_ids[:n_rows] for c in clients]),
+        np.stack([c.attention_mask[:n_rows] for c in clients]),
+        np.stack([c.labels[:n_rows] for c in clients]),
+    )

@@ -1,0 +1,238 @@
+"""WordPiece tokenizer (BERT-compatible), pure Python + numpy.
+
+The reference tokenizes with HF ``DistilBertTokenizer`` loaded from a local
+``./distilbert-base-uncased`` directory that must pre-exist (reference
+client1.py:357,360-364), with ``add_special_tokens=True, max_length=128,
+padding='max_length', truncation=True`` per sample inside a torch ``Dataset``
+(reference client1.py:36-50) — i.e. tokenization re-runs every epoch on the
+host. Here tokenization is a one-shot offline batch encode into static-shape
+``[N, max_len]`` int32 arrays that feed the TPU directly.
+
+Algorithm parity: BasicTokenizer (clean, lowercase, accent-strip, punctuation
+split) + greedy longest-match WordPiece with ``##`` continuations — the exact
+scheme of BERT's reference implementation, verified in tests against
+``transformers.BertTokenizer`` (which is what DistilBertTokenizer aliases).
+
+Because this image has no pretrained vocab (zero egress), the default vocab is
+*domain-complete*: every sentence the flow-template (textualize.py) can emit
+tokenizes with zero ``[UNK]``s — template words as whole tokens, plus full
+single-character + continuation coverage of ``[a-z0-9]`` and ASCII punctuation.
+A real ``vocab.txt`` (e.g. bert-base-uncased's 30522 entries) drops in via
+``WordPieceTokenizer.from_vocab_file`` for checkpoint parity.
+"""
+
+from __future__ import annotations
+
+import string
+import unicodedata
+from collections import Counter
+from typing import Iterable, Mapping, Sequence
+
+import numpy as np
+
+PAD, UNK, CLS, SEP, MASK = "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"
+SPECIAL_TOKENS = (PAD, UNK, CLS, SEP, MASK)
+
+#: Whole words appearing in the flow-text template (textualize.py), lowercased.
+TEMPLATE_WORDS: tuple[str, ...] = (
+    "destination", "port", "is", "flow", "duration", "microseconds",
+    "total", "forward", "packets", "are", "backward", "length", "of",
+    "bytes", "maximum", "packet", "minimum", "per", "second", "nan", "inf",
+)
+
+
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_control(ch: str) -> bool:
+    if ch in ("\t", "\n", "\r"):
+        return False
+    return unicodedata.category(ch).startswith("C")
+
+
+def _is_whitespace(ch: str) -> bool:
+    if ch in (" ", "\t", "\n", "\r"):
+        return True
+    return unicodedata.category(ch) == "Zs"
+
+
+def basic_tokenize(text: str, lowercase: bool = True) -> list[str]:
+    """BERT BasicTokenizer: clean, whitespace-split, lowercase + accent-strip,
+    split punctuation into standalone tokens."""
+    cleaned = []
+    for ch in text:
+        cp = ord(ch)
+        if cp == 0 or cp == 0xFFFD or _is_control(ch):
+            continue
+        cleaned.append(" " if _is_whitespace(ch) else ch)
+    out: list[str] = []
+    for word in "".join(cleaned).split():
+        if lowercase:
+            word = word.lower()
+            word = "".join(
+                c for c in unicodedata.normalize("NFD", word)
+                if unicodedata.category(c) != "Mn"
+            )
+        cur: list[str] = []
+        for ch in word:
+            if _is_punctuation(ch):
+                if cur:
+                    out.append("".join(cur))
+                    cur = []
+                out.append(ch)
+            else:
+                cur.append(ch)
+        if cur:
+            out.append("".join(cur))
+    return out
+
+
+def build_domain_vocab(
+    corpus: Iterable[str] | None = None,
+    max_corpus_words: int = 10000,
+    min_freq: int = 1,
+) -> list[str]:
+    """Vocab that fully covers the flow-text domain; optionally extended with
+    frequent whole words from a corpus (most-frequent first, deterministic)."""
+    vocab: list[str] = list(SPECIAL_TOKENS)
+    seen = set(vocab)
+
+    def _add(tok: str) -> None:
+        if tok and tok not in seen:
+            vocab.append(tok)
+            seen.add(tok)
+
+    for w in TEMPLATE_WORDS:
+        _add(w)
+    base_chars = string.ascii_lowercase + string.digits
+    for c in base_chars:
+        _add(c)
+        _add("##" + c)
+    for c in string.punctuation:
+        _add(c)
+    if corpus is not None:
+        counts: Counter[str] = Counter()
+        for text in corpus:
+            for tok in basic_tokenize(text):
+                counts[tok] += 1
+        for tok, freq in sorted(counts.items(), key=lambda kv: (-kv[1], kv[0])):
+            if freq < min_freq or len(seen) - len(SPECIAL_TOKENS) >= max_corpus_words:
+                break
+            _add(tok)
+    return vocab
+
+
+class WordPieceTokenizer:
+    """Greedy longest-match WordPiece over a BasicTokenizer pre-split."""
+
+    def __init__(
+        self,
+        vocab: Sequence[str] | Mapping[str, int],
+        lowercase: bool = True,
+        max_input_chars_per_word: int = 100,
+    ):
+        if isinstance(vocab, Mapping):
+            self.vocab: dict[str, int] = dict(vocab)
+        else:
+            self.vocab = {tok: i for i, tok in enumerate(vocab)}
+        if len(self.vocab) < len(SPECIAL_TOKENS):
+            raise ValueError("vocab too small")
+        for tok in SPECIAL_TOKENS:
+            if tok not in self.vocab:
+                raise ValueError(f"vocab missing special token {tok}")
+        self.inv_vocab = {i: t for t, i in self.vocab.items()}
+        self.lowercase = lowercase
+        self.max_input_chars_per_word = max_input_chars_per_word
+        self.pad_id = self.vocab[PAD]
+        self.unk_id = self.vocab[UNK]
+        self.cls_id = self.vocab[CLS]
+        self.sep_id = self.vocab[SEP]
+        self._word_cache: dict[str, list[int]] = {}
+
+    def __len__(self) -> int:
+        return len(self.vocab)
+
+    @classmethod
+    def from_vocab_file(cls, path: str, **kw) -> "WordPieceTokenizer":
+        with open(path, encoding="utf-8") as f:
+            tokens = [line.rstrip("\n") for line in f if line.rstrip("\n")]
+        return cls(tokens, **kw)
+
+    def save_vocab(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            for tok, _ in sorted(self.vocab.items(), key=lambda kv: kv[1]):
+                f.write(tok + "\n")
+
+    def _wordpiece(self, word: str) -> list[int]:
+        # Flow text is dominated by unique numeric strings — caching those
+        # would grow without bound at near-zero hit rate. Cache only
+        # alphabetic words (template vocabulary), which repeat constantly.
+        cacheable = word.isalpha() and len(self._word_cache) < 65536
+        cached = self._word_cache.get(word) if cacheable else None
+        if cached is not None:
+            return cached
+        if len(word) > self.max_input_chars_per_word:
+            ids = [self.unk_id]
+        else:
+            ids = []
+            start = 0
+            n = len(word)
+            while start < n:
+                end = n
+                piece_id = None
+                while start < end:
+                    sub = word[start:end]
+                    if start > 0:
+                        sub = "##" + sub
+                    pid = self.vocab.get(sub)
+                    if pid is not None:
+                        piece_id = pid
+                        break
+                    end -= 1
+                if piece_id is None:
+                    ids = [self.unk_id]
+                    break
+                ids.append(piece_id)
+                start = end
+        if cacheable:
+            self._word_cache[word] = ids
+        return ids
+
+    def tokenize(self, text: str) -> list[str]:
+        return [
+            self.inv_vocab[i]
+            for w in basic_tokenize(text, self.lowercase)
+            for i in self._wordpiece(w)
+        ]
+
+    def encode(self, text: str, max_len: int | None = None) -> list[int]:
+        """``[CLS] pieces... [SEP]`` truncated to ``max_len`` (specials kept),
+        matching HF ``add_special_tokens=True, truncation=True``."""
+        ids = [
+            i for w in basic_tokenize(text, self.lowercase) for i in self._wordpiece(w)
+        ]
+        if max_len is not None:
+            ids = ids[: max_len - 2]
+        return [self.cls_id, *ids, self.sep_id]
+
+    def batch_encode(
+        self, texts: Sequence[str], max_len: int = 128
+    ) -> dict[str, np.ndarray]:
+        """Static-shape ``[N, max_len]`` int32 ``input_ids`` + ``attention_mask``
+        (the TPU feed format; equivalent to HF ``padding='max_length'``)."""
+        n = len(texts)
+        input_ids = np.full((n, max_len), self.pad_id, dtype=np.int32)
+        attention_mask = np.zeros((n, max_len), dtype=np.int32)
+        for r, text in enumerate(texts):
+            ids = self.encode(text, max_len)
+            input_ids[r, : len(ids)] = ids
+            attention_mask[r, : len(ids)] = 1
+        return {"input_ids": input_ids, "attention_mask": attention_mask}
+
+
+def default_tokenizer() -> WordPieceTokenizer:
+    return WordPieceTokenizer(build_domain_vocab())

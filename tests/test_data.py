@@ -100,13 +100,15 @@ def test_split_disjoint_and_complete():
 
 def test_disjoint_partition():
     labels = np.zeros(1000, dtype=np.int32)
-    cfg = DataConfig(partition="disjoint", data_fraction=0.5)
+    cfg = DataConfig(partition="disjoint", data_fraction=0.2)
     parts = partition_indices(labels, 4, cfg)
     assert len(parts) == 4
     flat = np.concatenate(parts)
     assert len(np.unique(flat)) == len(flat)  # disjoint
     for p in parts:
-        assert len(p) == 125  # 1000/4 * 0.5
+        assert len(p) == 200  # data_fraction is per-dataset: 1000 * 0.2
+    with pytest.raises(ValueError, match="infeasible"):
+        partition_indices(labels, 4, DataConfig(partition="disjoint", data_fraction=0.5))
 
 
 def test_dirichlet_partition_skews_labels():

@@ -1,0 +1,142 @@
+"""Tokenizer parity vs transformers.BertTokenizer (same vocab file, offline)."""
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+    WordPieceTokenizer,
+    basic_tokenize,
+    build_domain_vocab,
+    default_tokenizer,
+    make_synthetic_flows,
+    texts_from_dataframe,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.pipeline import (
+    TokenizedSplit,
+    batch_iterator,
+    pad_split_to_batch,
+    stack_clients,
+)
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    df = make_synthetic_flows(200, seed=5)
+    return texts_from_dataframe(df)
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return default_tokenizer()
+
+
+def test_basic_tokenize():
+    assert basic_tokenize("Destination port is 443.") == [
+        "destination", "port", "is", "443", ".",
+    ]
+    assert basic_tokenize("Flow bytes/s: -1.5e+07!") == [
+        "flow", "bytes", "/", "s", ":", "-", "1", ".", "5e", "+", "07", "!",
+    ]
+    assert basic_tokenize("  \t\n  ") == []
+    assert basic_tokenize("Héllo") == ["hello"]  # accent strip
+
+
+def test_domain_vocab_covers_template_with_zero_unk(tok, corpus):
+    for text in corpus:
+        ids = tok.encode(text)
+        assert tok.unk_id not in ids, text
+
+
+def test_encode_structure(tok):
+    ids = tok.encode("Destination port is 443.", max_len=128)
+    assert ids[0] == tok.cls_id and ids[-1] == tok.sep_id
+    toks = tok.tokenize("port is 80")
+    assert toks == ["port", "is", "8", "##0"]
+
+
+def test_truncation(tok):
+    long_text = "packet " * 500
+    ids = tok.encode(long_text, max_len=16)
+    assert len(ids) == 16
+    assert ids[0] == tok.cls_id and ids[-1] == tok.sep_id
+
+
+def test_batch_encode_shapes_and_mask(tok, corpus):
+    enc = tok.batch_encode(corpus[:10], max_len=128)
+    assert enc["input_ids"].shape == (10, 128)
+    assert enc["input_ids"].dtype == np.int32
+    lens = enc["attention_mask"].sum(axis=1)
+    assert (lens > 10).all() and (lens <= 128).all()
+    # mask exactly covers non-pad positions
+    assert ((enc["input_ids"] != tok.pad_id) == enc["attention_mask"].astype(bool)).all()
+
+
+def test_parity_vs_hf_bert_tokenizer(tok, corpus, tmp_path):
+    transformers = pytest.importorskip("transformers")
+    vocab_path = tmp_path / "vocab.txt"
+    tok.save_vocab(str(vocab_path))
+    hf = transformers.BertTokenizer(str(vocab_path), do_lower_case=True)
+    probes = corpus[:25] + [
+        "Flow bytes per second is -1.5e+07.",
+        "UNKNOWNWORD xyzzy 99999999999999999999",
+        "Héllo,   world!!  ",
+    ]
+    for text in probes:
+        ours = tok.encode(text, max_len=128)
+        theirs = hf.encode(text, add_special_tokens=True, max_length=128, truncation=True)
+        assert ours == theirs, text
+    # batch path vs HF padded path (reference client1.py:38-45 semantics)
+    enc = tok.batch_encode(probes, max_len=128)
+    hf_enc = hf(probes, add_special_tokens=True, max_length=128,
+                padding="max_length", truncation=True)
+    np.testing.assert_array_equal(enc["input_ids"], np.array(hf_enc["input_ids"], np.int32))
+    np.testing.assert_array_equal(
+        enc["attention_mask"], np.array(hf_enc["attention_mask"], np.int32)
+    )
+
+
+def test_vocab_file_round_trip(tok, tmp_path):
+    p = tmp_path / "v.txt"
+    tok.save_vocab(str(p))
+    tok2 = WordPieceTokenizer.from_vocab_file(str(p))
+    assert tok2.vocab == tok.vocab
+
+
+def test_corpus_vocab_extension(corpus):
+    vocab = build_domain_vocab(corpus)
+    tok = WordPieceTokenizer(vocab)
+    # whole template words became single tokens
+    assert "destination" in tok.vocab and "microseconds" in tok.vocab
+
+
+def _mk_split(n=37, L=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return TokenizedSplit(
+        rng.integers(1, 50, (n, L)).astype(np.int32),
+        np.ones((n, L), np.int32),
+        rng.integers(0, 2, n).astype(np.int32),
+    )
+
+
+def test_batch_iterator_static_shapes():
+    s = _mk_split(37)
+    batches = list(batch_iterator(s, 8, shuffle=True, seed=1))
+    assert len(batches) == 4  # drop remainder
+    assert all(b["input_ids"].shape == (8, 16) for b in batches)
+    # shuffle deterministic by seed
+    b2 = list(batch_iterator(s, 8, shuffle=True, seed=1))
+    np.testing.assert_array_equal(batches[0]["labels"], b2[0]["labels"])
+
+
+def test_pad_split_to_batch():
+    s = _mk_split(37)
+    padded, valid = pad_split_to_batch(s, 8)
+    assert len(padded) == 40 and valid.sum() == 37
+    np.testing.assert_array_equal(padded.input_ids[:37], s.input_ids)
+
+
+def test_stack_clients():
+    a, b = _mk_split(20, seed=1), _mk_split(30, seed=2)
+    stacked = stack_clients([a, b])
+    assert stacked.input_ids.shape == (2, 20, 16)
+    np.testing.assert_array_equal(stacked.labels[1], b.labels[:20])
