@@ -28,6 +28,7 @@ class ModelConfig:
 
     vocab_size: int = 30522
     max_len: int = 128
+    max_position_embeddings: int = 512  # HF DistilBERT position-table size
     dim: int = 768
     n_layers: int = 6
     n_heads: int = 12
@@ -46,6 +47,14 @@ class ModelConfig:
     # (sequence-parallel ring attention over a mesh axis).
     attention_impl: str = "dot"
     remat: bool = False
+
+    def __post_init__(self) -> None:
+        if self.max_len > self.max_position_embeddings:
+            raise ValueError(
+                f"max_len={self.max_len} exceeds the position-embedding table "
+                f"(max_position_embeddings={self.max_position_embeddings}); "
+                "XLA would silently clamp position indices"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -71,6 +80,7 @@ class ModelConfig:
         """Small config for tests / CI on CPU."""
         kw.setdefault("vocab_size", 256)
         kw.setdefault("max_len", 32)
+        kw.setdefault("max_position_embeddings", 64)
         kw.setdefault("dim", 32)
         kw.setdefault("n_layers", 2)
         kw.setdefault("n_heads", 2)

@@ -55,8 +55,11 @@ def partition_indices(
 ) -> list[np.ndarray]:
     """Row indices per client for the 'disjoint' and 'dirichlet' schemes.
 
-    * disjoint: one global permutation (seed_base), equal contiguous shards,
-      then each client keeps ``data_fraction`` of its shard.
+    ``data_fraction`` is always per-dataset (same convention across schemes):
+
+    * disjoint: one global permutation (seed_base); each client gets
+      ``frac * n`` rows, disjoint across clients (requires
+      ``frac * num_clients <= 1``).
     * dirichlet: classic label-skew — for each class, split its rows among
       clients by Dirichlet(alpha) proportions (non-IID knob the reference
       never had; BASELINE.json config 3).

@@ -170,8 +170,9 @@ class WordPieceTokenizer:
     def _wordpiece(self, word: str) -> list[int]:
         # Flow text is dominated by unique numeric strings — caching those
         # would grow without bound at near-zero hit rate. Cache only
-        # alphabetic words (template vocabulary), which repeat constantly.
-        cacheable = word.isalpha() and len(self._word_cache) < 65536
+        # alphabetic words (template vocabulary), which repeat constantly;
+        # the size cap bounds insertions only — lookups always hit.
+        cacheable = word.isalpha()
         cached = self._word_cache.get(word) if cacheable else None
         if cached is not None:
             return cached
@@ -198,7 +199,7 @@ class WordPieceTokenizer:
                     break
                 ids.append(piece_id)
                 start = end
-        if cacheable:
+        if cacheable and len(self._word_cache) < 65536:
             self._word_cache[word] = ids
         return ids
 

@@ -1,0 +1,7 @@
+from .distilbert import (  # noqa: F401
+    DDoSClassifier,
+    DistilBertEncoder,
+    init_params,
+    param_count,
+)
+from .hf_convert import flax_to_hf, hf_to_flax  # noqa: F401

@@ -1,0 +1,204 @@
+"""Flax DistilBERT encoder + DDoS classification head.
+
+Re-implements, TPU-first, what the reference gets from HF PyTorch
+(``DistilBertModel`` at reference client1.py:56,61): embeddings (word +
+learned position, LayerNorm eps 1e-12), N post-LayerNorm transformer blocks
+(MHA -> residual -> LN -> exact-GELU FFN -> residual -> LN), followed by the
+reference's head: CLS pooling -> Dropout(0.3) -> Linear(dim, 2) (reference
+client1.py:57-58,62-64).
+
+Design notes (TPU):
+* depth/width come from ``ModelConfig`` — the same module is DistilBERT-base
+  (6 layers) or BERT-base scale-up (12 layers, BASELINE.json config 4).
+* activations in ``cfg.compute_dtype`` (bf16 by default) keep the MXU fed;
+  params stay fp32; softmax and LayerNorm statistics run in fp32.
+* no data-dependent control flow — one ``jit`` trace, static shapes.
+* optional ``jax.checkpoint`` (remat) per block trades FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+from ..ops.attention import dot_product_attention, make_attention_bias
+
+
+def _dtype(name: str):
+    return jnp.dtype(name)
+
+
+class MultiHeadSelfAttention(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x, bias, deterministic: bool):
+        cfg = self.cfg
+        dense = lambda name: nn.Dense(  # noqa: E731
+            cfg.dim,
+            dtype=_dtype(cfg.compute_dtype),
+            param_dtype=_dtype(cfg.param_dtype),
+            kernel_init=nn.initializers.normal(cfg.initializer_range),
+            name=name,
+        )
+        B, L, _ = x.shape
+        heads = cfg.n_heads
+        d = cfg.head_dim
+
+        def split(t):  # [B, L, dim] -> [B, H, L, d]
+            return t.reshape(B, L, heads, d).transpose(0, 2, 1, 3)
+
+        q, k, v = split(dense("q")(x)), split(dense("k")(x)), split(dense("v")(x))
+        dropout_rng = (
+            None
+            if deterministic or cfg.attention_dropout == 0.0
+            else self.make_rng("dropout")
+        )
+        if cfg.attention_impl == "flash":
+            # NOTE: the Pallas kernel does not apply attention dropout; use it
+            # for eval/inference or with attention_dropout=0.
+            from ..ops.flash_attention import flash_attention
+
+            ctx = flash_attention(q, k, v, bias)
+        elif cfg.attention_impl == "ring":
+            from ..parallel.ring_attention import ring_attention
+
+            ctx = ring_attention(q, k, v, bias)
+        elif cfg.attention_impl == "dot":
+            ctx = dot_product_attention(
+                q, k, v, bias,
+                dropout_rate=cfg.attention_dropout,
+                dropout_rng=dropout_rng,
+                deterministic=deterministic,
+            )
+        else:
+            raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, L, cfg.dim)
+        return dense("o")(ctx)
+
+
+class TransformerBlock(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x, bias, deterministic: bool):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=cfg.layer_norm_eps,
+            dtype=_dtype(cfg.compute_dtype),
+            param_dtype=_dtype(cfg.param_dtype),
+            name=name,
+        )
+        attn_out = MultiHeadSelfAttention(cfg, name="attn")(x, bias, deterministic)
+        attn_out = nn.Dropout(cfg.dropout)(attn_out, deterministic=deterministic)
+        x = ln("sa_ln")(x + attn_out)
+
+        h = nn.Dense(
+            cfg.hidden_dim,
+            dtype=_dtype(cfg.compute_dtype),
+            param_dtype=_dtype(cfg.param_dtype),
+            kernel_init=nn.initializers.normal(cfg.initializer_range),
+            name="lin1",
+        )(x)
+        h = jax.nn.gelu(h, approximate=False)  # HF 'gelu' = exact erf form
+        h = nn.Dense(
+            cfg.dim,
+            dtype=_dtype(cfg.compute_dtype),
+            param_dtype=_dtype(cfg.param_dtype),
+            kernel_init=nn.initializers.normal(cfg.initializer_range),
+            name="lin2",
+        )(h)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return ln("out_ln")(x + h)
+
+
+class Embeddings(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic: bool):
+        cfg = self.cfg
+        word = nn.Embed(
+            cfg.vocab_size,
+            cfg.dim,
+            dtype=_dtype(cfg.compute_dtype),
+            param_dtype=_dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(cfg.initializer_range),
+            name="word_embeddings",
+        )(input_ids)
+        L = input_ids.shape[-1]
+        pos_table = nn.Embed(
+            cfg.max_position_embeddings,
+            cfg.dim,
+            dtype=_dtype(cfg.compute_dtype),
+            param_dtype=_dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(cfg.initializer_range),
+            name="position_embeddings",
+        )
+        pos = pos_table(jnp.arange(L, dtype=jnp.int32))[None, :, :]
+        x = word + pos
+        x = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps,
+            dtype=_dtype(cfg.compute_dtype),
+            param_dtype=_dtype(cfg.param_dtype),
+            name="ln",
+        )(x)
+        return nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+
+class DistilBertEncoder(nn.Module):
+    """Token ids + attention mask -> last hidden states ``[B, L, dim]``."""
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask, deterministic: bool = True):
+        cfg = self.cfg
+        x = Embeddings(cfg, name="embeddings")(input_ids, deterministic)
+        bias = make_attention_bias(attention_mask)
+        block = TransformerBlock
+        if cfg.remat:
+            # static_argnums counts self: (self, x, bias, deterministic)
+            block = nn.remat(TransformerBlock, static_argnums=(3,))
+        for i in range(cfg.n_layers):
+            x = block(cfg, name=f"layer_{i}")(x, bias, deterministic)
+        return x
+
+
+class DDoSClassifier(nn.Module):
+    """Encoder + the reference's classification head (client1.py:53-65)."""
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask, deterministic: bool = True):
+        cfg = self.cfg
+        hidden = DistilBertEncoder(cfg, name="encoder")(
+            input_ids, attention_mask, deterministic
+        )
+        pooled = hidden[:, 0, :]  # CLS token (reference client1.py:62)
+        pooled = nn.Dropout(cfg.head_dropout)(pooled, deterministic=deterministic)
+        logits = nn.Dense(
+            cfg.n_classes,
+            dtype=jnp.float32,  # head + loss in fp32
+            param_dtype=_dtype(cfg.param_dtype),
+            kernel_init=nn.initializers.normal(cfg.initializer_range),
+            name="classifier",
+        )(pooled.astype(jnp.float32))
+        return logits
+
+
+def init_params(
+    model: nn.Module, cfg: ModelConfig, rng: jax.Array, batch_size: int = 2
+) -> Any:
+    dummy_ids = jnp.zeros((batch_size, cfg.max_len), jnp.int32)
+    dummy_mask = jnp.ones((batch_size, cfg.max_len), jnp.int32)
+    return model.init({"params": rng}, dummy_ids, dummy_mask, True)["params"]
+
+
+def param_count(params: Any) -> int:
+    return sum(int(x.size) for x in jax.tree.leaves(params))

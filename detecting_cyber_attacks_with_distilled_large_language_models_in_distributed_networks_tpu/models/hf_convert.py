@@ -1,0 +1,143 @@
+"""HF DistilBERT checkpoint <-> Flax param pytree conversion.
+
+The reference warm-starts from HF ``distilbert-base-uncased`` weights
+(reference client1.py:56) and round-trips full ``state_dict``s through its
+socket protocol. This converter maps a torch ``state_dict`` (either a bare
+``DistilBertModel`` or the reference's full ``DDoSClassifier`` with its
+``distilbert.`` prefix + ``classifier`` head, client1.py:53-58) into this
+package's Flax layout, transposing ``nn.Linear`` weights ([out,in] ->
+[in,out]). No torch import is required — any mapping of name -> array-like
+works (e.g. numpy arrays loaded from a safetensors file).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from ..config import ModelConfig
+
+
+def _np(t: Any) -> np.ndarray:
+    """torch.Tensor / numpy array -> float32 numpy, without importing torch."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _strip_prefix(sd: Mapping[str, Any]) -> tuple[dict[str, Any], bool]:
+    """Normalize to bare-encoder key space; returns (dict, had_classifier)."""
+    out: dict[str, Any] = {}
+    has_head = False
+    for k, v in sd.items():
+        if k.startswith("distilbert."):
+            out[k[len("distilbert.") :]] = v
+        elif k.startswith("classifier."):
+            out[k] = v
+            has_head = True
+        else:
+            out[k] = v
+    return out, has_head
+
+
+def hf_to_flax(
+    state_dict: Mapping[str, Any], cfg: ModelConfig, head_rng: np.random.Generator | None = None
+) -> dict:
+    """Torch/HF state dict -> Flax ``DDoSClassifier`` params.
+
+    If the state dict has no classifier head (a bare encoder checkpoint, the
+    reference's starting condition), the head is initialized from
+    ``head_rng`` (normal(initializer_range), zero bias) — mirroring the fresh
+    ``nn.Linear(768, 2)`` at reference client1.py:58.
+    """
+    sd, has_head = _strip_prefix(state_dict)
+
+    def lin(prefix: str) -> dict:
+        return {
+            "kernel": _np(sd[f"{prefix}.weight"]).T,
+            "bias": _np(sd[f"{prefix}.bias"]),
+        }
+
+    def ln(prefix: str) -> dict:
+        return {
+            "scale": _np(sd[f"{prefix}.weight"]),
+            "bias": _np(sd[f"{prefix}.bias"]),
+        }
+
+    encoder: dict[str, Any] = {
+        "embeddings": {
+            "word_embeddings": {
+                "embedding": _np(sd["embeddings.word_embeddings.weight"])
+            },
+            "position_embeddings": {
+                "embedding": _np(sd["embeddings.position_embeddings.weight"])
+            },
+            "ln": ln("embeddings.LayerNorm"),
+        }
+    }
+    for i in range(cfg.n_layers):
+        p = f"transformer.layer.{i}"
+        encoder[f"layer_{i}"] = {
+            "attn": {
+                "q": lin(f"{p}.attention.q_lin"),
+                "k": lin(f"{p}.attention.k_lin"),
+                "v": lin(f"{p}.attention.v_lin"),
+                "o": lin(f"{p}.attention.out_lin"),
+            },
+            "sa_ln": ln(f"{p}.sa_layer_norm"),
+            "lin1": lin(f"{p}.ffn.lin1"),
+            "lin2": lin(f"{p}.ffn.lin2"),
+            "out_ln": ln(f"{p}.output_layer_norm"),
+        }
+
+    if has_head:
+        head = lin("classifier")
+    else:
+        rng = head_rng or np.random.default_rng(0)
+        head = {
+            "kernel": rng.normal(0, cfg.initializer_range, (cfg.dim, cfg.n_classes)).astype(
+                np.float32
+            ),
+            "bias": np.zeros((cfg.n_classes,), np.float32),
+        }
+    return {"encoder": encoder, "classifier": head}
+
+
+def flax_to_hf(params: Mapping[str, Any], cfg: ModelConfig) -> dict[str, np.ndarray]:
+    """Inverse mapping, producing the reference's full-classifier key space
+    (``distilbert.*`` + ``classifier.*``) as numpy arrays — e.g. to export a
+    checkpoint a reference client could load."""
+    enc = params["encoder"]
+
+    out: dict[str, np.ndarray] = {}
+
+    def put_lin(prefix: str, p: Mapping[str, Any]) -> None:
+        out[f"{prefix}.weight"] = np.asarray(p["kernel"]).T.astype(np.float32)
+        out[f"{prefix}.bias"] = np.asarray(p["bias"]).astype(np.float32)
+
+    def put_ln(prefix: str, p: Mapping[str, Any]) -> None:
+        out[f"{prefix}.weight"] = np.asarray(p["scale"]).astype(np.float32)
+        out[f"{prefix}.bias"] = np.asarray(p["bias"]).astype(np.float32)
+
+    emb = enc["embeddings"]
+    out["distilbert.embeddings.word_embeddings.weight"] = np.asarray(
+        emb["word_embeddings"]["embedding"], dtype=np.float32
+    )
+    out["distilbert.embeddings.position_embeddings.weight"] = np.asarray(
+        emb["position_embeddings"]["embedding"], dtype=np.float32
+    )
+    put_ln("distilbert.embeddings.LayerNorm", emb["ln"])
+    for i in range(cfg.n_layers):
+        p = f"distilbert.transformer.layer.{i}"
+        layer = enc[f"layer_{i}"]
+        put_lin(f"{p}.attention.q_lin", layer["attn"]["q"])
+        put_lin(f"{p}.attention.k_lin", layer["attn"]["k"])
+        put_lin(f"{p}.attention.v_lin", layer["attn"]["v"])
+        put_lin(f"{p}.attention.out_lin", layer["attn"]["o"])
+        put_ln(f"{p}.sa_layer_norm", layer["sa_ln"])
+        put_lin(f"{p}.ffn.lin1", layer["lin1"])
+        put_lin(f"{p}.ffn.lin2", layer["lin2"])
+        put_ln(f"{p}.output_layer_norm", layer["out_ln"])
+    put_lin("classifier", params["classifier"])
+    return out

@@ -1,0 +1,6 @@
+from .attention import dot_product_attention, make_attention_bias  # noqa: F401
+from .metrics import (  # noqa: F401
+    BinaryCounts,
+    binary_counts,
+    finalize_metrics,
+)

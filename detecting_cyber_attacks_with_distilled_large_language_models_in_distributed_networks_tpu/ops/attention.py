@@ -1,0 +1,60 @@
+"""Attention ops.
+
+The reference's attention is whatever HF ``DistilBertModel`` does inside
+PyTorch (reference client1.py:61). Here it is explicit and TPU-shaped:
+
+* ``dot``   — einsum attention; XLA fuses mask+softmax+matmul chains onto the
+              MXU. Scores/softmax run in fp32 even under bf16 activations.
+* ``flash`` — Pallas blocked flash-attention kernel (ops/flash_attention.py),
+              O(L) memory, VMEM-tiled.
+* ``ring``  — sequence-parallel blockwise attention over a mesh axis
+              (parallel/ring_attention.py) for long-context.
+
+All variants consume the same ``[B, H, L, D]`` tensors and an additive bias.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9  # large-negative mask addend; safe in fp32 softmax
+
+
+def make_attention_bias(attention_mask: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """``[B, L]`` 0/1 mask -> additive ``[B, 1, 1, L]`` bias (0 keep, -1e9 drop).
+
+    Matches HF DistilBERT's masked_fill of key positions where mask==0.
+    """
+    bias = (1.0 - attention_mask.astype(dtype)) * NEG_INF
+    return bias[:, None, None, :]
+
+
+def dot_product_attention(
+    q: jnp.ndarray,  # [B, H, Lq, D]
+    k: jnp.ndarray,  # [B, H, Lk, D]
+    v: jnp.ndarray,  # [B, H, Lk, D]
+    bias: jnp.ndarray | None = None,  # additive, broadcastable to [B, H, Lq, Lk]
+    *,
+    dropout_rate: float = 0.0,
+    dropout_rng: jax.Array | None = None,
+    deterministic: bool = True,
+) -> jnp.ndarray:
+    """Scaled dot-product attention with fp32 softmax.
+
+    Scores accumulate in fp32 on the MXU (``preferred_element_type``) so bf16
+    activations don't lose the softmax; output returns to q's dtype.
+    """
+    depth = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(depth, jnp.float32))
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    weights = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0 and not deterministic:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, weights.shape)
+        weights = weights * keep / (1.0 - dropout_rate)
+    weights = weights.astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)

@@ -1,0 +1,91 @@
+"""On-device classification metrics.
+
+The reference accumulates predictions on the host and calls sklearn per eval
+(reference client1.py:118-150: ``precision_recall_fscore_support``,
+``confusion_matrix``). Here the eval step accumulates sufficient statistics
+(loss sum, correct count, TP/FP/FN/TN) on device — one scalar pytree per
+batch, no [N]-sized host transfers — and the host finalizes the same five
+metrics (Accuracy, Loss, Precision, Recall, F1) plus the confusion matrix.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class BinaryCounts(NamedTuple):
+    """Sufficient statistics for binary classification metrics."""
+
+    loss_sum: jnp.ndarray  # fp32 scalar — sum of per-batch mean losses
+    n_batches: jnp.ndarray  # fp32 scalar
+    n_examples: jnp.ndarray  # fp32 scalar
+    correct: jnp.ndarray  # fp32 scalar
+    tp: jnp.ndarray
+    fp: jnp.ndarray
+    fn: jnp.ndarray
+    tn: jnp.ndarray
+
+    @classmethod
+    def zero(cls) -> "BinaryCounts":
+        z = jnp.zeros((), jnp.float32)
+        return cls(z, z, z, z, z, z, z, z)
+
+    def __add__(self, other: "BinaryCounts") -> "BinaryCounts":  # type: ignore[override]
+        return BinaryCounts(*(a + b for a, b in zip(self, other)))
+
+
+def binary_counts(
+    logits: jnp.ndarray,  # [B, 2]
+    labels: jnp.ndarray,  # [B]
+    loss: jnp.ndarray,  # scalar — batch mean loss
+    valid: jnp.ndarray | None = None,  # [B] 0/1 — padded-row mask
+) -> BinaryCounts:
+    preds = jnp.argmax(logits, axis=-1)
+    if valid is None:
+        valid = jnp.ones_like(labels)
+    v = valid.astype(jnp.float32)
+    pos = (labels == 1).astype(jnp.float32) * v
+    neg = (labels == 0).astype(jnp.float32) * v
+    pred_pos = (preds == 1).astype(jnp.float32)
+    pred_neg = (preds == 0).astype(jnp.float32)
+    return BinaryCounts(
+        loss_sum=loss.astype(jnp.float32),
+        n_batches=jnp.asarray(1.0, jnp.float32),
+        n_examples=v.sum(),
+        correct=((preds == labels).astype(jnp.float32) * v).sum(),
+        tp=(pos * pred_pos).sum(),
+        fp=(neg * pred_pos).sum(),
+        fn=(pos * pred_neg).sum(),
+        tn=(neg * pred_neg).sum(),
+    )
+
+
+def finalize_metrics(counts: BinaryCounts) -> dict[str, float]:
+    """Host-side finalization into the reference's five-metric schema
+    (Accuracy in percent, as at reference client1.py:143) + confusion matrix.
+
+    Precision/recall/F1 follow sklearn's ``average='binary'`` zero-division
+    convention (0.0 when undefined)."""
+    c = {k: float(v) for k, v in counts._asdict().items()}
+    n = max(c["n_examples"], 1.0)
+    precision = c["tp"] / (c["tp"] + c["fp"]) if (c["tp"] + c["fp"]) > 0 else 0.0
+    recall = c["tp"] / (c["tp"] + c["fn"]) if (c["tp"] + c["fn"]) > 0 else 0.0
+    f1 = (
+        2 * precision * recall / (precision + recall)
+        if (precision + recall) > 0
+        else 0.0
+    )
+    return {
+        "Accuracy": 100.0 * c["correct"] / n,
+        "Loss": c["loss_sum"] / max(c["n_batches"], 1.0),
+        "Precision": precision,
+        "Recall": recall,
+        "F1-Score": f1,
+        "confusion_matrix": np.array(
+            [[c["tn"], c["fp"]], [c["fn"], c["tp"]]], dtype=np.int64
+        ),
+        "n": int(c["n_examples"]),
+    }

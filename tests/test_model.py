@@ -1,0 +1,160 @@
+"""Model tests: shapes, determinism, and numerical parity vs HF torch DistilBERT."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+    ModelConfig,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.models import (
+    DDoSClassifier,
+    DistilBertEncoder,
+    flax_to_hf,
+    hf_to_flax,
+    init_params,
+    param_count,
+)
+
+TINY = ModelConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    model = DDoSClassifier(TINY)
+    return init_params(model, TINY, jax.random.key(0))
+
+
+def _batch(cfg, B=4, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(5, cfg.vocab_size, (B, cfg.max_len)).astype(np.int32)
+    lens = rng.integers(4, cfg.max_len, B)
+    mask = (np.arange(cfg.max_len)[None, :] < lens[:, None]).astype(np.int32)
+    ids = np.where(mask == 1, ids, 0)
+    return ids, mask
+
+
+def test_forward_shapes_and_dtype(tiny_params):
+    model = DDoSClassifier(TINY)
+    ids, mask = _batch(TINY)
+    logits = model.apply({"params": tiny_params}, ids, mask)
+    assert logits.shape == (4, 2)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_padding_invariance(tiny_params):
+    """Masked positions must not affect the CLS logits."""
+    model = DDoSClassifier(TINY)
+    ids, mask = _batch(TINY, B=2, seed=1)
+    logits_a = model.apply({"params": tiny_params}, ids, mask)
+    ids_b = np.where(mask == 1, ids, 7)  # garbage in padded region
+    logits_b = model.apply({"params": tiny_params}, ids_b, mask)
+    np.testing.assert_allclose(np.asarray(logits_a), np.asarray(logits_b), atol=1e-5)
+
+
+def test_dropout_train_vs_eval(tiny_params):
+    model = DDoSClassifier(TINY)
+    ids, mask = _batch(TINY)
+    e1 = model.apply({"params": tiny_params}, ids, mask, True)
+    e2 = model.apply({"params": tiny_params}, ids, mask, True)
+    np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
+    t1 = model.apply(
+        {"params": tiny_params}, ids, mask, False, rngs={"dropout": jax.random.key(1)}
+    )
+    t2 = model.apply(
+        {"params": tiny_params}, ids, mask, False, rngs={"dropout": jax.random.key(2)}
+    )
+    assert np.abs(np.asarray(t1) - np.asarray(t2)).max() > 1e-6
+
+
+def test_param_count_distilbert_base():
+    cfg = ModelConfig()  # distilbert-base
+    params = init_params(DistilBertEncoder(cfg), cfg, jax.random.key(0))
+    n = param_count(params)
+    assert n == 66_362_880  # HF distilbert-base-uncased encoder size
+
+
+def _hf_reference(cfg: ModelConfig, seed: int = 0):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    torch.manual_seed(seed)
+    hf_cfg = transformers.DistilBertConfig(
+        vocab_size=cfg.vocab_size,
+        max_position_embeddings=cfg.max_position_embeddings,
+        n_layers=cfg.n_layers,
+        n_heads=cfg.n_heads,
+        dim=cfg.dim,
+        hidden_dim=cfg.hidden_dim,
+        dropout=cfg.dropout,
+        attention_dropout=cfg.attention_dropout,
+    )
+    return transformers.DistilBertModel(hf_cfg).eval()
+
+
+def test_encoder_parity_vs_hf():
+    torch = pytest.importorskip("torch")
+    cfg = ModelConfig.tiny()
+    hf = _hf_reference(cfg)
+    params = hf_to_flax(hf.state_dict(), cfg)["encoder"]
+    ids, mask = _batch(cfg, B=3, seed=2)
+    with torch.no_grad():
+        theirs = hf(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        ).last_hidden_state.numpy()
+    ours = DistilBertEncoder(cfg).apply({"params": params}, ids, mask)
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-5, rtol=1e-4)
+
+
+def test_classifier_parity_vs_reference_head():
+    """Full-model parity: our DDoSClassifier vs the reference's architecture
+    (HF encoder + CLS pool + dropout(eval) + Linear(dim,2), client1.py:53-65)."""
+    torch = pytest.importorskip("torch")
+    cfg = ModelConfig.tiny()
+    hf = _hf_reference(cfg, seed=3)
+    torch.manual_seed(4)
+    head = torch.nn.Linear(cfg.dim, 2)
+
+    sd = {f"distilbert.{k}": v for k, v in hf.state_dict().items()}
+    sd["classifier.weight"] = head.weight
+    sd["classifier.bias"] = head.bias
+    params = hf_to_flax(sd, cfg)
+
+    ids, mask = _batch(cfg, B=3, seed=5)
+    with torch.no_grad():
+        hidden = hf(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        ).last_hidden_state
+        theirs = head(hidden[:, 0, :]).numpy()
+    ours = DDoSClassifier(cfg).apply({"params": params}, ids, mask)
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-5, rtol=1e-4)
+
+
+def test_hf_round_trip():
+    cfg = ModelConfig.tiny()
+    params = init_params(DDoSClassifier(cfg), cfg, jax.random.key(7))
+    sd = flax_to_hf(params, cfg)
+    back = hf_to_flax(sd, cfg)
+    flat_a = jax.tree_util.tree_leaves_with_path(params)
+    flat_b = jax.tree_util.tree_leaves_with_path(back)
+    assert len(flat_a) == len(flat_b)
+    for (pa, a), (pb, b) in zip(sorted(flat_a, key=str), sorted(flat_b, key=str)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7, err_msg=str(pa))
+
+
+def test_bert_base_scaleup_builds():
+    cfg = ModelConfig.bert_base(vocab_size=1000, max_len=32, max_position_embeddings=64)
+    params = init_params(DDoSClassifier(cfg), cfg, jax.random.key(0))
+    assert "layer_11" in params["encoder"]
+
+
+def test_remat_matches(tiny_params):
+    cfg = TINY.replace(remat=True)
+    ids, mask = _batch(TINY)
+    a = DDoSClassifier(TINY).apply({"params": tiny_params}, ids, mask)
+    b = DDoSClassifier(cfg).apply({"params": tiny_params}, ids, mask)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
