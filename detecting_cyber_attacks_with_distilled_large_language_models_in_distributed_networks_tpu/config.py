@@ -55,6 +55,13 @@ class ModelConfig:
                 f"(max_position_embeddings={self.max_position_embeddings}); "
                 "XLA would silently clamp position indices"
             )
+        if self.attention_impl not in ("dot", "flash", "ring"):
+            raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+        if self.attention_impl == "flash" and self.attention_dropout > 0.0:
+            raise ValueError(
+                "attention_impl='flash' does not implement attention dropout; "
+                "set attention_dropout=0.0 (the head/FFN dropouts still apply)"
+            )
 
     @property
     def head_dim(self) -> int:

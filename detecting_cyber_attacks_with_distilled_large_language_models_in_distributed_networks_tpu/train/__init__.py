@@ -1,0 +1,7 @@
+from .engine import (  # noqa: F401
+    TrainState,
+    Trainer,
+    make_eval_step,
+    make_optimizer,
+    make_train_step,
+)

@@ -1,0 +1,1 @@
+from .logging import get_logger, phase, timestamp  # noqa: F401
