@@ -165,6 +165,9 @@ class FedConfig:
     # Minimum fraction of clients that must survive a round for aggregation
     # to proceed (masked mean over survivors); reference requires all.
     min_client_fraction: float = 1.0
+    # Fresh optimizer state each round — mirrors the reference, where every
+    # round is a new process with a newly constructed Adam (client1.py:380).
+    reset_optimizer_each_round: bool = True
 
 
 @dataclass(frozen=True)
@@ -192,10 +195,12 @@ class ExperimentConfig:
     checkpoint_dir: str | None = None
 
     def __post_init__(self) -> None:
-        if self.fed.num_clients != self.mesh.clients:
+        # Logical clients may exceed the mesh's clients axis (several client
+        # replicas per device shard) but must tile it evenly.
+        if self.fed.num_clients % self.mesh.clients:
             raise ValueError(
-                f"fed.num_clients={self.fed.num_clients} != mesh.clients="
-                f"{self.mesh.clients}; use ExperimentConfig.for_clients(n)"
+                f"fed.num_clients={self.fed.num_clients} must be a multiple of "
+                f"mesh.clients={self.mesh.clients}; use ExperimentConfig.for_clients(n)"
             )
         if self.data.max_len != self.model.max_len:
             raise ValueError(

@@ -51,9 +51,12 @@ def binary_counts(
     neg = (labels == 0).astype(jnp.float32) * v
     pred_pos = (preds == 1).astype(jnp.float32)
     pred_neg = (preds == 0).astype(jnp.float32)
+    has_valid = (v.sum() > 0).astype(jnp.float32)
     return BinaryCounts(
-        loss_sum=loss.astype(jnp.float32),
-        n_batches=jnp.asarray(1.0, jnp.float32),
+        # All-padding batches (possible when clients' eval splits are stacked
+        # to a common length) must not dilute the batch-mean loss.
+        loss_sum=loss.astype(jnp.float32) * has_valid,
+        n_batches=has_valid,
         n_examples=v.sum(),
         correct=((preds == labels).astype(jnp.float32) * v).sum(),
         tp=(pos * pred_pos).sum(),

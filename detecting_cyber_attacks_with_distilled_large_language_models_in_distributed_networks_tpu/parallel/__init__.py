@@ -1,0 +1,2 @@
+from .mesh import FedShardings, make_mesh  # noqa: F401
+from .fedavg import fedavg, make_fedavg_step  # noqa: F401

@@ -1,0 +1,77 @@
+"""FedAvg as an XLA collective.
+
+The reference's aggregation pipeline is: each client gzip-pickles a 245 MB
+state dict, ships it over TCP (client1.py:276-295), a server thread decodes it
+(server.py:57-65), a Python loop computes an in-place unweighted mean
+(server.py:67-79, 0.36 s host-side), and a second socket broadcasts the result
+back (server.py:81-114). Total round path: minutes of serialize/transfer.
+
+Here the whole pipeline is one jitted mean over the stacked client axis of a
+``[C, ...]``-parameter pytree sharded over the ``clients`` mesh axis — XLA
+lowers it to an all-reduce on ICI and the broadcast is implicit (the output is
+the already-replicated mean written back to every client's shard). Weights
+never leave the devices; there is no serialization step at all.
+
+Capabilities beyond the reference:
+* weighted FedAvg (weight clients by sample count),
+* masked FedAvg (dropped/failed clients excluded from the mean — the
+  reference instead hangs its accept loop, server.py:69-71,124-132).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import FedShardings
+
+
+def fedavg(
+    stacked_params: Any,
+    weights: jnp.ndarray | None = None,
+    mask: jnp.ndarray | None = None,
+) -> Any:
+    """Weighted, masked mean over the leading (clients) axis of every leaf,
+    broadcast back to ``[C, ...]`` so each client shard receives the average.
+
+    ``weights``: [C] client weights (e.g. local sample counts); uniform if
+    None — the reference's unweighted mean (server.py:73-76).
+    ``mask``: [C] 0/1 survivors; masked-out clients contribute nothing and
+    the divisor shrinks accordingly.
+    """
+    leaves = jax.tree.leaves(stacked_params)
+    if not leaves:
+        return stacked_params
+    C = leaves[0].shape[0]
+    w = jnp.ones((C,), jnp.float32) if weights is None else weights.astype(jnp.float32)
+    if mask is not None:
+        w = w * mask.astype(jnp.float32)
+    denom = jnp.maximum(w.sum(), 1e-9)
+    wn = w / denom
+
+    def _avg(x: jnp.ndarray) -> jnp.ndarray:
+        wshape = (C,) + (1,) * (x.ndim - 1)
+        # fp32 accumulation regardless of param dtype
+        mean = (x.astype(jnp.float32) * wn.reshape(wshape)).sum(axis=0)
+        return jnp.broadcast_to(mean.astype(x.dtype), x.shape)
+
+    return jax.tree.map(_avg, stacked_params)
+
+
+def make_fedavg_step(shardings: FedShardings) -> Callable:
+    """Jitted FedAvg over the mesh: inputs/outputs sharded ``P('clients')``,
+    so the mean lowers to a cross-client all-reduce on ICI."""
+
+    @partial(
+        jax.jit,
+        in_shardings=(shardings.client, None, None),
+        out_shardings=shardings.client,
+        static_argnums=(),
+    )
+    def step(stacked_params, weights, mask):
+        return fedavg(stacked_params, weights, mask)
+
+    return step

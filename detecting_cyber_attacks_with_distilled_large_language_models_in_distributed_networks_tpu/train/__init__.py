@@ -5,3 +5,10 @@ from .engine import (  # noqa: F401
     make_optimizer,
     make_train_step,
 )
+from .federated import (  # noqa: F401
+    FederatedTrainer,
+    FedState,
+    RoundRecord,
+    federated_batches,
+    stack_eval_splits,
+)

@@ -72,6 +72,27 @@ def loss_fn(model: DDoSClassifier, params, batch, rng) -> jnp.ndarray:
     ).mean()
 
 
+def eval_counts(
+    model: DDoSClassifier, params, batch, valid
+) -> tuple[BinaryCounts, jnp.ndarray]:
+    """Shared eval body: masked batch-mean loss + sufficient statistics +
+    P(class 1) probs. Single source of truth for both the single-client and
+    the vmapped federated eval paths (their metrics must never diverge)."""
+    logits = model.apply(
+        {"params": params}, batch["input_ids"], batch["attention_mask"], True
+    )
+    per_example = optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["labels"]
+    )
+    v = valid.astype(jnp.float32)
+    # Batch-mean over valid rows (reference averages per batch then over
+    # batches, client1.py:135,144; padded rows must not contribute).
+    loss = (per_example * v).sum() / jnp.maximum(v.sum(), 1.0)
+    counts = binary_counts(logits, batch["labels"], loss, valid)
+    probs = jax.nn.softmax(logits, axis=-1)[:, 1]
+    return counts, probs
+
+
 def make_train_step(
     model: DDoSClassifier, optimizer: optax.GradientTransformation
 ) -> Callable[[TrainState, dict], tuple[TrainState, jnp.ndarray]]:
@@ -95,19 +116,7 @@ def make_eval_step(model: DDoSClassifier) -> Callable:
 
     @jax.jit
     def eval_step(params, batch, valid) -> tuple[BinaryCounts, jnp.ndarray]:
-        logits = model.apply(
-            {"params": params}, batch["input_ids"], batch["attention_mask"], True
-        )
-        per_example = optax.softmax_cross_entropy_with_integer_labels(
-            logits, batch["labels"]
-        )
-        v = valid.astype(jnp.float32)
-        # Batch-mean over valid rows (reference averages per batch then over
-        # batches, client1.py:135,144; padded rows must not contribute).
-        loss = (per_example * v).sum() / jnp.maximum(v.sum(), 1.0)
-        counts = binary_counts(logits, batch["labels"], loss, valid)
-        probs = jax.nn.softmax(logits, axis=-1)[:, 1]
-        return counts, probs
+        return eval_counts(model, params, batch, valid)
 
     return eval_step
 

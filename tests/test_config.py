@@ -46,7 +46,8 @@ def test_from_dict_rejects_unknown_keys():
 
 def test_inconsistent_config_rejected():
     with pytest.raises(ValueError, match="num_clients"):
-        ExperimentConfig(fed=FedConfig(num_clients=8))
+        ExperimentConfig(fed=FedConfig(num_clients=3))  # not a multiple of mesh 2
+    ExperimentConfig(fed=FedConfig(num_clients=8))  # 8 clients tile a 2-wide axis
     with pytest.raises(ValueError, match="max_len"):
         ExperimentConfig(model=ModelConfig(max_len=256))
     cfg = ExperimentConfig.for_clients(8)

@@ -1,0 +1,93 @@
+"""FedAvg collective properties on a faked multi-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.parallel import (
+    FedShardings,
+    fedavg,
+    make_fedavg_step,
+    make_mesh,
+)
+
+
+def _tree(C, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(rng.normal(size=(C, 4, 3)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(C, 3)).astype(np.float32)),
+        "nested": {"k": jnp.asarray(rng.normal(size=(C, 2)).astype(np.float32))},
+    }
+
+
+def test_fedavg_identity_on_identical_models():
+    base = _tree(1, seed=1)
+    stacked = jax.tree.map(lambda x: jnp.broadcast_to(x[0][None], (4, *x.shape[1:])), base)
+    out = fedavg(stacked)
+    for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+
+
+def test_fedavg_is_arithmetic_mean():
+    t = _tree(3, seed=2)
+    out = fedavg(t)
+    for leaf, orig in zip(jax.tree.leaves(out), jax.tree.leaves(t)):
+        expected = np.asarray(orig).mean(axis=0)
+        for c in range(3):
+            np.testing.assert_allclose(np.asarray(leaf)[c], expected, atol=1e-6)
+
+
+def test_fedavg_weighted():
+    t = _tree(2, seed=3)
+    w = jnp.asarray([3.0, 1.0])
+    out = fedavg(t, weights=w)
+    for leaf, orig in zip(jax.tree.leaves(out), jax.tree.leaves(t)):
+        o = np.asarray(orig)
+        expected = (3 * o[0] + o[1]) / 4
+        np.testing.assert_allclose(np.asarray(leaf)[0], expected, atol=1e-6)
+
+
+def test_fedavg_masked_excludes_clients():
+    t = _tree(4, seed=4)
+    mask = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+    out = fedavg(t, mask=mask)
+    for leaf, orig in zip(jax.tree.leaves(out), jax.tree.leaves(t)):
+        o = np.asarray(orig)
+        expected = (o[0] + o[2]) / 2
+        np.testing.assert_allclose(np.asarray(leaf)[1], expected, atol=1e-6)
+
+
+def test_fedavg_on_mesh_collective(eight_devices):
+    """Sharded over a real (faked-CPU) clients axis, the jitted step must
+    produce the replicated mean on every client shard."""
+    mesh = make_mesh(4, 2, devices=eight_devices)
+    sh = FedShardings(mesh)
+    t = _tree(4, seed=5)
+    t_sharded = jax.device_put(t, sh.client)
+    step = make_fedavg_step(sh)
+    out = step(t_sharded, None, None)
+    assert out["w"].sharding.spec == sh.client.spec
+    expected = np.asarray(t["w"]).mean(axis=0)
+    for c in range(4):
+        np.testing.assert_allclose(np.asarray(out["w"])[c], expected, atol=1e-6)
+
+
+def test_fedavg_matches_reference_inplace_mean():
+    """Element-wise parity with the reference's aggregation loop
+    (server.py:72-76: base += other; base /= N)."""
+    t = _tree(3, seed=6)
+    ours = fedavg(t)
+    models = [jax.tree.map(lambda x, c=c: np.asarray(x)[c].copy(), t) for c in range(3)]
+    ref = jax.tree_util.tree_map(
+        lambda *xs: sum(xs[1:], xs[0].copy()) / len(xs), *models
+    )
+    for leaf, rleaf in zip(jax.tree.leaves(ours), jax.tree.leaves(ref)):
+        np.testing.assert_allclose(np.asarray(leaf)[0], rleaf, atol=1e-6)
+
+
+def test_mesh_requires_enough_devices(eight_devices):
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        make_mesh(8, 2, devices=eight_devices)

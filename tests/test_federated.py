@@ -1,0 +1,214 @@
+"""End-to-end federated training on a faked 8-device CPU mesh.
+
+This is the TPU analogue of the reference's only integration evidence (the
+2-client golden run logs): N clients train on private shards, FedAvg
+aggregates, and the aggregated model must not regress vs local models —
+the reference's headline result (99.09% local -> 99.93% aggregated)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+    default_tokenizer,
+    make_all_client_splits,
+    make_synthetic_flows,
+    stack_clients,
+    tokenize_client,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train import (
+    FederatedTrainer,
+    federated_batches,
+    stack_eval_splits,
+)
+
+MAX_LEN = 64
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return default_tokenizer()
+
+
+def _cfg(tok, clients=2, data=1, **fed_kw):
+    return ExperimentConfig(
+        model=ModelConfig.tiny(
+            vocab_size=len(tok), max_len=MAX_LEN, max_position_embeddings=MAX_LEN,
+            dim=64, n_layers=2, n_heads=4, hidden_dim=128,
+        ),
+        data=DataConfig(data_fraction=0.45, max_len=MAX_LEN, batch_size=16),
+        train=TrainConfig(learning_rate=1e-3, epochs_per_round=1, seed=0),
+        fed=FedConfig(num_clients=clients, **fed_kw),
+        mesh=MeshConfig(clients=clients, data=data),
+    )
+
+
+@pytest.fixture(scope="module")
+def fed_data(tok):
+    df = make_synthetic_flows(2400, seed=11)
+    cfg = DataConfig(data_fraction=0.45, max_len=MAX_LEN)
+    splits = make_all_client_splits(df, 2, cfg)
+    clients = [tokenize_client(s, tok, max_len=MAX_LEN) for s in splits]
+    stacked_train = stack_clients([c.train for c in clients])
+    return clients, stacked_train
+
+
+def test_federated_batches_per_client_shuffles(fed_data):
+    _, stacked = fed_data
+    batches = list(federated_batches(stacked, 16, seed=0, epoch=0))
+    C, N = stacked.labels.shape
+    assert len(batches) == N // 16
+    b0 = batches[0]
+    assert b0["input_ids"].shape == (C, 16, MAX_LEN)
+    assert not np.array_equal(b0["labels"][0], b0["labels"][1])
+    again = list(federated_batches(stacked, 16, seed=0, epoch=0))
+    np.testing.assert_array_equal(b0["labels"], again[0]["labels"])  # deterministic
+    other = list(federated_batches(stacked, 16, seed=0, epoch=1))
+    assert not np.array_equal(b0["labels"], other[0]["labels"])  # epoch decorrelated
+
+
+def test_stack_eval_splits_counts(fed_data, tok):
+    clients, _ = fed_data
+    splits = [c.val for c in clients]
+    stacked, valid = stack_eval_splits(splits, 16, pad_id=tok.pad_id)
+    assert valid.shape == stacked.labels.shape
+    for c, s in enumerate(splits):
+        assert valid[c].sum() == len(s)
+
+
+def test_two_client_federation_end_to_end(tok, fed_data, eight_devices):
+    clients, stacked_train = fed_data
+    cfg = _cfg(tok, clients=2, data=2)
+    trainer = FederatedTrainer(cfg, pad_id=tok.pad_id)
+    state = trainer.init_state()
+    test_splits = [c.test for c in clients]
+
+    state, history = trainer.run(state, stacked_train, test_splits, rounds=2)
+    assert len(history) == 2
+    last = history[-1]
+    for c in range(2):
+        assert last.aggregated_metrics[c]["Accuracy"] > 90.0
+    # aggregated params are identical across clients after FedAvg
+    p = np.asarray(jax.tree.leaves(state.params)[0])
+    np.testing.assert_allclose(p[0], p[1], atol=1e-6)
+    # losses decrease across rounds
+    assert history[1].epoch_losses.mean() < history[0].epoch_losses.mean()
+
+
+def test_federation_not_worse_than_local(tok, fed_data, eight_devices):
+    """The reference's headline property: aggregation helps (or at least
+    does not catastrophically hurt) each client's test metrics."""
+    clients, stacked_train = fed_data
+    cfg = _cfg(tok, clients=2)
+    trainer = FederatedTrainer(cfg, pad_id=tok.pad_id)
+    state = trainer.init_state()
+    state, history = trainer.run(state, stacked_train, [c.test for c in clients])
+    rec = history[-1]
+    for c in range(2):
+        assert (
+            rec.aggregated_metrics[c]["Accuracy"]
+            >= rec.local_metrics[c]["Accuracy"] - 5.0
+        )
+
+
+def test_eight_client_mesh(tok, eight_devices):
+    """8 logical clients on an 8-wide clients axis."""
+    df = make_synthetic_flows(1600, seed=13)
+    dcfg = DataConfig(data_fraction=0.12, max_len=MAX_LEN, partition="disjoint")
+    splits = make_all_client_splits(df, 8, dcfg)
+    clients = [tokenize_client(s, tok, max_len=MAX_LEN) for s in splits]
+    stacked_train = stack_clients([c.train for c in clients])
+    cfg = _cfg(tok, clients=8)
+    trainer = FederatedTrainer(cfg, pad_id=tok.pad_id)
+    state = trainer.init_state()
+    state, losses = trainer.fit_local(state, stacked_train, epochs=1)
+    assert losses.shape == (1, 8)
+    state = trainer.aggregate(state)
+    p = np.asarray(jax.tree.leaves(state.params)[0])
+    for c in range(1, 8):
+        np.testing.assert_allclose(p[0], p[c], atol=1e-6)
+
+
+def test_more_clients_than_mesh_axis(tok, eight_devices):
+    """4 logical clients stacked on a 2-wide mesh axis (2 replicas/shard)."""
+    df = make_synthetic_flows(1200, seed=17)
+    dcfg = DataConfig(data_fraction=0.2, max_len=MAX_LEN, partition="disjoint")
+    splits = make_all_client_splits(df, 4, dcfg)
+    clients = [tokenize_client(s, tok, max_len=MAX_LEN) for s in splits]
+    stacked_train = stack_clients([c.train for c in clients])
+    cfg = ExperimentConfig(
+        model=ModelConfig.tiny(vocab_size=len(tok), max_len=MAX_LEN,
+                               max_position_embeddings=MAX_LEN),
+        data=DataConfig(data_fraction=0.2, max_len=MAX_LEN),
+        train=TrainConfig(learning_rate=1e-3, epochs_per_round=1),
+        fed=FedConfig(num_clients=4),
+        mesh=MeshConfig(clients=2, data=2),
+    )
+    trainer = FederatedTrainer(cfg, pad_id=tok.pad_id)
+    state = trainer.init_state()
+    state, _ = trainer.fit_local(state, stacked_train, epochs=1)
+    metrics = trainer.evaluate_clients(state.params, [c.val for c in clients])
+    assert len(metrics) == 4
+
+
+def test_unequal_eval_sizes_loss_not_diluted(tok, fed_data, eight_devices):
+    """All-padding batches (stacking a small client's eval split up to a big
+    client's) must not dilute the reported Loss."""
+    clients, _ = fed_data
+    cfg = _cfg(tok, clients=2)
+    trainer = FederatedTrainer(cfg, pad_id=tok.pad_id)
+    state = trainer.init_state()
+    small = clients[1].val.take(np.arange(24))  # 24 rows vs client 0's full val
+    m = trainer.evaluate_clients(state.params, [clients[0].val, small])
+    assert m[1]["n"] == 24
+    # directly evaluate the small split alone via the other client slot
+    m_alone = trainer.evaluate_clients(state.params, [small, small])
+    np.testing.assert_allclose(m[1]["Loss"], m_alone[1]["Loss"], rtol=1e-5)
+
+
+def test_weighted_requires_explicit_weights(tok, fed_data, eight_devices):
+    clients, stacked_train = fed_data
+    cfg = _cfg(tok, clients=2, weighted=True)
+    trainer = FederatedTrainer(cfg, pad_id=tok.pad_id)
+    state = trainer.init_state()
+    with pytest.raises(ValueError, match="weights"):
+        trainer.run(state, stacked_train, [c.test for c in clients], rounds=1)
+
+
+def test_tiny_client_rejected_with_clear_error(tok, eight_devices):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.pipeline import (
+        TokenizedSplit,
+    )
+
+    rng = np.random.default_rng(0)
+    tiny = TokenizedSplit(
+        rng.integers(1, 50, (2, 5, MAX_LEN)).astype(np.int32),
+        np.ones((2, 5, MAX_LEN), np.int32),
+        rng.integers(0, 2, (2, 5)).astype(np.int32),
+    )
+    cfg = _cfg(tok, clients=2)
+    trainer = FederatedTrainer(cfg, pad_id=tok.pad_id)
+    state = trainer.init_state()
+    with pytest.raises(ValueError, match="zero batches"):
+        trainer.fit_local(state, tiny)
+
+
+def test_masked_aggregation_and_min_fraction(tok, eight_devices):
+    cfg = _cfg(tok, clients=4, min_client_fraction=0.5)
+    trainer = FederatedTrainer(cfg, pad_id=tok.pad_id)
+    state = trainer.init_state()
+    mask = np.array([1, 1, 0, 0], np.float32)
+    state2 = trainer.aggregate(state, client_mask=mask)
+    p = np.asarray(jax.tree.leaves(state2.params)[0])
+    np.testing.assert_allclose(p[0], p[3], atol=1e-6)  # result replicated
+    with pytest.raises(RuntimeError, match="survived"):
+        trainer.aggregate(state, client_mask=np.array([1, 0, 0, 0], np.float32))
