@@ -1,0 +1,82 @@
+"""Headline benchmark: local-training throughput on the flagship model.
+
+Measures the jitted train step on the full DistilBERT-base DDoS classifier
+(66 M params) at the reference's own configuration (batch 16, seq 128,
+Adam 2e-5 — reference client1.py:27,370,379-380) and reports samples/sec
+against the reference's recorded CPU throughput of ~2.5 batch/s = 40
+samples/s (client1_terminal_output.txt:7,9,11; BASELINE.md).
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# Keep the noisy platform banner off stdout (the JSON line must be parseable).
+os.environ.setdefault("JAX_LOGGING_LEVEL", "ERROR")
+
+import jax  # noqa: E402
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (  # noqa: E402
+    ModelConfig,
+    TrainConfig,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.engine import (  # noqa: E402
+    Trainer,
+)
+
+REFERENCE_SAMPLES_PER_SEC = 40.0  # ~2.5 batch/s * bs 16 (BASELINE.md)
+
+
+def main() -> None:
+    batch_size = int(os.environ.get("BENCH_BATCH", "16"))
+    steps = int(os.environ.get("BENCH_STEPS", "100"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "10"))
+
+    model_cfg = ModelConfig()  # DistilBERT-base, bf16 compute
+    trainer = Trainer(model_cfg, TrainConfig())
+    state = trainer.init_state(seed=0)
+
+    rng = np.random.default_rng(0)
+    L = model_cfg.max_len
+    batch = {
+        "input_ids": rng.integers(0, model_cfg.vocab_size, (batch_size, L)).astype(
+            np.int32
+        ),
+        "attention_mask": np.ones((batch_size, L), np.int32),
+        "labels": rng.integers(0, 2, batch_size).astype(np.int32),
+    }
+    batch = {k: jax.device_put(v) for k, v in batch.items()}
+
+    for _ in range(warmup):
+        state, loss = trainer.train_step(state, batch)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = trainer.train_step(state, batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = batch_size * steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": "train_samples_per_sec_distilbert_bs%d" % batch_size,
+                "value": round(samples_per_sec, 2),
+                "unit": "samples/sec",
+                "vs_baseline": round(samples_per_sec / REFERENCE_SAMPLES_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -105,6 +105,15 @@ def stack_eval_splits(
     )
 
 
+class PreparedEval(NamedTuple):
+    """Stacked eval splits, padded once and reused across rounds."""
+
+    stacked: TokenizedSplit  # [C, M, ...] arrays, M a batch multiple
+    valid: np.ndarray  # [C, M] 0/1
+    batch_size: int
+    labels: list[np.ndarray]  # per-client unpadded labels (for ROC/PR)
+
+
 @dataclass
 class RoundRecord:
     round: int
@@ -255,19 +264,42 @@ class FederatedTrainer:
                 )
         return state, np.stack(out) if out else np.zeros((0, self.C))
 
+    def prepare_eval(
+        self,
+        splits: Sequence[TokenizedSplit],
+        *,
+        batch_size: int | None = None,
+    ) -> "PreparedEval":
+        """Pad/stack eval splits once; reuse across rounds (re-stacking every
+        evaluation would repeat the host-side concat of the full eval set)."""
+        bs = self.cfg.data.eval_batch_size if batch_size is None else batch_size
+        stacked, valid = stack_eval_splits(splits, bs, pad_id=self.pad_id)
+        return PreparedEval(stacked, valid, bs, [s.labels.copy() for s in splits])
+
     def evaluate_clients(
         self,
         stacked_params: Any,
-        splits: Sequence[TokenizedSplit],
+        splits: Sequence[TokenizedSplit] | None = None,
         *,
+        prepared: "PreparedEval | None" = None,
         batch_size: int | None = None,
         collect_probs: bool = False,
     ) -> list[dict]:
         """Per-client metrics dicts (reference five-metric schema)."""
-        bs = self.cfg.data.eval_batch_size if batch_size is None else batch_size
-        stacked, valid = stack_eval_splits(splits, bs, pad_id=self.pad_id)
+        if prepared is None:
+            if splits is None:
+                raise ValueError("pass either splits or prepared")
+            prepared = self.prepare_eval(splits, batch_size=batch_size)
+        elif splits is not None or batch_size is not None:
+            raise ValueError(
+                "prepared already fixes the eval data and batch size; "
+                "do not also pass splits/batch_size"
+            )
+        stacked, valid, bs = prepared.stacked, prepared.valid, prepared.batch_size
         C, M = stacked.labels.shape
-        totals = [BinaryCounts.zero() for _ in range(C)]
+        # Accumulate the stacked [C] counts on device; one host sync after
+        # the loop (per-batch np.asarray would block async dispatch).
+        totals: BinaryCounts | None = None
         probs_dev = []
         for i in range(M // bs):
             sl = slice(i * bs, (i + 1) * bs)
@@ -277,19 +309,22 @@ class FederatedTrainer:
                 "labels": stacked.labels[:, sl],
             }
             counts, probs = self.eval_step(stacked_params, batch, valid[:, sl])
-            counts = jax.tree.map(np.asarray, counts)
-            for c in range(C):
-                totals[c] = totals[c] + jax.tree.map(lambda x: x[c], counts)
+            totals = counts if totals is None else totals + counts
             if collect_probs:
                 probs_dev.append(probs)
+        host = (
+            jax.tree.map(np.asarray, totals)
+            if totals is not None
+            else BinaryCounts(*(np.zeros(C, np.float32) for _ in BinaryCounts._fields))
+        )
         out = []
         all_probs = np.concatenate([np.asarray(p) for p in probs_dev], axis=1) if probs_dev else None
         for c in range(C):
-            m = finalize_metrics(BinaryCounts(*[jnp.asarray(v) for v in totals[c]]))
+            m = finalize_metrics(BinaryCounts(*(v[c] for v in host)))
             if collect_probs and all_probs is not None:
                 mask_c = valid[c, : all_probs.shape[1]] == 1
                 m["probs"] = all_probs[c][mask_c]
-                m["labels"] = splits[c].labels.copy()
+                m["labels"] = prepared.labels[c].copy()
             out.append(m)
         return out
 
@@ -305,10 +340,21 @@ class FederatedTrainer:
         server.py:69-71)."""
         if client_mask is not None:
             surviving = float(np.asarray(client_mask).sum())
-            if surviving < self.cfg.fed.min_client_fraction * self.C:
+            if surviving == 0.0 or surviving < self.cfg.fed.min_client_fraction * self.C:
                 raise RuntimeError(
                     f"only {int(surviving)}/{self.C} clients survived the round "
                     f"(min_client_fraction={self.cfg.fed.min_client_fraction})"
+                )
+        if weights is not None:
+            eff = np.asarray(weights, dtype=np.float64)
+            if client_mask is not None:
+                eff = eff * np.asarray(client_mask, dtype=np.float64)
+            if eff.sum() <= 0.0:
+                # fedavg's jitted mean clamps the divisor; a zero weight sum
+                # would silently zero every parameter.
+                raise ValueError(
+                    "effective FedAvg weight sum is zero (all-zero weights, "
+                    "or every weighted client masked out)"
                 )
         w = None if weights is None else jnp.asarray(weights)
         m = None if client_mask is None else jnp.asarray(client_mask)
@@ -339,15 +385,16 @@ class FederatedTrainer:
                 "(pass weights=[n_train per client])"
             )
         history: list[RoundRecord] = []
+        prepared = self.prepare_eval(eval_splits)
         for r in range(R):
             with phase(f"round {r + 1}/{R} local training", tag="FED"):
                 state, losses = self.fit_local(
                     state, stacked_train, epoch_offset=r * E
                 )
-            local = self.evaluate_clients(state.params, eval_splits)
+            local = self.evaluate_clients(state.params, prepared=prepared)
             with phase(f"round {r + 1}/{R} FedAvg", tag="FED"):
                 state = self.aggregate(state, weights=weights)
-            aggregated = self.evaluate_clients(state.params, eval_splits)
+            aggregated = self.evaluate_clients(state.params, prepared=prepared)
             history.append(RoundRecord(r, losses, local, aggregated))
             for c in range(self.C):
                 log.info(
