@@ -1,0 +1,310 @@
+"""Reporting: metrics CSVs and evaluation plots.
+
+Capability parity with the reference's L7 reporting layer:
+
+* ``save_metrics`` — one-row CSV with the reference's exact five-column
+  schema ``Accuracy,Loss,Precision,Recall,F1-Score`` (reference
+  client1.py:339-350), so recorded results stay comparable side-by-side.
+* ``plot_evaluation`` — confusion-matrix heatmaps and the local-vs-aggregated
+  grouped bar chart (reference client1.py:153-225). The reference also
+  *defines* ROC and precision-recall plotters but never calls them
+  (client1.py:167-193 — dead code); here they are wired in.
+
+Curve math (ROC, PR, AUC) is pure numpy — no sklearn dependency — and plots
+are pure matplotlib on the Agg backend (the reference pulls in seaborn only
+for ``sns.heatmap``, client1.py:158). Everything here is host-side: metrics
+arrive as plain floats/arrays already finalized from on-device counts
+(ops/metrics.py).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Mapping, Sequence
+
+import numpy as np
+
+try:  # pragma: no cover - exercised implicitly by import
+    # Figure + FigureCanvasAgg directly: rendering never touches the global
+    # pyplot state machine or the host process's chosen backend.
+    from matplotlib.backends.backend_agg import FigureCanvasAgg
+    from matplotlib.figure import Figure
+
+    HAVE_MATPLOTLIB = True
+except Exception:  # matplotlib absent: CSVs still work, plots become no-ops
+    HAVE_MATPLOTLIB = False
+
+METRIC_COLUMNS = ("Accuracy", "Loss", "Precision", "Recall", "F1-Score")
+
+DEFAULT_DPI = 300  # the reference's higher-quality client2 setting (client2.py:155)
+
+
+# --------------------------------------------------------------------- CSV IO
+def save_metrics(metrics: Mapping[str, float], filename: str) -> str:
+    """One-row CSV in the reference's schema (reference client1.py:339-350)."""
+    os.makedirs(os.path.dirname(filename) or ".", exist_ok=True)
+    with open(filename, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=list(METRIC_COLUMNS))
+        writer.writeheader()
+        writer.writerow({k: metrics[k] for k in METRIC_COLUMNS})
+    return filename
+
+
+def load_metrics(filename: str) -> dict[str, float]:
+    """Inverse of ``save_metrics`` (also reads the reference's recorded CSVs)."""
+    with open(filename, newline="") as f:
+        row = next(csv.DictReader(f))
+    return {k: float(v) for k, v in row.items()}
+
+
+# ------------------------------------------------------------- curve math
+def roc_curve(labels: np.ndarray, probs: np.ndarray):
+    """ROC points (fpr, tpr, thresholds), numpy-native.
+
+    Matches sklearn's ``roc_curve(..., drop_intermediate=False)``: thresholds
+    descending, curve anchored at (0, 0) with an initial +inf threshold, one
+    point per distinct threshold (collinear interior points kept).
+    """
+    labels = np.asarray(labels).astype(np.int64)
+    probs = np.asarray(probs).astype(np.float64)
+    order = np.argsort(-probs, kind="stable")
+    labels, probs = labels[order], probs[order]
+    # Cumulative TP/FP at each distinct-threshold boundary.
+    distinct = np.where(np.diff(probs))[0]
+    idx = np.concatenate([distinct, [labels.size - 1]])
+    tps = np.cumsum(labels)[idx].astype(np.float64)
+    fps = (idx + 1) - tps
+    tps = np.concatenate([[0.0], tps])
+    fps = np.concatenate([[0.0], fps])
+    thresholds = np.concatenate([[np.inf], probs[idx]])
+    p = max(tps[-1], 1.0)
+    n = max(fps[-1], 1.0)
+    return fps / n, tps / p, thresholds
+
+
+def precision_recall_curve(labels: np.ndarray, probs: np.ndarray):
+    """PR points (precision, recall, thresholds), sklearn convention:
+    recall descending to 0, final point (precision=1, recall=0)."""
+    labels = np.asarray(labels).astype(np.int64)
+    probs = np.asarray(probs).astype(np.float64)
+    order = np.argsort(-probs, kind="stable")
+    labels, probs = labels[order], probs[order]
+    distinct = np.where(np.diff(probs))[0]
+    idx = np.concatenate([distinct, [labels.size - 1]])
+    tps = np.cumsum(labels)[idx].astype(np.float64)
+    fps = (idx + 1) - tps
+    denom = np.maximum(tps + fps, 1.0)
+    precision = tps / denom
+    recall = tps / max(tps[-1], 1.0)
+    # Reverse so recall ascends, then append the (1, 0) anchor.
+    precision = np.concatenate([precision[::-1], [1.0]])
+    recall = np.concatenate([recall[::-1], [0.0]])
+    thresholds = probs[idx][::-1]
+    return precision, recall, thresholds
+
+
+def auc(x: np.ndarray, y: np.ndarray) -> float:
+    """Trapezoidal area under a curve sorted by x (sklearn ``auc``)."""
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    order = np.argsort(x, kind="stable")
+    trapezoid = getattr(np, "trapezoid", None) or np.trapz  # numpy<2 fallback
+    return float(trapezoid(y[order], x[order]))
+
+
+def average_precision(labels: np.ndarray, probs: np.ndarray) -> float:
+    """AP = sum over thresholds of (recall step) * precision."""
+    precision, recall, _ = precision_recall_curve(labels, probs)
+    # recall ascends then ends with the 0 anchor; integrate the step curve.
+    return float(-np.sum(np.diff(recall) * precision[:-1]))
+
+
+# ---------------------------------------------------------------------- plots
+def plot_confusion_matrix(
+    cm: np.ndarray,
+    title: str,
+    path: str,
+    *,
+    class_names: Sequence[str] = ("Benign", "DDoS"),
+    dpi: int = DEFAULT_DPI,
+) -> str | None:
+    """Annotated heatmap of the 2x2 confusion matrix (reference
+    client1.py:157-165, there via seaborn)."""
+    if not HAVE_MATPLOTLIB:
+        return None
+    cm = np.asarray(cm)
+    fig, ax = _figure((6, 5))
+    im = ax.imshow(cm, cmap="Blues")
+    fig.colorbar(im, ax=ax)
+    thresh = cm.max() / 2.0 if cm.max() > 0 else 0.5
+    for i in range(cm.shape[0]):
+        for j in range(cm.shape[1]):
+            ax.text(
+                j,
+                i,
+                f"{int(cm[i, j]):d}",
+                ha="center",
+                va="center",
+                color="white" if cm[i, j] > thresh else "black",
+            )
+    ax.set_xticks(range(len(class_names)), class_names)
+    ax.set_yticks(range(len(class_names)), class_names)
+    ax.set_xlabel("Predicted")
+    ax.set_ylabel("Actual")
+    ax.set_title(title)
+    fig.tight_layout()
+    _save(fig, path, dpi)
+    return path
+
+
+def plot_roc_curve(
+    labels: np.ndarray, probs: np.ndarray, title: str, path: str, *, dpi: int = DEFAULT_DPI
+) -> str | None:
+    """ROC with AUC in the legend (reference client1.py:167-181, dead code
+    there — wired in here)."""
+    if not HAVE_MATPLOTLIB:
+        return None
+    fpr, tpr, _ = roc_curve(labels, probs)
+    fig, ax = _figure((6, 5))
+    ax.plot(fpr, tpr, label=f"ROC (AUC = {auc(fpr, tpr):.4f})")
+    ax.plot([0, 1], [0, 1], linestyle="--", color="grey", label="Chance")
+    ax.set_xlabel("False Positive Rate")
+    ax.set_ylabel("True Positive Rate")
+    ax.set_title(title)
+    ax.legend(loc="lower right")
+    fig.tight_layout()
+    _save(fig, path, dpi)
+    return path
+
+
+def plot_precision_recall(
+    labels: np.ndarray, probs: np.ndarray, title: str, path: str, *, dpi: int = DEFAULT_DPI
+) -> str | None:
+    """PR curve with average precision (reference client1.py:183-193, dead
+    code there — wired in here)."""
+    if not HAVE_MATPLOTLIB:
+        return None
+    precision, recall, _ = precision_recall_curve(labels, probs)
+    fig, ax = _figure((6, 5))
+    ax.plot(recall, precision, label=f"PR (AP = {average_precision(labels, probs):.4f})")
+    ax.set_xlabel("Recall")
+    ax.set_ylabel("Precision")
+    ax.set_title(title)
+    ax.legend(loc="lower left")
+    fig.tight_layout()
+    _save(fig, path, dpi)
+    return path
+
+
+def plot_metrics_comparison(
+    local: Mapping[str, float],
+    aggregated: Mapping[str, float],
+    title: str,
+    path: str,
+    *,
+    dpi: int = DEFAULT_DPI,
+) -> str | None:
+    """Grouped local-vs-aggregated bar chart over the five metrics
+    (reference client1.py:195-218). Accuracy is rescaled from percent to
+    [0, 1] so all bars share an axis, as the reference does
+    (client1.py:199-200)."""
+    if not HAVE_MATPLOTLIB:
+        return None
+
+    def _values(m: Mapping[str, float]) -> list[float]:
+        return [
+            float(m[k]) / 100.0 if k == "Accuracy" else float(m[k])
+            for k in METRIC_COLUMNS
+        ]
+
+    x = np.arange(len(METRIC_COLUMNS))
+    width = 0.35
+    fig, ax = _figure((9, 5))
+    ax.bar(x - width / 2, _values(local), width, label="Local")
+    ax.bar(x + width / 2, _values(aggregated), width, label="Aggregated")
+    ax.set_xticks(x, METRIC_COLUMNS)
+    ax.set_ylabel("Value (Accuracy scaled to [0,1])")
+    ax.set_title(title)
+    ax.legend()
+    fig.tight_layout()
+    _save(fig, path, dpi)
+    return path
+
+
+def plot_evaluation(
+    local: Mapping,
+    aggregated: Mapping | None,
+    output_dir: str,
+    *,
+    client_id: int = 0,
+    dpi: int = DEFAULT_DPI,
+) -> list[str]:
+    """Full reference plot set for one client (reference client1.py:220-224):
+    confusion matrices for local and (if present) aggregated models, the
+    comparison bar chart, plus ROC and PR curves when probs are available.
+
+    ``aggregated=None`` reproduces the reference's degraded local-only mode
+    (client1.py:405-410). Returns paths of the files written."""
+    if not HAVE_MATPLOTLIB:
+        return []
+    os.makedirs(output_dir, exist_ok=True)
+    tag = f"client{client_id}"
+    written: list[str] = []
+
+    def _emit(path: str | None) -> None:
+        if path:
+            written.append(path)
+
+    for kind, m in (("local", local), ("aggregated", aggregated)):
+        if m is None:
+            continue
+        _emit(
+            plot_confusion_matrix(
+                m["confusion_matrix"],
+                f"Client {client_id} {kind.capitalize()} Model Confusion Matrix",
+                os.path.join(output_dir, f"{tag}_{kind}_confusion_matrix.png"),
+                dpi=dpi,
+            )
+        )
+        if "probs" in m and "labels" in m and len(m["probs"]):
+            _emit(
+                plot_roc_curve(
+                    m["labels"],
+                    m["probs"],
+                    f"Client {client_id} {kind.capitalize()} Model ROC",
+                    os.path.join(output_dir, f"{tag}_{kind}_roc.png"),
+                    dpi=dpi,
+                )
+            )
+            _emit(
+                plot_precision_recall(
+                    m["labels"],
+                    m["probs"],
+                    f"Client {client_id} {kind.capitalize()} Model Precision-Recall",
+                    os.path.join(output_dir, f"{tag}_{kind}_pr.png"),
+                    dpi=dpi,
+                )
+            )
+    if aggregated is not None:
+        _emit(
+            plot_metrics_comparison(
+                local,
+                aggregated,
+                f"Client {client_id} Local vs Aggregated Metrics",
+                os.path.join(output_dir, f"{tag}_metrics_comparison.png"),
+                dpi=dpi,
+            )
+        )
+    return written
+
+
+def _figure(figsize: tuple[float, float]):
+    fig = Figure(figsize=figsize)
+    FigureCanvasAgg(fig)
+    return fig, fig.add_subplot()
+
+
+def _save(fig, path: str, dpi: int) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fig.savefig(path, dpi=dpi)

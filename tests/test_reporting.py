@@ -1,0 +1,123 @@
+"""Reporting layer: CSV schema parity and curve-math parity vs sklearn."""
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu import (
+    reporting,
+)
+
+sklearn_metrics = pytest.importorskip("sklearn.metrics")
+
+
+def _fake_metrics(seed=0):
+    rng = np.random.default_rng(seed)
+    n = 400
+    labels = rng.integers(0, 2, n)
+    probs = np.clip(labels * 0.6 + rng.normal(0.3, 0.25, n), 0.0, 1.0)
+    return labels, probs
+
+
+def test_save_load_metrics_roundtrip(tmp_path):
+    m = {
+        "Accuracy": 99.9336,
+        "Loss": 0.0123,
+        "Precision": 1.0,
+        "Recall": 0.99884,
+        "F1-Score": 0.99942,
+    }
+    path = reporting.save_metrics(m, str(tmp_path / "client1_local_metrics.csv"))
+    back = reporting.load_metrics(path)
+    assert back == pytest.approx(m)
+    # Header matches the reference CSV schema exactly (client1.py:339-350).
+    header = open(path).readline().strip()
+    assert header == "Accuracy,Loss,Precision,Recall,F1-Score"
+
+
+def test_load_reference_recorded_csv(tmp_path):
+    # Byte-format compatibility with the reference's recorded results files.
+    p = tmp_path / "ref.csv"
+    p.write_text(
+        "Accuracy,Loss,Precision,Recall,F1-Score\n"
+        "99.93355481727574,0.004704117158216095,1.0,0.9988399071925754,0.9994196170177677\n"
+    )
+    m = reporting.load_metrics(str(p))
+    assert m["Accuracy"] == pytest.approx(99.93355481727574)
+    assert m["F1-Score"] == pytest.approx(0.9994196170177677)
+
+
+def test_roc_curve_matches_sklearn():
+    labels, probs = _fake_metrics()
+    fpr, tpr, thr = reporting.roc_curve(labels, probs)
+    sk_fpr, sk_tpr, sk_thr = sklearn_metrics.roc_curve(
+        labels, probs, drop_intermediate=False
+    )
+    np.testing.assert_allclose(fpr, sk_fpr, atol=1e-12)
+    np.testing.assert_allclose(tpr, sk_tpr, atol=1e-12)
+    assert reporting.auc(fpr, tpr) == pytest.approx(
+        sklearn_metrics.roc_auc_score(labels, probs)
+    )
+
+
+def test_pr_curve_matches_sklearn():
+    labels, probs = _fake_metrics(1)
+    precision, recall, thr = reporting.precision_recall_curve(labels, probs)
+    sk_p, sk_r, sk_t = sklearn_metrics.precision_recall_curve(labels, probs)
+    np.testing.assert_allclose(precision, sk_p, atol=1e-12)
+    np.testing.assert_allclose(recall, sk_r, atol=1e-12)
+    assert reporting.average_precision(labels, probs) == pytest.approx(
+        sklearn_metrics.average_precision_score(labels, probs)
+    )
+
+
+def test_roc_handles_degenerate_single_class():
+    labels = np.zeros(10, dtype=int)
+    probs = np.linspace(0, 1, 10)
+    fpr, tpr, _ = reporting.roc_curve(labels, probs)
+    assert np.all(tpr == 0.0)  # no positives -> tpr pinned at 0, no NaN
+    assert not np.any(np.isnan(fpr))
+
+
+@pytest.mark.skipif(not reporting.HAVE_MATPLOTLIB, reason="matplotlib absent")
+def test_plot_evaluation_writes_reference_plot_set(tmp_path):
+    labels, probs = _fake_metrics(2)
+    base = {
+        "Accuracy": 99.0,
+        "Loss": 0.05,
+        "Precision": 0.99,
+        "Recall": 0.98,
+        "F1-Score": 0.985,
+        "confusion_matrix": np.array([[4474, 41], [0, 862]]),
+        "labels": labels,
+        "probs": probs,
+    }
+    agg = dict(base, Accuracy=99.9, confusion_matrix=np.array([[4515, 0], [3, 859]]))
+    written = reporting.plot_evaluation(base, agg, str(tmp_path), client_id=1)
+    names = {p.split("/")[-1] for p in written}
+    assert names == {
+        "client1_local_confusion_matrix.png",
+        "client1_local_roc.png",
+        "client1_local_pr.png",
+        "client1_aggregated_confusion_matrix.png",
+        "client1_aggregated_roc.png",
+        "client1_aggregated_pr.png",
+        "client1_metrics_comparison.png",
+    }
+    for p in written:
+        assert (tmp_path / p.split("/")[-1]).stat().st_size > 0
+
+
+@pytest.mark.skipif(not reporting.HAVE_MATPLOTLIB, reason="matplotlib absent")
+def test_plot_evaluation_degraded_local_only(tmp_path):
+    # aggregated=None reproduces the reference's failure path (client1.py:405-410).
+    base = {
+        "Accuracy": 99.0,
+        "Loss": 0.05,
+        "Precision": 0.99,
+        "Recall": 0.98,
+        "F1-Score": 0.985,
+        "confusion_matrix": np.array([[10, 1], [0, 9]]),
+    }
+    written = reporting.plot_evaluation(base, None, str(tmp_path), client_id=2)
+    names = {p.split("/")[-1] for p in written}
+    assert names == {"client2_local_confusion_matrix.png"}
