@@ -186,8 +186,9 @@ def plot_precision_recall(
     if not HAVE_MATPLOTLIB:
         return None
     precision, recall, _ = precision_recall_curve(labels, probs)
+    ap = float(-np.sum(np.diff(recall) * precision[:-1]))
     fig, ax = _figure((6, 5))
-    ax.plot(recall, precision, label=f"PR (AP = {average_precision(labels, probs):.4f})")
+    ax.plot(recall, precision, label=f"PR (AP = {ap:.4f})")
     ax.set_xlabel("Recall")
     ax.set_ylabel("Precision")
     ax.set_title(title)
