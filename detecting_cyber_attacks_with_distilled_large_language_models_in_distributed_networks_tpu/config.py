@@ -46,6 +46,9 @@ class ModelConfig:
     # "dot" (XLA fused attention), "flash" (Pallas kernel), "ring"
     # (sequence-parallel ring attention over a mesh axis).
     attention_impl: str = "dot"
+    # Mesh axis the sequence dimension is sharded over when attention_impl
+    # is "ring" (the forward must run inside shard_map with this axis bound).
+    ring_axis: str = "seq"
     remat: bool = False
 
     def __post_init__(self) -> None:
@@ -57,10 +60,11 @@ class ModelConfig:
             )
         if self.attention_impl not in ("dot", "flash", "ring"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
-        if self.attention_impl == "flash" and self.attention_dropout > 0.0:
+        if self.attention_impl in ("flash", "ring") and self.attention_dropout > 0.0:
             raise ValueError(
-                "attention_impl='flash' does not implement attention dropout; "
-                "set attention_dropout=0.0 (the head/FFN dropouts still apply)"
+                f"attention_impl={self.attention_impl!r} does not implement "
+                "attention dropout; set attention_dropout=0.0 (the head/FFN "
+                "dropouts still apply)"
             )
 
     @property

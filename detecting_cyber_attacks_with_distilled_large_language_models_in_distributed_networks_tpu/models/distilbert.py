@@ -65,9 +65,11 @@ class MultiHeadSelfAttention(nn.Module):
 
             ctx = flash_attention(q, k, v, bias)
         elif cfg.attention_impl == "ring":
+            # Requires the forward to run inside shard_map with the sequence
+            # dimension sharded over cfg.ring_axis.
             from ..parallel.ring_attention import ring_attention
 
-            ctx = ring_attention(q, k, v, bias)
+            ctx = ring_attention(q, k, v, bias, axis_name=cfg.ring_axis)
         elif cfg.attention_impl == "dot":
             ctx = dot_product_attention(
                 q, k, v, bias,
@@ -139,7 +141,15 @@ class Embeddings(nn.Module):
             embedding_init=nn.initializers.normal(cfg.initializer_range),
             name="position_embeddings",
         )
-        pos = pos_table(jnp.arange(L, dtype=jnp.int32))[None, :, :]
+        if cfg.attention_impl == "ring":
+            # Sequence-sharded forward (inside shard_map over cfg.ring_axis):
+            # this shard embeds global positions [shard*L_local, ...), not
+            # [0, L_local).
+            offset = jax.lax.axis_index(cfg.ring_axis) * L
+            pos_ids = offset + jnp.arange(L, dtype=jnp.int32)
+            pos = pos_table(pos_ids)[None, :, :]
+        else:
+            pos = pos_table(jnp.arange(L, dtype=jnp.int32))[None, :, :]
         x = word + pos
         x = nn.LayerNorm(
             epsilon=cfg.layer_norm_eps,
@@ -181,6 +191,11 @@ class DDoSClassifier(nn.Module):
             input_ids, attention_mask, deterministic
         )
         pooled = hidden[:, 0, :]  # CLS token (reference client1.py:62)
+        if cfg.attention_impl == "ring":
+            # Under sequence sharding only shard 0's token 0 is the global
+            # CLS; broadcast it so every shard computes identical logits.
+            is_first = (jax.lax.axis_index(cfg.ring_axis) == 0).astype(pooled.dtype)
+            pooled = jax.lax.psum(pooled * is_first, cfg.ring_axis)
         pooled = nn.Dropout(cfg.head_dropout)(pooled, deterministic=deterministic)
         logits = nn.Dense(
             cfg.n_classes,

@@ -1,0 +1,185 @@
+"""Pallas blocked flash attention for TPU.
+
+Forward pass is a Pallas kernel: the [Lq, Lk] score matrix is never
+materialized in HBM — each grid step streams one query block against key/value
+blocks held in VMEM, maintaining the online-softmax running max/denominator
+(the standard flash recurrence), with fp32 accumulation feeding the MXU.
+Memory is O(L·D) per (batch, head) instead of O(L²).
+
+The reference has no analogue — its attention is whatever torch runs inside
+HF ``DistilBertModel`` (reference client1.py:61). At the reference's L=128
+XLA's fused dot attention is already fine; this kernel is the long-context
+headroom path (``ModelConfig.attention_impl="flash"``) and the building
+block the ring-attention sequence-parallel path composes with.
+
+Differentiability: ``flash_attention`` carries a ``jax.custom_vjp`` whose
+backward recomputes the softmax with standard XLA ops (O(L²) scores live only
+inside the backward). Forward-pass memory wins are kept; a Pallas backward
+kernel is future work. Attention dropout is not implemented (config enforces
+``attention_dropout == 0`` for this impl).
+
+Bias: only key-position masks — shape ``[B, 1, 1, Lk]`` additive, as produced
+by ``ops.attention.make_attention_bias`` — are supported.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale: float, block_k: int):
+    """One query block vs. all key blocks, online softmax."""
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, D]
+    bq = q.shape[0]
+    d = v_ref.shape[-1]
+    lk = k_ref.shape[2]
+    num_kb = lk // block_k
+
+    def body(i, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, 0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        b_blk = bias_ref[0, pl.ds(i * block_k, block_k)].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            + b_blk[None, :]
+        )  # [bq, bk]
+        m_new = jnp.maximum(m, s.max(axis=1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + p.sum(axis=1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+    # -1e9 mask addends keep l > 0 even for fully masked rows (matches the
+    # dot-attention path, which softmaxes the same finite scores).
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _key_bias(bias: jnp.ndarray | None, batch: int, lk: int) -> jnp.ndarray:
+    if bias is None:
+        return jnp.zeros((batch, lk), jnp.float32)
+    if bias.ndim != 4 or bias.shape[1] != 1 or bias.shape[2] != 1:
+        raise ValueError(
+            f"flash_attention supports key-position bias [B,1,1,Lk] only, got {bias.shape}"
+        )
+    return bias[:, 0, 0, :].astype(jnp.float32)
+
+
+def _flash_forward(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: jnp.ndarray | None,
+    *,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> jnp.ndarray:
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    if lq % block_q or lk % block_k:
+        raise ValueError(
+            f"sequence lengths (Lq={lq}, Lk={lk}) must tile into blocks "
+            f"({block_q}, {block_k})"
+        )
+    key_bias = _key_bias(bias, b, lk)
+    scale = 1.0 / (d**0.5)
+    kernel = functools.partial(_fwd_kernel, scale=scale, block_k=block_k)
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h, lq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, lk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, lk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, lk), lambda bi, hi, qi: (bi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, key_bias)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, bias, block_q, block_k, interpret):
+    return _flash_forward(
+        q, k, v, bias, block_q=block_q, block_k=block_k, interpret=interpret
+    )
+
+
+def _flash_fwd(q, k, v, bias, block_q, block_k, interpret):
+    out = _flash_forward(
+        q, k, v, bias, block_q=block_q, block_k=block_k, interpret=interpret
+    )
+    return out, (q, k, v, bias, out)
+
+
+def _flash_bwd(block_q, block_k, interpret, res, do):
+    """Recompute-softmax backward (standard XLA ops, fp32)."""
+    q, k, v, bias, out = res
+    d = q.shape[-1]
+    scale = 1.0 / (d**0.5)
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf, preferred_element_type=jnp.float32)
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof, preferred_element_type=jnp.float32)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf, preferred_element_type=jnp.float32)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # [B,H,Lq]
+    ds = p * (dp - delta[..., None])
+    dq = (
+        jnp.einsum("bhqk,bhkd->bhqd", ds, kf, preferred_element_type=jnp.float32)
+        * scale
+    )
+    dk = (
+        jnp.einsum("bhqk,bhqd->bhkd", ds, qf, preferred_element_type=jnp.float32)
+    )
+    dbias = None
+    if bias is not None:
+        db = ds.sum(axis=(1, 2), keepdims=True)  # -> [B,1,1,Lk]
+        dbias = db.astype(bias.dtype)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dbias
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,  # [B, H, Lq, D]
+    k: jnp.ndarray,  # [B, H, Lk, D]
+    v: jnp.ndarray,  # [B, H, Lk, D]
+    bias: jnp.ndarray | None = None,  # [B, 1, 1, Lk] additive key mask
+    *,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Blocked flash attention; drop-in for ``dot_product_attention`` (minus
+    attention dropout). ``interpret=None`` auto-selects interpreter mode off
+    TPU so the same tests run on the CPU mesh."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, bias, block_q, block_k, interpret)

@@ -1,0 +1,159 @@
+"""Ring attention: sequence-parallel blockwise attention over a mesh axis.
+
+Long-context path: the sequence dimension is sharded over a ``seq`` mesh
+axis, each device holding [B, H, L/n, D] query/key/value shards. Attention
+runs in n ring steps — every device computes blockwise attention of its
+local queries against the key/value chunk it currently holds, then passes
+that chunk to its ring neighbor with ``jax.lax.ppermute`` (one ICI hop),
+accumulating results with the online-softmax (flash) recurrence. No device
+ever materializes the full [L, L] score matrix or the full K/V — memory is
+O(L/n · D) per device and communication rides the ICI ring.
+
+The reference has nothing like this (sequences are fixed 128 tokens,
+reference client1.py:27); this is the framework's long-context scaling
+story, composing the flash recurrence (ops/flash_attention.py) with the
+mesh machinery (parallel/mesh.py).
+
+``ring_attention`` must be called inside ``shard_map`` with ``axis_name``
+bound (the model's ``attention_impl="ring"`` path assumes the whole forward
+runs under one); ``ring_attention_sharded`` wraps full arrays for
+standalone/tests. Everything is differentiable — ``ppermute`` and the
+recurrence are standard JAX ops, so autodiff composes (gradients take the
+reverse ring).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _partial_attention(q_scaled, k, v, bias):
+    """Unnormalized flash statistics of local queries vs one K/V chunk.
+
+    Returns ``(pv, m, l)``: exp-weighted values, row max, row denominator —
+    enough to merge chunks with the online-softmax recurrence.
+    """
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q_scaled, k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    m = s.max(axis=-1)  # [B,H,Lq]
+    p = jnp.exp(s - m[..., None])
+    l = p.sum(axis=-1)
+    pv = jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return pv, m, l
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [B, H, Lq_local, D] — local query shard
+    k: jnp.ndarray,  # [B, H, Lk_local, D] — local key shard
+    v: jnp.ndarray,  # [B, H, Lk_local, D]
+    bias: jnp.ndarray | None = None,  # [B, 1, 1, Lk_local] — mask for LOCAL keys
+    *,
+    axis_name: str = "seq",
+) -> jnp.ndarray:
+    """Sequence-parallel attention inside ``shard_map``; the key-position
+    bias (when given) rotates around the ring together with its K/V chunk.
+
+    Only key-position biases are accepted: a bias with a real query dimension
+    would be applied to *other devices'* queries after the first rotation.
+    """
+    if bias is not None and (
+        bias.ndim != 4 or bias.shape[1] != 1 or bias.shape[2] != 1
+    ):
+        raise ValueError(
+            f"ring_attention supports key-position bias [B,1,1,Lk] only, "
+            f"got {bias.shape}"
+        )
+    n = jax.lax.psum(1, axis_name)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    q_scaled = q.astype(jnp.float32) * scale
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    has_bias = bias is not None
+
+    def merge(acc, m, l, k_c, v_c, b_c):
+        pv_i, m_i, l_i = _partial_attention(
+            q_scaled, k_c, v_c, b_c if has_bias else None
+        )
+        m_new = jnp.maximum(m, m_i)
+        alpha = jnp.exp(m - m_new)
+        alpha_i = jnp.exp(m_i - m_new)
+        acc = acc * alpha[..., None] + pv_i * alpha_i[..., None]
+        l = l * alpha + l_i * alpha_i
+        return acc, m_new, l
+
+    def rotate(x):
+        return jax.tree.map(lambda t: jax.lax.ppermute(t, axis_name, perm), x)
+
+    b_sz, h, lq, d = q.shape
+    acc0 = jnp.zeros((b_sz, h, lq, d), jnp.float32)
+    m0 = jnp.full((b_sz, h, lq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b_sz, h, lq), jnp.float32)
+
+    # Constants enter the scan carry device-invariant but come out varying
+    # over the ring axis; mark them varying up front so the carry types
+    # match (inputs like a shard_map-bound bias are already varying).
+    def _vary(x):
+        if axis_name in getattr(jax.typeof(x), "vma", ()):
+            return x
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+
+    acc0, m0, l0 = jax.tree.map(_vary, (acc0, m0, l0))
+    b0 = bias if has_bias else ()  # empty pytree: nothing rotates when no mask
+
+    def step(carry, _):
+        k_c, v_c, b_c, acc, m, l = carry
+        acc, m, l = merge(acc, m, l, k_c, v_c, b_c)
+        return (rotate(k_c), rotate(v_c), rotate(b_c), acc, m, l), None
+
+    # n-1 compute+rotate steps; the final chunk is merged without the last
+    # rotation (its rotated carry would be discarded — one wasted ICI hop
+    # of full K/V per layer otherwise).
+    (k_f, v_f, b_f, acc, m, l), _ = jax.lax.scan(
+        step, (k, v, b0, acc0, m0, l0), None, length=n - 1
+    )
+    acc, m, l = merge(acc, m, l, k_f, v_f, b_f)
+    # -1e9 mask addends keep l > 0 even for fully masked rows (parity with
+    # the dot/flash paths).
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jnp.ndarray,  # [B, H, L, D] — full arrays
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: jnp.ndarray | None = None,
+    *,
+    mesh: Mesh,
+    axis_name: str = "seq",
+) -> jnp.ndarray:
+    """Standalone wrapper: shards the sequence axis of full [B, H, L, D]
+    arrays over ``axis_name`` and runs the ring. The model-integrated path
+    instead runs the whole encoder under one ``shard_map``."""
+    shard_map = jax.shard_map
+
+    seq_spec = P(None, None, axis_name, None)
+    bias_spec = P(None, None, None, axis_name)
+    fn = functools.partial(ring_attention, axis_name=axis_name)
+    if bias is None:
+        return shard_map(
+            lambda q_, k_, v_: fn(q_, k_, v_),
+            mesh=mesh,
+            in_specs=(seq_spec, seq_spec, seq_spec),
+            out_specs=seq_spec,
+        )(q, k, v)
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec, bias_spec),
+        out_specs=seq_spec,
+    )(q, k, v, bias)

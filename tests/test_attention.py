@@ -1,0 +1,180 @@
+"""Attention impl parity: flash (Pallas, interpret on CPU) and ring
+(shard_map sequence parallelism) must match the XLA dot-attention path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+    ModelConfig,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.models.distilbert import (
+    DDoSClassifier,
+    init_params,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.ops.attention import (
+    dot_product_attention,
+    make_attention_bias,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.ops.flash_attention import (
+    flash_attention,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.parallel.ring_attention import (
+    ring_attention_sharded,
+)
+
+
+def _qkv(rng, b=2, h=2, l=64, d=16, dtype=jnp.float32):
+    shape = (b, h, l, d)
+    q = jnp.asarray(rng.normal(size=shape), dtype)
+    k = jnp.asarray(rng.normal(size=shape), dtype)
+    v = jnp.asarray(rng.normal(size=shape), dtype)
+    return q, k, v
+
+
+def _mask_bias(rng, b=2, l=64):
+    mask = (rng.random((b, l)) > 0.2).astype(np.int32)
+    mask[:, 0] = 1  # CLS always visible
+    return make_attention_bias(jnp.asarray(mask))
+
+
+def test_flash_matches_dot_forward(rng):
+    q, k, v = _qkv(rng)
+    bias = _mask_bias(rng)
+    ref = dot_product_attention(q, k, v, bias)
+    out = flash_attention(q, k, v, bias, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_matches_dot_no_bias(rng):
+    q, k, v = _qkv(rng, l=32)
+    ref = dot_product_attention(q, k, v, None)
+    out = flash_attention(q, k, v, None, block_q=8, block_k=8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_gradients_match_dot(rng):
+    q, k, v = _qkv(rng, b=1, h=2, l=32, d=8)
+    bias = _mask_bias(rng, b=1, l=32)
+
+    def loss_dot(q, k, v):
+        return (dot_product_attention(q, k, v, bias) ** 2).sum()
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, bias, block_q=8, block_k=8) ** 2).sum()
+
+    g_ref = jax.grad(loss_dot, argnums=(0, 1, 2))(q, k, v)
+    g_out = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_out, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_flash_rejects_full_bias(rng):
+    q, k, v = _qkv(rng, l=16)
+    full_bias = jnp.zeros((2, 2, 16, 16))
+    with pytest.raises(ValueError, match="key-position bias"):
+        flash_attention(q, k, v, full_bias, block_q=8, block_k=8)
+
+
+def test_flash_in_model_forward(rng):
+    """attention_impl='flash' through the full classifier equals 'dot'."""
+    base = ModelConfig.tiny(attention_dropout=0.0)
+    flash_cfg = base.replace(attention_impl="flash")
+    model_dot = DDoSClassifier(base)
+    model_flash = DDoSClassifier(flash_cfg)
+    params = init_params(model_dot, base, jax.random.key(0))
+    ids = jnp.asarray(rng.integers(0, base.vocab_size, (2, base.max_len)), jnp.int32)
+    mask = jnp.ones((2, base.max_len), jnp.int32)
+    out_dot = model_dot.apply({"params": params}, ids, mask, True)
+    out_flash = model_flash.apply({"params": params}, ids, mask, True)
+    np.testing.assert_allclose(
+        np.asarray(out_flash), np.asarray(out_dot), atol=2e-4
+    )
+
+
+def test_ring_matches_dot(rng, eight_devices):
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(eight_devices[:2]), ("seq",))
+    q, k, v = _qkv(rng, b=1, h=2, l=32, d=8)
+    bias = _mask_bias(rng, b=1, l=32)
+    ref = dot_product_attention(q, k, v, bias)
+    out = ring_attention_sharded(q, k, v, bias, mesh=mesh, axis_name="seq")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_no_bias_matches_dot(rng, eight_devices):
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(eight_devices[:2]), ("seq",))
+    q, k, v = _qkv(rng, b=1, h=1, l=16, d=8)
+    ref = dot_product_attention(q, k, v, None)
+    out = ring_attention_sharded(q, k, v, mesh=mesh, axis_name="seq")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_gradients_match_dot(rng, eight_devices):
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(eight_devices[:2]), ("seq",))
+    q, k, v = _qkv(rng, b=1, h=1, l=16, d=8)
+    bias = _mask_bias(rng, b=1, l=16)
+
+    def loss_dot(q, k, v):
+        return (dot_product_attention(q, k, v, bias) ** 2).sum()
+
+    def loss_ring(q, k, v):
+        return (
+            ring_attention_sharded(q, k, v, bias, mesh=mesh, axis_name="seq") ** 2
+        ).sum()
+
+    g_ref = jax.grad(loss_dot, argnums=(0, 1, 2))(q, k, v)
+    g_out = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_out, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_ring_model_forward_matches_dot(rng, eight_devices):
+    """Full classifier under a sequence-sharded shard_map (ring attention,
+    shard-offset positions, global CLS pooling) equals the unsharded dot
+    path."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(eight_devices[:2]), ("seq",))
+    base = ModelConfig.tiny(
+        attention_dropout=0.0, max_len=64, max_position_embeddings=64
+    )
+    ring_cfg = base.replace(attention_impl="ring", ring_axis="seq")
+    model_dot = DDoSClassifier(base)
+    model_ring = DDoSClassifier(ring_cfg)
+    params = init_params(model_dot, base, jax.random.key(0))
+    ids = jnp.asarray(rng.integers(0, base.vocab_size, (2, 64)), jnp.int32)
+    mask_np = (rng.random((2, 64)) > 0.3).astype(np.int32)
+    mask_np[:, 0] = 1
+    mask = jnp.asarray(mask_np)
+
+    ref = model_dot.apply({"params": params}, ids, mask, True)
+    out = jax.shard_map(
+        lambda p, i, m: model_ring.apply({"params": p}, i, m, True),
+        mesh=mesh,
+        in_specs=(P(), P(None, "seq"), P(None, "seq")),
+        out_specs=P(),
+    )(params, ids, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_ring_rejects_query_bias(rng, eight_devices):
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(eight_devices[:2]), ("seq",))
+    q, k, v = _qkv(rng, b=1, h=1, l=16, d=8)
+    causal = jnp.zeros((1, 1, 16, 16))
+    with pytest.raises(ValueError, match="key-position bias"):
+        ring_attention_sharded(q, k, v, causal, mesh=mesh, axis_name="seq")
+
+
+def test_config_rejects_attention_dropout_for_flash_and_ring():
+    for impl in ("flash", "ring"):
+        with pytest.raises(ValueError, match="attention dropout"):
+            ModelConfig.tiny(attention_impl=impl, attention_dropout=0.1)
