@@ -1,0 +1,19 @@
+"""Cross-host communication backend (demo-parity mode).
+
+On-TPU federation never serializes weights (parallel/fedavg.py — the round
+is a mesh collective). This package covers the reference's other deployment
+shape — clients as separate processes on separate hosts over TCP (reference
+client1.py:246-336, server.py:29-114) — with a non-executable wire format,
+CRC'd chunked framing, and a native C++ byte-path (native/fedwire.cpp).
+"""
+
+from .client import FederatedClient, connect_with_retry  # noqa: F401
+from .framing import recv_frame, send_frame  # noqa: F401
+from .server import AggregationServer, aggregate_flat  # noqa: F401
+from .wire import (  # noqa: F401
+    WireError,
+    decode,
+    encode,
+    flatten_params,
+    unflatten_params,
+)

@@ -1,0 +1,115 @@
+"""Federated client session for the cross-host demo-parity mode.
+
+The reference's client session is: connect, gzip-pickle upload on port
+12345, poll a second port every 1 s until the server opens it, download
+with a retry budget (reference client1.py:276-336). Here the whole
+exchange is one request/response on one connection — upload the local
+params, block until the aggregated params come back on the same socket —
+with connection retry/backoff standing in for the reference's
+``wait_for_server`` probe loop (client1.py:298-311) but without the
+probe-kills-server race (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Any, Mapping
+
+from ..utils.logging import get_logger
+from . import framing, wire
+
+log = get_logger()
+
+
+def connect_with_retry(
+    host: str,
+    port: int,
+    *,
+    timeout: float = 300.0,
+    poll_interval: float = 1.0,  # the reference's 1 s probe cadence
+) -> socket.socket:
+    """Dial until the server is up or ``timeout`` elapses."""
+    deadline = time.monotonic() + timeout
+    last: Exception | None = None
+    while time.monotonic() < deadline:
+        try:
+            sock = socket.create_connection(
+                (host, port), timeout=max(0.1, deadline - time.monotonic())
+            )
+            return sock
+        except OSError as e:
+            last = e
+            time.sleep(poll_interval)
+    raise ConnectionError(f"server {host}:{port} unreachable after {timeout}s: {last}")
+
+
+class FederatedClient:
+    """One client's view of a federated round over TCP."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        client_id: int,
+        timeout: float = 300.0,  # the reference's TIMEOUT (client1.py:22)
+        compression: str = "none",
+    ):
+        self.host = host
+        self.port = port
+        self.client_id = client_id
+        self.timeout = timeout
+        self.compression = compression
+
+    def exchange(
+        self,
+        params: Any,
+        *,
+        n_samples: int = 1,
+        meta: Mapping[str, Any] | None = None,
+        max_retries: int = 5,  # the reference's retry budget (client1.py:314)
+    ) -> dict:
+        """Upload local params, return the aggregated params (nested dict).
+
+        Retries the whole round-trip on connection errors; a server-side
+        WireError (e.g. CRC mismatch after corruption) also retries with a
+        fresh upload.
+        """
+        msg = wire.encode(
+            params,
+            meta={
+                "client_id": self.client_id,
+                "n_samples": int(n_samples),
+                **dict(meta or {}),
+            },
+            compression=self.compression,
+        )
+        last: Exception | None = None
+        for attempt in range(1, max_retries + 1):
+            sock = None
+            try:
+                sock = connect_with_retry(self.host, self.port, timeout=self.timeout)
+                sock.settimeout(self.timeout)
+                log.info(
+                    f"[CLIENT {self.client_id}] uploading {len(msg) / 1e6:.1f} MB "
+                    f"(attempt {attempt}/{max_retries})"
+                )
+                framing.send_frame(sock, msg)
+                reply = framing.recv_frame(sock)
+                agg, agg_meta = wire.decode(reply)
+                log.info(
+                    f"[CLIENT {self.client_id}] received aggregated model "
+                    f"({len(reply) / 1e6:.1f} MB, clients {agg_meta.get('round_clients')})"
+                )
+                return agg
+            except (OSError, ConnectionError, wire.WireError) as e:
+                last = e
+                log.info(f"[CLIENT {self.client_id}] round attempt {attempt} failed: {e}")
+                time.sleep(min(2.0**attempt, 10.0))
+            finally:
+                if sock is not None:
+                    sock.close()
+        raise ConnectionError(
+            f"client {self.client_id}: round failed after {max_retries} attempts: {last}"
+        )

@@ -1,0 +1,210 @@
+"""Aggregation server for the cross-host demo-parity mode.
+
+On TPU, FedAvg is a ``pmean`` on the mesh and there is no server at all
+(parallel/fedavg.py). This module exists for the reference's *other*
+capability: genuinely separate client processes on separate hosts
+(reference server.py end-to-end). Differences from the reference, by
+design:
+
+* one port, request/response on a single connection — the reference's
+  second listening port plus 1 s client polling (client1.py:298-311,
+  server.py:81-114) is a built-in race: probe connects are accepted by the
+  send loop and kill it (WinError 10053 in the golden logs,
+  server_terminal_output.txt:19,27). With request/response there is nothing
+  to poll: the reply arrives on the connection the upload used.
+* clients are identified by the ``client_id`` in the message meta, not by
+  accept order (the reference can serve one client twice and starve
+  another, SURVEY.md §5).
+* weighted FedAvg by ``n_samples`` (optional) and a ``min_clients``
+  quorum with a round deadline, instead of hanging forever when a client
+  dies (reference server.py:69-71 + 124-132).
+* wire format is non-executable (comm/wire.py) — no pickle RCE.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from ..utils.logging import get_logger
+from . import framing, wire
+
+log = get_logger()
+
+
+def aggregate_flat(
+    models: list[dict[str, np.ndarray]], weights: list[float] | None = None
+) -> dict[str, np.ndarray]:
+    """Weighted element-wise mean of flat param dicts (fp32 accumulation),
+    the reference's ``aggregate_models`` (server.py:67-79) without the
+    in-place mutation of client 0's weights."""
+    if not models:
+        raise ValueError("no models to aggregate")
+    keys = set(models[0])
+    for i, m in enumerate(models[1:], 1):
+        if set(m) != keys:
+            raise wire.WireError(f"model {i} key set differs from model 0")
+    if weights is None:
+        w = np.ones(len(models), np.float64)
+    else:
+        w = np.asarray(weights, np.float64)
+        if w.shape != (len(models),) or w.sum() <= 0:
+            raise ValueError(f"bad weights {weights}")
+    w = w / w.sum()
+    out: dict[str, np.ndarray] = {}
+    for key in models[0]:
+        acc = np.zeros_like(np.asarray(models[0][key], np.float32))
+        for wi, m in zip(w, models):
+            if m[key].shape != acc.shape:
+                raise wire.WireError(f"shape mismatch for {key!r}")
+            acc += np.float32(wi) * np.asarray(m[key], np.float32)
+        out[key] = acc
+    return out
+
+
+@dataclass
+class _Round:
+    """One aggregation round's rendezvous state."""
+
+    expected: int
+    models: dict[int, dict] = field(default_factory=dict)  # client_id -> flat params
+    n_samples: dict[int, float] = field(default_factory=dict)
+    conns: dict[int, socket.socket] = field(default_factory=dict)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    complete: threading.Event = field(default_factory=threading.Event)
+
+
+class AggregationServer:
+    """Receive ``num_clients`` models, FedAvg, reply on the same connections.
+
+    ``serve_round()`` runs one round; ``serve(rounds=N)`` loops. A round
+    deadline plus ``min_clients`` lets the mean proceed over survivors
+    (masked mean) instead of hanging on a dead client.
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        num_clients: int = 2,
+        weighted: bool = False,
+        min_clients: int | None = None,
+        timeout: float = 300.0,  # the reference's TIMEOUT (server.py:10)
+        compression: str = "none",
+    ):
+        self.num_clients = num_clients
+        self.weighted = weighted
+        self.min_clients = num_clients if min_clients is None else min_clients
+        self.timeout = timeout
+        self.compression = compression
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(num_clients * 2)
+        self._sock.settimeout(timeout)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------- lifecycle
+    def close(self) -> None:
+        self._stop.set()
+        self._sock.close()
+
+    def __enter__(self) -> "AggregationServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ----------------------------------------------------------------- round
+    def _handle_upload(self, conn: socket.socket, rnd: _Round) -> None:
+        try:
+            conn.settimeout(self.timeout)
+            payload = framing.recv_frame(conn)
+            flat, meta = wire.decode(payload)
+            flat = wire.flatten_params(flat)
+            client_id = int(meta.get("client_id", -1))
+            with rnd.lock:
+                if client_id in rnd.models:
+                    log.info(f"[SERVER] duplicate upload from client {client_id}; replacing")
+                    old = rnd.conns.pop(client_id, None)
+                    if old is not None:
+                        old.close()
+                rnd.models[client_id] = flat
+                rnd.n_samples[client_id] = float(meta.get("n_samples", 1.0))
+                rnd.conns[client_id] = conn
+                done = len(rnd.models) >= rnd.expected
+            log.info(
+                f"[SERVER] received model from client {client_id} "
+                f"({len(rnd.models)}/{rnd.expected})"
+            )
+            if done:
+                rnd.complete.set()
+        except (OSError, wire.WireError, ConnectionError) as e:
+            log.info(f"[SERVER] upload failed: {e}")
+            conn.close()
+
+    def serve_round(self, *, deadline: float | None = None) -> dict | None:
+        """Accept uploads until all clients arrive (or deadline), aggregate,
+        reply to every contributor. Returns the aggregated flat params."""
+        rnd = _Round(expected=self.num_clients)
+        deadline = time.monotonic() + (self.timeout if deadline is None else deadline)
+        threads: list[threading.Thread] = []
+        while not rnd.complete.is_set() and time.monotonic() < deadline:
+            self._sock.settimeout(max(0.05, min(1.0, deadline - time.monotonic())))
+            try:
+                conn, addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break  # closed
+            t = threading.Thread(target=self._handle_upload, args=(conn, rnd), daemon=True)
+            t.start()
+            threads.append(t)
+        rnd.complete.wait(timeout=max(0.0, deadline - time.monotonic()))
+        for t in threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+
+        with rnd.lock:
+            models = dict(rnd.models)
+            conns = dict(rnd.conns)
+            n_samples = dict(rnd.n_samples)
+        try:
+            if len(models) < self.min_clients:
+                raise RuntimeError(
+                    f"only {len(models)}/{self.num_clients} clients arrived "
+                    f"(min_clients={self.min_clients})"
+                )
+            ids = sorted(models)
+            weights = [n_samples[i] for i in ids] if self.weighted else None
+            agg = aggregate_flat([models[i] for i in ids], weights)
+            log.info(f"[SERVER] aggregated {len(ids)} models (clients {ids})")
+            reply = wire.encode(
+                agg, meta={"round_clients": ids}, compression=self.compression
+            )
+        except BaseException:
+            # A failed round must not leave clients blocked in recv_frame
+            # until their timeouts — drop every connection so they fail fast.
+            for c in conns.values():
+                c.close()
+            raise
+        for cid in ids:
+            conn = conns[cid]
+            try:
+                framing.send_frame(conn, reply)
+            except (OSError, wire.WireError, ConnectionError) as e:
+                log.info(f"[SERVER] reply to client {cid} failed: {e}")
+            finally:
+                conn.close()
+        return agg
+
+    def serve(self, rounds: int = 1) -> None:
+        for r in range(rounds):
+            log.info(f"[SERVER] round {r + 1}/{rounds}")
+            self.serve_round()

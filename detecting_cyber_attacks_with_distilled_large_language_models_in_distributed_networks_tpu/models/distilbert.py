@@ -32,6 +32,21 @@ def _dtype(name: str):
     return jnp.dtype(name)
 
 
+def _axis_bound(axis_name: str) -> bool:
+    """Trace-time check: are we inside shard_map with ``axis_name`` bound?
+
+    Lets ``attention_impl="ring"`` degrade to the mathematically identical
+    unsharded path outside shard_map — in particular ``init_params`` (which
+    traces the forward on dummy data with no mesh axes) would otherwise die
+    on an unbound axis name.
+    """
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+
+
 class MultiHeadSelfAttention(nn.Module):
     cfg: ModelConfig
 
@@ -64,13 +79,14 @@ class MultiHeadSelfAttention(nn.Module):
             from ..ops.flash_attention import flash_attention
 
             ctx = flash_attention(q, k, v, bias)
-        elif cfg.attention_impl == "ring":
-            # Requires the forward to run inside shard_map with the sequence
-            # dimension sharded over cfg.ring_axis.
+        elif cfg.attention_impl == "ring" and _axis_bound(cfg.ring_axis):
+            # Sequence-sharded forward inside shard_map over cfg.ring_axis.
             from ..parallel.ring_attention import ring_attention
 
             ctx = ring_attention(q, k, v, bias, axis_name=cfg.ring_axis)
-        elif cfg.attention_impl == "dot":
+        elif cfg.attention_impl in ("dot", "ring"):
+            # "ring" outside shard_map (e.g. init_params, unsharded eval)
+            # runs the identical unsharded math.
             ctx = dot_product_attention(
                 q, k, v, bias,
                 dropout_rate=cfg.attention_dropout,
@@ -141,7 +157,7 @@ class Embeddings(nn.Module):
             embedding_init=nn.initializers.normal(cfg.initializer_range),
             name="position_embeddings",
         )
-        if cfg.attention_impl == "ring":
+        if cfg.attention_impl == "ring" and _axis_bound(cfg.ring_axis):
             # Sequence-sharded forward (inside shard_map over cfg.ring_axis):
             # this shard embeds global positions [shard*L_local, ...), not
             # [0, L_local).
@@ -191,7 +207,7 @@ class DDoSClassifier(nn.Module):
             input_ids, attention_mask, deterministic
         )
         pooled = hidden[:, 0, :]  # CLS token (reference client1.py:62)
-        if cfg.attention_impl == "ring":
+        if cfg.attention_impl == "ring" and _axis_bound(cfg.ring_axis):
             # Under sequence sharding only shard 0's token 0 is the global
             # CLS; broadcast it so every shard computes identical logits.
             is_first = (jax.lax.axis_index(cfg.ring_axis) == 0).astype(pooled.dtype)

@@ -1,0 +1,55 @@
+"""Build fedwire.so (the native wire-format byte-path) with g++.
+
+Usage: ``python native/build.py [--out DIR]``. Also importable:
+``build(out_dir)`` returns the .so path or None when no toolchain exists
+(callers fall back to the pure-numpy implementations in comm/native.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fedwire.cpp")
+DEFAULT_OUT = os.path.dirname(os.path.abspath(__file__))
+SONAME = "fedwire.so"
+
+
+def build(out_dir: str = DEFAULT_OUT, *, force: bool = False) -> str | None:
+    out = os.path.join(out_dir, SONAME)
+    if not force and os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(_SRC):
+        return out
+    gxx = shutil.which("g++") or shutil.which("clang++")
+    if gxx is None:
+        return None
+    cmd = [
+        gxx,
+        "-O3",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        "-fno-exceptions",
+        _SRC,
+        "-o",
+        out,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        sys.stderr.write(f"fedwire build failed:\n{e.stderr}\n")
+        return None
+    return out
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=DEFAULT_OUT)
+    ap.add_argument("--force", action="store_true")
+    args = ap.parse_args()
+    path = build(args.out, force=args.force)
+    if path is None:
+        sys.exit("no C++ toolchain found (g++/clang++)")
+    print(path)

@@ -174,6 +174,29 @@ def test_ring_rejects_query_bias(rng, eight_devices):
         ring_attention_sharded(q, k, v, causal, mesh=mesh, axis_name="seq")
 
 
+def test_ring_config_initializes_and_runs_outside_shard_map(rng):
+    """attention_impl='ring' must work through the normal Trainer path:
+    init_params and unsharded eval trace outside shard_map and fall back to
+    the identical unsharded math."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        TrainConfig,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.engine import (
+        Trainer,
+    )
+
+    cfg = ModelConfig.tiny(attention_impl="ring", attention_dropout=0.0)
+    trainer = Trainer(cfg, TrainConfig())
+    state = trainer.init_state(seed=0)  # would raise NameError before the fix
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, cfg.max_len)), jnp.int32)
+    mask = jnp.ones((2, cfg.max_len), jnp.int32)
+    ref = DDoSClassifier(cfg.replace(attention_impl="dot", attention_dropout=0.0)).apply(
+        {"params": state.params}, ids, mask, True
+    )
+    out = trainer.model.apply({"params": state.params}, ids, mask, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
 def test_config_rejects_attention_dropout_for_flash_and_ring():
     for impl in ("flash", "ring"):
         with pytest.raises(ValueError, match="attention dropout"):
