@@ -1,0 +1,416 @@
+"""Command-line orchestration — the reference's three ``main()``s unified.
+
+The reference's entry points are three scripts with hard-coded paths, ports,
+seeds, and client count (reference client1.py:353-415, client2.py:332-392,
+server.py:116-140); adding a client means copy-pasting a file. Here one CLI
+covers every deployment shape, parameterized by client id / count:
+
+  local       one client, train -> eval -> metrics CSV + plots
+              (reference client1.py minus the sockets)
+  federated   N clients on one TPU mesh: SPMD local epochs + pmean FedAvg,
+              multi-round, checkpoint/resume (the TPU-native deployment)
+  serve       TCP aggregation server (demo-parity mode, reference server.py)
+  client      TCP client: train locally, exchange with a serve process,
+              re-evaluate the aggregate (reference client1.py end-to-end)
+  export-config   print the full default config as JSON (there is no config
+                  file in the reference to copy from)
+
+Config resolution: defaults <- --config JSON <- explicit flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import Any, Sequence
+
+import numpy as np
+
+from .config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from .utils.logging import get_logger, phase
+
+log = get_logger()
+
+
+# ------------------------------------------------------------------ config
+def _preset_model(preset: str, vocab_size: int) -> ModelConfig:
+    if preset == "tiny":
+        return ModelConfig.tiny(vocab_size=vocab_size)
+    if preset == "distilbert":
+        return ModelConfig(vocab_size=vocab_size)
+    if preset == "bert":
+        return ModelConfig.bert_base(vocab_size=vocab_size)
+    raise SystemExit(f"unknown --preset {preset!r} (tiny|distilbert|bert)")
+
+
+def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentConfig:
+    """defaults <- --config file <- flags."""
+    if getattr(args, "config", None):
+        with open(args.config) as f:
+            cfg = ExperimentConfig.from_dict(json.load(f))
+    else:
+        preset = getattr(args, "preset", "tiny")
+        model = _preset_model(preset, vocab_size)
+        cfg = ExperimentConfig(
+            model=model,
+            data=DataConfig(max_len=model.max_len),
+        )
+
+    model_kw: dict[str, Any] = {}
+    if getattr(args, "max_len", None):
+        model_kw.update(max_len=args.max_len)
+    if model_kw:
+        cfg = dataclasses.replace(cfg, model=cfg.model.replace(**model_kw))
+
+    data_kw: dict[str, Any] = {"max_len": cfg.model.max_len}
+    if getattr(args, "batch_size", None):
+        data_kw.update(batch_size=args.batch_size, eval_batch_size=args.batch_size)
+    if getattr(args, "data_fraction", None):
+        data_kw.update(data_fraction=args.data_fraction)
+    if getattr(args, "partition", None):
+        data_kw.update(partition=args.partition)
+    cfg = dataclasses.replace(cfg, data=dataclasses.replace(cfg.data, **data_kw))
+
+    train_kw: dict[str, Any] = {}
+    if getattr(args, "epochs", None):
+        train_kw.update(epochs_per_round=args.epochs)
+    if getattr(args, "learning_rate", None):
+        train_kw.update(learning_rate=args.learning_rate)
+    if getattr(args, "seed", None) is not None:
+        train_kw.update(seed=args.seed)
+    if train_kw:
+        cfg = dataclasses.replace(cfg, train=dataclasses.replace(cfg.train, **train_kw))
+
+    if hasattr(args, "num_clients"):
+        n = args.num_clients or cfg.fed.num_clients
+        cfg = dataclasses.replace(
+            cfg,
+            fed=dataclasses.replace(
+                cfg.fed,
+                num_clients=n,
+                rounds=getattr(args, "rounds", None) or cfg.fed.rounds,
+                weighted=bool(getattr(args, "weighted", False)) or cfg.fed.weighted,
+            ),
+            mesh=MeshConfig(
+                clients=n, data=getattr(args, "data_parallel", None) or cfg.mesh.data
+            ),
+        )
+    if getattr(args, "output_dir", None):
+        cfg = dataclasses.replace(cfg, output_dir=args.output_dir)
+    if getattr(args, "checkpoint_dir", None):
+        cfg = dataclasses.replace(cfg, checkpoint_dir=args.checkpoint_dir)
+    return cfg
+
+
+# -------------------------------------------------------------------- data
+def _load_clients(args, cfg: ExperimentConfig, tok, num_clients: int):
+    """CSV (or synthetic) -> per-client tokenized splits."""
+    from .data import (
+        load_flow_csv,
+        make_all_client_splits,
+        make_synthetic_flows,
+        tokenize_client,
+    )
+
+    if getattr(args, "csv", None):
+        with phase(f"loading {args.csv}", tag="DATA"):
+            df = load_flow_csv(args.csv)
+    else:
+        n = getattr(args, "synthetic", None) or 2400
+        with phase(f"generating {n} synthetic flows", tag="DATA"):
+            df = make_synthetic_flows(n, seed=cfg.data.seed_base)
+    with phase("partition/split/tokenize", tag="DATA"):
+        splits = make_all_client_splits(df, num_clients, cfg.data)
+        return [tokenize_client(s, tok, max_len=cfg.model.max_len) for s in splits]
+
+
+# --------------------------------------------------------------- reporting
+def _write_reports(
+    client_id: int,
+    local: dict,
+    aggregated: dict | None,
+    output_dir: str,
+) -> None:
+    """The reference's per-client artifact set: one-row metrics CSVs named
+    ``client{N}_{local,aggregated}_metrics.csv`` (client1.py:386,401) and the
+    plot set under ``client{N}_plots/`` (client1.py:153-225)."""
+    from . import reporting
+
+    os.makedirs(output_dir, exist_ok=True)
+    reporting.save_metrics(
+        local, os.path.join(output_dir, f"client{client_id}_local_metrics.csv")
+    )
+    if aggregated is not None:
+        reporting.save_metrics(
+            aggregated,
+            os.path.join(output_dir, f"client{client_id}_aggregated_metrics.csv"),
+        )
+    written = reporting.plot_evaluation(
+        local,
+        aggregated,
+        os.path.join(output_dir, f"client{client_id}_plots"),
+        client_id=client_id,
+    )
+    log.info(
+        f"[CLIENT {client_id}] wrote metrics CSVs and {len(written)} plots "
+        f"under {output_dir}"
+    )
+
+
+# ---------------------------------------------------------------- commands
+def cmd_local(args) -> int:
+    from .data import default_tokenizer
+    from .train.engine import Trainer
+
+    tok = default_tokenizer()
+    cfg = resolve_config(args, vocab_size=len(tok.vocab))
+    client = _load_clients(args, cfg, tok, max(args.client_id + 1, 1))[args.client_id]
+    trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
+    state = trainer.init_state()
+    with phase(f"client {args.client_id} local training", tag="TRAIN"):
+        state, losses = trainer.fit(
+            state,
+            client.train,
+            batch_size=cfg.data.batch_size,
+            tag=f"[CLIENT {args.client_id}] ",
+        )
+    with phase("validation evaluation", tag="EVAL"):
+        val = trainer.evaluate(state.params, client.val, batch_size=cfg.data.eval_batch_size)
+    with phase("test evaluation", tag="EVAL"):
+        test = trainer.evaluate(state.params, client.test, batch_size=cfg.data.eval_batch_size)
+    log.info(
+        f"[CLIENT {args.client_id}] val acc {val['Accuracy']:.4f} | "
+        f"test acc {test['Accuracy']:.4f} f1 {test['F1-Score']:.4f}"
+    )
+    _write_reports(args.client_id, test, None, cfg.output_dir)
+    if cfg.checkpoint_dir:
+        from .train.checkpoint import Checkpointer
+
+        with Checkpointer(cfg.checkpoint_dir) as ckpt:
+            ckpt.save(int(state.step), state, meta={"client_id": args.client_id})
+            ckpt.wait()
+    return 0
+
+
+def cmd_federated(args) -> int:
+    from .data import default_tokenizer, stack_clients
+    from .train.federated import FederatedTrainer
+
+    tok = default_tokenizer()
+    cfg = resolve_config(args, vocab_size=len(tok.vocab))
+    C = cfg.fed.num_clients
+    clients = _load_clients(args, cfg, tok, C)
+    stacked_train = stack_clients([c.train for c in clients])
+    trainer = FederatedTrainer(cfg, pad_id=tok.pad_id)
+
+    ckpt = None
+    start_round = 0
+    state = trainer.init_state()
+    if cfg.checkpoint_dir:
+        from .train.checkpoint import Checkpointer, maybe_warm_start
+
+        restored, step = maybe_warm_start(cfg.checkpoint_dir, state)
+        if restored is not None:
+            state, start_round = restored, int(step)
+            log.info(f"[FED] resumed from round {start_round}")
+        ckpt = Checkpointer(cfg.checkpoint_dir)
+
+    weights = (
+        np.array([len(c.train) for c in clients], np.float64)
+        if cfg.fed.weighted
+        else None
+    )
+    prepared = trainer.prepare_eval([c.test for c in clients])
+    history = []
+    for r in range(start_round, cfg.fed.rounds):
+        with phase(f"round {r + 1}/{cfg.fed.rounds}", tag="FED"):
+            state, losses = trainer.fit_local(
+                state, stacked_train, epoch_offset=r * cfg.train.epochs_per_round
+            )
+            local = trainer.evaluate_clients(state.params, prepared=prepared)
+            state = trainer.aggregate(state, weights=weights)
+            aggregated = trainer.evaluate_clients(state.params, prepared=prepared)
+        history.append((r, local, aggregated))
+        for c in range(C):
+            log.info(
+                f"[FED] round {r + 1} client {c}: local acc "
+                f"{local[c]['Accuracy']:.4f} -> aggregated "
+                f"{aggregated[c]['Accuracy']:.4f}"
+            )
+        if ckpt is not None:
+            ckpt.save(r + 1, state, meta={"round": r + 1, "config": cfg.to_dict()})
+        if r + 1 < cfg.fed.rounds and cfg.fed.reset_optimizer_each_round:
+            state = trainer.reset_optimizer(state)
+    if ckpt is not None:
+        ckpt.wait()
+        ckpt.close()
+
+    # Final reporting with probs for ROC/PR curves.
+    final_local = history[-1][1] if history else None
+    final_agg = trainer.evaluate_clients(
+        state.params, prepared=prepared, collect_probs=True
+    )
+    for c in range(C):
+        _write_reports(
+            c,
+            final_local[c] if final_local else final_agg[c],
+            final_agg[c],
+            cfg.output_dir,
+        )
+    return 0
+
+
+def cmd_serve(args) -> int:
+    from .comm import AggregationServer
+
+    with AggregationServer(
+        host=args.host,
+        port=args.port,
+        num_clients=args.num_clients,
+        weighted=args.weighted,
+        min_clients=args.min_clients,
+        timeout=args.timeout,
+        compression=args.compression,
+    ) as server:
+        log.info(f"[SERVER] listening on {args.host}:{server.port}")
+        server.serve(rounds=args.rounds or 1)
+    return 0
+
+
+def cmd_client(args) -> int:
+    """The reference client1.py end-to-end: train -> eval -> exchange over
+    TCP -> load aggregate -> re-eval -> CSVs + plots; degrades to local-only
+    reports when the exchange fails (client1.py:405-410)."""
+    from .comm import FederatedClient
+    from .data import default_tokenizer
+    from .train.engine import Trainer
+
+    tok = default_tokenizer()
+    cfg = resolve_config(args, vocab_size=len(tok.vocab))
+    client_data = _load_clients(args, cfg, tok, cfg.fed.num_clients)[args.client_id]
+    trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
+    state = trainer.init_state()
+    with phase(f"client {args.client_id} local training", tag="TRAIN"):
+        state, _ = trainer.fit(
+            state, client_data.train, batch_size=cfg.data.batch_size,
+            tag=f"[CLIENT {args.client_id}] ",
+        )
+    local = trainer.evaluate(state.params, client_data.test)
+
+    import jax
+
+    host_params = jax.tree.map(np.asarray, state.params)
+    agg_metrics = None
+    try:
+        with phase("federated exchange", tag="COMM"):
+            fed = FederatedClient(
+                args.host, args.port, client_id=args.client_id,
+                timeout=args.timeout, compression=args.compression,
+            )
+            aggregated = fed.exchange(host_params, n_samples=len(client_data.train))
+        with phase("aggregated evaluation", tag="EVAL"):
+            agg_metrics = trainer.evaluate(aggregated, client_data.test)
+        log.info(
+            f"[CLIENT {args.client_id}] local acc {local['Accuracy']:.4f} -> "
+            f"aggregated acc {agg_metrics['Accuracy']:.4f}"
+        )
+    except (ConnectionError, OSError) as e:
+        log.info(f"[CLIENT {args.client_id}] exchange failed ({e}); local-only reports")
+    _write_reports(args.client_id, local, agg_metrics, cfg.output_dir)
+    return 0
+
+
+def cmd_export_config(args) -> int:
+    from .data import default_tokenizer
+
+    cfg = resolve_config(args, vocab_size=len(default_tokenizer().vocab))
+    json.dump(cfg.to_dict(), sys.stdout, indent=2)
+    sys.stdout.write("\n")
+    return 0
+
+
+# ------------------------------------------------------------------ parser
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", help="JSON config file (ExperimentConfig.to_dict shape)")
+    p.add_argument("--preset", default="tiny", help="tiny|distilbert|bert")
+    p.add_argument("--csv", help="CICIDS2017-style flow CSV path")
+    p.add_argument("--synthetic", type=int, metavar="N", help="use N synthetic flows")
+    p.add_argument("--output-dir", default=None)
+    p.add_argument("--batch-size", type=int)
+    p.add_argument("--epochs", type=int, help="epochs per round")
+    p.add_argument("--learning-rate", type=float)
+    p.add_argument("--max-len", type=int)
+    p.add_argument("--data-fraction", type=float)
+    p.add_argument("--seed", type=int)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="fedtpu",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("local", help="single-client train/eval/report")
+    _add_common(p)
+    p.add_argument("--client-id", type=int, default=0)
+    p.add_argument("--checkpoint-dir")
+    p.set_defaults(fn=cmd_local)
+
+    p = sub.add_parser("federated", help="N-client SPMD FedAvg on the TPU mesh")
+    _add_common(p)
+    p.add_argument("--num-clients", type=int, default=None)  # None: config wins
+    p.add_argument("--rounds", type=int)
+    p.add_argument("--data-parallel", type=int, help="per-client data-parallel shards")
+    p.add_argument("--weighted", action="store_true", help="weight FedAvg by sample count")
+    p.add_argument("--partition", help="sample|disjoint|dirichlet")
+    p.add_argument("--checkpoint-dir")
+    p.set_defaults(fn=cmd_federated)
+
+    p = sub.add_parser("serve", help="TCP aggregation server (demo-parity mode)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=12345)
+    p.add_argument("--num-clients", type=int, default=2)
+    p.add_argument("--rounds", type=int, default=1)
+    p.add_argument("--min-clients", type=int, default=None)
+    p.add_argument("--weighted", action="store_true")
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--compression", default="none", choices=["none", "bf16"])
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("client", help="TCP federated client (demo-parity mode)")
+    _add_common(p)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=12345)
+    p.add_argument("--client-id", type=int, required=True)
+    p.add_argument("--num-clients", type=int, default=None)  # None: config wins
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--compression", default="none", choices=["none", "bf16"])
+    p.set_defaults(fn=cmd_client)
+
+    p = sub.add_parser("export-config", help="print the resolved config as JSON")
+    _add_common(p)
+    p.add_argument("--num-clients", type=int)
+    p.add_argument("--rounds", type=int)
+    p.set_defaults(fn=cmd_export_config)
+    return ap
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

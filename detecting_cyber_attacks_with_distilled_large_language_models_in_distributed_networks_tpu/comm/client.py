@@ -106,7 +106,8 @@ class FederatedClient:
             except (OSError, ConnectionError, wire.WireError) as e:
                 last = e
                 log.info(f"[CLIENT {self.client_id}] round attempt {attempt} failed: {e}")
-                time.sleep(min(2.0**attempt, 10.0))
+                if attempt < max_retries:
+                    time.sleep(min(2.0**attempt, 10.0))
             finally:
                 if sock is not None:
                     sock.close()

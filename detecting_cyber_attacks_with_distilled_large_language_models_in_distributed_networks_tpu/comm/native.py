@@ -102,12 +102,9 @@ def pack_bf16(x: np.ndarray) -> np.ndarray:
             x.size,
         )
         return out
-    bits = x.view(np.uint32)
-    nan = (bits & 0x7FFFFFFF) > 0x7F800000
-    rounding = np.uint32(0x7FFF) + ((bits >> np.uint32(16)) & np.uint32(1))
-    rounded = ((bits + rounding) >> np.uint32(16)).astype(np.uint16)
-    quiet_nan = ((bits >> np.uint32(16)).astype(np.uint16)) | np.uint16(0x0040)
-    return np.where(nan, quiet_nan, rounded)
+    import ml_dtypes  # JAX dependency; its cast is the TPU RNE semantics
+
+    return x.astype(ml_dtypes.bfloat16).view(np.uint16)
 
 
 def unpack_bf16(x: np.ndarray, shape=None) -> np.ndarray:
@@ -122,7 +119,11 @@ def unpack_bf16(x: np.ndarray, shape=None) -> np.ndarray:
             x.size,
         )
     else:
-        out[...] = x.astype(np.uint32) << np.uint32(16)
+        import ml_dtypes
+
+        out[...] = (
+            x.view(ml_dtypes.bfloat16).astype(np.float32).view(np.uint32)
+        )
     f = out.view(np.float32)
     return f.reshape(shape) if shape is not None else f
 

@@ -77,6 +77,10 @@ class _Round:
     conns: dict[int, socket.socket] = field(default_factory=dict)
     lock: threading.Lock = field(default_factory=threading.Lock)
     complete: threading.Event = field(default_factory=threading.Event)
+    # Set (under lock) when serve_round snapshots the round; a handler that
+    # finishes its recv after this must drop the connection, not register
+    # into an abandoned round.
+    closed: bool = False
 
 
 class AggregationServer:
@@ -131,6 +135,16 @@ class AggregationServer:
             flat = wire.flatten_params(flat)
             client_id = int(meta.get("client_id", -1))
             with rnd.lock:
+                if rnd.closed:
+                    # Round already snapshotted (deadline hit mid-upload):
+                    # close so the client fails fast and retries next round
+                    # instead of blocking on a reply that will never come.
+                    log.info(
+                        f"[SERVER] late upload from client {client_id} after "
+                        "round close; dropping connection"
+                    )
+                    conn.close()
+                    return
                 if client_id in rnd.models:
                     log.info(f"[SERVER] duplicate upload from client {client_id}; replacing")
                     old = rnd.conns.pop(client_id, None)
@@ -172,6 +186,7 @@ class AggregationServer:
             t.join(timeout=max(0.1, deadline - time.monotonic()))
 
         with rnd.lock:
+            rnd.closed = True
             models = dict(rnd.models)
             conns = dict(rnd.conns)
             n_samples = dict(rnd.n_samples)
@@ -194,14 +209,25 @@ class AggregationServer:
             for c in conns.values():
                 c.close()
             raise
-        for cid in ids:
-            conn = conns[cid]
+        # Replies go out on parallel threads: send_frame blocks on the
+        # client's ACK, so a sequential loop would let one dead client stall
+        # every healthy one behind it for a full socket timeout.
+        def _reply(cid: int, conn: socket.socket) -> None:
             try:
                 framing.send_frame(conn, reply)
             except (OSError, wire.WireError, ConnectionError) as e:
                 log.info(f"[SERVER] reply to client {cid} failed: {e}")
             finally:
                 conn.close()
+
+        reply_threads = [
+            threading.Thread(target=_reply, args=(cid, conns[cid]), daemon=True)
+            for cid in ids
+        ]
+        for t in reply_threads:
+            t.start()
+        for t in reply_threads:
+            t.join(timeout=self.timeout)
         return agg
 
     def serve(self, rounds: int = 1) -> None:

@@ -160,9 +160,12 @@ def decode(data: bytes | memoryview) -> tuple[dict, dict]:
             key, dtype = t["key"], t["dtype"]
             if dtype not in _ALLOWED_DTYPES:
                 raise WireError(f"tensor {key!r} has unsupported dtype {dtype}")
-            raw = payload[t["offset"] : t["offset"] + t["nbytes"]]
-            if len(raw) != t["nbytes"]:
-                raise WireError(f"tensor {key!r} extends past payload")
+            offset, nbytes = int(t["offset"]), int(t["nbytes"])
+            if offset < 0 or nbytes < 0 or offset + nbytes > len(payload):
+                # Explicit bounds: a negative offset would slice from the
+                # payload's tail and alias another tensor's bytes.
+                raise WireError(f"tensor {key!r} has out-of-bounds extent")
+            raw = payload[offset : offset + nbytes]
             if t["enc"] == "bf16":
                 packed = np.frombuffer(raw, np.uint16)
                 arr = native.unpack_bf16(packed, shape=tuple(t["shape"]))

@@ -1,0 +1,108 @@
+"""CLI orchestration: config resolution and the local/federated flows
+end-to-end on synthetic data (reference artifact names must appear)."""
+
+import json
+import os
+
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
+    build_parser,
+    main,
+    resolve_config,
+)
+
+
+def test_parser_covers_reference_deployment_shapes():
+    ap = build_parser()
+    for argv in (
+        ["local", "--synthetic", "400"],
+        ["federated", "--num-clients", "4", "--rounds", "2"],
+        ["serve", "--port", "0", "--num-clients", "2"],
+        ["client", "--client-id", "1", "--port", "12345"],
+        ["export-config"],
+    ):
+        args = ap.parse_args(argv)
+        assert callable(args.fn)
+
+
+def test_resolve_config_flag_overrides():
+    ap = build_parser()
+    args = ap.parse_args(
+        [
+            "federated", "--num-clients", "4", "--rounds", "3",
+            "--batch-size", "8", "--epochs", "2", "--learning-rate", "1e-3",
+            "--output-dir", "/tmp/x",
+        ]
+    )
+    cfg = resolve_config(args, vocab_size=130)
+    assert cfg.fed.num_clients == 4 and cfg.fed.rounds == 3
+    assert cfg.mesh.clients == 4
+    assert cfg.data.batch_size == 8
+    assert cfg.train.epochs_per_round == 2
+    assert cfg.train.learning_rate == pytest.approx(1e-3)
+    assert cfg.output_dir == "/tmp/x"
+
+
+def test_resolve_config_from_file_roundtrip(tmp_path):
+    ap = build_parser()
+    cfg0 = resolve_config(ap.parse_args(["export-config"]), vocab_size=130)
+    path = tmp_path / "cfg.json"
+    path.write_text(json.dumps(cfg0.to_dict()))
+    cfg1 = resolve_config(
+        ap.parse_args(["federated", "--config", str(path)]), vocab_size=130
+    )
+    assert cfg1.model == cfg0.model
+    assert cfg1.data == cfg0.data
+
+
+def test_export_config_prints_json(capsys):
+    assert main(["export-config", "--num-clients", "3"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["fed"]["num_clients"] == 3
+    assert out["model"]["n_layers"] == 2  # tiny preset
+
+
+def test_local_flow_writes_reference_artifacts(tmp_path):
+    rc = main(
+        [
+            "local", "--synthetic", "300", "--epochs", "1",
+            "--output-dir", str(tmp_path), "--seed", "0",
+        ]
+    )
+    assert rc == 0
+    assert (tmp_path / "client0_local_metrics.csv").exists()
+    header = (tmp_path / "client0_local_metrics.csv").read_text().splitlines()[0]
+    assert header == "Accuracy,Loss,Precision,Recall,F1-Score"
+    plots = os.listdir(tmp_path / "client0_plots")
+    assert "client0_local_confusion_matrix.png" in plots
+
+
+def test_federated_flow_writes_artifacts_and_checkpoints(tmp_path, eight_devices):
+    out = tmp_path / "out"
+    ckpt = tmp_path / "ckpt"
+    rc = main(
+        [
+            "federated", "--synthetic", "600", "--num-clients", "2",
+            "--rounds", "1", "--epochs", "1",
+            "--output-dir", str(out), "--checkpoint-dir", str(ckpt),
+        ]
+    )
+    assert rc == 0
+    for c in range(2):
+        assert (out / f"client{c}_local_metrics.csv").exists()
+        assert (out / f"client{c}_aggregated_metrics.csv").exists()
+        plots = os.listdir(out / f"client{c}_plots")
+        assert f"client{c}_metrics_comparison.png" in plots
+        assert f"client{c}_aggregated_roc.png" in plots
+    # Round checkpoint landed and is resumable (round 1 == fed.rounds, so a
+    # resume is a no-op that still reports).
+    assert any(p.isdigit() for p in os.listdir(ckpt))
+    rc2 = main(
+        [
+            "federated", "--synthetic", "600", "--num-clients", "2",
+            "--rounds", "1", "--epochs", "1",
+            "--output-dir", str(out), "--checkpoint-dir", str(ckpt),
+        ]
+    )
+    assert rc2 == 0
