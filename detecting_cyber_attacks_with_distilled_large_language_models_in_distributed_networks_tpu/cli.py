@@ -69,17 +69,22 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
     model_kw: dict[str, Any] = {}
     if getattr(args, "max_len", None):
         model_kw.update(max_len=args.max_len)
-    if model_kw:
-        cfg = dataclasses.replace(cfg, model=cfg.model.replace(**model_kw))
+    new_model = cfg.model.replace(**model_kw) if model_kw else cfg.model
 
-    data_kw: dict[str, Any] = {"max_len": cfg.model.max_len}
+    # model and data must change together: ExperimentConfig.__post_init__
+    # checks data.max_len == model.max_len on every replace.
+    data_kw: dict[str, Any] = {"max_len": new_model.max_len}
+    if getattr(args, "dataset", None):
+        data_kw.update(dataset=args.dataset)
     if getattr(args, "batch_size", None):
         data_kw.update(batch_size=args.batch_size, eval_batch_size=args.batch_size)
     if getattr(args, "data_fraction", None):
         data_kw.update(data_fraction=args.data_fraction)
     if getattr(args, "partition", None):
         data_kw.update(partition=args.partition)
-    cfg = dataclasses.replace(cfg, data=dataclasses.replace(cfg.data, **data_kw))
+    cfg = dataclasses.replace(
+        cfg, model=new_model, data=dataclasses.replace(cfg.data, **data_kw)
+    )
 
     train_kw: dict[str, Any] = {}
     if getattr(args, "epochs", None):
@@ -114,21 +119,41 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
 
 # -------------------------------------------------------------------- data
 def _load_clients(args, cfg: ExperimentConfig, tok, num_clients: int):
-    """CSV (or synthetic) -> per-client tokenized splits."""
+    """CSV / mixed corpus / synthetic -> per-client tokenized splits."""
     from .data import (
         load_flow_csv,
+        load_mixed_corpus,
         make_all_client_splits,
-        make_synthetic_flows,
+        make_all_client_splits_from_corpus,
+        make_synthetic,
+        parse_source_arg,
         tokenize_client,
     )
 
+    if getattr(args, "source", None):
+        if getattr(args, "csv", None):
+            raise SystemExit("--csv and --source are mutually exclusive")
+        # --dataset pins the schema for unprefixed --source entries; entries
+        # without either fall back to schema auto-detection.
+        default_name = getattr(args, "dataset", None)
+        entries = [
+            (name or default_name, path)
+            for name, path in map(parse_source_arg, args.source)
+        ]
+        with phase(f"loading {len(entries)}-source mixed corpus", tag="DATA"):
+            corpus = load_mixed_corpus(entries)
+        with phase("partition/split/tokenize", tag="DATA"):
+            splits = make_all_client_splits_from_corpus(
+                corpus, num_clients, cfg.data
+            )
+            return [tokenize_client(s, tok, max_len=cfg.model.max_len) for s in splits]
     if getattr(args, "csv", None):
         with phase(f"loading {args.csv}", tag="DATA"):
             df = load_flow_csv(args.csv)
     else:
         n = getattr(args, "synthetic", None) or 2400
-        with phase(f"generating {n} synthetic flows", tag="DATA"):
-            df = make_synthetic_flows(n, seed=cfg.data.seed_base)
+        with phase(f"generating {n} synthetic {cfg.data.dataset} flows", tag="DATA"):
+            df = make_synthetic(cfg.data.dataset, n, seed=cfg.data.seed_base)
     with phase("partition/split/tokenize", tag="DATA"):
         splits = make_all_client_splits(df, num_clients, cfg.data)
         return [tokenize_client(s, tok, max_len=cfg.model.max_len) for s in splits]
@@ -343,7 +368,18 @@ def cmd_export_config(args) -> int:
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--config", help="JSON config file (ExperimentConfig.to_dict shape)")
     p.add_argument("--preset", default="tiny", help="tiny|distilbert|bert")
-    p.add_argument("--csv", help="CICIDS2017-style flow CSV path")
+    p.add_argument("--csv", help="flow CSV path (schema set by --dataset)")
+    p.add_argument(
+        "--dataset",
+        help="registered dataset schema: cicids2017|cicddos2019|unswnb15",
+    )
+    p.add_argument(
+        "--source",
+        action="append",
+        metavar="[DATASET=]PATH",
+        help="mixed-corpus CSV source (repeatable); dataset auto-detected "
+        "from the schema when omitted",
+    )
     p.add_argument("--synthetic", type=int, metavar="N", help="use N synthetic flows")
     p.add_argument("--output-dir", default=None)
     p.add_argument("--batch-size", type=int)

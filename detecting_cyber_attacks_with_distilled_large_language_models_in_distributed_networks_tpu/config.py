@@ -112,6 +112,9 @@ class DataConfig:
     """
 
     csv_path: str = "CICIDS2017.csv"
+    # Registered dataset schema: cicids2017 | cicddos2019 | unswnb15
+    # (data/datasets.py). Governs the text template + binary-label semantics.
+    dataset: str = "cicids2017"
     data_fraction: float = 0.1
     seed_base: int = 42  # client i uses seed_base + i  (42, 43, ... — matches reference)
     val_fraction: float = 0.2

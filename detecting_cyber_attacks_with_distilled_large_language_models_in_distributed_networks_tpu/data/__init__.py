@@ -1,15 +1,40 @@
-from .textualize import FLOW_TEXT_COLUMNS, flow_to_text, texts_from_dataframe  # noqa: F401
+from .textualize import (  # noqa: F401
+    CICIDS_TEMPLATE,
+    FLOW_TEXT_COLUMNS,
+    flow_to_text,
+    render_template,
+    texts_from_dataframe,
+)
+from .datasets import (  # noqa: F401
+    DATASETS,
+    Corpus,
+    DatasetSpec,
+    UNSW_TEMPLATE,
+    concat_corpora,
+    corpus_from_frame,
+    detect_dataset,
+    get_dataset,
+    load_mixed_corpus,
+    parse_source_arg,
+)
 from .cicids import (  # noqa: F401
     ClientSplits,
     SplitArrays,
     load_client_frame,
     load_flow_csv,
     make_all_client_splits,
+    make_all_client_splits_from_corpus,
     make_client_splits,
     partition_indices,
     train_val_test_split,
 )
-from .synthetic import make_synthetic_flows, write_synthetic_csv  # noqa: F401
+from .synthetic import (  # noqa: F401
+    make_synthetic,
+    make_synthetic_ddos2019,
+    make_synthetic_flows,
+    make_synthetic_unsw,
+    write_synthetic_csv,
+)
 from .tokenizer import (  # noqa: F401
     WordPieceTokenizer,
     basic_tokenize,

@@ -24,7 +24,24 @@ import numpy as np
 import pandas as pd
 
 from ..config import DataConfig
-from .textualize import labels_from_dataframe, texts_from_dataframe
+from .datasets import Corpus, get_dataset
+from .textualize import labels_from_dataframe  # noqa: F401  (re-export)
+
+
+def _spec_texts(df: pd.DataFrame, cfg: DataConfig) -> list[str]:
+    return get_dataset(cfg.dataset).render_texts(df)
+
+
+def _spec_labels(df: pd.DataFrame, cfg: DataConfig) -> np.ndarray:
+    """Binary labels under the active dataset spec; for CICIDS2017-style
+    positive-match labels the config's label_column/positive_label knobs
+    still apply (reference client1.py:91 semantics)."""
+    spec = get_dataset(cfg.dataset)
+    if spec.label_kind == "positive":
+        return spec.binary_labels(
+            df, label_column=cfg.label_column, positive_value=cfg.positive_label
+        )
+    return spec.binary_labels(df)
 
 
 def load_flow_csv(path: str) -> pd.DataFrame:
@@ -152,7 +169,7 @@ def _all_client_frames(
             sample_client_frame(df, cfg.data_fraction, cfg.client_seed(cid))
             for cid in range(num_clients)
         ]
-    labels = labels_from_dataframe(df, cfg.label_column, cfg.positive_label)
+    labels = _spec_labels(df, cfg)
     parts = partition_indices(labels, num_clients, cfg)
     return [df.iloc[idx] for idx in parts]
 
@@ -167,11 +184,9 @@ def load_client_frame(
     return _all_client_frames(df, num_clients, cfg)[client_id]
 
 
-def _splits_from_frame(
-    part: pd.DataFrame, client_id: int, cfg: DataConfig
+def _splits_from_arrays(
+    texts: list[str], labels: np.ndarray, client_id: int, cfg: DataConfig
 ) -> ClientSplits:
-    texts = texts_from_dataframe(part)
-    labels = labels_from_dataframe(part, cfg.label_column, cfg.positive_label)
     tr, va, te = train_val_test_split(
         len(texts), cfg.client_seed(client_id), cfg.val_fraction, cfg.test_fraction
     )
@@ -180,6 +195,14 @@ def _splits_from_frame(
         return SplitArrays([texts[i] for i in idx], labels[idx])
 
     return ClientSplits(client_id, _take(tr), _take(va), _take(te))
+
+
+def _splits_from_frame(
+    part: pd.DataFrame, client_id: int, cfg: DataConfig
+) -> ClientSplits:
+    return _splits_from_arrays(
+        _spec_texts(part, cfg), _spec_labels(part, cfg), client_id, cfg
+    )
 
 
 def make_client_splits(
@@ -196,3 +219,32 @@ def make_all_client_splits(
     """All clients in one pass (the partition is computed once)."""
     frames = _all_client_frames(df, num_clients, cfg)
     return [_splits_from_frame(p, cid, cfg) for cid, p in enumerate(frames)]
+
+
+def make_all_client_splits_from_corpus(
+    corpus: Corpus, num_clients: int, cfg: DataConfig
+) -> list[ClientSplits]:
+    """Per-client splits over a schema-erased (possibly mixed-dataset) corpus.
+
+    Same partition semantics as the frame path: ``sample`` draws an
+    independent ``data_fraction`` subset per client seed (the reference's
+    ``df.sample(frac, random_state)``, client1.py:89, on row indices);
+    ``disjoint``/``dirichlet`` reuse :func:`partition_indices` on the binary
+    labels. Mixed corpora are shuffled together, so a client's shard can span
+    source datasets — the point of BASELINE.json config 5.
+    """
+    n = len(corpus)
+    if cfg.partition == "sample":
+        per_client = max(1, int(round(n * cfg.data_fraction)))
+        parts = [
+            np.random.RandomState(cfg.client_seed(cid)).permutation(n)[:per_client]
+            for cid in range(num_clients)
+        ]
+    else:
+        parts = partition_indices(corpus.labels, num_clients, cfg)
+    return [
+        _splits_from_arrays(
+            [corpus.texts[i] for i in idx], corpus.labels[idx], cid, cfg
+        )
+        for cid, idx in enumerate(parts)
+    ]

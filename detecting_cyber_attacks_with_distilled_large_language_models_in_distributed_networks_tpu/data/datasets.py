@@ -1,0 +1,246 @@
+"""Dataset registry: CICIDS2017, CIC-DDoS2019, UNSW-NB15, and mixed corpora.
+
+The reference is hard-wired to one CICIDS2017 CSV with a ``'DDoS' -> 1``
+label map (reference client1.py:84-93); BASELINE.json config 5 asks for a
+"CIC-DDoS2019 + UNSW-NB15 mixed corpus" fleet. Each dataset here is a
+:class:`DatasetSpec`: an English text template over its flow columns (the
+same feature-to-text trick as reference client1.py:68-81, adapted per
+schema) plus binary-label semantics:
+
+* ``cicids2017``  — ``Label == 'DDoS'`` -> 1 (reference client1.py:91).
+* ``cicddos2019`` — CICFlowMeter schema shared with CICIDS2017, but labels
+  are per-attack classes (``DrDoS_DNS``, ``Syn``, ...), so the binary map is
+  ``Label != 'BENIGN'`` -> 1.
+* ``unswnb15``    — different schema entirely (dur/proto/service/spkts/...);
+  the official CSVs carry a 0/1 ``label`` column directly.
+
+A :class:`Corpus` is the schema-erased form — texts + binary labels +
+per-row source ids — which is what mixed-dataset federation partitions
+over (the per-client pipeline downstream of textualization is identical
+for every dataset).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+import pandas as pd
+
+from .textualize import CICIDS_TEMPLATE, render_template
+
+#: UNSW-NB15 text template over 10 of its 49 features, in the same English
+#: sentence style as the CICIDS2017 template (reference client1.py:68-81).
+UNSW_TEMPLATE: tuple[tuple[str, str, str], ...] = (
+    ("Protocol is ", "proto", ". "),
+    ("Service is ", "service", ". "),
+    ("Flow duration is ", "dur", " seconds. "),
+    ("Source to destination packets are ", "spkts", ". "),
+    ("Destination to source packets are ", "dpkts", ". "),
+    ("Source to destination bytes are ", "sbytes", " bytes. "),
+    ("Destination to source bytes are ", "dbytes", " bytes. "),
+    ("Packet rate is ", "rate", " per second. "),
+    ("Source load is ", "sload", " bits per second. "),
+    ("Destination load is ", "dload", " bits per second."),
+)
+
+
+@dataclass(frozen=True)
+class DatasetSpec:
+    """One dataset's text template + binary-label semantics."""
+
+    name: str
+    template: tuple[tuple[str, str, str], ...]
+    label_column: str
+    #: "positive"   — label == positive_value -> 1 (CICIDS2017 semantics)
+    #: "not_benign" — label != benign_value  -> 1 (multi-attack-class sets)
+    #: "int"        — label column already 0/1
+    label_kind: str
+    positive_value: str = "DDoS"
+    benign_value: str = "BENIGN"
+
+    def render_texts(self, df: pd.DataFrame) -> list[str]:
+        missing = [c for _, c, _ in self.template if c not in df.columns]
+        if missing:
+            raise KeyError(
+                f"dataset {self.name!r}: CSV is missing template columns "
+                f"{missing} (have {list(df.columns)[:8]}...)"
+            )
+        return render_template(df, self.template)
+
+    def binary_labels(
+        self,
+        df: pd.DataFrame,
+        *,
+        label_column: str | None = None,
+        positive_value: str | None = None,
+    ) -> np.ndarray:
+        col = label_column or self.label_column
+        if col not in df.columns:
+            raise KeyError(f"dataset {self.name!r}: no label column {col!r}")
+        if self.label_kind == "positive":
+            pos = positive_value or self.positive_value
+            return (df[col] == pos).to_numpy().astype(np.int32)
+        if self.label_kind == "not_benign":
+            return (df[col] != self.benign_value).to_numpy().astype(np.int32)
+        if self.label_kind == "int":
+            return df[col].to_numpy().astype(np.int32)
+        raise ValueError(f"unknown label_kind {self.label_kind!r}")
+
+    @property
+    def feature_columns(self) -> tuple[str, ...]:
+        return tuple(c for _, c, _ in self.template)
+
+
+CICIDS2017 = DatasetSpec(
+    name="cicids2017",
+    template=CICIDS_TEMPLATE,
+    label_column="Label",
+    label_kind="positive",
+    positive_value="DDoS",
+)
+
+CICDDOS2019 = DatasetSpec(
+    name="cicddos2019",
+    template=CICIDS_TEMPLATE,  # same CICFlowMeter feature schema
+    label_column="Label",
+    label_kind="not_benign",
+    benign_value="BENIGN",
+)
+
+UNSWNB15 = DatasetSpec(
+    name="unswnb15",
+    template=UNSW_TEMPLATE,
+    label_column="label",
+    label_kind="int",
+)
+
+DATASETS: dict[str, DatasetSpec] = {
+    s.name: s for s in (CICIDS2017, CICDDOS2019, UNSWNB15)
+}
+
+
+def get_dataset(name: str) -> DatasetSpec:
+    try:
+        return DATASETS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown dataset {name!r}; registered: {sorted(DATASETS)}"
+        ) from None
+
+
+#: Label values that occur only in CIC-DDoS2019 exports (beyond the DrDoS_*
+#: prefix family, which is matched by prefix).
+_DDOS2019_ONLY_LABELS = frozenset(
+    {"Syn", "TFTP", "MSSQL", "NetBIOS", "LDAP", "Portmap", "UDP", "UDPLag",
+     "UDP-lag", "WebDDoS"}
+)
+
+
+def detect_dataset(df: pd.DataFrame) -> DatasetSpec:
+    """Schema sniffing for ``--source path`` entries without an explicit name.
+
+    UNSW-NB15 is structurally distinct; CICIDS2017 vs CIC-DDoS2019 share the
+    CICFlowMeter schema and are told apart by their label vocabulary:
+    CIC-DDoS2019 names specific DDoS attacks (``DrDoS_*``, ``Syn``, ...).
+    Everything else — including real CICIDS2017 exports whose labels span
+    PortScan/Bot/DoS Hulk/etc. — keeps the reference's CICIDS2017 semantics
+    (only the exact label ``'DDoS'`` maps to 1, client1.py:91), so non-DDoS
+    attack rows stay 0 exactly as the reference would label them.
+    """
+    cols = set(df.columns)
+    if {"dur", "spkts", "dpkts"} <= cols:
+        return UNSWNB15
+    if "Label" in cols:
+        values = set(map(str, pd.unique(df["Label"])))
+        if any(v.startswith("DrDoS") for v in values) or (
+            values & _DDOS2019_ONLY_LABELS
+        ):
+            return CICDDOS2019
+        return CICIDS2017
+    raise ValueError(
+        "cannot detect dataset: no UNSW-NB15 columns and no 'Label' column "
+        f"(have {sorted(cols)[:10]}...)"
+    )
+
+
+# ------------------------------------------------------------------ corpus
+@dataclass
+class Corpus:
+    """Schema-erased training corpus: texts + binary labels + provenance."""
+
+    texts: list[str]
+    labels: np.ndarray  # [N] int32
+    source: np.ndarray  # [N] int32 — index into source_names
+    source_names: tuple[str, ...] = ()
+
+    def __len__(self) -> int:
+        return len(self.texts)
+
+    def __post_init__(self) -> None:
+        if not (len(self.texts) == len(self.labels) == len(self.source)):
+            raise ValueError(
+                f"corpus length mismatch: {len(self.texts)} texts, "
+                f"{len(self.labels)} labels, {len(self.source)} source ids"
+            )
+
+
+def corpus_from_frame(
+    df: pd.DataFrame, spec: DatasetSpec, source_id: int = 0
+) -> Corpus:
+    return Corpus(
+        texts=spec.render_texts(df),
+        labels=spec.binary_labels(df),
+        source=np.full(len(df), source_id, np.int32),
+        source_names=(spec.name,),
+    )
+
+
+def concat_corpora(parts: Sequence[Corpus]) -> Corpus:
+    """Concatenate per-dataset corpora into one mixed corpus, re-basing each
+    part's source ids onto a combined ``source_names`` tuple."""
+    texts: list[str] = []
+    labels: list[np.ndarray] = []
+    source: list[np.ndarray] = []
+    names: list[str] = []
+    for part in parts:
+        base = len(names)
+        names.extend(part.source_names)
+        texts.extend(part.texts)
+        labels.append(part.labels)
+        source.append(part.source + base)
+    return Corpus(
+        texts,
+        np.concatenate(labels) if labels else np.zeros(0, np.int32),
+        np.concatenate(source) if source else np.zeros(0, np.int32),
+        tuple(names),
+    )
+
+
+def load_mixed_corpus(
+    entries: Sequence[tuple[str | None, str]],
+) -> Corpus:
+    """Load ``(dataset_name_or_None, csv_path)`` entries into one corpus.
+
+    ``None`` dataset names are schema-sniffed via :func:`detect_dataset`.
+    Imputation follows the reference (±inf -> NaN -> column mean,
+    client1.py:86-88) per source file, matching :func:`load_flow_csv`.
+    """
+    from .cicids import load_flow_csv
+
+    parts = []
+    for name, path in entries:
+        df = load_flow_csv(path)
+        spec = get_dataset(name) if name else detect_dataset(df)
+        parts.append(corpus_from_frame(df, spec))
+    return concat_corpora(parts)
+
+
+def parse_source_arg(arg: str) -> tuple[str | None, str]:
+    """CLI ``--source [dataset=]path`` parser."""
+    if "=" in arg:
+        name, path = arg.split("=", 1)
+        get_dataset(name)  # validate early
+        return name, path
+    return None, arg

@@ -114,10 +114,161 @@ def make_synthetic_flows(
     return df.iloc[perm].reset_index(drop=True)
 
 
-def write_synthetic_csv(path: str, **kwargs) -> pd.DataFrame:
-    df = make_synthetic_flows(**kwargs)
+#: CIC-DDoS2019 attack-class label vocabulary (subset of the real set).
+DDOS2019_ATTACKS: tuple[str, ...] = (
+    "DrDoS_DNS",
+    "DrDoS_LDAP",
+    "DrDoS_NTP",
+    "DrDoS_UDP",
+    "Syn",
+    "UDP-lag",
+)
+
+
+def make_synthetic_ddos2019(
+    n_rows: int = 2000,
+    attack_fraction: float = 0.5,
+    seed: int = 0,
+    **kwargs,
+) -> pd.DataFrame:
+    """CIC-DDoS2019-style frame: same CICFlowMeter schema as CICIDS2017
+    (shared template, data/datasets.py) but per-attack-class labels, so the
+    binary map is ``Label != 'BENIGN'``."""
+    df = make_synthetic_flows(
+        n_rows, ddos_fraction=attack_fraction, seed=seed, **kwargs
+    )
+    rng = np.random.default_rng(seed + 1)
+    attack = df["Label"].to_numpy() == "DDoS"
+    labels = df["Label"].to_numpy().astype(object)
+    labels[attack] = rng.choice(DDOS2019_ATTACKS, size=int(attack.sum()))
+    df["Label"] = labels
+    return df
+
+
+def make_synthetic_unsw(
+    n_rows: int = 2000,
+    attack_fraction: float = 0.5,
+    seed: int = 0,
+    inf_fraction: float = 0.01,
+    nan_fraction: float = 0.01,
+) -> pd.DataFrame:
+    """UNSW-NB15-style frame with separable normal/attack populations over
+    the 10 templated columns (data/datasets.py UNSW_TEMPLATE) plus the
+    official ``attack_cat``/``label`` tail columns."""
+    rng = np.random.default_rng(seed)
+    n_attack = int(n_rows * attack_fraction)
+    n_normal = n_rows - n_attack
+
+    def _mix(normal_sampler, attack_sampler):
+        return np.concatenate([normal_sampler(n_normal), attack_sampler(n_attack)])
+
+    cols: dict[str, np.ndarray] = {}
+    cols["dur"] = np.round(
+        _mix(
+            lambda n: rng.uniform(0.05, 30.0, size=n),
+            lambda n: rng.uniform(1e-4, 0.02, size=n),
+        ),
+        6,
+    )
+    cols["proto"] = _mix(
+        lambda n: rng.choice(["tcp", "udp", "arp"], size=n),
+        lambda n: rng.choice(["tcp", "udp"], size=n),
+    )
+    cols["service"] = _mix(
+        lambda n: rng.choice(["http", "dns", "smtp", "-"], size=n),
+        lambda n: rng.choice(["dns", "-"], size=n),
+    )
+    cols["spkts"] = _mix(
+        lambda n: rng.integers(2, 40, size=n),
+        lambda n: rng.integers(100, 4_000, size=n),
+    ).astype(np.int64)
+    cols["dpkts"] = _mix(
+        lambda n: rng.integers(2, 40, size=n),
+        lambda n: rng.integers(0, 3, size=n),
+    ).astype(np.int64)
+    cols["sbytes"] = _mix(
+        lambda n: rng.integers(100, 10_000, size=n),
+        lambda n: rng.integers(50_000, 1_000_000, size=n),
+    ).astype(np.int64)
+    cols["dbytes"] = _mix(
+        lambda n: rng.integers(100, 10_000, size=n),
+        lambda n: rng.integers(0, 500, size=n),
+    ).astype(np.int64)
+    cols["rate"] = np.round(
+        _mix(
+            lambda n: rng.uniform(0.5, 500.0, size=n),
+            lambda n: rng.uniform(5e4, 1e6, size=n),
+        ),
+        4,
+    )
+    cols["sload"] = np.round(
+        _mix(
+            lambda n: rng.uniform(1e2, 1e6, size=n),
+            lambda n: rng.uniform(1e8, 5e9, size=n),
+        ),
+        4,
+    )
+    cols["dload"] = np.round(
+        _mix(
+            lambda n: rng.uniform(1e2, 1e6, size=n),
+            lambda n: rng.uniform(0, 1e3, size=n),
+        ),
+        4,
+    )
+    # Schema-filler tail columns from the official feature list.
+    for name in ("sttl", "dttl", "sloss", "dloss"):
+        cols[name] = rng.integers(0, 255, size=n_rows).astype(np.int64)
+    for name in ("sinpkt", "dinpkt", "sjit", "djit"):
+        arr = np.round(rng.uniform(0, 1_000, size=n_rows), 4)
+        bad = rng.random(n_rows)
+        arr[bad < inf_fraction] = np.inf
+        arr[(bad >= inf_fraction) & (bad < inf_fraction + nan_fraction)] = np.nan
+        cols[name] = arr
+
+    cols["attack_cat"] = _mix(
+        lambda n: np.array(["Normal"] * n),
+        lambda n: rng.choice(["Generic", "Exploits", "DoS", "Fuzzers"], size=n),
+    )
+    cols["label"] = np.concatenate(
+        [np.zeros(n_normal, np.int64), np.ones(n_attack, np.int64)]
+    )
+
+    df = pd.DataFrame(cols)
+    perm = rng.permutation(n_rows)
+    return df.iloc[perm].reset_index(drop=True)
+
+
+_GENERATORS = {
+    "cicids2017": make_synthetic_flows,
+    "cicddos2019": make_synthetic_ddos2019,
+    "unswnb15": make_synthetic_unsw,
+}
+
+
+def make_synthetic(dataset: str, n_rows: int = 2000, **kwargs) -> pd.DataFrame:
+    """Schema-dispatched synthetic generator (datasets registry names)."""
+    try:
+        gen = _GENERATORS[dataset]
+    except KeyError:
+        raise ValueError(
+            f"no synthetic generator for dataset {dataset!r}; "
+            f"have {sorted(_GENERATORS)}"
+        ) from None
+    return gen(n_rows, **kwargs)
+
+
+def write_synthetic_csv(path: str, dataset: str = "cicids2017", **kwargs) -> pd.DataFrame:
+    df = make_synthetic(dataset, **kwargs)
     df.to_csv(path, index=False)
     return df
 
 
-__all__ = ["make_synthetic_flows", "write_synthetic_csv", "FLOW_TEXT_COLUMNS"]
+__all__ = [
+    "make_synthetic_flows",
+    "make_synthetic_ddos2019",
+    "make_synthetic_unsw",
+    "make_synthetic",
+    "write_synthetic_csv",
+    "FLOW_TEXT_COLUMNS",
+    "DDOS2019_ATTACKS",
+]

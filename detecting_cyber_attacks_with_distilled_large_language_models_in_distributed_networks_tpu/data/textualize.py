@@ -43,32 +43,47 @@ _TEMPLATE: tuple[tuple[str, str, str], ...] = (
     ("Flow packets per second is ", "Flow Packets/s", "."),
 )
 
+#: Public alias for dataset registrations (data/datasets.py).
+CICIDS_TEMPLATE = _TEMPLATE
 
-def flow_to_text(row: Mapping[str, object]) -> str:
-    """Render one flow record. Byte-identical to reference client1.py:68-81."""
+
+def render_row(row: Mapping[str, object], template: Sequence[tuple[str, str, str]]) -> str:
+    """Render one record through a ``(prefix, column, suffix)`` template."""
     parts = []
-    for prefix, col, suffix in _TEMPLATE:
+    for prefix, col, suffix in template:
         parts.append(f"{prefix}{row[col]}{suffix}")
     return "".join(parts)
 
 
-def texts_from_dataframe(df: pd.DataFrame) -> list[str]:
+def render_template(
+    df: pd.DataFrame, template: Sequence[tuple[str, str, str]]
+) -> list[str]:
     """Vectorized template rendering for a whole frame.
 
-    Equivalent to ``df.apply(flow_to_text, axis=1).tolist()`` (reference
-    client1.py:90) but builds the strings column-wise: one str() pass per
-    column rather than 10 dict lookups + f-string per row.
+    Equivalent to ``df.apply(render_row, axis=1).tolist()`` but builds the
+    strings column-wise: one str() pass per column rather than one dict
+    lookup + f-string per cell.
     """
     n = len(df)
     if n == 0:
         return []
     # One str() pass per column. .tolist() yields python ints/floats whose
     # str() is identical to formatting the numpy scalar in an f-string
-    # (e.g. '666666.6667', '54865', 'nan'), so parity with flow_to_text holds.
+    # (e.g. '666666.6667', '54865', 'nan'), so parity with render_row holds.
     col_strs: list[list[str]] = []
-    for prefix, col, suffix in _TEMPLATE:
+    for prefix, col, suffix in template:
         col_strs.append([f"{prefix}{v}{suffix}" for v in df[col].tolist()])
     return ["".join(row) for row in zip(*col_strs)]
+
+
+def flow_to_text(row: Mapping[str, object]) -> str:
+    """Render one flow record. Byte-identical to reference client1.py:68-81."""
+    return render_row(row, _TEMPLATE)
+
+
+def texts_from_dataframe(df: pd.DataFrame) -> list[str]:
+    """CICIDS2017 template over a whole frame (reference client1.py:90)."""
+    return render_template(df, _TEMPLATE)
 
 
 def labels_from_dataframe(

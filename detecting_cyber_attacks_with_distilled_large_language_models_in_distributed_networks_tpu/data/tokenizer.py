@@ -40,6 +40,15 @@ TEMPLATE_WORDS: tuple[str, ...] = (
     "bytes", "maximum", "packet", "minimum", "per", "second", "nan", "inf",
 )
 
+#: UNSW-NB15 template words (datasets.py UNSW_TEMPLATE) plus the categorical
+#: values its proto/service columns commonly take. Appended AFTER the
+#: char/punct block in build_domain_vocab so the ids of every pre-existing
+#: default-vocab token stay stable (old configs/checkpoints keep working).
+EXTRA_TEMPLATE_WORDS: tuple[str, ...] = (
+    "protocol", "service", "seconds", "source", "to", "rate", "load", "bits",
+    "tcp", "udp", "arp", "icmp", "http", "dns", "smtp", "ftp", "ssh", "normal",
+)
+
 
 def _is_punctuation(ch: str) -> bool:
     cp = ord(ch)
@@ -114,6 +123,10 @@ def build_domain_vocab(
         _add("##" + c)
     for c in string.punctuation:
         _add(c)
+    # New whole-word entries go after the stable id range (see
+    # EXTRA_TEMPLATE_WORDS): ids 0..129 are frozen for back-compat.
+    for w in EXTRA_TEMPLATE_WORDS:
+        _add(w)
     if corpus is not None:
         counts: Counter[str] = Counter()
         for text in corpus:

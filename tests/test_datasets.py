@@ -1,0 +1,219 @@
+"""Dataset registry: CIC-DDoS2019 / UNSW-NB15 schemas + mixed corpus
+(BASELINE.json config 5 — the reference supports only CICIDS2017,
+client1.py:84-93)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+    DataConfig,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+    Corpus,
+    concat_corpora,
+    corpus_from_frame,
+    default_tokenizer,
+    detect_dataset,
+    get_dataset,
+    load_mixed_corpus,
+    make_all_client_splits,
+    make_all_client_splits_from_corpus,
+    make_synthetic,
+    make_synthetic_ddos2019,
+    make_synthetic_unsw,
+    parse_source_arg,
+    tokenize_client,
+    write_synthetic_csv,
+)
+
+
+def test_registry_names():
+    for name in ("cicids2017", "cicddos2019", "unswnb15"):
+        assert get_dataset(name).name == name
+    with pytest.raises(ValueError, match="unknown dataset"):
+        get_dataset("kdd99")
+
+
+def test_unsw_template_rendering():
+    spec = get_dataset("unswnb15")
+    df = pd.DataFrame(
+        {
+            "dur": [0.5], "proto": ["tcp"], "service": ["http"],
+            "spkts": [10], "dpkts": [8], "sbytes": [1200], "dbytes": [900],
+            "rate": [36.0], "sload": [19200.0], "dload": [14400.0],
+            "label": [0],
+        }
+    )
+    (text,) = spec.render_texts(df)
+    assert text == (
+        "Protocol is tcp. Service is http. Flow duration is 0.5 seconds. "
+        "Source to destination packets are 10. "
+        "Destination to source packets are 8. "
+        "Source to destination bytes are 1200 bytes. "
+        "Destination to source bytes are 900 bytes. "
+        "Packet rate is 36.0 per second. "
+        "Source load is 19200.0 bits per second. "
+        "Destination load is 14400.0 bits per second."
+    )
+    assert spec.binary_labels(df).tolist() == [0]
+
+
+def test_label_semantics_per_kind():
+    ddos2019 = get_dataset("cicddos2019")
+    df = pd.DataFrame({"Label": ["BENIGN", "DrDoS_DNS", "Syn"]})
+    assert ddos2019.binary_labels(df).tolist() == [0, 1, 1]
+
+    cicids = get_dataset("cicids2017")
+    df = pd.DataFrame({"Label": ["BENIGN", "DDoS", "PortScan"]})
+    # Reference semantics: only the exact positive value maps to 1
+    # (client1.py:91).
+    assert cicids.binary_labels(df).tolist() == [0, 1, 0]
+
+    unsw = get_dataset("unswnb15")
+    df = pd.DataFrame({"label": [0, 1, 1]})
+    assert unsw.binary_labels(df).tolist() == [0, 1, 1]
+
+
+def test_missing_columns_raise():
+    spec = get_dataset("unswnb15")
+    with pytest.raises(KeyError, match="missing template columns"):
+        spec.render_texts(pd.DataFrame({"dur": [1.0]}))
+    with pytest.raises(KeyError, match="no label column"):
+        spec.binary_labels(pd.DataFrame({"dur": [1.0]}))
+
+
+def test_detect_dataset():
+    assert detect_dataset(make_synthetic("cicids2017", 50, seed=0)).name == "cicids2017"
+    assert (
+        detect_dataset(make_synthetic_ddos2019(50, seed=0)).name == "cicddos2019"
+    )
+    assert detect_dataset(make_synthetic_unsw(50, seed=0)).name == "unswnb15"
+    with pytest.raises(ValueError, match="cannot detect"):
+        detect_dataset(pd.DataFrame({"x": [1]}))
+
+
+def test_detect_dataset_real_cicids2017_label_vocabulary():
+    """Real CICIDS2017 exports carry many non-DDoS attack labels; they must
+    stay under CICIDS2017 semantics (only 'DDoS' -> 1, reference
+    client1.py:91), not get misread as CIC-DDoS2019."""
+    df = pd.DataFrame(
+        {"Label": ["BENIGN", "DDoS", "PortScan", "Bot", "DoS Hulk",
+                   "FTP-Patator", "Heartbleed"]}
+    )
+    spec = detect_dataset(df)
+    assert spec.name == "cicids2017"
+    assert spec.binary_labels(df).tolist() == [0, 1, 0, 0, 0, 0, 0]
+    # DrDoS-family labels flip the detection.
+    assert detect_dataset(pd.DataFrame({"Label": ["BENIGN", "Syn"]})).name == (
+        "cicddos2019"
+    )
+
+
+def test_default_vocab_ids_are_stable():
+    """New UNSW words append after the original id range: the first 130 ids
+    of the default vocab (pre-UNSW configs/checkpoints) must be unchanged."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.tokenizer import (
+        EXTRA_TEMPLATE_WORDS,
+        SPECIAL_TOKENS,
+        TEMPLATE_WORDS,
+        build_domain_vocab,
+    )
+    import string
+
+    vocab = build_domain_vocab()
+    legacy = list(SPECIAL_TOKENS) + [w for w in TEMPLATE_WORDS]
+    for c in string.ascii_lowercase + string.digits:
+        legacy.extend([c, "##" + c])
+    legacy.extend(c for c in string.punctuation if c not in legacy)
+    # Dedup preserving order (mirrors build_domain_vocab's _add).
+    seen: list[str] = []
+    for tok in legacy:
+        if tok not in seen:
+            seen.append(tok)
+    assert vocab[: len(seen)] == seen
+    assert set(EXTRA_TEMPLATE_WORDS) <= set(vocab[len(seen):])
+
+
+def test_synthetic_generators_are_separable_and_labeled():
+    df = make_synthetic_ddos2019(400, attack_fraction=0.25, seed=3)
+    labels = get_dataset("cicddos2019").binary_labels(df)
+    assert labels.sum() == 100
+    assert set(df["Label"]) > {"BENIGN"}  # real attack-class names present
+
+    df = make_synthetic_unsw(400, attack_fraction=0.25, seed=3)
+    labels = get_dataset("unswnb15").binary_labels(df)
+    assert labels.sum() == 100
+    # Attack rows are statistically separable on the templated columns.
+    assert df.loc[labels == 1, "rate"].min() > df.loc[labels == 0, "rate"].max()
+
+
+def test_corpus_concat_rebases_source_ids():
+    a = corpus_from_frame(make_synthetic("cicids2017", 30, seed=0), get_dataset("cicids2017"))
+    b = corpus_from_frame(make_synthetic_unsw(20, seed=0), get_dataset("unswnb15"))
+    mixed = concat_corpora([a, b])
+    assert len(mixed) == 50
+    assert mixed.source_names == ("cicids2017", "unswnb15")
+    assert mixed.source[:30].tolist() == [0] * 30
+    assert mixed.source[30:].tolist() == [1] * 20
+
+
+def test_corpus_length_mismatch_raises():
+    with pytest.raises(ValueError, match="length mismatch"):
+        Corpus(["a"], np.zeros(2, np.int32), np.zeros(1, np.int32))
+
+
+def test_parse_source_arg():
+    assert parse_source_arg("unswnb15=/tmp/u.csv") == ("unswnb15", "/tmp/u.csv")
+    assert parse_source_arg("/tmp/plain.csv") == (None, "/tmp/plain.csv")
+    with pytest.raises(ValueError, match="unknown dataset"):
+        parse_source_arg("bogus=/tmp/x.csv")
+
+
+def test_mixed_corpus_end_to_end(tmp_path):
+    """Two schemas on disk -> auto-detected mixed corpus -> disjoint
+    2-client splits -> tokenized static-shape arrays."""
+    p1 = tmp_path / "ddos2019.csv"
+    p2 = tmp_path / "unsw.csv"
+    write_synthetic_csv(str(p1), dataset="cicddos2019", n_rows=300, seed=1)
+    write_synthetic_csv(str(p2), dataset="unswnb15", n_rows=300, seed=2)
+
+    corpus = load_mixed_corpus([(None, str(p1)), (None, str(p2))])
+    assert corpus.source_names == ("cicddos2019", "unswnb15")
+    assert len(corpus) == 600
+
+    cfg = DataConfig(partition="disjoint", data_fraction=0.5, max_len=64)
+    splits = make_all_client_splits_from_corpus(corpus, 2, cfg)
+    assert len(splits) == 2
+    # Disjoint: both clients together cover the corpus exactly once.
+    n_total = sum(len(s.train) + len(s.val) + len(s.test) for s in splits)
+    assert n_total == 600
+
+    tok = default_tokenizer()
+    client = tokenize_client(splits[0], tok, max_len=64)
+    assert client.train.input_ids.shape[1] == 64
+    # Both schemas' text tokenizes without [UNK].
+    assert not (client.train.input_ids == tok.unk_id).any()
+
+
+def test_corpus_sample_partition_matches_fraction():
+    corpus = corpus_from_frame(
+        make_synthetic_unsw(200, seed=5), get_dataset("unswnb15")
+    )
+    cfg = DataConfig(partition="sample", data_fraction=0.1, max_len=32)
+    splits = make_all_client_splits_from_corpus(corpus, 3, cfg)
+    for s in splits:
+        assert len(s.train) + len(s.val) + len(s.test) == 20
+
+
+def test_frame_path_honors_dataset_config():
+    """make_all_client_splits with dataset='unswnb15' partitions on the 0/1
+    label column and renders the UNSW template."""
+    df = make_synthetic_unsw(200, seed=4)
+    cfg = DataConfig(dataset="unswnb15", partition="disjoint", data_fraction=0.5, max_len=32)
+    splits = make_all_client_splits(df, 2, cfg)
+    assert splits[0].train.texts[0].startswith("Protocol is ")
+    all_labels = np.concatenate(
+        [np.concatenate([s.train.labels, s.val.labels, s.test.labels]) for s in splits]
+    )
+    assert set(all_labels.tolist()) == {0, 1}
