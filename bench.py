@@ -1,10 +1,12 @@
 """Headline benchmark: local-training throughput on the flagship model.
 
 Measures the jitted train step on the full DistilBERT-base DDoS classifier
-(66 M params) at the reference's own configuration (batch 16, seq 128,
-Adam 2e-5 — reference client1.py:27,370,379-380) and reports samples/sec
-against the reference's recorded CPU throughput of ~2.5 batch/s = 40
-samples/s (client1_terminal_output.txt:7,9,11; BASELINE.md).
+(66 M params; seq 128, Adam 2e-5 — reference client1.py:27,379-380) and
+reports samples/sec against the reference's recorded CPU throughput of
+~2.5 batch/s = 40 samples/s (client1_terminal_output.txt:7,9,11;
+BASELINE.md), plus MFU against the local chip's peak (north star: ≥40%,
+BASELINE.json). Batch defaults to the TPU sweet spot (BENCH_BATCH=16 for
+the reference's exact configuration).
 
 Prints exactly one JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
@@ -36,12 +38,20 @@ REFERENCE_SAMPLES_PER_SEC = 40.0  # ~2.5 batch/s * bs 16 (BASELINE.md)
 
 
 def main() -> None:
-    batch_size = int(os.environ.get("BENCH_BATCH", "16"))
+    # Default batch 128: the reference trains at bs=16 (client1.py:370) but
+    # per-client batch is a free TPU knob (SURVEY.md §7c) — 128 is this
+    # chip's measured MFU sweet spot; vs_baseline compares samples/sec,
+    # which is batch-size-fair. BENCH_BATCH=16 reproduces the reference
+    # configuration exactly.
+    batch_size = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "100"))
     warmup = int(os.environ.get("BENCH_WARMUP", "10"))
 
     model_cfg = ModelConfig()  # DistilBERT-base, bf16 compute
-    trainer = Trainer(model_cfg, TrainConfig())
+    # TrainConfig defaults are the production path (incl. prng_impl="rbg"
+    # dropout keys); BENCH_PRNG=threefry2x32 measures the costlier impl.
+    train_cfg = TrainConfig(prng_impl=os.environ.get("BENCH_PRNG", "rbg"))
+    trainer = Trainer(model_cfg, train_cfg)
     state = trainer.init_state(seed=0)
 
     rng = np.random.default_rng(0)
@@ -55,27 +65,43 @@ def main() -> None:
     }
     batch = {k: jax.device_put(v) for k, v in batch.items()}
 
+    # Sync via host readback of the loss. Measured on this axon-tunneled TPU
+    # backend, block_until_ready returned ~100x faster than the chip's peak
+    # FLOPs allow (i.e. before completion); a scalar pull waits for the full
+    # dependency chain on every backend, so it is the safe timing fence.
     for _ in range(warmup):
         state, loss = trainer.train_step(state, batch)
-    jax.block_until_ready(loss)
+    float(loss)
 
     t0 = time.perf_counter()
     for _ in range(steps):
         state, loss = trainer.train_step(state, batch)
-    jax.block_until_ready(loss)
+    float(loss)
     dt = time.perf_counter() - t0
 
     samples_per_sec = batch_size * steps / dt
-    print(
-        json.dumps(
-            {
-                "metric": "train_samples_per_sec_distilbert_bs%d" % batch_size,
-                "value": round(samples_per_sec, 2),
-                "unit": "samples/sec",
-                "vs_baseline": round(samples_per_sec / REFERENCE_SAMPLES_PER_SEC, 2),
-            }
-        )
+
+    # MFU accounting (utils/profiling.py): analytic step FLOPs over the
+    # chip's peak — the BASELINE.json north-star metric (≥40% on DistilBERT).
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.utils.profiling import (
+        device_peak_flops,
+        mfu,
+        train_step_flops,
     )
+
+    flops = train_step_flops(model_cfg, batch_size)
+    util = mfu(flops, dt / steps, peak_flops_per_device=device_peak_flops())
+    record = {
+        "metric": "train_samples_per_sec_distilbert_bs%d" % batch_size,
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(samples_per_sec / REFERENCE_SAMPLES_PER_SEC, 2),
+        "device": jax.devices()[0].device_kind,
+        "tflops_per_sec": round(flops * steps / dt / 1e12, 2),
+    }
+    if util is not None:
+        record["mfu"] = round(util, 4)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

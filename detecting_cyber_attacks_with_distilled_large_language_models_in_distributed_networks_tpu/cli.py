@@ -202,7 +202,11 @@ def cmd_local(args) -> int:
     client = _load_clients(args, cfg, tok, max(args.client_id + 1, 1))[args.client_id]
     trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
     state = trainer.init_state()
-    with phase(f"client {args.client_id} local training", tag="TRAIN"):
+    from .utils.profiling import trace
+
+    with phase(f"client {args.client_id} local training", tag="TRAIN"), trace(
+        getattr(args, "profile_dir", None)
+    ):
         state, losses = trainer.fit(
             state,
             client.train,
@@ -255,27 +259,30 @@ def cmd_federated(args) -> int:
         if cfg.fed.weighted
         else None
     )
+    from .utils.profiling import trace
+
     prepared = trainer.prepare_eval([c.test for c in clients])
     history = []
-    for r in range(start_round, cfg.fed.rounds):
-        with phase(f"round {r + 1}/{cfg.fed.rounds}", tag="FED"):
-            state, losses = trainer.fit_local(
-                state, stacked_train, epoch_offset=r * cfg.train.epochs_per_round
-            )
-            local = trainer.evaluate_clients(state.params, prepared=prepared)
-            state = trainer.aggregate(state, weights=weights)
-            aggregated = trainer.evaluate_clients(state.params, prepared=prepared)
-        history.append((r, local, aggregated))
-        for c in range(C):
-            log.info(
-                f"[FED] round {r + 1} client {c}: local acc "
-                f"{local[c]['Accuracy']:.4f} -> aggregated "
-                f"{aggregated[c]['Accuracy']:.4f}"
-            )
-        if ckpt is not None:
-            ckpt.save(r + 1, state, meta={"round": r + 1, "config": cfg.to_dict()})
-        if r + 1 < cfg.fed.rounds and cfg.fed.reset_optimizer_each_round:
-            state = trainer.reset_optimizer(state)
+    with trace(getattr(args, "profile_dir", None)):
+        for r in range(start_round, cfg.fed.rounds):
+            with phase(f"round {r + 1}/{cfg.fed.rounds}", tag="FED"):
+                state, losses = trainer.fit_local(
+                    state, stacked_train, epoch_offset=r * cfg.train.epochs_per_round
+                )
+                local = trainer.evaluate_clients(state.params, prepared=prepared)
+                state = trainer.aggregate(state, weights=weights)
+                aggregated = trainer.evaluate_clients(state.params, prepared=prepared)
+            history.append((r, local, aggregated))
+            for c in range(C):
+                log.info(
+                    f"[FED] round {r + 1} client {c}: local acc "
+                    f"{local[c]['Accuracy']:.4f} -> aggregated "
+                    f"{aggregated[c]['Accuracy']:.4f}"
+                )
+            if ckpt is not None:
+                ckpt.save(r + 1, state, meta={"round": r + 1, "config": cfg.to_dict()})
+            if r + 1 < cfg.fed.rounds and cfg.fed.reset_optimizer_each_round:
+                state = trainer.reset_optimizer(state)
     if ckpt is not None:
         ckpt.wait()
         ckpt.close()
@@ -388,6 +395,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--max-len", type=int)
     p.add_argument("--data-fraction", type=float)
     p.add_argument("--seed", type=int)
+    p.add_argument(
+        "--profile-dir",
+        help="write a jax.profiler trace of the training phase here "
+        "(view with xprof/tensorboard)",
+    )
 
 
 def build_parser() -> argparse.ArgumentParser:

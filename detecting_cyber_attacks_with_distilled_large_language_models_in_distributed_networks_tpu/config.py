@@ -151,6 +151,14 @@ class TrainConfig:
     eps: float = 1e-8
     seed: int = 0
     log_every: int = 100
+    # Dropout-key PRNG implementation. "rbg" (counter-based, the standard
+    # TPU choice for dropout masks) is ~10 points of MFU cheaper than
+    # "threefry2x32" on the flagship model; both are valid JAX key impls.
+    prng_impl: str = "rbg"
+
+    def __post_init__(self) -> None:
+        if self.prng_impl not in ("rbg", "threefry2x32", "unsafe_rbg"):
+            raise ValueError(f"unknown prng_impl {self.prng_impl!r}")
 
 
 @dataclass(frozen=True)

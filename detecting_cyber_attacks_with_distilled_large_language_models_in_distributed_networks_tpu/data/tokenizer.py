@@ -42,8 +42,10 @@ TEMPLATE_WORDS: tuple[str, ...] = (
 
 #: UNSW-NB15 template words (datasets.py UNSW_TEMPLATE) plus the categorical
 #: values its proto/service columns commonly take. Appended AFTER the
-#: char/punct block in build_domain_vocab so the ids of every pre-existing
-#: default-vocab token stay stable (old configs/checkpoints keep working).
+#: char/punct block in build_domain_vocab so every pre-existing token keeps
+#: its id (already-tokenized data stays valid). The vocab still GROWS, so a
+#: model checkpoint pinned to the old vocab_size has a smaller embedding
+#: table — maybe_warm_start degrades to a fresh start on that mismatch.
 EXTRA_TEMPLATE_WORDS: tuple[str, ...] = (
     "protocol", "service", "seconds", "source", "to", "rate", "load", "bits",
     "tcp", "udp", "arp", "icmp", "http", "dns", "smtp", "ftp", "ssh", "normal",

@@ -46,7 +46,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale: float, block_k: 
         acc, m, l = carry
         k_blk = k_ref[0, 0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
         v_blk = v_ref[0, 0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        b_blk = bias_ref[0, pl.ds(i * block_k, block_k)].astype(jnp.float32)
+        b_blk = bias_ref[0, 0, pl.ds(i * block_k, block_k)].astype(jnp.float32)
         s = (
             jax.lax.dot_general(
                 q, k_blk, (((1,), (1,)), ((), ())),
@@ -73,13 +73,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale: float, block_k: 
 
 
 def _key_bias(bias: jnp.ndarray | None, batch: int, lk: int) -> jnp.ndarray:
+    """Returns [B, 1, Lk]: the middle singleton keeps the Pallas block's
+    second-to-last dim equal to the array dim (the TPU lowering requires
+    last-two block dims divisible by (8, 128) or equal to the array's)."""
     if bias is None:
-        return jnp.zeros((batch, lk), jnp.float32)
+        return jnp.zeros((batch, 1, lk), jnp.float32)
     if bias.ndim != 4 or bias.shape[1] != 1 or bias.shape[2] != 1:
         raise ValueError(
             f"flash_attention supports key-position bias [B,1,1,Lk] only, got {bias.shape}"
         )
-    return bias[:, 0, 0, :].astype(jnp.float32)
+    return bias[:, 0, :, :].astype(jnp.float32)
 
 
 def _flash_forward(
@@ -111,7 +114,7 @@ def _flash_forward(
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, lk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, lk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, lk), lambda bi, hi, qi: (bi, 0)),
+            pl.BlockSpec((1, 1, lk), lambda bi, hi, qi: (bi, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),

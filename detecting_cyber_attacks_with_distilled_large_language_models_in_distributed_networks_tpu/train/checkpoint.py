@@ -145,6 +145,12 @@ def maybe_warm_start(directory: str, template: Any) -> tuple[Any | None, int | N
 
     Returns ``(state, step)`` — callers decide whether to keep the optimizer
     state or reset it (FedConfig.reset_optimizer_each_round).
+
+    An incompatible checkpoint (different model/vocab shapes or tree
+    structure — e.g. the config changed between runs) degrades to a fresh
+    start with a warning instead of aborting: warm start is an optimization,
+    and the reference likewise proceeds from scratch when its ``.pth`` is
+    absent.
     """
     if not os.path.isdir(directory):
         return None, None
@@ -152,4 +158,13 @@ def maybe_warm_start(directory: str, template: Any) -> tuple[Any | None, int | N
         step = ckpt.latest_step()
         if step is None:
             return None, None
-        return ckpt.restore(template, step=step), step
+        try:
+            return ckpt.restore(template, step=step), step
+        except Exception as e:  # orbax raises backend-specific error types
+            from ..utils.logging import get_logger
+
+            get_logger().warning(
+                f"checkpoint at {directory} (step {step}) is incompatible with "
+                f"the current config ({type(e).__name__}: {e}); starting fresh"
+            )
+            return None, None

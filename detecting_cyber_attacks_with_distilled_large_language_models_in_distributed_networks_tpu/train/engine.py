@@ -141,7 +141,7 @@ class Trainer:
 
     def init_state(self, seed: int | None = None, params: Any | None = None) -> TrainState:
         seed = self.train_cfg.seed if seed is None else seed
-        rng = jax.random.key(seed)
+        rng = jax.random.key(seed, impl=self.train_cfg.prng_impl)
         if params is None:
             params = init_params(self.model, self.model_cfg, rng)
         return TrainState(

@@ -203,7 +203,7 @@ class FederatedTrainer:
         condition (every client loads the same pretrained DistilBERT,
         client1.py:56)."""
         seed = self.cfg.train.seed if seed is None else seed
-        rng = jax.random.key(seed)
+        rng = jax.random.key(seed, impl=self.cfg.train.prng_impl)
         if params is None:
             params = init_params(self.model, self.cfg.model, rng)
         C = self.C

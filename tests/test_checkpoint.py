@@ -139,6 +139,34 @@ def test_warm_start_absent_and_present(tmp_path, rng):
     _assert_tree_equal(state.params, trained.params)
 
 
+def test_warm_start_incompatible_checkpoint_degrades_to_fresh(tmp_path, rng):
+    """A checkpoint saved under a different model shape (e.g. the default
+    vocab grew between runs) must warm-start as None, not abort — warm start
+    is an optimization (reference client1.py:375-377 proceeds from scratch
+    when no compatible .pth exists)."""
+    old = Trainer(ModelConfig.tiny(vocab_size=100), TrainConfig(seed=3))
+    state = old.init_state(seed=0)
+    with Checkpointer(str(tmp_path / "old")) as ckpt:
+        ckpt.save(4, state)
+        ckpt.wait()
+
+    new = Trainer(ModelConfig.tiny(vocab_size=140), TrainConfig(seed=3))
+    template = new.init_state(seed=0)
+    restored, step = maybe_warm_start(str(tmp_path / "old"), template)
+    assert restored is None and step is None
+
+
+def test_prng_impl_is_plumbed():
+    """TrainConfig.prng_impl selects the dropout-key generator (rbg default
+    — the cheap TPU impl bench.py measures — threefry on request)."""
+    for impl in ("rbg", "threefry2x32"):
+        tr = Trainer(ModelConfig.tiny(), TrainConfig(seed=0, prng_impl=impl))
+        st = tr.init_state(seed=0)
+        assert str(jax.random.key_impl(st.rng)) == impl
+    with pytest.raises(ValueError, match="unknown prng_impl"):
+        TrainConfig(prng_impl="bogus")
+
+
 def test_restore_empty_dir_raises(tmp_path):
     trainer = _tiny_trainer()
     with Checkpointer(str(tmp_path / "empty")) as ckpt:

@@ -1,0 +1,113 @@
+"""Profiling/MFU accounting (SURVEY.md §5: the reference has no profiling
+beyond timestamped prints; the build adds FLOPs/MFU accounting and
+jax.profiler traces)."""
+
+import os
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+    ModelConfig,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.utils.profiling import (
+    device_peak_flops,
+    forward_flops,
+    mfu,
+    trace,
+    train_step_flops,
+)
+
+
+def test_train_step_is_3x_forward():
+    cfg = ModelConfig.tiny()
+    assert train_step_flops(cfg, 8) == pytest.approx(3 * forward_flops(cfg, 8))
+
+
+def test_forward_flops_scaling():
+    cfg = ModelConfig.tiny()
+    # Linear in batch.
+    assert forward_flops(cfg, 16) == pytest.approx(2 * forward_flops(cfg, 8))
+    # Doubling layers doubles the encoder term.
+    deep = cfg.replace(n_layers=4)
+    head = 2 * cfg.dim * cfg.n_classes
+    assert forward_flops(deep, 1) - head == pytest.approx(
+        2 * (forward_flops(cfg, 1) - head)
+    )
+
+
+def test_forward_flops_matches_xla_cost_analysis():
+    """The analytic count must track XLA's own cost model on the real
+    forward. Analytic excludes elementwise work (softmax/LN/GELU), so XLA's
+    number is an upper bound that should sit within ~2x on a
+    matmul-dominated config."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.models.distilbert import (
+        DDoSClassifier,
+        init_params,
+    )
+
+    cfg = ModelConfig.tiny(dim=64, n_heads=4, hidden_dim=256, max_len=64,
+                           max_position_embeddings=64)
+    model = DDoSClassifier(cfg)
+    params = init_params(model, cfg, jax.random.key(0))
+    B = 4
+    ids = jnp.zeros((B, cfg.max_len), jnp.int32)
+    mask = jnp.ones((B, cfg.max_len), jnp.int32)
+
+    def fwd(p):
+        return model.apply({"params": p}, ids, mask, True)
+
+    compiled = jax.jit(fwd).lower(params).compile()
+    analysis = compiled.cost_analysis()
+    analysis = analysis[0] if isinstance(analysis, list) else analysis
+    xla_flops = float(analysis["flops"])
+    ours = forward_flops(cfg, B)
+    assert ours <= xla_flops * 1.05  # we must not overcount real matmul work
+    assert xla_flops <= ours * 2.0, (xla_flops, ours)
+
+
+def test_device_peak_flops_table():
+    for kind, tflops in [
+        ("TPU v2", 45.0),
+        ("TPU v3", 123.0),
+        ("TPU v4", 275.0),
+        ("TPU v5e", 197.0),
+        ("TPU v5 lite", 197.0),
+        ("TPU v5p", 459.0),
+        ("TPU v6e", 918.0),
+        ("TPU v6 lite", 918.0),
+    ]:
+        dev = SimpleNamespace(device_kind=kind)
+        assert device_peak_flops(dev) == pytest.approx(tflops * 1e12), kind
+    assert device_peak_flops(SimpleNamespace(device_kind="cpu")) is None
+    assert device_peak_flops(SimpleNamespace(device_kind="")) is None
+
+
+def test_mfu_math():
+    # 1e12 FLOPs/step at 0.01 s/step on a 275 TFLOP chip = ~36.4% MFU.
+    assert mfu(1e12, 0.01, peak_flops_per_device=275e12) == pytest.approx(
+        1e12 / (0.01 * 275e12)
+    )
+    # Two devices halve utilization for the same step time.
+    assert mfu(1e12, 0.01, n_devices=2, peak_flops_per_device=275e12) == (
+        pytest.approx(1e12 / (0.01 * 275e12) / 2)
+    )
+    assert mfu(1e12, 0.01, peak_flops_per_device=None) is None or isinstance(
+        mfu(1e12, 0.01, peak_flops_per_device=None), float
+    )
+
+
+def test_trace_noop_and_real(tmp_path):
+    with trace(None):
+        pass  # no-op path needs no profiler at all
+
+    out = tmp_path / "prof"
+    with trace(str(out)):
+        jnp.dot(jnp.ones((8, 8)), jnp.ones((8, 8))).block_until_ready()
+    files = [
+        os.path.join(r, f) for r, _, fs in os.walk(out) for f in fs
+    ]
+    assert files, "jax.profiler.trace wrote nothing"
