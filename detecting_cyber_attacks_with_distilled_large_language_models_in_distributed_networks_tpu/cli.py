@@ -362,6 +362,91 @@ def cmd_client(args) -> int:
     return 0
 
 
+def cmd_distill(args) -> int:
+    """Train a (2x-deeper by default) teacher, distill it into the student
+    encoder, evaluate both — the recipe that produced the reference's
+    pretrained DistilBERT (client1.py:56), now a first-class capability."""
+    import dataclasses as dc
+
+    from . import reporting
+    from .data import default_tokenizer
+    from .train.distill import DistillTrainer
+    from .train.engine import Trainer
+
+    tok = default_tokenizer()
+    cfg = resolve_config(args, vocab_size=len(tok.vocab))
+    # Flags override the config only where given; invalid values (e.g.
+    # --temperature 0) flow into DistillConfig validation rather than being
+    # silently replaced, and --no-teacher-init can only turn the init OFF.
+    d = cfg.distill
+    cfg = dc.replace(
+        cfg,
+        distill=dc.replace(
+            d,
+            temperature=d.temperature if args.temperature is None else args.temperature,
+            alpha=d.alpha if args.alpha is None else args.alpha,
+            init_from_teacher=d.init_from_teacher and not args.no_teacher_init,
+        ),
+    )
+    client = _load_clients(args, cfg, tok, 1)[0]
+
+    from .utils.profiling import trace
+
+    teacher_cfg = cfg.model.replace(
+        n_layers=args.teacher_layers or 2 * cfg.model.n_layers
+    )
+    t_trainer = Trainer(teacher_cfg, cfg.train, pad_id=tok.pad_id)
+    t_state = t_trainer.init_state()
+    with trace(getattr(args, "profile_dir", None)):
+        with phase(f"teacher training ({teacher_cfg.n_layers} layers)", tag="DISTILL"):
+            t_state, _ = t_trainer.fit(
+                t_state, client.train, batch_size=cfg.data.batch_size, tag="[TEACHER] "
+            )
+        teacher_metrics = t_trainer.evaluate(t_state.params, client.test)
+
+        d_trainer = DistillTrainer(
+            cfg.model, teacher_cfg, cfg.train, cfg.distill, pad_id=tok.pad_id
+        )
+        s_state = d_trainer.init_student_state(t_state.params)
+        with phase(f"distilling into {cfg.model.n_layers}-layer student", tag="DISTILL"):
+            s_state, _ = d_trainer.distill(
+                s_state,
+                t_state.params,
+                client.train,
+                batch_size=cfg.data.batch_size,
+                epochs=args.distill_epochs,
+                tag="[STUDENT] ",
+            )
+        student_metrics = d_trainer.evaluate(s_state.params, client.test)
+
+    log.info(
+        f"[DISTILL] teacher acc {teacher_metrics['Accuracy']:.4f} -> "
+        f"student acc {student_metrics['Accuracy']:.4f} "
+        f"({teacher_cfg.n_layers} -> {cfg.model.n_layers} layers)"
+    )
+    os.makedirs(cfg.output_dir, exist_ok=True)
+    reporting.save_metrics(
+        teacher_metrics, os.path.join(cfg.output_dir, "teacher_metrics.csv")
+    )
+    reporting.save_metrics(
+        student_metrics, os.path.join(cfg.output_dir, "student_metrics.csv")
+    )
+    reporting.plot_metrics_comparison(
+        teacher_metrics,
+        student_metrics,
+        "Teacher vs Distilled Student (test)",
+        os.path.join(cfg.output_dir, "distillation_comparison.png"),
+        labels=("Teacher", "Student"),
+    )
+    if cfg.checkpoint_dir:
+        from .train.checkpoint import Checkpointer
+
+        with Checkpointer(cfg.checkpoint_dir) as ckpt:
+            ckpt.save(int(s_state.step), s_state, meta={"distilled": True})
+            ckpt.wait()
+    return 0
+
+
 def cmd_export_config(args) -> int:
     from .data import default_tokenizer
 
@@ -446,6 +531,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeout", type=float, default=300.0)
     p.add_argument("--compression", default="none", choices=["none", "bf16"])
     p.set_defaults(fn=cmd_client)
+
+    p = sub.add_parser("distill", help="teacher -> student knowledge distillation")
+    _add_common(p)
+    p.add_argument("--teacher-layers", type=int, help="default: 2x student layers")
+    p.add_argument("--distill-epochs", type=int, help="default: train epochs")
+    p.add_argument("--temperature", type=float, help="KD softmax temperature")
+    p.add_argument("--alpha", type=float, help="KD loss weight in [0,1]")
+    p.add_argument(
+        "--no-teacher-init",
+        action="store_true",
+        help="skip the every-other-layer student init",
+    )
+    p.add_argument("--checkpoint-dir")
+    p.set_defaults(fn=cmd_distill)
 
     p = sub.add_parser("export-config", help="print the resolved config as JSON")
     _add_common(p)

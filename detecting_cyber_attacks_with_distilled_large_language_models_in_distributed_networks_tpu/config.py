@@ -186,6 +186,33 @@ class FedConfig:
 
 
 @dataclass(frozen=True)
+class DistillConfig:
+    """Knowledge distillation (teacher -> student).
+
+    The reference consumes a pre-distilled encoder (HF DistilBERT,
+    client1.py:56) but has no distillation capability of its own. Here the
+    DistilBERT recipe is first-class: soft-target KL at temperature T plus
+    hard-label CE, with the student optionally initialized from every other
+    teacher layer (the published DistilBERT init).
+    """
+
+    temperature: float = 2.0
+    # Loss = alpha * T^2 * KL(teacher || student) + (1 - alpha) * CE(labels).
+    alpha: float = 0.5
+    # Initialize the student from evenly-strided teacher layers (DistilBERT
+    # init: 12 -> 6 layers takes every other one). The stride is derived as
+    # teacher_layers // student_layers by DistillTrainer.init_student_state,
+    # not configured here; widths must match (depth-only distillation).
+    init_from_teacher: bool = True
+
+    def __post_init__(self) -> None:
+        if not 0.0 <= self.alpha <= 1.0:
+            raise ValueError(f"alpha={self.alpha} must be in [0, 1]")
+        if self.temperature <= 0.0:
+            raise ValueError(f"temperature={self.temperature} must be > 0")
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout.
 
@@ -206,6 +233,7 @@ class ExperimentConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
     fed: FedConfig = field(default_factory=FedConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    distill: DistillConfig = field(default_factory=DistillConfig)
     output_dir: str = "outputs"
     checkpoint_dir: str | None = None
 
@@ -246,6 +274,7 @@ class ExperimentConfig:
             "train": TrainConfig,
             "fed": FedConfig,
             "mesh": MeshConfig,
+            "distill": DistillConfig,
         }
         scalars = ("output_dir", "checkpoint_dir")
         unknown_top = set(d) - set(sections) - set(scalars)

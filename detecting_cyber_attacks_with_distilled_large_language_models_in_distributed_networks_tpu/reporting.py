@@ -205,10 +205,12 @@ def plot_metrics_comparison(
     path: str,
     *,
     dpi: int = DEFAULT_DPI,
+    labels: tuple[str, str] = ("Local", "Aggregated"),
 ) -> str | None:
-    """Grouped local-vs-aggregated bar chart over the five metrics
-    (reference client1.py:195-218). Accuracy is rescaled from percent to
-    [0, 1] so all bars share an axis, as the reference does
+    """Grouped two-model bar chart over the five metrics (reference
+    client1.py:195-218; default labels are its local-vs-aggregated pair, the
+    distill CLI passes Teacher/Student). Accuracy is rescaled from percent
+    to [0, 1] so all bars share an axis, as the reference does
     (client1.py:199-200)."""
     if not HAVE_MATPLOTLIB:
         return None
@@ -222,8 +224,8 @@ def plot_metrics_comparison(
     x = np.arange(len(METRIC_COLUMNS))
     width = 0.35
     fig, ax = _figure((9, 5))
-    ax.bar(x - width / 2, _values(local), width, label="Local")
-    ax.bar(x + width / 2, _values(aggregated), width, label="Aggregated")
+    ax.bar(x - width / 2, _values(local), width, label=labels[0])
+    ax.bar(x + width / 2, _values(aggregated), width, label=labels[1])
     ax.set_xticks(x, METRIC_COLUMNS)
     ax.set_ylabel("Value (Accuracy scaled to [0,1])")
     ax.set_title(title)

@@ -5,6 +5,11 @@ from .engine import (  # noqa: F401
     make_optimizer,
     make_train_step,
 )
+from .distill import (  # noqa: F401
+    DistillTrainer,
+    distillation_loss,
+    init_student_from_teacher,
+)
 from .federated import (  # noqa: F401
     FederatedTrainer,
     FedState,

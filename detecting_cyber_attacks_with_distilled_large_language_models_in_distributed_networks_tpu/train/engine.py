@@ -175,6 +175,29 @@ class Trainer:
         order across repeated fit() calls (e.g. pass ``round * E`` from a
         multi-round driver); without it every round would replay the same
         batch permutations."""
+        return self._fit_loop(
+            state,
+            split,
+            self.train_step,
+            batch_size=batch_size,
+            epochs=epochs,
+            epoch_offset=epoch_offset,
+            tag=tag,
+        )
+
+    def _fit_loop(
+        self,
+        state: TrainState,
+        split: TokenizedSplit,
+        step_fn: Callable[[TrainState, dict], tuple[TrainState, jnp.ndarray]],
+        *,
+        batch_size: int,
+        epochs: int | None,
+        epoch_offset: int,
+        tag: str,
+        loss_label: str = "Average Loss",
+    ) -> tuple[TrainState, list[float]]:
+        """Shared epoch loop (plain fit and the KD step both ride it)."""
         epochs = self.train_cfg.epochs_per_round if epochs is None else epochs
         epoch_losses: list[float] = []
         for epoch in range(epoch_offset, epoch_offset + epochs):
@@ -182,13 +205,13 @@ class Trainer:
             # per step would block async dispatch and stall the TPU.
             losses: list[jnp.ndarray] = []
             for batch in self.epoch_batches(split, epoch, batch_size):
-                state, loss = self.train_step(state, batch)
+                state, loss = step_fn(state, batch)
                 losses.append(loss)
             avg = float(jnp.stack(losses).mean()) if losses else 0.0
             epoch_losses.append(avg)
             log.info(
                 f"{tag}Epoch [{epoch - epoch_offset + 1}/{epochs}], "
-                f"Average Loss: {avg:.4f}"
+                f"{loss_label}: {avg:.4f}"
             )
         return state, epoch_losses
 
