@@ -366,8 +366,6 @@ def cmd_distill(args) -> int:
     """Train a (2x-deeper by default) teacher, distill it into the student
     encoder, evaluate both — the recipe that produced the reference's
     pretrained DistilBERT (client1.py:56), now a first-class capability."""
-    import dataclasses as dc
-
     from . import reporting
     from .data import default_tokenizer
     from .train.distill import DistillTrainer
@@ -379,9 +377,9 @@ def cmd_distill(args) -> int:
     # --temperature 0) flow into DistillConfig validation rather than being
     # silently replaced, and --no-teacher-init can only turn the init OFF.
     d = cfg.distill
-    cfg = dc.replace(
+    cfg = dataclasses.replace(
         cfg,
-        distill=dc.replace(
+        distill=dataclasses.replace(
             d,
             temperature=d.temperature if args.temperature is None else args.temperature,
             alpha=d.alpha if args.alpha is None else args.alpha,
@@ -392,9 +390,17 @@ def cmd_distill(args) -> int:
 
     from .utils.profiling import trace
 
-    teacher_cfg = cfg.model.replace(
-        n_layers=args.teacher_layers or 2 * cfg.model.n_layers
+    teacher_layers = (
+        2 * cfg.model.n_layers if args.teacher_layers is None else args.teacher_layers
     )
+    # ModelConfig validates n_layers >= 1; enforce deeper-than-student here so
+    # a degenerate teacher fails before the training budget is spent.
+    if teacher_layers < cfg.model.n_layers:
+        raise SystemExit(
+            f"--teacher-layers {teacher_layers} is shallower than the "
+            f"{cfg.model.n_layers}-layer student"
+        )
+    teacher_cfg = cfg.model.replace(n_layers=teacher_layers)
     t_trainer = Trainer(teacher_cfg, cfg.train, pad_id=tok.pad_id)
     t_state = t_trainer.init_state()
     with trace(getattr(args, "profile_dir", None)):

@@ -52,6 +52,8 @@ class ModelConfig:
     remat: bool = False
 
     def __post_init__(self) -> None:
+        if self.n_layers < 1:
+            raise ValueError(f"n_layers={self.n_layers} must be >= 1")
         if self.max_len > self.max_position_embeddings:
             raise ValueError(
                 f"max_len={self.max_len} exceeds the position-embedding table "

@@ -176,7 +176,10 @@ class DistillTrainer(Trainer):
         self, teacher_params: Any, seed: int | None = None
     ) -> TrainState:
         """Fresh student state, layer-initialized from the teacher when
-        ``DistillConfig.init_from_teacher`` and the depths divide evenly."""
+        ``DistillConfig.init_from_teacher``. The stride is
+        ``teacher_layers // student_layers`` (floored — non-divisible depths
+        take the first strided layers, e.g. 5 -> 2 copies teacher layers
+        0 and 2)."""
         state = self.init_state(seed=seed)
         if not self.distill_cfg.init_from_teacher:
             return state

@@ -1,0 +1,83 @@
+"""Golden-metrics regression vs the reference's recorded run (SURVEY.md §4/§6).
+
+The reference repo ships no tests, but it ships exact recorded results: the
+metrics CSVs pin accuracy/precision/recall/F1 to full float precision and
+the confusion-matrix PNGs pin exact error counts for the 2025-08-05 run
+(client 1 test set n=4515: aggregated FP=0 / FN=3). Those two records are
+mutually consistent only for one confusion matrix — reconstructing it and
+pushing it through this framework's metric pipeline must reproduce the
+reference's CSV numbers exactly. This pins our metric definitions (sklearn
+``average='binary'`` semantics, percent-scaled accuracy, reference
+client1.py:134-143) to the reference's observed behavior.
+"""
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.ops.metrics import (
+    BinaryCounts,
+    finalize_metrics,
+)
+
+# client1_aggregated_metrics.csv:2 (full precision, quoted in SURVEY.md §6
+# and tests/test_reporting.py):
+GOLDEN_AGG = {
+    "Accuracy": 99.93355481727574,
+    "Precision": 1.0,
+    "Recall": 0.9988399071925754,
+    "F1-Score": 0.9994196170177677,
+}
+N_TEST = 4515  # client 1 test split size (confusion-matrix PNG)
+FP, FN = 0, 3  # aggregated-model error counts (confusion-matrix PNG)
+
+
+def _reference_confusion():
+    """Solve for the only (TP, TN) consistent with the recorded metrics:
+    accuracy fixes total errors (= FP + FN ✓) and recall fixes the positive
+    count: FN / (1 - recall) = TP + FN."""
+    positives = round(FN / (1.0 - GOLDEN_AGG["Recall"]))
+    tp = positives - FN
+    tn = N_TEST - positives - FP
+    return tp, tn
+
+
+def test_reconstruction_is_self_consistent():
+    tp, tn = _reference_confusion()
+    assert tp + tn + FP + FN == N_TEST
+    # 2586 DDoS rows in client 1's test split — the recorded recall demands it.
+    assert tp + FN == 2586
+
+
+def test_finalize_metrics_reproduces_reference_csv():
+    tp, tn = _reference_confusion()
+    z = np.float32(0.0)
+    counts = BinaryCounts(
+        loss_sum=z,
+        n_batches=np.float32(1.0),
+        n_examples=np.float32(N_TEST),
+        correct=np.float32(tp + tn),
+        tp=np.float32(tp),
+        fp=np.float32(FP),
+        fn=np.float32(FN),
+        tn=np.float32(tn),
+    )
+    m = finalize_metrics(counts)
+    for key, want in GOLDEN_AGG.items():
+        # Accuracy/precision/recall reproduce to full float64 precision.
+        # The recorded F1's final digits (…70177677 vs our …69471851, a
+        # 7e-11 gap) are not reproducible from these counts by any standard
+        # float64 F1 formula (2PR/(P+R), 2TP/(2TP+FP+FN), fbeta form all
+        # agree with ours) — an artifact of the reference's toolchain, so
+        # F1 is pinned at 1e-9 instead.
+        tol = 1e-9 if key == "F1-Score" else 1e-12
+        assert m[key] == pytest.approx(want, abs=tol), key
+    np.testing.assert_array_equal(
+        m["confusion_matrix"], np.array([[tn, FP], [FN, tp]])
+    )
+
+
+def test_local_error_counts_match_recorded_accuracy():
+    """Local model record: FP=41 / FN=0 (confusion PNG) should yield the
+    recorded 99.09% accuracy (client1_local_metrics.csv)."""
+    acc = 100.0 * (N_TEST - 41) / N_TEST
+    assert acc == pytest.approx(99.09, abs=0.005)
