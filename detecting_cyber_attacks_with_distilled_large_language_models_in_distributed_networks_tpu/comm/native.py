@@ -10,63 +10,39 @@ by one verify under the other.
 from __future__ import annotations
 
 import ctypes
-import os
 import zlib
 
 import numpy as np
 
-_LIB: ctypes.CDLL | None = None
-_TRIED = False
+from ..utils.native import load_native
 
 
-def _repo_native_dir() -> str:
-    # <repo>/detecting_cyber..._tpu/comm/native.py -> <repo>/native
-    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    return os.path.join(os.path.dirname(pkg), "native")
+def _configure(cdll: ctypes.CDLL) -> None:
+    cdll.fedwire_crc32.restype = ctypes.c_uint32
+    cdll.fedwire_crc32.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+        ctypes.c_uint32,
+    ]
+    cdll.fedwire_pack_bf16.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+    ]
+    cdll.fedwire_unpack_bf16.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+    ]
+    cdll.fedwire_xor.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+    ]
 
 
 def lib() -> ctypes.CDLL | None:
-    global _LIB, _TRIED
-    if _TRIED:
-        return _LIB
-    _TRIED = True
-    try:
-        import importlib.util
-
-        spec = importlib.util.spec_from_file_location(
-            "fedwire_build", os.path.join(_repo_native_dir(), "build.py")
-        )
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        so_path = mod.build()
-        if so_path is None:
-            return None
-        cdll = ctypes.CDLL(so_path)
-        cdll.fedwire_crc32.restype = ctypes.c_uint32
-        cdll.fedwire_crc32.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_size_t,
-            ctypes.c_uint32,
-        ]
-        cdll.fedwire_pack_bf16.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_void_p,
-            ctypes.c_size_t,
-        ]
-        cdll.fedwire_unpack_bf16.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_void_p,
-            ctypes.c_size_t,
-        ]
-        cdll.fedwire_xor.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_void_p,
-            ctypes.c_size_t,
-        ]
-        _LIB = cdll
-    except Exception:
-        _LIB = None
-    return _LIB
+    return load_native("fedwire.cpp", "fedwire.so", _configure)
 
 
 def have_native() -> bool:

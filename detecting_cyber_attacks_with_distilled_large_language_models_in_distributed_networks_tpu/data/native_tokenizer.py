@@ -1,0 +1,96 @@
+"""ctypes binding for the native WordPiece batch encoder (wordpiece.so).
+
+Same contract as comm/native.py for the wire byte-path: lazily build + load
+the shared library, degrade to the pure-Python implementation when no
+toolchain exists. The native path is ASCII-exact with tokenizer.py's
+BasicTokenizer+WordPiece (the flow-text templates are pure ASCII); the
+wrapper in ``WordPieceTokenizer.batch_encode`` routes non-ASCII batches to
+Python, so outputs are identical either way.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import weakref
+from typing import Sequence
+
+import numpy as np
+
+from ..utils.native import load_native
+
+
+def _configure(cdll: ctypes.CDLL) -> None:
+    cdll.wp_create.restype = ctypes.c_void_p
+    cdll.wp_create.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    cdll.wp_destroy.argtypes = [ctypes.c_void_p]
+    cdll.wp_encode_batch.restype = ctypes.c_int32
+    cdll.wp_encode_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+    ]
+
+
+def lib() -> ctypes.CDLL | None:
+    return load_native("wordpiece.cpp", "wordpiece.so", _configure)
+
+
+def have_native() -> bool:
+    return lib() is not None
+
+
+class NativeWordPiece:
+    """One vocab bound into the native encoder. ``None``-safe constructor:
+    use :func:`NativeWordPiece.create` which returns None when unavailable."""
+
+    def __init__(self, cdll: ctypes.CDLL, handle: int):
+        self._cdll = cdll
+        self._handle = handle
+        self._finalizer = weakref.finalize(self, cdll.wp_destroy, handle)
+
+    @classmethod
+    def create(cls, vocab_in_id_order: Sequence[str]) -> "NativeWordPiece | None":
+        cdll = lib()
+        if cdll is None:
+            return None
+        blob = "\n".join(vocab_in_id_order).encode("utf-8")
+        handle = cdll.wp_create(ctypes.c_char_p(blob), len(blob))
+        if not handle:
+            return None
+        return cls(cdll, handle)
+
+    def encode_batch(
+        self, texts: Sequence[str], max_len: int, *, lowercase: bool = True
+    ) -> dict[str, np.ndarray] | None:
+        """Returns the tokenizer feed dict, or None when any text is
+        non-ASCII (caller falls back to Python for exact unicode parity)."""
+        n = len(texts)
+        input_ids = np.empty((n, max_len), np.int32)
+        attention_mask = np.empty((n, max_len), np.int32)
+        if n == 0:
+            return {"input_ids": input_ids, "attention_mask": attention_mask}
+        try:
+            encoded = [t.encode("ascii") for t in texts]
+        except UnicodeEncodeError:
+            return None
+        offsets = np.zeros(n + 1, np.int64)
+        np.cumsum([len(b) for b in encoded], out=offsets[1:])
+        blob = b"".join(encoded)
+        rc = self._cdll.wp_encode_batch(
+            self._handle,
+            ctypes.c_char_p(blob),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n,
+            max_len,
+            1 if lowercase else 0,
+            input_ids.ctypes.data_as(ctypes.c_void_p),
+            attention_mask.ctypes.data_as(ctypes.c_void_p),
+        )
+        if rc != 0:
+            return None
+        return {"input_ids": input_ids, "attention_mask": attention_mask}

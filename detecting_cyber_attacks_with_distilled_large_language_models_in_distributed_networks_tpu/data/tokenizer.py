@@ -167,6 +167,8 @@ class WordPieceTokenizer:
         self.cls_id = self.vocab[CLS]
         self.sep_id = self.vocab[SEP]
         self._word_cache: dict[str, list[int]] = {}
+        self._native = None  # lazily created by batch_encode
+        self._native_tried = False
 
     def __len__(self) -> int:
         return len(self.vocab)
@@ -235,11 +237,51 @@ class WordPieceTokenizer:
             ids = ids[: max_len - 2]
         return [self.cls_id, *ids, self.sep_id]
 
+    def _native_encoder(self):
+        """Lazily bind this vocab into the native batch encoder
+        (data/native_tokenizer.py). Only when the vocab is dense (ids
+        0..n-1), newline-free, and the word-length cap is the native
+        default — otherwise the Python path is authoritative."""
+        if self._native_tried:
+            return self._native
+        self._native_tried = True
+        if self.max_input_chars_per_word != 100:
+            return None
+        tokens: list[str | None] = [None] * len(self.vocab)
+        for tok, i in self.vocab.items():
+            # Empty tokens would vanish from the '\n'-joined native vocab
+            # blob and shift every later id — Python path only for those.
+            if (
+                not tok
+                or "\n" in tok
+                or not (0 <= i < len(tokens))
+                or tokens[i] is not None
+            ):
+                return None
+            tokens[i] = tok
+        if any(t is None for t in tokens):
+            return None
+        from .native_tokenizer import NativeWordPiece
+
+        self._native = NativeWordPiece.create(tokens)  # None without toolchain
+        return self._native
+
     def batch_encode(
         self, texts: Sequence[str], max_len: int = 128
     ) -> dict[str, np.ndarray]:
         """Static-shape ``[N, max_len]`` int32 ``input_ids`` + ``attention_mask``
-        (the TPU feed format; equivalent to HF ``padding='max_length'``)."""
+        (the TPU feed format; equivalent to HF ``padding='max_length'``).
+
+        Pure-ASCII batches take the native C++ encoder when available
+        (bit-identical output, ~an order of magnitude faster); anything else
+        — non-ASCII text, exotic vocabs, no toolchain — runs the Python
+        implementation below.
+        """
+        native = self._native_encoder()
+        if native is not None:
+            out = native.encode_batch(texts, max_len, lowercase=self.lowercase)
+            if out is not None:
+                return out
         n = len(texts)
         input_ids = np.full((n, max_len), self.pad_id, dtype=np.int32)
         attention_mask = np.zeros((n, max_len), dtype=np.int32)

@@ -1,0 +1,50 @@
+"""Shared loader for the repo's native C++ libraries (native/*.so).
+
+One code path for every binding (comm/native.py wire byte-path,
+data/native_tokenizer.py WordPiece encoder): lazily build via
+native/build.py, load with ctypes, hand the CDLL to a configure callback
+that declares argtypes/restypes, and cache the result — returning None
+(pure-Python fallback) when no toolchain exists or anything fails.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Callable
+
+_CACHE: dict[str, ctypes.CDLL | None] = {}
+
+
+def repo_native_dir() -> str:
+    # <repo>/<package>/utils/native.py -> <repo>/native
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg), "native")
+
+
+def load_native(
+    src: str, soname: str, configure: Callable[[ctypes.CDLL], None]
+) -> ctypes.CDLL | None:
+    """Build (if stale) + load + configure ``native/<src>`` -> ``<soname>``.
+
+    The first outcome — loaded library or None — is cached per soname;
+    failures never raise (callers keep their pure-Python twin)."""
+    if soname in _CACHE:
+        return _CACHE[soname]
+    lib: ctypes.CDLL | None = None
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            f"{soname}_build", os.path.join(repo_native_dir(), "build.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        so_path = mod.build_lib(src, soname)
+        if so_path is not None:
+            lib = ctypes.CDLL(so_path)
+            configure(lib)
+    except Exception:
+        lib = None
+    _CACHE[soname] = lib
+    return lib

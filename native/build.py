@@ -1,8 +1,9 @@
-"""Build fedwire.so (the native wire-format byte-path) with g++.
+"""Build the native shared libraries (fedwire.so, wordpiece.so) with g++.
 
-Usage: ``python native/build.py [--out DIR]``. Also importable:
-``build(out_dir)`` returns the .so path or None when no toolchain exists
-(callers fall back to the pure-numpy implementations in comm/native.py).
+Usage: ``python native/build.py [--out DIR]`` builds everything. Also
+importable: ``build(out_dir)`` (fedwire, kept for back-compat) and
+``build_lib(src, soname, out_dir)`` return the .so path or None when no
+toolchain exists (callers fall back to pure-Python/numpy implementations).
 """
 
 from __future__ import annotations
@@ -13,14 +14,25 @@ import shutil
 import subprocess
 import sys
 
-_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fedwire.cpp")
-DEFAULT_OUT = os.path.dirname(os.path.abspath(__file__))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_OUT = _HERE
 SONAME = "fedwire.so"
+LIBS: tuple[tuple[str, str], ...] = (
+    ("fedwire.cpp", "fedwire.so"),
+    ("wordpiece.cpp", "wordpiece.so"),
+)
 
 
-def build(out_dir: str = DEFAULT_OUT, *, force: bool = False) -> str | None:
-    out = os.path.join(out_dir, SONAME)
-    if not force and os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(_SRC):
+def build_lib(
+    src: str, soname: str, out_dir: str = DEFAULT_OUT, *, force: bool = False
+) -> str | None:
+    src_path = os.path.join(_HERE, src)
+    out = os.path.join(out_dir, soname)
+    if (
+        not force
+        and os.path.exists(out)
+        and os.path.getmtime(out) >= os.path.getmtime(src_path)
+    ):
         return out
     gxx = shutil.which("g++") or shutil.which("clang++")
     if gxx is None:
@@ -32,16 +44,21 @@ def build(out_dir: str = DEFAULT_OUT, *, force: bool = False) -> str | None:
         "-fPIC",
         "-std=c++17",
         "-fno-exceptions",
-        _SRC,
+        src_path,
         "-o",
         out,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
     except subprocess.CalledProcessError as e:
-        sys.stderr.write(f"fedwire build failed:\n{e.stderr}\n")
+        sys.stderr.write(f"{soname} build failed:\n{e.stderr}\n")
         return None
     return out
+
+
+def build(out_dir: str = DEFAULT_OUT, *, force: bool = False) -> str | None:
+    """fedwire.so (back-compat entry point used by comm/native.py)."""
+    return build_lib("fedwire.cpp", SONAME, out_dir, force=force)
 
 
 if __name__ == "__main__":
@@ -49,7 +66,13 @@ if __name__ == "__main__":
     ap.add_argument("--out", default=DEFAULT_OUT)
     ap.add_argument("--force", action="store_true")
     args = ap.parse_args()
-    path = build(args.out, force=args.force)
-    if path is None:
-        sys.exit("no C++ toolchain found (g++/clang++)")
-    print(path)
+    failed = False
+    for src, soname in LIBS:
+        path = build_lib(src, soname, args.out, force=args.force)
+        if path is None:
+            failed = True
+            sys.stderr.write(f"FAILED: {soname}\n")
+        else:
+            print(path)
+    if failed:
+        sys.exit("no C++ toolchain found (g++/clang++) or compile error")

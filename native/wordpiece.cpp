@@ -1,0 +1,168 @@
+// Native WordPiece batch encoder (ASCII fast path).
+//
+// The TPU feed format is pre-tokenized [N, max_len] int32 arrays
+// (data/pipeline.py); tokenization is the one host-side hot loop left, so it
+// gets the same native treatment as the wire byte-path (fedwire.cpp). The
+// algorithm mirrors data/tokenizer.py exactly for ASCII input: BERT
+// BasicTokenizer (clean -> whitespace split -> lowercase -> punctuation
+// split; NFD accent-stripping is a no-op on ASCII) followed by greedy
+// longest-match WordPiece with "##" continuations. The Python wrapper
+// (data/native_tokenizer.py) routes only pure-ASCII batches here — anything
+// else takes the pure-Python path — so parity with the reference HF
+// tokenizer behavior (reference client1.py:36-50) is preserved bit-for-bit.
+//
+// C ABI: wp_create / wp_destroy / wp_encode_batch (see prototypes below).
+// Built by native/build.py into wordpiece.so; loaded via ctypes.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Vocab {
+  std::unordered_map<std::string, int32_t> table;
+  int32_t pad_id = -1, unk_id = -1, cls_id = -1, sep_id = -1;
+  int32_t max_word_chars = 100;
+};
+
+inline bool is_ascii_punct(unsigned char c) {
+  return (c >= 33 && c <= 47) || (c >= 58 && c <= 64) || (c >= 91 && c <= 96) ||
+         (c >= 123 && c <= 126);
+}
+
+inline bool is_ws(unsigned char c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r';
+}
+
+// Greedy longest-match WordPiece of one word -> ids appended to out.
+void wordpiece(const Vocab& v, const std::string& word,
+               std::vector<int32_t>& out) {
+  const size_t n = word.size();
+  if (n > static_cast<size_t>(v.max_word_chars)) {
+    out.push_back(v.unk_id);
+    return;
+  }
+  const size_t start_len = out.size();
+  size_t start = 0;
+  std::string probe;
+  while (start < n) {
+    size_t end = n;
+    int32_t piece = -1;
+    while (start < end) {
+      probe.assign(start > 0 ? "##" : "");
+      probe.append(word, start, end - start);
+      auto it = v.table.find(probe);
+      if (it != v.table.end()) {
+        piece = it->second;
+        break;
+      }
+      --end;
+    }
+    if (piece < 0) {
+      out.resize(start_len);
+      out.push_back(v.unk_id);
+      return;
+    }
+    out.push_back(piece);
+    start = end;
+  }
+}
+
+// BasicTokenizer (ASCII) + WordPiece over one text -> ids appended to out.
+void encode_text(const Vocab& v, const char* s, size_t len, bool lowercase,
+                 std::vector<int32_t>& out) {
+  std::string word;
+  auto flush_word = [&]() {
+    if (!word.empty()) {
+      wordpiece(v, word, out);
+      word.clear();
+    }
+  };
+  for (size_t i = 0; i < len; ++i) {
+    unsigned char c = static_cast<unsigned char>(s[i]);
+    if (c == 0) continue;                 // cleaned
+    if (is_ws(c)) { flush_word(); continue; }
+    if (c < 32 || c == 127) continue;     // ASCII control: cleaned
+    if (is_ascii_punct(c)) {              // punctuation: standalone token
+      flush_word();
+      word.push_back(static_cast<char>(c));
+      flush_word();
+      continue;
+    }
+    if (lowercase && c >= 'A' && c <= 'Z') c = c - 'A' + 'a';
+    word.push_back(static_cast<char>(c));
+  }
+  flush_word();
+}
+
+}  // namespace
+
+extern "C" {
+
+// vocab_blob: '\n'-joined token strings (index = id). Returns handle or null.
+void* wp_create(const char* vocab_blob, size_t len) {
+  Vocab* v = new (std::nothrow) Vocab();
+  if (!v) return nullptr;
+  size_t start = 0;
+  int32_t id = 0;
+  for (size_t i = 0; i <= len; ++i) {
+    if (i == len || vocab_blob[i] == '\n') {
+      if (i > start) {
+        std::string tok(vocab_blob + start, i - start);
+        if (tok == "[PAD]") v->pad_id = id;
+        else if (tok == "[UNK]") v->unk_id = id;
+        else if (tok == "[CLS]") v->cls_id = id;
+        else if (tok == "[SEP]") v->sep_id = id;
+        v->table.emplace(std::move(tok), id);
+        ++id;
+      }
+      start = i + 1;
+    }
+  }
+  if (v->pad_id < 0 || v->unk_id < 0 || v->cls_id < 0 || v->sep_id < 0) {
+    delete v;
+    return nullptr;
+  }
+  return v;
+}
+
+void wp_destroy(void* handle) { delete static_cast<Vocab*>(handle); }
+
+// texts_blob + offsets[n_texts+1] (byte offsets into the blob) -> row-major
+// out_ids/out_mask [n_texts, max_len], PAD-filled, "[CLS] ... [SEP]" with
+// truncation to max_len (specials kept) exactly like tokenizer.py encode().
+// Returns 0 on success, -1 on bad arguments.
+int wp_encode_batch(void* handle, const char* texts_blob,
+                    const int64_t* offsets, int32_t n_texts, int32_t max_len,
+                    int32_t lowercase, int32_t* out_ids, int32_t* out_mask) {
+  if (!handle || max_len < 2 || n_texts < 0) return -1;
+  const Vocab& v = *static_cast<Vocab*>(handle);
+  std::vector<int32_t> ids;
+  for (int32_t r = 0; r < n_texts; ++r) {
+    ids.clear();
+    const int64_t b = offsets[r], e = offsets[r + 1];
+    if (e < b) return -1;
+    encode_text(v, texts_blob + b, static_cast<size_t>(e - b), lowercase != 0,
+                ids);
+    const int32_t body =
+        ids.size() > static_cast<size_t>(max_len - 2) ? max_len - 2
+                                                      : static_cast<int32_t>(ids.size());
+    int32_t* row_ids = out_ids + static_cast<int64_t>(r) * max_len;
+    int32_t* row_mask = out_mask + static_cast<int64_t>(r) * max_len;
+    int32_t w = 0;
+    row_ids[w++] = v.cls_id;
+    for (int32_t i = 0; i < body; ++i) row_ids[w++] = ids[i];
+    row_ids[w++] = v.sep_id;
+    for (int32_t i = 0; i < w; ++i) row_mask[i] = 1;
+    for (int32_t i = w; i < max_len; ++i) {
+      row_ids[i] = v.pad_id;
+      row_mask[i] = 0;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -141,14 +141,18 @@ def test_bf16_pack_matches_jax_cast(rng):
 
 
 def test_bf16_python_fallback_matches_native(rng):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.utils import (
+        native as native_loader,
+    )
+
     x = rng.normal(size=1024).astype(np.float32)
     via_native = native.pack_bf16(x)
-    lib = native._LIB
-    native._LIB, native._TRIED = None, True  # force numpy path
+    saved = native_loader._CACHE.get("fedwire.so")
+    native_loader._CACHE["fedwire.so"] = None  # force numpy path
     try:
         via_python = native.pack_bf16(x)
     finally:
-        native._LIB = lib
+        native_loader._CACHE["fedwire.so"] = saved
     np.testing.assert_array_equal(via_native, via_python)
 
 

@@ -76,8 +76,25 @@ def test_finalize_metrics_reproduces_reference_csv():
     )
 
 
-def test_local_error_counts_match_recorded_accuracy():
-    """Local model record: FP=41 / FN=0 (confusion PNG) should yield the
-    recorded 99.09% accuracy (client1_local_metrics.csv)."""
-    acc = 100.0 * (N_TEST - 41) / N_TEST
-    assert acc == pytest.approx(99.09, abs=0.005)
+def test_local_error_counts_reproduce_recorded_accuracy():
+    """Local model record: FP=41 / FN=0 (confusion PNG) through the metric
+    pipeline must yield the recorded 99.09% accuracy with perfect recall
+    (client1_local_metrics.csv). The positive count (2586) is fixed by the
+    aggregated-model reconstruction above — same test split."""
+    tp = 2586  # all positives found (FN=0)
+    tn = N_TEST - tp - 41
+    m = finalize_metrics(
+        BinaryCounts(
+            loss_sum=np.float32(0.0),
+            n_batches=np.float32(1.0),
+            n_examples=np.float32(N_TEST),
+            correct=np.float32(tp + tn),
+            tp=np.float32(tp),
+            fp=np.float32(41),
+            fn=np.float32(0),
+            tn=np.float32(tn),
+        )
+    )
+    assert m["Accuracy"] == pytest.approx(99.09, abs=0.005)
+    assert m["Recall"] == 1.0
+    assert m["Precision"] == pytest.approx(tp / (tp + 41), abs=1e-12)
