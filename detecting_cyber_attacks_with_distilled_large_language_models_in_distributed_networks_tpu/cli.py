@@ -118,8 +118,10 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
 
 
 # -------------------------------------------------------------------- data
-def _load_clients(args, cfg: ExperimentConfig, tok, num_clients: int):
-    """CSV / mixed corpus / synthetic -> per-client tokenized splits."""
+def _load_client_splits(args, cfg: ExperimentConfig, num_clients: int):
+    """CSV / mixed corpus / synthetic -> per-client text splits (host-side
+    pandas/numpy only; tokenization is a separate phase so multi-host
+    processes can tokenize just their own clients)."""
     from .data import (
         load_flow_csv,
         load_mixed_corpus,
@@ -127,7 +129,6 @@ def _load_clients(args, cfg: ExperimentConfig, tok, num_clients: int):
         make_all_client_splits_from_corpus,
         make_synthetic,
         parse_source_arg,
-        tokenize_client,
     )
 
     if getattr(args, "source", None):
@@ -142,11 +143,8 @@ def _load_clients(args, cfg: ExperimentConfig, tok, num_clients: int):
         ]
         with phase(f"loading {len(entries)}-source mixed corpus", tag="DATA"):
             corpus = load_mixed_corpus(entries)
-        with phase("partition/split/tokenize", tag="DATA"):
-            splits = make_all_client_splits_from_corpus(
-                corpus, num_clients, cfg.data
-            )
-            return [tokenize_client(s, tok, max_len=cfg.model.max_len) for s in splits]
+        with phase("partition/split", tag="DATA"):
+            return make_all_client_splits_from_corpus(corpus, num_clients, cfg.data)
     if getattr(args, "csv", None):
         with phase(f"loading {args.csv}", tag="DATA"):
             df = load_flow_csv(args.csv)
@@ -154,8 +152,16 @@ def _load_clients(args, cfg: ExperimentConfig, tok, num_clients: int):
         n = getattr(args, "synthetic", None) or 2400
         with phase(f"generating {n} synthetic {cfg.data.dataset} flows", tag="DATA"):
             df = make_synthetic(cfg.data.dataset, n, seed=cfg.data.seed_base)
-    with phase("partition/split/tokenize", tag="DATA"):
-        splits = make_all_client_splits(df, num_clients, cfg.data)
+    with phase("partition/split", tag="DATA"):
+        return make_all_client_splits(df, num_clients, cfg.data)
+
+
+def _load_clients(args, cfg: ExperimentConfig, tok, num_clients: int):
+    """Full path: text splits -> tokenized static-shape arrays, all clients."""
+    from .data import tokenize_client
+
+    splits = _load_client_splits(args, cfg, num_clients)
+    with phase("tokenize", tag="DATA"):
         return [tokenize_client(s, tok, max_len=cfg.model.max_len) for s in splits]
 
 
@@ -232,20 +238,73 @@ def cmd_local(args) -> int:
 
 
 def cmd_federated(args) -> int:
-    from .data import default_tokenizer, stack_clients
+    import jax
+
+    from .data import default_tokenizer, stack_clients, tokenize_client
     from .train.federated import FederatedTrainer
+
+    # Multi-host bootstrap must precede the first backend touch
+    # (jax.devices()/process_count()); config resolution and data loading
+    # are backend-free so their order doesn't matter.
+    mesh = None
+    local_sl = None
+    multihost_flags = (
+        getattr(args, "coordinator", None)
+        or getattr(args, "num_processes", None)
+        or getattr(args, "process_id", None) is not None
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    )
+    if multihost_flags:
+        from .parallel.multihost import initialize
+
+        if not initialize(args.coordinator, args.num_processes, args.process_id):
+            raise SystemExit(
+                "multi-host bootstrap failed: pass --coordinator HOST:PORT "
+                "plus --num-processes/--process-id (or run on a platform "
+                "where jax.distributed autodetects)"
+            )
 
     tok = default_tokenizer()
     cfg = resolve_config(args, vocab_size=len(tok.vocab))
     C = cfg.fed.num_clients
-    clients = _load_clients(args, cfg, tok, C)
-    stacked_train = stack_clients([c.train for c in clients])
-    trainer = FederatedTrainer(cfg, pad_id=tok.pad_id)
+    if jax.process_count() > 1:
+        from .parallel.multihost import local_client_slice, make_global_mesh
+
+        if C != cfg.mesh.clients:
+            raise SystemExit(
+                f"multi-host runs need one mesh row per client "
+                f"(num_clients={C}, mesh.clients={cfg.mesh.clients})"
+            )
+        mesh = make_global_mesh(
+            cfg.mesh.clients, cfg.mesh.data, axis_names=cfg.mesh.axis_names
+        )
+        local_sl = local_client_slice(mesh)
+        log.info(
+            f"[FED] process {jax.process_index()}/{jax.process_count()} owns "
+            f"clients [{local_sl.start}, {local_sl.stop})"
+        )
+
+    # Partitioning runs over the full fleet on every host (it must be
+    # globally consistent); tokenization — the host-side hot loop — runs
+    # only for this process's clients. Global row counts for the stacked
+    # train/eval feeds come from the (cheap) split lengths, so every host
+    # agrees on batch counts without seeing other hosts' token arrays.
+    splits = _load_client_splits(args, cfg, C)
+    local_ids = range(C) if local_sl is None else range(local_sl.start, local_sl.stop)
+    with phase(f"tokenize clients {list(local_ids)}", tag="DATA"):
+        clients = [
+            tokenize_client(splits[c], tok, max_len=cfg.model.max_len)
+            for c in local_ids
+        ]
+    n_train_common = min(len(s.train) for s in splits)
+    eval_rows_global = max(len(s.test) for s in splits)
+    stacked_train = stack_clients([c.train for c in clients], n_rows=n_train_common)
+    trainer = FederatedTrainer(cfg, pad_id=tok.pad_id, mesh=mesh)
 
     ckpt = None
     start_round = 0
     state = trainer.init_state()
-    if cfg.checkpoint_dir:
+    if cfg.checkpoint_dir and local_sl is None:
         from .train.checkpoint import Checkpointer, maybe_warm_start
 
         restored, step = maybe_warm_start(cfg.checkpoint_dir, state)
@@ -253,15 +312,21 @@ def cmd_federated(args) -> int:
             state, start_round = restored, int(step)
             log.info(f"[FED] resumed from round {start_round}")
         ckpt = Checkpointer(cfg.checkpoint_dir)
+    elif cfg.checkpoint_dir:
+        log.info("[FED] multi-host checkpointing not wired yet; skipping")
 
+    # FedAvg weights are the GLOBAL per-client sample counts (known from the
+    # cheap split phase on every host, reference semantics: weight by data).
     weights = (
-        np.array([len(c.train) for c in clients], np.float64)
+        np.array([len(s.train) for s in splits], np.float64)
         if cfg.fed.weighted
         else None
     )
     from .utils.profiling import trace
 
-    prepared = trainer.prepare_eval([c.test for c in clients])
+    prepared = trainer.prepare_eval(
+        [c.test for c in clients], target_rows=eval_rows_global
+    )
     history = []
     with trace(getattr(args, "profile_dir", None)):
         for r in range(start_round, cfg.fed.rounds):
@@ -287,18 +352,22 @@ def cmd_federated(args) -> int:
         ckpt.wait()
         ckpt.close()
 
-    # Final reporting with probs for ROC/PR curves.
+    # Final reporting with probs for ROC/PR curves. Under multi-host the
+    # per-example probs live on their owning hosts; the metric counts are
+    # replicated everywhere, so process 0 writes prob-free reports for all.
     final_local = history[-1][1] if history else None
+    multihost = jax.process_count() > 1
     final_agg = trainer.evaluate_clients(
-        state.params, prepared=prepared, collect_probs=True
+        state.params, prepared=prepared, collect_probs=not multihost
     )
-    for c in range(C):
-        _write_reports(
-            c,
-            final_local[c] if final_local else final_agg[c],
-            final_agg[c],
-            cfg.output_dir,
-        )
+    if not multihost or jax.process_index() == 0:
+        for c in range(C):
+            _write_reports(
+                c,
+                final_local[c] if final_local else final_agg[c],
+                final_agg[c],
+                cfg.output_dir,
+            )
     return 0
 
 
@@ -515,6 +584,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--weighted", action="store_true", help="weight FedAvg by sample count")
     p.add_argument("--partition", help="sample|disjoint|dirichlet")
     p.add_argument("--checkpoint-dir")
+    p.add_argument(
+        "--coordinator",
+        help="multi-host: coordinator HOST:PORT (every process passes the "
+        "same address; also via JAX_COORDINATOR_ADDRESS)",
+    )
+    p.add_argument("--num-processes", type=int, help="multi-host: process count")
+    p.add_argument("--process-id", type=int, help="multi-host: this process's id")
     p.set_defaults(fn=cmd_federated)
 
     p = sub.add_parser("serve", help="TCP aggregation server (demo-parity mode)")

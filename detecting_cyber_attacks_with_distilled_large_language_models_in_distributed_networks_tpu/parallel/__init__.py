@@ -1,2 +1,9 @@
 from .mesh import FedShardings, make_mesh  # noqa: F401
 from .fedavg import fedavg, make_fedavg_step  # noqa: F401
+from .multihost import (  # noqa: F401
+    global_array_from_replicated,
+    global_batch,
+    initialize,
+    local_client_slice,
+    make_global_mesh,
+)

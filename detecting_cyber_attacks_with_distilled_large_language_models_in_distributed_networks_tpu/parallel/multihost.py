@@ -1,0 +1,158 @@
+"""Multi-host federation: jax.distributed bootstrap + global mesh + feeds.
+
+The reference's "multi-node" story is three processes on one laptop joined
+by hand-rolled TCP with a polling rendezvous (reference client1.py:276-336,
+server.py:116-137). The TPU-native equivalent is the JAX runtime's own
+bootstrap: every process calls :func:`initialize` (coordinator address +
+process id), after which ``jax.devices()`` spans all hosts and ONE SPMD
+program runs across them — FedAvg rides DCN between hosts and ICI within,
+with no application-level sockets at all.
+
+Topology: :func:`make_global_mesh` lays the ``clients`` axis process-major,
+so each host holds a contiguous block of client replicas. Cross-client
+collectives (the FedAvg pmean) cross DCN once per round; the per-client
+``data``-axis gradient psum stays inside a host's ICI domain. Data feeding
+follows the same split: each process tokenizes only its own clients' shards
+(:func:`local_client_slice`) and assembles global arrays with
+:func:`global_batch`.
+
+Single-process runs degrade to the ordinary mesh/arrays — every function
+here is a no-op wrapper in that case, so the federated trainer has one code
+path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from .mesh import make_mesh
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """``jax.distributed.initialize`` with env fallbacks; returns whether a
+    multi-process runtime is active afterwards.
+
+    Env fallbacks (the standard JAX names): ``JAX_COORDINATOR_ADDRESS``,
+    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``. A ``num_processes`` of 1 (or
+    nothing configured) is the single-process case: no-op, returns False.
+    On TPU pods the runtime can discover everything itself — then call with
+    no arguments and let ``jax.distributed.initialize()`` autodetect.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    # NOTE: no jax.devices()/process_count() before jax.distributed
+    # initializes — any backend touch would lock in a single-process runtime.
+    if jax.distributed.is_initialized():
+        return jax.process_count() > 1
+    if num_processes in (None, 1) and coordinator_address is None:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_count() > 1
+
+
+def make_global_mesh(
+    clients: int = 1,
+    data: int = 1,
+    *,
+    axis_names: tuple[str, str] = ("clients", "data"),
+) -> Mesh:
+    """``clients x data`` mesh over ALL processes' devices, clients-major by
+    process: client c's submesh lives entirely on process
+    ``c // (clients / process_count)``. Requires ``clients`` to be a
+    multiple of the process count and ``clients*data`` devices total.
+
+    Single-process: identical to :func:`..mesh.make_mesh`.
+    """
+    P = jax.process_count()
+    if P == 1:
+        return make_mesh(clients, data, axis_names=axis_names)
+    if clients % P:
+        raise ValueError(
+            f"clients={clients} must be a multiple of process_count={P} so "
+            "each host owns whole client replicas (FedAvg crosses DCN, the "
+            "data axis stays on-host)"
+        )
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    need = clients * data
+    if len(devs) != need:
+        raise ValueError(
+            f"global mesh {clients}x{data} needs exactly {need} devices "
+            f"across {P} processes, have {len(devs)}"
+        )
+    per_proc = len(devs) // P
+    if (clients // P) * data != per_proc:
+        raise ValueError(
+            f"each process must contribute (clients/P)*data = "
+            f"{(clients // P) * data} devices, has {per_proc}"
+        )
+    grid = np.array(devs).reshape(clients, data)
+    return Mesh(grid, axis_names)
+
+
+def local_client_slice(mesh: Mesh) -> slice:
+    """Which block of the stacked ``[C, ...]`` client axis this process
+    feeds. With the process-major layout of :func:`make_global_mesh`, that
+    is one contiguous slice."""
+    C = mesh.devices.shape[0]
+    procs = [d.process_index for d in mesh.devices[:, 0]]
+    mine = [c for c, p in enumerate(procs) if p == jax.process_index()]
+    if not mine:  # a process holding no client shards feeds nothing
+        return slice(0, 0)
+    lo, hi = mine[0], mine[-1] + 1
+    if mine != list(range(lo, hi)):
+        raise ValueError(
+            "client axis is not process-contiguous; build the mesh with "
+            "make_global_mesh"
+        )
+    return slice(lo, hi)
+
+
+def global_batch(
+    sharding: NamedSharding, local: Mapping[str, np.ndarray], num_clients: int
+) -> dict[str, jax.Array]:
+    """Assemble global ``[C, ...]`` arrays from this process's local client
+    block ``[C_local, ...]`` (the :func:`local_client_slice` rows).
+
+    Single-process: plain ``device_put`` (local IS global)."""
+    if jax.process_count() == 1:
+        return {k: jax.device_put(v, sharding) for k, v in local.items()}
+    out = {}
+    for k, v in local.items():
+        global_shape = (num_clients, *v.shape[1:])
+        out[k] = jax.make_array_from_process_local_data(
+            sharding, np.ascontiguousarray(v), global_shape
+        )
+    return out
+
+
+def global_array_from_replicated(
+    sharding: NamedSharding, value: np.ndarray
+) -> jax.Array:
+    """Build a (possibly cross-process) sharded array from a host value that
+    every process holds in full — used for initial stacked params, where all
+    replicas start identical (the reference's shared-pretrained-start,
+    client1.py:56)."""
+    if jax.process_count() == 1:
+        return jax.device_put(value, sharding)
+    return jax.make_array_from_callback(
+        np.shape(value), sharding, lambda idx: value[idx]
+    )

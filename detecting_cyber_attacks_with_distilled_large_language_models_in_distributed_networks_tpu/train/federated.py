@@ -59,14 +59,25 @@ def federated_batches(
     *,
     seed: int,
     epoch: int,
+    client_offset: int = 0,
 ) -> Iterator[dict[str, np.ndarray]]:
     """Per-epoch batches ``[C, B, ...]`` with an independent shuffle per
     client (the reference's DataLoader shuffles per client independently,
-    client1.py:370)."""
+    client1.py:370).
+
+    Each permutation is keyed by (seed, epoch, GLOBAL client index) — under
+    multi-host, ``client_offset`` is this process's first global client, so
+    clients on different hosts draw distinct streams and a same-seed
+    multi-host run shuffles identically to its single-host equivalent.
+    """
     C, N = stacked.labels.shape
-    root = np.random.default_rng(seed * 100_003 + epoch)
     perms = np.stack(
-        [np.random.default_rng(root.integers(2**63)).permutation(N) for _ in range(C)]
+        [
+            np.random.default_rng(
+                (seed * 100_003 + epoch) * 1_000_003 + client_offset + c
+            ).permutation(N)
+            for c in range(C)
+        ]
     )
     rows = np.arange(C)[:, None]
     for i in range(N // batch_size):
@@ -79,12 +90,22 @@ def federated_batches(
 
 
 def stack_eval_splits(
-    splits: Sequence[TokenizedSplit], batch_size: int, pad_id: int = 0
+    splits: Sequence[TokenizedSplit],
+    batch_size: int,
+    pad_id: int = 0,
+    *,
+    target_rows: int | None = None,
 ) -> tuple[TokenizedSplit, np.ndarray]:
     """Pad per-client eval splits to one common ``[C, M, ...]`` stack (M a
     batch multiple) plus a ``[C, M]`` validity matrix so every real example
-    is counted exactly once per client."""
+    is counted exactly once per client.
+
+    ``target_rows``: minimum row count before batch-rounding — multi-host
+    processes pass the GLOBAL max split length so every host agrees on M
+    (and therefore on the eval batch count, which is a collective)."""
     target = max(len(s) for s in splits)
+    if target_rows is not None:
+        target = max(target, target_rows)
     target += (-target) % batch_size
     ids, masks, labels, valid = [], [], [], []
     for s in splits:
@@ -129,9 +150,23 @@ class FederatedTrainer:
         self.cfg = cfg
         self.C = cfg.fed.num_clients
         self.pad_id = pad_id
+        # Multi-host: the caller bootstraps jax.distributed (multihost.py
+        # initialize) and passes a global mesh (make_global_mesh); each
+        # process then feeds only its own client rows. Single process is the
+        # degenerate case of the same code path.
+        self.P = jax.process_count()
         self.mesh = mesh if mesh is not None else make_mesh(
             cfg.mesh.clients, cfg.mesh.data, axis_names=cfg.mesh.axis_names
         )
+        if self.P > 1:
+            from ..parallel.multihost import local_client_slice
+
+            mesh_rows = self.mesh.devices.shape[0]
+            self.client_offset = local_client_slice(self.mesh).start * (
+                self.C // mesh_rows
+            )
+        else:
+            self.client_offset = 0
         self.sh = FedShardings(self.mesh)
         self.model = DDoSClassifier(cfg.model)
         self.optimizer = make_optimizer(cfg.train)
@@ -196,6 +231,23 @@ class FederatedTrainer:
             in_shardings=(csh,),
             out_shardings=csh,
         )
+        # Host-sync path for clients-sharded values: under multi-process,
+        # shards on other hosts are not addressable — replicate first (an
+        # all-gather over DCN), then np.asarray is local. Single process
+        # short-circuits in _host().
+        self._replicate = jax.jit(lambda x: x, out_shardings=self.sh.replicated)
+
+    def _host(self, tree: Any) -> Any:
+        """np.asarray over a (possibly clients-sharded) pytree."""
+        if self.P > 1:
+            tree = self._replicate(tree)
+        return jax.tree.map(np.asarray, tree)
+
+    def _feed(self, batch: dict[str, np.ndarray]) -> dict[str, Any]:
+        """Process-local [C_local, B, ...] host batch -> global sharded feed."""
+        from ..parallel.multihost import global_batch
+
+        return global_batch(self.sh.batch, batch, self.C)
 
     # -------------------------------------------------------------- lifecycle
     def init_state(self, seed: int | None = None, params: Any | None = None) -> FedState:
@@ -203,24 +255,47 @@ class FederatedTrainer:
         condition (every client loads the same pretrained DistilBERT,
         client1.py:56)."""
         seed = self.cfg.train.seed if seed is None else seed
-        rng = jax.random.key(seed, impl=self.cfg.train.prng_impl)
+        impl = self.cfg.train.prng_impl
+        rng = jax.random.key(seed, impl=impl)
         if params is None:
             params = init_params(self.model, self.cfg.model, rng)
         C = self.C
 
-        def stack(x):
-            return jnp.broadcast_to(x[None], (C, *x.shape))
+        rngs = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+            jax.random.fold_in(rng, 7), jnp.arange(C)
+        )
+        if self.P == 1:
+            stacked_params = jax.device_put(
+                jax.tree.map(
+                    lambda x: jnp.broadcast_to(x[None], (C, *x.shape)), params
+                ),
+                self.sh.client,
+            )
+        else:
+            # Every process computed identical params from the same seed
+            # (the reference's shared-pretrained-start, client1.py:56);
+            # assemble the global [C, ...] stack from those replicas.
+            from ..parallel.multihost import global_array_from_replicated
 
-        stacked_params = jax.tree.map(stack, params)
-        stacked_params = jax.device_put(stacked_params, self.sh.client)
+            stacked_params = jax.tree.map(
+                lambda x: global_array_from_replicated(
+                    self.sh.client,
+                    np.broadcast_to(np.asarray(x)[None], (C, *np.shape(x))),
+                ),
+                params,
+            )
+            rngs = jax.random.wrap_key_data(
+                global_array_from_replicated(
+                    self.sh.client, np.asarray(jax.random.key_data(rngs))
+                ),
+                impl=impl,
+            )
         opt_state = self._opt_init(stacked_params)
         return FedState(
             params=stacked_params,
             opt_state=opt_state,
             step=jnp.zeros((), jnp.int32),
-            rngs=jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
-                jax.random.fold_in(rng, 7), jnp.arange(C)
-            ),
+            rngs=rngs,
         )
 
     def reset_optimizer(self, state: FedState) -> FedState:
@@ -251,12 +326,16 @@ class FederatedTrainer:
         for epoch in range(epoch_offset, epoch_offset + E):
             losses = []
             for batch in federated_batches(
-                stacked_train, bs, seed=self.cfg.train.seed, epoch=epoch
+                stacked_train,
+                bs,
+                seed=self.cfg.train.seed,
+                epoch=epoch,
+                client_offset=self.client_offset,
             ):
-                state, loss = self.train_step(state, batch)
+                state, loss = self.train_step(state, self._feed(batch))
                 losses.append(loss)
             epoch_avg = jnp.stack(losses).mean(axis=0) if losses else jnp.zeros(self.C)
-            out.append(np.asarray(epoch_avg))
+            out.append(self._host(epoch_avg))
             for c in range(self.C):
                 log.info(
                     f"Client {c} Epoch [{epoch - epoch_offset + 1}/{E}], "
@@ -269,11 +348,16 @@ class FederatedTrainer:
         splits: Sequence[TokenizedSplit],
         *,
         batch_size: int | None = None,
+        target_rows: int | None = None,
     ) -> "PreparedEval":
         """Pad/stack eval splits once; reuse across rounds (re-stacking every
-        evaluation would repeat the host-side concat of the full eval set)."""
+        evaluation would repeat the host-side concat of the full eval set).
+        Multi-host callers pass only their LOCAL clients' splits plus the
+        global max split length as ``target_rows``."""
         bs = self.cfg.data.eval_batch_size if batch_size is None else batch_size
-        stacked, valid = stack_eval_splits(splits, bs, pad_id=self.pad_id)
+        stacked, valid = stack_eval_splits(
+            splits, bs, pad_id=self.pad_id, target_rows=target_rows
+        )
         return PreparedEval(stacked, valid, bs, [s.labels.copy() for s in splits])
 
     def evaluate_clients(
@@ -296,24 +380,34 @@ class FederatedTrainer:
                 "do not also pass splits/batch_size"
             )
         stacked, valid, bs = prepared.stacked, prepared.valid, prepared.batch_size
-        C, M = stacked.labels.shape
+        if self.P > 1 and collect_probs:
+            raise NotImplementedError(
+                "collect_probs under multi-process federation: per-example "
+                "probs live on their owning host; gather them per-host"
+            )
+        C = self.C
+        M = stacked.labels.shape[1]
         # Accumulate the stacked [C] counts on device; one host sync after
         # the loop (per-batch np.asarray would block async dispatch).
         totals: BinaryCounts | None = None
         probs_dev = []
         for i in range(M // bs):
             sl = slice(i * bs, (i + 1) * bs)
-            batch = {
-                "input_ids": stacked.input_ids[:, sl],
-                "attention_mask": stacked.attention_mask[:, sl],
-                "labels": stacked.labels[:, sl],
-            }
-            counts, probs = self.eval_step(stacked_params, batch, valid[:, sl])
+            fed = self._feed(
+                {
+                    "input_ids": stacked.input_ids[:, sl],
+                    "attention_mask": stacked.attention_mask[:, sl],
+                    "labels": stacked.labels[:, sl],
+                    "valid": valid[:, sl],
+                }
+            )
+            batch = {k: fed[k] for k in ("input_ids", "attention_mask", "labels")}
+            counts, probs = self.eval_step(stacked_params, batch, fed["valid"])
             totals = counts if totals is None else totals + counts
             if collect_probs:
                 probs_dev.append(probs)
         host = (
-            jax.tree.map(np.asarray, totals)
+            self._host(totals)
             if totals is not None
             else BinaryCounts(*(np.zeros(C, np.float32) for _ in BinaryCounts._fields))
         )
