@@ -8,6 +8,15 @@ BASELINE.md), plus MFU against the local chip's peak (north star: ≥40%,
 BASELINE.json). Batch defaults to the TPU sweet spot (BENCH_BATCH=16 for
 the reference's exact configuration).
 
+Secondary modes via BENCH_MODE:
+    train  (default)  DistilBERT train step
+    bert              BERT-base scale-up train step (BASELINE.json config 4)
+    eval              DistilBERT eval step vs the reference's ~10 batch/s
+                      recorded eval throughput (BASELINE.md)
+    fedavg            on-device FedAvg of a stacked 2-client DistilBERT
+                      param tree vs the reference's 0.36 s host aggregation
+                      (server_terminal_output.txt:14-15)
+
 Prints exactly one JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 """
@@ -34,10 +43,39 @@ from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed
     Trainer,
 )
 
-REFERENCE_SAMPLES_PER_SEC = 40.0  # ~2.5 batch/s * bs 16 (BASELINE.md)
+REFERENCE_TRAIN_SAMPLES_PER_SEC = 40.0  # ~2.5 batch/s * bs 16 (BASELINE.md)
+REFERENCE_EVAL_SAMPLES_PER_SEC = 160.0  # ~10 batch/s * bs 16 (BASELINE.md)
+REFERENCE_FEDAVG_SECONDS = 0.36  # server_terminal_output.txt:14-15
 
 
-def main() -> None:
+def _sync(x) -> None:
+    """Host readback as the timing fence. Measured on this axon-tunneled TPU
+    backend, block_until_ready returned ~100x faster than the chip's peak
+    FLOPs allow (i.e. before completion); a scalar pull waits for the full
+    dependency chain on every backend."""
+    np.asarray(jax.tree.leaves(x)[0]).ravel()[0]
+
+
+def _emit(record: dict) -> None:
+    print(json.dumps(record))
+
+
+def _batch(model_cfg: ModelConfig, batch_size: int) -> dict:
+    rng = np.random.default_rng(0)
+    L = model_cfg.max_len
+    return {
+        k: jax.device_put(v)
+        for k, v in {
+            "input_ids": rng.integers(
+                0, model_cfg.vocab_size, (batch_size, L)
+            ).astype(np.int32),
+            "attention_mask": np.ones((batch_size, L), np.int32),
+            "labels": rng.integers(0, 2, batch_size).astype(np.int32),
+        }.items()
+    }
+
+
+def bench_train(model_cfg: ModelConfig, name: str) -> None:
     # Default batch 128: the reference trains at bs=16 (client1.py:370) but
     # per-client batch is a free TPU knob (SURVEY.md §7c) — 128 is this
     # chip's measured MFU sweet spot; vs_baseline compares samples/sec,
@@ -47,36 +85,21 @@ def main() -> None:
     steps = int(os.environ.get("BENCH_STEPS", "100"))
     warmup = int(os.environ.get("BENCH_WARMUP", "10"))
 
-    model_cfg = ModelConfig()  # DistilBERT-base, bf16 compute
     # TrainConfig defaults are the production path (incl. prng_impl="rbg"
     # dropout keys); BENCH_PRNG=threefry2x32 measures the costlier impl.
     train_cfg = TrainConfig(prng_impl=os.environ.get("BENCH_PRNG", "rbg"))
     trainer = Trainer(model_cfg, train_cfg)
     state = trainer.init_state(seed=0)
+    batch = _batch(model_cfg, batch_size)
 
-    rng = np.random.default_rng(0)
-    L = model_cfg.max_len
-    batch = {
-        "input_ids": rng.integers(0, model_cfg.vocab_size, (batch_size, L)).astype(
-            np.int32
-        ),
-        "attention_mask": np.ones((batch_size, L), np.int32),
-        "labels": rng.integers(0, 2, batch_size).astype(np.int32),
-    }
-    batch = {k: jax.device_put(v) for k, v in batch.items()}
-
-    # Sync via host readback of the loss. Measured on this axon-tunneled TPU
-    # backend, block_until_ready returned ~100x faster than the chip's peak
-    # FLOPs allow (i.e. before completion); a scalar pull waits for the full
-    # dependency chain on every backend, so it is the safe timing fence.
     for _ in range(warmup):
         state, loss = trainer.train_step(state, batch)
-    float(loss)
+    _sync(loss)
 
     t0 = time.perf_counter()
     for _ in range(steps):
         state, loss = trainer.train_step(state, batch)
-    float(loss)
+    _sync(loss)
     dt = time.perf_counter() - t0
 
     samples_per_sec = batch_size * steps / dt
@@ -92,16 +115,101 @@ def main() -> None:
     flops = train_step_flops(model_cfg, batch_size)
     util = mfu(flops, dt / steps, peak_flops_per_device=device_peak_flops())
     record = {
-        "metric": "train_samples_per_sec_distilbert_bs%d" % batch_size,
+        "metric": f"train_samples_per_sec_{name}_bs{batch_size}",
         "value": round(samples_per_sec, 2),
         "unit": "samples/sec",
-        "vs_baseline": round(samples_per_sec / REFERENCE_SAMPLES_PER_SEC, 2),
+        "vs_baseline": round(samples_per_sec / REFERENCE_TRAIN_SAMPLES_PER_SEC, 2),
         "device": jax.devices()[0].device_kind,
         "tflops_per_sec": round(flops * steps / dt / 1e12, 2),
     }
     if util is not None:
         record["mfu"] = round(util, 4)
-    print(json.dumps(record))
+    _emit(record)
+
+
+def bench_eval() -> None:
+    batch_size = int(os.environ.get("BENCH_BATCH", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "100"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "10"))
+    model_cfg = ModelConfig()
+    trainer = Trainer(model_cfg, TrainConfig())
+    state = trainer.init_state(seed=0)
+    batch = _batch(model_cfg, batch_size)
+    valid = jax.device_put(np.ones(batch_size, np.int32))
+
+    for _ in range(warmup):
+        counts, _ = trainer.eval_step(state.params, batch, valid)
+    _sync(counts)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        counts, _ = trainer.eval_step(state.params, batch, valid)
+    _sync(counts)
+    dt = time.perf_counter() - t0
+    sps = batch_size * steps / dt
+    _emit(
+        {
+            "metric": f"eval_samples_per_sec_distilbert_bs{batch_size}",
+            "value": round(sps, 2),
+            "unit": "samples/sec",
+            "vs_baseline": round(sps / REFERENCE_EVAL_SAMPLES_PER_SEC, 2),
+            "device": jax.devices()[0].device_kind,
+        }
+    )
+
+
+def bench_fedavg() -> None:
+    """On-device mean of a stacked 2-client DistilBERT param tree — the
+    round boundary the reference spends 0.36 s + two ~245 MB socket
+    transfers on (server.py:67-79)."""
+    import jax.numpy as jnp
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.models.distilbert import (
+        DDoSClassifier,
+        init_params,
+    )
+
+    steps = int(os.environ.get("BENCH_STEPS", "50"))
+    model_cfg = ModelConfig()
+    params = init_params(
+        DDoSClassifier(model_cfg), model_cfg, jax.random.key(0, impl="rbg")
+    )
+    stacked = jax.tree.map(lambda x: jnp.stack([x, x * 1.5]), params)
+
+    @jax.jit
+    def agg(t):
+        return jax.tree.map(lambda x: x.mean(axis=0), t)
+
+    out = agg(stacked)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = agg(stacked)
+    _sync(out)
+    dt = (time.perf_counter() - t0) / steps
+    _emit(
+        {
+            "metric": "fedavg_seconds_distilbert_2clients",
+            "value": round(dt, 6),
+            "unit": "seconds",
+            # Higher is better: reference seconds over ours.
+            "vs_baseline": round(REFERENCE_FEDAVG_SECONDS / dt, 2),
+            "device": jax.devices()[0].device_kind,
+        }
+    )
+
+
+def main() -> None:
+    mode = os.environ.get("BENCH_MODE", "train")
+    if mode == "train":
+        bench_train(ModelConfig(), "distilbert")
+    elif mode == "bert":
+        bench_train(ModelConfig.bert_base(), "bertbase")
+    elif mode == "eval":
+        bench_eval()
+    elif mode == "fedavg":
+        bench_fedavg()
+    else:
+        raise SystemExit(f"unknown BENCH_MODE {mode!r} (train|bert|eval|fedavg)")
 
 
 if __name__ == "__main__":

@@ -248,21 +248,37 @@ def cmd_federated(args) -> int:
     # are backend-free so their order doesn't matter.
     mesh = None
     local_sl = None
-    multihost_flags = (
-        getattr(args, "coordinator", None)
-        or getattr(args, "num_processes", None)
-        or getattr(args, "process_id", None) is not None
-        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    coord = getattr(args, "coordinator", None) or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
     )
-    if multihost_flags:
+    nproc = getattr(args, "num_processes", None)
+    if nproc is None and os.environ.get("JAX_NUM_PROCESSES"):
+        nproc = int(os.environ["JAX_NUM_PROCESSES"])
+    pid = getattr(args, "process_id", None)
+    if pid is None and os.environ.get("JAX_PROCESS_ID"):
+        pid = int(os.environ["JAX_PROCESS_ID"])
+    if nproc == 1 and not coord:
+        pass  # explicitly single-process
+    elif coord or nproc is not None or pid is not None:
+        missing = [
+            flag
+            for flag, v in (
+                ("--coordinator", coord),
+                ("--num-processes", nproc),
+                ("--process-id", pid),
+            )
+            if v is None
+        ]
+        if missing:
+            raise SystemExit(
+                f"multi-host runs need {', '.join(missing)} as well (pass "
+                "all three, or none of them on a platform where "
+                "jax.distributed autodetects)"
+            )
         from .parallel.multihost import initialize
 
-        if not initialize(args.coordinator, args.num_processes, args.process_id):
-            raise SystemExit(
-                "multi-host bootstrap failed: pass --coordinator HOST:PORT "
-                "plus --num-processes/--process-id (or run on a platform "
-                "where jax.distributed autodetects)"
-            )
+        if not initialize(coord, nproc, pid):
+            raise SystemExit("multi-host bootstrap failed")
 
     tok = default_tokenizer()
     cfg = resolve_config(args, vocab_size=len(tok.vocab))

@@ -322,16 +322,22 @@ class FederatedTrainer:
                 "(e.g. extreme Dirichlet skew) dragged the stacked size down — "
                 "drop or mask it before stacking."
             )
+        # Hosts must execute identical train-step counts (each step is a
+        # collective); bound every epoch by the global minimum batch count.
+        n_batches = stacked_train.labels.shape[1] // bs
+        if self.P > 1:
+            n_batches = int(self._allgather(n_batches).min())
         out = []
         for epoch in range(epoch_offset, epoch_offset + E):
             losses = []
-            for batch in federated_batches(
+            batches = federated_batches(
                 stacked_train,
                 bs,
                 seed=self.cfg.train.seed,
                 epoch=epoch,
                 client_offset=self.client_offset,
-            ):
+            )
+            for _, batch in zip(range(n_batches), batches):
                 state, loss = self.train_step(state, self._feed(batch))
                 losses.append(loss)
             epoch_avg = jnp.stack(losses).mean(axis=0) if losses else jnp.zeros(self.C)
@@ -355,10 +361,23 @@ class FederatedTrainer:
         Multi-host callers pass only their LOCAL clients' splits plus the
         global max split length as ``target_rows``."""
         bs = self.cfg.data.eval_batch_size if batch_size is None else batch_size
+        if target_rows is None and self.P > 1:
+            # Hosts must agree on M (the eval loop is a sequence of
+            # collectives); default to the global max split length.
+            target_rows = int(
+                self._allgather(max(len(s) for s in splits)).max()
+            )
         stacked, valid = stack_eval_splits(
             splits, bs, pad_id=self.pad_id, target_rows=target_rows
         )
         return PreparedEval(stacked, valid, bs, [s.labels.copy() for s in splits])
+
+    @staticmethod
+    def _allgather(value: int) -> np.ndarray:
+        """All processes' values of a host scalar (multi-host only)."""
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(np.int64(value)))
 
     def evaluate_clients(
         self,
