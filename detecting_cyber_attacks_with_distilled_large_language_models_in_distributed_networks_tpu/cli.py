@@ -248,37 +248,24 @@ def cmd_federated(args) -> int:
     # are backend-free so their order doesn't matter.
     mesh = None
     local_sl = None
-    coord = getattr(args, "coordinator", None) or os.environ.get(
-        "JAX_COORDINATOR_ADDRESS"
-    )
-    nproc = getattr(args, "num_processes", None)
-    if nproc is None and os.environ.get("JAX_NUM_PROCESSES"):
-        nproc = int(os.environ["JAX_NUM_PROCESSES"])
-    pid = getattr(args, "process_id", None)
-    if pid is None and os.environ.get("JAX_PROCESS_ID"):
-        pid = int(os.environ["JAX_PROCESS_ID"])
-    if nproc == 1 and not coord:
-        pass  # explicitly single-process
-    elif coord or nproc is not None or pid is not None:
-        missing = [
-            flag
-            for flag, v in (
-                ("--coordinator", coord),
-                ("--num-processes", nproc),
-                ("--process-id", pid),
-            )
-            if v is None
-        ]
-        if missing:
-            raise SystemExit(
-                f"multi-host runs need {', '.join(missing)} as well (pass "
-                "all three, or none of them on a platform where "
-                "jax.distributed autodetects)"
-            )
-        from .parallel.multihost import initialize
+    # multihost.initialize owns ALL the configuration logic (flag/env
+    # resolution, single-process no-op, TPU-pod autodetect); the CLI only
+    # converts its failures into actionable messages.
+    from .parallel.multihost import initialize
 
-        if not initialize(coord, nproc, pid):
-            raise SystemExit("multi-host bootstrap failed")
+    try:
+        initialize(
+            getattr(args, "coordinator", None),
+            getattr(args, "num_processes", None),
+            getattr(args, "process_id", None),
+        )
+    except Exception as e:
+        raise SystemExit(
+            f"multi-host bootstrap failed: {e}\n"
+            "Pass --coordinator HOST:PORT --num-processes N --process-id I "
+            "together (every process the same coordinator), or none of them "
+            "on a platform where jax.distributed autodetects."
+        )
 
     tok = default_tokenizer()
     cfg = resolve_config(args, vocab_size=len(tok.vocab))

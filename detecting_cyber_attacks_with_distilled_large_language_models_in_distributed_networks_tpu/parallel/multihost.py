@@ -59,8 +59,20 @@ def initialize(
     # initializes — any backend touch would lock in a single-process runtime.
     if jax.distributed.is_initialized():
         return jax.process_count() > 1
-    if num_processes in (None, 1) and coordinator_address is None:
-        return False
+    configured = (
+        coordinator_address is not None
+        or num_processes is not None
+        or process_id is not None
+    )
+    if not configured:
+        return False  # nothing requested: ordinary single-process run
+    if num_processes == 1 and coordinator_address is None:
+        return False  # explicitly single-process
+    # Partial configuration (e.g. a coordinator with no process id) is
+    # deliberately passed through: jax.distributed.initialize either
+    # autodetects the rest (TPU pods, Slurm) or raises its own precise
+    # error — silently falling back to single-process would mask a typo'd
+    # launch as a working run.
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -315,18 +315,21 @@ class FederatedTrainer:
         per-client average losses."""
         bs = self.cfg.data.batch_size if batch_size is None else batch_size
         E = self.cfg.train.epochs_per_round if epochs is None else epochs
-        if stacked_train.labels.shape[1] < bs:
-            raise ValueError(
-                f"common per-client train rows ({stacked_train.labels.shape[1]}) "
-                f"< batch_size ({bs}): zero batches per epoch. A tiny client "
-                "(e.g. extreme Dirichlet skew) dragged the stacked size down — "
-                "drop or mask it before stacking."
-            )
         # Hosts must execute identical train-step counts (each step is a
         # collective); bound every epoch by the global minimum batch count.
+        # The zero-batch check runs AFTER the allgather so an undersized
+        # host raises on every process instead of deadlocking the others
+        # inside the collective.
         n_batches = stacked_train.labels.shape[1] // bs
         if self.P > 1:
             n_batches = int(self._allgather(n_batches).min())
+        if n_batches == 0:
+            raise ValueError(
+                f"common per-client train rows ({stacked_train.labels.shape[1]}) "
+                f"< batch_size ({bs}) on at least one host: zero batches per "
+                "epoch. A tiny client (e.g. extreme Dirichlet skew) dragged "
+                "the stacked size down — drop or mask it before stacking."
+            )
         out = []
         for epoch in range(epoch_offset, epoch_offset + E):
             losses = []

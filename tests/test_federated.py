@@ -160,6 +160,37 @@ def test_more_clients_than_mesh_axis(tok, eight_devices):
     assert len(metrics) == 4
 
 
+def test_sixty_four_client_fleet(tok, eight_devices):
+    """BASELINE.json config 5 scale: a 64-client FedAvg fleet (8 replicas
+    per mesh shard on the 8-row virtual mesh) trains a round and aggregates
+    to identical replicas."""
+    df = make_synthetic_flows(3200, seed=23)
+    dcfg = DataConfig(
+        data_fraction=1.0 / 64, max_len=MAX_LEN, partition="disjoint"
+    )
+    splits = make_all_client_splits(df, 64, dcfg)
+    clients = [tokenize_client(s, tok, max_len=MAX_LEN) for s in splits]
+    stacked_train = stack_clients([c.train for c in clients])
+    cfg = ExperimentConfig(
+        model=ModelConfig.tiny(vocab_size=len(tok), max_len=MAX_LEN,
+                               max_position_embeddings=MAX_LEN),
+        data=DataConfig(data_fraction=1.0 / 64, max_len=MAX_LEN, batch_size=8),
+        train=TrainConfig(learning_rate=1e-3, epochs_per_round=1),
+        fed=FedConfig(num_clients=64),
+        mesh=MeshConfig(clients=8, data=1),
+    )
+    trainer = FederatedTrainer(cfg, pad_id=tok.pad_id)
+    state = trainer.init_state()
+    state, losses = trainer.fit_local(state, stacked_train, epochs=1)
+    assert losses.shape == (1, 64)
+    state = trainer.aggregate(state)
+    leaf = np.asarray(jax.tree.leaves(state.params)[0])
+    for c in range(1, 64):
+        np.testing.assert_allclose(leaf[c], leaf[0], rtol=1e-6)
+    metrics = trainer.evaluate_clients(state.params, [c.val for c in clients])
+    assert len(metrics) == 64
+
+
 def test_unequal_eval_sizes_loss_not_diluted(tok, fed_data, eight_devices):
     """All-padding batches (stacking a small client's eval split up to a big
     client's) must not dilute the reported Loss."""
