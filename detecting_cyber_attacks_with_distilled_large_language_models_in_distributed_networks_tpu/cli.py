@@ -117,6 +117,72 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
     return cfg
 
 
+# --------------------------------------------------------------- pretrained
+def _resolve_with_pretrained(args):
+    """(tokenizer, resolved config, initial params or None).
+
+    With ``--hf-dir`` (the reference's required ``./distilbert-base-uncased``
+    directory, client1.py:357,360-361): vocab from its ``vocab.txt``,
+    architecture from its ``config.json``, initial encoder weights from its
+    checkpoint (fresh head, as at reference client1.py:58). Without it:
+    the domain tokenizer and random init.
+    """
+    hf_dir = getattr(args, "hf_dir", None)
+    if not hf_dir:
+        from .data import default_tokenizer
+
+        tok = default_tokenizer()
+        return tok, resolve_config(args, vocab_size=len(tok.vocab)), None
+
+    import copy
+
+    from .data import WordPieceTokenizer
+    from .models.hf_convert import config_from_hf_dir, load_hf_dir
+
+    tok = WordPieceTokenizer.from_vocab_file(os.path.join(hf_dir, "vocab.txt"))
+    # Resolve WITHOUT --max-len: the preset model this produces is discarded
+    # below, and validating the flag against its (irrelevant) position table
+    # would reject lengths the checkpoint actually supports.
+    args_sans_len = copy.copy(args)
+    args_sans_len.max_len = None
+    cfg = resolve_config(args_sans_len, vocab_size=len(tok.vocab))
+    # Architecture comes from the checkpoint; every non-architecture knob
+    # (dtypes, dropouts, attention impl, head size) carries over from the
+    # resolved config so --config files keep working under --hf-dir.
+    # Sequence length defaults to min(128, the checkpoint's position table)
+    # — the reference's 128 (client1.py:27) — unless --max-len says else.
+    m = cfg.model
+    overrides: dict[str, Any] = dict(
+        dropout=m.dropout,
+        attention_dropout=m.attention_dropout,
+        head_dropout=m.head_dropout,
+        n_classes=m.n_classes,
+        compute_dtype=m.compute_dtype,
+        param_dtype=m.param_dtype,
+        attention_impl=m.attention_impl,
+        ring_axis=m.ring_axis,
+        remat=m.remat,
+    )
+    if getattr(args, "max_len", None):
+        overrides["max_len"] = args.max_len
+    model_cfg = config_from_hf_dir(hf_dir, **overrides)
+    if len(tok.vocab) != model_cfg.vocab_size:
+        raise SystemExit(
+            f"--hf-dir vocab.txt has {len(tok.vocab)} entries but config.json "
+            f"says vocab_size={model_cfg.vocab_size}"
+        )
+    cfg = dataclasses.replace(
+        cfg,
+        model=model_cfg,
+        data=dataclasses.replace(cfg.data, max_len=model_cfg.max_len),
+    )
+    with phase(f"loading HF checkpoint {hf_dir}", tag="MODEL"):
+        params, _ = load_hf_dir(
+            hf_dir, cfg=model_cfg, head_rng=np.random.default_rng(cfg.train.seed)
+        )
+    return tok, cfg, params
+
+
 # -------------------------------------------------------------------- data
 def _load_client_splits(args, cfg: ExperimentConfig, num_clients: int):
     """CSV / mixed corpus / synthetic -> per-client text splits (host-side
@@ -200,14 +266,12 @@ def _write_reports(
 
 # ---------------------------------------------------------------- commands
 def cmd_local(args) -> int:
-    from .data import default_tokenizer
     from .train.engine import Trainer
 
-    tok = default_tokenizer()
-    cfg = resolve_config(args, vocab_size=len(tok.vocab))
+    tok, cfg, pretrained = _resolve_with_pretrained(args)
     client = _load_clients(args, cfg, tok, max(args.client_id + 1, 1))[args.client_id]
     trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
-    state = trainer.init_state()
+    state = trainer.init_state(params=pretrained)
     from .utils.profiling import trace
 
     with phase(f"client {args.client_id} local training", tag="TRAIN"), trace(
@@ -240,7 +304,7 @@ def cmd_local(args) -> int:
 def cmd_federated(args) -> int:
     import jax
 
-    from .data import default_tokenizer, stack_clients, tokenize_client
+    from .data import stack_clients, tokenize_client
     from .train.federated import FederatedTrainer
 
     # Multi-host bootstrap must precede the first backend touch
@@ -267,8 +331,7 @@ def cmd_federated(args) -> int:
             "on a platform where jax.distributed autodetects."
         )
 
-    tok = default_tokenizer()
-    cfg = resolve_config(args, vocab_size=len(tok.vocab))
+    tok, cfg, pretrained = _resolve_with_pretrained(args)
     C = cfg.fed.num_clients
     if jax.process_count() > 1:
         from .parallel.multihost import local_client_slice, make_global_mesh
@@ -306,7 +369,7 @@ def cmd_federated(args) -> int:
 
     ckpt = None
     start_round = 0
-    state = trainer.init_state()
+    state = trainer.init_state(params=pretrained)
     if cfg.checkpoint_dir and local_sl is None:
         from .train.checkpoint import Checkpointer, maybe_warm_start
 
@@ -396,14 +459,12 @@ def cmd_client(args) -> int:
     TCP -> load aggregate -> re-eval -> CSVs + plots; degrades to local-only
     reports when the exchange fails (client1.py:405-410)."""
     from .comm import FederatedClient
-    from .data import default_tokenizer
     from .train.engine import Trainer
 
-    tok = default_tokenizer()
-    cfg = resolve_config(args, vocab_size=len(tok.vocab))
+    tok, cfg, pretrained = _resolve_with_pretrained(args)
     client_data = _load_clients(args, cfg, tok, cfg.fed.num_clients)[args.client_id]
     trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
-    state = trainer.init_state()
+    state = trainer.init_state(params=pretrained)
     with phase(f"client {args.client_id} local training", tag="TRAIN"):
         state, _ = trainer.fit(
             state, client_data.train, batch_size=cfg.data.batch_size,
@@ -538,6 +599,12 @@ def cmd_export_config(args) -> int:
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--config", help="JSON config file (ExperimentConfig.to_dict shape)")
     p.add_argument("--preset", default="tiny", help="tiny|distilbert|bert")
+    p.add_argument(
+        "--hf-dir",
+        help="HF DistilBERT checkpoint dir (config.json + vocab.txt + "
+        "model.safetensors|pytorch_model.bin) — the reference's required "
+        "./distilbert-base-uncased; pretrained encoder + fresh head",
+    )
     p.add_argument("--csv", help="flow CSV path (schema set by --dataset)")
     p.add_argument(
         "--dataset",

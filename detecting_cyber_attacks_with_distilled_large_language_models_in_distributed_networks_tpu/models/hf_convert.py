@@ -104,6 +104,66 @@ def hf_to_flax(
     return {"encoder": encoder, "classifier": head}
 
 
+def config_from_hf_dir(path: str, **overrides: Any) -> ModelConfig:
+    """``config.json`` of an HF DistilBERT checkpoint dir -> ModelConfig.
+
+    The reference hard-requires such a directory at startup
+    (``./distilbert-base-uncased``, client1.py:357,360-361). Architecture
+    fields come from the checkpoint; training-side knobs (max_len, attention
+    impl, dtypes, dropout rates) stay at our defaults unless overridden.
+    """
+    import json
+    import os
+
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    kw: dict[str, Any] = dict(
+        vocab_size=hf["vocab_size"],
+        dim=hf["dim"],
+        n_layers=hf["n_layers"],
+        n_heads=hf["n_heads"],
+        hidden_dim=hf["hidden_dim"],
+        max_position_embeddings=hf.get("max_position_embeddings", 512),
+        pad_token_id=hf.get("pad_token_id", 0),
+        initializer_range=hf.get("initializer_range", 0.02),
+    )
+    kw.update(overrides)
+    kw.setdefault("max_len", min(128, kw["max_position_embeddings"]))
+    return ModelConfig(**kw)
+
+
+def load_hf_dir(
+    path: str,
+    cfg: ModelConfig | None = None,
+    head_rng: np.random.Generator | None = None,
+) -> tuple[dict, ModelConfig]:
+    """Load an HF DistilBERT checkpoint directory (the reference's
+    ``./distilbert-base-uncased`` layout: ``config.json`` + weights in
+    ``model.safetensors`` or ``pytorch_model.bin``) into Flax params.
+
+    Returns ``(params, model_config)``; pass ``cfg`` to pin non-architecture
+    knobs (attention impl, max_len, dtypes)."""
+    import os
+
+    if cfg is None:
+        cfg = config_from_hf_dir(path)
+    st_path = os.path.join(path, "model.safetensors")
+    bin_path = os.path.join(path, "pytorch_model.bin")
+    if os.path.exists(st_path):
+        from safetensors.numpy import load_file
+
+        sd: Mapping[str, Any] = load_file(st_path)
+    elif os.path.exists(bin_path):
+        import torch
+
+        sd = torch.load(bin_path, map_location="cpu", weights_only=True)
+    else:
+        raise FileNotFoundError(
+            f"no model.safetensors or pytorch_model.bin under {path}"
+        )
+    return hf_to_flax(sd, cfg, head_rng=head_rng), cfg
+
+
 def flax_to_hf(params: Mapping[str, Any], cfg: ModelConfig) -> dict[str, np.ndarray]:
     """Inverse mapping, producing the reference's full-classifier key space
     (``distilbert.*`` + ``classifier.*``) as numpy arrays — e.g. to export a

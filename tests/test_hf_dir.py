@@ -1,0 +1,126 @@
+"""--hf-dir: training from a pretrained HF DistilBERT checkpoint directory
+(the reference's hard-required ./distilbert-base-uncased, client1.py:357,
+360-364)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.models.hf_convert import (
+    config_from_hf_dir,
+    load_hf_dir,
+)
+
+transformers = pytest.importorskip("transformers")
+
+DIM, LAYERS, HEADS, FFN, VOCAB = 48, 2, 4, 96, 160
+
+
+@pytest.fixture(scope="module")
+def hf_dir(tmp_path_factory):
+    """A real save_pretrained checkpoint dir + BERT-style vocab.txt."""
+    path = tmp_path_factory.mktemp("hf") / "distilbert-tiny"
+    cfg = transformers.DistilBertConfig(
+        vocab_size=VOCAB, dim=DIM, n_layers=LAYERS, n_heads=HEADS,
+        hidden_dim=FFN, max_position_embeddings=128,
+    )
+    model = transformers.DistilBertModel(cfg)
+    model.save_pretrained(str(path))
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.tokenizer import (
+        build_domain_vocab,
+    )
+
+    vocab = build_domain_vocab()[:VOCAB]
+    vocab += [f"[unused{i}]" for i in range(VOCAB - len(vocab))]
+    assert len(vocab) == VOCAB
+    with open(path / "vocab.txt", "w") as f:
+        f.write("\n".join(vocab) + "\n")
+    return str(path)
+
+
+def test_config_from_hf_dir(hf_dir):
+    cfg = config_from_hf_dir(hf_dir)
+    assert (cfg.dim, cfg.n_layers, cfg.n_heads, cfg.hidden_dim) == (
+        DIM, LAYERS, HEADS, FFN,
+    )
+    assert cfg.vocab_size == VOCAB
+    assert cfg.max_len <= cfg.max_position_embeddings
+
+
+def test_load_hf_dir_params_match_checkpoint(hf_dir):
+    params, cfg = load_hf_dir(hf_dir)
+    model = transformers.DistilBertModel.from_pretrained(hf_dir)
+    want = model.state_dict()["embeddings.word_embeddings.weight"].numpy()
+    np.testing.assert_allclose(
+        np.asarray(params["encoder"]["embeddings"]["word_embeddings"]["embedding"]),
+        want,
+        rtol=1e-6,
+    )
+    # Fresh head (the checkpoint is a bare encoder, reference client1.py:58).
+    assert params["classifier"]["kernel"].shape == (DIM, 2)
+
+
+def test_load_hf_dir_missing_weights(tmp_path):
+    (tmp_path / "config.json").write_text(json.dumps({
+        "vocab_size": 16, "dim": 8, "n_layers": 1, "n_heads": 2,
+        "hidden_dim": 16,
+    }))
+    with pytest.raises(FileNotFoundError, match="model.safetensors"):
+        load_hf_dir(str(tmp_path))
+
+
+def test_cli_local_from_hf_dir(hf_dir, tmp_path, monkeypatch):
+    """End-to-end: fedtpu local --hf-dir trains from the pretrained encoder
+    and writes the reference artifact set."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
+        main,
+    )
+
+    monkeypatch.chdir(tmp_path)
+    out = tmp_path / "out"
+    rc = main([
+        "local", "--hf-dir", hf_dir, "--synthetic", "300",
+        "--data-fraction", "0.8", "--epochs", "1", "--batch-size", "8",
+        "--max-len", "48", "--learning-rate", "1e-3",
+        "--output-dir", str(out),
+    ])
+    assert rc == 0
+    assert (out / "client0_local_metrics.csv").exists()
+
+
+def test_hf_dir_max_len_validated_against_checkpoint_not_preset(hf_dir):
+    """--max-len beyond the (discarded) tiny preset's 64-entry position
+    table but within the checkpoint's must resolve, with config-file model
+    knobs carried over rather than reset."""
+    import argparse
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
+        _resolve_with_pretrained,
+    )
+
+    args = argparse.Namespace(hf_dir=hf_dir, max_len=128, preset="tiny")
+    tok, cfg, params = _resolve_with_pretrained(args)
+    assert cfg.model.max_len == 128  # > tiny's table (64), <= checkpoint's
+    assert cfg.model.dim == DIM
+    assert cfg.data.max_len == 128
+    # Non-architecture knobs survive from the resolved (preset) config.
+    assert cfg.model.compute_dtype == "float32"  # tiny preset's dtype
+    assert params is not None
+
+
+def test_cli_hf_dir_vocab_mismatch(hf_dir, tmp_path):
+    import shutil
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
+        main,
+    )
+
+    bad = tmp_path / "bad"
+    shutil.copytree(hf_dir, bad)
+    with open(bad / "vocab.txt", "a") as f:
+        f.write("extratoken\n")
+    with pytest.raises(SystemExit, match="vocab"):
+        main(["local", "--hf-dir", str(bad), "--synthetic", "50"])
