@@ -331,6 +331,20 @@ def cmd_federated(args) -> int:
             "on a platform where jax.distributed autodetects."
         )
 
+    # Fail fast on an unfittable data axis — knowable from argv + device
+    # count alone, before any (potentially large) HF checkpoint load.
+    # Client-axis fitting itself lives in FederatedTrainer (replica
+    # stacking), serving library callers too.
+    if (
+        jax.process_count() == 1
+        and getattr(args, "data_parallel", None)
+        and args.data_parallel > len(jax.devices())
+    ):
+        raise SystemExit(
+            f"--data-parallel {args.data_parallel} exceeds the "
+            f"{len(jax.devices())} available devices"
+        )
+
     tok, cfg, pretrained = _resolve_with_pretrained(args)
     C = cfg.fed.num_clients
     if jax.process_count() > 1:

@@ -45,6 +45,26 @@ def make_mesh(
     return Mesh(grid, axis_names)
 
 
+def fit_clients_axis(num_clients: int, data: int, n_devices: int) -> int:
+    """Largest clients-axis size that (a) divides the logical client count
+    (several replicas may stack per mesh row) and (b) fits the hardware
+    alongside the ``data`` axis. Raises when even one row doesn't fit."""
+    rows = max(
+        (
+            r
+            for r in range(1, num_clients + 1)
+            if num_clients % r == 0 and r * data <= n_devices
+        ),
+        default=None,
+    )
+    if rows is None:
+        raise ValueError(
+            f"mesh data axis {data} alone exceeds the {n_devices} available "
+            "devices"
+        )
+    return rows
+
+
 @dataclass(frozen=True)
 class FedShardings:
     """The three shardings federated training needs."""

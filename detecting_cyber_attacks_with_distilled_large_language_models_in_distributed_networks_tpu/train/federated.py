@@ -155,9 +155,27 @@ class FederatedTrainer:
         # process then feeds only its own client rows. Single process is the
         # degenerate case of the same code path.
         self.P = jax.process_count()
-        self.mesh = mesh if mesh is not None else make_mesh(
-            cfg.mesh.clients, cfg.mesh.data, axis_names=cfg.mesh.axis_names
-        )
+        if mesh is not None:
+            self.mesh = mesh
+        else:
+            rows = cfg.mesh.clients
+            n_dev = len(jax.devices())
+            if self.P == 1 and rows * cfg.mesh.data > n_dev:
+                # Fit the mesh to the hardware: stack several logical client
+                # replicas per row rather than refusing to run (tested up to
+                # 64 logical clients on 8 rows).
+                from ..parallel.mesh import fit_clients_axis
+
+                rows = fit_clients_axis(self.C, cfg.mesh.data, n_dev)
+                log.info(
+                    f"[FED] {self.C} clients on {n_dev} device(s): mesh "
+                    f"{cfg.mesh.clients}x{cfg.mesh.data} -> "
+                    f"{rows}x{cfg.mesh.data} "
+                    f"({self.C // rows} client replicas per row)"
+                )
+            self.mesh = make_mesh(
+                rows, cfg.mesh.data, axis_names=cfg.mesh.axis_names
+            )
         if self.P > 1:
             from ..parallel.multihost import local_client_slice
 
