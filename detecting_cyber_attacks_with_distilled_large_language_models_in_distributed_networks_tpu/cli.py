@@ -384,16 +384,22 @@ def cmd_federated(args) -> int:
     ckpt = None
     start_round = 0
     state = trainer.init_state(params=pretrained)
-    if cfg.checkpoint_dir and local_sl is None:
+    if cfg.checkpoint_dir:
+        # Works multi-host too: every process participates in save/restore
+        # (orbax coordinates through the jax.distributed runtime; the state
+        # template carries the global shardings).
         from .train.checkpoint import Checkpointer, maybe_warm_start
 
         restored, step = maybe_warm_start(cfg.checkpoint_dir, state)
         if restored is not None:
             state, start_round = restored, int(step)
             log.info(f"[FED] resumed from round {start_round}")
+            # Checkpoints are written BEFORE the per-round optimizer reset
+            # (cmd loop below); apply the reset a continuous run would have
+            # done so the resumed trajectory matches it exactly.
+            if start_round < cfg.fed.rounds and cfg.fed.reset_optimizer_each_round:
+                state = trainer.reset_optimizer(state)
         ckpt = Checkpointer(cfg.checkpoint_dir)
-    elif cfg.checkpoint_dir:
-        log.info("[FED] multi-host checkpointing not wired yet; skipping")
 
     # FedAvg weights are the GLOBAL per-client sample counts (known from the
     # cheap split phase on every host, reference semantics: weight by data).
@@ -441,13 +447,27 @@ def cmd_federated(args) -> int:
         state.params, prepared=prepared, collect_probs=not multihost
     )
     if not multihost or jax.process_index() == 0:
-        for c in range(C):
-            _write_reports(
-                c,
-                final_local[c] if final_local else final_agg[c],
-                final_agg[c],
-                cfg.output_dir,
+        if final_local is None:
+            # No round trained this launch (e.g. relaunching a completed
+            # checkpointed run): there ARE no local-model metrics — write
+            # aggregated artifacts only rather than mislabeling.
+            log.info(
+                "[FED] all rounds already complete; writing aggregated "
+                "reports only"
             )
+        for c in range(C):
+            if final_local is None:
+                from . import reporting
+
+                os.makedirs(cfg.output_dir, exist_ok=True)
+                reporting.save_metrics(
+                    final_agg[c],
+                    os.path.join(
+                        cfg.output_dir, f"client{c}_aggregated_metrics.csv"
+                    ),
+                )
+            else:
+                _write_reports(c, final_local[c], final_agg[c], cfg.output_dir)
     return 0
 
 

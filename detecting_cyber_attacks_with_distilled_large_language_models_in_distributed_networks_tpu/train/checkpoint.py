@@ -152,12 +152,29 @@ def maybe_warm_start(directory: str, template: Any) -> tuple[Any | None, int | N
     and the reference likewise proceeds from scratch when its ``.pth`` is
     absent.
     """
-    if not os.path.isdir(directory):
+    P = jax.process_count()
+
+    def _agree_min(value: int) -> int:
+        """Collective minimum of a host int — every warm-start decision must
+        be identical on all processes, else their orbax barrier sequences
+        diverge (observed as sync_global_devices name mismatches when one
+        process saw the directory the other's Checkpointer just created)."""
+        if P == 1:
+            return value
+        from jax.experimental import multihost_utils
+
+        return int(
+            np.asarray(multihost_utils.process_allgather(np.int64(value))).min()
+        )
+
+    if not _agree_min(int(os.path.isdir(directory))):
         return None, None
     with Checkpointer(directory) as ckpt:
         step = ckpt.latest_step()
-        if step is None:
+        step_agreed = _agree_min(-1 if step is None else int(step))
+        if step_agreed < 0:
             return None, None
+        step = step_agreed
         try:
             return ckpt.restore(template, step=step), step
         except Exception as e:  # orbax raises backend-specific error types

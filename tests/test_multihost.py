@@ -57,6 +57,7 @@ _WORKER = """
 import sys, os
 sys.path.insert(0, {repo!r})
 pid = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
+extra = sys.argv[4:]
 import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 2)
@@ -70,22 +71,20 @@ rc = main([
     "--synthetic", "320", "--data-fraction", "0.5", "--partition", "disjoint",
     "--batch-size", "8", "--max-len", "32",
     "--output-dir", out,
+    *extra,
 ])
 print(f"proc {{pid}} rc {{rc}}", flush=True)
 sys.exit(rc)
 """
 
 
-def test_two_process_federated_cli(tmp_path):
-    """Full multi-host flow through the CLI: bootstrap, global mesh, each
-    process feeding its own client, FedAvg over DCN, process 0 reporting."""
+def _launch_pair(tmp_path, out, extra=()):
     port = _free_port()
     script = tmp_path / "worker.py"
     script.write_text(_WORKER.format(repo=REPO))
-    out = tmp_path / "out"
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(i), str(port), str(out)],
+            [sys.executable, str(script), str(i), str(port), str(out), *extra],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -102,6 +101,14 @@ def test_two_process_federated_cli(tmp_path):
             p.kill()
     for i, (p, o) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"proc {i} failed:\n{o[-3000:]}"
+    return outputs
+
+
+def test_two_process_federated_cli(tmp_path):
+    """Full multi-host flow through the CLI: bootstrap, global mesh, each
+    process feeding its own client, FedAvg over DCN, process 0 reporting."""
+    out = tmp_path / "out"
+    outputs = _launch_pair(tmp_path, out)
     # Process 0 wrote the full fleet's reports.
     for c in range(2):
         assert (out / f"client{c}_aggregated_metrics.csv").exists(), outputs[0][-2000:]
@@ -112,6 +119,25 @@ def test_two_process_federated_cli(tmp_path):
     assert _fed_lines(outputs[0]) and (
         _fed_lines(outputs[0]) == _fed_lines(outputs[1])
     )
+
+
+def test_two_process_checkpoint_resume(tmp_path):
+    """Multi-host checkpoint/resume: round 1 saves a sharded checkpoint
+    (every process participates); a fresh launch resumes from it instead of
+    retraining round 1."""
+    out = tmp_path / "out"
+    ckpt = tmp_path / "ckpt"
+    _launch_pair(tmp_path, out, ("--checkpoint-dir", str(ckpt)))
+    assert any(ckpt.iterdir()), "no checkpoint written"
+
+    out2 = tmp_path / "out2"
+    outputs = _launch_pair(tmp_path, out2, ("--checkpoint-dir", str(ckpt)))
+    for o in outputs:
+        assert "resumed from round 1" in o, o[-2000:]
+    # A fully-resumed run trained nothing: aggregated reports only, no
+    # fabricated local-model CSVs.
+    assert (out2 / "client0_aggregated_metrics.csv").exists()
+    assert not (out2 / "client0_local_metrics.csv").exists()
 
 
 def _free_port() -> int:
