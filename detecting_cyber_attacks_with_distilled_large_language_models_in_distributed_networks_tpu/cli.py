@@ -105,6 +105,11 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
                 num_clients=n,
                 rounds=getattr(args, "rounds", None) or cfg.fed.rounds,
                 weighted=bool(getattr(args, "weighted", False)) or cfg.fed.weighted,
+                prox_mu=(
+                    cfg.fed.prox_mu
+                    if getattr(args, "prox_mu", None) is None
+                    else args.prox_mu
+                ),
             ),
             mesh=MeshConfig(
                 clients=n, data=getattr(args, "data_parallel", None) or cfg.mesh.data
@@ -451,22 +456,22 @@ def cmd_federated(args) -> int:
             # No round trained this launch (e.g. relaunching a completed
             # checkpointed run): there ARE no local-model metrics — write
             # aggregated artifacts only rather than mislabeling.
+            from . import reporting
+
             log.info(
                 "[FED] all rounds already complete; writing aggregated "
                 "reports only"
             )
-        for c in range(C):
-            if final_local is None:
-                from . import reporting
-
-                os.makedirs(cfg.output_dir, exist_ok=True)
+            os.makedirs(cfg.output_dir, exist_ok=True)
+            for c in range(C):
                 reporting.save_metrics(
                     final_agg[c],
                     os.path.join(
                         cfg.output_dir, f"client{c}_aggregated_metrics.csv"
                     ),
                 )
-            else:
+        else:
+            for c in range(C):
                 _write_reports(c, final_local[c], final_agg[c], cfg.output_dir)
     return 0
 
@@ -687,6 +692,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-parallel", type=int, help="per-client data-parallel shards")
     p.add_argument("--weighted", action="store_true", help="weight FedAvg by sample count")
     p.add_argument("--partition", help="sample|disjoint|dirichlet")
+    p.add_argument(
+        "--prox-mu",
+        type=float,
+        help="FedProx proximal weight (0 = plain FedAvg); stabilizes "
+        "non-IID partitions",
+    )
     p.add_argument("--checkpoint-dir")
     p.add_argument(
         "--coordinator",

@@ -179,6 +179,10 @@ class FedConfig:
     num_clients: int = 2
     rounds: int = 1
     weighted: bool = False
+    # FedProx (Li et al.): local loss += mu/2 * ||w - w_round_start||^2,
+    # anchoring client drift under non-IID partitions (the dirichlet knob,
+    # BASELINE.json config 3). 0 = plain FedAvg, the reference's algorithm.
+    prox_mu: float = 0.0
     # Minimum fraction of clients that must survive a round for aggregation
     # to proceed (masked mean over survivors); reference requires all.
     min_client_fraction: float = 1.0

@@ -156,6 +156,19 @@ def global_batch(
     return out
 
 
+def allgather_hosts(value: int) -> np.ndarray:
+    """Every process's value of a host int scalar, as a numpy array.
+
+    THE primitive for cross-host agreement (batch counts, eval row counts,
+    warm-start decisions): every process must call it at the same program
+    point. Single-process: the value alone, no collective."""
+    if jax.process_count() == 1:
+        return np.asarray([value], np.int64)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(np.int64(value)))
+
+
 def global_array_from_replicated(
     sharding: NamedSharding, value: np.ndarray
 ) -> jax.Array:

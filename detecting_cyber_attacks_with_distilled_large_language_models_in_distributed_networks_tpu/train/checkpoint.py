@@ -152,20 +152,14 @@ def maybe_warm_start(directory: str, template: Any) -> tuple[Any | None, int | N
     and the reference likewise proceeds from scratch when its ``.pth`` is
     absent.
     """
-    P = jax.process_count()
+    from ..parallel.multihost import allgather_hosts
 
     def _agree_min(value: int) -> int:
         """Collective minimum of a host int — every warm-start decision must
         be identical on all processes, else their orbax barrier sequences
         diverge (observed as sync_global_devices name mismatches when one
         process saw the directory the other's Checkpointer just created)."""
-        if P == 1:
-            return value
-        from jax.experimental import multihost_utils
-
-        return int(
-            np.asarray(multihost_utils.process_allgather(np.int64(value))).min()
-        )
+        return int(allgather_hosts(value).min())
 
     if not _agree_min(int(os.path.isdir(directory))):
         return None, None
@@ -176,12 +170,18 @@ def maybe_warm_start(directory: str, template: Any) -> tuple[Any | None, int | N
             return None, None
         step = step_agreed
         try:
-            return ckpt.restore(template, step=step), step
+            restored: Any | None = ckpt.restore(template, step=step)
         except Exception as e:  # orbax raises backend-specific error types
             from ..utils.logging import get_logger
 
             get_logger().warning(
-                f"checkpoint at {directory} (step {step}) is incompatible with "
-                f"the current config ({type(e).__name__}: {e}); starting fresh"
+                f"checkpoint at {directory} (step {step}) failed to restore "
+                f"({type(e).__name__}: {e}); starting fresh"
             )
+            restored = None
+        # The outcome must be agreed too: if any process failed to restore,
+        # every process starts fresh — a split decision would desync the
+        # collective training loops.
+        if not _agree_min(int(restored is not None)):
             return None, None
+        return restored, step

@@ -194,37 +194,60 @@ class FederatedTrainer:
     def _build_steps(self) -> None:
         model, optimizer = self.model, self.optimizer
         csh, bsh = self.sh.client, self.sh.batch
+        mu = float(self.cfg.fed.prox_mu)
 
-        def per_client_step(params, opt_state, batch, rng):
+        def local_loss(p, batch, rng, anchor):
+            loss = loss_fn(model, p, batch, rng)
+            if mu > 0.0:
+                # FedProx proximal term vs the round-start globals —
+                # trace-time constant, zero cost at mu=0 (plain FedAvg).
+                sq = sum(
+                    jnp.sum(jnp.square(a - b))
+                    for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(anchor))
+                )
+                loss = loss + 0.5 * mu * sq
+            return loss
+
+        def per_client_step(params, opt_state, batch, rng, anchor):
             loss, grads = jax.value_and_grad(
-                lambda p: loss_fn(model, p, batch, rng)
+                lambda p: local_loss(p, batch, rng, anchor)
             )(params)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
 
-        @partial(
-            jax.jit,
-            donate_argnums=(0,),
-            in_shardings=(
-                FedState(csh, csh, self.sh.replicated, csh),
-                {"input_ids": bsh, "attention_mask": bsh, "labels": bsh},
-            ),
-            out_shardings=(
-                FedState(csh, csh, self.sh.replicated, csh),
-                csh,
-            ),
-        )
-        def train_step(state: FedState, batch) -> tuple[FedState, jnp.ndarray]:
+        state_sh = FedState(csh, csh, self.sh.replicated, csh)
+        batch_sh = {"input_ids": bsh, "attention_mask": bsh, "labels": bsh}
+
+        def _step_body(state: FedState, batch, anchor):
             step_rngs = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
                 state.rngs, state.step
             )
-            params, opt_state, losses = jax.vmap(per_client_step)(
-                state.params, state.opt_state, batch, step_rngs
-            )
+            params, opt_state, losses = jax.vmap(
+                per_client_step, in_axes=(0, 0, 0, 0, 0 if mu > 0.0 else None)
+            )(state.params, state.opt_state, batch, step_rngs, anchor)
             return (
                 FedState(params, opt_state, state.step + 1, state.rngs),
                 losses,  # [C]
             )
+
+        if mu > 0.0:
+            # FedProx signature: (state, batch, anchor). The anchor is the
+            # stacked round-start params — a separate buffer, NOT the
+            # donated state.params.
+            train_step = partial(
+                jax.jit,
+                donate_argnums=(0,),
+                in_shardings=(state_sh, batch_sh, csh),
+                out_shardings=(state_sh, csh),
+            )(_step_body)
+        else:
+            # Plain FedAvg signature: (state, batch) — no anchor transfer.
+            train_step = partial(
+                jax.jit,
+                donate_argnums=(0,),
+                in_shardings=(state_sh, batch_sh),
+                out_shardings=(state_sh, csh),
+            )(lambda state, batch: _step_body(state, batch, None))
 
         @partial(
             jax.jit,
@@ -348,6 +371,13 @@ class FederatedTrainer:
                 "epoch. A tiny client (e.g. extreme Dirichlet skew) dragged "
                 "the stacked size down — drop or mask it before stacking."
             )
+        if self.cfg.fed.prox_mu > 0.0:
+            # FedProx anchor: the round-start params, copied so the donated
+            # state buffers never alias it.
+            anchor = jax.tree.map(jnp.copy, state.params)
+            step = lambda s, b: self.train_step(s, b, anchor)  # noqa: E731
+        else:
+            step = self.train_step
         out = []
         for epoch in range(epoch_offset, epoch_offset + E):
             losses = []
@@ -359,7 +389,7 @@ class FederatedTrainer:
                 client_offset=self.client_offset,
             )
             for _, batch in zip(range(n_batches), batches):
-                state, loss = self.train_step(state, self._feed(batch))
+                state, loss = step(state, self._feed(batch))
                 losses.append(loss)
             epoch_avg = jnp.stack(losses).mean(axis=0) if losses else jnp.zeros(self.C)
             out.append(self._host(epoch_avg))
@@ -395,10 +425,9 @@ class FederatedTrainer:
 
     @staticmethod
     def _allgather(value: int) -> np.ndarray:
-        """All processes' values of a host scalar (multi-host only)."""
-        from jax.experimental import multihost_utils
+        from ..parallel.multihost import allgather_hosts
 
-        return np.asarray(multihost_utils.process_allgather(np.int64(value)))
+        return allgather_hosts(value)
 
     def evaluate_clients(
         self,

@@ -233,6 +233,29 @@ def test_tiny_client_rejected_with_clear_error(tok, eight_devices):
         trainer.fit_local(state, tiny)
 
 
+def test_fedprox_bounds_client_drift(tok, fed_data, eight_devices):
+    """FedProx (FedConfig.prox_mu): a strong proximal term must keep local
+    params closer to the round-start globals than plain FedAvg does, with
+    mu=0 preserving the plain (state, batch) step signature."""
+    clients, stacked_train = fed_data
+
+    def drift(mu):
+        cfg = _cfg(tok, clients=2, data=1, prox_mu=mu)
+        trainer = FederatedTrainer(cfg, pad_id=tok.pad_id)
+        state = trainer.init_state(seed=0)
+        start = jax.tree.map(lambda x: np.asarray(x).copy(), state.params)
+        state, _ = trainer.fit_local(state, stacked_train, epochs=1)
+        sq = sum(
+            float(np.sum((np.asarray(a) - b) ** 2))
+            for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(start))
+        )
+        return sq
+
+    free = drift(0.0)
+    anchored = drift(50.0)
+    assert anchored < free * 0.5, (anchored, free)
+
+
 def test_masked_aggregation_and_min_fraction(tok, eight_devices):
     cfg = _cfg(tok, clients=4, min_client_fraction=0.5)
     trainer = FederatedTrainer(cfg, pad_id=tok.pad_id)
