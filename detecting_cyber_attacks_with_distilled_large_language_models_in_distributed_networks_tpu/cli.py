@@ -91,6 +91,8 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
         train_kw.update(epochs_per_round=args.epochs)
     if getattr(args, "learning_rate", None):
         train_kw.update(learning_rate=args.learning_rate)
+    if getattr(args, "warmup_steps", None) is not None:
+        train_kw.update(warmup_steps=args.warmup_steps)
     if getattr(args, "seed", None) is not None:
         train_kw.update(seed=args.seed)
     if train_kw:
@@ -661,6 +663,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--batch-size", type=int)
     p.add_argument("--epochs", type=int, help="epochs per round")
     p.add_argument("--learning-rate", type=float)
+    p.add_argument(
+        "--warmup-steps",
+        type=int,
+        help="linear LR warmup steps (global step count; 0 = constant)",
+    )
     p.add_argument("--max-len", type=int)
     p.add_argument("--data-fraction", type=float)
     p.add_argument("--seed", type=int)

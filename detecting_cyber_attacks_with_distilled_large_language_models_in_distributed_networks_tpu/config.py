@@ -144,6 +144,10 @@ class TrainConfig:
     """Local-training hyperparameters (reference client1.py:370,379-380)."""
 
     learning_rate: float = 2e-5
+    # Linear LR warmup over this many steps (0 = constant, the reference's
+    # schedule). Larger per-client batches than the reference's 16 (the TPU
+    # MFU sweet spot is 128, SURVEY.md §7c) train more stably with warmup.
+    warmup_steps: int = 0
     epochs_per_round: int = 3
     weight_decay: float = 0.0
     grad_accum_steps: int = 1

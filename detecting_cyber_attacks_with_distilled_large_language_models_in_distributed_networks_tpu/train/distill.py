@@ -167,6 +167,10 @@ class DistillTrainer(Trainer):
             updates, opt_state = self.optimizer.update(
                 grads, state.opt_state, state.params
             )
+            from .engine import warmup_factor
+
+            w = warmup_factor(state.step, self.train_cfg.warmup_steps)
+            updates = jax.tree.map(lambda u: u * w, updates)
             params = optax.apply_updates(state.params, updates)
             return TrainState(params, opt_state, state.step + 1, state.rng), loss
 

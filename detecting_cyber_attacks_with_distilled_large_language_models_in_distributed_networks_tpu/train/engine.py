@@ -35,9 +35,24 @@ class TrainState(NamedTuple):
     rng: jax.Array  # dropout PRNG key, folded per step
 
 
+def warmup_factor(step: jnp.ndarray, warmup_steps: int) -> jnp.ndarray:
+    """Linear LR warmup multiplier driven by the GLOBAL step counter.
+
+    Scaling the optimizer's update is equivalent to scaling Adam's learning
+    rate; keying on ``state.step`` (never reset) instead of an optax
+    schedule count (which lives in opt_state) means per-round optimizer
+    resets (FedConfig.reset_optimizer_each_round) restart the moments — the
+    reference's fresh-Adam semantics — without restarting the warmup ramp.
+    """
+    if warmup_steps <= 0:
+        return jnp.float32(1.0)
+    return jnp.minimum(1.0, (step.astype(jnp.float32) + 1.0) / warmup_steps)
+
+
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
-    """Adam(lr=2e-5) as the reference (client1.py:380); optional grad clip and
-    decoupled weight decay the reference lacks."""
+    """Adam(lr=2e-5) as the reference (client1.py:380); optional grad clip
+    and decoupled weight decay the reference lacks. LR warmup is applied by
+    the train step (see :func:`warmup_factor`), not here."""
     tx: list[optax.GradientTransformation] = []
     if cfg.max_grad_norm is not None:
         tx.append(optax.clip_by_global_norm(cfg.max_grad_norm))
@@ -94,7 +109,9 @@ def eval_counts(
 
 
 def make_train_step(
-    model: DDoSClassifier, optimizer: optax.GradientTransformation
+    model: DDoSClassifier,
+    optimizer: optax.GradientTransformation,
+    warmup_steps: int = 0,
 ) -> Callable[[TrainState, dict], tuple[TrainState, jnp.ndarray]]:
     """One jitted SGD step; params/opt_state buffers are donated."""
 
@@ -105,6 +122,8 @@ def make_train_step(
             lambda p: loss_fn(model, p, batch, step_rng)
         )(state.params)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        w = warmup_factor(state.step, warmup_steps)
+        updates = jax.tree.map(lambda u: u * w, updates)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1, state.rng), loss
 
@@ -136,7 +155,9 @@ class Trainer:
         self.pad_id = pad_id
         self.model = DDoSClassifier(model_cfg)
         self.optimizer = make_optimizer(train_cfg)
-        self.train_step = make_train_step(self.model, self.optimizer)
+        self.train_step = make_train_step(
+            self.model, self.optimizer, warmup_steps=train_cfg.warmup_steps
+        )
         self.eval_step = make_eval_step(self.model)
 
     def init_state(self, seed: int | None = None, params: Any | None = None) -> TrainState:

@@ -38,7 +38,7 @@ from ..models.distilbert import DDoSClassifier, init_params
 from ..ops.metrics import BinaryCounts, finalize_metrics
 from ..parallel.fedavg import make_fedavg_step
 from ..parallel.mesh import FedShardings, make_mesh
-from ..train.engine import eval_counts, loss_fn, make_optimizer
+from ..train.engine import eval_counts, loss_fn, make_optimizer, warmup_factor
 from ..utils.logging import get_logger, phase
 
 log = get_logger()
@@ -196,8 +196,14 @@ class FederatedTrainer:
         csh, bsh = self.sh.client, self.sh.batch
         mu = float(self.cfg.fed.prox_mu)
 
+        wsteps = self.cfg.train.warmup_steps
+
         def local_loss(p, batch, rng, anchor):
-            loss = loss_fn(model, p, batch, rng)
+            """Returns (training objective, task loss): gradients flow from
+            the first, logs/round records report the second so FedProx and
+            FedAvg loss curves stay comparable."""
+            task = loss_fn(model, p, batch, rng)
+            total = task
             if mu > 0.0:
                 # FedProx proximal term vs the round-start globals —
                 # trace-time constant, zero cost at mu=0 (plain FedAvg).
@@ -205,15 +211,17 @@ class FederatedTrainer:
                     jnp.sum(jnp.square(a - b))
                     for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(anchor))
                 )
-                loss = loss + 0.5 * mu * sq
-            return loss
+                total = task + 0.5 * mu * sq
+            return total, task
 
-        def per_client_step(params, opt_state, batch, rng, anchor):
-            loss, grads = jax.value_and_grad(
-                lambda p: local_loss(p, batch, rng, anchor)
+        def per_client_step(params, opt_state, batch, rng, anchor, step):
+            (_, task), grads = jax.value_and_grad(
+                lambda p: local_loss(p, batch, rng, anchor), has_aux=True
             )(params)
             updates, opt_state = optimizer.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
+            w = warmup_factor(step, wsteps)
+            updates = jax.tree.map(lambda u: u * w, updates)
+            return optax.apply_updates(params, updates), opt_state, task
 
         state_sh = FedState(csh, csh, self.sh.replicated, csh)
         batch_sh = {"input_ids": bsh, "attention_mask": bsh, "labels": bsh}
@@ -223,8 +231,9 @@ class FederatedTrainer:
                 state.rngs, state.step
             )
             params, opt_state, losses = jax.vmap(
-                per_client_step, in_axes=(0, 0, 0, 0, 0 if mu > 0.0 else None)
-            )(state.params, state.opt_state, batch, step_rngs, anchor)
+                per_client_step,
+                in_axes=(0, 0, 0, 0, 0 if mu > 0.0 else None, None),
+            )(state.params, state.opt_state, batch, step_rngs, anchor, state.step)
             return (
                 FedState(params, opt_state, state.step + 1, state.rngs),
                 losses,  # [C]

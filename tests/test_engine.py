@@ -64,6 +64,46 @@ def test_end_to_end_learns(trainer, client_data):
     assert cm.sum() == after["n"] == len(client_data.test)
 
 
+def test_warmup_ramps_then_reaches_full_lr(tok):
+    """Per-step update magnitudes must ramp over the warmup window and reach
+    the constant-LR magnitude once the window has passed; the ramp is keyed
+    on the global step so a mid-training optimizer reset does not restart
+    it (reference fresh-Adam-per-round semantics, FedConfig docstring)."""
+    mcfg = ModelConfig.tiny(vocab_size=len(tok), max_len=MAX_LEN,
+                            max_position_embeddings=MAX_LEN)
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": rng.integers(0, mcfg.vocab_size, (8, MAX_LEN)).astype(np.int32),
+        "attention_mask": np.ones((8, MAX_LEN), np.int32),
+        "labels": rng.integers(0, 2, 8).astype(np.int32),
+    }
+
+    def step_norms(warmup, n_steps):
+        tr = Trainer(mcfg, TrainConfig(learning_rate=1e-3, warmup_steps=warmup, seed=0))
+        state = tr.init_state(seed=0)
+        norms = []
+        for _ in range(n_steps):
+            before = jax.tree.map(lambda x: np.asarray(x).copy(), state.params)
+            state, _ = tr.train_step(state, batch)
+            norms.append(sum(
+                float(np.abs(np.asarray(a) - b).sum())
+                for a, b in zip(
+                    jax.tree.leaves(state.params), jax.tree.leaves(before)
+                )
+            ))
+        return norms
+
+    warm = step_norms(warmup=4, n_steps=6)
+    const = step_norms(warmup=0, n_steps=1)
+    # Ramp: strictly increasing through the window, starting well below
+    # the constant-LR magnitude (first factor = 1/4).
+    assert warm[0] < const[0] * 0.5
+    assert warm[0] < warm[1] < warm[2] < warm[3]
+    # Post-window steps run at full LR (same order of magnitude as the
+    # constant-LR first step; Adam normalizes update scale).
+    assert warm[4] > const[0] * 0.5
+
+
 def test_eval_counts_every_example_once(trainer, client_data):
     """Padded eval must count each of the N examples exactly once even when
     N % batch_size != 0."""
