@@ -233,6 +233,15 @@ def _load_clients(args, cfg: ExperimentConfig, tok, num_clients: int):
     """Full path: text splits -> tokenized static-shape arrays, all clients."""
     from .data import tokenize_client
 
+    if getattr(args, "stream", False):
+        if not getattr(args, "csv", None):
+            raise SystemExit("--stream needs --csv (chunked two-pass reader)")
+        from .data import stream_client_tokens
+
+        with phase(f"streaming {args.csv}", tag="DATA"):
+            return stream_client_tokens(
+                args.csv, cfg.data, num_clients, tok, max_len=cfg.model.max_len
+            )
     splits = _load_client_splits(args, cfg, num_clients)
     with phase("tokenize", tag="DATA"):
         return [tokenize_client(s, tok, max_len=cfg.model.max_len) for s in splits]
@@ -371,20 +380,34 @@ def cmd_federated(args) -> int:
             f"clients [{local_sl.start}, {local_sl.stop})"
         )
 
-    # Partitioning runs over the full fleet on every host (it must be
-    # globally consistent); tokenization — the host-side hot loop — runs
-    # only for this process's clients. Global row counts for the stacked
-    # train/eval feeds come from the (cheap) split lengths, so every host
-    # agrees on batch counts without seeing other hosts' token arrays.
-    splits = _load_client_splits(args, cfg, C)
-    local_ids = range(C) if local_sl is None else range(local_sl.start, local_sl.stop)
-    with phase(f"tokenize clients {list(local_ids)}", tag="DATA"):
-        clients = [
-            tokenize_client(splits[c], tok, max_len=cfg.model.max_len)
-            for c in local_ids
-        ]
-    n_train_common = min(len(s.train) for s in splits)
-    eval_rows_global = max(len(s.test) for s in splits)
+    if getattr(args, "stream", False):
+        if local_sl is not None:
+            raise SystemExit(
+                "--stream is single-host for now (multi-host feeds need "
+                "per-host client slicing of the streamed plan)"
+            )
+        clients = _load_clients(args, cfg, tok, C)
+        n_train_common = min(len(c.train) for c in clients)
+        eval_rows_global = max(len(c.test) for c in clients)
+        train_sizes = [len(c.train) for c in clients]
+    else:
+        # Partitioning runs over the full fleet on every host (it must be
+        # globally consistent); tokenization — the host-side hot loop — runs
+        # only for this process's clients. Global row counts for the stacked
+        # train/eval feeds come from the (cheap) split lengths, so every host
+        # agrees on batch counts without seeing other hosts' token arrays.
+        splits = _load_client_splits(args, cfg, C)
+        local_ids = (
+            range(C) if local_sl is None else range(local_sl.start, local_sl.stop)
+        )
+        with phase(f"tokenize clients {list(local_ids)}", tag="DATA"):
+            clients = [
+                tokenize_client(splits[c], tok, max_len=cfg.model.max_len)
+                for c in local_ids
+            ]
+        n_train_common = min(len(s.train) for s in splits)
+        eval_rows_global = max(len(s.test) for s in splits)
+        train_sizes = [len(s.train) for s in splits]
     stacked_train = stack_clients([c.train for c in clients], n_rows=n_train_common)
     trainer = FederatedTrainer(cfg, pad_id=tok.pad_id, mesh=mesh)
 
@@ -411,9 +434,7 @@ def cmd_federated(args) -> int:
     # FedAvg weights are the GLOBAL per-client sample counts (known from the
     # cheap split phase on every host, reference semantics: weight by data).
     weights = (
-        np.array([len(s.train) for s in splits], np.float64)
-        if cfg.fed.weighted
-        else None
+        np.array(train_sizes, np.float64) if cfg.fed.weighted else None
     )
     from .utils.profiling import trace
 
@@ -659,6 +680,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "from the schema when omitted",
     )
     p.add_argument("--synthetic", type=int, metavar="N", help="use N synthetic flows")
+    p.add_argument(
+        "--stream",
+        action="store_true",
+        help="two-pass chunked CSV reader (corpora larger than RAM); "
+        "index-based sampling semantics",
+    )
     p.add_argument("--output-dir", default=None)
     p.add_argument("--batch-size", type=int)
     p.add_argument("--epochs", type=int, help="epochs per round")

@@ -41,6 +41,7 @@ from .tokenizer import (  # noqa: F401
     build_domain_vocab,
     default_tokenizer,
 )
+from .streaming import stream_client_tokens  # noqa: F401
 from .pipeline import (  # noqa: F401
     TokenizedClient,
     TokenizedSplit,

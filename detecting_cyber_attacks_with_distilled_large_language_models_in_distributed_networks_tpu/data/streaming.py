@@ -1,0 +1,222 @@
+"""Two-pass streaming CSV -> per-client token arrays, for corpora > RAM.
+
+The reference loads its whole CSV into pandas at once (client1.py:85) —
+fine for the bundled ~225k-row file, impossible for the real CIC-DDoS2019
+exports (tens of GB). This pipeline never materializes the frame:
+
+* **Pass 1** (cheap scan): row count, per-column finite sums/counts for the
+  reference's ``±inf -> NaN -> column-mean`` imputation (client1.py:86-88),
+  per-column dtype facts (so pass 2 can pin dtypes — pandas infers PER
+  CHUNK, which would render ``0`` in one chunk and ``0.0`` in another and
+  silently diverge from the whole-file inference of the in-memory path),
+  and the binary label vector (4 bytes/row).
+* **Partition plan** (in memory, labels only): per-client row indices via
+  the same ``disjoint``/``dirichlet`` partitioners as the in-memory path,
+  then the reference's 60/20/20 split per client; destinations are stored
+  as row-sorted numpy arrays, located per chunk with ``searchsorted``.
+* **Pass 2**: impute each chunk with the pass-1 means, render the dataset's
+  text template, batch-encode (the native WordPiece path), and scatter rows
+  straight into preallocated ``[N_split, max_len]`` int32 arrays.
+
+Peak memory is the OUTPUT token arrays plus the destination index arrays
+(~17 bytes/selected row) plus one chunk — independent of the CSV size. The
+``sample`` scheme uses index-permutation sampling (the corpus convention)
+rather than ``df.sample``; use the in-memory path when exact pandas
+sampling parity matters.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+import pandas as pd
+
+from ..config import DataConfig
+from .cicids import partition_indices, train_val_test_split
+from .datasets import DatasetSpec, get_dataset
+from .pipeline import TokenizedClient, TokenizedSplit
+from .tokenizer import WordPieceTokenizer
+
+_SPLIT_NAMES = ("train", "val", "test")
+
+
+class _Pass1:
+    """Streaming scan results."""
+
+    def __init__(
+        self,
+        n_rows: int,
+        means: dict[str, float],
+        labels: np.ndarray,
+        float_cols: list[str],
+    ):
+        self.n_rows = n_rows
+        self.means = means
+        self.labels = labels
+        #: Columns pass 2 must read as float64: any chunk saw a float dtype
+        #: or a non-finite value. Whole-file pandas inference would promote
+        #: exactly these (one NaN anywhere floats the column), so pinning
+        #: them keeps string rendering identical to the in-memory path.
+        self.float_cols = float_cols
+
+
+def _chunks(
+    path: str, chunk_rows: int, dtype: dict | None = None
+) -> Iterator[pd.DataFrame]:
+    for chunk in pd.read_csv(
+        path, skipinitialspace=True, chunksize=chunk_rows, dtype=dtype
+    ):
+        chunk.columns = [c.strip() for c in chunk.columns]
+        yield chunk
+
+
+def _scan(path: str, spec: DatasetSpec, cfg: DataConfig, chunk_rows: int) -> _Pass1:
+    sums: dict[str, float] = {}
+    counts: dict[str, int] = {}
+    saw_float: set[str] = set()
+    saw_nonnumeric: set[str] = set()
+    labels: list[np.ndarray] = []
+    n = 0
+    for chunk in _chunks(path, chunk_rows):
+        n += len(chunk)
+        if spec.label_kind == "positive":
+            labels.append(
+                spec.binary_labels(
+                    chunk,
+                    label_column=cfg.label_column,
+                    positive_value=cfg.positive_label,
+                )
+            )
+        else:
+            labels.append(spec.binary_labels(chunk))
+        for col in chunk.columns:
+            if not pd.api.types.is_numeric_dtype(chunk[col]):
+                saw_nonnumeric.add(col)
+                continue
+            if pd.api.types.is_float_dtype(chunk[col]):
+                saw_float.add(col)
+            vals = chunk[col].to_numpy(dtype=np.float64, copy=False)
+            finite = np.isfinite(vals)
+            if not finite.all():
+                saw_float.add(col)
+            sums[col] = sums.get(col, 0.0) + float(vals[finite].sum())
+            counts[col] = counts.get(col, 0) + int(finite.sum())
+    # A column that is non-numeric in ANY chunk is non-numeric whole-file
+    # (pandas would infer object): exclude it from imputation entirely.
+    means = {
+        c: (sums[c] / counts[c] if counts[c] else 0.0)
+        for c in sums
+        if c not in saw_nonnumeric
+    }
+    float_cols = sorted(saw_float - saw_nonnumeric)
+    return _Pass1(
+        n,
+        means,
+        np.concatenate(labels) if labels else np.zeros(0, np.int32),
+        float_cols,
+    )
+
+
+def _impute(chunk: pd.DataFrame, means: dict[str, float]) -> pd.DataFrame:
+    for col, mean in means.items():
+        if col not in chunk.columns:
+            continue
+        vals = chunk[col].to_numpy(dtype=np.float64)
+        bad = ~np.isfinite(vals)
+        if bad.any():
+            vals = vals.copy()  # to_numpy may return a read-only view
+            vals[bad] = mean
+            chunk[col] = vals
+    return chunk
+
+
+def _client_split_indices(
+    labels: np.ndarray, num_clients: int, cfg: DataConfig
+) -> list[dict[str, np.ndarray]]:
+    """Per-client {train,val,test} -> global row indices."""
+    n = len(labels)
+    if cfg.partition == "sample":
+        per_client = max(1, int(round(n * cfg.data_fraction)))
+        parts = [
+            np.random.RandomState(cfg.client_seed(cid)).permutation(n)[:per_client]
+            for cid in range(num_clients)
+        ]
+    else:
+        parts = partition_indices(labels, num_clients, cfg)
+    out = []
+    for cid, rows in enumerate(parts):
+        tr, va, te = train_val_test_split(
+            len(rows), cfg.client_seed(cid), cfg.val_fraction, cfg.test_fraction
+        )
+        out.append({"train": rows[tr], "val": rows[va], "test": rows[te]})
+    return out
+
+
+def stream_client_tokens(
+    path: str,
+    cfg: DataConfig,
+    num_clients: int,
+    tok: WordPieceTokenizer,
+    *,
+    max_len: int | None = None,
+    chunk_rows: int = 100_000,
+) -> list[TokenizedClient]:
+    """Streamed equivalent of ``make_all_client_splits`` + ``tokenize_client``
+    for the index-based partition schemes; peak memory is the output arrays
+    plus the destination index plus one chunk of the CSV."""
+    max_len = cfg.max_len if max_len is None else max_len
+    spec = get_dataset(cfg.dataset)
+    scan = _scan(path, spec, cfg, chunk_rows)
+    plans = _client_split_indices(scan.labels, num_clients, cfg)
+
+    # Destination arrays (allocated up front) + a flat, row-sorted index:
+    # (global_row, client, split, position) in parallel numpy arrays — a
+    # row may land in several destinations under the 'sample' scheme.
+    dest: list[dict[str, TokenizedSplit]] = []
+    rows_l, client_l, split_l, pos_l = [], [], [], []
+    for cid, plan in enumerate(plans):
+        splits = {}
+        for sid, name in enumerate(_SPLIT_NAMES):
+            rows = plan[name]
+            m = len(rows)
+            splits[name] = TokenizedSplit(
+                np.full((m, max_len), tok.pad_id, np.int32),
+                np.zeros((m, max_len), np.int32),
+                scan.labels[rows].astype(np.int32),
+            )
+            rows_l.append(rows.astype(np.int64))
+            client_l.append(np.full(m, cid, np.int32))
+            split_l.append(np.full(m, sid, np.int8))
+            pos_l.append(np.arange(m, dtype=np.int64))
+        dest.append(splits)
+    rows_all = np.concatenate(rows_l) if rows_l else np.zeros(0, np.int64)
+    order = np.argsort(rows_all, kind="stable")
+    rows_all = rows_all[order]
+    client_all = np.concatenate(client_l)[order]
+    split_all = np.concatenate(split_l)[order]
+    pos_all = np.concatenate(pos_l)[order]
+
+    dtype_spec = {c: np.float64 for c in scan.float_cols}
+    row_base = 0
+    for chunk in _chunks(path, chunk_rows, dtype=dtype_spec or None):
+        lo = np.searchsorted(rows_all, row_base)
+        hi = np.searchsorted(rows_all, row_base + len(chunk))
+        if hi > lo:
+            hit_rows = rows_all[lo:hi] - row_base  # local, may repeat
+            uniq, inverse = np.unique(hit_rows, return_inverse=True)
+            sub = _impute(chunk.iloc[uniq].copy(), scan.means)
+            texts = spec.render_texts(sub)
+            enc = tok.batch_encode(texts, max_len=max_len)
+            for k in range(hi - lo):
+                split = dest[client_all[lo + k]][_SPLIT_NAMES[split_all[lo + k]]]
+                src = inverse[k]
+                p = pos_all[lo + k]
+                split.input_ids[p] = enc["input_ids"][src]
+                split.attention_mask[p] = enc["attention_mask"][src]
+        row_base += len(chunk)
+
+    return [
+        TokenizedClient(cid, d["train"], d["val"], d["test"])
+        for cid, d in enumerate(dest)
+    ]

@@ -27,7 +27,7 @@ import optax
 from ..config import DistillConfig, ModelConfig, TrainConfig
 from ..data.pipeline import TokenizedSplit
 from ..models.distilbert import DDoSClassifier
-from .engine import Trainer, TrainState
+from .engine import Trainer, TrainState, apply_warmup
 
 
 def distillation_loss(
@@ -167,10 +167,7 @@ class DistillTrainer(Trainer):
             updates, opt_state = self.optimizer.update(
                 grads, state.opt_state, state.params
             )
-            from .engine import warmup_factor
-
-            w = warmup_factor(state.step, self.train_cfg.warmup_steps)
-            updates = jax.tree.map(lambda u: u * w, updates)
+            updates = apply_warmup(updates, state.step, self.train_cfg.warmup_steps)
             params = optax.apply_updates(state.params, updates)
             return TrainState(params, opt_state, state.step + 1, state.rng), loss
 

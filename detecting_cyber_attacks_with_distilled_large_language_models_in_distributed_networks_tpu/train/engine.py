@@ -49,6 +49,16 @@ def warmup_factor(step: jnp.ndarray, warmup_steps: int) -> jnp.ndarray:
     return jnp.minimum(1.0, (step.astype(jnp.float32) + 1.0) / warmup_steps)
 
 
+def apply_warmup(updates: Any, step: jnp.ndarray, warmup_steps: int) -> Any:
+    """Scale an optimizer update tree by the warmup factor (no-op traced
+    away at warmup_steps=0). The single shared implementation for the
+    engine, federated, and distillation steps."""
+    if warmup_steps <= 0:
+        return updates
+    w = warmup_factor(step, warmup_steps)
+    return jax.tree.map(lambda u: u * w, updates)
+
+
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     """Adam(lr=2e-5) as the reference (client1.py:380); optional grad clip
     and decoupled weight decay the reference lacks. LR warmup is applied by
@@ -122,8 +132,7 @@ def make_train_step(
             lambda p: loss_fn(model, p, batch, step_rng)
         )(state.params)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-        w = warmup_factor(state.step, warmup_steps)
-        updates = jax.tree.map(lambda u: u * w, updates)
+        updates = apply_warmup(updates, state.step, warmup_steps)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1, state.rng), loss
 

@@ -38,7 +38,7 @@ from ..models.distilbert import DDoSClassifier, init_params
 from ..ops.metrics import BinaryCounts, finalize_metrics
 from ..parallel.fedavg import make_fedavg_step
 from ..parallel.mesh import FedShardings, make_mesh
-from ..train.engine import eval_counts, loss_fn, make_optimizer, warmup_factor
+from ..train.engine import apply_warmup, eval_counts, loss_fn, make_optimizer
 from ..utils.logging import get_logger, phase
 
 log = get_logger()
@@ -219,8 +219,7 @@ class FederatedTrainer:
                 lambda p: local_loss(p, batch, rng, anchor), has_aux=True
             )(params)
             updates, opt_state = optimizer.update(grads, opt_state, params)
-            w = warmup_factor(step, wsteps)
-            updates = jax.tree.map(lambda u: u * w, updates)
+            updates = apply_warmup(updates, step, wsteps)
             return optax.apply_updates(params, updates), opt_state, task
 
         state_sh = FedState(csh, csh, self.sh.replicated, csh)

@@ -1,0 +1,130 @@
+"""Streaming CSV -> token pipeline (data/streaming.py): two passes, chunked,
+must reproduce the in-memory path exactly for the index-based partitions."""
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+    DataConfig,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+    default_tokenizer,
+    load_flow_csv,
+    make_all_client_splits,
+    stream_client_tokens,
+    tokenize_client,
+    write_synthetic_csv,
+)
+
+MAX_LEN = 48
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return default_tokenizer()
+
+
+def _inmemory(csv_path, cfg, num_clients, tok):
+    df = load_flow_csv(csv_path)
+    splits = make_all_client_splits(df, num_clients, cfg)
+    return [tokenize_client(s, tok, max_len=cfg.max_len) for s in splits]
+
+
+def _assert_clients_equal(a, b):
+    assert len(a) == len(b)
+    for ca, cb in zip(a, b):
+        for name in ("train", "val", "test"):
+            sa, sb = getattr(ca, name), getattr(cb, name)
+            np.testing.assert_array_equal(sa.labels, sb.labels, err_msg=name)
+            np.testing.assert_array_equal(sa.input_ids, sb.input_ids, err_msg=name)
+            np.testing.assert_array_equal(
+                sa.attention_mask, sb.attention_mask, err_msg=name
+            )
+
+
+@pytest.mark.parametrize("partition", ["disjoint", "dirichlet"])
+def test_streaming_matches_inmemory(tmp_path, tok, partition):
+    """Clean data (no ±inf/NaN): the streamed arrays must be bit-identical
+    to the in-memory path, across chunk boundaries."""
+    path = tmp_path / f"{partition}.csv"
+    write_synthetic_csv(
+        str(path), n_rows=600, seed=5, inf_fraction=0.0, nan_fraction=0.0
+    )
+    cfg = DataConfig(partition=partition, data_fraction=0.3, max_len=MAX_LEN)
+    want = _inmemory(str(path), cfg, 2, tok)
+    got = stream_client_tokens(str(path), cfg, 2, tok, chunk_rows=97)
+    _assert_clients_equal(got, want)
+
+
+def test_streaming_sample_partition_matches_corpus_convention(tmp_path, tok):
+    """'sample' uses index-permutation sampling (the corpus convention);
+    sizes follow data_fraction and clients may overlap."""
+    path = tmp_path / "s.csv"
+    write_synthetic_csv(str(path), n_rows=400, seed=6)
+    cfg = DataConfig(partition="sample", data_fraction=0.25, max_len=MAX_LEN)
+    clients = stream_client_tokens(str(path), cfg, 3, tok, chunk_rows=111)
+    for c in clients:
+        assert len(c.train) + len(c.val) + len(c.test) == 100
+        assert c.train.input_ids.shape[1] == MAX_LEN
+
+
+def test_streaming_imputes_with_global_means(tmp_path, tok):
+    """±inf/NaN rows still tokenize (imputed with pass-1 global means) and
+    labels survive; rows free of bad values match the in-memory path."""
+    path = tmp_path / "noisy.csv"
+    write_synthetic_csv(
+        str(path), n_rows=300, seed=7, inf_fraction=0.05, nan_fraction=0.05
+    )
+    cfg = DataConfig(partition="disjoint", data_fraction=0.5, max_len=MAX_LEN)
+    want = _inmemory(str(path), cfg, 2, tok)
+    got = stream_client_tokens(str(path), cfg, 2, tok, chunk_rows=64)
+    for ca, cb in zip(got, want):
+        for name in ("train", "val", "test"):
+            sa, sb = getattr(ca, name), getattr(cb, name)
+            np.testing.assert_array_equal(sa.labels, sb.labels)
+            assert sa.input_ids.shape == sb.input_ids.shape
+            # Identical for the vast majority of rows (the rest can differ
+            # in the last float digit of an imputed value because pandas'
+            # pairwise mean and the streaming chunk-sum mean round
+            # differently).
+            same = (sa.input_ids == sb.input_ids).all(axis=1).mean()
+            assert same > 0.7, same
+            # Every row tokenized (CLS at position 0, nothing left empty).
+            assert (sa.input_ids[:, 0] == tok.cls_id).all()
+
+
+def test_streaming_pins_whole_file_dtypes(tmp_path, tok):
+    """One NaN in a LATE chunk floats the whole column under pandas'
+    whole-file inference ('443' renders as '443.0' everywhere). The
+    streamed reader must pin that dtype from pass 1 so early, NaN-free
+    chunks tokenize identically to the in-memory path."""
+    import pandas as pd
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+        make_synthetic_flows,
+    )
+
+    df = make_synthetic_flows(200, seed=9, inf_fraction=0.0, nan_fraction=0.0)
+    assert df["Destination Port"].dtype == np.int64
+    df.loc[df.index[-1], "Destination Port"] = np.nan  # floats the column
+    path = tmp_path / "late_nan.csv"
+    df.to_csv(path, index=False)
+    assert pd.read_csv(path)["Destination Port"].dtype == np.float64
+
+    cfg = DataConfig(partition="disjoint", data_fraction=0.5, max_len=MAX_LEN)
+    want = _inmemory(str(path), cfg, 2, tok)
+    # chunk_rows=50: the NaN sits in the final chunk; earlier chunks would
+    # infer int64 on their own.
+    got = stream_client_tokens(str(path), cfg, 2, tok, chunk_rows=50)
+    _assert_clients_equal(got, want)
+
+
+def test_streaming_unsw_schema(tmp_path, tok):
+    path = tmp_path / "unsw.csv"
+    write_synthetic_csv(str(path), dataset="unswnb15", n_rows=300, seed=8)
+    cfg = DataConfig(
+        dataset="unswnb15", partition="disjoint", data_fraction=0.5, max_len=MAX_LEN
+    )
+    want = _inmemory(str(path), cfg, 2, tok)
+    got = stream_client_tokens(str(path), cfg, 2, tok, chunk_rows=50)
+    _assert_clients_equal(got, want)
