@@ -499,6 +499,16 @@ def cmd_federated(args) -> int:
     return 0
 
 
+def _auth_key() -> bytes | None:
+    """Shared-secret HMAC key for the TCP demo-parity mode, from the
+    FEDTPU_SECRET env var (never argv — process listings leak flags). The
+    reference's protocol accepts weights from anyone who can connect
+    (server.py:57-65); with a secret set, unauthenticated or tampered
+    messages are rejected."""
+    secret = os.environ.get("FEDTPU_SECRET")
+    return secret.encode() if secret else None
+
+
 def cmd_serve(args) -> int:
     from .comm import AggregationServer
 
@@ -510,6 +520,7 @@ def cmd_serve(args) -> int:
         min_clients=args.min_clients,
         timeout=args.timeout,
         compression=args.compression,
+        auth_key=_auth_key(),
     ) as server:
         log.info(f"[SERVER] listening on {args.host}:{server.port}")
         server.serve(rounds=args.rounds or 1)
@@ -543,6 +554,7 @@ def cmd_client(args) -> int:
             fed = FederatedClient(
                 args.host, args.port, client_id=args.client_id,
                 timeout=args.timeout, compression=args.compression,
+                auth_key=_auth_key(),
             )
             aggregated = fed.exchange(host_params, n_samples=len(client_data.train))
         with phase("aggregated evaluation", tag="EVAL"):
@@ -742,7 +754,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--process-id", type=int, help="multi-host: this process's id")
     p.set_defaults(fn=cmd_federated)
 
-    p = sub.add_parser("serve", help="TCP aggregation server (demo-parity mode)")
+    p = sub.add_parser(
+        "serve",
+        help="TCP aggregation server (demo-parity mode)",
+        epilog="Set FEDTPU_SECRET (env var, same value on server and every "
+        "client) to require HMAC-SHA256-authenticated, replay-protected "
+        "exchanges; unset = the reference's open protocol.",
+    )
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=12345)
     p.add_argument("--num-clients", type=int, default=2)
@@ -753,7 +771,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compression", default="none", choices=["none", "bf16"])
     p.set_defaults(fn=cmd_serve)
 
-    p = sub.add_parser("client", help="TCP federated client (demo-parity mode)")
+    p = sub.add_parser(
+        "client",
+        help="TCP federated client (demo-parity mode)",
+        epilog="Set FEDTPU_SECRET (env var) to authenticate exchanges; must "
+        "match the server's.",
+    )
     _add_common(p)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=12345)

@@ -55,12 +55,14 @@ class FederatedClient:
         client_id: int,
         timeout: float = 300.0,  # the reference's TIMEOUT (client1.py:22)
         compression: str = "none",
+        auth_key: bytes | None = None,
     ):
         self.host = host
         self.port = port
         self.client_id = client_id
         self.timeout = timeout
         self.compression = compression
+        self.auth_key = auth_key
 
     def exchange(
         self,
@@ -76,14 +78,18 @@ class FederatedClient:
         WireError (e.g. CRC mismatch after corruption) also retries with a
         fresh upload.
         """
-        msg = wire.encode(
-            params,
-            meta={
-                "client_id": self.client_id,
-                "n_samples": int(n_samples),
-                **dict(meta or {}),
-            },
-            compression=self.compression,
+        base_meta = {
+            "client_id": self.client_id,
+            "n_samples": int(n_samples),
+            **dict(meta or {}),
+        }
+        # Unauthenticated uploads are nonce-free and encode once; in auth
+        # mode each attempt embeds that connection's server challenge, so
+        # encoding happens inside the loop.
+        msg = (
+            wire.encode(params, meta=base_meta, compression=self.compression)
+            if self.auth_key is None
+            else None
         )
         last: Exception | None = None
         for attempt in range(1, max_retries + 1):
@@ -91,13 +97,33 @@ class FederatedClient:
             try:
                 sock = connect_with_retry(self.host, self.port, timeout=self.timeout)
                 sock.settimeout(self.timeout)
+                nonce_hex = None
+                if self.auth_key is not None:
+                    chal = framing.recv_frame(sock)
+                    if len(chal) != 20 or not chal.startswith(b"NONC"):
+                        raise wire.WireError("bad auth challenge from server")
+                    nonce_hex = chal[4:].hex()
+                    msg = wire.encode(
+                        params,
+                        meta={**base_meta, "role": "client", "nonce": nonce_hex},
+                        compression=self.compression,
+                        auth_key=self.auth_key,
+                    )
                 log.info(
                     f"[CLIENT {self.client_id}] uploading {len(msg) / 1e6:.1f} MB "
                     f"(attempt {attempt}/{max_retries})"
                 )
                 framing.send_frame(sock, msg)
                 reply = framing.recv_frame(sock)
-                agg, agg_meta = wire.decode(reply)
+                agg, agg_meta = wire.decode(reply, auth_key=self.auth_key)
+                if self.auth_key is not None and (
+                    agg_meta.get("role") != "server"
+                    or agg_meta.get("nonce") != nonce_hex
+                ):
+                    raise wire.WireError(
+                        "aggregated reply failed the freshness check "
+                        "(stale nonce or wrong role) — possible replay"
+                    )
                 log.info(
                     f"[CLIENT {self.client_id}] received aggregated model "
                     f"({len(reply) / 1e6:.1f} MB, clients {agg_meta.get('round_clients')})"

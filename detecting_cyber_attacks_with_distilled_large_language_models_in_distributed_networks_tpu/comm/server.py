@@ -75,6 +75,7 @@ class _Round:
     models: dict[int, dict] = field(default_factory=dict)  # client_id -> flat params
     n_samples: dict[int, float] = field(default_factory=dict)
     conns: dict[int, socket.socket] = field(default_factory=dict)
+    nonces: dict[int, str] = field(default_factory=dict)  # auth mode only
     lock: threading.Lock = field(default_factory=threading.Lock)
     complete: threading.Event = field(default_factory=threading.Event)
     # Set (under lock) when serve_round snapshots the round; a handler that
@@ -101,12 +102,14 @@ class AggregationServer:
         min_clients: int | None = None,
         timeout: float = 300.0,  # the reference's TIMEOUT (server.py:10)
         compression: str = "none",
+        auth_key: bytes | None = None,
     ):
         self.num_clients = num_clients
         self.weighted = weighted
         self.min_clients = num_clients if min_clients is None else min_clients
         self.timeout = timeout
         self.compression = compression
+        self.auth_key = auth_key
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -130,8 +133,27 @@ class AggregationServer:
     def _handle_upload(self, conn: socket.socket, rnd: _Round) -> None:
         try:
             conn.settimeout(self.timeout)
+            nonce_hex = None
+            if self.auth_key is not None:
+                # Freshness + direction binding: a per-connection challenge
+                # the client must echo inside its authenticated header, so a
+                # captured upload can't be replayed into a later round, and
+                # the reply (which echoes the same nonce with role=server)
+                # can't be reflected. Without a key, the wire is the
+                # reference-style open protocol and no challenge is sent.
+                import os as _os
+
+                nonce_hex = _os.urandom(16).hex()
+                framing.send_frame(conn, b"NONC" + bytes.fromhex(nonce_hex))
             payload = framing.recv_frame(conn)
-            flat, meta = wire.decode(payload)
+            flat, meta = wire.decode(payload, auth_key=self.auth_key)
+            if self.auth_key is not None and (
+                meta.get("role") != "client" or meta.get("nonce") != nonce_hex
+            ):
+                raise wire.WireError(
+                    "authenticated upload failed the freshness check "
+                    "(stale nonce or wrong role) — possible replay"
+                )
             flat = wire.flatten_params(flat)
             client_id = int(meta.get("client_id", -1))
             with rnd.lock:
@@ -153,6 +175,8 @@ class AggregationServer:
                 rnd.models[client_id] = flat
                 rnd.n_samples[client_id] = float(meta.get("n_samples", 1.0))
                 rnd.conns[client_id] = conn
+                if nonce_hex is not None:
+                    rnd.nonces[client_id] = nonce_hex
                 done = len(rnd.models) >= rnd.expected
             log.info(
                 f"[SERVER] received model from client {client_id} "
@@ -190,6 +214,7 @@ class AggregationServer:
             models = dict(rnd.models)
             conns = dict(rnd.conns)
             n_samples = dict(rnd.n_samples)
+            nonces = dict(rnd.nonces)
         try:
             if len(models) < self.min_clients:
                 raise RuntimeError(
@@ -200,9 +225,30 @@ class AggregationServer:
             weights = [n_samples[i] for i in ids] if self.weighted else None
             agg = aggregate_flat([models[i] for i in ids], weights)
             log.info(f"[SERVER] aggregated {len(ids)} models (clients {ids})")
-            reply = wire.encode(
-                agg, meta={"round_clients": ids}, compression=self.compression
-            )
+            if self.auth_key is None:
+                # One shared reply blob for every client.
+                replies = {cid: None for cid in ids}
+                shared_reply = wire.encode(
+                    agg, meta={"round_clients": ids}, compression=self.compression
+                )
+            else:
+                # Auth mode: each reply echoes that client's challenge nonce
+                # with role=server, so it can't be replayed or reflected.
+                # (Per-client encode costs one extra payload memcpy each.)
+                shared_reply = None
+                replies = {
+                    cid: wire.encode(
+                        agg,
+                        meta={
+                            "round_clients": ids,
+                            "role": "server",
+                            "nonce": nonces.get(cid),
+                        },
+                        compression=self.compression,
+                        auth_key=self.auth_key,
+                    )
+                    for cid in ids
+                }
         except BaseException:
             # A failed round must not leave clients blocked in recv_frame
             # until their timeouts — drop every connection so they fail fast.
@@ -214,7 +260,7 @@ class AggregationServer:
         # every healthy one behind it for a full socket timeout.
         def _reply(cid: int, conn: socket.socket) -> None:
             try:
-                framing.send_frame(conn, reply)
+                framing.send_frame(conn, replies[cid] or shared_reply)
             except (OSError, wire.WireError, ConnectionError) as e:
                 log.info(f"[SERVER] reply to client {cid} failed: {e}")
             finally:

@@ -19,6 +19,8 @@ precision, instead of the reference's ~11 s/round byte-level gzip.
 
 from __future__ import annotations
 
+import hashlib
+import hmac as hmac_mod
 import json
 import struct
 from typing import Any, Mapping
@@ -29,6 +31,9 @@ from . import native
 
 MAGIC = b"FTPW"
 VERSION = 1
+#: HMAC-SHA256 tag appended after the payload when a shared key is used.
+AUTH_TAG_LEN = 32
+_AUTH_SCHEME = "hmac-sha256"
 _ALLOWED_DTYPES = {
     "float32", "float64", "float16", "bfloat16",
     "int8", "int16", "int32", "int64",
@@ -78,8 +83,15 @@ def encode(
     *,
     meta: Mapping[str, Any] | None = None,
     compression: str = "none",
+    auth_key: bytes | None = None,
 ) -> bytes:
-    """Params pytree (nested dict or flat dict of arrays) -> wire bytes."""
+    """Params pytree (nested dict or flat dict of arrays) -> wire bytes.
+
+    ``auth_key``: shared-secret HMAC-SHA256 over the entire message,
+    appended as a 32-byte trailing tag. The reference's protocol has no
+    authentication at all (any peer that can connect injects weights,
+    server.py:57-65); a keyed decoder rejects unauthenticated or tampered
+    messages."""
     if compression not in ("none", "bf16"):
         raise WireError(f"unknown compression {compression!r}")
     flat = (
@@ -120,13 +132,25 @@ def encode(
         "payload_crc32": native.crc32(payload),
         "meta": dict(meta or {}),
     }
+    if auth_key is not None:
+        header["auth"] = _AUTH_SCHEME
     hbytes = json.dumps(header, separators=(",", ":")).encode()
-    return MAGIC + struct.pack("<II", VERSION, len(hbytes)) + hbytes + payload
+    msg = MAGIC + struct.pack("<II", VERSION, len(hbytes)) + hbytes + payload
+    if auth_key is not None:
+        msg += hmac_mod.new(auth_key, msg, hashlib.sha256).digest()
+    return msg
 
 
 # ----------------------------------------------------------------- decode
-def decode(data: bytes | memoryview) -> tuple[dict, dict]:
-    """Wire bytes -> ``(nested params dict, meta dict)``; verifies the CRC."""
+def decode(
+    data: bytes | memoryview, *, auth_key: bytes | None = None
+) -> tuple[dict, dict]:
+    """Wire bytes -> ``(nested params dict, meta dict)``; verifies the CRC.
+
+    With ``auth_key`` set, only messages carrying a valid HMAC-SHA256 tag
+    are accepted — unauthenticated, tampered, or wrong-key messages raise
+    :class:`WireError`. Without a key, a trailing tag (if any) is ignored
+    (the peer authenticated; this side did not configure a key)."""
     view = memoryview(data)
     if len(view) < 12 or bytes(view[:4]) != MAGIC:
         raise WireError("bad magic: not a fedwire message")
@@ -139,7 +163,27 @@ def decode(data: bytes | memoryview) -> tuple[dict, dict]:
         header = json.loads(bytes(view[12 : 12 + hlen]).decode())
     except (UnicodeDecodeError, json.JSONDecodeError) as e:
         raise WireError(f"malformed header: {e}") from None
-    payload = view[12 + hlen :]
+
+    auth = header.get("auth")
+    if auth not in (None, _AUTH_SCHEME):
+        raise WireError(f"unknown auth scheme {auth!r}")
+    if auth_key is not None and auth != _AUTH_SCHEME:
+        raise WireError(
+            f"unauthenticated message rejected (this side requires {_AUTH_SCHEME})"
+        )
+    if auth == _AUTH_SCHEME:
+        # Tag boundary computed once for both verification and payload slice.
+        if len(view) < 12 + hlen + AUTH_TAG_LEN:
+            raise WireError("truncated auth tag")
+        body_end = len(view) - AUTH_TAG_LEN
+        if auth_key is not None:
+            tag = bytes(view[body_end:])
+            want = hmac_mod.new(auth_key, view[:body_end], hashlib.sha256).digest()
+            if not hmac_mod.compare_digest(tag, want):
+                raise WireError("HMAC verification failed (tampered or wrong key)")
+        payload = view[12 + hlen : body_end]
+    else:
+        payload = view[12 + hlen :]
     if len(payload) != header.get("payload_nbytes"):
         raise WireError(
             f"payload length {len(payload)} != declared {header.get('payload_nbytes')}"

@@ -270,3 +270,111 @@ def test_round_times_out_below_quorum(rng):
         t.start()
         with pytest.raises(RuntimeError, match="1/2 clients"):
             server.serve_round(deadline=2.0)
+
+
+# ------------------------------------------------------------- wire auth
+def test_wire_hmac_roundtrip_and_rejections(rng):
+    """HMAC-SHA256 frame auth: keyed decode accepts only valid-tag messages
+    (the reference accepts weights from anyone who can connect,
+    server.py:57-65)."""
+    key = b"shared-secret"
+    p = _params(rng)
+    msg = encode(p, auth_key=key, meta={"client_id": 3})
+
+    back, meta = decode(msg, auth_key=key)
+    np.testing.assert_array_equal(
+        back["encoder"]["layer_0"]["kernel"], p["encoder"]["layer_0"]["kernel"]
+    )
+    assert meta["client_id"] == 3
+
+    # Keyless decoder tolerates (and ignores) the tag.
+    back2, _ = decode(msg)
+    np.testing.assert_array_equal(
+        back2["classifier"]["kernel"], p["classifier"]["kernel"]
+    )
+
+    # Tampered payload byte -> rejected.
+    bad = bytearray(msg)
+    bad[len(bad) - 50] ^= 0x01
+    with pytest.raises(WireError, match="HMAC|CRC"):
+        decode(bytes(bad), auth_key=key)
+
+    # Wrong key -> rejected.
+    with pytest.raises(WireError, match="HMAC"):
+        decode(msg, auth_key=b"other-secret")
+
+    # Unauthenticated message to a keyed decoder -> rejected.
+    plain = encode(p)
+    with pytest.raises(WireError, match="unauthenticated"):
+        decode(plain, auth_key=key)
+
+    # Tampered tag itself -> rejected.
+    clipped = bytearray(msg)
+    clipped[-1] ^= 0xFF
+    with pytest.raises(WireError, match="HMAC"):
+        decode(bytes(clipped), auth_key=key)
+
+
+def test_tcp_round_with_auth(rng):
+    """One authenticated 2-client TCP round end-to-end."""
+    key = b"fleet-secret"
+    with AggregationServer(
+        port=0, num_clients=2, timeout=20.0, auth_key=key
+    ) as server:
+        t = threading.Thread(target=lambda: server.serve(rounds=1), daemon=True)
+        t.start()
+        results = {}
+
+        def _client(cid):
+            results[cid] = FederatedClient(
+                "127.0.0.1", server.port, client_id=cid, timeout=20.0,
+                auth_key=key,
+            ).exchange(_params(rng, scale=cid + 1.0), max_retries=2)
+
+        threads = [threading.Thread(target=_client, args=(c,)) for c in (0, 1)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=30)
+        t.join(timeout=30)
+    assert set(results) == {0, 1}
+    a = results[0]["encoder"]["layer_0"]["kernel"]
+    np.testing.assert_array_equal(a, results[1]["encoder"]["layer_0"]["kernel"])
+
+
+def test_auth_rejects_replayed_upload(rng):
+    """A captured authenticated upload replayed into a new round carries a
+    stale nonce: the server must reject it and the round must fail rather
+    than aggregate attacker-chosen weights."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        framing as fr,
+    )
+
+    key = b"k"
+    stale = encode(
+        _params(rng),
+        meta={"client_id": 0, "n_samples": 1, "role": "client",
+              "nonce": "00" * 16},
+        auth_key=key,
+    )
+    with AggregationServer(
+        port=0, num_clients=1, min_clients=1, timeout=6.0, auth_key=key
+    ) as server:
+        errors = {}
+
+        def _round():
+            try:
+                server.serve_round(deadline=6.0)
+            except RuntimeError as e:
+                errors["e"] = e
+
+        t = threading.Thread(target=_round, daemon=True)
+        t.start()
+        sock = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        sock.settimeout(5)
+        chal = fr.recv_frame(sock)
+        assert chal.startswith(b"NONC")
+        fr.send_frame(sock, stale)  # replay: valid HMAC, wrong nonce
+        t.join(timeout=12)
+        sock.close()
+    assert "e" in errors and "0/1" in str(errors["e"])
