@@ -22,6 +22,7 @@ __version__ = "0.1.0"
 
 from .config import (  # noqa: F401
     DataConfig,
+    DistillConfig,
     FedConfig,
     MeshConfig,
     ModelConfig,
