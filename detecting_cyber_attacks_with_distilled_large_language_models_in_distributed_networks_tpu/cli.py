@@ -82,6 +82,10 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
         data_kw.update(data_fraction=args.data_fraction)
     if getattr(args, "partition", None):
         data_kw.update(partition=args.partition)
+    if getattr(args, "dirichlet_alpha", None) is not None:
+        # 0 must reach the partitioner and fail loudly there, not silently
+        # fall back to the default.
+        data_kw.update(dirichlet_alpha=args.dirichlet_alpha)
     cfg = dataclasses.replace(
         cfg, model=new_model, data=dataclasses.replace(cfg.data, **data_kw)
     )
@@ -738,6 +742,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-parallel", type=int, help="per-client data-parallel shards")
     p.add_argument("--weighted", action="store_true", help="weight FedAvg by sample count")
     p.add_argument("--partition", help="sample|disjoint|dirichlet")
+    p.add_argument(
+        "--dirichlet-alpha",
+        type=float,
+        help="label-skew concentration for --partition dirichlet "
+        "(smaller = more non-IID; default 0.5)",
+    )
     p.add_argument(
         "--prox-mu",
         type=float,
