@@ -83,7 +83,7 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
     if getattr(args, "partition", None):
         data_kw.update(partition=args.partition)
     if getattr(args, "dirichlet_alpha", None) is not None:
-        # 0 must reach the partitioner and fail loudly there, not silently
+        # Explicit 0 must reach DataConfig's own validation, not silently
         # fall back to the default.
         data_kw.update(dirichlet_alpha=args.dirichlet_alpha)
     cfg = dataclasses.replace(
@@ -104,6 +104,18 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
 
     if hasattr(args, "num_clients"):
         n = args.num_clients or cfg.fed.num_clients
+        participation = (
+            cfg.fed.participation
+            if getattr(args, "participation", None) is None
+            else args.participation
+        )
+        # --participation implies the survivor floor can't exceed the
+        # sampling rate; clamp ONLY the untouched default floor so an
+        # explicitly configured floor still collides loudly in FedConfig
+        # validation instead of being silently weakened.
+        min_frac = cfg.fed.min_client_fraction
+        if participation < min_frac and min_frac == FedConfig().min_client_fraction:
+            min_frac = participation
         cfg = dataclasses.replace(
             cfg,
             fed=dataclasses.replace(
@@ -116,6 +128,8 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
                     if getattr(args, "prox_mu", None) is None
                     else args.prox_mu
                 ),
+                participation=participation,
+                min_client_fraction=min_frac,
             ),
             mesh=MeshConfig(
                 clients=n, data=getattr(args, "data_parallel", None) or cfg.mesh.data
@@ -453,7 +467,11 @@ def cmd_federated(args) -> int:
                     state, stacked_train, epoch_offset=r * cfg.train.epochs_per_round
                 )
                 local = trainer.evaluate_clients(state.params, prepared=prepared)
-                state = trainer.aggregate(state, weights=weights)
+                state = trainer.aggregate(
+                    state,
+                    weights=weights,
+                    client_mask=trainer.participation_mask(r),
+                )
                 aggregated = trainer.evaluate_clients(state.params, prepared=prepared)
             history.append((r, local, aggregated))
             for c in range(C):
@@ -753,6 +771,12 @@ def build_parser() -> argparse.ArgumentParser:
         type=float,
         help="FedProx proximal weight (0 = plain FedAvg); stabilizes "
         "non-IID partitions",
+    )
+    p.add_argument(
+        "--participation",
+        type=float,
+        help="fraction of clients aggregated per round (sampled, seeded); "
+        "1.0 = everyone (reference behavior)",
     )
     p.add_argument("--checkpoint-dir")
     p.add_argument(

@@ -135,6 +135,14 @@ class DataConfig:
     vocab_path: str | None = None
     drop_remainder: bool = True
 
+    def __post_init__(self) -> None:
+        if self.dirichlet_alpha <= 0.0:
+            # numpy 2.x draws an all-zero Dirichlet for alpha=0 silently,
+            # which would hand every sample to the last client.
+            raise ValueError(
+                f"dirichlet_alpha={self.dirichlet_alpha} must be > 0"
+            )
+
     def client_seed(self, client_id: int) -> int:
         return self.seed_base + client_id
 
@@ -193,6 +201,25 @@ class FedConfig:
     # Fresh optimizer state each round — mirrors the reference, where every
     # round is a new process with a newly constructed Adam (client1.py:380).
     reset_optimizer_each_round: bool = True
+    # Partial participation: fraction of clients whose round contributes to
+    # the aggregate (sampled per round, seeded). Under SPMD every replica
+    # still computes in lockstep; non-participants' local epochs are simply
+    # excluded from the masked mean and overwritten by its result. 1.0 =
+    # everyone, the reference's behavior.
+    participation: float = 1.0
+
+    def __post_init__(self) -> None:
+        if not 0.0 < self.participation <= 1.0:
+            raise ValueError(
+                f"participation={self.participation} must be in (0, 1]"
+            )
+        if self.participation < self.min_client_fraction:
+            raise ValueError(
+                f"participation={self.participation} below "
+                f"min_client_fraction={self.min_client_fraction}: every "
+                "round would fail its own survivor check — lower "
+                "min_client_fraction to at most the participation rate"
+            )
 
 
 @dataclass(frozen=True)

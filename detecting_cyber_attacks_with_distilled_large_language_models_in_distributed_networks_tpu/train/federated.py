@@ -499,6 +499,22 @@ class FederatedTrainer:
             out.append(m)
         return out
 
+    def participation_mask(self, round_index: int) -> np.ndarray | None:
+        """Per-round participant sampling (FedConfig.participation < 1):
+        a seeded 0/1 mask over clients, identical on every host. None when
+        everyone participates (the reference's behavior)."""
+        frac = self.cfg.fed.participation
+        if frac >= 1.0:
+            return None
+        # ceil keeps k >= C*frac >= C*min_client_fraction, so the sampled
+        # round always passes aggregate()'s survivor check (round() could
+        # land below it via banker's rounding, e.g. round(2.5) == 2).
+        k = min(self.C, max(1, int(np.ceil(self.C * frac))))
+        rng = np.random.default_rng(self.cfg.train.seed * 7919 + round_index)
+        mask = np.zeros(self.C, np.float64)
+        mask[rng.choice(self.C, size=k, replace=False)] = 1.0
+        return mask
+
     def aggregate(
         self,
         state: FedState,
@@ -564,7 +580,9 @@ class FederatedTrainer:
                 )
             local = self.evaluate_clients(state.params, prepared=prepared)
             with phase(f"round {r + 1}/{R} FedAvg", tag="FED"):
-                state = self.aggregate(state, weights=weights)
+                state = self.aggregate(
+                    state, weights=weights, client_mask=self.participation_mask(r)
+                )
             aggregated = self.evaluate_clients(state.params, prepared=prepared)
             history.append(RoundRecord(r, losses, local, aggregated))
             for c in range(self.C):

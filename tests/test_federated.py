@@ -256,6 +256,39 @@ def test_fedprox_bounds_client_drift(tok, fed_data, eight_devices):
     assert anchored < free * 0.5, (anchored, free)
 
 
+def test_partial_participation(tok, fed_data, eight_devices):
+    """FedConfig.participation: only the sampled clients' params enter the
+    round mean; the replicated result overwrites every replica (incl.
+    non-participants, whose local epochs are discarded)."""
+    clients, stacked_train = fed_data
+    cfg = _cfg(tok, clients=2, data=1, participation=0.5, min_client_fraction=0.5)
+    trainer = FederatedTrainer(cfg, pad_id=tok.pad_id)
+    state = trainer.init_state(seed=0)
+    state, _ = trainer.fit_local(state, stacked_train, epochs=1)
+    pre = jax.tree.map(lambda x: np.asarray(x).copy(), state.params)
+
+    mask = trainer.participation_mask(0)
+    assert mask is not None and mask.sum() == 1  # 1 of 2 clients sampled
+    chosen = int(np.flatnonzero(mask)[0])
+    state = trainer.aggregate(state, client_mask=mask)
+    leaf = np.asarray(jax.tree.leaves(state.params)[0])
+    want = np.asarray(jax.tree.leaves(pre)[0])[chosen]
+    # Mean over a single participant = its params, replicated to everyone.
+    np.testing.assert_allclose(leaf[0], want, rtol=1e-6)
+    np.testing.assert_allclose(leaf[1], want, rtol=1e-6)
+    # Masks are seeded per round and identical across calls.
+    np.testing.assert_array_equal(mask, trainer.participation_mask(0))
+
+    # Everyone-participates configs return no mask; invalid rates rejected.
+    assert FederatedTrainer(
+        _cfg(tok, clients=2, data=1), pad_id=tok.pad_id
+    ).participation_mask(0) is None
+    with pytest.raises(ValueError, match="participation"):
+        _cfg(tok, clients=2, data=1, participation=0.0)
+    with pytest.raises(ValueError, match="min_client_fraction"):
+        _cfg(tok, clients=2, data=1, participation=0.5)  # min_frac stays 1.0
+
+
 def test_masked_aggregation_and_min_fraction(tok, eight_devices):
     cfg = _cfg(tok, clients=4, min_client_fraction=0.5)
     trainer = FederatedTrainer(cfg, pad_id=tok.pad_id)
