@@ -100,9 +100,11 @@ class FederatedClient:
                 nonce_hex = None
                 if self.auth_key is not None:
                     chal = framing.recv_frame(sock)
-                    if len(chal) != 20 or not chal.startswith(b"NONC"):
+                    if len(chal) != len(wire.NONCE_MAGIC) + wire.NONCE_LEN or (
+                        not chal.startswith(wire.NONCE_MAGIC)
+                    ):
                         raise wire.WireError("bad auth challenge from server")
-                    nonce_hex = chal[4:].hex()
+                    nonce_hex = chal[len(wire.NONCE_MAGIC) :].hex()
                     msg = wire.encode(
                         params,
                         meta={**base_meta, "role": "client", "nonce": nonce_hex},

@@ -23,6 +23,7 @@ design:
 
 from __future__ import annotations
 
+import os
 import socket
 import threading
 import time
@@ -141,10 +142,10 @@ class AggregationServer:
                 # the reply (which echoes the same nonce with role=server)
                 # can't be reflected. Without a key, the wire is the
                 # reference-style open protocol and no challenge is sent.
-                import os as _os
-
-                nonce_hex = _os.urandom(16).hex()
-                framing.send_frame(conn, b"NONC" + bytes.fromhex(nonce_hex))
+                nonce_hex = os.urandom(wire.NONCE_LEN).hex()
+                framing.send_frame(
+                    conn, wire.NONCE_MAGIC + bytes.fromhex(nonce_hex)
+                )
             payload = framing.recv_frame(conn)
             flat, meta = wire.decode(payload, auth_key=self.auth_key)
             if self.auth_key is not None and (
@@ -226,16 +227,15 @@ class AggregationServer:
             agg = aggregate_flat([models[i] for i in ids], weights)
             log.info(f"[SERVER] aggregated {len(ids)} models (clients {ids})")
             if self.auth_key is None:
-                # One shared reply blob for every client.
-                replies = {cid: None for cid in ids}
-                shared_reply = wire.encode(
+                # One shared reply blob, referenced by every client.
+                shared = wire.encode(
                     agg, meta={"round_clients": ids}, compression=self.compression
                 )
+                replies = {cid: shared for cid in ids}
             else:
                 # Auth mode: each reply echoes that client's challenge nonce
                 # with role=server, so it can't be replayed or reflected.
                 # (Per-client encode costs one extra payload memcpy each.)
-                shared_reply = None
                 replies = {
                     cid: wire.encode(
                         agg,
@@ -260,7 +260,7 @@ class AggregationServer:
         # every healthy one behind it for a full socket timeout.
         def _reply(cid: int, conn: socket.socket) -> None:
             try:
-                framing.send_frame(conn, replies[cid] or shared_reply)
+                framing.send_frame(conn, replies[cid])
             except (OSError, wire.WireError, ConnectionError) as e:
                 log.info(f"[SERVER] reply to client {cid} failed: {e}")
             finally:

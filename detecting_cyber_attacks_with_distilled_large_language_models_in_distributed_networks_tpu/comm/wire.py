@@ -34,6 +34,10 @@ VERSION = 1
 #: HMAC-SHA256 tag appended after the payload when a shared key is used.
 AUTH_TAG_LEN = 32
 _AUTH_SCHEME = "hmac-sha256"
+#: Challenge frame sent by an authenticated server on connect:
+#: NONCE_MAGIC + NONCE_LEN random bytes, echoed in the client's header.
+NONCE_MAGIC = b"NONC"
+NONCE_LEN = 16
 _ALLOWED_DTYPES = {
     "float32", "float64", "float16", "bfloat16",
     "int8", "int16", "int32", "int64",
