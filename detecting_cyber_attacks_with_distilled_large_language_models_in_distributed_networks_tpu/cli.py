@@ -130,6 +130,16 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
                 ),
                 participation=participation,
                 min_client_fraction=min_frac,
+                dp_clip=(
+                    cfg.fed.dp_clip
+                    if getattr(args, "dp_clip", None) is None
+                    else args.dp_clip
+                ),
+                dp_noise_multiplier=(
+                    cfg.fed.dp_noise_multiplier
+                    if getattr(args, "dp_noise_multiplier", None) is None
+                    else args.dp_noise_multiplier
+                ),
             ),
             mesh=MeshConfig(
                 clients=n, data=getattr(args, "data_parallel", None) or cfg.mesh.data
@@ -462,6 +472,7 @@ def cmd_federated(args) -> int:
     history = []
     with trace(getattr(args, "profile_dir", None)):
         for r in range(start_round, cfg.fed.rounds):
+            anchor = trainer.round_anchor(state)
             with phase(f"round {r + 1}/{cfg.fed.rounds}", tag="FED"):
                 state, losses = trainer.fit_local(
                     state, stacked_train, epoch_offset=r * cfg.train.epochs_per_round
@@ -471,6 +482,8 @@ def cmd_federated(args) -> int:
                     state,
                     weights=weights,
                     client_mask=trainer.participation_mask(r),
+                    anchor=anchor,
+                    round_index=r,
                 )
                 aggregated = trainer.evaluate_clients(state.params, prepared=prepared)
             history.append((r, local, aggregated))
@@ -487,6 +500,30 @@ def cmd_federated(args) -> int:
     if ckpt is not None:
         ckpt.wait()
         ckpt.close()
+
+    if cfg.fed.dp_clip > 0.0 and cfg.fed.dp_noise_multiplier > 0.0:
+        from .parallel.dp import dp_epsilon
+
+        # Only the rounds executed THIS launch are known to have run under
+        # this DP config; a resumed checkpoint's earlier rounds may have
+        # been trained without noise, so the guarantee must not cover them.
+        dp_rounds = cfg.fed.rounds - start_round
+        eps = dp_epsilon(dp_rounds, cfg.fed.dp_noise_multiplier, 1e-5)
+        caveat = (
+            ""
+            if start_round == 0
+            else (
+                f" — covers rounds {start_round + 1}..{cfg.fed.rounds} only; "
+                f"the {start_round} resumed round(s) carry whatever DP "
+                "config they were run with"
+            )
+        )
+        log.info(
+            f"[DP] client-level guarantee for {dp_rounds} round(s): "
+            f"({eps:.3g}, 1e-05)-DP "
+            f"(clip {cfg.fed.dp_clip}, noise x{cfg.fed.dp_noise_multiplier})"
+            f"{caveat}"
+        )
 
     # Final reporting with probs for ROC/PR curves. Under multi-host the
     # per-example probs live on their owning hosts; the metric counts are
@@ -531,6 +568,21 @@ def _auth_key() -> bytes | None:
     return secret.encode() if secret else None
 
 
+def _mask_secret(enabled: bool) -> bytes | None:
+    """Pairwise-mask secret for secure aggregation (comm/secure.py), from
+    the FEDTPU_MASK_SECRET env var. Shared among CLIENTS ONLY — the server
+    must not hold it, or it could unmask individual uploads."""
+    if not enabled:
+        return None
+    secret = os.environ.get("FEDTPU_MASK_SECRET")
+    if not secret:
+        raise SystemExit(
+            "--secure-agg needs FEDTPU_MASK_SECRET set (same value on every "
+            "client; NOT on the server)"
+        )
+    return secret.encode()
+
+
 def cmd_serve(args) -> int:
     from .comm import AggregationServer
 
@@ -543,6 +595,7 @@ def cmd_serve(args) -> int:
         timeout=args.timeout,
         compression=args.compression,
         auth_key=_auth_key(),
+        secure_agg=bool(getattr(args, "secure_agg", False)),
     ) as server:
         log.info(f"[SERVER] listening on {args.host}:{server.port}")
         server.serve(rounds=args.rounds or 1)
@@ -553,7 +606,7 @@ def cmd_client(args) -> int:
     """The reference client1.py end-to-end: train -> eval -> exchange over
     TCP -> load aggregate -> re-eval -> CSVs + plots; degrades to local-only
     reports when the exchange fails (client1.py:405-410)."""
-    from .comm import FederatedClient
+    from .comm import FederatedClient, SecureAggError
     from .train.engine import Trainer
 
     tok, cfg, pretrained = _resolve_with_pretrained(args)
@@ -577,6 +630,8 @@ def cmd_client(args) -> int:
                 args.host, args.port, client_id=args.client_id,
                 timeout=args.timeout, compression=args.compression,
                 auth_key=_auth_key(),
+                secure_secret=_mask_secret(getattr(args, "secure_agg", False)),
+                num_clients=cfg.fed.num_clients,
             )
             aggregated = fed.exchange(host_params, n_samples=len(client_data.train))
         with phase("aggregated evaluation", tag="EVAL"):
@@ -585,7 +640,7 @@ def cmd_client(args) -> int:
             f"[CLIENT {args.client_id}] local acc {local['Accuracy']:.4f} -> "
             f"aggregated acc {agg_metrics['Accuracy']:.4f}"
         )
-    except (ConnectionError, OSError) as e:
+    except (ConnectionError, OSError, SecureAggError) as e:
         log.info(f"[CLIENT {args.client_id}] exchange failed ({e}); local-only reports")
     _write_reports(args.client_id, local, agg_metrics, cfg.output_dir)
     return 0
@@ -778,6 +833,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="fraction of clients aggregated per round (sampled, seeded); "
         "1.0 = everyone (reference behavior)",
     )
+    p.add_argument(
+        "--dp-clip",
+        type=float,
+        help="DP-FedAvg: clip each client's round update to this L2 norm "
+        "before aggregation (0 = off)",
+    )
+    p.add_argument(
+        "--dp-noise-multiplier",
+        type=float,
+        help="DP-FedAvg: Gaussian noise multiplier on the clipped mean "
+        "update (std = multiplier * clip / n_participants); requires "
+        "--dp-clip",
+    )
     p.add_argument("--checkpoint-dir")
     p.add_argument(
         "--coordinator",
@@ -803,6 +871,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--weighted", action="store_true")
     p.add_argument("--timeout", type=float, default=300.0)
     p.add_argument("--compression", default="none", choices=["none", "bf16"])
+    p.add_argument(
+        "--secure-agg",
+        action="store_true",
+        help="secure aggregation: accept pairwise-masked uploads and "
+        "recover only their sum — individual client weights are never "
+        "visible to the server",
+    )
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
@@ -818,6 +893,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-clients", type=int, default=None)  # None: config wins
     p.add_argument("--timeout", type=float, default=300.0)
     p.add_argument("--compression", default="none", choices=["none", "bf16"])
+    p.add_argument(
+        "--secure-agg",
+        action="store_true",
+        help="mask the upload with pairwise secrets (FEDTPU_MASK_SECRET, "
+        "shared by clients only) so the server sees only the sum",
+    )
     p.set_defaults(fn=cmd_client)
 
     p = sub.add_parser("distill", help="teacher -> student knowledge distillation")

@@ -17,7 +17,7 @@ import time
 from typing import Any, Mapping
 
 from ..utils.logging import get_logger
-from . import framing, wire
+from . import framing, secure, wire
 
 log = get_logger()
 
@@ -56,13 +56,28 @@ class FederatedClient:
         timeout: float = 300.0,  # the reference's TIMEOUT (client1.py:22)
         compression: str = "none",
         auth_key: bytes | None = None,
+        secure_secret: bytes | None = None,
+        num_clients: int | None = None,
+        fp_bits: int = secure.DEFAULT_FP_BITS,
     ):
+        if secure_secret is not None and num_clients is None:
+            raise ValueError(
+                "secure aggregation needs num_clients: each client must "
+                "mask against the full advertised participant set"
+            )
         self.host = host
         self.port = port
         self.client_id = client_id
         self.timeout = timeout
         self.compression = compression
         self.auth_key = auth_key
+        self.secure_secret = secure_secret
+        self.num_clients = num_clients
+        self.fp_bits = fp_bits
+        # Highest (per session) round this instance has already masked an
+        # upload for: a later exchange() refuses a replayed advert rather
+        # than masking DIFFERENT weights under the same stream.
+        self._used_rounds: dict[bytes, int] = {}
 
     def exchange(
         self,
@@ -77,27 +92,43 @@ class FederatedClient:
         Retries the whole round-trip on connection errors; a server-side
         WireError (e.g. CRC mismatch after corruption) also retries with a
         fresh upload.
+
+        With ``secure_secret`` set, the upload is the pairwise-masked
+        fixed-point form (comm/secure.py): the server sees only uniform
+        ring elements, never this client's raw weights. Mask streams are
+        keyed by the server's advertised round number (received on
+        connect), so all participants mask consistently and a stream is
+        never reused across rounds — reuse would let the server difference
+        two uploads and unmask this client's weight delta.
         """
         base_meta = {
             "client_id": self.client_id,
             "n_samples": int(n_samples),
             **dict(meta or {}),
         }
-        # Unauthenticated uploads are nonce-free and encode once; in auth
-        # mode each attempt embeds that connection's server challenge, so
-        # encoding happens inside the loop.
+        flat = (
+            wire.flatten_params(params)
+            if self.secure_secret is not None
+            else None
+        )
+        # The plain (no auth, no masking) upload encodes once; auth embeds
+        # the per-connection challenge and secure mode embeds the per-round
+        # masks, so those encode inside the attempt loop.
         msg = (
             wire.encode(params, meta=base_meta, compression=self.compression)
-            if self.auth_key is None
+            if self.auth_key is None and self.secure_secret is None
             else None
         )
         last: Exception | None = None
+        this_call: tuple[bytes, int] | None = None  # (session, round) masked now
         for attempt in range(1, max_retries + 1):
             sock = None
             try:
                 sock = connect_with_retry(self.host, self.port, timeout=self.timeout)
                 sock.settimeout(self.timeout)
                 nonce_hex = None
+                attempt_meta = dict(base_meta)
+                upload = params
                 if self.auth_key is not None:
                     chal = framing.recv_frame(sock)
                     if len(chal) != len(wire.NONCE_MAGIC) + wire.NONCE_LEN or (
@@ -105,9 +136,71 @@ class FederatedClient:
                     ):
                         raise wire.WireError("bad auth challenge from server")
                     nonce_hex = chal[len(wire.NONCE_MAGIC) :].hex()
+                    attempt_meta.update(role="client", nonce=nonce_hex)
+                if self.secure_secret is not None:
+                    import struct as _struct
+
+                    # A secure server adverts immediately after accept; if
+                    # nothing arrives quickly the server is almost surely
+                    # running without --secure-agg. Fail fast and
+                    # non-retryably (retries would stall identically)
+                    # instead of blocking the full socket timeout.
+                    sock.settimeout(min(self.timeout, 30.0))
+                    try:
+                        adv = framing.recv_frame(sock)
+                    except socket.timeout:
+                        raise secure.SecureAggError(
+                            "server sent no round advert — is it running "
+                            "with --secure-agg?"
+                        ) from None
+                    finally:
+                        sock.settimeout(self.timeout)
+                    n_magic = len(wire.ROUND_MAGIC)
+                    if len(adv) != n_magic + 8 + wire.SESSION_LEN or (
+                        not adv.startswith(wire.ROUND_MAGIC)
+                    ):
+                        raise wire.WireError("bad round advert from server")
+                    round_no = _struct.unpack("<Q", adv[n_magic : n_magic + 8])[0]
+                    if round_no >= 2**63:
+                        raise wire.WireError(
+                            f"round advert {round_no} out of range"
+                        )
+                    session = bytes(adv[n_magic + 8 :])
+                    # Freshness: retries of THIS exchange may legitimately
+                    # re-mask the same weights for the same (session,
+                    # round); a replay of an earlier exchange's round would
+                    # mask different weights under the same stream, which
+                    # is exactly the differencing attack — refuse.
+                    prev = self._used_rounds.get(session, -1)
+                    if round_no <= prev and (session, round_no) != this_call:
+                        raise secure.SecureAggError(
+                            f"server replayed round {round_no} (already "
+                            f"masked up to round {prev} this session) — "
+                            "refusing to reuse a mask stream"
+                        )
+                    this_call = (session, round_no)
+                    upload = secure.masked_upload(
+                        flat,
+                        mask_secret=self.secure_secret,
+                        round_index=round_no,
+                        client_id=self.client_id,
+                        participants=range(self.num_clients),
+                        fp_bits=self.fp_bits,
+                        session=session,
+                    )
+                    self._used_rounds[session] = max(prev, round_no)
+                    attempt_meta.update(
+                        secure=True,
+                        fp_bits=self.fp_bits,
+                        round=round_no,
+                        participants=self.num_clients,
+                    )
+                if self.auth_key is not None or self.secure_secret is not None:
+                    # Fresh encode per attempt: the nonce and/or round (and
+                    # with them the masks) change between connections.
                     msg = wire.encode(
-                        params,
-                        meta={**base_meta, "role": "client", "nonce": nonce_hex},
+                        upload,
+                        meta=attempt_meta,
                         compression=self.compression,
                         auth_key=self.auth_key,
                     )

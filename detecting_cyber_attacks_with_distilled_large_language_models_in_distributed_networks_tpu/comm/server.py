@@ -33,7 +33,7 @@ from typing import Any
 import numpy as np
 
 from ..utils.logging import get_logger
-from . import framing, wire
+from . import framing, secure, wire
 
 log = get_logger()
 
@@ -73,6 +73,7 @@ class _Round:
     """One aggregation round's rendezvous state."""
 
     expected: int
+    round_no: int = 0
     models: dict[int, dict] = field(default_factory=dict)  # client_id -> flat params
     n_samples: dict[int, float] = field(default_factory=dict)
     conns: dict[int, socket.socket] = field(default_factory=dict)
@@ -104,13 +105,34 @@ class AggregationServer:
         timeout: float = 300.0,  # the reference's TIMEOUT (server.py:10)
         compression: str = "none",
         auth_key: bytes | None = None,
+        secure_agg: bool = False,
+        fp_bits: int = secure.DEFAULT_FP_BITS,
     ):
+        if secure_agg and weighted:
+            raise ValueError(
+                "secure aggregation is an unweighted ring sum; "
+                "weighted=True is incompatible"
+            )
+        if secure_agg and min_clients is not None and min_clients != num_clients:
+            raise ValueError(
+                "secure aggregation needs every advertised participant's "
+                "masks to cancel: min_clients must equal num_clients"
+            )
         self.num_clients = num_clients
         self.weighted = weighted
         self.min_clients = num_clients if min_clients is None else min_clients
         self.timeout = timeout
         self.compression = compression
         self.auth_key = auth_key
+        self.secure_agg = secure_agg
+        self.fp_bits = fp_bits
+        # Monotonic round counter plus a per-run random session nonce,
+        # advertised to secure clients on connect: mask streams are keyed
+        # by (session, round), so they are fresh across rounds AND across
+        # server restarts (a restarted counter alone would reuse streams,
+        # letting an observer difference two runs' uploads).
+        self._round_counter = 0
+        self._session = os.urandom(16)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -146,6 +168,17 @@ class AggregationServer:
                 framing.send_frame(
                     conn, wire.NONCE_MAGIC + bytes.fromhex(nonce_hex)
                 )
+            if self.secure_agg:
+                # Advertise (round, session) so every participant keys its
+                # mask streams identically — and freshly — for this round.
+                import struct as _struct
+
+                framing.send_frame(
+                    conn,
+                    wire.ROUND_MAGIC
+                    + _struct.pack("<Q", rnd.round_no)
+                    + self._session,
+                )
             payload = framing.recv_frame(conn)
             flat, meta = wire.decode(payload, auth_key=self.auth_key)
             if self.auth_key is not None and (
@@ -157,6 +190,32 @@ class AggregationServer:
                 )
             flat = wire.flatten_params(flat)
             client_id = int(meta.get("client_id", -1))
+            if bool(meta.get("secure", False)) != self.secure_agg:
+                raise wire.WireError(
+                    f"secure-aggregation mode mismatch: server "
+                    f"secure_agg={self.secure_agg}, upload "
+                    f"secure={meta.get('secure', False)}"
+                )
+            if self.secure_agg:
+                if int(meta.get("fp_bits", -1)) != self.fp_bits:
+                    raise wire.WireError(
+                        f"secure upload fp_bits={meta.get('fp_bits')} != server "
+                        f"fp_bits={self.fp_bits}: de-quantization would be wrong"
+                    )
+                if int(meta.get("participants", -1)) != self.num_clients:
+                    # A client masking against a different fleet size would
+                    # carry uncancelled pair masks — the sum would silently
+                    # de-quantize to ring noise.
+                    raise wire.WireError(
+                        f"secure upload masked for "
+                        f"{meta.get('participants')} participants, server "
+                        f"expects {self.num_clients}"
+                    )
+                if int(meta.get("round", -1)) != rnd.round_no:
+                    raise wire.WireError(
+                        f"secure upload keyed to round {meta.get('round')}, "
+                        f"server round is {rnd.round_no}"
+                    )
             with rnd.lock:
                 if rnd.closed:
                     # Round already snapshotted (deadline hit mid-upload):
@@ -189,10 +248,19 @@ class AggregationServer:
             log.info(f"[SERVER] upload failed: {e}")
             conn.close()
 
-    def serve_round(self, *, deadline: float | None = None) -> dict | None:
+    def serve_round(
+        self, *, deadline: float | None = None, round_index: int | None = None
+    ) -> dict | None:
         """Accept uploads until all clients arrive (or deadline), aggregate,
-        reply to every contributor. Returns the aggregated flat params."""
-        rnd = _Round(expected=self.num_clients)
+        reply to every contributor. Returns the aggregated flat params.
+
+        ``round_index`` overrides the internal monotonic round counter
+        (secure clients key their mask streams off the advertised value)."""
+        rnd = _Round(
+            expected=self.num_clients,
+            round_no=self._round_counter if round_index is None else round_index,
+        )
+        self._round_counter = rnd.round_no + 1
         deadline = time.monotonic() + (self.timeout if deadline is None else deadline)
         threads: list[threading.Thread] = []
         while not rnd.complete.is_set() and time.monotonic() < deadline:
@@ -223,9 +291,26 @@ class AggregationServer:
                     f"(min_clients={self.min_clients})"
                 )
             ids = sorted(models)
-            weights = [n_samples[i] for i in ids] if self.weighted else None
-            agg = aggregate_flat([models[i] for i in ids], weights)
-            log.info(f"[SERVER] aggregated {len(ids)} models (clients {ids})")
+            if self.secure_agg:
+                # Masks only cancel over the FULL advertised participant
+                # set; a partial round would de-quantize uniform noise.
+                expected_ids = list(range(self.num_clients))
+                if ids != expected_ids:
+                    raise RuntimeError(
+                        f"secure round incomplete: got clients {ids}, "
+                        f"need exactly {expected_ids}"
+                    )
+                agg = secure.aggregate_masked(
+                    [models[i] for i in ids], self.fp_bits
+                )
+                log.info(
+                    f"[SERVER] secure-aggregated {len(ids)} masked models "
+                    f"(server never saw raw weights)"
+                )
+            else:
+                weights = [n_samples[i] for i in ids] if self.weighted else None
+                agg = aggregate_flat([models[i] for i in ids], weights)
+                log.info(f"[SERVER] aggregated {len(ids)} models (clients {ids})")
             if self.auth_key is None:
                 # One shared reply blob, referenced by every client.
                 shared = wire.encode(

@@ -38,6 +38,15 @@ _AUTH_SCHEME = "hmac-sha256"
 #: NONCE_MAGIC + NONCE_LEN random bytes, echoed in the client's header.
 NONCE_MAGIC = b"NONC"
 NONCE_LEN = 16
+#: Round-advert frame sent by a secure-aggregation server on connect (after
+#: the nonce challenge, if any): ROUND_MAGIC + u64 little-endian round
+#: index + SESSION_LEN random session bytes (fresh per server run).
+#: Clients derive their pairwise mask streams from (session, round), so all
+#: participants of a round mask consistently and a mask stream is never
+#: reused across rounds or server restarts (reuse would let an observer
+#: difference two uploads and unmask a client's weight delta).
+ROUND_MAGIC = b"RNDX"
+SESSION_LEN = 16
 _ALLOWED_DTYPES = {
     "float32", "float64", "float16", "bfloat16",
     "int8", "int16", "int32", "int64",

@@ -207,6 +207,19 @@ class FedConfig:
     # excluded from the masked mean and overwritten by its result. 1.0 =
     # everyone, the reference's behavior.
     participation: float = 1.0
+    # DP-FedAvg (parallel/dp.py): clip each client's round update to this
+    # global L2 norm before aggregation. 0 = off (plain FedAvg, the
+    # reference's algorithm — which ships raw unclipped state dicts,
+    # client1.py:276-295).
+    dp_clip: float = 0.0
+    # Gaussian-mechanism noise multiplier: noise std on the mean update is
+    # noise_multiplier * dp_clip / n_participants. Requires dp_clip > 0.
+    dp_noise_multiplier: float = 0.0
+    # DP noise seed. None (default, the only private choice): fresh OS
+    # entropy per run, agreed across hosts. Setting a value makes the noise
+    # reproducible — anyone who knows it can subtract the noise, so it
+    # VOIDS the (epsilon, delta) guarantee; tests only.
+    dp_seed: int | None = None
 
     def __post_init__(self) -> None:
         if not 0.0 < self.participation <= 1.0:
@@ -219,6 +232,22 @@ class FedConfig:
                 f"min_client_fraction={self.min_client_fraction}: every "
                 "round would fail its own survivor check — lower "
                 "min_client_fraction to at most the participation rate"
+            )
+        if self.dp_clip < 0.0:
+            raise ValueError(f"dp_clip={self.dp_clip} must be >= 0")
+        if self.dp_noise_multiplier < 0.0:
+            raise ValueError(
+                f"dp_noise_multiplier={self.dp_noise_multiplier} must be >= 0"
+            )
+        if self.dp_noise_multiplier > 0.0 and self.dp_clip == 0.0:
+            raise ValueError(
+                "dp_noise_multiplier > 0 requires dp_clip > 0: the noise "
+                "std is calibrated to the clip norm (sensitivity)"
+            )
+        if self.dp_clip > 0.0 and self.weighted:
+            raise ValueError(
+                "dp_clip > 0 is incompatible with weighted FedAvg: the DP "
+                "sensitivity bound assumes a uniform mean over participants"
             )
 
 

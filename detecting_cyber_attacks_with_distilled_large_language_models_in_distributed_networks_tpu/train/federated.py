@@ -273,6 +273,31 @@ class FederatedTrainer:
         self.train_step = train_step
         self.eval_step = eval_step
         self.fedavg_step = make_fedavg_step(self.sh)
+        if self.cfg.fed.dp_clip > 0.0:
+            from ..parallel.dp import make_dp_fedavg_step
+
+            self.dp_fedavg_step = make_dp_fedavg_step(
+                self.sh,
+                clip=float(self.cfg.fed.dp_clip),
+                noise_multiplier=float(self.cfg.fed.dp_noise_multiplier),
+            )
+            # Noise seed: fresh OS entropy (the training seed is public
+            # config — noise derived from it could be regenerated and
+            # subtracted, voiding the guarantee). dp_seed overrides for
+            # reproducible tests. Multi-host: everyone adopts process 0's
+            # draw so the SPMD noise is globally consistent.
+            seed = self.cfg.fed.dp_seed
+            if seed is None:
+                import os as _os
+
+                seed = int.from_bytes(_os.urandom(8), "little") >> 1
+            if self.P > 1:
+                from ..parallel.multihost import allgather_hosts
+
+                seed = int(allgather_hosts(seed)[0])
+            self._dp_seed = seed
+        else:
+            self.dp_fedavg_step = None
         # vmapped optimizer init, compiled once (reset_optimizer runs it
         # every round — a fresh jit lambda per call would recompile).
         self._opt_init = jax.jit(
@@ -515,16 +540,34 @@ class FederatedTrainer:
         mask[rng.choice(self.C, size=k, replace=False)] = 1.0
         return mask
 
+    def round_anchor(self, state: FedState) -> Any | None:
+        """Round-start params snapshot for DP aggregation — capture BEFORE
+        ``fit_local`` (a copy, so donated train-step buffers never alias
+        it). None when DP is off."""
+        if self.dp_fedavg_step is None:
+            return None
+        return jax.tree.map(jnp.copy, state.params)
+
+    def _dp_key(self, round_index: int) -> jax.Array:
+        """Per-round noise key from the run's private DP seed (fresh OS
+        entropy unless FedConfig.dp_seed pins it for tests)."""
+        base = jax.random.key(self._dp_seed, impl=self.cfg.train.prng_impl)
+        return jax.random.fold_in(base, round_index)
+
     def aggregate(
         self,
         state: FedState,
         *,
         weights: np.ndarray | None = None,
         client_mask: np.ndarray | None = None,
+        anchor: Any | None = None,
+        round_index: int = 0,
     ) -> FedState:
         """The FedAvg round boundary. Enforces min_client_fraction (the
         reference instead refuses unless exactly N models arrived,
-        server.py:69-71)."""
+        server.py:69-71). With ``fed.dp_clip > 0`` the boundary runs
+        DP-FedAvg (parallel/dp.py): pass the ``round_anchor`` captured
+        before local training plus the round index (noise key)."""
         if client_mask is not None:
             surviving = float(np.asarray(client_mask).sum())
             if surviving == 0.0 or surviving < self.cfg.fed.min_client_fraction * self.C:
@@ -545,7 +588,35 @@ class FederatedTrainer:
                 )
         w = None if weights is None else jnp.asarray(weights)
         m = None if client_mask is None else jnp.asarray(client_mask)
-        params = self.fedavg_step(state.params, w, m)
+        if self.dp_fedavg_step is not None:
+            if anchor is None:
+                raise ValueError(
+                    "fed.dp_clip > 0: aggregate() needs the round-start "
+                    "anchor — capture it with round_anchor(state) before "
+                    "fit_local"
+                )
+            if w is not None:
+                raise ValueError(
+                    "DP aggregation is a uniform mean (FedConfig forbids "
+                    "weighted=True with dp_clip); do not pass weights"
+                )
+            params, norms = self.dp_fedavg_step(
+                state.params, anchor, self._dp_key(round_index), m
+            )
+            # Log stats over PARTICIPANTS only — masked-out clients' norms
+            # never touched the aggregate and would skew clip-rate tuning.
+            hn = np.asarray(self._host(norms))
+            if client_mask is not None:
+                hn = hn[np.asarray(client_mask) > 0]
+            clipped = int((hn > self.cfg.fed.dp_clip).sum())
+            log.info(
+                f"[DP] round {round_index}: participant update norms "
+                f"median {np.median(hn):.4g} max {hn.max():.4g}; "
+                f"{clipped}/{hn.size} participants clipped at "
+                f"{self.cfg.fed.dp_clip}"
+            )
+        else:
+            params = self.fedavg_step(state.params, w, m)
         return state._replace(params=params)
 
     # ------------------------------------------------------------------- run
@@ -574,6 +645,7 @@ class FederatedTrainer:
         history: list[RoundRecord] = []
         prepared = self.prepare_eval(eval_splits)
         for r in range(R):
+            anchor = self.round_anchor(state)
             with phase(f"round {r + 1}/{R} local training", tag="FED"):
                 state, losses = self.fit_local(
                     state, stacked_train, epoch_offset=r * E
@@ -581,7 +653,11 @@ class FederatedTrainer:
             local = self.evaluate_clients(state.params, prepared=prepared)
             with phase(f"round {r + 1}/{R} FedAvg", tag="FED"):
                 state = self.aggregate(
-                    state, weights=weights, client_mask=self.participation_mask(r)
+                    state,
+                    weights=weights,
+                    client_mask=self.participation_mask(r),
+                    anchor=anchor,
+                    round_index=r,
                 )
             aggregated = self.evaluate_clients(state.params, prepared=prepared)
             history.append(RoundRecord(r, losses, local, aggregated))

@@ -1,0 +1,384 @@
+"""Secure aggregation (comm/secure.py): mask cancellation, uniformity of
+what the server sees, and the end-to-end masked TCP round.
+
+The reference's server reads every client's raw weights off the wire
+(server.py:57-65); here the server must recover ONLY the mean."""
+
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+    AggregationServer,
+    FederatedClient,
+    aggregate_flat,
+    flatten_params,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.secure import (
+    DEFAULT_FP_BITS,
+    SecureAggError,
+    aggregate_masked,
+    dequantize_sum,
+    mask,
+    masked_upload,
+    quantize,
+    sum_masked,
+)
+
+SECRET = b"clients-only-mask-secret"
+
+
+def _params(rng, scale=1.0):
+    return {
+        "encoder": {
+            "kernel": (scale * rng.normal(size=(6, 4))).astype(np.float32),
+            "bias": (scale * rng.normal(size=(4,))).astype(np.float32),
+        },
+        "head": {"w": (scale * rng.normal(size=(4, 2))).astype(np.float32)},
+    }
+
+
+def _flats(rng, n, scale=1.0):
+    return [flatten_params(_params(rng, scale)) for _ in range(n)]
+
+
+def test_quantize_dequantize_roundtrip(rng):
+    flat = flatten_params(_params(rng))
+    q = quantize(flat)
+    back = dequantize_sum(q, n_clients=1)
+    for key in flat:
+        np.testing.assert_allclose(
+            back[key], flat[key], atol=2.0 / (1 << DEFAULT_FP_BITS)
+        )
+
+
+def test_masks_cancel_to_exact_quantized_sum(rng):
+    C = 3
+    flats = _flats(rng, C)
+    ids = list(range(C))
+    masked = [
+        masked_upload(
+            flats[i],
+            mask_secret=SECRET,
+            round_index=4,
+            client_id=i,
+            participants=ids,
+        )
+        for i in ids
+    ]
+    summed = sum_masked(masked)
+    plain_sum = sum_masked([quantize(f) for f in flats])
+    for key in summed:
+        # Bit-exact modular cancellation — not approximate.
+        np.testing.assert_array_equal(summed[key], plain_sum[key])
+
+
+def test_secure_mean_matches_plain_fedavg(rng):
+    C = 4
+    flats = _flats(rng, C)
+    masked = [
+        masked_upload(
+            flats[i],
+            mask_secret=SECRET,
+            round_index=0,
+            client_id=i,
+            participants=range(C),
+        )
+        for i in range(C)
+    ]
+    agg = aggregate_masked(masked)
+    expected = aggregate_flat(flats)
+    for key in expected:
+        np.testing.assert_allclose(
+            agg[key], expected[key], atol=2.0 / (1 << DEFAULT_FP_BITS)
+        )
+
+
+def test_single_upload_reveals_nothing(rng):
+    """One masked upload must look nothing like the raw quantized weights —
+    and two uploads of the SAME weights under different pair partners or
+    rounds must differ (fresh masks per round)."""
+    flat = flatten_params(_params(rng))
+    m1 = masked_upload(
+        flat, mask_secret=SECRET, round_index=0, client_id=0, participants=[0, 1]
+    )
+    q = quantize(flat)
+    for key in q:
+        assert not np.array_equal(m1[key], q[key])
+    m2 = masked_upload(
+        flat, mask_secret=SECRET, round_index=1, client_id=0, participants=[0, 1]
+    )
+    for key in q:
+        assert not np.array_equal(m1[key], m2[key])
+    # Deterministic per (secret, round, pair): same inputs, same masks.
+    m1_again = masked_upload(
+        flat, mask_secret=SECRET, round_index=0, client_id=0, participants=[0, 1]
+    )
+    for key in q:
+        np.testing.assert_array_equal(m1[key], m1_again[key])
+
+
+def test_missing_participant_leaves_garbage(rng):
+    """Without client 2's upload the pairwise masks do NOT cancel — the
+    'sum' is ring noise, which is exactly why the server enforces the full
+    participant set."""
+    C = 3
+    flats = _flats(rng, C)
+    masked = [
+        masked_upload(
+            flats[i],
+            mask_secret=SECRET,
+            round_index=0,
+            client_id=i,
+            participants=range(C),
+        )
+        for i in range(C)
+    ]
+    partial = dequantize_sum(sum_masked(masked[:2]), 2)
+    expected = aggregate_flat(flats[:2])
+    worst = max(
+        np.abs(partial[k] - expected[k]).max() for k in expected
+    )
+    assert worst > 1.0  # uncancelled uniform masks dwarf real weights
+
+
+def test_session_nonce_separates_mask_streams(rng):
+    """Same secret, same round, different server session -> different
+    masks: re-running the pipeline never reuses a stream (an observer
+    can't difference uploads across server restarts)."""
+    flat = flatten_params(_params(rng))
+    kw = dict(mask_secret=SECRET, round_index=0, client_id=0, participants=[0, 1])
+    a = masked_upload(flat, session=b"A" * 16, **kw)
+    b = masked_upload(flat, session=b"B" * 16, **kw)
+    for key in a:
+        assert not np.array_equal(a[key], b[key])
+    # Two live servers draw distinct random sessions.
+    with AggregationServer(port=0, num_clients=2, secure_agg=True) as s1, \
+         AggregationServer(port=0, num_clients=2, secure_agg=True) as s2:
+        assert s1._session != s2._session
+        assert len(s1._session) == 16
+
+
+def test_client_refuses_replayed_round(rng):
+    """A server advertising an already-used (session, round) to a later
+    exchange must be refused — masking different weights under the same
+    stream is the differencing attack."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        SecureAggError,
+        recv_frame,
+        send_frame,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.wire import (
+        ROUND_MAGIC,
+        encode,
+    )
+    import socket as socket_mod
+
+    session = b"S" * 16
+    reply = encode({"w": np.zeros(3, np.float32)}, meta={"round_clients": [0, 1]})
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+
+    def _fake_server():
+        for _ in range(2):  # two connections, SAME advertised round
+            conn, _ = srv.accept()
+            conn.settimeout(10)
+            try:
+                send_frame(conn, ROUND_MAGIC + struct.pack("<Q", 3) + session)
+                recv_frame(conn)
+                send_frame(conn, reply)
+            except Exception:
+                pass  # second connection dies when the client refuses
+            finally:
+                conn.close()
+
+    t = threading.Thread(target=_fake_server, daemon=True)
+    t.start()
+    client = FederatedClient(
+        "127.0.0.1", port, client_id=0, timeout=10,
+        secure_secret=SECRET, num_clients=2,
+    )
+    params = _params(rng)
+    client.exchange(params, max_retries=1)  # first use of round 3: fine
+    with pytest.raises(SecureAggError, match="replayed round 3"):
+        client.exchange(params, max_retries=1)
+    srv.close()
+
+
+def test_mask_input_validation(rng):
+    flat = quantize(flatten_params(_params(rng)))
+    with pytest.raises(SecureAggError, match="participants"):
+        mask(flat, mask_secret=SECRET, round_index=0, client_id=5, participants=[0, 1])
+    with pytest.raises(SecureAggError, match=">= 2"):
+        mask(flat, mask_secret=SECRET, round_index=0, client_id=0, participants=[0])
+    with pytest.raises(SecureAggError, match="expected float"):
+        quantize({"a": np.arange(3, dtype=np.int32)})
+
+
+def test_server_rejects_mode_mismatch(rng):
+    """A raw (unmasked) upload into a secure server must be refused — and a
+    masked upload into a plain server likewise — instead of silently
+    averaging ring elements as if they were weights."""
+    results = {}
+    with AggregationServer(
+        port=0, num_clients=2, timeout=5, secure_agg=True
+    ) as server:
+
+        def _plain_client():
+            try:
+                FederatedClient(
+                    "127.0.0.1", server.port, client_id=0, timeout=5
+                ).exchange(_params(np.random.default_rng(0)), max_retries=1)
+            except ConnectionError as e:
+                results["err"] = e
+
+        t = threading.Thread(target=_plain_client, daemon=True)
+        t.start()
+        with pytest.raises(RuntimeError, match="secure round incomplete|0/2|clients"):
+            server.serve_round(deadline=3.0)
+        t.join(timeout=5)
+    assert "err" in results
+
+
+def test_server_constructor_guards():
+    with pytest.raises(ValueError, match="unweighted"):
+        AggregationServer(port=0, num_clients=2, weighted=True, secure_agg=True)
+    with pytest.raises(ValueError, match="min_clients"):
+        AggregationServer(port=0, num_clients=3, min_clients=2, secure_agg=True)
+    with pytest.raises(ValueError, match="num_clients"):
+        FederatedClient("h", 1, client_id=0, secure_secret=SECRET)
+
+
+@pytest.mark.parametrize("auth", [False, True])
+def test_secure_tcp_round_end_to_end(rng, auth):
+    """Full masked round over localhost: 3 clients upload masked weights,
+    the server recovers only the mean, every client receives it."""
+    C = 3
+    params = [_params(rng) for _ in range(C)]
+    auth_key = b"wire-auth" if auth else None
+    results = {}
+    with AggregationServer(
+        port=0, num_clients=C, timeout=30, secure_agg=True, auth_key=auth_key
+    ) as server:
+
+        def _run_server():
+            results["agg"] = server.serve_round(deadline=30)
+
+        st = threading.Thread(target=_run_server)
+        st.start()
+
+        def _run_client(cid):
+            client = FederatedClient(
+                "127.0.0.1",
+                server.port,
+                client_id=cid,
+                timeout=30,
+                auth_key=auth_key,
+                secure_secret=SECRET,
+                num_clients=C,
+            )
+            results[cid] = client.exchange(params[cid])
+
+        threads = [
+            threading.Thread(target=_run_client, args=(cid,)) for cid in range(C)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        st.join(timeout=30)
+
+    assert "agg" in results and all(c in results for c in range(C))
+    expected = aggregate_flat([flatten_params(p) for p in params])
+    for key, arr in flatten_params(results[0]).items():
+        np.testing.assert_allclose(
+            arr, expected[key], atol=2.0 / (1 << DEFAULT_FP_BITS)
+        )
+    for key, arr in flatten_params(results[1]).items():
+        np.testing.assert_array_equal(arr, flatten_params(results[0])[key])
+
+
+def _secure_round(server, params, *, num_clients, results):
+    """Run one masked round: server thread + one client thread each."""
+    st = threading.Thread(
+        target=lambda: results.__setitem__("agg", server.serve_round(deadline=20))
+    )
+    st.start()
+
+    def _go(cid):
+        results[cid] = FederatedClient(
+            "127.0.0.1",
+            server.port,
+            client_id=cid,
+            timeout=20,
+            secure_secret=SECRET,
+            num_clients=num_clients,
+        ).exchange(params[cid])
+
+    ts = [threading.Thread(target=_go, args=(c,)) for c in range(len(params))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=20)
+    st.join(timeout=20)
+
+
+def test_consecutive_rounds_use_fresh_masks(rng):
+    """The server's round advert advances every round, so the same client
+    weights upload under different masks each round — the server can never
+    difference two rounds' uploads to unmask a client. Both rounds must
+    still aggregate correctly."""
+    C = 2
+    params = [_params(rng) for _ in range(C)]
+    expected = aggregate_flat([flatten_params(p) for p in params])
+    with AggregationServer(
+        port=0, num_clients=C, timeout=20, secure_agg=True
+    ) as server:
+        for _ in range(2):
+            results = {}
+            _secure_round(server, params, num_clients=C, results=results)
+            assert "agg" in results
+            for key, arr in flatten_params(results[0]).items():
+                np.testing.assert_allclose(
+                    arr, expected[key], atol=2.0 / (1 << DEFAULT_FP_BITS)
+                )
+        assert server._round_counter == 2
+
+
+def test_participant_set_mismatch_rejected(rng):
+    """A client masking against a 3-party fleet must be refused by a
+    2-party server (its pair masks vs the absent client would never
+    cancel) rather than silently averaged into ring noise."""
+    params = [_params(rng) for _ in range(2)]
+    with AggregationServer(
+        port=0, num_clients=2, timeout=5, secure_agg=True
+    ) as server:
+        errs = {}
+
+        def _go(cid):
+            try:
+                FederatedClient(
+                    "127.0.0.1",
+                    server.port,
+                    client_id=cid,
+                    timeout=5,
+                    secure_secret=SECRET,
+                    num_clients=3,  # wrong fleet size
+                ).exchange(params[cid], max_retries=1)
+            except ConnectionError as e:
+                errs[cid] = e
+
+        ts = [threading.Thread(target=_go, args=(c,), daemon=True) for c in range(2)]
+        for t in ts:
+            t.start()
+        with pytest.raises(
+            RuntimeError, match="clients arrived|secure round incomplete"
+        ):
+            server.serve_round(deadline=3.0)
+        for t in ts:
+            t.join(timeout=5)
+    assert set(errs) == {0, 1}
