@@ -69,6 +69,8 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
     model_kw: dict[str, Any] = {}
     if getattr(args, "max_len", None):
         model_kw.update(max_len=args.max_len)
+    if getattr(args, "gelu", None):
+        model_kw.update(gelu=args.gelu)
     new_model = cfg.model.replace(**model_kw) if model_kw else cfg.model
 
     # model and data must change together: ExperimentConfig.__post_init__
@@ -197,6 +199,7 @@ def _resolve_with_pretrained(args):
         attention_impl=m.attention_impl,
         ring_axis=m.ring_axis,
         remat=m.remat,
+        gelu=m.gelu,
     )
     if getattr(args, "max_len", None):
         overrides["max_len"] = args.max_len
@@ -750,6 +753,12 @@ def cmd_export_config(args) -> int:
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--config", help="JSON config file (ExperimentConfig.to_dict shape)")
     p.add_argument("--preset", default="tiny", help="tiny|distilbert|bert")
+    p.add_argument(
+        "--gelu",
+        choices=["exact", "tanh"],
+        help="FFN activation: tanh (default, ~20%% faster on TPU, within a "
+        "few bf16 ulps of erf) or exact (HF's erf form, fp32 parity)",
+    )
     p.add_argument(
         "--hf-dir",
         help="HF DistilBERT checkpoint dir (config.json + vocab.txt + "

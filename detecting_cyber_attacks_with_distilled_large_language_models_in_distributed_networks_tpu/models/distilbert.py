@@ -122,7 +122,10 @@ class TransformerBlock(nn.Module):
             kernel_init=nn.initializers.normal(cfg.initializer_range),
             name="lin1",
         )(x)
-        h = jax.nn.gelu(h, approximate=False)  # HF 'gelu' = exact erf form
+        # cfg.gelu: "exact" = HF's erf GELU (fp32 parity); "tanh" = the
+        # tanh form, within a few bf16 ulps of erf and ~20% faster per
+        # step on TPU v5e (config.py ModelConfig.gelu).
+        h = jax.nn.gelu(h, approximate=(cfg.gelu == "tanh"))
         h = nn.Dense(
             cfg.dim,
             dtype=_dtype(cfg.compute_dtype),

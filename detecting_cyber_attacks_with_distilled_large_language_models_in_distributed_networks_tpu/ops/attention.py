@@ -52,9 +52,11 @@ def dot_product_attention(
     ) * scale
     if bias is not None:
         scores = scores + bias.astype(jnp.float32)
-    weights = jax.nn.softmax(scores, axis=-1)
+    weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     if dropout_rate > 0.0 and not deterministic:
+        # Mask AFTER the compute-dtype cast: the [B,H,L,L] keep-mask
+        # multiply then runs at activation width (half the HBM traffic of
+        # an fp32 apply); the mask is 0-or-1/(1-p) noise either way.
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, weights.shape)
-        weights = weights * keep / (1.0 - dropout_rate)
-    weights = weights.astype(q.dtype)
+        weights = weights * keep.astype(q.dtype) * (1.0 / (1.0 - dropout_rate))
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v)

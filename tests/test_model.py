@@ -70,6 +70,26 @@ def test_dropout_train_vs_eval(tiny_params):
     assert np.abs(np.asarray(t1) - np.asarray(t2)).max() > 1e-6
 
 
+def test_tanh_gelu_matches_exact_within_bf16_rounding():
+    """The default fast path (gelu='tanh') must be indistinguishable from
+    HF's erf GELU at bf16 activation width — the basis for keeping it the
+    flagship default while 'exact' serves fp32 parity comparisons."""
+    exact_cfg = TINY.replace(compute_dtype="bfloat16", gelu="exact")
+    tanh_cfg = TINY.replace(compute_dtype="bfloat16", gelu="tanh")
+    params = init_params(DDoSClassifier(exact_cfg), exact_cfg, jax.random.key(3))
+    ids, mask = _batch(exact_cfg, B=8, seed=4)
+    a = np.asarray(DDoSClassifier(exact_cfg).apply({"params": params}, ids, mask))
+    b = np.asarray(DDoSClassifier(tanh_cfg).apply({"params": params}, ids, mask))
+    # Logit differences must stay within a few bf16 ulps of the logit scale.
+    scale = max(1.0, np.abs(a).max())
+    assert np.abs(a - b).max() <= 0.02 * scale
+
+
+def test_gelu_config_validation():
+    with pytest.raises(ValueError, match="gelu"):
+        ModelConfig(gelu="relu")
+
+
 def test_param_count_distilbert_base():
     cfg = ModelConfig()  # distilbert-base
     params = init_params(DistilBertEncoder(cfg), cfg, jax.random.key(0))
