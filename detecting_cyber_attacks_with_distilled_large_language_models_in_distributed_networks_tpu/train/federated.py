@@ -628,10 +628,19 @@ class FederatedTrainer:
         *,
         rounds: int | None = None,
         weights: np.ndarray | None = None,
+        fault_mask_fn: Callable[[int], np.ndarray | None] | None = None,
     ) -> tuple[FedState, list[RoundRecord]]:
         """The full federated flow, per round: local epochs -> local eval ->
         FedAvg -> aggregated eval (the reference's one-shot flow,
-        client1.py:379-404, looped)."""
+        client1.py:379-404, looped).
+
+        ``fault_mask_fn(round) -> [C] 0/1 mask | None`` injects deterministic
+        client failures for a round (a dropped client is excluded from the
+        masked mean, exactly as a crashed client would be — the reference
+        instead hangs its accept loop, server.py:69-71,124-132). Composes
+        with partial participation: a client aggregates only if both masks
+        keep it. ``min_client_fraction`` still gates the round.
+        """
         R = self.cfg.fed.rounds if rounds is None else rounds
         E = self.cfg.train.epochs_per_round
         if weights is None and self.cfg.fed.weighted:
@@ -651,11 +660,23 @@ class FederatedTrainer:
                     state, stacked_train, epoch_offset=r * E
                 )
             local = self.evaluate_clients(state.params, prepared=prepared)
+            mask = self.participation_mask(r)
+            if fault_mask_fn is not None:
+                faults = fault_mask_fn(r)
+                if faults is not None:
+                    faults = np.asarray(faults, np.float64)
+                    mask = faults if mask is None else mask * faults
+                    dropped = [c for c in range(self.C) if faults[c] == 0]
+                    if dropped:
+                        log.info(
+                            f"[FED] round {r + 1}: injected faults drop "
+                            f"clients {dropped}"
+                        )
             with phase(f"round {r + 1}/{R} FedAvg", tag="FED"):
                 state = self.aggregate(
                     state,
                     weights=weights,
-                    client_mask=self.participation_mask(r),
+                    client_mask=mask,
                     anchor=anchor,
                     round_index=r,
                 )
