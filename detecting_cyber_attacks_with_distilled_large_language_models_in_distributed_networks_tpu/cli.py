@@ -9,6 +9,11 @@ covers every deployment shape, parameterized by client id / count:
               (reference client1.py minus the sockets)
   federated   N clients on one TPU mesh: SPMD local epochs + pmean FedAvg,
               multi-round, checkpoint/resume (the TPU-native deployment)
+  predict     batch inference: flow CSV -> per-row P(attack) CSV, from a
+              local/federated checkpoint or a fine-tuned --hf-dir (the
+              deployment step the reference never ships)
+  distill     teacher -> student knowledge distillation (the recipe behind
+              the reference's pre-distilled encoder)
   serve       TCP aggregation server (demo-parity mode, reference server.py)
   client      TCP client: train locally, exchange with a serve process,
               re-evaluate the aggregate (reference client1.py end-to-end)
@@ -649,6 +654,171 @@ def cmd_client(args) -> int:
     return 0
 
 
+def _restore_predict_params(cfg, tok, trainer):
+    """Trained weights for inference from ``--checkpoint-dir``.
+
+    Understands both checkpoint flavors: a ``local``/``client`` TrainState
+    (restored against this trainer's template) and a ``federated`` FedState
+    (recognized by the config in its metadata; restored on the mesh and
+    collapsed to client 0's replica — post-aggregation all replicas are
+    identical). Returns ``(model_cfg, params)``; raises instead of silently
+    predicting from random weights."""
+    from .train.checkpoint import Checkpointer
+
+    if not os.path.isdir(cfg.checkpoint_dir):
+        # Read-only path: don't let the manager create a directory at a
+        # mistyped location (it would later masquerade as a real run dir).
+        raise SystemExit(f"--checkpoint-dir {cfg.checkpoint_dir} does not exist")
+    with Checkpointer(cfg.checkpoint_dir) as ckpt:
+        step = ckpt.latest_step()
+        if step is None:
+            raise SystemExit(f"no checkpoint found in {cfg.checkpoint_dir}")
+        meta = ckpt.restore_meta(step=step)
+        import jax
+
+        if "config" in meta:
+            from .train.federated import FederatedTrainer
+
+            fed_cfg = ExperimentConfig.from_dict(meta["config"])
+            if fed_cfg.model.vocab_size != cfg.model.vocab_size:
+                raise SystemExit(
+                    f"checkpoint model vocab ({fed_cfg.model.vocab_size}) != "
+                    f"tokenizer vocab ({cfg.model.vocab_size}); pass the "
+                    "matching --hf-dir / vocab"
+                )
+            ftr = FederatedTrainer(fed_cfg, pad_id=tok.pad_id)
+            # Abstract template + params-only restore: never materializes
+            # the C-stacked Adam moments (3x C model copies for a fleet
+            # checkpoint); only the [C, ...] params land, and replica 0 is
+            # the global model (FedAvg replicates its output).
+            template = jax.eval_shape(lambda: ftr.init_state(seed=0))
+            stacked = ckpt.restore_params(template, step=step)
+            params = jax.tree.map(lambda x: np.asarray(x)[0], stacked)
+            log.info(
+                f"[PREDICT] restored federated checkpoint (round "
+                f"{meta.get('round', '?')}, {fed_cfg.fed.num_clients} clients)"
+            )
+            return fed_cfg.model, params
+        template = jax.eval_shape(lambda: trainer.init_state(seed=0))
+        try:
+            params = ckpt.restore_params(template, step=step)
+        except Exception as e:
+            raise SystemExit(
+                f"checkpoint at {cfg.checkpoint_dir} (step {step}) does not "
+                f"match the resolved model ({type(e).__name__}: {e}) — pass "
+                "the --preset/--config/--hf-dir the checkpoint was trained "
+                "with"
+            ) from None
+        log.info(f"[PREDICT] restored local checkpoint (step {step})")
+        return cfg.model, params
+
+
+def cmd_predict(args) -> int:
+    """Batch inference on new flows — the deployment step the reference
+    never ships: it trains and evaluates (client1.py:379-400) but offers no
+    way to RUN the detector on unlabeled traffic. Reads a flow CSV (label
+    column optional), writes one row per flow: P(attack), the thresholded
+    0/1 prediction, and its label name; logs metrics when labels exist."""
+    import pandas as pd
+
+    from .data import get_dataset, load_flow_csv
+    from .data.pipeline import TokenizedSplit
+    from .train.engine import Trainer
+
+    if not getattr(args, "csv", None):
+        raise SystemExit("predict needs --csv (the flows to classify)")
+    for flag in ("stream", "source", "synthetic"):
+        if getattr(args, flag, None):
+            raise SystemExit(
+                f"--{flag} is a training-data option; predict reads the "
+                "flows to classify from --csv only"
+            )
+    if not getattr(args, "checkpoint_dir", None) and getattr(args, "hf_dir", None):
+        # Gate BEFORE the (expensive) weight conversion: a bare encoder's
+        # head would be random noise, so predicting from it is meaningless.
+        from .models.hf_convert import hf_dir_has_head
+
+        if not hf_dir_has_head(args.hf_dir):
+            raise SystemExit(
+                f"--hf-dir {args.hf_dir} is a bare encoder (no classifier.* "
+                "weights): its head would be random noise. Train it first "
+                "(local/federated, then --checkpoint-dir), or point --hf-dir "
+                "at a checkpoint fine-tuned with this head architecture"
+            )
+    tok, cfg, pretrained = _resolve_with_pretrained(args)
+    if not cfg.checkpoint_dir and pretrained is None:
+        raise SystemExit(
+            "predict needs trained weights: pass --checkpoint-dir (a local "
+            "or federated training checkpoint) or --hf-dir (a fine-tuned "
+            "classifier checkpoint)"
+        )
+    trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
+    if cfg.checkpoint_dir:
+        model_cfg, params = _restore_predict_params(cfg, tok, trainer)
+        if model_cfg != cfg.model:
+            trainer = Trainer(model_cfg, cfg.train, pad_id=tok.pad_id)
+    else:
+        model_cfg, params = cfg.model, pretrained
+
+    spec = get_dataset(cfg.data.dataset)
+    with phase(f"loading {args.csv}", tag="DATA"):
+        df = load_flow_csv(args.csv)
+        texts = spec.render_texts(df)
+        label_col = cfg.data.label_column if spec.label_kind == "positive" else spec.label_column
+        labels = None
+        if label_col in df.columns:
+            from .data.cicids import _spec_labels
+
+            labels = _spec_labels(df, cfg.data)
+    if not texts:
+        raise SystemExit(f"--csv {args.csv} has no data rows")
+    with phase(f"tokenize {len(texts)} flows", tag="DATA"):
+        enc = tok.batch_encode(texts, max_len=model_cfg.max_len)
+    split = TokenizedSplit(
+        enc["input_ids"],
+        enc["attention_mask"],
+        (labels if labels is not None else np.zeros(len(texts))).astype(np.int32),
+    )
+    bs = cfg.data.eval_batch_size
+    with phase(f"predict ({len(texts)} flows, bs {bs})", tag="EVAL"):
+        # Trainer.evaluate is the one eval pipeline (pad/slice/accumulate);
+        # its metrics are ignored here (labels may be dummies) — predict
+        # only consumes the per-row P(attack) probs.
+        probs = trainer.evaluate(params, split, batch_size=bs)["probs"]
+    preds = (probs >= args.threshold).astype(np.int32)
+    positive = (
+        cfg.data.positive_label if spec.label_kind == "positive" else "attack"
+    )
+    out = pd.DataFrame(
+        {
+            "prob_attack": probs,
+            "prediction": preds,
+            "label_name": np.where(preds == 1, positive, "BENIGN"),
+        }
+    )
+    out.to_csv(args.output, index=False)
+    log.info(
+        f"[PREDICT] wrote {len(out)} predictions to {args.output} "
+        f"({int(preds.sum())} flagged {positive})"
+    )
+    if labels is not None:
+        # Metrics at the SAME threshold the predictions used (sklearn
+        # average='binary' semantics, as the reference's evaluate_model).
+        y = labels.astype(np.int32)
+        tp = int(((preds == 1) & (y == 1)).sum())
+        fp = int(((preds == 1) & (y == 0)).sum())
+        fn = int(((preds == 0) & (y == 1)).sum())
+        prec = tp / (tp + fp) if tp + fp else 0.0
+        rec = tp / (tp + fn) if tp + fn else 0.0
+        f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+        log.info(
+            f"[PREDICT] against the CSV's labels (threshold "
+            f"{args.threshold}): acc {(preds == y).mean() * 100:.4f} "
+            f"prec {prec:.4f} rec {rec:.4f} f1 {f1:.4f}"
+        )
+    return 0
+
+
 def cmd_distill(args) -> int:
     """Train a (2x-deeper by default) teacher, distill it into the student
     encoder, evaluate both — the recipe that produced the reference's
@@ -909,6 +1079,23 @@ def build_parser() -> argparse.ArgumentParser:
         "shared by clients only) so the server sees only the sum",
     )
     p.set_defaults(fn=cmd_client)
+
+    p = sub.add_parser(
+        "predict",
+        help="batch inference: flow CSV -> per-row attack probability CSV",
+    )
+    _add_common(p)  # provides --csv (required here), --dataset, model flags
+    p.add_argument(
+        "--output", default="predictions.csv", help="predictions CSV path"
+    )
+    p.add_argument("--checkpoint-dir", help="local or federated training checkpoint")
+    p.add_argument(
+        "--threshold",
+        type=float,
+        default=0.5,
+        help="P(attack) decision threshold (default 0.5)",
+    )
+    p.set_defaults(fn=cmd_predict)
 
     p = sub.add_parser("distill", help="teacher -> student knowledge distillation")
     _add_common(p)

@@ -50,8 +50,21 @@ def hf_to_flax(
     reference's starting condition), the head is initialized from
     ``head_rng`` (normal(initializer_range), zero bias) — mirroring the fresh
     ``nn.Linear(768, 2)`` at reference client1.py:58.
+
+    HF ``DistilBertForSequenceClassification`` checkpoints carry an extra
+    ``pre_classifier`` Linear+ReLU under their ``classifier`` — an
+    architecture this model does not have (the reference's head is CLS ->
+    dropout -> Linear, client1.py:57-58). Converting only ``classifier.*``
+    would silently produce wrong logits, so such checkpoints are rejected.
     """
     sd, has_head = _strip_prefix(state_dict)
+    if any(k.startswith("pre_classifier.") for k in sd):
+        raise ValueError(
+            "this is an HF sequence-classification checkpoint (it has a "
+            "pre_classifier layer this architecture lacks) — converting it "
+            "would silently drop trained weights. Start from its bare "
+            "encoder instead and fine-tune here (local/federated)."
+        )
 
     def lin(prefix: str) -> dict:
         return {
@@ -102,6 +115,31 @@ def hf_to_flax(
             "bias": np.zeros((cfg.n_classes,), np.float32),
         }
     return {"encoder": encoder, "classifier": head}
+
+
+def hf_dir_has_head(path: str) -> bool:
+    """Whether the HF checkpoint dir carries trained ``classifier.*``
+    weights — a bare encoder (the reference's ``./distilbert-base-uncased``)
+    would get a randomly initialized head from :func:`hf_to_flax`, which is
+    fine for training warm-starts but meaningless for inference."""
+    import os
+
+    st_path = os.path.join(path, "model.safetensors")
+    bin_path = os.path.join(path, "pytorch_model.bin")
+    if os.path.exists(st_path):
+        from safetensors import safe_open
+
+        with safe_open(st_path, framework="numpy") as f:
+            keys = list(f.keys())
+    elif os.path.exists(bin_path):
+        import torch
+
+        keys = list(torch.load(bin_path, map_location="cpu", weights_only=True))
+    else:
+        raise FileNotFoundError(
+            f"no model.safetensors or pytorch_model.bin under {path}"
+        )
+    return any(k.startswith("classifier.") for k in keys)
 
 
 def config_from_hf_dir(path: str, **overrides: Any) -> ModelConfig:

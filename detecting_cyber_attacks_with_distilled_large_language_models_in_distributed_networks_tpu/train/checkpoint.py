@@ -116,6 +116,39 @@ class Checkpointer:
         )[STATE_ITEM]
         return _rewrap_keys(restored, template)
 
+    def restore_params(self, template: Any, *, step: int | None = None) -> Any:
+        """Restore ONLY the ``params`` field of a saved TrainState/FedState.
+
+        Every other field is skipped via ``ocp.PLACEHOLDER``, so optimizer
+        moments are never materialized — restoring a C-client FedState just
+        to read the (replicated) model would otherwise allocate ~3x C model
+        copies. Build ``template`` with ``jax.eval_shape(lambda:
+        init_state(...))`` so the template itself materializes nothing.
+
+        NOTE: placeholder skipping is a PyTreeRestore feature, and the
+        composite handler registry binds one restore-args class per item
+        per manager instance — call this on a Checkpointer that has not
+        already restored the full state (predict constructs its own).
+        """
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        abstract = _abstract(template)
+        masked = abstract._replace(
+            **{
+                f: jax.tree.map(lambda _: ocp.PLACEHOLDER, getattr(abstract, f))
+                for f in abstract._fields
+                if f != "params"
+            }
+        )
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                **{STATE_ITEM: ocp.args.PyTreeRestore(item=masked)}
+            ),
+        )[STATE_ITEM]
+        return restored.params
+
     def restore_meta(self, *, step: int | None = None) -> dict:
         step = self.latest_step() if step is None else step
         if step is None:

@@ -124,3 +124,49 @@ def test_cli_hf_dir_vocab_mismatch(hf_dir, tmp_path):
         f.write("extratoken\n")
     with pytest.raises(SystemExit, match="vocab"):
         main(["local", "--hf-dir", str(bad), "--synthetic", "50"])
+
+
+def test_hf_dir_has_head_detection(hf_dir):
+    """A bare DistilBertModel checkpoint has no classifier head — predict
+    must be able to detect that (its head would be random noise)."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.models.hf_convert import (
+        hf_dir_has_head,
+    )
+
+    assert hf_dir_has_head(hf_dir) is False
+
+
+def test_predict_rejects_bare_encoder_hf_dir(hf_dir, tmp_path):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
+        main,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+        write_synthetic_csv,
+    )
+
+    csv = str(tmp_path / "flows.csv")
+    write_synthetic_csv(csv, n_rows=20, seed=3)
+    with pytest.raises(SystemExit, match="bare encoder"):
+        main(
+            ["predict", "--csv", csv, "--hf-dir", hf_dir,
+             "--output", str(tmp_path / "p.csv")]
+        )
+
+
+def test_hf_to_flax_rejects_sequence_classifier_checkpoints(hf_dir):
+    """An HF DistilBertForSequenceClassification state dict carries a
+    pre_classifier layer this architecture lacks — converting it would
+    silently drop trained weights, so it must be refused."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.models.hf_convert import (
+        config_from_hf_dir,
+        hf_to_flax,
+    )
+
+    model = transformers.DistilBertModel.from_pretrained(hf_dir)
+    sd = {f"distilbert.{k}": v for k, v in model.state_dict().items()}
+    sd["pre_classifier.weight"] = np.zeros((DIM, DIM), np.float32)
+    sd["pre_classifier.bias"] = np.zeros((DIM,), np.float32)
+    sd["classifier.weight"] = np.zeros((2, DIM), np.float32)
+    sd["classifier.bias"] = np.zeros((2,), np.float32)
+    with pytest.raises(ValueError, match="pre_classifier"):
+        hf_to_flax(sd, config_from_hf_dir(hf_dir))
