@@ -343,6 +343,14 @@ def cmd_local(args) -> int:
         f"[CLIENT {args.client_id}] val acc {val['Accuracy']:.4f} | "
         f"test acc {test['Accuracy']:.4f} f1 {test['F1-Score']:.4f}"
     )
+    if getattr(args, "metrics_jsonl", None):
+        from .reporting import append_metrics_jsonl
+
+        for phase_name, m in (("val", val), ("test", test)):
+            append_metrics_jsonl(
+                args.metrics_jsonl,
+                {"client": args.client_id, "phase": phase_name, **m},
+            )
     _write_reports(args.client_id, test, None, cfg.output_dir)
     if cfg.checkpoint_dir:
         from .train.checkpoint import Checkpointer
@@ -501,6 +509,15 @@ def cmd_federated(args) -> int:
                     f"{local[c]['Accuracy']:.4f} -> aggregated "
                     f"{aggregated[c]['Accuracy']:.4f}"
                 )
+            if getattr(args, "metrics_jsonl", None) and jax.process_index() == 0:
+                from .reporting import append_metrics_jsonl
+
+                for c in range(C):
+                    for phase_name, m in (("local", local[c]), ("aggregated", aggregated[c])):
+                        append_metrics_jsonl(
+                            args.metrics_jsonl,
+                            {"round": r + 1, "client": c, "phase": phase_name, **m},
+                        )
             if ckpt is not None:
                 ckpt.save(r + 1, state, meta={"round": r + 1, "config": cfg.to_dict()})
             if r + 1 < cfg.fed.rounds and cfg.fed.reset_optimizer_each_round:
@@ -611,9 +628,16 @@ def cmd_serve(args) -> int:
 
 
 def cmd_client(args) -> int:
-    """The reference client1.py end-to-end: train -> eval -> exchange over
-    TCP -> load aggregate -> re-eval -> CSVs + plots; degrades to local-only
-    reports when the exchange fails (client1.py:405-410)."""
+    """The reference client1.py end-to-end: (warm start ->) train -> eval ->
+    exchange over TCP -> load aggregate -> re-eval -> CSVs + plots; degrades
+    to local-only reports when the exchange fails (client1.py:405-410).
+
+    ``--checkpoint-dir`` is the reference's ``client{N}_model.pth`` pattern
+    (save after local training and after applying the aggregate, auto-load
+    on the next launch, client1.py:375-377,388,403 — its only multi-round
+    mechanism), upgraded to full Orbax state. ``--rounds R`` runs the
+    re-launch loop in-process instead (the server must be serving at least
+    as many rounds)."""
     from .comm import FederatedClient, SecureAggError
     from .train.engine import Trainer
 
@@ -621,35 +645,101 @@ def cmd_client(args) -> int:
     client_data = _load_clients(args, cfg, tok, cfg.fed.num_clients)[args.client_id]
     trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
     state = trainer.init_state(params=pretrained)
-    with phase(f"client {args.client_id} local training", tag="TRAIN"):
-        state, _ = trainer.fit(
-            state, client_data.train, batch_size=cfg.data.batch_size,
-            tag=f"[CLIENT {args.client_id}] ",
-        )
-    local = trainer.evaluate(state.params, client_data.test)
+    ckpt = None
+    if cfg.checkpoint_dir:
+        from .train.checkpoint import Checkpointer, maybe_warm_start
+
+        restored, step = maybe_warm_start(cfg.checkpoint_dir, state)
+        if restored is not None:
+            state = restored
+            log.info(
+                f"[CLIENT {args.client_id}] warm start from "
+                f"{cfg.checkpoint_dir} (step {step})"
+            )
+        ckpt = Checkpointer(cfg.checkpoint_dir)
 
     import jax
 
-    host_params = jax.tree.map(np.asarray, state.params)
-    agg_metrics = None
-    try:
-        with phase("federated exchange", tag="COMM"):
-            fed = FederatedClient(
-                args.host, args.port, client_id=args.client_id,
-                timeout=args.timeout, compression=args.compression,
-                auth_key=_auth_key(),
-                secure_secret=_mask_secret(getattr(args, "secure_agg", False)),
-                num_clients=cfg.fed.num_clients,
+    fed = FederatedClient(
+        args.host, args.port, client_id=args.client_id,
+        timeout=args.timeout, compression=args.compression,
+        auth_key=_auth_key(),
+        secure_secret=_mask_secret(getattr(args, "secure_agg", False)),
+        num_clients=cfg.fed.num_clients,
+    )
+    import jax.numpy as jnp
+
+    rounds = max(1, getattr(args, "rounds", None) or 1)
+    local = agg_metrics = None
+    E = cfg.train.epochs_per_round
+    # Orbax step ids must be unique and increasing, and a duplicate save is
+    # SILENTLY skipped — two saves per round (post-train, post-aggregate)
+    # need their own sequence, seeded past the previous run's ids on warm
+    # start (state.step alone can lag them).
+    save_seq = int(state.step)
+    if ckpt is not None:
+        save_seq = max(save_seq, ckpt.latest_step() or 0)
+    for r in range(rounds):
+        with phase(f"client {args.client_id} round {r + 1}/{rounds} training", tag="TRAIN"):
+            state, _ = trainer.fit(
+                state, client_data.train, batch_size=cfg.data.batch_size,
+                epoch_offset=r * E, tag=f"[CLIENT {args.client_id}] ",
             )
-            aggregated = fed.exchange(host_params, n_samples=len(client_data.train))
-        with phase("aggregated evaluation", tag="EVAL"):
-            agg_metrics = trainer.evaluate(aggregated, client_data.test)
-        log.info(
-            f"[CLIENT {args.client_id}] local acc {local['Accuracy']:.4f} -> "
-            f"aggregated acc {agg_metrics['Accuracy']:.4f}"
-        )
-    except (ConnectionError, OSError, SecureAggError) as e:
-        log.info(f"[CLIENT {args.client_id}] exchange failed ({e}); local-only reports")
+        local = trainer.evaluate(state.params, client_data.test)
+        if ckpt is not None:
+            # Post-train save — the reference's client1.py:388.
+            save_seq += 1
+            ckpt.save(save_seq, state, meta={"client_id": args.client_id})
+        host_params = jax.tree.map(np.asarray, state.params)
+        try:
+            with phase("federated exchange", tag="COMM"):
+                aggregated = fed.exchange(
+                    host_params, n_samples=len(client_data.train)
+                )
+            with phase("aggregated evaluation", tag="EVAL"):
+                agg_metrics = trainer.evaluate(aggregated, client_data.test)
+            log.info(
+                f"[CLIENT {args.client_id}] round {r + 1}: local acc "
+                f"{local['Accuracy']:.4f} -> aggregated acc "
+                f"{agg_metrics['Accuracy']:.4f}"
+            )
+            if getattr(args, "metrics_jsonl", None):
+                from .reporting import append_metrics_jsonl
+
+                for phase_name, m in (("local", local), ("aggregated", agg_metrics)):
+                    append_metrics_jsonl(
+                        args.metrics_jsonl,
+                        {
+                            "round": r + 1,
+                            "client": args.client_id,
+                            "phase": phase_name,
+                            **m,
+                        },
+                    )
+            # Continue the next round FROM the aggregate with a fresh Adam
+            # (every reference re-launch constructs a new optimizer,
+            # client1.py:380) but a continuing step counter (LR warmup).
+            trained_steps = int(state.step)
+            state = trainer.init_state(params=aggregated)
+            state = state._replace(step=jnp.asarray(trained_steps, jnp.int32))
+            if ckpt is not None:
+                # Post-aggregate save — the reference's client1.py:403.
+                save_seq += 1
+                ckpt.save(
+                    save_seq,
+                    state,
+                    meta={"client_id": args.client_id, "aggregated": True},
+                )
+        except (ConnectionError, OSError, SecureAggError) as e:
+            agg_metrics = None
+            log.info(
+                f"[CLIENT {args.client_id}] round {r + 1} exchange failed "
+                f"({e}); local-only reports"
+            )
+            break
+    if ckpt is not None:
+        ckpt.wait()
+        ckpt.close()
     _write_reports(args.client_id, local, agg_metrics, cfg.output_dir)
     return 0
 
@@ -971,6 +1061,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         help="write a jax.profiler trace of the training phase here "
         "(view with xprof/tensorboard)",
     )
+    p.add_argument(
+        "--metrics-jsonl",
+        help="append one structured JSON record per (round, client, phase) "
+        "here — machine-readable observability the reference's prints/CSVs "
+        "lack (pd.read_json(..., lines=True))",
+    )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -1077,6 +1173,18 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="mask the upload with pairwise secrets (FEDTPU_MASK_SECRET, "
         "shared by clients only) so the server sees only the sum",
+    )
+    p.add_argument(
+        "--checkpoint-dir",
+        help="warm-start + save full state here (the reference's "
+        "client{N}_model.pth re-launch pattern, client1.py:375-377,388,403)",
+    )
+    p.add_argument(
+        "--rounds",
+        type=int,
+        default=1,
+        help="train/exchange rounds in one process (server must serve >= "
+        "this many); the reference achieves this by re-launching",
     )
     p.set_defaults(fn=cmd_client)
 

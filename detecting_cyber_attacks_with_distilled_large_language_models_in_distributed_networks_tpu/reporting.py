@@ -58,6 +58,29 @@ def load_metrics(filename: str) -> dict[str, float]:
     return {k: float(v) for k, v in row.items()}
 
 
+def append_metrics_jsonl(path: str, record: Mapping[str, object]) -> None:
+    """Append one structured metrics record as a JSON line.
+
+    The reference's only observability is timestamped prints + one-row CSVs
+    (SURVEY.md §5); a JSONL stream is the machine-readable upgrade — one
+    self-describing record per (round, client, phase), greppable and
+    loadable into pandas (``pd.read_json(path, lines=True)``). Non-scalar
+    metric entries (probs/labels arrays) are dropped, not serialized.
+    """
+    import json
+    import time
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    clean = {
+        k: (v.item() if isinstance(v, np.generic) else v)
+        for k, v in record.items()
+        if isinstance(v, (str, int, float, bool, np.generic)) or v is None
+    }
+    clean.setdefault("ts", time.time())
+    with open(path, "a") as f:
+        f.write(json.dumps(clean) + "\n")
+
+
 # ------------------------------------------------------------- curve math
 def roc_curve(labels: np.ndarray, probs: np.ndarray):
     """ROC points (fpr, tpr, thresholds), numpy-native.

@@ -121,3 +121,32 @@ def test_plot_evaluation_degraded_local_only(tmp_path):
     written = reporting.plot_evaluation(base, None, str(tmp_path), client_id=2)
     names = {p.split("/")[-1] for p in written}
     assert names == {"client2_local_confusion_matrix.png"}
+
+
+def test_append_metrics_jsonl(tmp_path):
+    """Structured per-round records: scalars kept, arrays dropped, one JSON
+    object per line, pandas-loadable."""
+    import json
+
+    import numpy as np
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.reporting import (
+        append_metrics_jsonl,
+    )
+
+    path = str(tmp_path / "m" / "rounds.jsonl")
+    append_metrics_jsonl(
+        path,
+        {
+            "round": 1, "client": 0, "phase": "local",
+            "Accuracy": np.float32(99.5), "Loss": 0.01,
+            "probs": np.zeros(10),  # non-scalar: dropped
+        },
+    )
+    append_metrics_jsonl(path, {"round": 1, "client": 1, "phase": "aggregated"})
+    lines = [json.loads(line) for line in open(path)]
+    assert len(lines) == 2
+    assert lines[0]["Accuracy"] == pytest.approx(99.5)
+    assert "probs" not in lines[0]
+    assert all("ts" in rec for rec in lines)
+    assert lines[1]["phase"] == "aggregated"
