@@ -208,12 +208,18 @@ def main() -> None:
         bench_train(ModelConfig(), "distilbert")
     elif mode == "bert":
         bench_train(ModelConfig.bert_base(), "bertbase")
+    elif mode == "bertlarge":
+        # 335 M params: bs 32 fits one v5e chip comfortably with remat off.
+        os.environ.setdefault("BENCH_BATCH", "32")
+        bench_train(ModelConfig.bert_large(), "bertlarge")
     elif mode == "eval":
         bench_eval()
     elif mode == "fedavg":
         bench_fedavg()
     else:
-        raise SystemExit(f"unknown BENCH_MODE {mode!r} (train|bert|eval|fedavg)")
+        raise SystemExit(
+            f"unknown BENCH_MODE {mode!r} (train|bert|bertlarge|eval|fedavg)"
+        )
 
 
 if __name__ == "__main__":

@@ -55,7 +55,11 @@ def _preset_model(preset: str, vocab_size: int) -> ModelConfig:
         return ModelConfig(vocab_size=vocab_size)
     if preset == "bert":
         return ModelConfig.bert_base(vocab_size=vocab_size)
-    raise SystemExit(f"unknown --preset {preset!r} (tiny|distilbert|bert)")
+    if preset == "bert-large":
+        return ModelConfig.bert_large(vocab_size=vocab_size)
+    raise SystemExit(
+        f"unknown --preset {preset!r} (tiny|distilbert|bert|bert-large)"
+    )
 
 
 def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentConfig:
@@ -204,8 +208,11 @@ def _resolve_with_pretrained(args):
         attention_impl=m.attention_impl,
         ring_axis=m.ring_axis,
         remat=m.remat,
-        gelu=m.gelu,
     )
+    if getattr(args, "gelu", None):
+        # Explicit flag only: otherwise the checkpoint's declared
+        # activation (config.json "activation") governs.
+        overrides["gelu"] = args.gelu
     if getattr(args, "max_len", None):
         overrides["max_len"] = args.max_len
     model_cfg = config_from_hf_dir(hf_dir, **overrides)
@@ -909,6 +916,60 @@ def cmd_predict(args) -> int:
     return 0
 
 
+def cmd_export_hf(args) -> int:
+    """Export trained weights to the HF DistilBERT checkpoint layout
+    (config.json + model.safetensors + vocab.txt) — the reference's own
+    artifact format (its required ``./distilbert-base-uncased`` input dir
+    and its ``.pth`` state dicts use the same key space, client1.py:56,388).
+    A reference user can load this with ``DistilBertModel.from_pretrained``
+    or hand it back to this framework via ``--hf-dir``."""
+    import jax
+
+    from .models.hf_convert import flax_to_hf
+    from .train.engine import Trainer
+
+    tok, cfg, _ = _resolve_with_pretrained(args)
+    if not cfg.checkpoint_dir:
+        raise SystemExit("export-hf needs --checkpoint-dir (trained weights)")
+    trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
+    model_cfg, params = _restore_predict_params(cfg, tok, trainer)
+    if model_cfg.n_classes != 2 or not isinstance(params, dict) or "encoder" not in params:
+        raise SystemExit("checkpoint does not hold a classifier params tree")
+    sd = flax_to_hf(jax.tree.map(np.asarray, params), model_cfg)
+
+    out = args.out
+    os.makedirs(out, exist_ok=True)
+    from safetensors.numpy import save_file
+
+    save_file(sd, os.path.join(out, "model.safetensors"))
+    hf_config = {
+        "architectures": ["DistilBertModel"],
+        "model_type": "distilbert",
+        "vocab_size": model_cfg.vocab_size,
+        "dim": model_cfg.dim,
+        "n_layers": model_cfg.n_layers,
+        "n_heads": model_cfg.n_heads,
+        "hidden_dim": model_cfg.hidden_dim,
+        "max_position_embeddings": model_cfg.max_position_embeddings,
+        "dropout": model_cfg.dropout,
+        "attention_dropout": model_cfg.attention_dropout,
+        "pad_token_id": model_cfg.pad_token_id,
+        "initializer_range": model_cfg.initializer_range,
+        # Declare the activation the weights were actually trained under:
+        # HF's "gelu" is the erf form, "gelu_new" the tanh form.
+        "activation": "gelu" if model_cfg.gelu == "exact" else "gelu_new",
+        "tie_weights_": True,
+    }
+    with open(os.path.join(out, "config.json"), "w") as f:
+        json.dump(hf_config, f, indent=2)
+    tok.save_vocab(os.path.join(out, "vocab.txt"))
+    log.info(
+        f"[EXPORT] wrote HF checkpoint ({len(sd)} tensors, "
+        f"{sum(v.nbytes for v in sd.values()) / 1e6:.1f} MB) to {out}"
+    )
+    return 0
+
+
 def cmd_distill(args) -> int:
     """Train a (2x-deeper by default) teacher, distill it into the student
     encoder, evaluate both — the recipe that produced the reference's
@@ -1012,7 +1073,9 @@ def cmd_export_config(args) -> int:
 # ------------------------------------------------------------------ parser
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--config", help="JSON config file (ExperimentConfig.to_dict shape)")
-    p.add_argument("--preset", default="tiny", help="tiny|distilbert|bert")
+    p.add_argument(
+        "--preset", default="tiny", help="tiny|distilbert|bert|bert-large"
+    )
     p.add_argument(
         "--gelu",
         choices=["exact", "tanh"],
@@ -1145,7 +1208,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--min-clients", type=int, default=None)
     p.add_argument("--weighted", action="store_true")
     p.add_argument("--timeout", type=float, default=300.0)
-    p.add_argument("--compression", default="none", choices=["none", "bf16"])
+    p.add_argument("--compression", default="none", choices=["none", "bf16", "int8"])
     p.add_argument(
         "--secure-agg",
         action="store_true",
@@ -1167,7 +1230,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--client-id", type=int, required=True)
     p.add_argument("--num-clients", type=int, default=None)  # None: config wins
     p.add_argument("--timeout", type=float, default=300.0)
-    p.add_argument("--compression", default="none", choices=["none", "bf16"])
+    p.add_argument("--compression", default="none", choices=["none", "bf16", "int8"])
     p.add_argument(
         "--secure-agg",
         action="store_true",
@@ -1218,6 +1281,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--checkpoint-dir")
     p.set_defaults(fn=cmd_distill)
+
+    p = sub.add_parser(
+        "export-hf",
+        help="export a trained checkpoint to the HF DistilBERT layout "
+        "(config.json + model.safetensors + vocab.txt)",
+    )
+    _add_common(p)
+    p.add_argument("--checkpoint-dir", required=True)
+    p.add_argument("--out", required=True, help="output HF checkpoint dir")
+    p.set_defaults(fn=cmd_export_hf)
 
     p = sub.add_parser("export-config", help="print the resolved config as JSON")
     _add_common(p)

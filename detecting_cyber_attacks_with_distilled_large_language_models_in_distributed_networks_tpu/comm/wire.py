@@ -15,6 +15,11 @@ params dict, so decode rebuilds the pytree with no embedded type tags.
 Optional ``compression="bf16"`` packs float32 tensors to bfloat16 via the
 native fedwire library (comm/native.py) — a 2x cut that matches TPU compute
 precision, instead of the reference's ~11 s/round byte-level gzip.
+``compression="int8"`` goes further (4x vs fp32): symmetric per-row
+quantization, each leading-axis row carrying its own fp32 scale
+(``max|row| / 127``) prepended to the tensor's payload segment. Worst-case
+per-weight error is half a quantization step (~0.4% of the row's max) —
+lossier than bf16; an opt-in bandwidth/fidelity trade for slow links.
 """
 
 from __future__ import annotations
@@ -56,6 +61,39 @@ _ALLOWED_DTYPES = {
 
 class WireError(ValueError):
     """Malformed, corrupt, or version-mismatched message."""
+
+
+# --------------------------------------------------- int8 row quantization
+def _int8_rows(arr: np.ndarray) -> tuple[np.ndarray, int]:
+    """View ``arr`` as [rows, cols] for per-row quantization (leading axis
+    = rows; scalars/1-D become one row). Explicit cols so zero-size
+    tensors reshape cleanly (reshape(-1) is ambiguous at size 0)."""
+    rows = arr.shape[0] if arr.ndim >= 2 else 1
+    cols = arr.size // rows if rows else 0
+    return arr.reshape(rows, cols), rows
+
+
+def quantize_int8(arr: np.ndarray) -> bytes:
+    """fp32 tensor -> payload bytes: [rows x fp32 scale] + [int8 data]."""
+    a, rows = _int8_rows(np.ascontiguousarray(arr, np.float32))
+    amax = np.abs(a).max(axis=1) if a.size else np.zeros(rows, np.float32)
+    scales = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(a / scales[:, None]), -127, 127).astype(np.int8)
+    return scales.tobytes() + q.tobytes()
+
+
+def dequantize_int8(raw, shape: tuple[int, ...]) -> np.ndarray:
+    """Inverse of :func:`quantize_int8` for a tensor of ``shape``."""
+    rows = shape[0] if len(shape) >= 2 else 1
+    cols = int(np.prod(shape)) // rows if rows else 0
+    want = 4 * rows + rows * cols
+    if len(raw) != want:
+        raise WireError(
+            f"int8 tensor payload is {len(raw)} bytes, expected {want}"
+        )
+    scales = np.frombuffer(raw[: 4 * rows], np.float32)
+    q = np.frombuffer(raw[4 * rows :], np.int8).reshape(rows, cols)
+    return (q.astype(np.float32) * scales[:, None]).reshape(shape)
 
 
 # ------------------------------------------------------- pytree <-> flat
@@ -105,7 +143,7 @@ def encode(
     authentication at all (any peer that can connect injects weights,
     server.py:57-65); a keyed decoder rejects unauthenticated or tampered
     messages."""
-    if compression not in ("none", "bf16"):
+    if compression not in ("none", "bf16", "int8"):
         raise WireError(f"unknown compression {compression!r}")
     flat = (
         dict(params)
@@ -123,6 +161,9 @@ def encode(
         if compression == "bf16" and arr.dtype == np.float32:
             buf = np.ascontiguousarray(native.pack_bf16(arr)).tobytes()
             enc = "bf16"
+        elif compression == "int8" and arr.dtype == np.float32:
+            buf = quantize_int8(arr)
+            enc = "int8"
         else:
             buf = np.ascontiguousarray(arr).tobytes()
             enc = "raw"
@@ -226,6 +267,8 @@ def decode(
             if t["enc"] == "bf16":
                 packed = np.frombuffer(raw, np.uint16)
                 arr = native.unpack_bf16(packed, shape=tuple(t["shape"]))
+            elif t["enc"] == "int8":
+                arr = dequantize_int8(raw, tuple(t["shape"]))
             elif t["enc"] == "raw":
                 arr = np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(t["shape"])
             else:

@@ -99,6 +99,17 @@ class ModelConfig:
         return cls(**kw)
 
     @classmethod
+    def bert_large(cls, **kw: Any) -> "ModelConfig":
+        """BERT-large-sized encoder (24L/1024/16H/4096, ~335 M params) —
+        the capacity ceiling for single-chip federated fine-tuning here;
+        larger models shard over the mesh's data axis."""
+        kw.setdefault("n_layers", 24)
+        kw.setdefault("dim", 1024)
+        kw.setdefault("n_heads", 16)
+        kw.setdefault("hidden_dim", 4096)
+        return cls(**kw)
+
+    @classmethod
     def tiny(cls, **kw: Any) -> "ModelConfig":
         """Small config for tests / CI on CPU."""
         kw.setdefault("vocab_size", 256)

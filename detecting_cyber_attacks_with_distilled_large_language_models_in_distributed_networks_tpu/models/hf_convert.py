@@ -155,6 +155,10 @@ def config_from_hf_dir(path: str, **overrides: Any) -> ModelConfig:
 
     with open(os.path.join(path, "config.json")) as f:
         hf = json.load(f)
+    # HF "gelu" = the erf form; "gelu_new"/"gelu_pytorch_tanh" = the tanh
+    # form — keep whichever the checkpoint was trained under (export-hf
+    # writes this field from ModelConfig.gelu).
+    activation = hf.get("activation", "gelu")
     kw: dict[str, Any] = dict(
         vocab_size=hf["vocab_size"],
         dim=hf["dim"],
@@ -164,6 +168,9 @@ def config_from_hf_dir(path: str, **overrides: Any) -> ModelConfig:
         max_position_embeddings=hf.get("max_position_embeddings", 512),
         pad_token_id=hf.get("pad_token_id", 0),
         initializer_range=hf.get("initializer_range", 0.02),
+        gelu=(
+            "tanh" if activation in ("gelu_new", "gelu_pytorch_tanh") else "exact"
+        ),
     )
     kw.update(overrides)
     kw.setdefault("max_len", min(128, kw["max_position_embeddings"]))

@@ -80,6 +80,46 @@ def test_encode_bf16_compression_halves_float_payload(rng):
             np.testing.assert_array_equal(arr, orig)  # ints stay exact
 
 
+def test_encode_int8_compression_quarters_float_payload(rng):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.wire import (
+        dequantize_int8,
+        quantize_int8,
+    )
+
+    p = {"w": rng.normal(size=(256, 256)).astype(np.float32),
+         "b": rng.normal(size=(256,)).astype(np.float32),
+         "step": np.int32(1)}
+    raw = encode(p)
+    packed = encode(p, compression="int8")
+    assert len(packed) < 0.35 * len(raw)
+    params, _ = decode(packed)
+    for key, arr in flatten_params(params).items():
+        orig = flatten_params(p)[key]
+        if orig.dtype == np.float32:
+            # Per-row symmetric quantization: error <= row amax / 254.
+            rows = orig.reshape(orig.shape[0] if orig.ndim >= 2 else 1, -1)
+            bound = (np.abs(rows).max(axis=1) / 254.0 + 1e-7)[:, None]
+            err = np.abs(arr.reshape(rows.shape) - rows)
+            assert (err <= bound).all()
+        else:
+            np.testing.assert_array_equal(arr, orig)  # ints stay exact
+
+    # Edge shapes: scalars, 1-D, zero rows/cols, all-zero tensors round-trip.
+    for edge in (
+        np.float32(3.5).reshape(()),
+        np.zeros((4, 8), np.float32),
+        rng.normal(size=(5,)).astype(np.float32),
+        np.zeros((0, 8), np.float32),
+        np.zeros((4, 0), np.float32),
+    ):
+        back = dequantize_int8(quantize_int8(edge), tuple(np.shape(edge)))
+        assert back.shape == np.shape(edge)
+        if edge.size:
+            np.testing.assert_allclose(
+                back, edge, atol=np.abs(edge).max() / 200 + 1e-7
+            )
+
+
 def test_decode_rejects_tampered_payload(rng):
     blob = bytearray(encode(_params(rng)))
     blob[-3] ^= 0x40  # flip one bit in the payload
@@ -218,7 +258,7 @@ def test_framing_roundtrip_loopback(rng):
 
 
 # ----------------------------------------------- end-to-end FL round (TCP)
-@pytest.mark.parametrize("compression", ["none", "bf16"])
+@pytest.mark.parametrize("compression", ["none", "bf16", "int8"])
 def test_two_client_round_loopback(rng, compression):
     """The reference's whole distributed flow on loopback: 2 clients upload,
     server FedAvgs, both receive the identical aggregate."""
@@ -250,7 +290,12 @@ def test_two_client_round_loopback(rng, compression):
         st.join(timeout=30)
 
     assert "agg" in results and 0 in results and 1 in results
-    tol = dict(rtol=1e-2, atol=1e-2) if compression == "bf16" else dict(rtol=1e-6)
+    tol = {
+        "none": dict(rtol=1e-6),
+        "bf16": dict(rtol=1e-2, atol=1e-2),
+        # int8 quantizes upload AND reply: ~2 steps of the row max each way.
+        "int8": dict(rtol=5e-2, atol=1e-1),
+    }[compression]
     expected = aggregate_flat([flatten_params(p0), flatten_params(p1)])
     for key, arr in flatten_params(results[0]).items():
         np.testing.assert_allclose(arr, expected[key], **tol)

@@ -1,0 +1,96 @@
+"""export-hf: trained checkpoint -> HF DistilBERT layout round trip.
+
+The reference's artifact format IS the HF key space (its ``.pth`` state
+dicts and required ``./distilbert-base-uncased`` input, client1.py:56,388);
+export-hf lets a reference user consume models trained here."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
+    main,
+)
+
+
+@pytest.fixture(scope="module")
+def trained_ckpt(tmp_path_factory):
+    d = tmp_path_factory.mktemp("export")
+    ckpt = str(d / "ckpt")
+    assert (
+        main(
+            [
+                "local", "--synthetic", "300", "--epochs", "1",
+                "--batch-size", "16", "--checkpoint-dir", ckpt,
+                "--output-dir", str(d / "reports"),
+            ]
+        )
+        == 0
+    )
+    return ckpt
+
+
+def test_export_hf_layout_and_roundtrip(trained_ckpt, tmp_path):
+    out = str(tmp_path / "hf")
+    assert (
+        main(["export-hf", "--checkpoint-dir", trained_ckpt, "--out", out]) == 0
+    )
+    assert sorted(os.listdir(out)) == ["config.json", "model.safetensors", "vocab.txt"]
+    hf_cfg = json.load(open(os.path.join(out, "config.json")))
+    assert hf_cfg["model_type"] == "distilbert"
+    # tiny preset trains under exact GELU; the export must declare it, and
+    # config_from_hf_dir must read it back (tanh would be "gelu_new").
+    assert hf_cfg["activation"] == "gelu"
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.models.hf_convert import (
+        config_from_hf_dir,
+    )
+
+    assert config_from_hf_dir(out).gelu == "exact"
+
+    # Our own --hf-dir loader reads the export back bit-for-bit.
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.models.hf_convert import (
+        load_hf_dir,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        ModelConfig,
+    )
+
+    cfg = ModelConfig.tiny(
+        vocab_size=hf_cfg["vocab_size"],
+        dim=hf_cfg["dim"],
+        n_layers=hf_cfg["n_layers"],
+        n_heads=hf_cfg["n_heads"],
+        hidden_dim=hf_cfg["hidden_dim"],
+        max_position_embeddings=hf_cfg["max_position_embeddings"],
+    )
+    params, _ = load_hf_dir(out, cfg=cfg)
+    leaves = [np.asarray(x) for x in __import__("jax").tree.leaves(params)]
+    assert all(np.isfinite(a).all() for a in leaves)
+
+    # transformers itself loads the exported encoder.
+    transformers = pytest.importorskip("transformers")
+    model = transformers.DistilBertModel.from_pretrained(out)
+    assert model.config.dim == hf_cfg["dim"]
+
+    # predict consumes the export via --hf-dir (the head is trained).
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+        write_synthetic_csv,
+    )
+
+    csv = str(tmp_path / "flows.csv")
+    write_synthetic_csv(csv, n_rows=40, seed=5)
+    preds = str(tmp_path / "p.csv")
+    assert (
+        main(["predict", "--csv", csv, "--hf-dir", out, "--output", preds]) == 0
+    )
+    assert os.path.exists(preds)
+
+
+def test_export_hf_requires_checkpoint(tmp_path):
+    with pytest.raises((SystemExit, FileNotFoundError)):
+        main(
+            ["export-hf", "--checkpoint-dir", str(tmp_path / "none"),
+             "--out", str(tmp_path / "o")]
+        )

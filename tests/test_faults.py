@@ -238,3 +238,33 @@ def test_silent_client_excluded_at_deadline(rng):
         t0.join(timeout=10)
         lurker.close()
     assert 0 in results
+
+
+def test_many_concurrent_clients_stress(rng):
+    """8 clients hammer one server simultaneously (the reference's thread-
+    per-client path held 2; SURVEY §5 flags its accept-order identity race).
+    Every client must get the identical, correct mean."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        aggregate_flat,
+    )
+
+    C = 8
+    params = [_params(rng) for _ in range(C)]
+    results = {}
+    with AggregationServer(port=0, num_clients=C, timeout=30) as server:
+        st = threading.Thread(
+            target=lambda: results.__setitem__("agg", server.serve_round(deadline=30))
+        )
+        st.start()
+        ts = [_healthy(server, cid, params[cid], results) for cid in range(C)]
+        for t in ts:
+            t.join(timeout=30)
+        st.join(timeout=30)
+    assert all(c in results for c in range(C))
+    expected = aggregate_flat([flatten_params(p) for p in params])
+    base = flatten_params(results[0])
+    for key, arr in base.items():
+        np.testing.assert_allclose(arr, expected[key], rtol=1e-5)
+    for c in range(1, C):
+        for key, arr in flatten_params(results[c]).items():
+            np.testing.assert_array_equal(arr, base[key])
