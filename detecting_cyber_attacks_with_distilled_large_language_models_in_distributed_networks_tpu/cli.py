@@ -209,10 +209,12 @@ def _resolve_with_pretrained(args):
         ring_axis=m.ring_axis,
         remat=m.remat,
     )
+    # Activation precedence: --gelu flag > --config file's model section >
+    # the checkpoint's declared activation (config.json) > library default.
     if getattr(args, "gelu", None):
-        # Explicit flag only: otherwise the checkpoint's declared
-        # activation (config.json "activation") governs.
         overrides["gelu"] = args.gelu
+    elif getattr(args, "config", None):
+        overrides["gelu"] = m.gelu
     if getattr(args, "max_len", None):
         overrides["max_len"] = args.max_len
     model_cfg = config_from_hf_dir(hf_dir, **overrides)
@@ -363,7 +365,15 @@ def cmd_local(args) -> int:
         from .train.checkpoint import Checkpointer
 
         with Checkpointer(cfg.checkpoint_dir) as ckpt:
-            ckpt.save(int(state.step), state, meta={"client_id": args.client_id})
+            ckpt.save(
+                int(state.step),
+                state,
+                meta={
+                    "client_id": args.client_id,
+                    "kind": "local",
+                    "config": cfg.to_dict(),
+                },
+            )
             ckpt.wait()
     return 0
 
@@ -526,7 +536,15 @@ def cmd_federated(args) -> int:
                             {"round": r + 1, "client": c, "phase": phase_name, **m},
                         )
             if ckpt is not None:
-                ckpt.save(r + 1, state, meta={"round": r + 1, "config": cfg.to_dict()})
+                ckpt.save(
+                    r + 1,
+                    state,
+                    meta={
+                        "round": r + 1,
+                        "kind": "federated",
+                        "config": cfg.to_dict(),
+                    },
+                )
             if r + 1 < cfg.fed.rounds and cfg.fed.reset_optimizer_each_round:
                 state = trainer.reset_optimizer(state)
     if ckpt is not None:
@@ -696,7 +714,15 @@ def cmd_client(args) -> int:
         if ckpt is not None:
             # Post-train save — the reference's client1.py:388.
             save_seq += 1
-            ckpt.save(save_seq, state, meta={"client_id": args.client_id})
+            ckpt.save(
+                save_seq,
+                state,
+                meta={
+                    "client_id": args.client_id,
+                    "kind": "local",
+                    "config": cfg.to_dict(),
+                },
+            )
         host_params = jax.tree.map(np.asarray, state.params)
         try:
             with phase("federated exchange", tag="COMM"):
@@ -735,7 +761,12 @@ def cmd_client(args) -> int:
                 ckpt.save(
                     save_seq,
                     state,
-                    meta={"client_id": args.client_id, "aggregated": True},
+                    meta={
+                        "client_id": args.client_id,
+                        "kind": "local",
+                        "config": cfg.to_dict(),
+                        "aggregated": True,
+                    },
                 )
         except (ConnectionError, OSError, SecureAggError) as e:
             agg_metrics = None
@@ -773,7 +804,13 @@ def _restore_predict_params(cfg, tok, trainer):
         meta = ckpt.restore_meta(step=step)
         import jax
 
-        if "config" in meta:
+        # "kind" discriminates local TrainState vs federated FedState
+        # checkpoints; older federated checkpoints predate it but always
+        # carried "round".
+        is_fed = (
+            meta.get("kind") == "federated" if "kind" in meta else "round" in meta
+        )
+        if is_fed:
             from .train.federated import FederatedTrainer
 
             fed_cfg = ExperimentConfig.from_dict(meta["config"])
@@ -796,6 +833,24 @@ def _restore_predict_params(cfg, tok, trainer):
                 f"{meta.get('round', '?')}, {fed_cfg.fed.num_clients} clients)"
             )
             return fed_cfg.model, params
+        model_cfg = cfg.model
+        if "config" in meta:
+            # Trust the checkpoint's recorded config over CLI presets —
+            # e.g. its gelu variant does not change parameter shapes, so a
+            # mismatched preset would restore fine and then run (or
+            # export) the wrong activation.
+            from .train.engine import Trainer
+
+            ckpt_cfg = ExperimentConfig.from_dict(meta["config"])
+            if ckpt_cfg.model.vocab_size != cfg.model.vocab_size:
+                raise SystemExit(
+                    f"checkpoint model vocab ({ckpt_cfg.model.vocab_size}) "
+                    f"!= tokenizer vocab ({cfg.model.vocab_size}); pass the "
+                    "matching --hf-dir / vocab"
+                )
+            model_cfg = ckpt_cfg.model
+            if model_cfg != cfg.model:
+                trainer = Trainer(model_cfg, cfg.train, pad_id=tok.pad_id)
         template = jax.eval_shape(lambda: trainer.init_state(seed=0))
         try:
             params = ckpt.restore_params(template, step=step)
@@ -807,7 +862,7 @@ def _restore_predict_params(cfg, tok, trainer):
                 "with"
             ) from None
         log.info(f"[PREDICT] restored local checkpoint (step {step})")
-        return cfg.model, params
+        return model_cfg, params
 
 
 def cmd_predict(args) -> int:

@@ -94,3 +94,27 @@ def test_export_hf_requires_checkpoint(tmp_path):
             ["export-hf", "--checkpoint-dir", str(tmp_path / "none"),
              "--out", str(tmp_path / "o")]
         )
+
+
+def test_export_declares_checkpoint_trained_activation(tmp_path):
+    """The checkpoint's recorded config (not the CLI preset at export time)
+    decides config.json's activation: tiny defaults to exact GELU, so a
+    --gelu tanh training run must export "gelu_new" even when export-hf is
+    invoked without --gelu."""
+    ckpt = str(tmp_path / "ckpt")
+    assert (
+        main(
+            [
+                "local", "--synthetic", "200", "--epochs", "1", "--gelu",
+                "tanh", "--checkpoint-dir", ckpt,
+                "--output-dir", str(tmp_path / "r"),
+            ]
+        )
+        == 0
+    )
+    out = str(tmp_path / "hf")
+    assert (
+        main(["export-hf", "--checkpoint-dir", ckpt, "--out", out]) == 0
+    )
+    hf_cfg = json.load(open(os.path.join(out, "config.json")))
+    assert hf_cfg["activation"] == "gelu_new"
