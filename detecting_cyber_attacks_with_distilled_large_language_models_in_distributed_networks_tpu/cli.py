@@ -782,25 +782,29 @@ def cmd_client(args) -> int:
     return 0
 
 
-def _restore_predict_params(cfg, tok, trainer):
-    """Trained weights for inference from ``--checkpoint-dir``.
+def _restore_predict_params(cfg, tok, trainer, *, ckpt_dir=None):
+    """Trained weights for inference from a checkpoint directory
+    (``cfg.checkpoint_dir`` unless ``ckpt_dir`` overrides — distill's
+    teacher restore points elsewhere).
 
     Understands both checkpoint flavors: a ``local``/``client`` TrainState
-    (restored against this trainer's template) and a ``federated`` FedState
-    (recognized by the config in its metadata; restored on the mesh and
-    collapsed to client 0's replica — post-aggregation all replicas are
-    identical). Returns ``(model_cfg, params)``; raises instead of silently
-    predicting from random weights."""
+    (restored against this trainer's template, or the checkpoint's own
+    recorded config when present) and a ``federated`` FedState (recognized
+    by its metadata; restored on the mesh and collapsed to client 0's
+    replica — post-aggregation all replicas are identical). Returns
+    ``(model_cfg, params)``; raises instead of silently predicting from
+    random weights."""
     from .train.checkpoint import Checkpointer
 
-    if not os.path.isdir(cfg.checkpoint_dir):
+    ckpt_dir = cfg.checkpoint_dir if ckpt_dir is None else ckpt_dir
+    if not os.path.isdir(ckpt_dir):
         # Read-only path: don't let the manager create a directory at a
         # mistyped location (it would later masquerade as a real run dir).
-        raise SystemExit(f"--checkpoint-dir {cfg.checkpoint_dir} does not exist")
-    with Checkpointer(cfg.checkpoint_dir) as ckpt:
+        raise SystemExit(f"checkpoint dir {ckpt_dir} does not exist")
+    with Checkpointer(ckpt_dir) as ckpt:
         step = ckpt.latest_step()
         if step is None:
-            raise SystemExit(f"no checkpoint found in {cfg.checkpoint_dir}")
+            raise SystemExit(f"no checkpoint found in {ckpt_dir}")
         meta = ckpt.restore_meta(step=step)
         import jax
 
@@ -833,7 +837,10 @@ def _restore_predict_params(cfg, tok, trainer):
                 f"{meta.get('round', '?')}, {fed_cfg.fed.num_clients} clients)"
             )
             return fed_cfg.model, params
-        model_cfg = cfg.model
+        # Without recorded config (legacy checkpoints) the caller's trainer
+        # IS the architecture claim — return ITS config, not cfg.model
+        # (distill passes a deeper-than-student teacher template here).
+        model_cfg = trainer.model_cfg
         if "config" in meta:
             # Trust the checkpoint's recorded config over CLI presets —
             # e.g. its gelu variant does not change parameter shapes, so a
@@ -849,14 +856,14 @@ def _restore_predict_params(cfg, tok, trainer):
                     "matching --hf-dir / vocab"
                 )
             model_cfg = ckpt_cfg.model
-            if model_cfg != cfg.model:
+            if model_cfg != trainer.model_cfg:
                 trainer = Trainer(model_cfg, cfg.train, pad_id=tok.pad_id)
         template = jax.eval_shape(lambda: trainer.init_state(seed=0))
         try:
             params = ckpt.restore_params(template, step=step)
         except Exception as e:
             raise SystemExit(
-                f"checkpoint at {cfg.checkpoint_dir} (step {step}) does not "
+                f"checkpoint at {ckpt_dir} (step {step}) does not "
                 f"match the resolved model ({type(e).__name__}: {e}) — pass "
                 "the --preset/--config/--hf-dir the checkpoint was trained "
                 "with"
@@ -1064,23 +1071,58 @@ def cmd_distill(args) -> int:
             f"{cfg.model.n_layers}-layer student"
         )
     teacher_cfg = cfg.model.replace(n_layers=teacher_layers)
-    t_trainer = Trainer(teacher_cfg, cfg.train, pad_id=tok.pad_id)
-    t_state = t_trainer.init_state()
     with trace(getattr(args, "profile_dir", None)):
-        with phase(f"teacher training ({teacher_cfg.n_layers} layers)", tag="DISTILL"):
-            t_state, _ = t_trainer.fit(
-                t_state, client.train, batch_size=cfg.data.batch_size, tag="[TEACHER] "
+        if getattr(args, "teacher_checkpoint", None):
+            # Distill a model trained elsewhere — e.g. the aggregate of a
+            # federated BERT-base fleet — into a small deployable student:
+            # the end-to-end "distilled LLMs in distributed networks" story.
+            teacher_cfg_hint = teacher_cfg
+            t_trainer = Trainer(teacher_cfg_hint, cfg.train, pad_id=tok.pad_id)
+            teacher_cfg, teacher_params = _restore_predict_params(
+                cfg, tok, t_trainer, ckpt_dir=args.teacher_checkpoint
             )
-        teacher_metrics = t_trainer.evaluate(t_state.params, client.test)
+            if teacher_cfg.n_layers < cfg.model.n_layers:
+                raise SystemExit(
+                    f"teacher checkpoint has {teacher_cfg.n_layers} layers — "
+                    f"shallower than the {cfg.model.n_layers}-layer student"
+                )
+            if (teacher_cfg.dim, teacher_cfg.n_heads, teacher_cfg.hidden_dim) != (
+                cfg.model.dim, cfg.model.n_heads, cfg.model.hidden_dim,
+            ):
+                raise SystemExit(
+                    f"teacher checkpoint width (dim {teacher_cfg.dim}, "
+                    f"heads {teacher_cfg.n_heads}, ffn {teacher_cfg.hidden_dim}) "
+                    f"!= student (dim {cfg.model.dim}, heads "
+                    f"{cfg.model.n_heads}, ffn {cfg.model.hidden_dim}): "
+                    "depth-only distillation"
+                )
+            if teacher_cfg != teacher_cfg_hint:
+                t_trainer = Trainer(teacher_cfg, cfg.train, pad_id=tok.pad_id)
+            log.info(
+                f"[DISTILL] teacher from {args.teacher_checkpoint} "
+                f"({teacher_cfg.n_layers} layers)"
+            )
+        else:
+            t_trainer = Trainer(teacher_cfg, cfg.train, pad_id=tok.pad_id)
+            t_state = t_trainer.init_state()
+            with phase(
+                f"teacher training ({teacher_cfg.n_layers} layers)", tag="DISTILL"
+            ):
+                t_state, _ = t_trainer.fit(
+                    t_state, client.train, batch_size=cfg.data.batch_size,
+                    tag="[TEACHER] ",
+                )
+            teacher_params = t_state.params
+        teacher_metrics = t_trainer.evaluate(teacher_params, client.test)
 
         d_trainer = DistillTrainer(
             cfg.model, teacher_cfg, cfg.train, cfg.distill, pad_id=tok.pad_id
         )
-        s_state = d_trainer.init_student_state(t_state.params)
+        s_state = d_trainer.init_student_state(teacher_params)
         with phase(f"distilling into {cfg.model.n_layers}-layer student", tag="DISTILL"):
             s_state, _ = d_trainer.distill(
                 s_state,
-                t_state.params,
+                teacher_params,
                 client.train,
                 batch_size=cfg.data.batch_size,
                 epochs=args.distill_epochs,
@@ -1111,7 +1153,15 @@ def cmd_distill(args) -> int:
         from .train.checkpoint import Checkpointer
 
         with Checkpointer(cfg.checkpoint_dir) as ckpt:
-            ckpt.save(int(s_state.step), s_state, meta={"distilled": True})
+            ckpt.save(
+                int(s_state.step),
+                s_state,
+                meta={
+                    "distilled": True,
+                    "kind": "local",
+                    "config": cfg.to_dict(),
+                },
+            )
             ckpt.wait()
     return 0
 
@@ -1326,6 +1376,12 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("distill", help="teacher -> student knowledge distillation")
     _add_common(p)
     p.add_argument("--teacher-layers", type=int, help="default: 2x student layers")
+    p.add_argument(
+        "--teacher-checkpoint",
+        help="distill FROM this trained checkpoint (local or federated — "
+        "e.g. a federated BERT fleet's aggregate) instead of training a "
+        "fresh teacher",
+    )
     p.add_argument("--distill-epochs", type=int, help="default: train epochs")
     p.add_argument("--temperature", type=float, help="KD softmax temperature")
     p.add_argument("--alpha", type=float, help="KD loss weight in [0,1]")
