@@ -151,6 +151,17 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
                     if getattr(args, "dp_noise_multiplier", None) is None
                     else args.dp_noise_multiplier
                 ),
+                server_opt=getattr(args, "server_opt", None) or cfg.fed.server_opt,
+                server_lr=(
+                    cfg.fed.server_lr
+                    if getattr(args, "server_lr", None) is None
+                    else args.server_lr
+                ),
+                server_momentum=(
+                    cfg.fed.server_momentum
+                    if getattr(args, "server_momentum", None) is None
+                    else args.server_momentum
+                ),
             ),
             mesh=MeshConfig(
                 clients=n, data=getattr(args, "data_parallel", None) or cfg.mesh.data
@@ -1288,6 +1299,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="DP-FedAvg: Gaussian noise multiplier on the clipped mean "
         "update (std = multiplier * clip / n_participants); requires "
         "--dp-clip",
+    )
+    p.add_argument(
+        "--server-opt",
+        choices=["none", "momentum", "adam"],
+        help="FedOpt server optimizer over the round's mean update: "
+        "momentum = FedAvgM, adam = FedAdam (default none = plain FedAvg)",
+    )
+    p.add_argument(
+        "--server-lr", type=float, help="server optimizer learning rate (default 1.0)"
+    )
+    p.add_argument(
+        "--server-momentum", type=float, help="FedAvgM momentum (default 0.9)"
     )
     p.add_argument("--checkpoint-dir")
     p.add_argument(

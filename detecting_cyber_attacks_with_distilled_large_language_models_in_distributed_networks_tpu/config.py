@@ -242,6 +242,17 @@ class FedConfig:
     # reproducible — anyone who knows it can subtract the noise, so it
     # VOIDS the (epsilon, delta) guarantee; tests only.
     dp_seed: int | None = None
+    # Server-side optimizer over the round's mean update (FedOpt, Reddi et
+    # al.): "none" = plain FedAvg (new global = mean, the reference's
+    # algorithm); "momentum" = FedAvgM (heavy-ball over round updates);
+    # "adam" = FedAdam (adaptive per-parameter server steps). Server state
+    # persists across rounds (unlike the per-round client optimizer reset).
+    server_opt: str = "none"
+    server_lr: float = 1.0
+    server_momentum: float = 0.9
+
+    def server_opt_enabled(self) -> bool:
+        return self.server_opt != "none"
 
     def __post_init__(self) -> None:
         if not 0.0 < self.participation <= 1.0:
@@ -270,6 +281,17 @@ class FedConfig:
             raise ValueError(
                 "dp_clip > 0 is incompatible with weighted FedAvg: the DP "
                 "sensitivity bound assumes a uniform mean over participants"
+            )
+        if self.server_opt not in ("none", "momentum", "adam"):
+            raise ValueError(
+                f"unknown server_opt {self.server_opt!r} (none|momentum|adam)"
+            )
+        if self.server_lr <= 0.0:
+            raise ValueError(f"server_lr={self.server_lr} must be > 0")
+        if not 0.0 <= self.server_momentum < 1.0:
+            raise ValueError(
+                f"server_momentum={self.server_momentum} must be in [0, 1) "
+                "(a decay >= 1 amplifies every round update geometrically)"
             )
 
 

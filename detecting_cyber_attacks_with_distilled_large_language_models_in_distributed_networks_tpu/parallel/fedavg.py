@@ -29,13 +29,13 @@ import jax.numpy as jnp
 from .mesh import FedShardings
 
 
-def fedavg(
+def weighted_mean(
     stacked_params: Any,
     weights: jnp.ndarray | None = None,
     mask: jnp.ndarray | None = None,
 ) -> Any:
-    """Weighted, masked mean over the leading (clients) axis of every leaf,
-    broadcast back to ``[C, ...]`` so each client shard receives the average.
+    """Weighted, masked mean over the leading (clients) axis — the
+    single-model fp32 result, NOT broadcast back (fedavg adds that).
 
     ``weights``: [C] client weights (e.g. local sample counts); uniform if
     None — the reference's unweighted mean (server.py:73-76).
@@ -55,10 +55,39 @@ def fedavg(
     def _avg(x: jnp.ndarray) -> jnp.ndarray:
         wshape = (C,) + (1,) * (x.ndim - 1)
         # fp32 accumulation regardless of param dtype
-        mean = (x.astype(jnp.float32) * wn.reshape(wshape)).sum(axis=0)
-        return jnp.broadcast_to(mean.astype(x.dtype), x.shape)
+        return (x.astype(jnp.float32) * wn.reshape(wshape)).sum(axis=0)
 
     return jax.tree.map(_avg, stacked_params)
+
+
+def fedavg(
+    stacked_params: Any,
+    weights: jnp.ndarray | None = None,
+    mask: jnp.ndarray | None = None,
+) -> Any:
+    """:func:`weighted_mean` broadcast back to ``[C, ...]`` so each client
+    shard receives the average."""
+    mean = weighted_mean(stacked_params, weights, mask)
+    return jax.tree.map(
+        lambda m, x: jnp.broadcast_to(m.astype(x.dtype), x.shape),
+        mean,
+        stacked_params,
+    )
+
+
+def make_server_optimizer(fed_cfg) -> "optax.GradientTransformation | None":
+    """The FedOpt server optimizer (Reddi et al.): applied to the round's
+    mean update at the aggregation boundary. "momentum" = FedAvgM (SGD with
+    heavy-ball momentum over round updates), "adam" = FedAdam. At
+    server_lr=1 with no momentum, the step reduces exactly to plain FedAvg
+    (new global = mean)."""
+    import optax
+
+    if fed_cfg.server_opt == "momentum":
+        return optax.sgd(fed_cfg.server_lr, momentum=fed_cfg.server_momentum)
+    if fed_cfg.server_opt == "adam":
+        return optax.adam(fed_cfg.server_lr)
+    return None
 
 
 def make_fedavg_step(shardings: FedShardings) -> Callable:

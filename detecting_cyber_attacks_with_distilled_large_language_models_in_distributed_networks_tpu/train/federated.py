@@ -51,6 +51,10 @@ class FedState(NamedTuple):
     opt_state: Any  # [C, ...]
     step: jnp.ndarray  # scalar int32 — lockstep across clients
     rngs: jax.Array  # [C] dropout keys
+    # FedOpt server-optimizer state (single-model shaped, replicated);
+    # None under plain FedAvg. Persists across rounds — the per-round
+    # client optimizer reset does not touch it.
+    server_opt: Any = None
 
 
 def federated_batches(
@@ -222,7 +226,9 @@ class FederatedTrainer:
             updates = apply_warmup(updates, step, wsteps)
             return optax.apply_updates(params, updates), opt_state, task
 
-        state_sh = FedState(csh, csh, self.sh.replicated, csh)
+        state_sh = FedState(
+            csh, csh, self.sh.replicated, csh, self.sh.replicated
+        )
         batch_sh = {"input_ids": bsh, "attention_mask": bsh, "labels": bsh}
 
         def _step_body(state: FedState, batch, anchor):
@@ -234,7 +240,9 @@ class FederatedTrainer:
                 in_axes=(0, 0, 0, 0, 0 if mu > 0.0 else None, None),
             )(state.params, state.opt_state, batch, step_rngs, anchor, state.step)
             return (
-                FedState(params, opt_state, state.step + 1, state.rngs),
+                state._replace(
+                    params=params, opt_state=opt_state, step=state.step + 1
+                ),
                 losses,  # [C]
             )
 
@@ -273,6 +281,40 @@ class FederatedTrainer:
         self.train_step = train_step
         self.eval_step = eval_step
         self.fedavg_step = make_fedavg_step(self.sh)
+        if self.cfg.fed.server_opt_enabled():
+            from ..parallel.fedavg import make_server_optimizer, weighted_mean
+
+            server_tx = make_server_optimizer(self.cfg.fed)
+            self.server_tx = server_tx
+
+            @partial(
+                jax.jit,
+                in_shardings=(csh, csh, None, None, self.sh.replicated),
+                out_shardings=(csh, self.sh.replicated),
+            )
+            def server_agg_step(stacked_params, anchor, w, m, server_state):
+                """FedOpt round boundary: pseudo-gradient = anchor - mean
+                of (possibly weighted/masked) client params; the server
+                optimizer turns it into the global step, broadcast back to
+                every client shard. All server math in fp32."""
+                mean = weighted_mean(stacked_params, w, m)
+                # Anchor rows are identical (previous round's replicated
+                # output); the mean over axis 0 IS the single-model value.
+                anchor1 = weighted_mean(anchor)
+                g = jax.tree.map(lambda a, mn: a - mn, anchor1, mean)
+                updates, new_state = server_tx.update(g, server_state, anchor1)
+                new1 = optax.apply_updates(anchor1, updates)
+                stacked = jax.tree.map(
+                    lambda n, ref: jnp.broadcast_to(n.astype(ref.dtype), ref.shape),
+                    new1,
+                    stacked_params,
+                )
+                return stacked, new_state
+
+            self.server_agg_step = server_agg_step
+        else:
+            self.server_tx = None
+            self.server_agg_step = None
         if self.cfg.fed.dp_clip > 0.0:
             from ..parallel.dp import make_dp_fedavg_step
 
@@ -365,11 +407,31 @@ class FederatedTrainer:
                 impl=impl,
             )
         opt_state = self._opt_init(stacked_params)
+        server_opt = None
+        if self.server_tx is not None:
+            # Single-model fp32 state (replicated); every host computes the
+            # identical init from the identical starting params.
+            server_opt = self.server_tx.init(
+                jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), params)
+            )
+            if self.P > 1:
+                # Like params/rngs above: promote host-local replicas to
+                # global replicated arrays, or the jitted steps reject the
+                # process-local device placement.
+                from ..parallel.multihost import global_array_from_replicated
+
+                server_opt = jax.tree.map(
+                    lambda x: global_array_from_replicated(
+                        self.sh.replicated, np.asarray(x)
+                    ),
+                    server_opt,
+                )
         return FedState(
             params=stacked_params,
             opt_state=opt_state,
             step=jnp.zeros((), jnp.int32),
             rngs=rngs,
+            server_opt=server_opt,
         )
 
     def reset_optimizer(self, state: FedState) -> FedState:
@@ -541,10 +603,10 @@ class FederatedTrainer:
         return mask
 
     def round_anchor(self, state: FedState) -> Any | None:
-        """Round-start params snapshot for DP aggregation — capture BEFORE
-        ``fit_local`` (a copy, so donated train-step buffers never alias
-        it). None when DP is off."""
-        if self.dp_fedavg_step is None:
+        """Round-start params snapshot for DP and/or FedOpt aggregation —
+        capture BEFORE ``fit_local`` (a copy, so donated train-step buffers
+        never alias it). None when neither needs it."""
+        if self.dp_fedavg_step is None and self.server_agg_step is None:
             return None
         return jax.tree.map(jnp.copy, state.params)
 
@@ -588,21 +650,26 @@ class FederatedTrainer:
                 )
         w = None if weights is None else jnp.asarray(weights)
         m = None if client_mask is None else jnp.asarray(client_mask)
+        needs_anchor = (
+            self.dp_fedavg_step is not None or self.server_agg_step is not None
+        )
+        if needs_anchor and anchor is None:
+            raise ValueError(
+                "DP and/or FedOpt aggregation needs the round-start anchor "
+                "— capture it with round_anchor(state) before fit_local"
+            )
         if self.dp_fedavg_step is not None:
-            if anchor is None:
-                raise ValueError(
-                    "fed.dp_clip > 0: aggregate() needs the round-start "
-                    "anchor — capture it with round_anchor(state) before "
-                    "fit_local"
-                )
             if w is not None:
                 raise ValueError(
                     "DP aggregation is a uniform mean (FedConfig forbids "
                     "weighted=True with dp_clip); do not pass weights"
                 )
-            params, norms = self.dp_fedavg_step(
+            base, norms = self.dp_fedavg_step(
                 state.params, anchor, self._dp_key(round_index), m
             )
+            # DP output is already the (uniform, noised) aggregate
+            # replicated across rows; any server step consumes it as-is.
+            w_srv = m_srv = None
             # Log stats over PARTICIPANTS only — masked-out clients' norms
             # never touched the aggregate and would skew clip-rate tuning.
             hn = np.asarray(self._host(norms))
@@ -616,8 +683,16 @@ class FederatedTrainer:
                 f"{self.cfg.fed.dp_clip}"
             )
         else:
-            params = self.fedavg_step(state.params, w, m)
-        return state._replace(params=params)
+            base, w_srv, m_srv = state.params, w, m
+        already_aggregated = self.dp_fedavg_step is not None
+        if self.server_agg_step is not None:
+            params, server_state = self.server_agg_step(
+                base, anchor, w_srv, m_srv, state.server_opt
+            )
+            return state._replace(params=params, server_opt=server_state)
+        if already_aggregated:
+            return state._replace(params=base)
+        return state._replace(params=self.fedavg_step(base, w_srv, m_srv))
 
     # ------------------------------------------------------------------- run
     def run(

@@ -175,3 +175,23 @@ def test_two_process_dp_fedavg(tmp_path):
         if "round" in l
     ]
     assert agg0 and agg0 == agg1
+
+
+def test_two_process_server_opt(tmp_path):
+    """Multi-host FedOpt: the server-optimizer state must be a global
+    replicated array (not host-local), or the jitted aggregate rejects the
+    device placement; identical round metrics on both hosts prove the
+    server step agreed."""
+    out = tmp_path / "out"
+    outputs = _launch_pair(
+        tmp_path, out, ("--server-opt", "momentum", "--server-lr", "1.0")
+    )
+    agg = [
+        [
+            l.split("aggregated")[1]
+            for l in o.splitlines()
+            if "aggregated" in l and "round" in l
+        ]
+        for o in outputs
+    ]
+    assert agg[0] and agg[0] == agg[1]

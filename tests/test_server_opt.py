@@ -1,0 +1,215 @@
+"""FedOpt server optimizers (parallel/fedavg.py::make_server_optimizer +
+the FederatedTrainer server aggregation step).
+
+The reference's aggregation is an unweighted mean, full stop
+(server.py:67-79). FedOpt (Reddi et al.) treats the round's mean update
+as a pseudo-gradient and applies a server optimizer: FedAvgM (momentum)
+and FedAdam. At server_lr=1 / momentum=0 the step must reduce exactly to
+plain FedAvg.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.parallel import (
+    make_mesh,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train import (
+    FederatedTrainer,
+)
+
+
+def _cfg(clients=2, **fed_kw):
+    model = ModelConfig.tiny()
+    return ExperimentConfig(
+        model=model,
+        data=DataConfig(max_len=model.max_len, batch_size=4),
+        train=TrainConfig(learning_rate=1e-3, epochs_per_round=1, seed=0),
+        fed=FedConfig(num_clients=clients, **fed_kw),
+        mesh=MeshConfig(clients=clients, data=1),
+    )
+
+
+def _batch(cfg, clients, B=4, seed=0):
+    rng = np.random.default_rng(seed)
+    L = cfg.model.max_len
+    return {
+        "input_ids": rng.integers(
+            0, cfg.model.vocab_size, (clients, B, L)
+        ).astype(np.int32),
+        "attention_mask": np.ones((clients, B, L), np.int32),
+        "labels": rng.integers(0, 2, (clients, B)).astype(np.int32),
+    }
+
+
+def _trainer(eight_devices, **fed_kw):
+    cfg = _cfg(clients=2, **fed_kw)
+    mesh = make_mesh(2, 1, devices=eight_devices[:2])
+    t = FederatedTrainer(cfg, mesh=mesh)
+    return t, t.init_state(seed=0)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="server_opt"):
+        FedConfig(server_opt="sgd")
+    with pytest.raises(ValueError, match="server_lr"):
+        FedConfig(server_opt="adam", server_lr=0.0)
+
+
+def test_momentum_lr1_m0_equals_plain_fedavg(eight_devices):
+    """server_opt=momentum at lr=1, momentum=0 must be bit-close to plain
+    FedAvg: new global == mean of client params."""
+    t_fed, s_fed = _trainer(eight_devices)
+    t_srv, s_srv = _trainer(
+        eight_devices, server_opt="momentum", server_lr=1.0, server_momentum=0.0
+    )
+    assert s_fed.server_opt is None and s_srv.server_opt is not None
+
+    batch = _batch(t_fed.cfg, 2)
+    s_fed, _ = t_fed.train_step(s_fed, batch)
+    s_srv, _ = t_srv.train_step(s_srv, batch)
+    anchor = t_srv.round_anchor(s_srv)
+    assert t_fed.round_anchor(s_fed) is None
+
+    s_fed = t_fed.aggregate(s_fed)
+    s_srv = t_srv.aggregate(s_srv, anchor=anchor)
+    for a, b in zip(jax.tree.leaves(s_fed.params), jax.tree.leaves(s_srv.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_momentum_accumulates_across_rounds(eight_devices):
+    """Two rounds with the same client delta: FedAvgM's second global step
+    must be larger than its first (heavy-ball memory), and the server state
+    must survive the per-round client-optimizer reset."""
+    trainer, state = _trainer(
+        eight_devices, server_opt="momentum", server_lr=1.0, server_momentum=0.9
+    )
+    delta = jax.tree.map(jnp.ones_like, state.params)
+
+    def push(state):
+        anchor = trainer.round_anchor(state)
+        before = jax.tree.leaves(anchor)[0]
+        pushed = state._replace(
+            params=jax.tree.map(lambda p, d: p + 0.01 * d, state.params, delta)
+        )
+        out = trainer.aggregate(pushed, anchor=anchor)
+        after = jax.tree.leaves(out.params)[0]
+        return out, float(np.abs(np.asarray(after - before)).mean())
+
+    state = trainer.reset_optimizer(state)  # must not clear server state
+    assert state.server_opt is not None
+    state, step1 = push(state)
+    state, step2 = push(state)
+    assert step2 > step1 * 1.5  # momentum compounds identical deltas
+
+
+def test_fedadam_round_replicates_and_is_finite(eight_devices):
+    trainer, state = _trainer(
+        eight_devices, server_opt="adam", server_lr=0.05
+    )
+    batch = _batch(trainer.cfg, 2)
+    anchor = trainer.round_anchor(state)
+    state, _ = trainer.train_step(state, batch)
+    state = trainer.aggregate(state, anchor=anchor)
+    leaf = np.asarray(jax.tree.leaves(state.params)[0])
+    np.testing.assert_allclose(leaf[1], leaf[0], rtol=1e-6)
+    assert np.isfinite(leaf).all()
+    assert state.server_opt is not None
+
+
+def test_server_opt_composes_with_dp(eight_devices):
+    trainer, state = _trainer(
+        eight_devices,
+        server_opt="momentum",
+        server_lr=1.0,
+        server_momentum=0.5,
+        dp_clip=1.0,
+        dp_noise_multiplier=0.1,
+    )
+    batch = _batch(trainer.cfg, 2)
+    anchor = trainer.round_anchor(state)
+    state, _ = trainer.train_step(state, batch)
+    state = trainer.aggregate(state, anchor=anchor, round_index=0)
+    leaf = np.asarray(jax.tree.leaves(state.params)[0])
+    np.testing.assert_allclose(leaf[1], leaf[0], rtol=1e-6)
+    assert np.isfinite(leaf).all()
+
+
+def test_run_loop_with_server_opt(eight_devices):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.pipeline import (
+        TokenizedSplit,
+    )
+
+    trainer, state = _trainer(
+        eight_devices, server_opt="momentum", rounds=2
+    )
+    rng = np.random.default_rng(0)
+    cfg = trainer.cfg
+    L = cfg.model.max_len
+    train = TokenizedSplit(
+        rng.integers(0, cfg.model.vocab_size, (2, 16, L)).astype(np.int32),
+        np.ones((2, 16, L), np.int32),
+        rng.integers(0, 2, (2, 16)).astype(np.int32),
+    )
+    evals = [
+        TokenizedSplit(
+            rng.integers(0, cfg.model.vocab_size, (8, L)).astype(np.int32),
+            np.ones((8, L), np.int32),
+            rng.integers(0, 2, 8).astype(np.int32),
+        )
+        for _ in range(2)
+    ]
+    state, history = trainer.run(state, train, evals, rounds=2)
+    assert len(history) == 2
+    assert state.server_opt is not None
+
+
+def test_server_state_checkpoints_and_restores(eight_devices, tmp_path):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.checkpoint import (
+        Checkpointer,
+    )
+
+    trainer, state = _trainer(
+        eight_devices, server_opt="momentum", server_lr=1.0, server_momentum=0.9
+    )
+    anchor = trainer.round_anchor(state)
+    pushed = state._replace(
+        params=jax.tree.map(lambda p: p + 0.01, state.params)
+    )
+    state = trainer.aggregate(pushed, anchor=anchor)  # non-trivial momentum
+    with Checkpointer(str(tmp_path / "ck")) as ckpt:
+        ckpt.save(1, state)
+        ckpt.wait()
+        template = trainer.init_state(seed=0)
+        restored = ckpt.restore(template, step=1)
+    for a, b in zip(
+        jax.tree.leaves(state.server_opt), jax.tree.leaves(restored.server_opt)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_cli_flags_resolve():
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
+        build_parser,
+        resolve_config,
+    )
+
+    args = build_parser().parse_args(
+        ["federated", "--num-clients", "2", "--server-opt", "momentum",
+         "--server-lr", "0.5", "--server-momentum", "0.8"]
+    )
+    cfg = resolve_config(args, vocab_size=130)
+    assert cfg.fed.server_opt == "momentum"
+    assert cfg.fed.server_lr == pytest.approx(0.5)
+    assert cfg.fed.server_momentum == pytest.approx(0.8)
