@@ -175,8 +175,12 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
 
 
 # --------------------------------------------------------------- pretrained
-def _resolve_with_pretrained(args):
+def _resolve_with_pretrained(args, *, load_weights: bool = True):
     """(tokenizer, resolved config, initial params or None).
+
+    ``load_weights=False`` skips the (full) HF/.pth weight load while still
+    resolving tokenizer + architecture from ``--hf-dir`` — for callers
+    whose weights come from elsewhere (e.g. distill --teacher-checkpoint).
 
     With ``--hf-dir`` (the reference's required ``./distilbert-base-uncased``
     directory, client1.py:357,360-361): vocab from its ``vocab.txt``,
@@ -185,6 +189,12 @@ def _resolve_with_pretrained(args):
     the domain tokenizer and random init.
     """
     hf_dir = getattr(args, "hf_dir", None)
+    if getattr(args, "pth", None) and not hf_dir:
+        raise SystemExit(
+            "--pth needs --hf-dir alongside it: the .pth holds only weights; "
+            "the tokenizer and architecture come from the HF checkpoint dir "
+            "(the reference requires the same directory, client1.py:357)"
+        )
     if not hf_dir:
         from .data import default_tokenizer
 
@@ -239,6 +249,29 @@ def _resolve_with_pretrained(args):
         model=model_cfg,
         data=dataclasses.replace(cfg.data, max_len=model_cfg.max_len),
     )
+    if not load_weights:
+        return tok, cfg, None
+    if getattr(args, "pth", None):
+        # The reference's own trained artifact: --hf-dir supplies the
+        # tokenizer + architecture (exactly as the reference requires that
+        # directory, client1.py:56,357), the .pth supplies the weights —
+        # mirroring its DDoSClassifier(path) + load_state_dict flow
+        # (client1.py:374-377).
+        from .models.hf_convert import load_reference_pth
+
+        with phase(f"loading reference .pth {args.pth}", tag="MODEL"):
+            try:
+                params = load_reference_pth(args.pth, model_cfg)
+            except Exception as e:
+                # KeyError = architecture mismatch vs --hf-dir's config.json,
+                # FileNotFoundError = bad path, ValueError = headless dict —
+                # all operator errors, none deserving a raw traceback.
+                raise SystemExit(
+                    f"--pth {args.pth}: {type(e).__name__}: {e} — expected "
+                    "the reference's DDoSClassifier state dict matching "
+                    "--hf-dir's architecture (client1.py:53-58,388)"
+                ) from None
+        return tok, cfg, params
     with phase(f"loading HF checkpoint {hf_dir}", tag="MODEL"):
         params, _ = load_hf_dir(
             hf_dir, cfg=model_cfg, head_rng=np.random.default_rng(cfg.train.seed)
@@ -903,7 +936,11 @@ def cmd_predict(args) -> int:
                 f"--{flag} is a training-data option; predict reads the "
                 "flows to classify from --csv only"
             )
-    if not getattr(args, "checkpoint_dir", None) and getattr(args, "hf_dir", None):
+    if (
+        not getattr(args, "checkpoint_dir", None)
+        and getattr(args, "hf_dir", None)
+        and not getattr(args, "pth", None)  # .pth supplies the trained head
+    ):
         # Gate BEFORE the (expensive) weight conversion: a bare encoder's
         # head would be random noise, so predicting from it is meaningless.
         from .models.hf_convert import hf_dir_has_head
@@ -916,6 +953,12 @@ def cmd_predict(args) -> int:
                 "at a checkpoint fine-tuned with this head architecture"
             )
     tok, cfg, pretrained = _resolve_with_pretrained(args)
+    if cfg.checkpoint_dir and getattr(args, "pth", None):
+        # Checked on the RESOLVED config: checkpoint_dir may come from a
+        # --config file, not just the flag.
+        raise SystemExit(
+            "--pth and a checkpoint_dir are both weight sources; pass one"
+        )
     if not cfg.checkpoint_dir and pretrained is None:
         raise SystemExit(
             "predict needs trained weights: pass --checkpoint-dir (a local "
@@ -1001,11 +1044,23 @@ def cmd_export_hf(args) -> int:
     from .models.hf_convert import flax_to_hf
     from .train.engine import Trainer
 
-    tok, cfg, _ = _resolve_with_pretrained(args)
-    if not cfg.checkpoint_dir:
-        raise SystemExit("export-hf needs --checkpoint-dir (trained weights)")
-    trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
-    model_cfg, params = _restore_predict_params(cfg, tok, trainer)
+    tok, cfg, pretrained = _resolve_with_pretrained(args)
+    if getattr(args, "pth", None) and cfg.checkpoint_dir:
+        # Resolved config: checkpoint_dir may come from a --config file.
+        raise SystemExit(
+            "--pth and a checkpoint_dir are both weight sources; pass one"
+        )
+    if cfg.checkpoint_dir:
+        trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
+        model_cfg, params = _restore_predict_params(cfg, tok, trainer)
+    elif getattr(args, "pth", None):
+        # Convert a reference-trained .pth straight to the HF layout.
+        model_cfg, params = cfg.model, pretrained
+    else:
+        raise SystemExit(
+            "export-hf needs trained weights: --checkpoint-dir, or "
+            "--pth + --hf-dir (a reference-trained model)"
+        )
     if model_cfg.n_classes != 2 or not isinstance(params, dict) or "encoder" not in params:
         raise SystemExit("checkpoint does not hold a classifier params tree")
     sd = flax_to_hf(jax.tree.map(np.asarray, params), model_cfg)
@@ -1044,16 +1099,35 @@ def cmd_export_hf(args) -> int:
 
 
 def cmd_distill(args) -> int:
-    """Train a (2x-deeper by default) teacher, distill it into the student
-    encoder, evaluate both — the recipe that produced the reference's
-    pretrained DistilBERT (client1.py:56), now a first-class capability."""
+    """Teacher -> student knowledge distillation — the recipe that produced
+    the reference's pretrained DistilBERT (client1.py:56).
+
+    Teacher sources, in precedence order: ``--teacher-checkpoint`` (a model
+    trained here, e.g. a federated aggregate), ``--pth`` + ``--hf-dir``
+    (a model the REFERENCE trained), or a fresh teacher trained in-run
+    (2x student depth by default). ``--student-layers`` shrinks the student
+    below the resolved model depth (e.g. distill a migrated 6-layer
+    reference model into 3 layers)."""
     from . import reporting
-    from .data import default_tokenizer
     from .train.distill import DistillTrainer
     from .train.engine import Trainer
 
-    tok = default_tokenizer()
-    cfg = resolve_config(args, vocab_size=len(tok.vocab))
+    if getattr(args, "teacher_checkpoint", None) and getattr(args, "pth", None):
+        raise SystemExit(
+            "--teacher-checkpoint and --pth are both teacher sources; pass one"
+        )
+    if getattr(args, "pth", None) and args.teacher_layers is not None:
+        raise SystemExit(
+            "--teacher-layers has no effect when --pth supplies the "
+            "teacher (its depth comes from --hf-dir's config.json)"
+        )
+    if getattr(args, "student_layers", None) is not None and args.student_layers < 1:
+        raise SystemExit(f"--student-layers {args.student_layers} must be >= 1")
+    # --teacher-checkpoint supplies the weights; skip the (full) --hf-dir
+    # weight load in that case — only tokenizer + architecture are needed.
+    tok, cfg, pretrained = _resolve_with_pretrained(
+        args, load_weights=not getattr(args, "teacher_checkpoint", None)
+    )
     # Flags override the config only where given; invalid values (e.g.
     # --temperature 0) flow into DistillConfig validation rather than being
     # silently replaced, and --no-teacher-init can only turn the init OFF.
@@ -1071,17 +1145,41 @@ def cmd_distill(args) -> int:
 
     from .utils.profiling import trace
 
+    student_cfg = (
+        cfg.model
+        if getattr(args, "student_layers", None) is None
+        else cfg.model.replace(n_layers=args.student_layers)
+    )
     teacher_layers = (
-        2 * cfg.model.n_layers if args.teacher_layers is None else args.teacher_layers
+        2 * student_cfg.n_layers
+        if args.teacher_layers is None
+        else args.teacher_layers
     )
     # ModelConfig validates n_layers >= 1; enforce deeper-than-student here so
     # a degenerate teacher fails before the training budget is spent.
-    if teacher_layers < cfg.model.n_layers:
+    if teacher_layers < student_cfg.n_layers:
         raise SystemExit(
             f"--teacher-layers {teacher_layers} is shallower than the "
-            f"{cfg.model.n_layers}-layer student"
+            f"{student_cfg.n_layers}-layer student"
         )
     teacher_cfg = cfg.model.replace(n_layers=teacher_layers)
+
+    def _check_teacher(tc):
+        if tc.n_layers < student_cfg.n_layers:
+            raise SystemExit(
+                f"teacher has {tc.n_layers} layers — shallower than the "
+                f"{student_cfg.n_layers}-layer student"
+            )
+        if (tc.dim, tc.n_heads, tc.hidden_dim) != (
+            student_cfg.dim, student_cfg.n_heads, student_cfg.hidden_dim,
+        ):
+            raise SystemExit(
+                f"teacher width (dim {tc.dim}, heads {tc.n_heads}, ffn "
+                f"{tc.hidden_dim}) != student (dim {student_cfg.dim}, heads "
+                f"{student_cfg.n_heads}, ffn {student_cfg.hidden_dim}): "
+                "depth-only distillation"
+            )
+
     with trace(getattr(args, "profile_dir", None)):
         if getattr(args, "teacher_checkpoint", None):
             # Distill a model trained elsewhere — e.g. the aggregate of a
@@ -1092,30 +1190,35 @@ def cmd_distill(args) -> int:
             teacher_cfg, teacher_params = _restore_predict_params(
                 cfg, tok, t_trainer, ckpt_dir=args.teacher_checkpoint
             )
-            if teacher_cfg.n_layers < cfg.model.n_layers:
-                raise SystemExit(
-                    f"teacher checkpoint has {teacher_cfg.n_layers} layers — "
-                    f"shallower than the {cfg.model.n_layers}-layer student"
-                )
-            if (teacher_cfg.dim, teacher_cfg.n_heads, teacher_cfg.hidden_dim) != (
-                cfg.model.dim, cfg.model.n_heads, cfg.model.hidden_dim,
-            ):
-                raise SystemExit(
-                    f"teacher checkpoint width (dim {teacher_cfg.dim}, "
-                    f"heads {teacher_cfg.n_heads}, ffn {teacher_cfg.hidden_dim}) "
-                    f"!= student (dim {cfg.model.dim}, heads "
-                    f"{cfg.model.n_heads}, ffn {cfg.model.hidden_dim}): "
-                    "depth-only distillation"
-                )
+            _check_teacher(teacher_cfg)
             if teacher_cfg != teacher_cfg_hint:
                 t_trainer = Trainer(teacher_cfg, cfg.train, pad_id=tok.pad_id)
             log.info(
                 f"[DISTILL] teacher from {args.teacher_checkpoint} "
                 f"({teacher_cfg.n_layers} layers)"
             )
+        elif getattr(args, "pth", None):
+            # The migrated reference model IS the (already-trained) teacher.
+            teacher_cfg, teacher_params = cfg.model, pretrained
+            _check_teacher(teacher_cfg)
+            t_trainer = Trainer(teacher_cfg, cfg.train, pad_id=tok.pad_id)
+            log.info(
+                f"[DISTILL] teacher from reference .pth {args.pth} "
+                f"({teacher_cfg.n_layers} layers)"
+            )
         else:
             t_trainer = Trainer(teacher_cfg, cfg.train, pad_id=tok.pad_id)
-            t_state = t_trainer.init_state()
+            # A bare --hf-dir encoder warm-starts the fresh teacher when the
+            # depths line up (the reference's own pretrained-start pattern).
+            warm = pretrained if teacher_cfg == cfg.model else None
+            if pretrained is not None and warm is None:
+                log.info(
+                    f"[DISTILL] --hf-dir encoder ({cfg.model.n_layers} "
+                    f"layers) cannot warm-start the {teacher_cfg.n_layers}-"
+                    f"layer teacher; pass --teacher-layers "
+                    f"{cfg.model.n_layers} to use it"
+                )
+            t_state = t_trainer.init_state(params=warm)
             with phase(
                 f"teacher training ({teacher_cfg.n_layers} layers)", tag="DISTILL"
             ):
@@ -1127,10 +1230,12 @@ def cmd_distill(args) -> int:
         teacher_metrics = t_trainer.evaluate(teacher_params, client.test)
 
         d_trainer = DistillTrainer(
-            cfg.model, teacher_cfg, cfg.train, cfg.distill, pad_id=tok.pad_id
+            student_cfg, teacher_cfg, cfg.train, cfg.distill, pad_id=tok.pad_id
         )
         s_state = d_trainer.init_student_state(teacher_params)
-        with phase(f"distilling into {cfg.model.n_layers}-layer student", tag="DISTILL"):
+        with phase(
+            f"distilling into {student_cfg.n_layers}-layer student", tag="DISTILL"
+        ):
             s_state, _ = d_trainer.distill(
                 s_state,
                 teacher_params,
@@ -1144,7 +1249,7 @@ def cmd_distill(args) -> int:
     log.info(
         f"[DISTILL] teacher acc {teacher_metrics['Accuracy']:.4f} -> "
         f"student acc {student_metrics['Accuracy']:.4f} "
-        f"({teacher_cfg.n_layers} -> {cfg.model.n_layers} layers)"
+        f"({teacher_cfg.n_layers} -> {student_cfg.n_layers} layers)"
     )
     os.makedirs(cfg.output_dir, exist_ok=True)
     reporting.save_metrics(
@@ -1164,13 +1269,16 @@ def cmd_distill(args) -> int:
         from .train.checkpoint import Checkpointer
 
         with Checkpointer(cfg.checkpoint_dir) as ckpt:
+            # Provenance records the STUDENT architecture (what the saved
+            # params actually are), not the resolved teacher-sized model.
+            student_experiment = dataclasses.replace(cfg, model=student_cfg)
             ckpt.save(
                 int(s_state.step),
                 s_state,
                 meta={
                     "distilled": True,
                     "kind": "local",
-                    "config": cfg.to_dict(),
+                    "config": student_experiment.to_dict(),
                 },
             )
             ckpt.wait()
@@ -1203,6 +1311,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         help="HF DistilBERT checkpoint dir (config.json + vocab.txt + "
         "model.safetensors|pytorch_model.bin) — the reference's required "
         "./distilbert-base-uncased; pretrained encoder + fresh head",
+    )
+    p.add_argument(
+        "--pth",
+        help="a reference-run .pth state dict (its DDoSClassifier / "
+        "aggregated model) as the weights, with --hf-dir supplying "
+        "tokenizer + architecture — direct migration of a model the "
+        "reference trained",
     )
     p.add_argument("--csv", help="flow CSV path (schema set by --dataset)")
     p.add_argument(
@@ -1403,7 +1518,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--teacher-checkpoint",
         help="distill FROM this trained checkpoint (local or federated — "
         "e.g. a federated BERT fleet's aggregate) instead of training a "
-        "fresh teacher",
+        "fresh teacher; --pth + --hf-dir similarly supplies a "
+        "reference-trained teacher",
+    )
+    p.add_argument(
+        "--student-layers",
+        type=int,
+        help="student depth (default: the resolved model's) — e.g. distill "
+        "a migrated 6-layer model into 3 layers",
     )
     p.add_argument("--distill-epochs", type=int, help="default: train epochs")
     p.add_argument("--temperature", type=float, help="KD softmax temperature")

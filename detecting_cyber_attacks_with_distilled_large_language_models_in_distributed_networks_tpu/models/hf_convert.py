@@ -209,6 +209,28 @@ def load_hf_dir(
     return hf_to_flax(sd, cfg, head_rng=head_rng), cfg
 
 
+def load_reference_pth(path: str, cfg: ModelConfig) -> dict:
+    """Load a reference-run ``.pth`` state dict (torch.save of its
+    DDoSClassifier — ``distilbert.*`` encoder + ``classifier.*`` head,
+    reference client1.py:53-58,388; server.py:77) into Flax params: the
+    direct migration path for models trained by the reference itself.
+
+    Requires the trained head — a headless dict is not a reference
+    training artifact, and silently random-initializing would betray the
+    "migrate my trained model" intent.
+    """
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if not any(str(k).startswith("classifier.") for k in sd):
+        raise ValueError(
+            f"{path} has no classifier.* keys — not a reference training "
+            "artifact (expected its DDoSClassifier state dict, "
+            "client1.py:53-58)"
+        )
+    return hf_to_flax(sd, cfg)
+
+
 def flax_to_hf(params: Mapping[str, Any], cfg: ModelConfig) -> dict[str, np.ndarray]:
     """Inverse mapping, producing the reference's full-classifier key space
     (``distilbert.*`` + ``classifier.*``) as numpy arrays — e.g. to export a

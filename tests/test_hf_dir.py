@@ -170,3 +170,101 @@ def test_hf_to_flax_rejects_sequence_classifier_checkpoints(hf_dir):
     sd["classifier.bias"] = np.zeros((2,), np.float32)
     with pytest.raises(ValueError, match="pre_classifier"):
         hf_to_flax(sd, config_from_hf_dir(hf_dir))
+
+
+def test_pth_migration_loads_reference_artifact(hf_dir, tmp_path):
+    """A reference-run .pth (distilbert.* + classifier.* state dict,
+    client1.py:53-58,388) migrates directly: --pth supplies the trained
+    weights, --hf-dir the tokenizer/architecture, and predict runs it."""
+    import torch
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.models.hf_convert import (
+        config_from_hf_dir,
+        load_reference_pth,
+    )
+
+    torch.manual_seed(0)
+    enc = transformers.DistilBertModel.from_pretrained(hf_dir)
+    sd = {f"distilbert.{k}": v for k, v in enc.state_dict().items()}
+    head_w = torch.randn(2, DIM)
+    sd["classifier.weight"] = head_w
+    sd["classifier.bias"] = torch.zeros(2)
+    pth = str(tmp_path / "client1_model.pth")
+    torch.save(sd, pth)
+
+    cfg = config_from_hf_dir(hf_dir)
+    params = load_reference_pth(pth, cfg)
+    np.testing.assert_allclose(
+        np.asarray(params["classifier"]["kernel"]),
+        head_w.numpy().T,
+        rtol=1e-6,
+    )
+
+    # Headless dict is not a migration artifact.
+    sd_headless = {k: v for k, v in sd.items() if not k.startswith("classifier.")}
+    pth2 = str(tmp_path / "headless.pth")
+    torch.save(sd_headless, pth2)
+    with pytest.raises(ValueError, match="classifier"):
+        load_reference_pth(pth2, cfg)
+
+    # End-to-end: predict from the migrated model (no checkpoint needed).
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
+        main,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+        write_synthetic_csv,
+    )
+
+    csv = str(tmp_path / "flows.csv")
+    write_synthetic_csv(csv, n_rows=24, seed=4)
+    out = str(tmp_path / "preds.csv")
+    assert (
+        main(
+            ["predict", "--csv", csv, "--hf-dir", hf_dir, "--pth", pth,
+             "--output", out]
+        )
+        == 0
+    )
+    assert os.path.exists(out)
+
+    # --pth without --hf-dir is refused (no tokenizer/architecture source).
+    with pytest.raises(SystemExit, match="--hf-dir"):
+        main(["predict", "--csv", csv, "--pth", pth, "--output", out])
+
+
+def test_distill_from_reference_pth(hf_dir, tmp_path):
+    """Distill a migrated reference model (--pth teacher) into a shallower
+    student (--student-layers): the full migration-then-compress flow."""
+    import torch
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
+        main,
+    )
+
+    torch.manual_seed(1)
+    enc = transformers.DistilBertModel.from_pretrained(hf_dir)
+    sd = {f"distilbert.{k}": v for k, v in enc.state_dict().items()}
+    sd["classifier.weight"] = torch.randn(2, DIM)
+    sd["classifier.bias"] = torch.zeros(2)
+    pth = str(tmp_path / "aggregated.pth")
+    torch.save(sd, pth)
+
+    out = str(tmp_path / "dist")
+    assert (
+        main(
+            [
+                "distill", "--synthetic", "200", "--epochs", "1",
+                "--batch-size", "8", "--hf-dir", hf_dir, "--pth", pth,
+                "--student-layers", "1", "--distill-epochs", "1",
+                "--output-dir", out,
+            ]
+        )
+        == 0
+    )
+    assert os.path.exists(os.path.join(out, "student_metrics.csv"))
+    # Conflicting teacher sources are refused.
+    with pytest.raises(SystemExit, match="both teacher sources"):
+        main(
+            ["distill", "--synthetic", "100", "--hf-dir", hf_dir,
+             "--pth", pth, "--teacher-checkpoint", str(tmp_path)]
+        )
