@@ -266,3 +266,31 @@ def test_config_rejects_attention_dropout_for_flash_and_ring():
     for impl in ("flash", "ring"):
         with pytest.raises(ValueError, match="attention dropout"):
             ModelConfig.tiny(attention_impl=impl, attention_dropout=0.1)
+
+
+def test_flash_handles_non_multiple_block_lengths():
+    """L=384 doesn't tile into the default 256/512 blocks — the kernel must
+    snap to a divisor (gcd -> 128) instead of erroring, and still match the
+    dot path."""
+    import numpy as np
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.ops.attention import (
+        dot_product_attention,
+        make_attention_bias,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.ops.flash_attention import (
+        flash_attention,
+    )
+
+    rng = np.random.default_rng(0)
+    B, H, L, D = 2, 2, 384, 16
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, H, L, D)).astype(np.float32))
+        for _ in range(3)
+    )
+    mask = np.ones((B, L), np.int32)
+    mask[1, 300:] = 0
+    bias = make_attention_bias(jnp.asarray(mask))
+    out = flash_attention(q, k, v, bias)
+    ref = dot_product_attention(q, k, v, bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
