@@ -232,10 +232,16 @@ def _resolve_with_pretrained(args, *, load_weights: bool = True):
     )
     # Activation precedence: --gelu flag > --config file's model section >
     # the checkpoint's declared activation (config.json) > library default.
+    # The config file only wins when it actually SAYS gelu — a file saved
+    # before the field existed must not inject today's library default over
+    # the checkpoint's declared activation (same legacy rule as
+    # ExperimentConfig.from_checkpoint_dict).
     if getattr(args, "gelu", None):
         overrides["gelu"] = args.gelu
     elif getattr(args, "config", None):
-        overrides["gelu"] = m.gelu
+        with open(args.config) as f:
+            if "gelu" in json.load(f).get("model", {}):
+                overrides["gelu"] = m.gelu
     if getattr(args, "max_len", None):
         overrides["max_len"] = args.max_len
     model_cfg = config_from_hf_dir(hf_dir, **overrides)
@@ -861,7 +867,7 @@ def _restore_predict_params(cfg, tok, trainer, *, ckpt_dir=None):
         if is_fed:
             from .train.federated import FederatedTrainer
 
-            fed_cfg = ExperimentConfig.from_dict(meta["config"])
+            fed_cfg = ExperimentConfig.from_checkpoint_dict(meta["config"])
             if fed_cfg.model.vocab_size != cfg.model.vocab_size:
                 raise SystemExit(
                     f"checkpoint model vocab ({fed_cfg.model.vocab_size}) != "
@@ -892,7 +898,7 @@ def _restore_predict_params(cfg, tok, trainer, *, ckpt_dir=None):
             # export) the wrong activation.
             from .train.engine import Trainer
 
-            ckpt_cfg = ExperimentConfig.from_dict(meta["config"])
+            ckpt_cfg = ExperimentConfig.from_checkpoint_dict(meta["config"])
             if ckpt_cfg.model.vocab_size != cfg.model.vocab_size:
                 raise SystemExit(
                     f"checkpoint model vocab ({ckpt_cfg.model.vocab_size}) "
@@ -1544,7 +1550,9 @@ def build_parser() -> argparse.ArgumentParser:
         "(config.json + model.safetensors + vocab.txt)",
     )
     _add_common(p)
-    p.add_argument("--checkpoint-dir", required=True)
+    # Not required: --pth + --hf-dir is the other valid weight source
+    # (cmd_export_hf checks that exactly one is given at runtime).
+    p.add_argument("--checkpoint-dir")
     p.add_argument("--out", required=True, help="output HF checkpoint dir")
     p.set_defaults(fn=cmd_export_hf)
 

@@ -27,7 +27,13 @@ reused across rounds or server restarts; a client instance additionally
 refuses a (session, round) it has already masked different weights for.
 
 Threat model: honest-but-curious server and passive wire observers (the
-semi-honest setting of the Bonawitz paper). Out of scope for this minimal
+semi-honest setting of the Bonawitz paper), with **mutually trusted
+clients**: all pairwise streams derive from the ONE shared mask secret, so
+any single client — or anyone who obtains that secret — can regenerate
+every pair's stream and unmask every other client's upload from the wire.
+Privacy here is against the server/wire only, not between clients; full
+Bonawitz derives per-pair keys by Diffie-Hellman agreement so each client
+can reconstruct only its own pairs. Also out of scope for this minimal
 form: a fully malicious server actively replaying session nonces across
 its own restarts (full Bonawitz adds signed key agreement), and client
 dropout recovery — every advertised participant must upload; the server

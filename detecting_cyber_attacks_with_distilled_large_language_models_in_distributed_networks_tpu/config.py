@@ -409,3 +409,17 @@ class ExperimentConfig:
             if scalar in d:
                 kw[scalar] = d[scalar]
         return cls(**kw)
+
+    @classmethod
+    def from_checkpoint_dict(cls, d: Mapping[str, Any]) -> "ExperimentConfig":
+        """``from_dict`` for a checkpoint's *recorded* config, applying the
+        library defaults that were in force when old checkpoints were saved
+        rather than today's: configs that predate the ``gelu`` field were
+        trained under the then-default erf GELU, so an absent key means
+        "exact", not the current ``tanh`` default."""
+        model = dict(d.get("model", {}))
+        if "gelu" not in model:
+            model["gelu"] = "exact"
+        out = dict(d)
+        out["model"] = model
+        return cls.from_dict(out)

@@ -37,6 +37,40 @@ from jax.experimental import pallas as pl
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 512
 
+# Smallest blocks worth running as a Pallas grid. A whole-length single
+# block is always fine (block == array dim); otherwise blocks below the TPU
+# sublane/lane tile (8 query rows, 128 key columns) would lower poorly and
+# a gcd-degenerate fit (e.g. prime L -> block 1) would build a pathological
+# grid — those lengths take the XLA dot path instead (see flash_attention).
+MIN_BLOCK_Q = 8
+MIN_BLOCK_K = 128
+
+
+def _fit(block: int, length: int) -> int:
+    """Largest block <= the requested size that tiles ``length``: short
+    sequences clamp to L, and lengths that aren't multiples of the
+    default (e.g. 384 vs 512) snap to gcd."""
+    if length <= block:
+        return length
+    import math
+
+    return math.gcd(length, block)
+
+
+def fits_blocks(lq: int, lk: int, block_q: int, block_k: int) -> bool:
+    """Whether (lq, lk) tile into viable Pallas blocks for these requests.
+
+    A block exactly as requested, or covering the whole length, is always
+    viable (explicit small blocks are the caller's choice — tests use them
+    under interpret mode); only a gcd fit that SHRANK the request below the
+    TPU tile minimum is degenerate."""
+
+    def ok(length: int, block: int, min_block: int) -> bool:
+        fit = _fit(block, length)
+        return fit == block or fit == length or fit >= min_block
+
+    return ok(lq, block_q, MIN_BLOCK_Q) and ok(lk, block_k, MIN_BLOCK_K)
+
 
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale: float, block_k: int):
     """One query block vs. all key blocks, online softmax.
@@ -109,16 +143,6 @@ def _flash_forward(
 ) -> jnp.ndarray:
     b, h, lq, d = q.shape
     lk = k.shape[2]
-
-    def _fit(block: int, length: int) -> int:
-        """Largest block <= the requested size that tiles ``length``: short
-        sequences clamp to L, and lengths that aren't multiples of the
-        default (e.g. 384 vs 512) snap to gcd instead of erroring."""
-        if length <= block:
-            return length
-        import math
-
-        return math.gcd(length, block)
 
     block_q = _fit(block_q, lq)
     block_k = _fit(block_k, lk)
@@ -200,7 +224,15 @@ def flash_attention(
 ) -> jnp.ndarray:
     """Blocked flash attention; drop-in for ``dot_product_attention`` (minus
     attention dropout). ``interpret=None`` auto-selects interpreter mode off
-    TPU so the same tests run on the CPU mesh."""
+    TPU so the same tests run on the CPU mesh.
+
+    Lengths whose gcd with the requested blocks is degenerate (prime or odd
+    L — block 1 would mean an Lq-step grid) fall back to the XLA dot path,
+    which is faster than a shredded Pallas grid at any such length."""
+    if not fits_blocks(q.shape[2], k.shape[2], block_q, block_k):
+        from .attention import dot_product_attention
+
+        return dot_product_attention(q, k, v, bias)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return _flash(q, k, v, bias, block_q, block_k, interpret)

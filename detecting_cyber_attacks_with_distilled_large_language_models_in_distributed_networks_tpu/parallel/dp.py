@@ -9,10 +9,19 @@ Recurrent Language Models", 2018):
 
 1. each client's round update ``delta_c = params_c - anchor`` is clipped to
    a global L2 norm of at most ``clip``,
-2. the uniform mean over the ``n`` participating clients is taken (L2
-   sensitivity ``clip / n`` under add-or-remove of one client),
+2. the uniform mean over the ``n`` participating clients is taken,
 3. Gaussian noise with std ``noise_multiplier * clip / n`` is added to the
    mean update before it is applied to the anchor and broadcast back.
+
+Adjacency notion (what the reported epsilon means): **zeroed-contribution
+adjacency with a fixed divisor** — neighboring executions differ in one
+client's clipped update being replaced by the zero vector while the
+divisor ``n`` stays fixed, giving L2 sensitivity ``clip / n``. This is the
+McMahan et al. convention (their fixed denominator ``qW``). Under the
+stricter replace-one adjacency (one client's update swapped for an
+arbitrary other) the mean's sensitivity is ``2 * clip / n`` and the same
+noise yields roughly 4x weaker (epsilon, delta); halve the effective
+noise multiplier fed to the accountant for that conservative bound.
 
 Everything is one jitted function over the ``[C, ...]`` stacked pytree
 sharded on the ``clients`` mesh axis — the clip/mean/noise pipeline lowers

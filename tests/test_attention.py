@@ -294,3 +294,31 @@ def test_flash_handles_non_multiple_block_lengths():
     out = flash_attention(q, k, v, bias)
     ref = dot_product_attention(q, k, v, bias)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_degenerate_length_falls_back_to_dot(rng):
+    """Prime / odd lengths whose gcd with the default blocks is degenerate
+    must take the XLA dot path (block-1 Pallas grids are pathological),
+    still matching dot numerics exactly."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.ops.flash_attention import (
+        DEFAULT_BLOCK_K,
+        DEFAULT_BLOCK_Q,
+        fits_blocks,
+    )
+
+    assert fits_blocks(64, 64, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)  # <= block
+    assert fits_blocks(2048, 2048, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+    assert not fits_blocks(1031, 1031, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)  # prime
+    assert not fits_blocks(768, 1031, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+    # 768 = 256*3: q fits; k gcd(768, 512)=256 >= 128: fits.
+    assert fits_blocks(768, 768, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+
+    q, k, v = _qkv(rng, l=521)  # prime length > default blocks
+    bias = _mask_bias(rng, l=521)
+    ref = dot_product_attention(q, k, v, bias)
+    out = flash_attention(q, k, v, bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+    # And gradients flow through the fallback.
+    g = jax.grad(lambda q: flash_attention(q, k, v, bias).sum())(q)
+    gref = jax.grad(lambda q: dot_product_attention(q, k, v, bias).sum())(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gref), atol=1e-5)

@@ -58,3 +58,20 @@ def test_bert_base_preset():
     m = ModelConfig.bert_base()
     assert m.n_layers == 12 and m.dim == 768
     assert ModelConfig.tiny().head_dim == 16
+
+
+def test_from_checkpoint_dict_legacy_gelu_default():
+    """Checkpoints recorded before the gelu field existed were trained
+    under the then-default erf GELU; restoring their config must not pick
+    up today's tanh default."""
+    cfg = ExperimentConfig()
+    d = cfg.to_dict()
+    del d["model"]["gelu"]  # a pre-gelu-field checkpoint's recorded config
+    assert ExperimentConfig.from_checkpoint_dict(d).model.gelu == "exact"
+    # An explicitly recorded gelu always wins.
+    d["model"]["gelu"] = "tanh"
+    assert ExperimentConfig.from_checkpoint_dict(d).model.gelu == "tanh"
+    # A config with no model section at all is also legacy-exact.
+    d2 = cfg.to_dict()
+    del d2["model"]
+    assert ExperimentConfig.from_checkpoint_dict(d2).model.gelu == "exact"

@@ -268,3 +268,80 @@ def test_distill_from_reference_pth(hf_dir, tmp_path):
             ["distill", "--synthetic", "100", "--hf-dir", hf_dir,
              "--pth", pth, "--teacher-checkpoint", str(tmp_path)]
         )
+
+def test_export_hf_from_reference_pth(hf_dir, tmp_path):
+    """export-hf --pth + --hf-dir (no checkpoint dir): a reference-trained
+    .pth converts straight to the HF layout — the documented migration
+    path '.pth + --hf-dir -> HF layout' (cmd_export_hf's elif branch)."""
+    import torch
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
+        main,
+    )
+
+    torch.manual_seed(1)
+    enc = transformers.DistilBertModel.from_pretrained(hf_dir)
+    sd = {f"distilbert.{k}": v for k, v in enc.state_dict().items()}
+    head_w = torch.randn(2, DIM)
+    sd["classifier.weight"] = head_w
+    sd["classifier.bias"] = torch.zeros(2)
+    pth = str(tmp_path / "aggregated.pth")
+    torch.save(sd, pth)
+
+    out = str(tmp_path / "hf_out")
+    assert (
+        main(["export-hf", "--hf-dir", hf_dir, "--pth", pth, "--out", out])
+        == 0
+    )
+    assert sorted(os.listdir(out)) == [
+        "config.json", "model.safetensors", "vocab.txt",
+    ]
+    # The migrated classifier head survives the round trip.
+    from safetensors.numpy import load_file
+
+    exported = load_file(os.path.join(out, "model.safetensors"))
+    np.testing.assert_allclose(
+        exported["classifier.weight"], head_w.numpy(), rtol=1e-6
+    )
+    # Both weight sources together are still refused.
+    with pytest.raises(SystemExit, match="both weight sources"):
+        main(
+            ["export-hf", "--hf-dir", hf_dir, "--pth", pth,
+             "--checkpoint-dir", str(tmp_path / "ck"), "--out", out]
+        )
+    # Neither source is refused too (the runtime check, not argparse).
+    with pytest.raises(SystemExit, match="trained weights"):
+        main(["export-hf", "--hf-dir", hf_dir, "--out", out])
+
+def test_pre_gelu_config_file_defers_to_checkpoint_activation(hf_dir, tmp_path):
+    """A --config file saved before the gelu field existed must not inject
+    today's library default (tanh) over the --hf-dir checkpoint's declared
+    erf activation; a file that explicitly says gelu still wins."""
+    import argparse
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
+        _resolve_with_pretrained,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        ExperimentConfig,
+    )
+
+    d = ExperimentConfig().to_dict()
+    del d["model"]["gelu"]  # pre-gelu-field export-config output
+    old_cfg = tmp_path / "old.json"
+    old_cfg.write_text(json.dumps(d))
+
+    def resolve(config_path):
+        args = argparse.Namespace(
+            hf_dir=hf_dir, config=str(config_path), preset="tiny",
+            max_len=None, gelu=None,
+        )
+        _, cfg, _ = _resolve_with_pretrained(args, load_weights=False)
+        return cfg.model.gelu
+
+    # hf_dir's config.json declares HF's default "gelu" (erf) activation.
+    assert resolve(old_cfg) == "exact"
+    d["model"]["gelu"] = "tanh"
+    new_cfg = tmp_path / "new.json"
+    new_cfg.write_text(json.dumps(d))
+    assert resolve(new_cfg) == "tanh"
