@@ -133,7 +133,13 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
                 cfg.fed,
                 num_clients=n,
                 rounds=getattr(args, "rounds", None) or cfg.fed.rounds,
-                weighted=bool(getattr(args, "weighted", False)) or cfg.fed.weighted,
+                weighted=(
+                    True
+                    if getattr(args, "weighted", False)
+                    else False
+                    if getattr(args, "unweighted", False)
+                    else cfg.fed.weighted
+                ),
                 prox_mu=(
                     cfg.fed.prox_mu
                     if getattr(args, "prox_mu", None) is None
@@ -431,7 +437,7 @@ def cmd_local(args) -> int:
 def cmd_federated(args) -> int:
     import jax
 
-    from .data import stack_clients, tokenize_client
+    from .data import stack_clients_ragged, tokenize_client
     from .train.federated import FederatedTrainer
 
     # Multi-host bootstrap must precede the first backend touch
@@ -498,7 +504,6 @@ def cmd_federated(args) -> int:
                 "per-host client slicing of the streamed plan)"
             )
         clients = _load_clients(args, cfg, tok, C)
-        n_train_common = min(len(c.train) for c in clients)
         eval_rows_global = max(len(c.test) for c in clients)
         train_sizes = [len(c.train) for c in clients]
     else:
@@ -516,10 +521,16 @@ def cmd_federated(args) -> int:
                 tokenize_client(splits[c], tok, max_len=cfg.model.max_len)
                 for c in local_ids
             ]
-        n_train_common = min(len(s.train) for s in splits)
         eval_rows_global = max(len(s.test) for s in splits)
         train_sizes = [len(s.train) for s in splits]
-    stacked_train = stack_clients([c.train for c in clients], n_rows=n_train_common)
+    # Ragged stack to the GLOBAL fleet-max row count: no client's rows are
+    # truncated (the reference's N independent processes each train on all
+    # their own samples), and every host agrees on the stacked shape.
+    stacked_train = stack_clients_ragged(
+        [c.train for c in clients],
+        pad_id=tok.pad_id,
+        target_rows=max(train_sizes),
+    )
     trainer = FederatedTrainer(cfg, pad_id=tok.pad_id, mesh=mesh)
 
     ckpt = None
@@ -544,8 +555,10 @@ def cmd_federated(args) -> int:
 
     # FedAvg weights are the GLOBAL per-client sample counts (known from the
     # cheap split phase on every host, reference semantics: weight by data).
+    # weighted=None (the default) auto-weights; --unweighted forces the
+    # reference's literal uniform mean.
     weights = (
-        np.array(train_sizes, np.float64) if cfg.fed.weighted else None
+        np.array(train_sizes, np.float64) if cfg.fed.resolve_weighted() else None
     )
     from .utils.profiling import trace
 
@@ -1388,7 +1401,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-clients", type=int, default=None)  # None: config wins
     p.add_argument("--rounds", type=int)
     p.add_argument("--data-parallel", type=int, help="per-client data-parallel shards")
-    p.add_argument("--weighted", action="store_true", help="weight FedAvg by sample count")
+    g = p.add_mutually_exclusive_group()
+    g.add_argument(
+        "--weighted",
+        action="store_true",
+        help="require sample-count FedAvg weights (the auto default already "
+        "weights by sample count when counts are known and DP is off)",
+    )
+    g.add_argument(
+        "--unweighted",
+        action="store_true",
+        help="force the uniform mean (the reference's server.py:73-76)",
+    )
     p.add_argument("--partition", help="sample|disjoint|dirichlet")
     p.add_argument(
         "--dirichlet-alpha",

@@ -212,7 +212,14 @@ class FedConfig:
 
     num_clients: int = 2
     rounds: int = 1
-    weighted: bool = False
+    # FedAvg weighting. None (default) = auto: weight by true per-client
+    # sample count whenever the counts are known (the ragged stacked path
+    # carries them) and DP is off — matching the reference's *semantics*
+    # (each client's rows influence the fleet equally) for unequal fleets
+    # while reproducing its unweighted mean exactly for equal ones.
+    # True = require sample-count weights; False = force the uniform mean
+    # (the reference's literal server.py:73-76 arithmetic).
+    weighted: bool | None = None
     # FedProx (Li et al.): local loss += mu/2 * ||w - w_round_start||^2,
     # anchoring client drift under non-IID partitions (the dirichlet knob,
     # BASELINE.json config 3). 0 = plain FedAvg, the reference's algorithm.
@@ -253,6 +260,13 @@ class FedConfig:
 
     def server_opt_enabled(self) -> bool:
         return self.server_opt != "none"
+
+    def resolve_weighted(self) -> bool:
+        """The effective weighting choice: auto (None) weights by sample
+        count unless DP needs its uniform mean."""
+        if self.weighted is None:
+            return self.dp_clip == 0.0
+        return self.weighted
 
     def __post_init__(self) -> None:
         if not 0.0 < self.participation <= 1.0:

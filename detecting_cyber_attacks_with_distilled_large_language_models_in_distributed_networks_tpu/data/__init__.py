@@ -48,7 +48,9 @@ from .pipeline import (  # noqa: F401
     batch_iterator,
     num_batches,
     pad_split_to_batch,
+    StackedClients,
     stack_clients,
+    stack_clients_ragged,
     tokenize_client,
     tokenize_split,
 )

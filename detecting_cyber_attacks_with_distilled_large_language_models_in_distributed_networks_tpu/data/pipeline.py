@@ -120,11 +120,85 @@ def stack_clients(
 ) -> TokenizedSplit:
     """Stack per-client splits into ``[C, N, ...]`` arrays with a common N
     (min across clients unless given) — the feed format for the stacked
-    federated train step, where axis 0 shards over the ``clients`` mesh axis."""
+    federated train step, where axis 0 shards over the ``clients`` mesh axis.
+
+    TRUNCATES rows beyond the common N; for unequal clients prefer
+    :func:`stack_clients_ragged`, which pads to the fleet max with validity
+    masks so every client's full split enters training."""
     if n_rows is None:
         n_rows = min(len(c) for c in clients)
     return TokenizedSplit(
         np.stack([c.input_ids[:n_rows] for c in clients]),
         np.stack([c.attention_mask[:n_rows] for c in clients]),
         np.stack([c.labels[:n_rows] for c in clients]),
+    )
+
+
+@dataclass
+class StackedClients:
+    """Ragged per-client train splits stacked to a common (fleet-max) row
+    count with per-row validity — the lossless feed format for the stacked
+    federated train step. Unlike :func:`stack_clients` (fleet-min
+    truncation), every client's every row enters training; pad rows carry
+    ``row_valid == 0`` and contribute nothing to losses or gradients.
+
+    The reference's N independent processes each consume 100% of their own
+    (differently sized) samples (reference client1.py:89 vs client2.py:84);
+    this is the SPMD shape of that exact semantic."""
+
+    split: TokenizedSplit  # [C, N_max, ...]
+    row_valid: np.ndarray  # [C, N_max] int32 0/1
+    n_rows: np.ndarray  # [C] true per-client row counts
+
+    @property
+    def labels(self) -> np.ndarray:
+        return self.split.labels
+
+    def __len__(self) -> int:
+        return len(self.n_rows)
+
+
+def stack_clients_ragged(
+    clients: Sequence[TokenizedSplit],
+    *,
+    pad_id: int = 0,
+    target_rows: int | None = None,
+) -> StackedClients:
+    """Stack unequal per-client splits into ``[C, N_max, ...]`` arrays plus
+    a validity matrix, padding short clients with PAD rows (attention mask
+    all zero, label 0, valid 0). ``target_rows`` lets multi-host callers
+    pass the GLOBAL max split length so every host agrees on N_max (the
+    stacked train loop is a sequence of collectives)."""
+    n_rows = np.array([len(c) for c in clients], np.int64)
+    target = int(n_rows.max()) if len(clients) else 0
+    if target_rows is not None:
+        if target_rows < target:
+            raise ValueError(
+                f"target_rows={target_rows} < local max split length {target}"
+            )
+        target = target_rows
+    ids, masks, labels, valid = [], [], [], []
+    for c in clients:
+        extra = target - len(c)
+        L = c.input_ids.shape[1]
+        ids.append(
+            np.concatenate(
+                [c.input_ids, np.full((extra, L), pad_id, c.input_ids.dtype)]
+            )
+        )
+        masks.append(
+            np.concatenate(
+                [c.attention_mask, np.zeros((extra, L), c.attention_mask.dtype)]
+            )
+        )
+        labels.append(
+            np.concatenate([c.labels, np.zeros(extra, c.labels.dtype)])
+        )
+        valid.append(
+            np.concatenate([np.ones(len(c), np.int32), np.zeros(extra, np.int32)])
+        )
+    return StackedClients(
+        TokenizedSplit(np.stack(ids), np.stack(masks), np.stack(labels)),
+        np.stack(valid),
+        n_rows,
     )

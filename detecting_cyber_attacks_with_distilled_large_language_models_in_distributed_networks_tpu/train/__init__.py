@@ -15,5 +15,6 @@ from .federated import (  # noqa: F401
     FedState,
     RoundRecord,
     federated_batches,
+    federated_batches_ragged,
     stack_eval_splits,
 )

@@ -97,6 +97,27 @@ def loss_fn(model: DDoSClassifier, params, batch, rng) -> jnp.ndarray:
     ).mean()
 
 
+def masked_loss_fn(model: DDoSClassifier, params, batch, rng) -> jnp.ndarray:
+    """Training CE over the batch's ``valid`` rows only (mean over valid;
+    0 for an all-padding batch). Equals :func:`loss_fn` on the valid subset
+    — the ragged federated path's per-batch objective, so a padded stacked
+    client optimizes exactly what an independent run on its own rows would
+    (reference DataLoader semantics incl. the short final batch,
+    client1.py:370 with torch's drop_last=False default)."""
+    logits = model.apply(
+        {"params": params},
+        batch["input_ids"],
+        batch["attention_mask"],
+        False,
+        rngs={"dropout": rng},
+    )
+    per_example = optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["labels"]
+    )
+    v = batch["valid"].astype(jnp.float32)
+    return (per_example * v).sum() / jnp.maximum(v.sum(), 1.0)
+
+
 def eval_counts(
     model: DDoSClassifier, params, batch, valid
 ) -> tuple[BinaryCounts, jnp.ndarray]:

@@ -33,12 +33,18 @@ import numpy as np
 import optax
 
 from ..config import ExperimentConfig
-from ..data.pipeline import TokenizedSplit, pad_split_to_batch
+from ..data.pipeline import StackedClients, TokenizedSplit, pad_split_to_batch
 from ..models.distilbert import DDoSClassifier, init_params
 from ..ops.metrics import BinaryCounts, finalize_metrics
 from ..parallel.fedavg import make_fedavg_step
 from ..parallel.mesh import FedShardings, make_mesh
-from ..train.engine import apply_warmup, eval_counts, loss_fn, make_optimizer
+from ..train.engine import (
+    apply_warmup,
+    eval_counts,
+    loss_fn,
+    make_optimizer,
+    masked_loss_fn,
+)
 from ..utils.logging import get_logger, phase
 
 log = get_logger()
@@ -90,6 +96,63 @@ def federated_batches(
             "input_ids": stacked.input_ids[rows, idx],
             "attention_mask": stacked.attention_mask[rows, idx],
             "labels": stacked.labels[rows, idx],
+        }
+
+
+def federated_batches_ragged(
+    stacked: StackedClients,
+    batch_size: int,
+    *,
+    seed: int,
+    epoch: int,
+    client_offset: int = 0,
+    n_batches: int | None = None,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Per-epoch ``[C, B, ...]`` batches over a RAGGED client stack, with a
+    ``valid`` ``[C, B]`` 0/1 mask. Each client's real rows are permuted
+    independently (same keying as :func:`federated_batches`) and consumed
+    exactly once per epoch: a client whose rows run out pads its remaining
+    lockstep batches with valid == 0 (its train step is gated off), and the
+    final partial batch mixes real and padding rows. ``n_batches`` lets
+    multi-host callers force the GLOBAL max step count.
+
+    Every batch also carries ``warmup_step`` ``[C, B]`` — each client's OWN
+    executed-step count entering this batch (``epoch * ceil(n_c/bs) +
+    min(i, ceil(n_c/bs))``, broadcast over B so it rides the standard batch
+    sharding). The ragged train step keys LR warmup on it, so a short
+    client's schedule advances only when the client actually steps —
+    matching its independent-run trajectory (the dense path's global
+    ``state.step`` would compress idle clients' warmup ramps)."""
+    C = stacked.split.labels.shape[0]
+    steps = n_batches
+    if steps is None:
+        steps = max(-(-int(n) // batch_size) for n in stacked.n_rows)
+    span = steps * batch_size
+    idx = np.zeros((C, span), np.int64)
+    valid = np.zeros((C, span), np.int32)
+    for c in range(C):
+        n_c = int(stacked.n_rows[c])
+        perm = np.random.default_rng(
+            (seed * 100_003 + epoch) * 1_000_003 + client_offset + c
+        ).permutation(n_c)
+        idx[c, :n_c] = perm
+        valid[c, :n_c] = 1
+    own_steps = np.array(
+        [-(-int(n) // batch_size) for n in stacked.n_rows], np.int32
+    )
+    rows = np.arange(C)[:, None]
+    for i in range(steps):
+        sl = slice(i * batch_size, (i + 1) * batch_size)
+        take = idx[:, sl]
+        wstep = epoch * own_steps + np.minimum(i, own_steps)
+        yield {
+            "input_ids": stacked.split.input_ids[rows, take],
+            "attention_mask": stacked.split.attention_mask[rows, take],
+            "labels": stacked.split.labels[rows, take],
+            "valid": valid[:, sl],
+            "warmup_step": np.broadcast_to(
+                wstep[:, None], (C, batch_size)
+            ).copy(),
         }
 
 
@@ -264,6 +327,79 @@ class FederatedTrainer:
                 in_shardings=(state_sh, batch_sh),
                 out_shardings=(state_sh, csh),
             )(lambda state, batch: _step_body(state, batch, None))
+
+        def per_client_step_masked(params, opt_state, batch, rng, anchor):
+            """Row-masked variant for the ragged stacked path: the loss
+            averages over the batch's valid rows only, and a client whose
+            lockstep batch is ALL padding keeps its params/optimizer state
+            untouched (zero grads through Adam would still move the moments
+            — a phantom update an independent run never takes)."""
+
+            def obj(p):
+                task = masked_loss_fn(model, p, batch, rng)
+                total = task
+                if mu > 0.0:
+                    sq = sum(
+                        jnp.sum(jnp.square(a - b))
+                        for a, b in zip(
+                            jax.tree.leaves(p), jax.tree.leaves(anchor)
+                        )
+                    )
+                    total = task + 0.5 * mu * sq
+                return total, task
+
+            (_, task), grads = jax.value_and_grad(obj, has_aux=True)(params)
+            updates, new_opt = optimizer.update(grads, opt_state, params)
+            # Warmup rides the client's OWN executed-step count (see
+            # federated_batches_ragged), not the shared lockstep counter —
+            # an idling client's ramp must not advance.
+            updates = apply_warmup(updates, batch["warmup_step"][0], wsteps)
+            new_params = optax.apply_updates(params, updates)
+            has = batch["valid"].sum() > 0
+            params = jax.tree.map(
+                lambda n, o: jnp.where(has, n, o), new_params, params
+            )
+            opt_state = jax.tree.map(
+                lambda n, o: jnp.where(has, n, o), new_opt, opt_state
+            )
+            return params, opt_state, task, has.astype(jnp.float32)
+
+        ragged_batch_sh = dict(batch_sh, valid=bsh, warmup_step=bsh)
+
+        def _ragged_body(state: FedState, batch, anchor):
+            step_rngs = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+                state.rngs, state.step
+            )
+            params, opt_state, losses, has = jax.vmap(
+                per_client_step_masked,
+                in_axes=(0, 0, 0, 0, 0 if mu > 0.0 else None),
+            )(state.params, state.opt_state, batch, step_rngs, anchor)
+            return (
+                state._replace(
+                    params=params, opt_state=opt_state, step=state.step + 1
+                ),
+                (losses, has),  # [C] masked losses, [C] 0/1 batch-had-rows
+            )
+
+        def _build_ragged_step():
+            if mu > 0.0:
+                return partial(
+                    jax.jit,
+                    donate_argnums=(0,),
+                    in_shardings=(state_sh, ragged_batch_sh, csh),
+                    out_shardings=(state_sh, (csh, csh)),
+                )(_ragged_body)
+            return partial(
+                jax.jit,
+                donate_argnums=(0,),
+                in_shardings=(state_sh, ragged_batch_sh),
+                out_shardings=(state_sh, (csh, csh)),
+            )(lambda state, batch: _ragged_body(state, batch, None))
+
+        # Built on first ragged fit_local (equal-client runs never pay the
+        # extra compilation).
+        self._build_ragged_step = _build_ragged_step
+        self._ragged_train_step = None
 
         @partial(
             jax.jit,
@@ -441,14 +577,27 @@ class FederatedTrainer:
     def fit_local(
         self,
         state: FedState,
-        stacked_train: TokenizedSplit,
+        stacked_train: TokenizedSplit | StackedClients,
         *,
         batch_size: int | None = None,
         epochs: int | None = None,
         epoch_offset: int = 0,
     ) -> tuple[FedState, np.ndarray]:
         """E local epochs for all clients in lockstep; returns ``[E, C]``
-        per-client average losses."""
+        per-client average losses.
+
+        A :class:`StackedClients` input takes the ragged path: every
+        client's full split trains each epoch (row-masked batches, gated
+        updates); a plain :class:`TokenizedSplit` takes the dense path
+        (all clients share one row count)."""
+        if isinstance(stacked_train, StackedClients):
+            return self._fit_local_ragged(
+                state,
+                stacked_train,
+                batch_size=batch_size,
+                epochs=epochs,
+                epoch_offset=epoch_offset,
+            )
         bs = self.cfg.data.batch_size if batch_size is None else batch_size
         E = self.cfg.train.epochs_per_round if epochs is None else epochs
         # Hosts must execute identical train-step counts (each step is a
@@ -463,8 +612,8 @@ class FederatedTrainer:
             raise ValueError(
                 f"common per-client train rows ({stacked_train.labels.shape[1]}) "
                 f"< batch_size ({bs}) on at least one host: zero batches per "
-                "epoch. A tiny client (e.g. extreme Dirichlet skew) dragged "
-                "the stacked size down — drop or mask it before stacking."
+                "epoch. Stack with stack_clients_ragged to train tiny "
+                "clients without dragging the fleet down."
             )
         if self.cfg.fed.prox_mu > 0.0:
             # FedProx anchor: the round-start params, copied so the donated
@@ -487,6 +636,68 @@ class FederatedTrainer:
                 state, loss = step(state, self._feed(batch))
                 losses.append(loss)
             epoch_avg = jnp.stack(losses).mean(axis=0) if losses else jnp.zeros(self.C)
+            out.append(self._host(epoch_avg))
+            for c in range(self.C):
+                log.info(
+                    f"Client {c} Epoch [{epoch - epoch_offset + 1}/{E}], "
+                    f"Average Loss: {out[-1][c]:.4f}"
+                )
+        return state, np.stack(out) if out else np.zeros((0, self.C))
+
+    def _fit_local_ragged(
+        self,
+        state: FedState,
+        stacked_train: StackedClients,
+        *,
+        batch_size: int | None = None,
+        epochs: int | None = None,
+        epoch_offset: int = 0,
+    ) -> tuple[FedState, np.ndarray]:
+        """Ragged lockstep epochs: the per-epoch step count is the fleet
+        MAX batch count (ceil — the final short batch trains too), clients
+        that exhaust their rows idle behind valid==0 masks, and reported
+        per-client epoch losses average over each client's own real
+        batches — the numbers an independent per-client run would log."""
+        bs = self.cfg.data.batch_size if batch_size is None else batch_size
+        E = self.cfg.train.epochs_per_round if epochs is None else epochs
+        n_batches = max(
+            (-(-int(n) // bs) for n in stacked_train.n_rows), default=0
+        )
+        if self.P > 1:
+            # Every host runs the GLOBAL max step count (each step is a
+            # collective); short hosts contribute all-masked batches.
+            n_batches = int(self._allgather(n_batches).max())
+        if n_batches == 0:
+            raise ValueError(
+                "every client's train split is empty: nothing to fit"
+            )
+        if self._ragged_train_step is None:
+            self._ragged_train_step = self._build_ragged_step()
+        if self.cfg.fed.prox_mu > 0.0:
+            anchor = jax.tree.map(jnp.copy, state.params)
+            step = lambda s, b: self._ragged_train_step(s, b, anchor)  # noqa: E731
+        else:
+            step = self._ragged_train_step
+        out = []
+        for epoch in range(epoch_offset, epoch_offset + E):
+            losses, had = [], []
+            batches = federated_batches_ragged(
+                stacked_train,
+                bs,
+                seed=self.cfg.train.seed,
+                epoch=epoch,
+                client_offset=self.client_offset,
+                n_batches=n_batches,
+            )
+            for batch in batches:
+                state, (loss, has) = step(state, self._feed(batch))
+                losses.append(loss)
+                had.append(has)
+            # Per-client mean over ITS OWN batches: masked-off lockstep
+            # steps carry loss 0 and has 0, so they vanish from both sums.
+            total = jnp.stack(losses).sum(axis=0)
+            count = jnp.stack(had).sum(axis=0)
+            epoch_avg = total / jnp.maximum(count, 1.0)
             out.append(self._host(epoch_avg))
             for c in range(self.C):
                 log.info(
@@ -698,7 +909,7 @@ class FederatedTrainer:
     def run(
         self,
         state: FedState,
-        stacked_train: TokenizedSplit,
+        stacked_train: TokenizedSplit | StackedClients,
         eval_splits: Sequence[TokenizedSplit],
         *,
         rounds: int | None = None,
@@ -718,14 +929,46 @@ class FederatedTrainer:
         """
         R = self.cfg.fed.rounds if rounds is None else rounds
         E = self.cfg.train.epochs_per_round
-        if weights is None and self.cfg.fed.weighted:
-            # stack_clients truncates every client to a common row count, so
-            # true per-client sample sizes are not recoverable here — the
-            # caller must supply them (e.g. [len(c.train) for c in clients]).
-            raise ValueError(
-                "fed.weighted=True requires explicit per-client weights "
-                "(pass weights=[n_train per client])"
-            )
+        if weights is None and self.cfg.fed.resolve_weighted():
+            if isinstance(stacked_train, StackedClients):
+                if self.P > 1:
+                    # The local ragged stack covers only this process's
+                    # clients; silently falling back to a uniform mean here
+                    # would make the same config aggregate differently on
+                    # 1 vs N hosts. The caller must supply the GLOBAL
+                    # n_train weights (cmd_federated does).
+                    raise ValueError(
+                        "multi-host run() cannot derive global sample-count "
+                        "weights from the process-local ragged stack — pass "
+                        "weights=[global n_train per client], or set "
+                        "fed.weighted=False for the uniform mean"
+                    )
+                # The ragged stack carries true per-client sample counts —
+                # the auto (weighted=None) default weights by them.
+                weights = np.asarray(stacked_train.n_rows, np.float64)
+            elif self.cfg.fed.weighted:
+                # Explicit weighted=True without recoverable counts: the
+                # fleet-min-truncated dense stack loses them — the caller
+                # must supply the true n_train weights.
+                raise ValueError(
+                    "fed.weighted=True requires explicit per-client weights "
+                    "(pass weights=[n_train per client])"
+                )
+        # Under a uniform mean (explicit weighted=False, or DP's forced
+        # uniform), a zero-row client would average its never-trained
+        # round-start params into the aggregate with full 1/C weight every
+        # round; mask it out as a permanently dropped client instead (it
+        # still receives the aggregate — the masked mean's output is
+        # broadcast to every row). min_client_fraction applies as usual.
+        base_mask: np.ndarray | None = None
+        if weights is None and isinstance(stacked_train, StackedClients):
+            empty = np.asarray(stacked_train.n_rows) == 0
+            if self.P == 1 and empty.any():
+                base_mask = (~empty).astype(np.float64)
+                log.warning(
+                    f"[FED] clients {np.flatnonzero(empty).tolist()} have "
+                    "zero train rows; excluding them from the uniform mean"
+                )
         history: list[RoundRecord] = []
         prepared = self.prepare_eval(eval_splits)
         for r in range(R):
@@ -736,6 +979,8 @@ class FederatedTrainer:
                 )
             local = self.evaluate_clients(state.params, prepared=prepared)
             mask = self.participation_mask(r)
+            if base_mask is not None:
+                mask = base_mask if mask is None else mask * base_mask
             if fault_mask_fn is not None:
                 faults = fault_mask_fn(r)
                 if faults is not None:

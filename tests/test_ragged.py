@@ -1,0 +1,342 @@
+"""Ragged-client SPMD federation: unequal clients train on 100% of their
+rows (no fleet-min truncation), with the stacked lockstep program matching
+N independent per-client runs + FedAvg — the reference's actual semantics
+(each process consumes all of its own differently-sized sample,
+client1.py:89 vs client2.py:84; server.py:73-76 averages the results)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+    StackedClients,
+    stack_clients_ragged,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.pipeline import (
+    TokenizedSplit,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train import (
+    FederatedTrainer,
+    federated_batches_ragged,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.engine import (
+    loss_fn,
+    make_optimizer,
+)
+
+MAX_LEN = 16
+
+
+def _split(n, seed, vocab=250):
+    r = np.random.default_rng(seed)
+    ids = r.integers(1, vocab, size=(n, MAX_LEN), dtype=np.int64).astype(np.int32)
+    mask = np.ones((n, MAX_LEN), np.int32)
+    labels = r.integers(0, 2, size=n).astype(np.int32)
+    return TokenizedSplit(ids, mask, labels)
+
+
+def _cfg(clients=2, **fed_kw):
+    # Zero dropout everywhere: the manual-parity comparison must not depend
+    # on PRNG folding details between the stacked and independent paths.
+    return ExperimentConfig(
+        model=ModelConfig.tiny(
+            max_len=MAX_LEN,
+            max_position_embeddings=MAX_LEN,
+            dropout=0.0,
+            attention_dropout=0.0,
+            head_dropout=0.0,
+        ),
+        data=DataConfig(max_len=MAX_LEN, batch_size=8),
+        train=TrainConfig(learning_rate=1e-3, epochs_per_round=1, seed=0),
+        fed=FedConfig(num_clients=clients, **fed_kw),
+        mesh=MeshConfig(clients=clients, data=1),
+    )
+
+
+def test_stack_clients_ragged_shapes():
+    splits = [_split(13, 0), _split(5, 1), _split(8, 2)]
+    st = stack_clients_ragged(splits, pad_id=0)
+    assert st.split.input_ids.shape == (3, 13, MAX_LEN)
+    np.testing.assert_array_equal(st.n_rows, [13, 5, 8])
+    np.testing.assert_array_equal(st.row_valid.sum(axis=1), [13, 5, 8])
+    # Pad rows: PAD ids, zero attention, zero labels, invalid.
+    assert (st.split.input_ids[1, 5:] == 0).all()
+    assert (st.split.attention_mask[1, 5:] == 0).all()
+    assert (st.row_valid[1, 5:] == 0).all()
+    # Real rows survive untouched.
+    np.testing.assert_array_equal(st.split.input_ids[2, :8], splits[2].input_ids)
+    # target_rows must cover the local max.
+    with pytest.raises(ValueError, match="target_rows"):
+        stack_clients_ragged(splits, target_rows=10)
+    assert stack_clients_ragged(splits, target_rows=20).split.labels.shape == (3, 20)
+
+
+def test_ragged_batches_cover_every_row_once():
+    splits = [_split(13, 0), _split(5, 1), _split(30, 2)]
+    st = stack_clients_ragged(splits)
+    bs = 8
+    batches = list(federated_batches_ragged(st, bs, seed=0, epoch=0))
+    assert len(batches) == -(-30 // bs)  # fleet max, ceil
+    for b in batches:
+        assert b["input_ids"].shape == (3, bs, MAX_LEN)
+        assert b["valid"].shape == (3, bs)
+    # Every client's real rows appear exactly once per epoch (valid rows
+    # reassemble the original split, no duplicates, no omissions).
+    for c, split in enumerate(splits):
+        seen = np.concatenate(
+            [b["input_ids"][c][b["valid"][c] == 1] for b in batches]
+        )
+        assert len(seen) == len(split)
+        order = np.lexsort(seen.T)
+        ref_order = np.lexsort(split.input_ids.T)
+        np.testing.assert_array_equal(seen[order], split.input_ids[ref_order])
+    # Determinism + epoch decorrelation (same keying as the dense path).
+    again = list(federated_batches_ragged(st, bs, seed=0, epoch=0))
+    np.testing.assert_array_equal(batches[0]["labels"], again[0]["labels"])
+    other = list(federated_batches_ragged(st, bs, seed=0, epoch=1))
+    assert not np.array_equal(batches[0]["labels"][2], other[0]["labels"][2])
+
+
+def test_ragged_spmd_matches_manual_per_client_runs(eight_devices):
+    """The VERDICT-1 'done' criterion: a ragged fleet's stacked lockstep
+    training + weighted FedAvg equals N manual independent per-client runs
+    (each on 100% of its own rows) + their sample-weighted mean."""
+    sizes = [40, 17]
+    bs = 8
+    cfg = _cfg(clients=2)
+    splits = [_split(n, 100 + i) for i, n in enumerate(sizes)]
+    st = stack_clients_ragged(splits)
+
+    trainer = FederatedTrainer(cfg)
+    state = trainer.init_state(seed=0)
+    params0 = jax.tree.map(lambda x: np.asarray(x)[0], state.params)
+
+    state, losses = trainer.fit_local(state, st)
+    assert losses.shape == (1, 2)
+
+    # Manual runs: same batch schedule (the generator is the spec), same
+    # optimizer, plain unmasked loss over each batch's real rows only.
+    opt = make_optimizer(cfg.train)
+    rng = jax.random.key(0, impl=cfg.train.prng_impl)
+    manual_params, manual_losses = [], []
+    for c in range(2):
+        p = jax.tree.map(jnp.asarray, params0)
+        opt_state = opt.init(p)
+        blosses = []
+        for b in federated_batches_ragged(st, bs, seed=cfg.train.seed, epoch=0):
+            keep = b["valid"][c] == 1
+            if not keep.any():
+                continue
+            sub = {
+                "input_ids": jnp.asarray(b["input_ids"][c][keep]),
+                "attention_mask": jnp.asarray(b["attention_mask"][c][keep]),
+                "labels": jnp.asarray(b["labels"][c][keep]),
+            }
+            loss, grads = jax.value_and_grad(
+                lambda q: loss_fn(trainer.model, q, sub, rng)
+            )(p)
+            updates, opt_state = opt.update(grads, opt_state, p)
+            p = optax.apply_updates(p, updates)
+            blosses.append(float(loss))
+        manual_params.append(jax.tree.map(np.asarray, p))
+        manual_losses.append(np.mean(blosses))
+
+    # Reported per-client epoch losses = each client's own batch average.
+    np.testing.assert_allclose(losses[0], manual_losses, rtol=2e-5, atol=1e-6)
+
+    # Per-client trained params match the independent runs.
+    for c in range(2):
+        got = jax.tree.map(lambda x: np.asarray(x)[c], state.params)
+        for g, w in zip(jax.tree.leaves(got), jax.tree.leaves(manual_params[c])):
+            np.testing.assert_allclose(g, w, rtol=2e-4, atol=2e-6)
+
+    # Weighted FedAvg = sample-weighted mean of the manual runs.
+    state = trainer.aggregate(state, weights=np.asarray(sizes, np.float64))
+    agg = jax.tree.map(lambda x: np.asarray(x)[0], state.params)
+    wts = np.asarray(sizes, np.float64) / np.sum(sizes)
+    for leaf, (a, b) in zip(
+        jax.tree.leaves(agg),
+        zip(jax.tree.leaves(manual_params[0]), jax.tree.leaves(manual_params[1])),
+    ):
+        np.testing.assert_allclose(
+            leaf, wts[0] * a + wts[1] * b, rtol=2e-4, atol=2e-6
+        )
+
+
+def test_zero_row_client_is_gated_not_fatal(eight_devices):
+    """A client with an empty split (extreme Dirichlet skew) idles behind
+    masks: its params stay at init through local training, and the auto
+    weights exclude it from the aggregate instead of crashing the fleet
+    (the dense path raised; reference would hang, server.py:69-71)."""
+    cfg = _cfg(clients=2)
+    splits = [_split(20, 0), _split(0, 1)]
+    st = stack_clients_ragged(splits)
+    trainer = FederatedTrainer(cfg)
+    state = trainer.init_state(seed=0)
+    p0 = jax.tree.map(lambda x: np.asarray(x), state.params)
+
+    eval_splits = [_split(12, 7), _split(12, 8)]
+    state, hist = trainer.run(state, st, eval_splits, rounds=1)
+
+    # Auto weights [20, 0]: the aggregate IS client 0's trained params.
+    assert len(hist) == 1
+    agg = jax.tree.map(np.asarray, state.params)
+    for leaf0, leaf in zip(jax.tree.leaves(p0), jax.tree.leaves(agg)):
+        # Client 1 never trained; client 0 did. Post-FedAvg both rows hold
+        # the aggregate == client 0's trained params (!= init).
+        np.testing.assert_allclose(leaf[0], leaf[1], rtol=1e-6, atol=1e-7)
+    changed = any(
+        not np.allclose(a[0], b[0])
+        for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(agg))
+    )
+    assert changed
+
+    # All-empty fleets still fail loudly.
+    empty = stack_clients_ragged([_split(0, 0), _split(0, 1)])
+    with pytest.raises(ValueError, match="empty"):
+        trainer.fit_local(trainer.init_state(seed=1), empty)
+
+
+def test_zero_row_client_aggregate_equals_solo_run(eight_devices):
+    """With auto weights, a 2-client fleet where one client is empty must
+    aggregate to exactly what client 0 trained to (weight [n, 0])."""
+    cfg = _cfg(clients=2)
+    splits = [_split(20, 0), _split(0, 1)]
+    st = stack_clients_ragged(splits)
+    trainer = FederatedTrainer(cfg)
+    state = trainer.init_state(seed=0)
+    state, _ = trainer.fit_local(state, st)
+    trained0 = jax.tree.map(lambda x: np.asarray(x)[0], state.params)
+    state = trainer.aggregate(state, weights=np.array([20.0, 0.0]))
+    agg = jax.tree.map(lambda x: np.asarray(x)[0], state.params)
+    for a, b in zip(jax.tree.leaves(agg), jax.tree.leaves(trained0)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_resolve_weighted_auto():
+    assert FedConfig().weighted is None
+    assert FedConfig().resolve_weighted() is True
+    assert FedConfig(weighted=False).resolve_weighted() is False
+    assert FedConfig(weighted=True).resolve_weighted() is True
+    # DP forces the uniform mean under auto; explicit True still errors.
+    assert (
+        FedConfig(dp_clip=1.0, dp_noise_multiplier=1.0).resolve_weighted()
+        is False
+    )
+    with pytest.raises(ValueError, match="weighted"):
+        FedConfig(weighted=True, dp_clip=1.0)
+
+
+def test_run_auto_weights_from_ragged_stack(eight_devices):
+    """run() with a ragged stack and the weighted=None default derives
+    true-n_train weights: the aggregate equals the explicit-weights run."""
+    cfg = _cfg(clients=2)
+    splits = [_split(24, 3), _split(9, 4)]
+    eval_splits = [_split(8, 5), _split(8, 6)]
+    st = stack_clients_ragged(splits)
+
+    t1 = FederatedTrainer(cfg)
+    s1, _ = t1.run(t1.init_state(seed=0), st, eval_splits, rounds=1)
+
+    t2 = FederatedTrainer(cfg)
+    s2, _ = t2.run(
+        t2.init_state(seed=0),
+        st,
+        eval_splits,
+        rounds=1,
+        weights=np.array([24.0, 9.0]),
+    )
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_ragged_warmup_rides_per_client_step_count(eight_devices):
+    """LR warmup must advance on each client's OWN executed steps: a short
+    client idling behind masks keeps its ramp frozen, matching its
+    independent run (keying on the global lockstep counter would compress
+    its schedule)."""
+    sizes = [40, 9]
+    bs = 8
+    cfg = _cfg(clients=2)
+    cfg = ExperimentConfig(
+        model=cfg.model,
+        data=cfg.data,
+        train=TrainConfig(
+            learning_rate=1e-3, epochs_per_round=2, seed=0, warmup_steps=10
+        ),
+        fed=cfg.fed,
+        mesh=cfg.mesh,
+    )
+    splits = [_split(n, 200 + i) for i, n in enumerate(sizes)]
+    st = stack_clients_ragged(splits)
+    trainer = FederatedTrainer(cfg)
+    state = trainer.init_state(seed=0)
+    params0 = jax.tree.map(lambda x: np.asarray(x)[0], state.params)
+    state, _ = trainer.fit_local(state, st)
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.engine import (
+        apply_warmup,
+    )
+
+    opt = make_optimizer(cfg.train)
+    rng = jax.random.key(0, impl=cfg.train.prng_impl)
+    for c in range(2):
+        p = jax.tree.map(jnp.asarray, params0)
+        opt_state = opt.init(p)
+        own_step = 0
+        for epoch in range(2):
+            for b in federated_batches_ragged(
+                st, bs, seed=cfg.train.seed, epoch=epoch
+            ):
+                keep = b["valid"][c] == 1
+                if not keep.any():
+                    continue
+                sub = {
+                    "input_ids": jnp.asarray(b["input_ids"][c][keep]),
+                    "attention_mask": jnp.asarray(b["attention_mask"][c][keep]),
+                    "labels": jnp.asarray(b["labels"][c][keep]),
+                }
+                _, grads = jax.value_and_grad(
+                    lambda q: loss_fn(trainer.model, q, sub, rng)
+                )(p)
+                updates, opt_state = opt.update(grads, opt_state, p)
+                updates = apply_warmup(
+                    updates, jnp.int32(own_step), cfg.train.warmup_steps
+                )
+                p = optax.apply_updates(p, updates)
+                own_step += 1
+        got = jax.tree.map(lambda x: np.asarray(x)[c], state.params)
+        for g, w in zip(jax.tree.leaves(got), jax.tree.leaves(p)):
+            np.testing.assert_allclose(g, np.asarray(w), rtol=2e-4, atol=2e-6)
+
+
+def test_zero_row_client_masked_from_uniform_mean(eight_devices):
+    """Under the uniform mean (weighted=False) a zero-row client must be
+    masked out of the aggregate, not average its init params in."""
+    cfg = _cfg(clients=2, weighted=False, min_client_fraction=0.5)
+    splits = [_split(20, 0), _split(0, 1)]
+    st = stack_clients_ragged(splits)
+    trainer = FederatedTrainer(cfg)
+    state = trainer.init_state(seed=0)
+    eval_splits = [_split(8, 5), _split(8, 6)]
+    state, _ = trainer.run(state, st, eval_splits, rounds=1)
+    agg = jax.tree.map(lambda x: np.asarray(x)[0], state.params)
+
+    # Reference: client 0 alone (the empty client contributes nothing).
+    t2 = FederatedTrainer(cfg)
+    s2 = t2.init_state(seed=0)
+    s2, _ = t2.fit_local(s2, st)
+    solo = jax.tree.map(lambda x: np.asarray(x)[0], s2.params)
+    for a, b in zip(jax.tree.leaves(agg), jax.tree.leaves(solo)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
