@@ -505,6 +505,7 @@ def cmd_federated(args) -> int:
             )
         clients = _load_clients(args, cfg, tok, C)
         eval_rows_global = max(len(c.test) for c in clients)
+        val_rows_global = max(len(c.val) for c in clients)
         train_sizes = [len(c.train) for c in clients]
     else:
         # Partitioning runs over the full fleet on every host (it must be
@@ -522,6 +523,7 @@ def cmd_federated(args) -> int:
                 for c in local_ids
             ]
         eval_rows_global = max(len(s.test) for s in splits)
+        val_rows_global = max(len(s.val) for s in splits)
         train_sizes = [len(s.train) for s in splits]
     # Ragged stack to the GLOBAL fleet-max row count: no client's rows are
     # truncated (the reference's N independent processes each train on all
@@ -560,10 +562,29 @@ def cmd_federated(args) -> int:
     weights = (
         np.array(train_sizes, np.float64) if cfg.fed.resolve_weighted() else None
     )
+    # Under a uniform mean (--unweighted, or DP's forced uniform), zero-row
+    # clients would average their never-trained round-start params in with
+    # full 1/C weight; mask them out as permanently dropped clients (same
+    # rule as FederatedTrainer.run). train_sizes is global, so every host
+    # builds the identical mask.
+    base_mask = None
+    if weights is None:
+        empty = np.asarray(train_sizes) == 0
+        if empty.any():
+            base_mask = (~empty).astype(np.float64)
+            log.warning(
+                f"[FED] clients {np.flatnonzero(empty).tolist()} have zero "
+                "train rows; excluding them from the uniform mean"
+            )
     from .utils.profiling import trace
 
     prepared = trainer.prepare_eval(
         [c.test for c in clients], target_rows=eval_rows_global
+    )
+    # Validation metrics every phase, like the reference (it evaluates val
+    # AND test at each of local/aggregated, client1.py:383-385,398-400).
+    prepared_val = trainer.prepare_eval(
+        [c.val for c in clients], target_rows=val_rows_global
     )
     history = []
     with trace(getattr(args, "profile_dir", None)):
@@ -573,30 +594,52 @@ def cmd_federated(args) -> int:
                 state, losses = trainer.fit_local(
                     state, stacked_train, epoch_offset=r * cfg.train.epochs_per_round
                 )
+                local_val = trainer.evaluate_clients(
+                    state.params, prepared=prepared_val
+                )
                 local = trainer.evaluate_clients(state.params, prepared=prepared)
+                mask = trainer.participation_mask(r)
+                if base_mask is not None:
+                    mask = base_mask if mask is None else mask * base_mask
                 state = trainer.aggregate(
                     state,
                     weights=weights,
-                    client_mask=trainer.participation_mask(r),
+                    client_mask=mask,
                     anchor=anchor,
                     round_index=r,
+                )
+                aggregated_val = trainer.evaluate_clients(
+                    state.params, prepared=prepared_val
                 )
                 aggregated = trainer.evaluate_clients(state.params, prepared=prepared)
             history.append((r, local, aggregated))
             for c in range(C):
                 log.info(
-                    f"[FED] round {r + 1} client {c}: local acc "
-                    f"{local[c]['Accuracy']:.4f} -> aggregated "
+                    f"[FED] round {r + 1} client {c}: local val/test acc "
+                    f"{local_val[c]['Accuracy']:.4f}/{local[c]['Accuracy']:.4f}"
+                    f" -> aggregated "
+                    f"{aggregated_val[c]['Accuracy']:.4f}/"
                     f"{aggregated[c]['Accuracy']:.4f}"
                 )
             if getattr(args, "metrics_jsonl", None) and jax.process_index() == 0:
                 from .reporting import append_metrics_jsonl
 
                 for c in range(C):
-                    for phase_name, m in (("local", local[c]), ("aggregated", aggregated[c])):
+                    for phase_name, split_name, m in (
+                        ("local", "val", local_val[c]),
+                        ("local", "test", local[c]),
+                        ("aggregated", "val", aggregated_val[c]),
+                        ("aggregated", "test", aggregated[c]),
+                    ):
                         append_metrics_jsonl(
                             args.metrics_jsonl,
-                            {"round": r + 1, "client": c, "phase": phase_name, **m},
+                            {
+                                "round": r + 1,
+                                "client": c,
+                                "phase": phase_name,
+                                "split": split_name,
+                                **m,
+                            },
                         )
             if ckpt is not None:
                 ckpt.save(

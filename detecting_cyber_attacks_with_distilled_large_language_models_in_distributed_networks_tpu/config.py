@@ -186,6 +186,10 @@ class TrainConfig:
     b2: float = 0.999
     eps: float = 1e-8
     seed: int = 0
+    # Per-step telemetry cadence: every N train steps the fit loops log
+    # step, loss, and samples/s (the reference's tqdm per-batch loss line,
+    # client1.py:101,112). Each log point syncs the device once; 0 disables
+    # (per-epoch averages only).
     log_every: int = 100
     # Dropout-key PRNG implementation. "rbg" (counter-based, the standard
     # TPU choice for dropout masks) is ~10 points of MFU cheaper than

@@ -139,6 +139,44 @@ def eval_counts(
     return counts, probs
 
 
+def make_step_telemetry(
+    log_every: int, *, prefix: str = "", label: str = "loss"
+) -> Callable:
+    """Per-step telemetry closure shared by the single-client and federated
+    fit loops (the reference's tqdm per-batch loss/rate line,
+    client1.py:101,112). Returns ``emit(loss, n_samples, active=None)``:
+    every ``log_every`` calls it logs the step, the mean loss — over
+    ``active`` clients only when given (idle ragged clients carry masked
+    loss 0 and must not understate the fleet mean) — and samples/s since
+    the previous log point. Each log point syncs the device once; between
+    them losses stay device-side so async dispatch never stalls.
+    ``log_every=0`` disables."""
+    import time
+
+    acc = {"steps": 0, "samples": 0, "t": time.perf_counter()}
+
+    def emit(loss, n_samples: int, active=None) -> None:
+        if not log_every:
+            return
+        acc["steps"] += 1
+        acc["samples"] += int(n_samples)
+        if acc["steps"] % log_every:
+            return
+        if active is None:
+            mean = float(jnp.mean(loss))
+        else:
+            mean = float(jnp.sum(loss) / jnp.maximum(jnp.sum(active), 1.0))
+        now = time.perf_counter()
+        sps = acc["samples"] / max(now - acc["t"], 1e-9)
+        acc["t"], acc["samples"] = now, 0
+        log.info(
+            f"{prefix}Step {acc['steps']}: {label} {mean:.4f} "
+            f"({sps:.1f} samples/s)"
+        )
+
+    return emit
+
+
 def make_train_step(
     model: DDoSClassifier,
     optimizer: optax.GradientTransformation,
@@ -251,6 +289,9 @@ class Trainer:
         """Shared epoch loop (plain fit and the KD step both ride it)."""
         epochs = self.train_cfg.epochs_per_round if epochs is None else epochs
         epoch_losses: list[float] = []
+        telemetry = make_step_telemetry(
+            self.train_cfg.log_every, prefix=tag, label=loss_label
+        )
         for epoch in range(epoch_offset, epoch_offset + epochs):
             # Collect device scalars and sync once per epoch — float(loss)
             # per step would block async dispatch and stall the TPU.
@@ -258,6 +299,7 @@ class Trainer:
             for batch in self.epoch_batches(split, epoch, batch_size):
                 state, loss = step_fn(state, batch)
                 losses.append(loss)
+                telemetry(loss, batch_size)
             avg = float(jnp.stack(losses).mean()) if losses else 0.0
             epoch_losses.append(avg)
             log.info(

@@ -623,6 +623,7 @@ class FederatedTrainer:
         else:
             step = self.train_step
         out = []
+        telemetry = self._step_telemetry()
         for epoch in range(epoch_offset, epoch_offset + E):
             losses = []
             batches = federated_batches(
@@ -635,6 +636,7 @@ class FederatedTrainer:
             for _, batch in zip(range(n_batches), batches):
                 state, loss = step(state, self._feed(batch))
                 losses.append(loss)
+                telemetry(loss, batch["labels"].size)
             epoch_avg = jnp.stack(losses).mean(axis=0) if losses else jnp.zeros(self.C)
             out.append(self._host(epoch_avg))
             for c in range(self.C):
@@ -679,6 +681,7 @@ class FederatedTrainer:
         else:
             step = self._ragged_train_step
         out = []
+        telemetry = self._step_telemetry()
         for epoch in range(epoch_offset, epoch_offset + E):
             losses, had = [], []
             batches = federated_batches_ragged(
@@ -693,6 +696,9 @@ class FederatedTrainer:
                 state, (loss, has) = step(state, self._feed(batch))
                 losses.append(loss)
                 had.append(has)
+                # Mean over ACTIVE clients only — idle clients' masked loss
+                # of 0 must not understate the fleet mean.
+                telemetry(loss, int(batch["valid"].sum()), active=has)
             # Per-client mean over ITS OWN batches: masked-off lockstep
             # steps carry loss 0 and has 0, so they vanish from both sums.
             total = jnp.stack(losses).sum(axis=0)
@@ -728,6 +734,15 @@ class FederatedTrainer:
             splits, bs, pad_id=self.pad_id, target_rows=target_rows
         )
         return PreparedEval(stacked, valid, bs, [s.labels.copy() for s in splits])
+
+    def _step_telemetry(self):
+        """Shared per-step logging closure (engine.make_step_telemetry)
+        with the fleet-mean loss label."""
+        from ..train.engine import make_step_telemetry
+
+        return make_step_telemetry(
+            self.cfg.train.log_every, prefix="[FED] ", label="mean loss"
+        )
 
     @staticmethod
     def _allgather(value: int) -> np.ndarray:
@@ -962,8 +977,22 @@ class FederatedTrainer:
         # broadcast to every row). min_client_fraction applies as usual.
         base_mask: np.ndarray | None = None
         if weights is None and isinstance(stacked_train, StackedClients):
-            empty = np.asarray(stacked_train.n_rows) == 0
-            if self.P == 1 and empty.any():
+            local_empty = (np.asarray(stacked_train.n_rows) == 0).astype(np.int64)
+            if self.P == 1:
+                empty = local_empty > 0
+            else:
+                # Every host must apply the SAME mask (the aggregate is one
+                # collective); clients lay process-major over the mesh, so
+                # the allgather's flattened order IS the global client order.
+                from jax.experimental import multihost_utils
+
+                empty = (
+                    np.asarray(
+                        multihost_utils.process_allgather(local_empty)
+                    ).reshape(-1)
+                    > 0
+                )
+            if empty.any():
                 base_mask = (~empty).astype(np.float64)
                 log.warning(
                     f"[FED] clients {np.flatnonzero(empty).tolist()} have "

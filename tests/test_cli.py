@@ -106,3 +106,73 @@ def test_federated_flow_writes_artifacts_and_checkpoints(tmp_path, eight_devices
         ]
     )
     assert rc2 == 0
+
+
+def test_federated_jsonl_has_val_and_test_phases(tmp_path, eight_devices):
+    """Federated runs report validation metrics per phase like the
+    reference (client1.py:383-385,398-400), streamed to --metrics-jsonl."""
+    import json
+
+    jsonl = tmp_path / "metrics.jsonl"
+    rc = main(
+        [
+            "federated", "--synthetic", "400", "--num-clients", "2",
+            "--rounds", "1", "--epochs", "1",
+            "--output-dir", str(tmp_path / "out"),
+            "--metrics-jsonl", str(jsonl),
+        ]
+    )
+    assert rc == 0
+    records = [json.loads(line) for line in jsonl.read_text().splitlines()]
+    keys = {(r["phase"], r["split"], r["client"]) for r in records}
+    assert keys == {
+        (p, s, c)
+        for p in ("local", "aggregated")
+        for s in ("val", "test")
+        for c in (0, 1)
+    }
+    assert all("Accuracy" in r for r in records)
+
+
+def test_local_fit_logs_per_step_telemetry(tmp_path):
+    """TrainConfig.log_every drives per-step loss/throughput lines (the
+    reference's tqdm per-batch reporting, client1.py:101,112)."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        ModelConfig,
+        TrainConfig,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.pipeline import (
+        TokenizedSplit,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.engine import (
+        Trainer,
+    )
+    import numpy as np
+
+    cfg = ModelConfig.tiny()
+    r = np.random.default_rng(0)
+    n, L = 64, cfg.max_len
+    split = TokenizedSplit(
+        r.integers(1, cfg.vocab_size, (n, L)).astype(np.int32),
+        np.ones((n, L), np.int32),
+        r.integers(0, 2, n).astype(np.int32),
+    )
+    import io
+    import logging
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.utils.logging import (
+        get_logger,
+    )
+
+    buf = io.StringIO()
+    handler = logging.StreamHandler(buf)
+    logger = get_logger()
+    logger.addHandler(handler)
+    try:
+        trainer = Trainer(cfg, TrainConfig(log_every=2, epochs_per_round=1))
+        state = trainer.init_state(seed=0)
+        trainer.fit(state, split, batch_size=16)
+    finally:
+        logger.removeHandler(handler)
+    out = buf.getvalue()
+    assert "samples/s" in out and "Step 2:" in out
