@@ -1,0 +1,23 @@
+"""Command-line orchestration package — the reference's three
+``main()``s unified behind one ``fedtpu`` CLI. The subcommand map and
+deployment-shape documentation live in :mod:`.parser`; each command is its
+own module (common plumbing in :mod:`.common`)."""
+
+from .comm import _auth_key, _mask_secret, cmd_client, cmd_serve  # noqa: F401
+from .common import (  # noqa: F401
+    _load_client_splits,
+    _load_clients,
+    _preset_model,
+    _resolve_with_pretrained,
+    _write_reports,
+    resolve_config,
+)
+from .distill import cmd_distill  # noqa: F401
+from .federated import cmd_federated  # noqa: F401
+from .local import cmd_local  # noqa: F401
+from .parser import build_parser, cmd_export_config, main  # noqa: F401
+from .predict import (  # noqa: F401
+    _restore_predict_params,
+    cmd_export_hf,
+    cmd_predict,
+)

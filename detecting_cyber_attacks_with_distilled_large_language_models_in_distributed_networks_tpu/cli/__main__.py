@@ -1,0 +1,7 @@
+"""Keep ``python -m <pkg>.cli`` working now that cli is a package."""
+
+import sys
+
+from .parser import main
+
+sys.exit(main())

@@ -1,0 +1,187 @@
+"""fedtpu serve / client — the TCP demo-parity mode (the reference's
+socket deployment shape, server.py + client1.py end-to-end)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..utils.logging import get_logger, phase
+from .common import _load_clients, _resolve_with_pretrained, _write_reports
+
+log = get_logger()
+
+
+def _auth_key() -> bytes | None:
+    """Shared-secret HMAC key for the TCP demo-parity mode, from the
+    FEDTPU_SECRET env var (never argv — process listings leak flags). The
+    reference's protocol accepts weights from anyone who can connect
+    (server.py:57-65); with a secret set, unauthenticated or tampered
+    messages are rejected."""
+    secret = os.environ.get("FEDTPU_SECRET")
+    return secret.encode() if secret else None
+
+
+def _mask_secret(enabled: bool) -> bytes | None:
+    """Pairwise-mask secret for secure aggregation (comm/secure.py), from
+    the FEDTPU_MASK_SECRET env var. Shared among CLIENTS ONLY — the server
+    must not hold it, or it could unmask individual uploads."""
+    if not enabled:
+        return None
+    secret = os.environ.get("FEDTPU_MASK_SECRET")
+    if not secret:
+        raise SystemExit(
+            "--secure-agg needs FEDTPU_MASK_SECRET set (same value on every "
+            "client; NOT on the server)"
+        )
+    return secret.encode()
+
+
+def cmd_serve(args) -> int:
+    from ..comm import AggregationServer
+
+    with AggregationServer(
+        host=args.host,
+        port=args.port,
+        num_clients=args.num_clients,
+        weighted=args.weighted,
+        min_clients=args.min_clients,
+        timeout=args.timeout,
+        compression=args.compression,
+        auth_key=_auth_key(),
+        secure_agg=bool(getattr(args, "secure_agg", False)),
+    ) as server:
+        log.info(f"[SERVER] listening on {args.host}:{server.port}")
+        server.serve(rounds=args.rounds or 1)
+    return 0
+
+
+def cmd_client(args) -> int:
+    """The reference client1.py end-to-end: (warm start ->) train -> eval ->
+    exchange over TCP -> load aggregate -> re-eval -> CSVs + plots; degrades
+    to local-only reports when the exchange fails (client1.py:405-410).
+
+    ``--checkpoint-dir`` is the reference's ``client{N}_model.pth`` pattern
+    (save after local training and after applying the aggregate, auto-load
+    on the next launch, client1.py:375-377,388,403 — its only multi-round
+    mechanism), upgraded to full Orbax state. ``--rounds R`` runs the
+    re-launch loop in-process instead (the server must be serving at least
+    as many rounds)."""
+    from ..comm import FederatedClient, SecureAggError
+    from ..train.engine import Trainer
+
+    tok, cfg, pretrained = _resolve_with_pretrained(args)
+    client_data = _load_clients(args, cfg, tok, cfg.fed.num_clients)[args.client_id]
+    trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
+    state = trainer.init_state(params=pretrained)
+    ckpt = None
+    if cfg.checkpoint_dir:
+        from ..train.checkpoint import Checkpointer, maybe_warm_start
+
+        restored, step = maybe_warm_start(cfg.checkpoint_dir, state)
+        if restored is not None:
+            state = restored
+            log.info(
+                f"[CLIENT {args.client_id}] warm start from "
+                f"{cfg.checkpoint_dir} (step {step})"
+            )
+        ckpt = Checkpointer(cfg.checkpoint_dir)
+
+    import jax
+
+    fed = FederatedClient(
+        args.host, args.port, client_id=args.client_id,
+        timeout=args.timeout, compression=args.compression,
+        auth_key=_auth_key(),
+        secure_secret=_mask_secret(getattr(args, "secure_agg", False)),
+        num_clients=cfg.fed.num_clients,
+    )
+    import jax.numpy as jnp
+
+    rounds = max(1, getattr(args, "rounds", None) or 1)
+    local = agg_metrics = None
+    E = cfg.train.epochs_per_round
+    # Orbax step ids must be unique and increasing, and a duplicate save is
+    # SILENTLY skipped — two saves per round (post-train, post-aggregate)
+    # need their own sequence, seeded past the previous run's ids on warm
+    # start (state.step alone can lag them).
+    save_seq = int(state.step)
+    if ckpt is not None:
+        save_seq = max(save_seq, ckpt.latest_step() or 0)
+    for r in range(rounds):
+        with phase(f"client {args.client_id} round {r + 1}/{rounds} training", tag="TRAIN"):
+            state, _ = trainer.fit(
+                state, client_data.train, batch_size=cfg.data.batch_size,
+                epoch_offset=r * E, tag=f"[CLIENT {args.client_id}] ",
+            )
+        local = trainer.evaluate(state.params, client_data.test)
+        if ckpt is not None:
+            # Post-train save — the reference's client1.py:388.
+            save_seq += 1
+            ckpt.save(
+                save_seq,
+                state,
+                meta={
+                    "client_id": args.client_id,
+                    "kind": "local",
+                    "config": cfg.to_dict(),
+                },
+            )
+        host_params = jax.tree.map(np.asarray, state.params)
+        try:
+            with phase("federated exchange", tag="COMM"):
+                aggregated = fed.exchange(
+                    host_params, n_samples=len(client_data.train)
+                )
+            with phase("aggregated evaluation", tag="EVAL"):
+                agg_metrics = trainer.evaluate(aggregated, client_data.test)
+            log.info(
+                f"[CLIENT {args.client_id}] round {r + 1}: local acc "
+                f"{local['Accuracy']:.4f} -> aggregated acc "
+                f"{agg_metrics['Accuracy']:.4f}"
+            )
+            if getattr(args, "metrics_jsonl", None):
+                from ..reporting import append_metrics_jsonl
+
+                for phase_name, m in (("local", local), ("aggregated", agg_metrics)):
+                    append_metrics_jsonl(
+                        args.metrics_jsonl,
+                        {
+                            "round": r + 1,
+                            "client": args.client_id,
+                            "phase": phase_name,
+                            **m,
+                        },
+                    )
+            # Continue the next round FROM the aggregate with a fresh Adam
+            # (every reference re-launch constructs a new optimizer,
+            # client1.py:380) but a continuing step counter (LR warmup).
+            trained_steps = int(state.step)
+            state = trainer.init_state(params=aggregated)
+            state = state._replace(step=jnp.asarray(trained_steps, jnp.int32))
+            if ckpt is not None:
+                # Post-aggregate save — the reference's client1.py:403.
+                save_seq += 1
+                ckpt.save(
+                    save_seq,
+                    state,
+                    meta={
+                        "client_id": args.client_id,
+                        "kind": "local",
+                        "config": cfg.to_dict(),
+                        "aggregated": True,
+                    },
+                )
+        except (ConnectionError, OSError, SecureAggError) as e:
+            agg_metrics = None
+            log.info(
+                f"[CLIENT {args.client_id}] round {r + 1} exchange failed "
+                f"({e}); local-only reports"
+            )
+            break
+    if ckpt is not None:
+        ckpt.wait()
+        ckpt.close()
+    _write_reports(args.client_id, local, agg_metrics, cfg.output_dir)
+    return 0

@@ -1,0 +1,200 @@
+"""fedtpu distill — teacher -> student knowledge distillation (the
+recipe behind the reference's pre-distilled encoder, client1.py:56)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from ..utils.logging import get_logger, phase
+from .common import _load_clients, _resolve_with_pretrained
+from .predict import _restore_predict_params
+
+log = get_logger()
+
+
+def cmd_distill(args) -> int:
+    """Teacher -> student knowledge distillation — the recipe that produced
+    the reference's pretrained DistilBERT (client1.py:56).
+
+    Teacher sources, in precedence order: ``--teacher-checkpoint`` (a model
+    trained here, e.g. a federated aggregate), ``--pth`` + ``--hf-dir``
+    (a model the REFERENCE trained), or a fresh teacher trained in-run
+    (2x student depth by default). ``--student-layers`` shrinks the student
+    below the resolved model depth (e.g. distill a migrated 6-layer
+    reference model into 3 layers)."""
+    from .. import reporting
+    from ..train.distill import DistillTrainer
+    from ..train.engine import Trainer
+
+    if getattr(args, "teacher_checkpoint", None) and getattr(args, "pth", None):
+        raise SystemExit(
+            "--teacher-checkpoint and --pth are both teacher sources; pass one"
+        )
+    if getattr(args, "pth", None) and args.teacher_layers is not None:
+        raise SystemExit(
+            "--teacher-layers has no effect when --pth supplies the "
+            "teacher (its depth comes from --hf-dir's config.json)"
+        )
+    if getattr(args, "student_layers", None) is not None and args.student_layers < 1:
+        raise SystemExit(f"--student-layers {args.student_layers} must be >= 1")
+    # --teacher-checkpoint supplies the weights; skip the (full) --hf-dir
+    # weight load in that case — only tokenizer + architecture are needed.
+    tok, cfg, pretrained = _resolve_with_pretrained(
+        args, load_weights=not getattr(args, "teacher_checkpoint", None)
+    )
+    # Flags override the config only where given; invalid values (e.g.
+    # --temperature 0) flow into DistillConfig validation rather than being
+    # silently replaced, and --no-teacher-init can only turn the init OFF.
+    d = cfg.distill
+    cfg = dataclasses.replace(
+        cfg,
+        distill=dataclasses.replace(
+            d,
+            temperature=d.temperature if args.temperature is None else args.temperature,
+            alpha=d.alpha if args.alpha is None else args.alpha,
+            init_from_teacher=d.init_from_teacher and not args.no_teacher_init,
+        ),
+    )
+    client = _load_clients(args, cfg, tok, 1)[0]
+
+    from ..utils.profiling import trace
+
+    student_cfg = (
+        cfg.model
+        if getattr(args, "student_layers", None) is None
+        else cfg.model.replace(n_layers=args.student_layers)
+    )
+    teacher_layers = (
+        2 * student_cfg.n_layers
+        if args.teacher_layers is None
+        else args.teacher_layers
+    )
+    # ModelConfig validates n_layers >= 1; enforce deeper-than-student here so
+    # a degenerate teacher fails before the training budget is spent.
+    if teacher_layers < student_cfg.n_layers:
+        raise SystemExit(
+            f"--teacher-layers {teacher_layers} is shallower than the "
+            f"{student_cfg.n_layers}-layer student"
+        )
+    teacher_cfg = cfg.model.replace(n_layers=teacher_layers)
+
+    def _check_teacher(tc):
+        if tc.n_layers < student_cfg.n_layers:
+            raise SystemExit(
+                f"teacher has {tc.n_layers} layers — shallower than the "
+                f"{student_cfg.n_layers}-layer student"
+            )
+        if (tc.dim, tc.n_heads, tc.hidden_dim) != (
+            student_cfg.dim, student_cfg.n_heads, student_cfg.hidden_dim,
+        ):
+            raise SystemExit(
+                f"teacher width (dim {tc.dim}, heads {tc.n_heads}, ffn "
+                f"{tc.hidden_dim}) != student (dim {student_cfg.dim}, heads "
+                f"{student_cfg.n_heads}, ffn {student_cfg.hidden_dim}): "
+                "depth-only distillation"
+            )
+
+    with trace(getattr(args, "profile_dir", None)):
+        if getattr(args, "teacher_checkpoint", None):
+            # Distill a model trained elsewhere — e.g. the aggregate of a
+            # federated BERT-base fleet — into a small deployable student:
+            # the end-to-end "distilled LLMs in distributed networks" story.
+            teacher_cfg_hint = teacher_cfg
+            t_trainer = Trainer(teacher_cfg_hint, cfg.train, pad_id=tok.pad_id)
+            teacher_cfg, teacher_params = _restore_predict_params(
+                cfg, tok, t_trainer, ckpt_dir=args.teacher_checkpoint
+            )
+            _check_teacher(teacher_cfg)
+            if teacher_cfg != teacher_cfg_hint:
+                t_trainer = Trainer(teacher_cfg, cfg.train, pad_id=tok.pad_id)
+            log.info(
+                f"[DISTILL] teacher from {args.teacher_checkpoint} "
+                f"({teacher_cfg.n_layers} layers)"
+            )
+        elif getattr(args, "pth", None):
+            # The migrated reference model IS the (already-trained) teacher.
+            teacher_cfg, teacher_params = cfg.model, pretrained
+            _check_teacher(teacher_cfg)
+            t_trainer = Trainer(teacher_cfg, cfg.train, pad_id=tok.pad_id)
+            log.info(
+                f"[DISTILL] teacher from reference .pth {args.pth} "
+                f"({teacher_cfg.n_layers} layers)"
+            )
+        else:
+            t_trainer = Trainer(teacher_cfg, cfg.train, pad_id=tok.pad_id)
+            # A bare --hf-dir encoder warm-starts the fresh teacher when the
+            # depths line up (the reference's own pretrained-start pattern).
+            warm = pretrained if teacher_cfg == cfg.model else None
+            if pretrained is not None and warm is None:
+                log.info(
+                    f"[DISTILL] --hf-dir encoder ({cfg.model.n_layers} "
+                    f"layers) cannot warm-start the {teacher_cfg.n_layers}-"
+                    f"layer teacher; pass --teacher-layers "
+                    f"{cfg.model.n_layers} to use it"
+                )
+            t_state = t_trainer.init_state(params=warm)
+            with phase(
+                f"teacher training ({teacher_cfg.n_layers} layers)", tag="DISTILL"
+            ):
+                t_state, _ = t_trainer.fit(
+                    t_state, client.train, batch_size=cfg.data.batch_size,
+                    tag="[TEACHER] ",
+                )
+            teacher_params = t_state.params
+        teacher_metrics = t_trainer.evaluate(teacher_params, client.test)
+
+        d_trainer = DistillTrainer(
+            student_cfg, teacher_cfg, cfg.train, cfg.distill, pad_id=tok.pad_id
+        )
+        s_state = d_trainer.init_student_state(teacher_params)
+        with phase(
+            f"distilling into {student_cfg.n_layers}-layer student", tag="DISTILL"
+        ):
+            s_state, _ = d_trainer.distill(
+                s_state,
+                teacher_params,
+                client.train,
+                batch_size=cfg.data.batch_size,
+                epochs=args.distill_epochs,
+                tag="[STUDENT] ",
+            )
+        student_metrics = d_trainer.evaluate(s_state.params, client.test)
+
+    log.info(
+        f"[DISTILL] teacher acc {teacher_metrics['Accuracy']:.4f} -> "
+        f"student acc {student_metrics['Accuracy']:.4f} "
+        f"({teacher_cfg.n_layers} -> {student_cfg.n_layers} layers)"
+    )
+    os.makedirs(cfg.output_dir, exist_ok=True)
+    reporting.save_metrics(
+        teacher_metrics, os.path.join(cfg.output_dir, "teacher_metrics.csv")
+    )
+    reporting.save_metrics(
+        student_metrics, os.path.join(cfg.output_dir, "student_metrics.csv")
+    )
+    reporting.plot_metrics_comparison(
+        teacher_metrics,
+        student_metrics,
+        "Teacher vs Distilled Student (test)",
+        os.path.join(cfg.output_dir, "distillation_comparison.png"),
+        labels=("Teacher", "Student"),
+    )
+    if cfg.checkpoint_dir:
+        from ..train.checkpoint import Checkpointer
+
+        with Checkpointer(cfg.checkpoint_dir) as ckpt:
+            # Provenance records the STUDENT architecture (what the saved
+            # params actually are), not the resolved teacher-sized model.
+            student_experiment = dataclasses.replace(cfg, model=student_cfg)
+            ckpt.save(
+                int(s_state.step),
+                s_state,
+                meta={
+                    "distilled": True,
+                    "kind": "local",
+                    "config": student_experiment.to_dict(),
+                },
+            )
+            ckpt.wait()
+    return 0

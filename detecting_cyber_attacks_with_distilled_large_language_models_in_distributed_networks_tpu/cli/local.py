@@ -1,0 +1,61 @@
+"""fedtpu local — one client: train -> eval -> metrics CSV + plots
+(reference client1.py minus the sockets)."""
+
+from __future__ import annotations
+
+from ..utils.logging import get_logger, phase
+from .common import _load_clients, _resolve_with_pretrained, _write_reports
+
+log = get_logger()
+
+
+def cmd_local(args) -> int:
+    from ..train.engine import Trainer
+
+    tok, cfg, pretrained = _resolve_with_pretrained(args)
+    client = _load_clients(args, cfg, tok, max(args.client_id + 1, 1))[args.client_id]
+    trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
+    state = trainer.init_state(params=pretrained)
+    from ..utils.profiling import trace
+
+    with phase(f"client {args.client_id} local training", tag="TRAIN"), trace(
+        getattr(args, "profile_dir", None)
+    ):
+        state, losses = trainer.fit(
+            state,
+            client.train,
+            batch_size=cfg.data.batch_size,
+            tag=f"[CLIENT {args.client_id}] ",
+        )
+    with phase("validation evaluation", tag="EVAL"):
+        val = trainer.evaluate(state.params, client.val, batch_size=cfg.data.eval_batch_size)
+    with phase("test evaluation", tag="EVAL"):
+        test = trainer.evaluate(state.params, client.test, batch_size=cfg.data.eval_batch_size)
+    log.info(
+        f"[CLIENT {args.client_id}] val acc {val['Accuracy']:.4f} | "
+        f"test acc {test['Accuracy']:.4f} f1 {test['F1-Score']:.4f}"
+    )
+    if getattr(args, "metrics_jsonl", None):
+        from ..reporting import append_metrics_jsonl
+
+        for phase_name, m in (("val", val), ("test", test)):
+            append_metrics_jsonl(
+                args.metrics_jsonl,
+                {"client": args.client_id, "phase": phase_name, **m},
+            )
+    _write_reports(args.client_id, test, None, cfg.output_dir)
+    if cfg.checkpoint_dir:
+        from ..train.checkpoint import Checkpointer
+
+        with Checkpointer(cfg.checkpoint_dir) as ckpt:
+            ckpt.save(
+                int(state.step),
+                state,
+                meta={
+                    "client_id": args.client_id,
+                    "kind": "local",
+                    "config": cfg.to_dict(),
+                },
+            )
+            ckpt.wait()
+    return 0

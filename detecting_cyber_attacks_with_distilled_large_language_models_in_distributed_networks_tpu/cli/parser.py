@@ -1,0 +1,328 @@
+"""Command-line orchestration — the reference's three ``main()``s unified.
+
+The reference's entry points are three scripts with hard-coded paths, ports,
+seeds, and client count (reference client1.py:353-415, client2.py:332-392,
+server.py:116-140); adding a client means copy-pasting a file. Here one CLI
+covers every deployment shape, parameterized by client id / count:
+
+  local       one client, train -> eval -> metrics CSV + plots
+              (reference client1.py minus the sockets)
+  federated   N clients on one TPU mesh: SPMD local epochs + pmean FedAvg,
+              multi-round, checkpoint/resume (the TPU-native deployment)
+  predict     batch inference: flow CSV -> per-row P(attack) CSV, from a
+              local/federated checkpoint or a fine-tuned --hf-dir (the
+              deployment step the reference never ships)
+  distill     teacher -> student knowledge distillation (the recipe behind
+              the reference's pre-distilled encoder)
+  serve       TCP aggregation server (demo-parity mode, reference server.py)
+  client      TCP client: train locally, exchange with a serve process,
+              re-evaluate the aggregate (reference client1.py end-to-end)
+  export-config   print the full default config as JSON (there is no config
+                  file in the reference to copy from)
+
+Config resolution: defaults <- --config JSON <- explicit flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Sequence
+
+from .comm import cmd_client, cmd_serve
+from .common import resolve_config
+from .distill import cmd_distill
+from .federated import cmd_federated
+from .local import cmd_local
+from .predict import cmd_export_hf, cmd_predict
+
+
+def cmd_export_config(args) -> int:
+    from ..data import default_tokenizer
+
+    cfg = resolve_config(args, vocab_size=len(default_tokenizer().vocab))
+    json.dump(cfg.to_dict(), sys.stdout, indent=2)
+    sys.stdout.write("\n")
+    return 0
+
+
+# ------------------------------------------------------------------ parser
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", help="JSON config file (ExperimentConfig.to_dict shape)")
+    p.add_argument(
+        "--preset", default="tiny", help="tiny|distilbert|bert|bert-large"
+    )
+    p.add_argument(
+        "--gelu",
+        choices=["exact", "tanh"],
+        help="FFN activation: tanh (default, ~20%% faster on TPU, within a "
+        "few bf16 ulps of erf) or exact (HF's erf form, fp32 parity)",
+    )
+    p.add_argument(
+        "--hf-dir",
+        help="HF DistilBERT checkpoint dir (config.json + vocab.txt + "
+        "model.safetensors|pytorch_model.bin) — the reference's required "
+        "./distilbert-base-uncased; pretrained encoder + fresh head",
+    )
+    p.add_argument(
+        "--pth",
+        help="a reference-run .pth state dict (its DDoSClassifier / "
+        "aggregated model) as the weights, with --hf-dir supplying "
+        "tokenizer + architecture — direct migration of a model the "
+        "reference trained",
+    )
+    p.add_argument("--csv", help="flow CSV path (schema set by --dataset)")
+    p.add_argument(
+        "--dataset",
+        help="registered dataset schema: cicids2017|cicddos2019|unswnb15",
+    )
+    p.add_argument(
+        "--source",
+        action="append",
+        metavar="[DATASET=]PATH",
+        help="mixed-corpus CSV source (repeatable); dataset auto-detected "
+        "from the schema when omitted",
+    )
+    p.add_argument("--synthetic", type=int, metavar="N", help="use N synthetic flows")
+    p.add_argument(
+        "--stream",
+        action="store_true",
+        help="two-pass chunked CSV reader (corpora larger than RAM); "
+        "index-based sampling semantics",
+    )
+    p.add_argument("--output-dir", default=None)
+    p.add_argument("--batch-size", type=int)
+    p.add_argument("--epochs", type=int, help="epochs per round")
+    p.add_argument("--learning-rate", type=float)
+    p.add_argument(
+        "--warmup-steps",
+        type=int,
+        help="linear LR warmup steps (global step count; 0 = constant)",
+    )
+    p.add_argument("--max-len", type=int)
+    p.add_argument("--data-fraction", type=float)
+    p.add_argument("--seed", type=int)
+    p.add_argument(
+        "--profile-dir",
+        help="write a jax.profiler trace of the training phase here "
+        "(view with xprof/tensorboard)",
+    )
+    p.add_argument(
+        "--metrics-jsonl",
+        help="append one structured JSON record per (round, client, phase) "
+        "here — machine-readable observability the reference's prints/CSVs "
+        "lack (pd.read_json(..., lines=True))",
+    )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="fedtpu",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("local", help="single-client train/eval/report")
+    _add_common(p)
+    p.add_argument("--client-id", type=int, default=0)
+    p.add_argument("--checkpoint-dir")
+    p.set_defaults(fn=cmd_local)
+
+    p = sub.add_parser("federated", help="N-client SPMD FedAvg on the TPU mesh")
+    _add_common(p)
+    p.add_argument("--num-clients", type=int, default=None)  # None: config wins
+    p.add_argument("--rounds", type=int)
+    p.add_argument("--data-parallel", type=int, help="per-client data-parallel shards")
+    g = p.add_mutually_exclusive_group()
+    g.add_argument(
+        "--weighted",
+        action="store_true",
+        help="require sample-count FedAvg weights (the auto default already "
+        "weights by sample count when counts are known and DP is off)",
+    )
+    g.add_argument(
+        "--unweighted",
+        action="store_true",
+        help="force the uniform mean (the reference's server.py:73-76)",
+    )
+    p.add_argument("--partition", help="sample|disjoint|dirichlet")
+    p.add_argument(
+        "--dirichlet-alpha",
+        type=float,
+        help="label-skew concentration for --partition dirichlet "
+        "(smaller = more non-IID; default 0.5)",
+    )
+    p.add_argument(
+        "--prox-mu",
+        type=float,
+        help="FedProx proximal weight (0 = plain FedAvg); stabilizes "
+        "non-IID partitions",
+    )
+    p.add_argument(
+        "--participation",
+        type=float,
+        help="fraction of clients aggregated per round (sampled, seeded); "
+        "1.0 = everyone (reference behavior)",
+    )
+    p.add_argument(
+        "--dp-clip",
+        type=float,
+        help="DP-FedAvg: clip each client's round update to this L2 norm "
+        "before aggregation (0 = off)",
+    )
+    p.add_argument(
+        "--dp-noise-multiplier",
+        type=float,
+        help="DP-FedAvg: Gaussian noise multiplier on the clipped mean "
+        "update (std = multiplier * clip / n_participants); requires "
+        "--dp-clip",
+    )
+    p.add_argument(
+        "--server-opt",
+        choices=["none", "momentum", "adam"],
+        help="FedOpt server optimizer over the round's mean update: "
+        "momentum = FedAvgM, adam = FedAdam (default none = plain FedAvg)",
+    )
+    p.add_argument(
+        "--server-lr", type=float, help="server optimizer learning rate (default 1.0)"
+    )
+    p.add_argument(
+        "--server-momentum", type=float, help="FedAvgM momentum (default 0.9)"
+    )
+    p.add_argument("--checkpoint-dir")
+    p.add_argument(
+        "--coordinator",
+        help="multi-host: coordinator HOST:PORT (every process passes the "
+        "same address; also via JAX_COORDINATOR_ADDRESS)",
+    )
+    p.add_argument("--num-processes", type=int, help="multi-host: process count")
+    p.add_argument("--process-id", type=int, help="multi-host: this process's id")
+    p.set_defaults(fn=cmd_federated)
+
+    p = sub.add_parser(
+        "serve",
+        help="TCP aggregation server (demo-parity mode)",
+        epilog="Set FEDTPU_SECRET (env var, same value on server and every "
+        "client) to require HMAC-SHA256-authenticated, replay-protected "
+        "exchanges; unset = the reference's open protocol.",
+    )
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=12345)
+    p.add_argument("--num-clients", type=int, default=2)
+    p.add_argument("--rounds", type=int, default=1)
+    p.add_argument("--min-clients", type=int, default=None)
+    p.add_argument("--weighted", action="store_true")
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--compression", default="none", choices=["none", "bf16", "int8"])
+    p.add_argument(
+        "--secure-agg",
+        action="store_true",
+        help="secure aggregation: accept pairwise-masked uploads and "
+        "recover only their sum — individual client weights are never "
+        "visible to the server",
+    )
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "client",
+        help="TCP federated client (demo-parity mode)",
+        epilog="Set FEDTPU_SECRET (env var) to authenticate exchanges; must "
+        "match the server's.",
+    )
+    _add_common(p)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=12345)
+    p.add_argument("--client-id", type=int, required=True)
+    p.add_argument("--num-clients", type=int, default=None)  # None: config wins
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--compression", default="none", choices=["none", "bf16", "int8"])
+    p.add_argument(
+        "--secure-agg",
+        action="store_true",
+        help="mask the upload with pairwise secrets (FEDTPU_MASK_SECRET, "
+        "shared by clients only) so the server sees only the sum",
+    )
+    p.add_argument(
+        "--checkpoint-dir",
+        help="warm-start + save full state here (the reference's "
+        "client{N}_model.pth re-launch pattern, client1.py:375-377,388,403)",
+    )
+    p.add_argument(
+        "--rounds",
+        type=int,
+        default=1,
+        help="train/exchange rounds in one process (server must serve >= "
+        "this many); the reference achieves this by re-launching",
+    )
+    p.set_defaults(fn=cmd_client)
+
+    p = sub.add_parser(
+        "predict",
+        help="batch inference: flow CSV -> per-row attack probability CSV",
+    )
+    _add_common(p)  # provides --csv (required here), --dataset, model flags
+    p.add_argument(
+        "--output", default="predictions.csv", help="predictions CSV path"
+    )
+    p.add_argument("--checkpoint-dir", help="local or federated training checkpoint")
+    p.add_argument(
+        "--threshold",
+        type=float,
+        default=0.5,
+        help="P(attack) decision threshold (default 0.5)",
+    )
+    p.set_defaults(fn=cmd_predict)
+
+    p = sub.add_parser("distill", help="teacher -> student knowledge distillation")
+    _add_common(p)
+    p.add_argument("--teacher-layers", type=int, help="default: 2x student layers")
+    p.add_argument(
+        "--teacher-checkpoint",
+        help="distill FROM this trained checkpoint (local or federated — "
+        "e.g. a federated BERT fleet's aggregate) instead of training a "
+        "fresh teacher; --pth + --hf-dir similarly supplies a "
+        "reference-trained teacher",
+    )
+    p.add_argument(
+        "--student-layers",
+        type=int,
+        help="student depth (default: the resolved model's) — e.g. distill "
+        "a migrated 6-layer model into 3 layers",
+    )
+    p.add_argument("--distill-epochs", type=int, help="default: train epochs")
+    p.add_argument("--temperature", type=float, help="KD softmax temperature")
+    p.add_argument("--alpha", type=float, help="KD loss weight in [0,1]")
+    p.add_argument(
+        "--no-teacher-init",
+        action="store_true",
+        help="skip the every-other-layer student init",
+    )
+    p.add_argument("--checkpoint-dir")
+    p.set_defaults(fn=cmd_distill)
+
+    p = sub.add_parser(
+        "export-hf",
+        help="export a trained checkpoint to the HF DistilBERT layout "
+        "(config.json + model.safetensors + vocab.txt)",
+    )
+    _add_common(p)
+    # Not required: --pth + --hf-dir is the other valid weight source
+    # (cmd_export_hf checks that exactly one is given at runtime).
+    p.add_argument("--checkpoint-dir")
+    p.add_argument("--out", required=True, help="output HF checkpoint dir")
+    p.set_defaults(fn=cmd_export_hf)
+
+    p = sub.add_parser("export-config", help="print the resolved config as JSON")
+    _add_common(p)
+    p.add_argument("--num-clients", type=int)
+    p.add_argument("--rounds", type=int)
+    p.set_defaults(fn=cmd_export_config)
+    return ap
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+

@@ -1,0 +1,287 @@
+"""fedtpu predict / export-hf — checkpoint restore for inference, batch
+prediction, and export to the HF DistilBERT layout."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from ..config import ExperimentConfig
+from ..utils.logging import get_logger, phase
+from .common import _resolve_with_pretrained
+
+log = get_logger()
+
+
+def _restore_predict_params(cfg, tok, trainer, *, ckpt_dir=None):
+    """Trained weights for inference from a checkpoint directory
+    (``cfg.checkpoint_dir`` unless ``ckpt_dir`` overrides — distill's
+    teacher restore points elsewhere).
+
+    Understands both checkpoint flavors: a ``local``/``client`` TrainState
+    (restored against this trainer's template, or the checkpoint's own
+    recorded config when present) and a ``federated`` FedState (recognized
+    by its metadata; restored on the mesh and collapsed to client 0's
+    replica — post-aggregation all replicas are identical). Returns
+    ``(model_cfg, params)``; raises instead of silently predicting from
+    random weights."""
+    from ..train.checkpoint import Checkpointer
+
+    ckpt_dir = cfg.checkpoint_dir if ckpt_dir is None else ckpt_dir
+    if not os.path.isdir(ckpt_dir):
+        # Read-only path: don't let the manager create a directory at a
+        # mistyped location (it would later masquerade as a real run dir).
+        raise SystemExit(f"checkpoint dir {ckpt_dir} does not exist")
+    with Checkpointer(ckpt_dir) as ckpt:
+        step = ckpt.latest_step()
+        if step is None:
+            raise SystemExit(f"no checkpoint found in {ckpt_dir}")
+        meta = ckpt.restore_meta(step=step)
+        import jax
+
+        # "kind" discriminates local TrainState vs federated FedState
+        # checkpoints; older federated checkpoints predate it but always
+        # carried "round".
+        is_fed = (
+            meta.get("kind") == "federated" if "kind" in meta else "round" in meta
+        )
+        if is_fed:
+            from ..train.federated import FederatedTrainer
+
+            fed_cfg = ExperimentConfig.from_checkpoint_dict(meta["config"])
+            if fed_cfg.model.vocab_size != cfg.model.vocab_size:
+                raise SystemExit(
+                    f"checkpoint model vocab ({fed_cfg.model.vocab_size}) != "
+                    f"tokenizer vocab ({cfg.model.vocab_size}); pass the "
+                    "matching --hf-dir / vocab"
+                )
+            ftr = FederatedTrainer(fed_cfg, pad_id=tok.pad_id)
+            # Abstract template + params-only restore: never materializes
+            # the C-stacked Adam moments (3x C model copies for a fleet
+            # checkpoint); only the [C, ...] params land, and replica 0 is
+            # the global model (FedAvg replicates its output).
+            template = jax.eval_shape(lambda: ftr.init_state(seed=0))
+            stacked = ckpt.restore_params(template, step=step)
+            params = jax.tree.map(lambda x: np.asarray(x)[0], stacked)
+            log.info(
+                f"[PREDICT] restored federated checkpoint (round "
+                f"{meta.get('round', '?')}, {fed_cfg.fed.num_clients} clients)"
+            )
+            return fed_cfg.model, params
+        # Without recorded config (legacy checkpoints) the caller's trainer
+        # IS the architecture claim — return ITS config, not cfg.model
+        # (distill passes a deeper-than-student teacher template here).
+        model_cfg = trainer.model_cfg
+        if "config" in meta:
+            # Trust the checkpoint's recorded config over CLI presets —
+            # e.g. its gelu variant does not change parameter shapes, so a
+            # mismatched preset would restore fine and then run (or
+            # export) the wrong activation.
+            from ..train.engine import Trainer
+
+            ckpt_cfg = ExperimentConfig.from_checkpoint_dict(meta["config"])
+            if ckpt_cfg.model.vocab_size != cfg.model.vocab_size:
+                raise SystemExit(
+                    f"checkpoint model vocab ({ckpt_cfg.model.vocab_size}) "
+                    f"!= tokenizer vocab ({cfg.model.vocab_size}); pass the "
+                    "matching --hf-dir / vocab"
+                )
+            model_cfg = ckpt_cfg.model
+            if model_cfg != trainer.model_cfg:
+                trainer = Trainer(model_cfg, cfg.train, pad_id=tok.pad_id)
+        template = jax.eval_shape(lambda: trainer.init_state(seed=0))
+        try:
+            params = ckpt.restore_params(template, step=step)
+        except Exception as e:
+            raise SystemExit(
+                f"checkpoint at {ckpt_dir} (step {step}) does not "
+                f"match the resolved model ({type(e).__name__}: {e}) — pass "
+                "the --preset/--config/--hf-dir the checkpoint was trained "
+                "with"
+            ) from None
+        log.info(f"[PREDICT] restored local checkpoint (step {step})")
+        return model_cfg, params
+
+
+def cmd_predict(args) -> int:
+    """Batch inference on new flows — the deployment step the reference
+    never ships: it trains and evaluates (client1.py:379-400) but offers no
+    way to RUN the detector on unlabeled traffic. Reads a flow CSV (label
+    column optional), writes one row per flow: P(attack), the thresholded
+    0/1 prediction, and its label name; logs metrics when labels exist."""
+    import pandas as pd
+
+    from ..data import get_dataset, load_flow_csv
+    from ..data.pipeline import TokenizedSplit
+    from ..train.engine import Trainer
+
+    if not getattr(args, "csv", None):
+        raise SystemExit("predict needs --csv (the flows to classify)")
+    for flag in ("stream", "source", "synthetic"):
+        if getattr(args, flag, None):
+            raise SystemExit(
+                f"--{flag} is a training-data option; predict reads the "
+                "flows to classify from --csv only"
+            )
+    if (
+        not getattr(args, "checkpoint_dir", None)
+        and getattr(args, "hf_dir", None)
+        and not getattr(args, "pth", None)  # .pth supplies the trained head
+    ):
+        # Gate BEFORE the (expensive) weight conversion: a bare encoder's
+        # head would be random noise, so predicting from it is meaningless.
+        from ..models.hf_convert import hf_dir_has_head
+
+        if not hf_dir_has_head(args.hf_dir):
+            raise SystemExit(
+                f"--hf-dir {args.hf_dir} is a bare encoder (no classifier.* "
+                "weights): its head would be random noise. Train it first "
+                "(local/federated, then --checkpoint-dir), or point --hf-dir "
+                "at a checkpoint fine-tuned with this head architecture"
+            )
+    tok, cfg, pretrained = _resolve_with_pretrained(args)
+    if cfg.checkpoint_dir and getattr(args, "pth", None):
+        # Checked on the RESOLVED config: checkpoint_dir may come from a
+        # --config file, not just the flag.
+        raise SystemExit(
+            "--pth and a checkpoint_dir are both weight sources; pass one"
+        )
+    if not cfg.checkpoint_dir and pretrained is None:
+        raise SystemExit(
+            "predict needs trained weights: pass --checkpoint-dir (a local "
+            "or federated training checkpoint) or --hf-dir (a fine-tuned "
+            "classifier checkpoint)"
+        )
+    trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
+    if cfg.checkpoint_dir:
+        model_cfg, params = _restore_predict_params(cfg, tok, trainer)
+        if model_cfg != cfg.model:
+            trainer = Trainer(model_cfg, cfg.train, pad_id=tok.pad_id)
+    else:
+        model_cfg, params = cfg.model, pretrained
+
+    spec = get_dataset(cfg.data.dataset)
+    with phase(f"loading {args.csv}", tag="DATA"):
+        df = load_flow_csv(args.csv)
+        texts = spec.render_texts(df)
+        label_col = cfg.data.label_column if spec.label_kind == "positive" else spec.label_column
+        labels = None
+        if label_col in df.columns:
+            from ..data.cicids import _spec_labels
+
+            labels = _spec_labels(df, cfg.data)
+    if not texts:
+        raise SystemExit(f"--csv {args.csv} has no data rows")
+    with phase(f"tokenize {len(texts)} flows", tag="DATA"):
+        enc = tok.batch_encode(texts, max_len=model_cfg.max_len)
+    split = TokenizedSplit(
+        enc["input_ids"],
+        enc["attention_mask"],
+        (labels if labels is not None else np.zeros(len(texts))).astype(np.int32),
+    )
+    bs = cfg.data.eval_batch_size
+    with phase(f"predict ({len(texts)} flows, bs {bs})", tag="EVAL"):
+        # Trainer.evaluate is the one eval pipeline (pad/slice/accumulate);
+        # its metrics are ignored here (labels may be dummies) — predict
+        # only consumes the per-row P(attack) probs.
+        probs = trainer.evaluate(params, split, batch_size=bs)["probs"]
+    preds = (probs >= args.threshold).astype(np.int32)
+    positive = (
+        cfg.data.positive_label if spec.label_kind == "positive" else "attack"
+    )
+    out = pd.DataFrame(
+        {
+            "prob_attack": probs,
+            "prediction": preds,
+            "label_name": np.where(preds == 1, positive, "BENIGN"),
+        }
+    )
+    out.to_csv(args.output, index=False)
+    log.info(
+        f"[PREDICT] wrote {len(out)} predictions to {args.output} "
+        f"({int(preds.sum())} flagged {positive})"
+    )
+    if labels is not None:
+        # Metrics at the SAME threshold the predictions used (sklearn
+        # average='binary' semantics, as the reference's evaluate_model).
+        y = labels.astype(np.int32)
+        tp = int(((preds == 1) & (y == 1)).sum())
+        fp = int(((preds == 1) & (y == 0)).sum())
+        fn = int(((preds == 0) & (y == 1)).sum())
+        prec = tp / (tp + fp) if tp + fp else 0.0
+        rec = tp / (tp + fn) if tp + fn else 0.0
+        f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+        log.info(
+            f"[PREDICT] against the CSV's labels (threshold "
+            f"{args.threshold}): acc {(preds == y).mean() * 100:.4f} "
+            f"prec {prec:.4f} rec {rec:.4f} f1 {f1:.4f}"
+        )
+    return 0
+
+
+def cmd_export_hf(args) -> int:
+    """Export trained weights to the HF DistilBERT checkpoint layout
+    (config.json + model.safetensors + vocab.txt) — the reference's own
+    artifact format (its required ``./distilbert-base-uncased`` input dir
+    and its ``.pth`` state dicts use the same key space, client1.py:56,388).
+    A reference user can load this with ``DistilBertModel.from_pretrained``
+    or hand it back to this framework via ``--hf-dir``."""
+    import jax
+
+    from ..models.hf_convert import flax_to_hf
+    from ..train.engine import Trainer
+
+    tok, cfg, pretrained = _resolve_with_pretrained(args)
+    if getattr(args, "pth", None) and cfg.checkpoint_dir:
+        # Resolved config: checkpoint_dir may come from a --config file.
+        raise SystemExit(
+            "--pth and a checkpoint_dir are both weight sources; pass one"
+        )
+    if cfg.checkpoint_dir:
+        trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
+        model_cfg, params = _restore_predict_params(cfg, tok, trainer)
+    elif getattr(args, "pth", None):
+        # Convert a reference-trained .pth straight to the HF layout.
+        model_cfg, params = cfg.model, pretrained
+    else:
+        raise SystemExit(
+            "export-hf needs trained weights: --checkpoint-dir, or "
+            "--pth + --hf-dir (a reference-trained model)"
+        )
+    if model_cfg.n_classes != 2 or not isinstance(params, dict) or "encoder" not in params:
+        raise SystemExit("checkpoint does not hold a classifier params tree")
+    sd = flax_to_hf(jax.tree.map(np.asarray, params), model_cfg)
+
+    out = args.out
+    os.makedirs(out, exist_ok=True)
+    from safetensors.numpy import save_file
+
+    save_file(sd, os.path.join(out, "model.safetensors"))
+    hf_config = {
+        "architectures": ["DistilBertModel"],
+        "model_type": "distilbert",
+        "vocab_size": model_cfg.vocab_size,
+        "dim": model_cfg.dim,
+        "n_layers": model_cfg.n_layers,
+        "n_heads": model_cfg.n_heads,
+        "hidden_dim": model_cfg.hidden_dim,
+        "max_position_embeddings": model_cfg.max_position_embeddings,
+        "dropout": model_cfg.dropout,
+        "attention_dropout": model_cfg.attention_dropout,
+        "pad_token_id": model_cfg.pad_token_id,
+        "initializer_range": model_cfg.initializer_range,
+        # Declare the activation the weights were actually trained under:
+        # HF's "gelu" is the erf form, "gelu_new" the tanh form.
+        "activation": "gelu" if model_cfg.gelu == "exact" else "gelu_new",
+        "tie_weights_": True,
+    }
+    with open(os.path.join(out, "config.json"), "w") as f:
+        json.dump(hf_config, f, indent=2)
+    tok.save_vocab(os.path.join(out, "vocab.txt"))
+    log.info(
+        f"[EXPORT] wrote HF checkpoint ({len(sd)} tensors, "
+        f"{sum(v.nbytes for v in sd.values()) / 1e6:.1f} MB) to {out}"
+    )
+    return 0

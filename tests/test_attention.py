@@ -135,6 +135,7 @@ def test_ring_gradients_match_dot(rng, eight_devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_model_forward_matches_dot(rng, eight_devices):
     """Full classifier under a sequence-sharded shard_map (ring attention,
     shard-offset positions, global CLS pooling) equals the unsharded dot
@@ -164,6 +165,7 @@ def test_ring_model_forward_matches_dot(rng, eight_devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
 
 
+@pytest.mark.slow
 def test_ring_sequence_parallel_training_matches_dot(rng, eight_devices):
     """Long-context TRAINING parity: gradients of the full classifier under
     sequence-sharded ring attention (shard_map, K/V ppermute ring) equal the

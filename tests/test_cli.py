@@ -81,14 +81,28 @@ def test_local_flow_writes_reference_artifacts(tmp_path):
 def test_federated_flow_writes_artifacts_and_checkpoints(tmp_path, eight_devices):
     out = tmp_path / "out"
     ckpt = tmp_path / "ckpt"
+    jsonl = tmp_path / "metrics.jsonl"
     rc = main(
         [
             "federated", "--synthetic", "600", "--num-clients", "2",
             "--rounds", "1", "--epochs", "1",
             "--output-dir", str(out), "--checkpoint-dir", str(ckpt),
+            "--metrics-jsonl", str(jsonl),
         ]
     )
     assert rc == 0
+    # Per-round JSONL reports val AND test at both phases, like the
+    # reference (client1.py:383-385,398-400).
+    import json
+
+    records = [json.loads(line) for line in jsonl.read_text().splitlines()]
+    assert {(r["phase"], r["split"], r["client"]) for r in records} == {
+        (p, sp, c)
+        for p in ("local", "aggregated")
+        for sp in ("val", "test")
+        for c in (0, 1)
+    }
+    assert all("Accuracy" in r for r in records)
     for c in range(2):
         assert (out / f"client{c}_local_metrics.csv").exists()
         assert (out / f"client{c}_aggregated_metrics.csv").exists()
@@ -107,31 +121,6 @@ def test_federated_flow_writes_artifacts_and_checkpoints(tmp_path, eight_devices
     )
     assert rc2 == 0
 
-
-def test_federated_jsonl_has_val_and_test_phases(tmp_path, eight_devices):
-    """Federated runs report validation metrics per phase like the
-    reference (client1.py:383-385,398-400), streamed to --metrics-jsonl."""
-    import json
-
-    jsonl = tmp_path / "metrics.jsonl"
-    rc = main(
-        [
-            "federated", "--synthetic", "400", "--num-clients", "2",
-            "--rounds", "1", "--epochs", "1",
-            "--output-dir", str(tmp_path / "out"),
-            "--metrics-jsonl", str(jsonl),
-        ]
-    )
-    assert rc == 0
-    records = [json.loads(line) for line in jsonl.read_text().splitlines()]
-    keys = {(r["phase"], r["split"], r["client"]) for r in records}
-    assert keys == {
-        (p, s, c)
-        for p in ("local", "aggregated")
-        for s in ("val", "test")
-        for c in (0, 1)
-    }
-    assert all("Accuracy" in r for r in records)
 
 
 def test_local_fit_logs_per_step_telemetry(tmp_path):

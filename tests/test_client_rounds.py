@@ -21,6 +21,7 @@ def _serve(server, rounds, errs):
         errs.append(e)
 
 
+@pytest.mark.slow
 def test_client_multi_round_with_checkpoints(tmp_path):
     """One client per round slot (num_clients=1 keeps the test single
     process): two in-process rounds, post-train and post-aggregate saves,
@@ -71,6 +72,7 @@ def test_client_multi_round_with_checkpoints(tmp_path):
     assert latest_after_run2 > latest_after_run1
 
 
+@pytest.mark.slow
 def test_client_degrades_without_server(tmp_path):
     """No server at all: the client still exits 0 with local-only reports
     (the reference's degraded path, client1.py:405-410)."""

@@ -121,6 +121,7 @@ def test_width_mismatch_rejected(rng):
         )
 
 
+@pytest.mark.slow
 def test_distill_end_to_end_student_learns(rng):
     """Teacher trains on synthetic flows; the distilled student matches its
     accuracy at half depth."""
@@ -163,6 +164,7 @@ def test_distill_end_to_end_student_learns(rng):
     assert d2.evaluate(s2.params, client.test)["Accuracy"] > 90.0
 
 
+@pytest.mark.slow
 def test_distill_from_federated_checkpoint(tmp_path):
     """The end-to-end 'distilled LLMs in distributed networks' pipeline:
     federate a model, then distill its aggregate into a student via
@@ -218,6 +220,7 @@ def test_distill_from_federated_checkpoint(tmp_path):
     assert os.path.exists(preds)
 
 
+@pytest.mark.slow
 def test_distill_from_local_checkpoint_same_arch(tmp_path):
     """Local-teacher path: the checkpoint's recorded config (tiny, 2
     layers) must override the 2x-deep default teacher hint — the restore

@@ -283,6 +283,7 @@ def test_trainer_dp_requires_anchor(eight_devices):
         trainer.aggregate(state)
 
 
+@pytest.mark.slow
 def test_trainer_dp_noise_is_fresh_entropy_unless_pinned(eight_devices):
     """Default dp_seed=None must draw fresh OS entropy per trainer (noise
     derived from the public config seed could be regenerated and

@@ -64,6 +64,7 @@ def test_end_to_end_learns(trainer, client_data):
     assert cm.sum() == after["n"] == len(client_data.test)
 
 
+@pytest.mark.slow
 def test_warmup_ramps_then_reaches_full_lr(tok):
     """Per-step update magnitudes must ramp over the warmup window and reach
     the constant-LR magnitude once the window has passed; the ramp is keyed
@@ -136,6 +137,7 @@ def test_warm_start_continues(trainer, client_data):
     assert losses[0] < 0.5  # warm-started, not from scratch
 
 
+@pytest.mark.slow
 def test_grad_accum_trains(tok, client_data):
     """grad_accum_steps=2 with bs=8 (effective batch 16) must train to the
     same regime as the plain bs=16 path."""

@@ -91,3 +91,19 @@ def test_fedavg_matches_reference_inplace_mean():
 def test_mesh_requires_enough_devices(eight_devices):
     with pytest.raises(ValueError, match="needs 16 devices"):
         make_mesh(8, 2, devices=eight_devices)
+
+
+def test_fit_clients_axis():
+    """Replica stacking: largest clients-axis size dividing the logical
+    client count that fits beside the data axis (the fast-lane unit check
+    behind the slow-lane more-clients-than-mesh integration test)."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.parallel.mesh import (
+        fit_clients_axis,
+    )
+
+    assert fit_clients_axis(4, 2, 8) == 4   # 4x2 fits 8 devices
+    assert fit_clients_axis(8, 2, 8) == 4   # 8 clients -> 4 rows, 2 each
+    assert fit_clients_axis(64, 1, 8) == 8  # 8 replicas per row
+    assert fit_clients_axis(3, 2, 8) == 3   # odd counts: 3x2 = 6 <= 8
+    with pytest.raises(ValueError, match="data axis"):
+        fit_clients_axis(4, 16, 8)

@@ -104,6 +104,7 @@ def _launch_pair(tmp_path, out, extra=()):
     return outputs
 
 
+@pytest.mark.slow
 def test_two_process_federated_cli(tmp_path):
     """Full multi-host flow through the CLI: bootstrap, global mesh, each
     process feeding its own client, FedAvg over DCN, process 0 reporting."""
@@ -121,6 +122,7 @@ def test_two_process_federated_cli(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_two_process_checkpoint_resume(tmp_path):
     """Multi-host checkpoint/resume: round 1 saves a sharded checkpoint
     (every process participates); a fresh launch resumes from it instead of
@@ -146,6 +148,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_dp_fedavg(tmp_path):
     """Multi-host DP-FedAvg: the fresh noise seed must be agreed across
     processes (allgather of process 0's entropy) — divergent seeds would
@@ -177,6 +180,7 @@ def test_two_process_dp_fedavg(tmp_path):
     assert agg0 and agg0 == agg1
 
 
+@pytest.mark.slow
 def test_two_process_server_opt(tmp_path):
     """Multi-host FedOpt: the server-optimizer state must be a global
     replicated array (not host-local), or the jitted aggregate rejects the

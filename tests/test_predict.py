@@ -64,6 +64,7 @@ def test_predict_from_local_checkpoint(flows_csv, tmp_path):
     assert 0 < df["prediction"].sum() < len(df)
 
 
+@pytest.mark.slow
 def test_predict_from_federated_checkpoint(flows_csv, tmp_path):
     ckpt = str(tmp_path / "fedckpt")
     out = str(tmp_path / "fedpreds.csv")

@@ -67,6 +67,7 @@ def test_config_validation():
         FedConfig(server_opt="adam", server_lr=0.0)
 
 
+@pytest.mark.slow
 def test_momentum_lr1_m0_equals_plain_fedavg(eight_devices):
     """server_opt=momentum at lr=1, momentum=0 must be bit-close to plain
     FedAvg: new global == mean of client params."""
@@ -128,6 +129,7 @@ def test_fedadam_round_replicates_and_is_finite(eight_devices):
     assert state.server_opt is not None
 
 
+@pytest.mark.slow
 def test_server_opt_composes_with_dp(eight_devices):
     trainer, state = _trainer(
         eight_devices,
@@ -146,6 +148,7 @@ def test_server_opt_composes_with_dp(eight_devices):
     assert np.isfinite(leaf).all()
 
 
+@pytest.mark.slow
 def test_run_loop_with_server_opt(eight_devices):
     from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.pipeline import (
         TokenizedSplit,
