@@ -72,11 +72,12 @@ class ModelConfig:
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
         if self.gelu not in ("exact", "tanh"):
             raise ValueError(f"unknown gelu {self.gelu!r} (exact|tanh)")
-        if self.attention_impl in ("flash", "ring") and self.attention_dropout > 0.0:
+        if self.attention_impl == "ring" and self.attention_dropout > 0.0:
             raise ValueError(
-                f"attention_impl={self.attention_impl!r} does not implement "
-                "attention dropout; set attention_dropout=0.0 (the head/FFN "
-                "dropouts still apply)"
+                "attention_impl='ring' does not implement attention "
+                "dropout; set attention_dropout=0.0 (the head/FFN dropouts "
+                "still apply). The flash kernel supports it (hash-based "
+                "masks, ops/flash_attention.py)."
             )
 
     @property

@@ -74,11 +74,14 @@ class MultiHeadSelfAttention(nn.Module):
             else self.make_rng("dropout")
         )
         if cfg.attention_impl == "flash":
-            # NOTE: the Pallas kernel does not apply attention dropout; use it
-            # for eval/inference or with attention_dropout=0.
             from ..ops.flash_attention import flash_attention
 
-            ctx = flash_attention(q, k, v, bias)
+            ctx = flash_attention(
+                q, k, v, bias,
+                dropout_rate=cfg.attention_dropout,
+                dropout_rng=dropout_rng,
+                deterministic=deterministic,
+            )
         elif cfg.attention_impl == "ring" and _axis_bound(cfg.ring_axis):
             # Sequence-sharded forward inside shard_map over cfg.ring_axis.
             from ..parallel.ring_attention import ring_attention

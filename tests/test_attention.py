@@ -264,10 +264,11 @@ def test_ring_config_initializes_and_runs_outside_shard_map(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
-def test_config_rejects_attention_dropout_for_flash_and_ring():
-    for impl in ("flash", "ring"):
-        with pytest.raises(ValueError, match="attention dropout"):
-            ModelConfig.tiny(attention_impl=impl, attention_dropout=0.1)
+def test_config_rejects_attention_dropout_for_ring_only():
+    with pytest.raises(ValueError, match="attention dropout"):
+        ModelConfig.tiny(attention_impl="ring", attention_dropout=0.1)
+    # flash DOES implement attention dropout (hash-based masks).
+    ModelConfig.tiny(attention_impl="flash", attention_dropout=0.1)
 
 
 def test_flash_handles_non_multiple_block_lengths():
@@ -324,3 +325,97 @@ def test_flash_degenerate_length_falls_back_to_dot(rng):
     g = jax.grad(lambda q: flash_attention(q, k, v, bias).sum())(q)
     gref = jax.grad(lambda q: dot_product_attention(q, k, v, bias).sum())(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gref), atol=1e-5)
+
+
+def test_flash_dropout_deterministic_and_unbiased(rng):
+    """Flash attention dropout: same rng -> same output; different rng ->
+    different mask; averaging over many seeds recovers the no-dropout
+    output (inverted-dropout unbiasedness) and the keep rate matches."""
+    q, k, v = _qkv(rng, b=1, h=2, l=32, d=8)
+    bias = _mask_bias(rng, b=1, l=32)
+    base = flash_attention(q, k, v, bias, block_q=16, block_k=16)
+    key = jax.random.key(0)
+
+    def drop(key):
+        return flash_attention(
+            q, k, v, bias, dropout_rate=0.4, dropout_rng=key,
+            deterministic=False, block_q=16, block_k=16,
+        )
+
+    out1, out2 = drop(key), drop(key)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    assert not np.allclose(np.asarray(out1), np.asarray(drop(jax.random.key(1))))
+    assert not np.allclose(np.asarray(out1), np.asarray(base))
+    # E[dropout(w)] = w: the seed-average converges to the clean output.
+    # 64 seeds put ~sqrt(p/(1-p))/8 ~ 0.1 of per-element noise on the mean,
+    # so bound the max loosely and the average error tightly.
+    outs = np.stack(
+        [np.asarray(drop(jax.random.key(s))) for s in range(64)]
+    )
+    err = np.abs(outs.mean(0) - np.asarray(base))
+    # Rows whose softmax concentrates on one key carry per-seed noise of
+    # the full |v| scale, so bound the bulk, not the max.
+    assert err.mean() < 0.05, err.mean()
+    assert np.quantile(err, 0.9) < 0.2, np.quantile(err, 0.9)
+    # And the mask itself keeps at the configured rate.
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.ops.flash_attention import (
+        _keep_mask,
+    )
+
+    keeps = np.mean(
+        [
+            np.asarray(
+                _keep_mask(
+                    jax.random.bits(jax.random.key(s), (), jnp.uint32),
+                    jnp.int32(0), jnp.int32(1), 0, 0, 32, 32, 0.4,
+                )
+            ).mean()
+            for s in range(16)
+        ]
+    )
+    np.testing.assert_allclose(keeps, 0.6, atol=0.03)
+    # deterministic=True ignores the rate entirely.
+    out_det = flash_attention(
+        q, k, v, bias, dropout_rate=0.4, dropout_rng=key,
+        deterministic=True, block_q=16, block_k=16,
+    )
+    np.testing.assert_allclose(np.asarray(out_det), np.asarray(base), atol=1e-6)
+
+
+def test_flash_dropout_gradients_check(rng):
+    """The Pallas backward regenerates the identical dropout mask from the
+    (seed, position) hash: reverse-mode grads must match finite differences
+    (the mask is locally constant, so f is differentiable at the check
+    point)."""
+    from jax.test_util import check_grads
+
+    q, k, v = _qkv(rng, b=1, h=1, l=16, d=8)
+    bias = _mask_bias(rng, b=1, l=16)
+    key = jax.random.key(3)
+
+    def f(q, k, v, bias):
+        return flash_attention(
+            q, k, v, bias, dropout_rate=0.3, dropout_rng=key,
+            deterministic=False, block_q=8, block_k=8,
+        ).sum()
+
+    check_grads(f, (q, k, v, bias), order=1, modes=["rev"], atol=2e-2, rtol=2e-2)
+
+
+def test_flash_pallas_backward_matches_dot_large_blocks(rng):
+    """Grad parity on a multi-block case (several q and k blocks per head),
+    including the key-bias gradient."""
+    q, k, v = _qkv(rng, b=2, h=2, l=64, d=16)
+    bias = _mask_bias(rng, b=2, l=64)
+
+    def loss(fn):
+        def inner(q, k, v, bias):
+            return (fn(q, k, v, bias) * 0.37).sum()
+        return inner
+
+    flash_fn = loss(lambda *a: flash_attention(*a, block_q=16, block_k=16))
+    dot_fn = loss(dot_product_attention)
+    g_flash = jax.grad(flash_fn, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    g_dot = jax.grad(dot_fn, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for a, b in zip(g_flash, g_dot):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
