@@ -248,7 +248,16 @@ def cmd_federated(args) -> int:
         # this DP config; a resumed checkpoint's earlier rounds may have
         # been trained without noise, so the guarantee must not cover them.
         dp_rounds = cfg.fed.rounds - start_round
-        eps = dp_epsilon(dp_rounds, cfg.fed.dp_noise_multiplier, 1e-5)
+        # participation < 1: the subsampled-Gaussian accountant credits
+        # privacy amplification (parallel/dp.py::sgm_rdp). The rate is the
+        # EFFECTIVE cohort_size/C, not the nominal fraction — ceil rounding
+        # can sample a much larger cohort than the flag says.
+        eps = dp_epsilon(
+            dp_rounds,
+            cfg.fed.dp_noise_multiplier,
+            1e-5,
+            sampling_rate=cfg.fed.effective_participation(),
+        )
         caveat = (
             ""
             if start_round == 0

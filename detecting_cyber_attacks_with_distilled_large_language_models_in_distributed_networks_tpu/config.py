@@ -273,6 +273,28 @@ class FedConfig:
             return self.dp_clip == 0.0
         return self.weighted
 
+    def cohort_size(self) -> int:
+        """Clients sampled per round. ceil keeps k >= C * participation
+        (round() could land below min_client_fraction via banker's
+        rounding) — the SINGLE source of truth shared by the sampler
+        (participation_mask) and the DP accountant's effective rate."""
+        import math
+
+        if self.participation >= 1.0:
+            return self.num_clients
+        return min(
+            self.num_clients,
+            max(1, math.ceil(self.num_clients * self.participation)),
+        )
+
+    def effective_participation(self) -> float:
+        """The ACTUAL per-round sampling rate ``cohort_size / C`` — what
+        the DP accountant must see: ceil rounding makes it >= the nominal
+        ``participation`` (e.g. 0.26 of 4 clients samples 2/4 = 0.5), and
+        feeding the accountant the nominal fraction would overstate the
+        privacy guarantee."""
+        return self.cohort_size() / self.num_clients
+
     def __post_init__(self) -> None:
         if not 0.0 < self.participation <= 1.0:
             raise ValueError(

@@ -29,10 +29,14 @@ to an all-reduce on ICI exactly like plain FedAvg (parallel/fedavg.py),
 with the noise generated on device from a replicated key.
 
 ``dp_epsilon`` converts (rounds, noise_multiplier) into an (epsilon, delta)
-guarantee by Renyi-DP composition of the Gaussian mechanism. The bound
-assumes full participation every round; partial participation
-(FedConfig.participation < 1) only amplifies privacy, so the reported
-epsilon stays a valid upper bound.
+guarantee by Renyi-DP composition. With full participation it composes the
+plain Gaussian mechanism; with ``sampling_rate < 1`` it uses the
+subsampled-Gaussian-mechanism RDP bound (Mironov, Talwar & Zhang 2019,
+integer orders), which is the privacy-amplification-tight accountant —
+the plain bound stays valid under subsampling but wastes the
+amplification exactly where small-cohort DP needs it. Caveat: the SGM
+bound assumes Poisson sampling; ``participation_mask`` samples a fixed-
+size cohort, for which q = cohort/C is the standard approximation.
 """
 
 from __future__ import annotations
@@ -143,36 +147,91 @@ DEFAULT_RDP_ORDERS: tuple[float, ...] = tuple(
 )
 
 
+def sgm_rdp(alpha: int, q: float, sigma: float) -> float:
+    """RDP of one subsampled-Gaussian-mechanism step at INTEGER order
+    ``alpha >= 2`` (Mironov, Talwar & Zhang 2019, eq. for integer orders):
+
+        RDP(alpha) = log( sum_{k=0..alpha} C(alpha,k) (1-q)^(alpha-k) q^k
+                          * exp(k (k-1) / (2 sigma^2)) ) / (alpha - 1)
+
+    Computed in log space (the exp(k(k-1)/2sigma^2) terms overflow float64
+    near alpha ~ sigma * 50)."""
+    if not (isinstance(alpha, int) or float(alpha).is_integer()) or alpha < 2:
+        raise ValueError(f"sgm_rdp needs an integer order >= 2, got {alpha}")
+    alpha = int(alpha)
+    if not 0.0 < q <= 1.0:
+        raise ValueError(f"sampling rate q={q} must be in (0, 1]")
+    if q == 1.0:
+        return alpha / (2.0 * sigma**2)
+    log_terms = []
+    log_q, log_1q = math.log(q), math.log1p(-q)
+    for k in range(alpha + 1):
+        log_terms.append(
+            math.lgamma(alpha + 1)
+            - math.lgamma(k + 1)
+            - math.lgamma(alpha - k + 1)
+            + (alpha - k) * log_1q
+            + k * log_q
+            + k * (k - 1) / (2.0 * sigma**2)
+        )
+    m = max(log_terms)
+    log_sum = m + math.log(sum(math.exp(t - m) for t in log_terms))
+    return log_sum / (alpha - 1)
+
+
 def dp_epsilon(
     rounds: int,
     noise_multiplier: float,
     delta: float,
     orders: Sequence[float] = DEFAULT_RDP_ORDERS,
+    *,
+    sampling_rate: float = 1.0,
 ) -> float:
-    """(epsilon, delta)-DP after ``rounds`` adaptive compositions of the
-    Gaussian mechanism with the given noise multiplier, via Renyi DP:
-    the mechanism is (alpha, alpha / (2 sigma^2))-RDP, RDP composes
-    additively over rounds, and conversion to approximate DP takes the
-    minimum of ``R * alpha / (2 sigma^2) + log(1/delta) / (alpha - 1)``
-    over orders alpha > 1.
+    """(epsilon, delta)-DP after ``rounds`` adaptive compositions, via
+    Renyi DP: per-step RDP at order alpha composes additively over rounds,
+    and conversion to approximate DP takes the minimum of
+    ``R * RDP(alpha) + log(1/delta) / (alpha - 1)`` over orders.
+
+    ``sampling_rate=1`` (full participation): the Gaussian mechanism is
+    (alpha, alpha / (2 sigma^2))-RDP at every real order. With
+    ``sampling_rate < 1`` (partial participation, FedConfig.participation)
+    the subsampled-Gaussian bound applies at integer orders >= 2
+    (:func:`sgm_rdp`) — privacy amplification by subsampling, the tight
+    accounting for small cohorts.
 
     Client-level guarantee (the clipped unit is one client's whole round
-    update). Full participation assumed; subsampling only improves it.
+    update). Fixed-size cohorts are accounted as Poisson sampling with
+    q = participation (the standard approximation).
     """
     if rounds < 0:
         raise ValueError(f"rounds={rounds} must be >= 0")
     if not 0.0 < delta < 1.0:
         raise ValueError(f"delta={delta} must be in (0, 1)")
+    if not 0.0 < sampling_rate <= 1.0:
+        raise ValueError(f"sampling_rate={sampling_rate} must be in (0, 1]")
     if noise_multiplier <= 0.0:
         return math.inf
     if rounds == 0:
         return 0.0
+    log_delta_inv = math.log(1.0 / delta)
     best = math.inf
+    # The full-participation Gaussian bound stays valid under subsampling
+    # (removing clients from a round never weakens privacy) and holds at
+    # every REAL order — it wins when the optimal order is fractional
+    # (< 2), where the integer-order SGM bound cannot go.
     for a in orders:
         if a <= 1.0:
             continue
-        eps = rounds * a / (2.0 * noise_multiplier**2) + math.log(1.0 / delta) / (
+        eps = rounds * a / (2.0 * noise_multiplier**2) + log_delta_inv / (
             a - 1.0
         )
+        best = min(best, eps)
+    if sampling_rate == 1.0:
+        return best
+    for a in orders:
+        if a < 2.0 or not float(a).is_integer():
+            continue
+        eps = rounds * sgm_rdp(int(a), sampling_rate, noise_multiplier)
+        eps += log_delta_inv / (a - 1.0)
         best = min(best, eps)
     return best

@@ -816,13 +816,12 @@ class FederatedTrainer:
         """Per-round participant sampling (FedConfig.participation < 1):
         a seeded 0/1 mask over clients, identical on every host. None when
         everyone participates (the reference's behavior)."""
-        frac = self.cfg.fed.participation
-        if frac >= 1.0:
+        if self.cfg.fed.participation >= 1.0:
             return None
-        # ceil keeps k >= C*frac >= C*min_client_fraction, so the sampled
-        # round always passes aggregate()'s survivor check (round() could
-        # land below it via banker's rounding, e.g. round(2.5) == 2).
-        k = min(self.C, max(1, int(np.ceil(self.C * frac))))
+        # FedConfig.cohort_size is the single source of truth for k — the
+        # DP accountant derives its effective sampling rate from the same
+        # number (ceil keeps the sampled round above min_client_fraction).
+        k = self.cfg.fed.cohort_size()
         rng = np.random.default_rng(self.cfg.train.seed * 7919 + round_index)
         mask = np.zeros(self.C, np.float64)
         mask[rng.choice(self.C, size=k, replace=False)] = 1.0

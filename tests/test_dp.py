@@ -317,3 +317,127 @@ def test_trainer_without_dp_has_no_anchor(eight_devices):
     trainer = FederatedTrainer(cfg, mesh=mesh)
     state = trainer.init_state(seed=0)
     assert trainer.round_anchor(state) is None
+
+
+def test_sgm_rdp_alpha2_closed_form():
+    """Integer-order SGM RDP at alpha=2 has the exact closed form
+    RDP(2) = log(1 + q^2 (e^(1/sigma^2) - 1)); the log-space series must
+    reproduce it across (q, sigma)."""
+    import math
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.parallel.dp import (
+        sgm_rdp,
+    )
+
+    for q in (0.01, 0.1, 0.5, 0.9):
+        for sigma in (0.5, 1.0, 2.0, 5.0):
+            want = math.log(1.0 + q * q * (math.exp(1.0 / sigma**2) - 1.0))
+            assert abs(sgm_rdp(2, q, sigma) - want) < 1e-12, (q, sigma)
+    # q=1 collapses to the plain Gaussian RDP alpha/(2 sigma^2).
+    assert abs(sgm_rdp(7, 1.0, 1.3) - 7 / (2 * 1.3**2)) < 1e-12
+    # Large alpha must not overflow (log-space evaluation).
+    assert sgm_rdp(511, 0.05, 1.0) < float("inf")
+    with pytest.raises(ValueError, match="integer order"):
+        sgm_rdp(1, 0.1, 1.0)
+
+
+def test_dp_epsilon_subsampling_amplification():
+    """The subsampled accountant must (a) reduce to the full bound at q=1,
+    (b) beat it strictly for q < 1 (privacy amplification), (c) stay
+    monotone in q, T, and 1/sigma, and (d) vanish as q -> 0."""
+    full = dp_epsilon(100, 1.0, 1e-5)
+    at_q1 = dp_epsilon(100, 1.0, 1e-5, sampling_rate=1.0)
+    assert at_q1 == full
+    # Integer orders only for q<1: in regimes where the optimal order is
+    # >= 2 (here sigma=4 -> alpha* ~ 3), q ~ 1 lands within a whisker of
+    # the full bound. (At sigma=1/T=100 the optimal order is fractional
+    # ~1.5, where the integer-order SGM bound is inherently ~14% looser.)
+    full4 = dp_epsilon(100, 4.0, 1e-5)
+    near = dp_epsilon(100, 4.0, 1e-5, sampling_rate=0.999999)
+    assert abs(near - full4) / full4 < 0.05
+    sub = dp_epsilon(100, 1.0, 1e-5, sampling_rate=0.1)
+    assert sub < 0.5 * full  # amplification is large at q=0.1
+    assert dp_epsilon(100, 1.0, 1e-5, sampling_rate=0.01) < sub
+    assert dp_epsilon(200, 1.0, 1e-5, sampling_rate=0.1) > sub  # more rounds
+    assert dp_epsilon(100, 2.0, 1e-5, sampling_rate=0.1) < sub  # more noise
+    # q -> 0: amplification drives epsilon far below the full bound (the
+    # log(1/delta)/(alpha-1) conversion term floors it near ~0.7 here).
+    assert dp_epsilon(100, 1.0, 1e-5, sampling_rate=1e-4) < 0.01 * full
+    with pytest.raises(ValueError, match="sampling_rate"):
+        dp_epsilon(10, 1.0, 1e-5, sampling_rate=0.0)
+
+
+def test_sgm_rdp_matches_independent_series():
+    """Cross-check the log-space series against a direct float evaluation
+    in a regime where the direct sum cannot overflow."""
+    import math
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.parallel.dp import (
+        sgm_rdp,
+    )
+
+    q, sigma = 0.2, 2.0
+    for alpha in (2, 3, 5, 8, 16):
+        direct = sum(
+            math.comb(alpha, k)
+            * (1 - q) ** (alpha - k)
+            * q**k
+            * math.exp(k * (k - 1) / (2 * sigma**2))
+            for k in range(alpha + 1)
+        )
+        want = math.log(direct) / (alpha - 1)
+        assert abs(sgm_rdp(alpha, q, sigma) - want) < 1e-12, alpha
+
+
+def test_dp_epsilon_never_worse_than_full_bound():
+    """q < 1 must never report a LARGER epsilon than full participation
+    (the full Gaussian bound stays valid under subsampling and covers the
+    fractional-order regime the integer-order SGM bound cannot reach)."""
+    for sigma in (0.7, 1.0, 4.0):
+        full = dp_epsilon(100, sigma, 1e-5)
+        for q in (0.9, 0.99, 0.999999):
+            assert dp_epsilon(100, sigma, 1e-5, sampling_rate=q) <= full
+
+
+def test_effective_participation_feeds_accountant():
+    """ceil-rounded cohorts: --participation 0.26 of 4 clients samples 2
+    (q=0.5); the accountant and the sampler must agree on that rate."""
+    import numpy as np
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        FedConfig,
+    )
+
+    fed = FedConfig(
+        num_clients=4, participation=0.26, min_client_fraction=0.25
+    )
+    assert fed.cohort_size() == 2
+    assert fed.effective_participation() == 0.5
+    assert FedConfig(num_clients=4).effective_participation() == 1.0
+    # The sampler draws exactly cohort_size clients.
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.federated import (
+        FederatedTrainer,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        MeshConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+
+    cfg = ExperimentConfig(
+        model=ModelConfig.tiny(),
+        data=DataConfig(max_len=ModelConfig.tiny().max_len),
+        train=TrainConfig(),
+        fed=fed,
+        mesh=MeshConfig(clients=4, data=1),
+    )
+    t = FederatedTrainer(cfg)
+    mask = t.participation_mask(0)
+    assert mask is not None and int(np.asarray(mask).sum()) == 2
+    # Overstating privacy: nominal 0.26 would claim a tighter epsilon than
+    # the executed q=0.5 run actually provides.
+    assert dp_epsilon(50, 1.0, 1e-5, sampling_rate=0.26) < dp_epsilon(
+        50, 1.0, 1e-5, sampling_rate=0.5
+    )
