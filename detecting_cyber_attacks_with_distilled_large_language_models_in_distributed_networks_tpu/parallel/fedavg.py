@@ -29,6 +29,16 @@ import jax.numpy as jnp
 from .mesh import FedShardings
 
 
+def stack_params(params: Any, num_clients: int) -> Any:
+    """Single-model params -> the ``[C, ...]`` stacked layout (every row
+    identical — the reference's shared pretrained start, client1.py:56).
+    The one definition of the per-client leading axis, shared by the
+    federated trainer, the fedseq composition, and tests."""
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (num_clients, *x.shape)), params
+    )
+
+
 def weighted_mean(
     stacked_params: Any,
     weights: jnp.ndarray | None = None,

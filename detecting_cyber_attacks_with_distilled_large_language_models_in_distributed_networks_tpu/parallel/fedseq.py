@@ -1,0 +1,170 @@
+"""Federated long-context training: one ``clients × data × seq`` mesh.
+
+Composes the two parallelism stories that previously ran separately:
+
+* the federated axis — stacked ``[C, ...]`` per-client params sharded over
+  ``clients``, FedAvg as a collective (parallel/fedavg.py);
+* sequence parallelism — the encoder forward runs inside ``shard_map``
+  with the sequence dimension sharded over ``seq``, ring attention
+  rotating K/V chunks by ``ppermute`` (parallel/ring_attention.py), plus
+  per-client batch parallelism over ``data``.
+
+Layout of one train step for batch ``[C, B, L]``:
+
+* ``input_ids`` / ``attention_mask``: ``P('clients', 'data', 'seq')`` —
+  every device holds one client's batch-shard of one sequence chunk;
+* ``labels``: ``P('clients', 'data')``;
+* params / optimizer state: ``P('clients')`` (replicated over data+seq).
+
+The loss runs under ONE ``shard_map`` over all three axes: a local vmap
+covers the device's client replicas, the model's ring path handles
+shard-offset position embeddings and global-CLS pooling over ``seq``, and
+a ``pmean`` over ``data`` merges batch shards. Autodiff is taken OUTSIDE
+the shard_map (shard_map is transparent to it), so the ppermute ring's
+reverse path and the data-axis gradient reduction come out correct by
+construction instead of by hand-placed collectives.
+
+The reference has neither axis (three laptop processes, L=128,
+client1.py:27); this is the framework's "long sequences on a federated
+fleet" scaling story (SURVEY.md §5 long-context + §2.11 comm backend).
+
+Dropout note: the step runs the model deterministically — per-(client,
+seq-shard) dropout-key plumbing through shard_map is future work; the
+head/FFN/attention dropouts are off in this path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..train.engine import apply_warmup
+from .fedavg import stack_params
+
+
+def make_fedseq_loss(
+    model,
+    mesh: Mesh,
+    *,
+    clients_axis: str = "clients",
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+) -> Callable:
+    """``(stacked_params, ids [C,B,L], mask [C,B,L], labels [C,B]) -> [C]``
+    per-client mean losses, computed sequence- and batch-parallel. The
+    model must be built with ``attention_impl="ring"`` and
+    ``ring_axis=seq_axis``."""
+
+    def local_losses(params_l, ids_l, mask_l, labels_l):
+        def one(p, ids, mask, labels):
+            logits = model.apply({"params": p}, ids, mask, True)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            ).mean()
+
+        losses = jax.vmap(one)(params_l, ids_l, mask_l, labels_l)  # [C_l]
+        # Merge batch shards: each data instance saw B/data rows.
+        return jax.lax.pmean(losses, data_axis)
+
+    batch_spec = P(clients_axis, data_axis, seq_axis)
+    return jax.shard_map(
+        local_losses,
+        mesh=mesh,
+        in_specs=(
+            P(clients_axis),
+            batch_spec,
+            batch_spec,
+            P(clients_axis, data_axis),
+        ),
+        out_specs=P(clients_axis),
+    )
+
+
+def make_fedseq_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    warmup_steps: int = 0,
+    clients_axis: str = "clients",
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+) -> Callable:
+    """Jitted ``(stacked_params, stacked_opt_state, step, batch) ->
+    (params, opt_state, losses [C])`` — one lockstep local step for every
+    client, sequence-parallel inside, donated buffers."""
+    loss_fn = make_fedseq_loss(
+        model,
+        mesh,
+        clients_axis=clients_axis,
+        data_axis=data_axis,
+        seq_axis=seq_axis,
+    )
+    csh = NamedSharding(mesh, P(clients_axis))
+    batch_sh = NamedSharding(mesh, P(clients_axis, data_axis, seq_axis))
+    labels_sh = NamedSharding(mesh, P(clients_axis, data_axis))
+
+    @partial(
+        jax.jit,
+        donate_argnums=(0, 1),
+        in_shardings=(
+            csh,
+            csh,
+            None,
+            {
+                "input_ids": batch_sh,
+                "attention_mask": batch_sh,
+                "labels": labels_sh,
+            },
+        ),
+        out_shardings=(csh, csh, None),
+    )
+    def step(stacked_params, opt_state, step_idx, batch):
+        def total(p):
+            losses = loss_fn(
+                p,
+                batch["input_ids"],
+                batch["attention_mask"],
+                batch["labels"],
+            )
+            # Clients are independent: d(sum)/d(params[c]) touches only
+            # client c's row, so one grad call yields every per-client grad.
+            return losses.sum(), losses
+
+        (_, losses), grads = jax.value_and_grad(total, has_aux=True)(
+            stacked_params
+        )
+        updates, opt_state = jax.vmap(optimizer.update)(
+            grads, opt_state, stacked_params
+        )
+        updates = apply_warmup(updates, step_idx, warmup_steps)
+        params = optax.apply_updates(stacked_params, updates)
+        return params, opt_state, losses
+
+    return step
+
+
+def init_fedseq_state(
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    params: Any,
+    num_clients: int,
+    *,
+    clients_axis: str = "clients",
+) -> tuple[Any, Any]:
+    """Stack single-model ``params`` into the ``[C, ...]`` clients-sharded
+    layout (every client starts identical — the reference's shared
+    pretrained start, client1.py:56) plus matching optimizer state."""
+    csh = NamedSharding(mesh, P(clients_axis))
+    stacked = jax.device_put(stack_params(params, num_clients), csh)
+    opt_state = jax.jit(
+        lambda p: jax.vmap(optimizer.init)(p),
+        in_shardings=(csh,),
+        out_shardings=csh,
+    )(stacked)
+    return stacked, opt_state

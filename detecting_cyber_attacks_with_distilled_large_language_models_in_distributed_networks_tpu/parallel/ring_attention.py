@@ -100,12 +100,17 @@ def ring_attention(
     l0 = jnp.zeros((b_sz, h, lq), jnp.float32)
 
     # Constants enter the scan carry device-invariant but come out varying
-    # over the ring axis; mark them varying up front so the carry types
-    # match (inputs like a shard_map-bound bias are already varying).
+    # over every mesh axis q varies over (the ring axis alone inside a pure
+    # seq shard_map; clients/data too inside the 3-axis fedseq composition);
+    # mark them varying up front so the scan carry types match.
+    want_vma = tuple(getattr(jax.typeof(q), "vma", ()))
+
     def _vary(x):
-        if axis_name in getattr(jax.typeof(x), "vma", ()):
+        have = getattr(jax.typeof(x), "vma", ())
+        missing = tuple(a for a in want_vma if a not in have)
+        if not missing:
             return x
-        return jax.lax.pcast(x, (axis_name,), to="varying")
+        return jax.lax.pcast(x, missing, to="varying")
 
     acc0, m0, l0 = jax.tree.map(_vary, (acc0, m0, l0))
     b0 = bias if has_bias else ()  # empty pytree: nothing rotates when no mask

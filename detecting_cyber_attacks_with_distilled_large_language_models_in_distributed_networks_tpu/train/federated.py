@@ -36,7 +36,7 @@ from ..config import ExperimentConfig
 from ..data.pipeline import StackedClients, TokenizedSplit, pad_split_to_batch
 from ..models.distilbert import DDoSClassifier, init_params
 from ..ops.metrics import BinaryCounts, finalize_metrics
-from ..parallel.fedavg import make_fedavg_step
+from ..parallel.fedavg import make_fedavg_step, stack_params
 from ..parallel.mesh import FedShardings, make_mesh
 from ..train.engine import (
     apply_warmup,
@@ -518,10 +518,7 @@ class FederatedTrainer:
         )
         if self.P == 1:
             stacked_params = jax.device_put(
-                jax.tree.map(
-                    lambda x: jnp.broadcast_to(x[None], (C, *x.shape)), params
-                ),
-                self.sh.client,
+                stack_params(params, C), self.sh.client
             )
         else:
             # Every process computed identical params from the same seed

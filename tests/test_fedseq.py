@@ -1,0 +1,156 @@
+"""clients × data × seq composition: federated long-context training on
+one 3-axis mesh must match N independent unsharded programs + FedAvg."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+    ModelConfig,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.models.distilbert import (
+    DDoSClassifier,
+    init_params,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.parallel.fedavg import (
+    fedavg,
+    stack_params,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.parallel.fedseq import (
+    init_fedseq_state,
+    make_fedseq_loss,
+    make_fedseq_train_step,
+)
+
+C, B, L = 2, 4, 64
+
+
+@pytest.fixture(scope="module")
+def mesh3(eight_devices):
+    return Mesh(
+        np.array(eight_devices[:8]).reshape(2, 2, 2),
+        ("clients", "data", "seq"),
+    )
+
+
+def _cfgs():
+    base = ModelConfig.tiny(
+        attention_dropout=0.0, max_len=L, max_position_embeddings=L
+    )
+    return base, base.replace(attention_impl="ring", ring_axis="seq")
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    base, _ = _cfgs()
+    ids = rng.integers(0, base.vocab_size, (C, B, L)).astype(np.int32)
+    mask = (rng.random((C, B, L)) > 0.3).astype(np.int32)
+    mask[:, :, 0] = 1  # CLS always visible
+    labels = rng.integers(0, 2, (C, B)).astype(np.int32)
+    return jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(labels)
+
+
+def test_fedseq_loss_matches_unsharded(mesh3):
+    base, ring = _cfgs()
+    model_dot = DDoSClassifier(base)
+    model_ring = DDoSClassifier(ring)
+    params = init_params(model_dot, base, jax.random.key(0))
+    stacked = stack_params(params, C)
+    ids, mask, labels = _data()
+
+    loss_fn = make_fedseq_loss(model_ring, mesh3)
+    got = np.asarray(loss_fn(stacked, ids, mask, labels))
+
+    want = np.array(
+        [
+            float(
+                optax.softmax_cross_entropy_with_integer_labels(
+                    model_dot.apply({"params": params}, ids[c], mask[c], True),
+                    labels[c],
+                ).mean()
+            )
+            for c in range(C)
+        ]
+    )
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_fedseq_grads_match_unsharded(mesh3):
+    """VERDICT-5 'done' criterion: grad parity of the 2-client x 2-seq-shard
+    (x 2 data shards) stacked program vs the unsharded per-client program."""
+    base, ring = _cfgs()
+    model_dot = DDoSClassifier(base)
+    model_ring = DDoSClassifier(ring)
+    params = init_params(model_dot, base, jax.random.key(0))
+    stacked = stack_params(params, C)
+    ids, mask, labels = _data()
+    loss_fn = make_fedseq_loss(model_ring, mesh3)
+
+    g_stacked = jax.grad(
+        lambda p: loss_fn(p, ids, mask, labels).sum()
+    )(stacked)
+
+    for c in range(C):
+        g_solo = jax.grad(
+            lambda p: optax.softmax_cross_entropy_with_integer_labels(
+                model_dot.apply({"params": p}, ids[c], mask[c], True),
+                labels[c],
+            ).mean()
+        )(params)
+        for a, b in zip(jax.tree.leaves(g_stacked), jax.tree.leaves(g_solo)):
+            np.testing.assert_allclose(
+                np.asarray(a)[c], np.asarray(b), atol=5e-4
+            )
+
+
+def test_fedseq_train_step_and_fedavg(mesh3):
+    """One lockstep train step over the 3-axis mesh matches per-client Adam
+    on the unsharded program; FedAvg then replicates the mean."""
+    base, ring = _cfgs()
+    model_dot = DDoSClassifier(base)
+    model_ring = DDoSClassifier(ring)
+    params = init_params(model_dot, base, jax.random.key(0))
+    opt = optax.adam(1e-3)
+    stacked, opt_state = init_fedseq_state(opt, mesh3, params, C)
+    ids, mask, labels = _data()
+
+    step = make_fedseq_train_step(model_ring, opt, mesh3)
+    new_stacked, opt_state, losses = step(
+        stacked, opt_state, jnp.int32(0),
+        {"input_ids": ids, "attention_mask": mask, "labels": labels},
+    )
+    assert losses.shape == (C,)
+
+    # Manual per-client Adam on the unsharded program.
+    manual = []
+    for c in range(C):
+        g = jax.grad(
+            lambda p: optax.softmax_cross_entropy_with_integer_labels(
+                model_dot.apply({"params": p}, ids[c], mask[c], True),
+                labels[c],
+            ).mean()
+        )(params)
+        u, _ = opt.update(g, opt.init(params), params)
+        manual.append(optax.apply_updates(params, u))
+    for a, m0, m1 in zip(
+        jax.tree.leaves(new_stacked),
+        jax.tree.leaves(manual[0]),
+        jax.tree.leaves(manual[1]),
+    ):
+        a = np.asarray(a)
+        np.testing.assert_allclose(a[0], np.asarray(m0), atol=1e-5)
+        np.testing.assert_allclose(a[1], np.asarray(m1), atol=1e-5)
+
+    # FedAvg across the clients axis of the 3-axis mesh.
+    agg = fedavg(new_stacked)
+    leaf = np.asarray(jax.tree.leaves(agg)[0])
+    np.testing.assert_allclose(leaf[0], leaf[1], atol=1e-6)
+    want = 0.5 * (
+        np.asarray(jax.tree.leaves(manual[0])[0])
+        + np.asarray(jax.tree.leaves(manual[1])[0])
+    )
+    np.testing.assert_allclose(leaf[0], want, atol=1e-5)
