@@ -10,7 +10,6 @@ import numpy as np
 from ..utils.logging import get_logger, phase
 from .common import (
     _load_client_splits,
-    _load_clients,
     _resolve_with_pretrained,
     _write_reports,
 )
@@ -82,15 +81,26 @@ def cmd_federated(args) -> int:
         )
 
     if getattr(args, "stream", False):
-        if local_sl is not None:
-            raise SystemExit(
-                "--stream is single-host for now (multi-host feeds need "
-                "per-host client slicing of the streamed plan)"
+        if not getattr(args, "csv", None):
+            raise SystemExit("--stream needs --csv (chunked two-pass reader)")
+        from ..data import stream_client_tokens_for
+
+        # Works multi-host: every process computes the identical global
+        # plan (same label scan), materializes tokens only for ITS clients,
+        # and learns every client's split sizes for the stacked shapes and
+        # FedAvg weights.
+        stream_ids = (
+            list(range(C))
+            if local_sl is None
+            else list(range(local_sl.start, local_sl.stop))
+        )
+        with phase(f"streaming {args.csv} for clients {stream_ids}", tag="DATA"):
+            clients, sizes = stream_client_tokens_for(
+                args.csv, cfg.data, C, tok, stream_ids, max_len=cfg.model.max_len
             )
-        clients = _load_clients(args, cfg, tok, C)
-        eval_rows_global = max(len(c.test) for c in clients)
-        val_rows_global = max(len(c.val) for c in clients)
-        train_sizes = [len(c.train) for c in clients]
+        train_sizes = [s["train"] for s in sizes]
+        eval_rows_global = max(s["test"] for s in sizes)
+        val_rows_global = max(s["val"] for s in sizes)
     else:
         # Partitioning runs over the full fleet on every host (it must be
         # globally consistent); tokenization — the host-side hot loop — runs
@@ -274,13 +284,14 @@ def cmd_federated(args) -> int:
             f"{caveat}"
         )
 
-    # Final reporting with probs for ROC/PR curves. Under multi-host the
-    # per-example probs live on their owning hosts; the metric counts are
-    # replicated everywhere, so process 0 writes prob-free reports for all.
+    # Final reporting with probs for ROC/PR curves. Under multi-host,
+    # evaluate_clients gathers every client's probs/labels process-major
+    # (device replication + host allgather), so process 0 writes the FULL
+    # artifact set — ROC/PR included — for all clients.
     final_local = history[-1][1] if history else None
     multihost = jax.process_count() > 1
     final_agg = trainer.evaluate_clients(
-        state.params, prepared=prepared, collect_probs=not multihost
+        state.params, prepared=prepared, collect_probs=True
     )
     if not multihost or jax.process_index() == 0:
         if final_local is None:

@@ -41,7 +41,10 @@ from .tokenizer import (  # noqa: F401
     build_domain_vocab,
     default_tokenizer,
 )
-from .streaming import stream_client_tokens  # noqa: F401
+from .streaming import (  # noqa: F401
+    stream_client_tokens,
+    stream_client_tokens_for,
+)
 from .pipeline import (  # noqa: F401
     TokenizedClient,
     TokenizedSplit,

@@ -153,29 +153,48 @@ def _client_split_indices(
     return out
 
 
-def stream_client_tokens(
+def stream_client_tokens_for(
     path: str,
     cfg: DataConfig,
     num_clients: int,
     tok: WordPieceTokenizer,
+    client_ids: list[int],
     *,
     max_len: int | None = None,
     chunk_rows: int = 100_000,
-) -> list[TokenizedClient]:
-    """Streamed equivalent of ``make_all_client_splits`` + ``tokenize_client``
-    for the index-based partition schemes; peak memory is the output arrays
-    plus the destination index plus one chunk of the CSV."""
+) -> tuple[list[TokenizedClient], list[dict[str, int]]]:
+    """Streamed tokenization for a SUBSET of the fleet's clients, plus the
+    GLOBAL per-client split sizes.
+
+    The partition plan always covers all ``num_clients`` (it must be
+    globally consistent — under multi-host every process computes the
+    identical plan from the identical label scan), but token arrays are
+    materialized only for ``client_ids``: each host streams its own pass
+    over the CSV and pays memory only for its own clients. Returns
+    ``(tokenized clients in client_ids order,
+    [{"train": n, "val": n, "test": n} for every global client])``."""
     max_len = cfg.max_len if max_len is None else max_len
+    wanted = list(client_ids)
+    # Validate BEFORE the full-file scan: a bad id must fail instantly,
+    # not after minutes of I/O on a multi-GB CSV.
+    bad = [c for c in wanted if not 0 <= c < num_clients]
+    if bad:
+        raise ValueError(f"client_ids {bad} outside [0, {num_clients})")
     spec = get_dataset(cfg.dataset)
     scan = _scan(path, spec, cfg, chunk_rows)
     plans = _client_split_indices(scan.labels, num_clients, cfg)
+    sizes = [
+        {name: int(len(plan[name])) for name in _SPLIT_NAMES} for plan in plans
+    ]
 
-    # Destination arrays (allocated up front) + a flat, row-sorted index:
-    # (global_row, client, split, position) in parallel numpy arrays — a
-    # row may land in several destinations under the 'sample' scheme.
+    # Destination arrays (allocated up front, LOCAL clients only) + a flat,
+    # row-sorted index: (global_row, local_client, split, position) in
+    # parallel numpy arrays — a row may land in several destinations under
+    # the 'sample' scheme.
     dest: list[dict[str, TokenizedSplit]] = []
     rows_l, client_l, split_l, pos_l = [], [], [], []
-    for cid, plan in enumerate(plans):
+    for local, cid in enumerate(wanted):
+        plan = plans[cid]
         splits = {}
         for sid, name in enumerate(_SPLIT_NAMES):
             rows = plan[name]
@@ -186,16 +205,16 @@ def stream_client_tokens(
                 scan.labels[rows].astype(np.int32),
             )
             rows_l.append(rows.astype(np.int64))
-            client_l.append(np.full(m, cid, np.int32))
+            client_l.append(np.full(m, local, np.int32))
             split_l.append(np.full(m, sid, np.int8))
             pos_l.append(np.arange(m, dtype=np.int64))
         dest.append(splits)
     rows_all = np.concatenate(rows_l) if rows_l else np.zeros(0, np.int64)
     order = np.argsort(rows_all, kind="stable")
     rows_all = rows_all[order]
-    client_all = np.concatenate(client_l)[order]
-    split_all = np.concatenate(split_l)[order]
-    pos_all = np.concatenate(pos_l)[order]
+    client_all = np.concatenate(client_l)[order] if rows_l else np.zeros(0, np.int32)
+    split_all = np.concatenate(split_l)[order] if rows_l else np.zeros(0, np.int8)
+    pos_all = np.concatenate(pos_l)[order] if rows_l else np.zeros(0, np.int64)
 
     dtype_spec = {c: np.float64 for c in scan.float_cols}
     row_base = 0
@@ -216,7 +235,32 @@ def stream_client_tokens(
                 split.attention_mask[p] = enc["attention_mask"][src]
         row_base += len(chunk)
 
-    return [
+    clients = [
         TokenizedClient(cid, d["train"], d["val"], d["test"])
-        for cid, d in enumerate(dest)
+        for cid, d in zip(wanted, dest)
     ]
+    return clients, sizes
+
+
+def stream_client_tokens(
+    path: str,
+    cfg: DataConfig,
+    num_clients: int,
+    tok: WordPieceTokenizer,
+    *,
+    max_len: int | None = None,
+    chunk_rows: int = 100_000,
+) -> list[TokenizedClient]:
+    """Streamed equivalent of ``make_all_client_splits`` + ``tokenize_client``
+    for the index-based partition schemes; peak memory is the output arrays
+    plus the destination index plus one chunk of the CSV."""
+    clients, _ = stream_client_tokens_for(
+        path,
+        cfg,
+        num_clients,
+        tok,
+        list(range(num_clients)),
+        max_len=max_len,
+        chunk_rows=chunk_rows,
+    )
+    return clients

@@ -194,12 +194,13 @@ def stack_eval_splits(
 
 
 class PreparedEval(NamedTuple):
-    """Stacked eval splits, padded once and reused across rounds."""
+    """Stacked eval splits, padded once and reused across rounds. ROC/PR
+    labels come from the stacked arrays' valid rows (padding appends, so
+    the valid subsequence preserves split order)."""
 
     stacked: TokenizedSplit  # [C, M, ...] arrays, M a batch multiple
     valid: np.ndarray  # [C, M] 0/1
     batch_size: int
-    labels: list[np.ndarray]  # per-client unpadded labels (for ROC/PR)
 
 
 @dataclass
@@ -730,7 +731,7 @@ class FederatedTrainer:
         stacked, valid = stack_eval_splits(
             splits, bs, pad_id=self.pad_id, target_rows=target_rows
         )
-        return PreparedEval(stacked, valid, bs, [s.labels.copy() for s in splits])
+        return PreparedEval(stacked, valid, bs)
 
     def _step_telemetry(self):
         """Shared per-step logging closure (engine.make_step_telemetry)
@@ -767,11 +768,6 @@ class FederatedTrainer:
                 "do not also pass splits/batch_size"
             )
         stacked, valid, bs = prepared.stacked, prepared.valid, prepared.batch_size
-        if self.P > 1 and collect_probs:
-            raise NotImplementedError(
-                "collect_probs under multi-process federation: per-example "
-                "probs live on their owning host; gather them per-host"
-            )
         C = self.C
         M = stacked.labels.shape[1]
         # Accumulate the stacked [C] counts on device; one host sync after
@@ -799,13 +795,35 @@ class FederatedTrainer:
             else BinaryCounts(*(np.zeros(C, np.float32) for _ in BinaryCounts._fields))
         )
         out = []
-        all_probs = np.concatenate([np.asarray(p) for p in probs_dev], axis=1) if probs_dev else None
+        all_probs = None
+        labels_g, valid_g = stacked.labels, valid
+        if probs_dev:
+            # Probs accumulate as GLOBAL [C, bs] device arrays (the eval
+            # step's output sharding); _host replicates across processes
+            # first, so every host sees every client's probabilities.
+            all_probs = np.asarray(
+                self._host(jnp.concatenate(probs_dev, axis=1))
+            )
+            if self.P > 1:
+                # The host-side labels/validity cover only LOCAL clients;
+                # gather them process-major (the global client order).
+                from jax.experimental import multihost_utils
+
+                M_pad = stacked.labels.shape[1]
+                labels_g = np.asarray(
+                    multihost_utils.process_allgather(stacked.labels)
+                ).reshape(-1, M_pad)
+                valid_g = np.asarray(
+                    multihost_utils.process_allgather(valid)
+                ).reshape(-1, M_pad)
         for c in range(C):
             m = finalize_metrics(BinaryCounts(*(v[c] for v in host)))
             if collect_probs and all_probs is not None:
-                mask_c = valid[c, : all_probs.shape[1]] == 1
+                # Padding appends rows, so the valid-row subsequence IS the
+                # original split order (pad_split_to_batch/stack_eval_splits).
+                mask_c = valid_g[c, : all_probs.shape[1]] == 1
                 m["probs"] = all_probs[c][mask_c]
-                m["labels"] = prepared.labels[c].copy()
+                m["labels"] = labels_g[c][mask_c]
             out.append(m)
         return out
 

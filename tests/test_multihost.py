@@ -110,9 +110,13 @@ def test_two_process_federated_cli(tmp_path):
     process feeding its own client, FedAvg over DCN, process 0 reporting."""
     out = tmp_path / "out"
     outputs = _launch_pair(tmp_path, out)
-    # Process 0 wrote the full fleet's reports.
+    # Process 0 wrote the full fleet's reports — INCLUDING the prob-based
+    # ROC/PR artifacts (multi-host probs gather in evaluate_clients).
     for c in range(2):
         assert (out / f"client{c}_aggregated_metrics.csv").exists(), outputs[0][-2000:]
+        plots = {p.name for p in (out / f"client{c}_plots").iterdir()}
+        assert f"client{c}_aggregated_roc.png" in plots, plots
+        assert f"client{c}_aggregated_pr.png" in plots, plots
     # Both processes logged identical (replicated) round metrics.
     def _fed_lines(o):
         return [l for l in o.splitlines() if "aggregated" in l and "round" in l]
@@ -120,6 +124,30 @@ def test_two_process_federated_cli(tmp_path):
     assert _fed_lines(outputs[0]) and (
         _fed_lines(outputs[0]) == _fed_lines(outputs[1])
     )
+
+
+@pytest.mark.slow
+def test_two_process_stream_matches_in_memory(tmp_path):
+    """--stream under multi-host: each process streams only its own
+    client's tokens from the shared CSV; the run's reports must be
+    byte-identical to the in-memory multi-host run (same plan, same
+    tokens, same training)."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+        write_synthetic_csv,
+    )
+
+    csv = tmp_path / "flows.csv"
+    write_synthetic_csv(str(csv), n_rows=400, seed=13)
+    common = ("--csv", str(csv), "--partition", "disjoint")
+    out_mem = tmp_path / "out_mem"
+    _launch_pair(tmp_path, out_mem, common)
+    out_stream = tmp_path / "out_stream"
+    _launch_pair(tmp_path, out_stream, common + ("--stream",))
+    for c in range(2):
+        for kind in ("local", "aggregated"):
+            a = (out_mem / f"client{c}_{kind}_metrics.csv").read_bytes()
+            b = (out_stream / f"client{c}_{kind}_metrics.csv").read_bytes()
+            assert a == b, (c, kind, a, b)
 
 
 @pytest.mark.slow

@@ -128,3 +128,36 @@ def test_streaming_unsw_schema(tmp_path, tok):
     want = _inmemory(str(path), cfg, 2, tok)
     got = stream_client_tokens(str(path), cfg, 2, tok, chunk_rows=50)
     _assert_clients_equal(got, want)
+
+
+def test_stream_subset_matches_full_run(tmp_path, tok):
+    """stream_client_tokens_for materializes only the requested clients but
+    plans globally: the subset's arrays are bit-identical to the full run's,
+    and the returned sizes cover every client (the multi-host contract)."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+        stream_client_tokens_for,
+    )
+
+    csv = str(tmp_path / "flows.csv")
+    write_synthetic_csv(csv, n_rows=400, seed=9)
+    cfg = DataConfig(
+        data_fraction=0.25, max_len=MAX_LEN, partition="disjoint"
+    )
+    full = stream_client_tokens(csv, cfg, 4, tok, max_len=MAX_LEN, chunk_rows=97)
+    subset, sizes = stream_client_tokens_for(
+        csv, cfg, 4, tok, [1, 3], max_len=MAX_LEN, chunk_rows=97
+    )
+    assert [c.client_id for c in subset] == [1, 3]
+    assert len(sizes) == 4
+    for cid, got in zip([1, 3], subset):
+        want = full[cid]
+        for name in ("train", "val", "test"):
+            sa, sb = getattr(got, name), getattr(want, name)
+            np.testing.assert_array_equal(sa.input_ids, sb.input_ids)
+            np.testing.assert_array_equal(sa.attention_mask, sb.attention_mask)
+            np.testing.assert_array_equal(sa.labels, sb.labels)
+    for cid in range(4):
+        for name in ("train", "val", "test"):
+            assert sizes[cid][name] == len(getattr(full[cid], name))
+    with pytest.raises(ValueError, match="client_ids"):
+        stream_client_tokens_for(csv, cfg, 4, tok, [4], max_len=MAX_LEN)
