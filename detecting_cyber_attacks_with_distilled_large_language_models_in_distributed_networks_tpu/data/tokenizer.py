@@ -141,6 +141,37 @@ def build_domain_vocab(
     return vocab
 
 
+def build_reference_scale_vocab(size: int = 30522) -> list[str]:
+    """A deterministic vocab at the reference's REAL scale — 30522 entries,
+    the vocab_size of its required ``./distilbert-base-uncased``
+    (client1.py:56,357 via HF) — for end-to-end exercises of the full
+    embedding table and WordPiece path without network access.
+
+    Layout: the domain vocab first (template words, chars, ##-pieces —
+    flow texts tokenize with zero [UNK]s), then whole-number tokens
+    0..9999 and their ##-continuations (realistic multi-piece numerals),
+    then ``[unusedN]`` filler up to exactly ``size``."""
+    vocab = build_domain_vocab()
+    seen = set(vocab)
+
+    def _add(tok: str) -> None:
+        if tok not in seen and len(vocab) < size:
+            vocab.append(tok)
+            seen.add(tok)
+
+    for n in range(10_000):
+        _add(str(n))
+    for n in range(10_000):
+        _add(f"##{n}")
+    i = 0
+    while len(vocab) < size:
+        _add(f"[unused{i}]")
+        i += 1
+    if len(vocab) != size:
+        raise ValueError(f"vocab overflow: base entries exceed size={size}")
+    return vocab
+
+
 class WordPieceTokenizer:
     """Greedy longest-match WordPiece over a BasicTokenizer pre-split."""
 

@@ -1,0 +1,128 @@
+"""The reference-scale artifact chain (VERDICT round-1 item 7): a
+30522-token vocab + DistilBERT-base encoder through
+local -> federated -> export-hf -> transformers reload -> predict.
+
+The environment has no real pretrained weights (zero egress), so this is
+the closest demonstrable stand-in for the reference's pretrained run (its
+hard-required ./distilbert-base-uncased, client1.py:56,357): the same
+vocab size, the same architecture, the same artifact formats, every hop
+exercised at full scale — only the encoder weights are random."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
+    main,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+    write_synthetic_csv,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.tokenizer import (
+    build_reference_scale_vocab,
+)
+
+transformers = pytest.importorskip("transformers")
+
+
+def test_reference_scale_vocab_layout():
+    vocab = build_reference_scale_vocab()
+    assert len(vocab) == 30522
+    assert vocab[0] == "[PAD]"
+    assert len(set(vocab)) == 30522
+    # Flow templates tokenize with zero UNKs and realistic numerals.
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.tokenizer import (
+        WordPieceTokenizer,
+    )
+
+    tok = WordPieceTokenizer(vocab)
+    ids = tok.encode("Flow bytes per second are 70759.2337. Flow packets per second are 36.2252.")
+    assert tok.unk_id not in ids
+
+
+@pytest.mark.slow
+def test_reference_scale_artifact_chain(tmp_path):
+    """local -> federated -> export-hf -> transformers -> predict, all at
+    DistilBERT-base scale (30522 vocab, 6L/768/12H, 66M params)."""
+    torch = pytest.importorskip("torch")
+
+    # The reference's input artifact: an HF DistilBERT checkpoint dir with
+    # the REAL vocab size (random weights — no egress for the real ones).
+    hf = tmp_path / "distilbert-base"
+    cfg = transformers.DistilBertConfig()  # stock: 30522/768/6L/12H
+    torch.manual_seed(0)
+    transformers.DistilBertModel(cfg).save_pretrained(str(hf))
+    vocab = build_reference_scale_vocab(cfg.vocab_size)
+    (hf / "vocab.txt").write_text("\n".join(vocab) + "\n")
+
+    csv = tmp_path / "flows.csv"
+    write_synthetic_csv(str(csv), n_rows=80, seed=31)
+
+    # 1) Single-client fine-tune from the "pretrained" encoder.
+    local_ckpt = tmp_path / "local_ckpt"
+    assert (
+        main(
+            [
+                "local", "--hf-dir", str(hf), "--csv", str(csv),
+                "--data-fraction", "0.6", "--epochs", "1",
+                "--batch-size", "8", "--max-len", "64",
+                "--checkpoint-dir", str(local_ckpt),
+                "--output-dir", str(tmp_path / "local_out"),
+            ]
+        )
+        == 0
+    )
+    assert (tmp_path / "local_out" / "client0_local_metrics.csv").exists()
+
+    # 2) Two-client federated round from the same encoder.
+    fed_ckpt = tmp_path / "fed_ckpt"
+    assert (
+        main(
+            [
+                "federated", "--hf-dir", str(hf), "--csv", str(csv),
+                "--num-clients", "2", "--rounds", "1", "--epochs", "1",
+                "--partition", "disjoint", "--data-fraction", "0.4",
+                "--batch-size", "8", "--max-len", "64",
+                "--checkpoint-dir", str(fed_ckpt),
+                "--output-dir", str(tmp_path / "fed_out"),
+            ]
+        )
+        == 0
+    )
+
+    # 3) Export the federated aggregate to the HF layout.
+    exported = tmp_path / "exported"
+    assert (
+        main(
+            ["export-hf", "--hf-dir", str(hf), "--checkpoint-dir",
+             str(fed_ckpt), "--out", str(exported)]
+        )
+        == 0
+    )
+    hf_cfg = json.load(open(exported / "config.json"))
+    assert hf_cfg["vocab_size"] == 30522 and hf_cfg["dim"] == 768
+    assert len((exported / "vocab.txt").read_text().splitlines()) == 30522
+
+    # 4) transformers itself loads the exported 66M-param encoder.
+    reloaded = transformers.DistilBertModel.from_pretrained(str(exported))
+    assert reloaded.config.vocab_size == 30522
+    emb = reloaded.state_dict()["embeddings.word_embeddings.weight"]
+    assert tuple(emb.shape) == (30522, 768)
+
+    # 5) predict consumes the exported checkpoint (trained head included).
+    preds = tmp_path / "preds.csv"
+    assert (
+        main(
+            ["predict", "--csv", str(csv), "--hf-dir", str(exported),
+             "--max-len", "64", "--output", str(preds)]
+        )
+        == 0
+    )
+    import pandas as pd
+
+    df = pd.read_csv(preds)
+    assert len(df) == 80
+    assert df["prob_attack"].between(0, 1).all()
+    assert np.isfinite(df["prob_attack"]).all()
