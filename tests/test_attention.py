@@ -327,6 +327,7 @@ def test_flash_degenerate_length_falls_back_to_dot(rng):
     np.testing.assert_allclose(np.asarray(g), np.asarray(gref), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_flash_dropout_deterministic_and_unbiased(rng):
     """Flash attention dropout: same rng -> same output; different rng ->
     different mask; averaging over many seeds recovers the no-dropout
@@ -399,6 +400,15 @@ def test_flash_dropout_gradients_check(rng):
             deterministic=False, block_q=8, block_k=8,
         ).sum()
 
+    # Fast-lane determinism: same key -> identical value; different key ->
+    # different mask (the 64-seed unbiasedness statistics run in the slow
+    # lane).
+    assert float(f(q, k, v, bias)) == float(f(q, k, v, bias))
+    alt = flash_attention(
+        q, k, v, bias, dropout_rate=0.3, dropout_rng=jax.random.key(4),
+        deterministic=False, block_q=8, block_k=8,
+    ).sum()
+    assert float(f(q, k, v, bias)) != float(alt)
     check_grads(f, (q, k, v, bias), order=1, modes=["rev"], atol=2e-2, rtol=2e-2)
 
 

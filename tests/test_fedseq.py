@@ -79,6 +79,7 @@ def test_fedseq_loss_matches_unsharded(mesh3):
     np.testing.assert_allclose(got, want, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_fedseq_grads_match_unsharded(mesh3):
     """VERDICT-5 'done' criterion: grad parity of the 2-client x 2-seq-shard
     (x 2 data shards) stacked program vs the unsharded per-client program."""
@@ -107,6 +108,7 @@ def test_fedseq_grads_match_unsharded(mesh3):
             )
 
 
+@pytest.mark.slow
 def test_fedseq_train_step_and_fedavg(mesh3):
     """One lockstep train step over the 3-axis mesh matches per-client Adam
     on the unsharded program; FedAvg then replicates the mean."""
