@@ -58,7 +58,21 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
         model_kw.update(max_len=args.max_len)
     if getattr(args, "gelu", None):
         model_kw.update(gelu=args.gelu)
-    new_model = cfg.model.replace(**model_kw) if model_kw else cfg.model
+    if getattr(args, "attention_impl", None):
+        model_kw.update(attention_impl=args.attention_impl)
+    if getattr(args, "attention_dropout", None) is not None:
+        # Explicit 0 must reach the config (ring requires it).
+        model_kw.update(attention_dropout=args.attention_dropout)
+    if getattr(args, "remat", None) is not None:
+        # Tri-state: --remat / --no-remat / absent (config wins).
+        model_kw.update(remat=args.remat)
+    try:
+        new_model = cfg.model.replace(**model_kw) if model_kw else cfg.model
+    except ValueError as e:
+        # Operator error (e.g. --attention-impl ring with the default
+        # attention_dropout): surface the config validation message, not
+        # a traceback.
+        raise SystemExit(str(e)) from None
 
     # model and data must change together: ExperimentConfig.__post_init__
     # checks data.max_len == model.max_len on every replace.

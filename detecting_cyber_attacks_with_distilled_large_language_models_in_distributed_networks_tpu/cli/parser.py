@@ -100,6 +100,28 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         type=int,
         help="linear LR warmup steps (global step count; 0 = constant)",
     )
+    p.add_argument(
+        "--attention-impl",
+        choices=["dot", "flash", "ring"],
+        help="attention path: dot (XLA fused, default), flash (Pallas "
+        "kernel — the long-context choice, O(L·D) memory both directions), "
+        "ring (sequence-parallel over a mesh axis; needs "
+        "--attention-dropout 0)",
+    )
+    p.add_argument(
+        "--attention-dropout",
+        type=float,
+        help="attention-weight dropout rate (default from the preset/"
+        "config; ring requires 0)",
+    )
+    p.add_argument(
+        "--remat",
+        action=argparse.BooleanOptionalAction,
+        default=None,
+        help="rematerialize transformer blocks in the backward pass "
+        "(trade FLOPs for activation memory; long-context / big-batch "
+        "runs); --no-remat overrides a config file's remat=true",
+    )
     p.add_argument("--max-len", type=int)
     p.add_argument("--data-fraction", type=float)
     p.add_argument("--seed", type=int)

@@ -165,3 +165,55 @@ def test_local_fit_logs_per_step_telemetry(tmp_path):
         logger.removeHandler(handler)
     out = buf.getvalue()
     assert "samples/s" in out and "Step 2:" in out
+
+
+def test_attention_impl_and_remat_flags(tmp_path):
+    """--attention-impl / --attention-dropout / --remat reach the model
+    config; ring without --attention-dropout 0 fails as an operator error,
+    and --no-remat overrides a config file."""
+    import argparse
+    import json as _json
+
+    def ns(**kw):
+        base = dict(
+            preset="tiny", attention_impl=None, attention_dropout=None,
+            remat=None, max_len=None, config=None,
+        )
+        base.update(kw)
+        return argparse.Namespace(**base)
+
+    cfg = resolve_config(ns(attention_impl="flash", remat=True), vocab_size=128)
+    assert cfg.model.attention_impl == "flash" and cfg.model.remat is True
+    # ring + default attention_dropout: SystemExit, not a traceback.
+    with pytest.raises(SystemExit, match="attention dropout"):
+        resolve_config(ns(attention_impl="ring"), vocab_size=128)
+    cfg = resolve_config(
+        ns(attention_impl="ring", attention_dropout=0.0), vocab_size=128
+    )
+    assert cfg.model.attention_impl == "ring"
+    assert cfg.model.attention_dropout == 0.0
+    # --no-remat beats a config file's remat=true.
+    cfg_file = tmp_path / "remat.json"
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        ExperimentConfig,
+        ModelConfig,
+        DataConfig,
+    )
+
+    m = ModelConfig.tiny(remat=True)
+    cfg_file.write_text(_json.dumps(
+        ExperimentConfig(model=m, data=DataConfig(max_len=m.max_len)).to_dict()
+    ))
+    assert resolve_config(ns(config=str(cfg_file)), vocab_size=256).model.remat
+    cfg = resolve_config(ns(config=str(cfg_file), remat=False), vocab_size=256)
+    assert cfg.model.remat is False
+    # End-to-end: a flash+remat local run trains and reports.
+    rc = main(
+        [
+            "local", "--synthetic", "200", "--epochs", "1",
+            "--batch-size", "8", "--attention-impl", "flash", "--remat",
+            "--output-dir", str(tmp_path / "out"),
+        ]
+    )
+    assert rc == 0
+    assert (tmp_path / "out" / "client0_local_metrics.csv").exists()

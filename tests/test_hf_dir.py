@@ -345,3 +345,22 @@ def test_pre_gelu_config_file_defers_to_checkpoint_activation(hf_dir, tmp_path):
     new_cfg = tmp_path / "new.json"
     new_cfg.write_text(json.dumps(d))
     assert resolve(new_cfg) == "tanh"
+
+def test_attention_flags_survive_hf_dir_resolution(hf_dir):
+    """--attention-impl/--remat must carry into the checkpoint-derived
+    model config (the overrides dict in _resolve_with_pretrained)."""
+    import argparse
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
+        _resolve_with_pretrained,
+    )
+
+    args = argparse.Namespace(
+        hf_dir=hf_dir, preset="tiny", max_len=None, gelu=None, config=None,
+        attention_impl="flash", attention_dropout=0.0, remat=True,
+    )
+    _, cfg, _ = _resolve_with_pretrained(args, load_weights=False)
+    assert cfg.model.attention_impl == "flash"
+    assert cfg.model.attention_dropout == 0.0
+    assert cfg.model.remat is True
+    assert cfg.model.dim == DIM  # architecture still from the checkpoint
