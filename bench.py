@@ -87,6 +87,9 @@ def bench_train(model_cfg: ModelConfig, name: str) -> None:
 
     # TrainConfig defaults are the production path (incl. prng_impl="rbg"
     # dropout keys); BENCH_PRNG=threefry2x32 measures the costlier impl.
+    # BENCH_FUSED_QKV=1 measures the apply-time Q/K/V fusion.
+    if os.environ.get("BENCH_FUSED_QKV", "0").lower() not in ("", "0", "false"):
+        model_cfg = model_cfg.replace(fused_qkv=True)
     train_cfg = TrainConfig(prng_impl=os.environ.get("BENCH_PRNG", "rbg"))
     trainer = Trainer(model_cfg, train_cfg)
     state = trainer.init_state(seed=0)

@@ -225,6 +225,7 @@ def _resolve_with_pretrained(args, *, load_weights: bool = True):
         attention_impl=m.attention_impl,
         ring_axis=m.ring_axis,
         remat=m.remat,
+        fused_qkv=m.fused_qkv,
     )
     # Activation precedence: --gelu flag > --config file's model section >
     # the checkpoint's declared activation (config.json) > library default.

@@ -54,6 +54,11 @@ class ModelConfig:
     # "dot" (XLA fused attention), "flash" (Pallas kernel), "ring"
     # (sequence-parallel ring attention over a mesh axis).
     attention_impl: str = "dot"
+    # Compute Q/K/V with ONE [D, 3D] matmul over kernels concatenated at
+    # apply time (the parameter tree keeps the separate q/k/v layout, so
+    # checkpoints and HF conversion are unaffected). Same math, fewer
+    # larger MXU dispatches; measured via BENCH_FUSED_QKV.
+    fused_qkv: bool = False
     # Mesh axis the sequence dimension is sharded over when attention_impl
     # is "ring" (the forward must run inside shard_map with this axis bound).
     ring_axis: str = "seq"

@@ -67,7 +67,30 @@ class MultiHeadSelfAttention(nn.Module):
         def split(t):  # [B, L, dim] -> [B, H, L, d]
             return t.reshape(B, L, heads, d).transpose(0, 2, 1, 3)
 
-        q, k, v = split(dense("q")(x)), split(dense("k")(x)), split(dense("v")(x))
+        if cfg.fused_qkv:
+            qd, kd, vd = dense("q"), dense("k"), dense("v")
+            if self.is_initializing():
+                # Materialize the SAME parameter tree the unfused path
+                # builds (child Dense modules named q/k/v) — checkpoints
+                # and HF conversion see an identical layout either way.
+                probe = jnp.zeros((1, 1, cfg.dim), x.dtype)
+                qd(probe), kd(probe), vd(probe)
+            p = self.variables["params"]
+            cd = _dtype(cfg.compute_dtype)
+            W = jnp.concatenate(
+                [p["q"]["kernel"], p["k"]["kernel"], p["v"]["kernel"]], axis=-1
+            ).astype(cd)  # [D, 3D] — one MXU dispatch instead of three
+            bias3 = jnp.concatenate(
+                [p["q"]["bias"], p["k"]["bias"], p["v"]["bias"]]
+            ).astype(cd)
+            qkv = x @ W + bias3
+            q, k, v = (split(t) for t in jnp.split(qkv, 3, axis=-1))
+        else:
+            q, k, v = (
+                split(dense("q")(x)),
+                split(dense("k")(x)),
+                split(dense("v")(x)),
+            )
         dropout_rng = (
             None
             if deterministic or cfg.attention_dropout == 0.0

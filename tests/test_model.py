@@ -182,3 +182,30 @@ def test_remat_matches(tiny_params):
     a = DDoSClassifier(TINY).apply({"params": tiny_params}, ids, mask)
     b = DDoSClassifier(cfg).apply({"params": tiny_params}, ids, mask)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_fused_qkv_matches_unfused():
+    """fused_qkv computes identical logits from the identical parameter
+    tree (the fusion is apply-time only; params/checkpoints/HF layout are
+    unchanged), and gradients flow equivalently."""
+    cfg = ModelConfig.tiny()
+    fused_cfg = cfg.replace(fused_qkv=True)
+    model = DDoSClassifier(cfg)
+    model_f = DDoSClassifier(fused_cfg)
+    params = init_params(model, cfg, jax.random.key(0))
+    params_f = init_params(model_f, fused_cfg, jax.random.key(0))
+    # Identical parameter trees from the same seed.
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(params_f)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (4, cfg.max_len)), jnp.int32)
+    mask = jnp.ones((4, cfg.max_len), jnp.int32)
+    out = model.apply({"params": params}, ids, mask, True)
+    out_f = model_f.apply({"params": params}, ids, mask, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_f), atol=1e-5)
+
+    g = jax.grad(lambda p: model.apply({"params": p}, ids, mask, True).sum())(params)
+    g_f = jax.grad(lambda p: model_f.apply({"params": p}, ids, mask, True).sum())(params)
+    for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(g_f)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
