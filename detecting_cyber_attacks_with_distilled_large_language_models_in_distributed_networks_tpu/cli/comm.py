@@ -73,7 +73,10 @@ def cmd_client(args) -> int:
 
     tok, cfg, pretrained = _resolve_with_pretrained(args)
     client_data = _load_clients(args, cfg, tok, cfg.fed.num_clients)[args.client_id]
-    trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
+    trainer = Trainer(
+        cfg.model, cfg.train, pad_id=tok.pad_id,
+        drop_remainder=cfg.data.drop_remainder,
+    )
     state = trainer.init_state(params=pretrained)
     ckpt = None
     if cfg.checkpoint_dir:

@@ -14,7 +14,10 @@ def cmd_local(args) -> int:
 
     tok, cfg, pretrained = _resolve_with_pretrained(args)
     client = _load_clients(args, cfg, tok, max(args.client_id + 1, 1))[args.client_id]
-    trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
+    trainer = Trainer(
+        cfg.model, cfg.train, pad_id=tok.pad_id,
+        drop_remainder=cfg.data.drop_remainder,
+    )
     state = trainer.init_state(params=pretrained)
     from ..utils.profiling import trace
 

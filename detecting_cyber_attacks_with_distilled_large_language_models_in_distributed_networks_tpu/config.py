@@ -161,6 +161,11 @@ class DataConfig:
     partition: str = "sample"
     dirichlet_alpha: float = 0.5
     vocab_path: str | None = None
+    # Training batches: True (default) drops the final short batch of each
+    # epoch so every step compiles once at one shape; False trains it at
+    # its own (smaller) shape — the reference DataLoader's drop_last=False
+    # (client1.py:370) at the cost of one extra XLA compilation. Eval is
+    # unaffected (it always counts every example via row masks).
     drop_remainder: bool = True
 
     def __post_init__(self) -> None:

@@ -217,10 +217,12 @@ class Trainer:
         train_cfg: TrainConfig,
         *,
         pad_id: int = 0,
+        drop_remainder: bool = True,
     ):
         self.model_cfg = model_cfg
         self.train_cfg = train_cfg
         self.pad_id = pad_id
+        self.drop_remainder = drop_remainder
         self.model = DDoSClassifier(model_cfg)
         self.optimizer = make_optimizer(train_cfg)
         self.train_step = make_train_step(
@@ -243,11 +245,17 @@ class Trainer:
     def epoch_batches(
         self, split: TokenizedSplit, epoch: int, batch_size: int
     ) -> Iterator[dict]:
+        # drop_remainder=False (DataConfig.drop_remainder): the final short
+        # batch trains at its own shape (one extra XLA compilation) — the
+        # reference DataLoader's drop_last=False semantics (client1.py:370),
+        # exact per-batch mean loss included. The default drops it for a
+        # single compiled shape.
         return batch_iterator(
             split,
             batch_size,
             shuffle=True,
             seed=self.train_cfg.seed * 100_003 + epoch,
+            drop_remainder=self.drop_remainder,
         )
 
     def fit(

@@ -156,3 +156,37 @@ def test_grad_accum_trains(tok, client_data):
     m_accum = accum.evaluate(s_accum.params, client_data.test, collect_probs=False)
     assert m_base["Accuracy"] > 85.0
     assert m_accum["Accuracy"] > 85.0
+
+
+def test_train_remainder_trains_final_short_batch():
+    """DataConfig.drop_remainder=False (Trainer(drop_remainder=False))
+    runs the reference DataLoader's drop_last=False semantics: the final
+    short batch takes a real step (state.step counts it) and its loss
+    enters the epoch average."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.pipeline import (
+        TokenizedSplit,
+    )
+
+    cfg = ModelConfig.tiny()
+    n, bs = 20, 8
+    r = np.random.default_rng(0)
+    split = TokenizedSplit(
+        r.integers(1, cfg.vocab_size, (n, cfg.max_len)).astype(np.int32),
+        np.ones((n, cfg.max_len), np.int32),
+        r.integers(0, 2, n).astype(np.int32),
+    )
+    dropped = Trainer(cfg, TrainConfig(epochs_per_round=1))
+    s1 = dropped.init_state(seed=0)
+    s1, _ = dropped.fit(s1, split, batch_size=bs)
+    assert int(s1.step) == n // bs  # 2 full batches, tail dropped
+
+    full = Trainer(cfg, TrainConfig(epochs_per_round=1), drop_remainder=False)
+    s2 = full.init_state(seed=0)
+    s2, losses = full.fit(s2, split, batch_size=bs)
+    assert int(s2.step) == -(-n // bs)  # 3 steps: the 4-row tail trained
+    # The extra step moved the params (the tail actually trained).
+    diff = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params))
+    )
+    assert diff
