@@ -16,6 +16,8 @@ Secondary modes via BENCH_MODE:
     fedavg            on-device FedAvg of a stacked 2-client DistilBERT
                       param tree vs the reference's 0.36 s host aggregation
                       (server_terminal_output.txt:14-15)
+    flash             long-context flash-attention grad step vs the XLA
+                      dot path at L=8192 (BENCH_SEQ overrides)
 
 Prints exactly one JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
@@ -214,6 +216,62 @@ def bench_fedavg() -> None:
     )
 
 
+def bench_flash() -> None:
+    """Long-context flash attention fwd+bwd vs the XLA dot path at L=8192
+    (B=1, H=12, D=64 — the PARITY.md record's configuration)."""
+    import jax.numpy as jnp
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.ops.attention import (
+        dot_product_attention,
+        make_attention_bias,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.ops.flash_attention import (
+        flash_attention,
+    )
+
+    B, H, L, D = 1, 12, int(os.environ.get("BENCH_SEQ", "8192")), 64
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jax.device_put(rng.normal(size=(B, H, L, D)).astype(np.float32)).astype(
+            jnp.bfloat16
+        )
+        for _ in range(3)
+    )
+    bias = make_attention_bias(jax.device_put(np.ones((B, L), np.int32)))
+
+    def time_grad(fn):
+        # Grad over ALL of (q, k, v): differentiating q alone would let XLA
+        # dead-code-eliminate the dK/dV backward work, timing only part of
+        # the gradient step.
+        g = jax.jit(
+            jax.grad(
+                lambda qkv: fn(*qkv, bias).astype(jnp.float32).sum()
+            )
+        )
+        out = g((q, k, v))
+        _sync(out)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = g((q, k, v))
+        _sync(out)
+        return (time.perf_counter() - t0) / steps
+
+    flash_s = time_grad(flash_attention)
+    dot_s = time_grad(dot_product_attention)
+    _emit(
+        {
+            "metric": f"flash_attn_grad_ms_L{L}",
+            "value": round(flash_s * 1e3, 2),
+            "unit": "ms",
+            # Higher is better: the XLA dot path's time over the kernel's.
+            "vs_baseline": round(dot_s / flash_s, 2),
+            "baseline_note": f"vs XLA dot-attention grad {dot_s * 1e3:.1f} ms",
+            "device": jax.devices()[0].device_kind,
+        }
+    )
+
+
 def main() -> None:
     mode = os.environ.get("BENCH_MODE", "train")
     if mode == "train":
@@ -228,9 +286,11 @@ def main() -> None:
         bench_eval()
     elif mode == "fedavg":
         bench_fedavg()
+    elif mode == "flash":
+        bench_flash()
     else:
         raise SystemExit(
-            f"unknown BENCH_MODE {mode!r} (train|bert|bertlarge|eval|fedavg)"
+            f"unknown BENCH_MODE {mode!r} (train|bert|bertlarge|eval|fedavg|flash)"
         )
 
 
