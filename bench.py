@@ -28,6 +28,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -272,26 +273,116 @@ def bench_flash() -> None:
     )
 
 
+def _watchdog(seconds: int, record: dict) -> threading.Timer:
+    """Hard deadline that fires even while the main thread is blocked inside
+    an XLA C++ call (the tunnel's observed stall mode) — a SIGALRM handler
+    would wait for the interpreter to regain control, i.e. forever. The
+    timer thread emits the diagnostic JSON and hard-exits 2."""
+
+    def fire():
+        _emit(record)
+        sys.stdout.flush()
+        os._exit(2)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def _preflight() -> None:
+    """Fail FAST with a parseable diagnostic instead of hanging or dumping a
+    28-frame traceback: the chip sits behind an experimental tunnel that has
+    been observed both to refuse backend init (BENCH_r02: "Unable to
+    initialize backend 'axon': UNAVAILABLE") and to accept init then stall
+    on the first executable. Retries init a few times, then bounds a tiny
+    device round-trip with a watchdog."""
+    attempts = max(1, int(os.environ.get("BENCH_INIT_RETRIES", "3")))
+    last = None
+    for attempt in range(attempts):
+        try:
+            devices = jax.devices()
+            break
+        except Exception as e:  # backend init is all-or-nothing in JAX
+            last = e
+            if attempt + 1 < attempts:
+                time.sleep(5)
+    else:
+        _emit(
+            {
+                "metric": "bench_error",
+                "error": "backend_init_failed",
+                "detail": f"{type(last).__name__}: {str(last)[:300]}",
+                "attempts": attempts,
+            }
+        )
+        raise SystemExit(2)
+    timeout = int(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "120"))
+    guard = _watchdog(
+        timeout,
+        {
+            "metric": "bench_error",
+            "error": "tunnel_stalled",
+            "detail": f"trivial jit round-trip exceeded {timeout}s on "
+            f"{devices[0].device_kind}; tunnel degraded — retry later",
+        },
+    )
+    try:
+        np.asarray(jax.jit(lambda x: x * 2)(np.ones(8, np.float32)))
+    except Exception as e:
+        # Init succeeded but the first executable failed (BENCH_r02's
+        # "TPU backend setup/compile error" mode) — still one JSON line.
+        _emit(
+            {
+                "metric": "bench_error",
+                "error": "backend_exec_failed",
+                "detail": f"{type(e).__name__}: {str(e)[:300]}",
+            }
+        )
+        raise SystemExit(2)
+    finally:
+        guard.cancel()
+
+
+MODES = ("train", "bert", "bertlarge", "eval", "fedavg", "flash")
+
+
 def main() -> None:
     mode = os.environ.get("BENCH_MODE", "train")
-    if mode == "train":
-        bench_train(ModelConfig(), "distilbert")
-    elif mode == "bert":
-        bench_train(ModelConfig.bert_base(), "bertbase")
-    elif mode == "bertlarge":
-        # 335 M params: bs 32 fits one v5e chip comfortably with remat off.
-        os.environ.setdefault("BENCH_BATCH", "32")
-        bench_train(ModelConfig.bert_large(), "bertlarge")
-    elif mode == "eval":
-        bench_eval()
-    elif mode == "fedavg":
-        bench_fedavg()
-    elif mode == "flash":
-        bench_flash()
-    else:
-        raise SystemExit(
-            f"unknown BENCH_MODE {mode!r} (train|bert|bertlarge|eval|fedavg|flash)"
+    if mode not in MODES:  # validate before paying for the tunnel handshake
+        raise SystemExit(f"unknown BENCH_MODE {mode!r} ({'|'.join(MODES)})")
+    _preflight()
+    # Global watchdog: a stall mid-bench still produces one JSON line.
+    budget = int(os.environ.get("BENCH_TIMEOUT", "1500"))
+    guard = None
+    if budget:
+        guard = _watchdog(
+            budget,
+            {
+                "metric": "bench_error",
+                "error": "bench_stalled",
+                "detail": f"BENCH_MODE={mode} exceeded the {budget}s watchdog "
+                "after a healthy preflight; tunnel likely degraded mid-run",
+            },
         )
+    try:
+        if mode == "train":
+            bench_train(ModelConfig(), "distilbert")
+        elif mode == "bert":
+            bench_train(ModelConfig.bert_base(), "bertbase")
+        elif mode == "bertlarge":
+            # 335 M params: bs 32 fits one v5e chip comfortably with remat off.
+            os.environ.setdefault("BENCH_BATCH", "32")
+            bench_train(ModelConfig.bert_large(), "bertlarge")
+        elif mode == "eval":
+            bench_eval()
+        elif mode == "fedavg":
+            bench_fedavg()
+        elif mode == "flash":
+            bench_flash()
+    finally:
+        if guard is not None:
+            guard.cancel()
 
 
 if __name__ == "__main__":

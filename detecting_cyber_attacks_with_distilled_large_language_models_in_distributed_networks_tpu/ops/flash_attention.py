@@ -96,22 +96,30 @@ def _keep_mask(seed, b, h, q0, k0, bq: int, bk: int, rate: float):
     """Deterministic [bq, bk] fp32 keep mask for dropout, from a hash of
     the GLOBAL (seed, batch, head, q index, k index) coordinate — the
     forward and both backward kernels regenerate the identical mask from
-    the same coordinates, whatever their block iteration order."""
+    the same coordinates, whatever their block iteration order.
+
+    ``seed`` is a pair of uint32 words (64 bits total): a single 32-bit
+    seed would birthday-collide to an identical whole-call mask after
+    ~2^16 distinct dropout_rng draws (steps x layers)."""
     # Everything MUST be uint32 before the mixing ops: a traced int32
     # (program_id, block offsets) would silently promote the whole chain
     # to a signed dtype, turning the >> shifts arithmetic and changing the
     # bits between call sites.
     q0 = jnp.asarray(q0).astype(jnp.uint32)
     k0 = jnp.asarray(k0).astype(jnp.uint32)
-    seed = jnp.asarray(seed).astype(jnp.uint32)
+    s0 = jnp.asarray(seed[0]).astype(jnp.uint32)
+    s1 = jnp.asarray(seed[1]).astype(jnp.uint32)
     qi = q0 + jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 0)
     ki = k0 + jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 1)
     x = (qi * jnp.uint32(0x9E3779B1)) ^ (ki * jnp.uint32(0x85EBCA77))
     x = x ^ (
-        seed
+        s0
         + jnp.asarray(b).astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)
         + jnp.asarray(h).astype(jnp.uint32) * jnp.uint32(0x27D4EB2F)
     )
+    # Fold the second seed word in with its own odd multiplier so the two
+    # words act as one 64-bit seed rather than xor-cancelling.
+    x = x + s1 * jnp.uint32(0x632BE59B)
     # murmur3 finalizer: avalanche the combined coordinate.
     x = x ^ (x >> 16)
     x = x * jnp.uint32(0x7FEB352D)
@@ -141,7 +149,7 @@ def _fwd_kernel(
     lk = k_ref.shape[2]
     num_kb = lk // block_k
     b, h, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    seed = seed_ref[0, 0]
+    seed = (seed_ref[0, 0], seed_ref[0, 1])
     inv = 1.0 / (1.0 - rate) if rate else 1.0
 
     def body(i, carry):
@@ -201,7 +209,7 @@ def _dkdv_kernel(
     lq = q_ref.shape[2]
     num_qb = lq // block_q
     b, h, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    seed = seed_ref[0, 0]
+    seed = (seed_ref[0, 0], seed_ref[0, 1])
     inv = 1.0 / (1.0 - rate) if rate else 1.0
 
     def body(i, carry):
@@ -264,7 +272,7 @@ def _dq_kernel(
     lk = k_ref.shape[2]
     num_kb = lk // block_k
     b, h, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    seed = seed_ref[0, 0]
+    seed = (seed_ref[0, 0], seed_ref[0, 1])
     inv = 1.0 / (1.0 - rate) if rate else 1.0
 
     def body(i, dq_acc):
@@ -332,7 +340,7 @@ def _flash_forward(
             pl.BlockSpec((1, 1, lk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, lk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, lk), lambda bi, hi, qi: (bi, 0, 0)),
-            pl.BlockSpec((1, 1), lambda bi, hi, qi: (0, 0)),
+            pl.BlockSpec((1, 2), lambda bi, hi, qi: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -369,7 +377,7 @@ def _flash_backward(
     blk_rows = pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, i: (bi, hi, i, 0))
     full_bias = pl.BlockSpec((1, 1, lk), lambda bi, hi, i: (bi, 0, 0))
     blk_bias = pl.BlockSpec((1, 1, block_k), lambda bi, hi, i: (bi, 0, i))
-    seed_spec = pl.BlockSpec((1, 1), lambda bi, hi, i: (0, 0))
+    seed_spec = pl.BlockSpec((1, 2), lambda bi, hi, i: (0, 0))
 
     dk, dv, db_h = pl.pallas_call(
         functools.partial(
@@ -474,7 +482,7 @@ def flash_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if rate:
-        seed = jax.random.bits(dropout_rng, (1, 1), jnp.uint32)
+        seed = jax.random.bits(dropout_rng, (1, 2), jnp.uint32)
     else:
-        seed = jnp.zeros((1, 1), jnp.uint32)
+        seed = jnp.zeros((1, 2), jnp.uint32)
     return _flash(q, k, v, bias, seed, rate, block_q, block_k, interpret)

@@ -124,9 +124,20 @@ def federated_batches_ragged(
     matching its independent-run trajectory (the dense path's global
     ``state.step`` would compress idle clients' warmup ramps)."""
     C = stacked.split.labels.shape[0]
+    own_steps = np.array(
+        [-(-int(n) // batch_size) for n in stacked.n_rows], np.int32
+    )
+    min_steps = int(own_steps.max())
     steps = n_batches
     if steps is None:
-        steps = max(-(-int(n) // batch_size) for n in stacked.n_rows)
+        steps = min_steps
+    elif steps < min_steps:
+        worst = int(own_steps.argmax())
+        raise ValueError(
+            f"n_batches={steps} is smaller than client {worst}'s own epoch "
+            f"length ceil({int(stacked.n_rows[worst])}/{batch_size})="
+            f"{min_steps}; every client's rows must fit the lockstep span"
+        )
     span = steps * batch_size
     idx = np.zeros((C, span), np.int64)
     valid = np.zeros((C, span), np.int32)
@@ -137,9 +148,6 @@ def federated_batches_ragged(
         ).permutation(n_c)
         idx[c, :n_c] = perm
         valid[c, :n_c] = 1
-    own_steps = np.array(
-        [-(-int(n) // batch_size) for n in stacked.n_rows], np.int32
-    )
     rows = np.arange(C)[:, None]
     for i in range(steps):
         sl = slice(i * batch_size, (i + 1) * batch_size)

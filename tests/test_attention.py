@@ -367,7 +367,7 @@ def test_flash_dropout_deterministic_and_unbiased(rng):
         [
             np.asarray(
                 _keep_mask(
-                    jax.random.bits(jax.random.key(s), (), jnp.uint32),
+                    jax.random.bits(jax.random.key(s), (2,), jnp.uint32),
                     jnp.int32(0), jnp.int32(1), 0, 0, 32, 32, 0.4,
                 )
             ).mean()

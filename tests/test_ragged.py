@@ -108,6 +108,19 @@ def test_ragged_batches_cover_every_row_once():
     assert not np.array_equal(batches[0]["labels"][2], other[0]["labels"][2])
 
 
+def test_ragged_batches_reject_short_n_batches():
+    """A caller-supplied n_batches below a client's own epoch length must
+    raise a clear error naming the client, not a numpy broadcast error."""
+    splits = [_split(13, 0), _split(30, 1)]
+    st = stack_clients_ragged(splits)
+    with pytest.raises(ValueError, match=r"client 1's own epoch length"):
+        list(federated_batches_ragged(st, 8, seed=0, epoch=0, n_batches=2))
+    # At or above the max it degrades to extra all-padding lockstep steps.
+    batches = list(federated_batches_ragged(st, 8, seed=0, epoch=0, n_batches=5))
+    assert len(batches) == 5
+    assert batches[4]["valid"].sum() == 0
+
+
 @pytest.mark.slow
 def test_ragged_spmd_matches_manual_per_client_runs(eight_devices):
     """The VERDICT-1 'done' criterion: a ragged fleet's stacked lockstep
