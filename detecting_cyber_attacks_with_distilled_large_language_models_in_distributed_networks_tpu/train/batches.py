@@ -1,0 +1,116 @@
+"""Per-epoch federated batch iterators (host side).
+
+The reference shuffles each client's rows independently inside
+``DataLoader(shuffle=True)`` (client1.py:368-372); here every client's
+permutation is derived from (seed, epoch, global client index) so the
+stacked ``[C, B, ...]`` lockstep batches are deterministic, epoch-decorrelated,
+and identical no matter how clients are laid out over hosts.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from ..data.pipeline import StackedClients
+
+
+def federated_batches(
+    stacked,
+    batch_size: int,
+    *,
+    seed: int,
+    epoch: int,
+    client_offset: int = 0,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Yields ``[C, B, ...]`` batches with every client's rows permuted
+    independently per epoch (dense path: all clients share one row count,
+    the fleet-min truncation applied upstream).
+
+    ``client_offset``: this process's first GLOBAL client index — multi-host
+    runs must key client c's permutation on its global identity, or two
+    hosts' "client 0" would shuffle identically.
+    """
+    C, N = stacked.labels.shape[:2]
+    perms = np.stack(
+        [
+            np.random.default_rng(
+                (seed * 100_003 + epoch) * 1_000_003 + client_offset + c
+            ).permutation(N)
+            for c in range(C)
+        ]
+    )
+    rows = np.arange(C)[:, None]
+    for i in range(N // batch_size):
+        idx = perms[:, i * batch_size : (i + 1) * batch_size]
+        yield {
+            "input_ids": stacked.input_ids[rows, idx],
+            "attention_mask": stacked.attention_mask[rows, idx],
+            "labels": stacked.labels[rows, idx],
+        }
+
+
+def federated_batches_ragged(
+    stacked: StackedClients,
+    batch_size: int,
+    *,
+    seed: int,
+    epoch: int,
+    client_offset: int = 0,
+    n_batches: int | None = None,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Per-epoch ``[C, B, ...]`` batches over a RAGGED client stack, with a
+    ``valid`` ``[C, B]`` 0/1 mask. Each client's real rows are permuted
+    independently (same keying as :func:`federated_batches`) and consumed
+    exactly once per epoch: a client whose rows run out pads its remaining
+    lockstep batches with valid == 0 (its train step is gated off), and the
+    final partial batch mixes real and padding rows. ``n_batches`` lets
+    multi-host callers force the GLOBAL max step count.
+
+    Every batch also carries ``warmup_step`` ``[C, B]`` — each client's OWN
+    executed-step count entering this batch (``epoch * ceil(n_c/bs) +
+    min(i, ceil(n_c/bs))``, broadcast over B so it rides the standard batch
+    sharding). The ragged train step keys LR warmup on it, so a short
+    client's schedule advances only when the client actually steps —
+    matching its independent-run trajectory (the dense path's global
+    ``state.step`` would compress idle clients' warmup ramps)."""
+    C = stacked.split.labels.shape[0]
+    own_steps = np.array(
+        [-(-int(n) // batch_size) for n in stacked.n_rows], np.int32
+    )
+    min_steps = int(own_steps.max())
+    steps = n_batches
+    if steps is None:
+        steps = min_steps
+    elif steps < min_steps:
+        worst = int(own_steps.argmax())
+        raise ValueError(
+            f"n_batches={steps} is smaller than client {worst}'s own epoch "
+            f"length ceil({int(stacked.n_rows[worst])}/{batch_size})="
+            f"{min_steps}; every client's rows must fit the lockstep span"
+        )
+    span = steps * batch_size
+    idx = np.zeros((C, span), np.int64)
+    valid = np.zeros((C, span), np.int32)
+    for c in range(C):
+        n_c = int(stacked.n_rows[c])
+        perm = np.random.default_rng(
+            (seed * 100_003 + epoch) * 1_000_003 + client_offset + c
+        ).permutation(n_c)
+        idx[c, :n_c] = perm
+        valid[c, :n_c] = 1
+    rows = np.arange(C)[:, None]
+    for i in range(steps):
+        sl = slice(i * batch_size, (i + 1) * batch_size)
+        take = idx[:, sl]
+        wstep = epoch * own_steps + np.minimum(i, own_steps)
+        yield {
+            "input_ids": stacked.split.input_ids[rows, take],
+            "attention_mask": stacked.split.attention_mask[rows, take],
+            "labels": stacked.split.labels[rows, take],
+            "valid": valid[:, sl],
+            "warmup_step": np.broadcast_to(
+                wstep[:, None], (C, batch_size)
+            ).copy(),
+        }

@@ -24,191 +24,35 @@ fresh-Adam-per-run semantics (FedConfig.reset_optimizer_each_round).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from functools import partial
-from typing import Any, Callable, Iterator, NamedTuple, Sequence
+from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
 from ..config import ExperimentConfig
-from ..data.pipeline import StackedClients, TokenizedSplit, pad_split_to_batch
+from ..data.pipeline import StackedClients, TokenizedSplit
 from ..models.distilbert import DDoSClassifier, init_params
-from ..ops.metrics import BinaryCounts, finalize_metrics
-from ..parallel.fedavg import make_fedavg_step, stack_params
+from ..parallel.fedavg import stack_params
 from ..parallel.mesh import FedShardings, make_mesh
-from ..train.engine import (
-    apply_warmup,
-    eval_counts,
-    loss_fn,
-    make_optimizer,
-    masked_loss_fn,
-)
+from ..train.engine import make_optimizer
 from ..utils.logging import get_logger, phase
 
+# Re-exports: batch iterators, eval plumbing, and jitted-step builders
+# split out of this file; importing them from here keeps the historical API.
+from .batches import federated_batches, federated_batches_ragged  # noqa: F401
+from .fedeval import (  # noqa: F401
+    PreparedEval,
+    evaluate_stacked,
+    stack_eval_splits,
+)
+from .fedsteps import (  # noqa: F401
+    FedState,
+    aggregate_round,
+    build_federated_steps,
+)
+
 log = get_logger()
-
-
-class FedState(NamedTuple):
-    """Stacked per-client training state; every leaf's axis 0 is clients."""
-
-    params: Any  # [C, ...]
-    opt_state: Any  # [C, ...]
-    step: jnp.ndarray  # scalar int32 — lockstep across clients
-    rngs: jax.Array  # [C] dropout keys
-    # FedOpt server-optimizer state (single-model shaped, replicated);
-    # None under plain FedAvg. Persists across rounds — the per-round
-    # client optimizer reset does not touch it.
-    server_opt: Any = None
-
-
-def federated_batches(
-    stacked: TokenizedSplit,
-    batch_size: int,
-    *,
-    seed: int,
-    epoch: int,
-    client_offset: int = 0,
-) -> Iterator[dict[str, np.ndarray]]:
-    """Per-epoch batches ``[C, B, ...]`` with an independent shuffle per
-    client (the reference's DataLoader shuffles per client independently,
-    client1.py:370).
-
-    Each permutation is keyed by (seed, epoch, GLOBAL client index) — under
-    multi-host, ``client_offset`` is this process's first global client, so
-    clients on different hosts draw distinct streams and a same-seed
-    multi-host run shuffles identically to its single-host equivalent.
-    """
-    C, N = stacked.labels.shape
-    perms = np.stack(
-        [
-            np.random.default_rng(
-                (seed * 100_003 + epoch) * 1_000_003 + client_offset + c
-            ).permutation(N)
-            for c in range(C)
-        ]
-    )
-    rows = np.arange(C)[:, None]
-    for i in range(N // batch_size):
-        idx = perms[:, i * batch_size : (i + 1) * batch_size]
-        yield {
-            "input_ids": stacked.input_ids[rows, idx],
-            "attention_mask": stacked.attention_mask[rows, idx],
-            "labels": stacked.labels[rows, idx],
-        }
-
-
-def federated_batches_ragged(
-    stacked: StackedClients,
-    batch_size: int,
-    *,
-    seed: int,
-    epoch: int,
-    client_offset: int = 0,
-    n_batches: int | None = None,
-) -> Iterator[dict[str, np.ndarray]]:
-    """Per-epoch ``[C, B, ...]`` batches over a RAGGED client stack, with a
-    ``valid`` ``[C, B]`` 0/1 mask. Each client's real rows are permuted
-    independently (same keying as :func:`federated_batches`) and consumed
-    exactly once per epoch: a client whose rows run out pads its remaining
-    lockstep batches with valid == 0 (its train step is gated off), and the
-    final partial batch mixes real and padding rows. ``n_batches`` lets
-    multi-host callers force the GLOBAL max step count.
-
-    Every batch also carries ``warmup_step`` ``[C, B]`` — each client's OWN
-    executed-step count entering this batch (``epoch * ceil(n_c/bs) +
-    min(i, ceil(n_c/bs))``, broadcast over B so it rides the standard batch
-    sharding). The ragged train step keys LR warmup on it, so a short
-    client's schedule advances only when the client actually steps —
-    matching its independent-run trajectory (the dense path's global
-    ``state.step`` would compress idle clients' warmup ramps)."""
-    C = stacked.split.labels.shape[0]
-    own_steps = np.array(
-        [-(-int(n) // batch_size) for n in stacked.n_rows], np.int32
-    )
-    min_steps = int(own_steps.max())
-    steps = n_batches
-    if steps is None:
-        steps = min_steps
-    elif steps < min_steps:
-        worst = int(own_steps.argmax())
-        raise ValueError(
-            f"n_batches={steps} is smaller than client {worst}'s own epoch "
-            f"length ceil({int(stacked.n_rows[worst])}/{batch_size})="
-            f"{min_steps}; every client's rows must fit the lockstep span"
-        )
-    span = steps * batch_size
-    idx = np.zeros((C, span), np.int64)
-    valid = np.zeros((C, span), np.int32)
-    for c in range(C):
-        n_c = int(stacked.n_rows[c])
-        perm = np.random.default_rng(
-            (seed * 100_003 + epoch) * 1_000_003 + client_offset + c
-        ).permutation(n_c)
-        idx[c, :n_c] = perm
-        valid[c, :n_c] = 1
-    rows = np.arange(C)[:, None]
-    for i in range(steps):
-        sl = slice(i * batch_size, (i + 1) * batch_size)
-        take = idx[:, sl]
-        wstep = epoch * own_steps + np.minimum(i, own_steps)
-        yield {
-            "input_ids": stacked.split.input_ids[rows, take],
-            "attention_mask": stacked.split.attention_mask[rows, take],
-            "labels": stacked.split.labels[rows, take],
-            "valid": valid[:, sl],
-            "warmup_step": np.broadcast_to(
-                wstep[:, None], (C, batch_size)
-            ).copy(),
-        }
-
-
-def stack_eval_splits(
-    splits: Sequence[TokenizedSplit],
-    batch_size: int,
-    pad_id: int = 0,
-    *,
-    target_rows: int | None = None,
-) -> tuple[TokenizedSplit, np.ndarray]:
-    """Pad per-client eval splits to one common ``[C, M, ...]`` stack (M a
-    batch multiple) plus a ``[C, M]`` validity matrix so every real example
-    is counted exactly once per client.
-
-    ``target_rows``: minimum row count before batch-rounding — multi-host
-    processes pass the GLOBAL max split length so every host agrees on M
-    (and therefore on the eval batch count, which is a collective)."""
-    target = max(len(s) for s in splits)
-    if target_rows is not None:
-        target = max(target, target_rows)
-    target += (-target) % batch_size
-    ids, masks, labels, valid = [], [], [], []
-    for s in splits:
-        padded, v = pad_split_to_batch(s, batch_size, pad_id=pad_id)
-        extra = target - len(padded)
-        L = padded.input_ids.shape[1]
-        ids.append(
-            np.concatenate([padded.input_ids, np.full((extra, L), pad_id, np.int32)])
-        )
-        masks.append(
-            np.concatenate([padded.attention_mask, np.zeros((extra, L), np.int32)])
-        )
-        labels.append(np.concatenate([padded.labels, np.zeros(extra, np.int32)]))
-        valid.append(np.concatenate([v, np.zeros(extra, np.int32)]))
-    return (
-        TokenizedSplit(np.stack(ids), np.stack(masks), np.stack(labels)),
-        np.stack(valid),
-    )
-
-
-class PreparedEval(NamedTuple):
-    """Stacked eval splits, padded once and reused across rounds. ROC/PR
-    labels come from the stacked arrays' valid rows (padding appends, so
-    the valid subsequence preserves split order)."""
-
-    stacked: TokenizedSplit  # [C, M, ...] arrays, M a batch multiple
-    valid: np.ndarray  # [C, M] 0/1
-    batch_size: int
 
 
 @dataclass
@@ -268,206 +112,26 @@ class FederatedTrainer:
 
     # ---------------------------------------------------------- jitted steps
     def _build_steps(self) -> None:
-        model, optimizer = self.model, self.optimizer
-        csh, bsh = self.sh.client, self.sh.batch
-        mu = float(self.cfg.fed.prox_mu)
-
-        wsteps = self.cfg.train.warmup_steps
-
-        def local_loss(p, batch, rng, anchor):
-            """Returns (training objective, task loss): gradients flow from
-            the first, logs/round records report the second so FedProx and
-            FedAvg loss curves stay comparable."""
-            task = loss_fn(model, p, batch, rng)
-            total = task
-            if mu > 0.0:
-                # FedProx proximal term vs the round-start globals —
-                # trace-time constant, zero cost at mu=0 (plain FedAvg).
-                sq = sum(
-                    jnp.sum(jnp.square(a - b))
-                    for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(anchor))
-                )
-                total = task + 0.5 * mu * sq
-            return total, task
-
-        def per_client_step(params, opt_state, batch, rng, anchor, step):
-            (_, task), grads = jax.value_and_grad(
-                lambda p: local_loss(p, batch, rng, anchor), has_aux=True
-            )(params)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            updates = apply_warmup(updates, step, wsteps)
-            return optax.apply_updates(params, updates), opt_state, task
-
-        state_sh = FedState(
-            csh, csh, self.sh.replicated, csh, self.sh.replicated
+        """Delegates jitted-program construction to fedsteps (pure function
+        of config/model/optimizer/shardings); keeps only the lifecycle
+        state this trainer owns — lazy ragged compilation and the DP noise
+        seed (OS entropy + multi-host agreement)."""
+        steps = build_federated_steps(
+            self.cfg, self.model, self.optimizer, self.sh
         )
-        batch_sh = {"input_ids": bsh, "attention_mask": bsh, "labels": bsh}
-
-        def _step_body(state: FedState, batch, anchor):
-            step_rngs = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
-                state.rngs, state.step
-            )
-            params, opt_state, losses = jax.vmap(
-                per_client_step,
-                in_axes=(0, 0, 0, 0, 0 if mu > 0.0 else None, None),
-            )(state.params, state.opt_state, batch, step_rngs, anchor, state.step)
-            return (
-                state._replace(
-                    params=params, opt_state=opt_state, step=state.step + 1
-                ),
-                losses,  # [C]
-            )
-
-        if mu > 0.0:
-            # FedProx signature: (state, batch, anchor). The anchor is the
-            # stacked round-start params — a separate buffer, NOT the
-            # donated state.params.
-            train_step = partial(
-                jax.jit,
-                donate_argnums=(0,),
-                in_shardings=(state_sh, batch_sh, csh),
-                out_shardings=(state_sh, csh),
-            )(_step_body)
-        else:
-            # Plain FedAvg signature: (state, batch) — no anchor transfer.
-            train_step = partial(
-                jax.jit,
-                donate_argnums=(0,),
-                in_shardings=(state_sh, batch_sh),
-                out_shardings=(state_sh, csh),
-            )(lambda state, batch: _step_body(state, batch, None))
-
-        def per_client_step_masked(params, opt_state, batch, rng, anchor):
-            """Row-masked variant for the ragged stacked path: the loss
-            averages over the batch's valid rows only, and a client whose
-            lockstep batch is ALL padding keeps its params/optimizer state
-            untouched (zero grads through Adam would still move the moments
-            — a phantom update an independent run never takes)."""
-
-            def obj(p):
-                task = masked_loss_fn(model, p, batch, rng)
-                total = task
-                if mu > 0.0:
-                    sq = sum(
-                        jnp.sum(jnp.square(a - b))
-                        for a, b in zip(
-                            jax.tree.leaves(p), jax.tree.leaves(anchor)
-                        )
-                    )
-                    total = task + 0.5 * mu * sq
-                return total, task
-
-            (_, task), grads = jax.value_and_grad(obj, has_aux=True)(params)
-            updates, new_opt = optimizer.update(grads, opt_state, params)
-            # Warmup rides the client's OWN executed-step count (see
-            # federated_batches_ragged), not the shared lockstep counter —
-            # an idling client's ramp must not advance.
-            updates = apply_warmup(updates, batch["warmup_step"][0], wsteps)
-            new_params = optax.apply_updates(params, updates)
-            has = batch["valid"].sum() > 0
-            params = jax.tree.map(
-                lambda n, o: jnp.where(has, n, o), new_params, params
-            )
-            opt_state = jax.tree.map(
-                lambda n, o: jnp.where(has, n, o), new_opt, opt_state
-            )
-            return params, opt_state, task, has.astype(jnp.float32)
-
-        ragged_batch_sh = dict(batch_sh, valid=bsh, warmup_step=bsh)
-
-        def _ragged_body(state: FedState, batch, anchor):
-            step_rngs = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
-                state.rngs, state.step
-            )
-            params, opt_state, losses, has = jax.vmap(
-                per_client_step_masked,
-                in_axes=(0, 0, 0, 0, 0 if mu > 0.0 else None),
-            )(state.params, state.opt_state, batch, step_rngs, anchor)
-            return (
-                state._replace(
-                    params=params, opt_state=opt_state, step=state.step + 1
-                ),
-                (losses, has),  # [C] masked losses, [C] 0/1 batch-had-rows
-            )
-
-        def _build_ragged_step():
-            if mu > 0.0:
-                return partial(
-                    jax.jit,
-                    donate_argnums=(0,),
-                    in_shardings=(state_sh, ragged_batch_sh, csh),
-                    out_shardings=(state_sh, (csh, csh)),
-                )(_ragged_body)
-            return partial(
-                jax.jit,
-                donate_argnums=(0,),
-                in_shardings=(state_sh, ragged_batch_sh),
-                out_shardings=(state_sh, (csh, csh)),
-            )(lambda state, batch: _ragged_body(state, batch, None))
-
+        self.train_step = steps.train_step
+        self.eval_step = steps.eval_step
+        self.fedavg_step = steps.fedavg_step
+        self.server_tx = steps.server_tx
+        self.server_agg_step = steps.server_agg_step
+        self.dp_fedavg_step = steps.dp_fedavg_step
+        self._opt_init = steps.opt_init
+        self._replicate = steps.replicate
         # Built on first ragged fit_local (equal-client runs never pay the
         # extra compilation).
-        self._build_ragged_step = _build_ragged_step
+        self._build_ragged_step = steps.build_ragged_step
         self._ragged_train_step = None
-
-        @partial(
-            jax.jit,
-            in_shardings=(
-                csh,
-                {"input_ids": bsh, "attention_mask": bsh, "labels": bsh},
-                bsh,
-            ),
-        )
-        def eval_step(stacked_params, batch, valid):
-            return jax.vmap(lambda p, b, v: eval_counts(model, p, b, v))(
-                stacked_params, batch, valid
-            )
-
-        self.train_step = train_step
-        self.eval_step = eval_step
-        self.fedavg_step = make_fedavg_step(self.sh)
-        if self.cfg.fed.server_opt_enabled():
-            from ..parallel.fedavg import make_server_optimizer, weighted_mean
-
-            server_tx = make_server_optimizer(self.cfg.fed)
-            self.server_tx = server_tx
-
-            @partial(
-                jax.jit,
-                in_shardings=(csh, csh, None, None, self.sh.replicated),
-                out_shardings=(csh, self.sh.replicated),
-            )
-            def server_agg_step(stacked_params, anchor, w, m, server_state):
-                """FedOpt round boundary: pseudo-gradient = anchor - mean
-                of (possibly weighted/masked) client params; the server
-                optimizer turns it into the global step, broadcast back to
-                every client shard. All server math in fp32."""
-                mean = weighted_mean(stacked_params, w, m)
-                # Anchor rows are identical (previous round's replicated
-                # output); the mean over axis 0 IS the single-model value.
-                anchor1 = weighted_mean(anchor)
-                g = jax.tree.map(lambda a, mn: a - mn, anchor1, mean)
-                updates, new_state = server_tx.update(g, server_state, anchor1)
-                new1 = optax.apply_updates(anchor1, updates)
-                stacked = jax.tree.map(
-                    lambda n, ref: jnp.broadcast_to(n.astype(ref.dtype), ref.shape),
-                    new1,
-                    stacked_params,
-                )
-                return stacked, new_state
-
-            self.server_agg_step = server_agg_step
-        else:
-            self.server_tx = None
-            self.server_agg_step = None
-        if self.cfg.fed.dp_clip > 0.0:
-            from ..parallel.dp import make_dp_fedavg_step
-
-            self.dp_fedavg_step = make_dp_fedavg_step(
-                self.sh,
-                clip=float(self.cfg.fed.dp_clip),
-                noise_multiplier=float(self.cfg.fed.dp_noise_multiplier),
-            )
+        if self.dp_fedavg_step is not None:
             # Noise seed: fresh OS entropy (the training seed is public
             # config — noise derived from it could be regenerated and
             # subtracted, voiding the guarantee). dp_seed overrides for
@@ -483,20 +147,6 @@ class FederatedTrainer:
 
                 seed = int(allgather_hosts(seed)[0])
             self._dp_seed = seed
-        else:
-            self.dp_fedavg_step = None
-        # vmapped optimizer init, compiled once (reset_optimizer runs it
-        # every round — a fresh jit lambda per call would recompile).
-        self._opt_init = jax.jit(
-            lambda p: jax.vmap(self.optimizer.init)(p),
-            in_shardings=(csh,),
-            out_shardings=csh,
-        )
-        # Host-sync path for clients-sharded values: under multi-process,
-        # shards on other hosts are not addressable — replicate first (an
-        # all-gather over DCN), then np.asarray is local. Single process
-        # short-circuits in _host().
-        self._replicate = jax.jit(lambda x: x, out_shardings=self.sh.replicated)
 
     def _host(self, tree: Any) -> Any:
         """np.asarray over a (possibly clients-sharded) pytree."""
@@ -775,65 +425,9 @@ class FederatedTrainer:
                 "prepared already fixes the eval data and batch size; "
                 "do not also pass splits/batch_size"
             )
-        stacked, valid, bs = prepared.stacked, prepared.valid, prepared.batch_size
-        C = self.C
-        M = stacked.labels.shape[1]
-        # Accumulate the stacked [C] counts on device; one host sync after
-        # the loop (per-batch np.asarray would block async dispatch).
-        totals: BinaryCounts | None = None
-        probs_dev = []
-        for i in range(M // bs):
-            sl = slice(i * bs, (i + 1) * bs)
-            fed = self._feed(
-                {
-                    "input_ids": stacked.input_ids[:, sl],
-                    "attention_mask": stacked.attention_mask[:, sl],
-                    "labels": stacked.labels[:, sl],
-                    "valid": valid[:, sl],
-                }
-            )
-            batch = {k: fed[k] for k in ("input_ids", "attention_mask", "labels")}
-            counts, probs = self.eval_step(stacked_params, batch, fed["valid"])
-            totals = counts if totals is None else totals + counts
-            if collect_probs:
-                probs_dev.append(probs)
-        host = (
-            self._host(totals)
-            if totals is not None
-            else BinaryCounts(*(np.zeros(C, np.float32) for _ in BinaryCounts._fields))
+        return evaluate_stacked(
+            self, stacked_params, prepared, collect_probs=collect_probs
         )
-        out = []
-        all_probs = None
-        labels_g, valid_g = stacked.labels, valid
-        if probs_dev:
-            # Probs accumulate as GLOBAL [C, bs] device arrays (the eval
-            # step's output sharding); _host replicates across processes
-            # first, so every host sees every client's probabilities.
-            all_probs = np.asarray(
-                self._host(jnp.concatenate(probs_dev, axis=1))
-            )
-            if self.P > 1:
-                # The host-side labels/validity cover only LOCAL clients;
-                # gather them process-major (the global client order).
-                from jax.experimental import multihost_utils
-
-                M_pad = stacked.labels.shape[1]
-                labels_g = np.asarray(
-                    multihost_utils.process_allgather(stacked.labels)
-                ).reshape(-1, M_pad)
-                valid_g = np.asarray(
-                    multihost_utils.process_allgather(valid)
-                ).reshape(-1, M_pad)
-        for c in range(C):
-            m = finalize_metrics(BinaryCounts(*(v[c] for v in host)))
-            if collect_probs and all_probs is not None:
-                # Padding appends rows, so the valid-row subsequence IS the
-                # original split order (pad_split_to_batch/stack_eval_splits).
-                mask_c = valid_g[c, : all_probs.shape[1]] == 1
-                m["probs"] = all_probs[c][mask_c]
-                m["labels"] = labels_g[c][mask_c]
-            out.append(m)
-        return out
 
     def participation_mask(self, round_index: int) -> np.ndarray | None:
         """Per-round participant sampling (FedConfig.participation < 1):
@@ -873,74 +467,16 @@ class FederatedTrainer:
         anchor: Any | None = None,
         round_index: int = 0,
     ) -> FedState:
-        """The FedAvg round boundary. Enforces min_client_fraction (the
-        reference instead refuses unless exactly N models arrived,
-        server.py:69-71). With ``fed.dp_clip > 0`` the boundary runs
-        DP-FedAvg (parallel/dp.py): pass the ``round_anchor`` captured
-        before local training plus the round index (noise key)."""
-        if client_mask is not None:
-            surviving = float(np.asarray(client_mask).sum())
-            if surviving == 0.0 or surviving < self.cfg.fed.min_client_fraction * self.C:
-                raise RuntimeError(
-                    f"only {int(surviving)}/{self.C} clients survived the round "
-                    f"(min_client_fraction={self.cfg.fed.min_client_fraction})"
-                )
-        if weights is not None:
-            eff = np.asarray(weights, dtype=np.float64)
-            if client_mask is not None:
-                eff = eff * np.asarray(client_mask, dtype=np.float64)
-            if eff.sum() <= 0.0:
-                # fedavg's jitted mean clamps the divisor; a zero weight sum
-                # would silently zero every parameter.
-                raise ValueError(
-                    "effective FedAvg weight sum is zero (all-zero weights, "
-                    "or every weighted client masked out)"
-                )
-        w = None if weights is None else jnp.asarray(weights)
-        m = None if client_mask is None else jnp.asarray(client_mask)
-        needs_anchor = (
-            self.dp_fedavg_step is not None or self.server_agg_step is not None
+        """The FedAvg round boundary — dispatch in fedsteps.aggregate_round
+        (plain/weighted/masked FedAvg, DP-FedAvg, FedOpt)."""
+        return aggregate_round(
+            self,
+            state,
+            weights=weights,
+            client_mask=client_mask,
+            anchor=anchor,
+            round_index=round_index,
         )
-        if needs_anchor and anchor is None:
-            raise ValueError(
-                "DP and/or FedOpt aggregation needs the round-start anchor "
-                "— capture it with round_anchor(state) before fit_local"
-            )
-        if self.dp_fedavg_step is not None:
-            if w is not None:
-                raise ValueError(
-                    "DP aggregation is a uniform mean (FedConfig forbids "
-                    "weighted=True with dp_clip); do not pass weights"
-                )
-            base, norms = self.dp_fedavg_step(
-                state.params, anchor, self._dp_key(round_index), m
-            )
-            # DP output is already the (uniform, noised) aggregate
-            # replicated across rows; any server step consumes it as-is.
-            w_srv = m_srv = None
-            # Log stats over PARTICIPANTS only — masked-out clients' norms
-            # never touched the aggregate and would skew clip-rate tuning.
-            hn = np.asarray(self._host(norms))
-            if client_mask is not None:
-                hn = hn[np.asarray(client_mask) > 0]
-            clipped = int((hn > self.cfg.fed.dp_clip).sum())
-            log.info(
-                f"[DP] round {round_index}: participant update norms "
-                f"median {np.median(hn):.4g} max {hn.max():.4g}; "
-                f"{clipped}/{hn.size} participants clipped at "
-                f"{self.cfg.fed.dp_clip}"
-            )
-        else:
-            base, w_srv, m_srv = state.params, w, m
-        already_aggregated = self.dp_fedavg_step is not None
-        if self.server_agg_step is not None:
-            params, server_state = self.server_agg_step(
-                base, anchor, w_srv, m_srv, state.server_opt
-            )
-            return state._replace(params=params, server_opt=server_state)
-        if already_aggregated:
-            return state._replace(params=base)
-        return state._replace(params=self.fedavg_step(base, w_srv, m_srv))
 
     # ------------------------------------------------------------------- run
     def run(

@@ -1,0 +1,135 @@
+"""Federated evaluation plumbing: eval-split stacking and the stacked
+metrics loop.
+
+The reference evaluates each client separately with a host-side sklearn
+pass (client1.py:118-150); here all C clients evaluate in one jitted
+vmapped sweep over a padded ``[C, M, ...]`` stack, with on-device
+BinaryCounts accumulation and one host sync per evaluation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.pipeline import TokenizedSplit, pad_split_to_batch
+from ..ops.metrics import BinaryCounts, finalize_metrics
+
+
+def stack_eval_splits(
+    splits: Sequence[TokenizedSplit],
+    batch_size: int,
+    pad_id: int = 0,
+    *,
+    target_rows: int | None = None,
+) -> tuple[TokenizedSplit, np.ndarray]:
+    """Pad per-client eval splits to one common ``[C, M, ...]`` stack (M a
+    batch multiple) plus a ``[C, M]`` validity matrix so every real example
+    is counted exactly once per client.
+
+    ``target_rows``: minimum row count before batch-rounding — multi-host
+    processes pass the GLOBAL max split length so every host agrees on M
+    (and therefore on the eval batch count, which is a collective)."""
+    target = max(len(s) for s in splits)
+    if target_rows is not None:
+        target = max(target, target_rows)
+    target += (-target) % batch_size
+    ids, masks, labels, valid = [], [], [], []
+    for s in splits:
+        padded, v = pad_split_to_batch(s, batch_size, pad_id=pad_id)
+        extra = target - len(padded)
+        L = padded.input_ids.shape[1]
+        ids.append(
+            np.concatenate([padded.input_ids, np.full((extra, L), pad_id, np.int32)])
+        )
+        masks.append(
+            np.concatenate([padded.attention_mask, np.zeros((extra, L), np.int32)])
+        )
+        labels.append(np.concatenate([padded.labels, np.zeros(extra, np.int32)]))
+        valid.append(np.concatenate([v, np.zeros(extra, np.int32)]))
+    return (
+        TokenizedSplit(np.stack(ids), np.stack(masks), np.stack(labels)),
+        np.stack(valid),
+    )
+
+
+class PreparedEval(NamedTuple):
+    """Stacked eval splits, padded once and reused across rounds. ROC/PR
+    labels come from the stacked arrays' valid rows (padding appends, so
+    the valid subsequence preserves split order)."""
+
+    stacked: TokenizedSplit  # [C, M, ...] arrays, M a batch multiple
+    valid: np.ndarray  # [C, M] 0/1
+    batch_size: int
+
+
+def evaluate_stacked(
+    trainer,
+    stacked_params: Any,
+    prepared: PreparedEval,
+    *,
+    collect_probs: bool = False,
+) -> list[dict]:
+    """Per-client metrics dicts (reference five-metric schema) from one
+    sweep of the trainer's jitted eval step over a prepared stack."""
+    stacked, valid, bs = prepared.stacked, prepared.valid, prepared.batch_size
+    C = trainer.C
+    M = stacked.labels.shape[1]
+    # Accumulate the stacked [C] counts on device; one host sync after
+    # the loop (per-batch np.asarray would block async dispatch).
+    totals: BinaryCounts | None = None
+    probs_dev = []
+    for i in range(M // bs):
+        sl = slice(i * bs, (i + 1) * bs)
+        fed = trainer._feed(
+            {
+                "input_ids": stacked.input_ids[:, sl],
+                "attention_mask": stacked.attention_mask[:, sl],
+                "labels": stacked.labels[:, sl],
+                "valid": valid[:, sl],
+            }
+        )
+        batch = {k: fed[k] for k in ("input_ids", "attention_mask", "labels")}
+        counts, probs = trainer.eval_step(stacked_params, batch, fed["valid"])
+        totals = counts if totals is None else totals + counts
+        if collect_probs:
+            probs_dev.append(probs)
+    host = (
+        trainer._host(totals)
+        if totals is not None
+        else BinaryCounts(*(np.zeros(C, np.float32) for _ in BinaryCounts._fields))
+    )
+    out = []
+    all_probs = None
+    labels_g, valid_g = stacked.labels, valid
+    if probs_dev:
+        # Probs accumulate as GLOBAL [C, bs] device arrays (the eval
+        # step's output sharding); _host replicates across processes
+        # first, so every host sees every client's probabilities.
+        all_probs = np.asarray(
+            trainer._host(jnp.concatenate(probs_dev, axis=1))
+        )
+        if trainer.P > 1:
+            # The host-side labels/validity cover only LOCAL clients;
+            # gather them process-major (the global client order).
+            from jax.experimental import multihost_utils
+
+            M_pad = stacked.labels.shape[1]
+            labels_g = np.asarray(
+                multihost_utils.process_allgather(stacked.labels)
+            ).reshape(-1, M_pad)
+            valid_g = np.asarray(
+                multihost_utils.process_allgather(valid)
+            ).reshape(-1, M_pad)
+    for c in range(C):
+        m = finalize_metrics(BinaryCounts(*(v[c] for v in host)))
+        if collect_probs and all_probs is not None:
+            # Padding appends rows, so the valid-row subsequence IS the
+            # original split order (pad_split_to_batch/stack_eval_splits).
+            mask_c = valid_g[c, : all_probs.shape[1]] == 1
+            m["probs"] = all_probs[c][mask_c]
+            m["labels"] = labels_g[c][mask_c]
+        out.append(m)
+    return out

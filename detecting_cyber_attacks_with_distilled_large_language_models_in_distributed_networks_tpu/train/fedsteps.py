@@ -1,0 +1,366 @@
+"""Jitted federated step construction: the SPMD programs the trainer runs.
+
+One stacked ``[C, ...]`` parameter tree sharded over the ``clients`` mesh
+axis; one vmapped train step advances every client in lockstep on its
+private shard (the reference instead runs N separate OS processes,
+client1.py:96-115 per process). ``build_federated_steps`` is a pure
+function of (config, model, optimizer, shardings); ``aggregate_round`` is
+the round-boundary dispatch over those steps — it takes the trainer as a
+facade (cfg/steps/_host/_dp_key) and is called only through
+``FederatedTrainer.aggregate``. Lifecycle and multi-host sync stay in
+train/federated.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import numpy as np
+
+from ..parallel.fedavg import make_fedavg_step
+from ..train.engine import (
+    apply_warmup,
+    eval_counts,
+    loss_fn,
+    masked_loss_fn,
+)
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+
+class FedState(NamedTuple):
+    """Stacked per-client training state; every leaf's axis 0 is clients."""
+
+    params: Any  # [C, ...]
+    opt_state: Any  # [C, ...]
+    step: jnp.ndarray  # scalar int32 — lockstep across clients
+    rngs: jax.Array  # [C] dropout keys
+    # FedOpt server-optimizer state (single-model shaped, replicated);
+    # None under plain FedAvg. Persists across rounds — the per-round
+    # client optimizer reset does not touch it.
+    server_opt: Any = None
+
+
+class FedSteps(NamedTuple):
+    """The jitted programs + lazy builders behind a FederatedTrainer."""
+
+    train_step: Callable  # (state, batch[, anchor]) -> (state, [C] losses)
+    build_ragged_step: Callable  # () -> ragged train step (compiled on demand)
+    eval_step: Callable  # (params, batch, valid) -> (BinaryCounts, probs)
+    fedavg_step: Callable
+    server_tx: Any  # optax server optimizer | None
+    server_agg_step: Callable | None
+    dp_fedavg_step: Callable | None
+    opt_init: Callable  # stacked params -> stacked opt state
+    replicate: Callable  # clients-sharded tree -> replicated tree
+
+
+def build_federated_steps(cfg, model, optimizer, sh) -> FedSteps:
+    """Compile-ready step closures for one experiment configuration.
+
+    ``sh``: parallel.mesh.FedShardings — fixes how every input/output lays
+    over the ``clients x data`` mesh, so jit inserts the collectives (the
+    reference's entire TCP protocol, client1.py:246-336) at trace time."""
+    csh, bsh = sh.client, sh.batch
+    mu = float(cfg.fed.prox_mu)
+    wsteps = cfg.train.warmup_steps
+
+    def local_loss(p, batch, rng, anchor):
+        """Returns (training objective, task loss): gradients flow from
+        the first, logs/round records report the second so FedProx and
+        FedAvg loss curves stay comparable."""
+        task = loss_fn(model, p, batch, rng)
+        total = task
+        if mu > 0.0:
+            # FedProx proximal term vs the round-start globals —
+            # trace-time constant, zero cost at mu=0 (plain FedAvg).
+            sq = sum(
+                jnp.sum(jnp.square(a - b))
+                for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(anchor))
+            )
+            total = task + 0.5 * mu * sq
+        return total, task
+
+    def per_client_step(params, opt_state, batch, rng, anchor, step):
+        (_, task), grads = jax.value_and_grad(
+            lambda p: local_loss(p, batch, rng, anchor), has_aux=True
+        )(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        updates = apply_warmup(updates, step, wsteps)
+        return optax.apply_updates(params, updates), opt_state, task
+
+    state_sh = FedState(csh, csh, sh.replicated, csh, sh.replicated)
+    batch_sh = {"input_ids": bsh, "attention_mask": bsh, "labels": bsh}
+
+    def _step_body(state: FedState, batch, anchor):
+        step_rngs = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+            state.rngs, state.step
+        )
+        params, opt_state, losses = jax.vmap(
+            per_client_step,
+            in_axes=(0, 0, 0, 0, 0 if mu > 0.0 else None, None),
+        )(state.params, state.opt_state, batch, step_rngs, anchor, state.step)
+        return (
+            state._replace(
+                params=params, opt_state=opt_state, step=state.step + 1
+            ),
+            losses,  # [C]
+        )
+
+    if mu > 0.0:
+        # FedProx signature: (state, batch, anchor). The anchor is the
+        # stacked round-start params — a separate buffer, NOT the
+        # donated state.params.
+        train_step = partial(
+            jax.jit,
+            donate_argnums=(0,),
+            in_shardings=(state_sh, batch_sh, csh),
+            out_shardings=(state_sh, csh),
+        )(_step_body)
+    else:
+        # Plain FedAvg signature: (state, batch) — no anchor transfer.
+        train_step = partial(
+            jax.jit,
+            donate_argnums=(0,),
+            in_shardings=(state_sh, batch_sh),
+            out_shardings=(state_sh, csh),
+        )(lambda state, batch: _step_body(state, batch, None))
+
+    def per_client_step_masked(params, opt_state, batch, rng, anchor):
+        """Row-masked variant for the ragged stacked path: the loss
+        averages over the batch's valid rows only, and a client whose
+        lockstep batch is ALL padding keeps its params/optimizer state
+        untouched (zero grads through Adam would still move the moments
+        — a phantom update an independent run never takes)."""
+
+        def obj(p):
+            task = masked_loss_fn(model, p, batch, rng)
+            total = task
+            if mu > 0.0:
+                sq = sum(
+                    jnp.sum(jnp.square(a - b))
+                    for a, b in zip(
+                        jax.tree.leaves(p), jax.tree.leaves(anchor)
+                    )
+                )
+                total = task + 0.5 * mu * sq
+            return total, task
+
+        (_, task), grads = jax.value_and_grad(obj, has_aux=True)(params)
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        # Warmup rides the client's OWN executed-step count (see
+        # train/batches.py federated_batches_ragged), not the shared
+        # lockstep counter — an idling client's ramp must not advance.
+        updates = apply_warmup(updates, batch["warmup_step"][0], wsteps)
+        new_params = optax.apply_updates(params, updates)
+        has = batch["valid"].sum() > 0
+        params = jax.tree.map(
+            lambda n, o: jnp.where(has, n, o), new_params, params
+        )
+        opt_state = jax.tree.map(
+            lambda n, o: jnp.where(has, n, o), new_opt, opt_state
+        )
+        return params, opt_state, task, has.astype(jnp.float32)
+
+    ragged_batch_sh = dict(batch_sh, valid=bsh, warmup_step=bsh)
+
+    def _ragged_body(state: FedState, batch, anchor):
+        step_rngs = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+            state.rngs, state.step
+        )
+        params, opt_state, losses, has = jax.vmap(
+            per_client_step_masked,
+            in_axes=(0, 0, 0, 0, 0 if mu > 0.0 else None),
+        )(state.params, state.opt_state, batch, step_rngs, anchor)
+        return (
+            state._replace(
+                params=params, opt_state=opt_state, step=state.step + 1
+            ),
+            (losses, has),  # [C] masked losses, [C] 0/1 batch-had-rows
+        )
+
+    def build_ragged_step():
+        """Built on first ragged fit_local (equal-client runs never pay
+        the extra compilation)."""
+        if mu > 0.0:
+            return partial(
+                jax.jit,
+                donate_argnums=(0,),
+                in_shardings=(state_sh, ragged_batch_sh, csh),
+                out_shardings=(state_sh, (csh, csh)),
+            )(_ragged_body)
+        return partial(
+            jax.jit,
+            donate_argnums=(0,),
+            in_shardings=(state_sh, ragged_batch_sh),
+            out_shardings=(state_sh, (csh, csh)),
+        )(lambda state, batch: _ragged_body(state, batch, None))
+
+    @partial(
+        jax.jit,
+        in_shardings=(
+            csh,
+            {"input_ids": bsh, "attention_mask": bsh, "labels": bsh},
+            bsh,
+        ),
+    )
+    def eval_step(stacked_params, batch, valid):
+        return jax.vmap(lambda p, b, v: eval_counts(model, p, b, v))(
+            stacked_params, batch, valid
+        )
+
+    if cfg.fed.server_opt_enabled():
+        from ..parallel.fedavg import make_server_optimizer, weighted_mean
+
+        server_tx = make_server_optimizer(cfg.fed)
+
+        @partial(
+            jax.jit,
+            in_shardings=(csh, csh, None, None, sh.replicated),
+            out_shardings=(csh, sh.replicated),
+        )
+        def server_agg_step(stacked_params, anchor, w, m, server_state):
+            """FedOpt round boundary: pseudo-gradient = anchor - mean
+            of (possibly weighted/masked) client params; the server
+            optimizer turns it into the global step, broadcast back to
+            every client shard. All server math in fp32."""
+            mean = weighted_mean(stacked_params, w, m)
+            # Anchor rows are identical (previous round's replicated
+            # output); the mean over axis 0 IS the single-model value.
+            anchor1 = weighted_mean(anchor)
+            g = jax.tree.map(lambda a, mn: a - mn, anchor1, mean)
+            updates, new_state = server_tx.update(g, server_state, anchor1)
+            new1 = optax.apply_updates(anchor1, updates)
+            stacked = jax.tree.map(
+                lambda n, ref: jnp.broadcast_to(n.astype(ref.dtype), ref.shape),
+                new1,
+                stacked_params,
+            )
+            return stacked, new_state
+
+    else:
+        server_tx = None
+        server_agg_step = None
+
+    if cfg.fed.dp_clip > 0.0:
+        from ..parallel.dp import make_dp_fedavg_step
+
+        dp_fedavg_step = make_dp_fedavg_step(
+            sh,
+            clip=float(cfg.fed.dp_clip),
+            noise_multiplier=float(cfg.fed.dp_noise_multiplier),
+        )
+    else:
+        dp_fedavg_step = None
+
+    # vmapped optimizer init, compiled once (reset_optimizer runs it
+    # every round — a fresh jit lambda per call would recompile).
+    opt_init = jax.jit(
+        lambda p: jax.vmap(optimizer.init)(p),
+        in_shardings=(csh,),
+        out_shardings=csh,
+    )
+    # Host-sync path for clients-sharded values: under multi-process,
+    # shards on other hosts are not addressable — replicate first (an
+    # all-gather over DCN), then np.asarray is local. Single process
+    # short-circuits in the trainer's _host().
+    replicate = jax.jit(lambda x: x, out_shardings=sh.replicated)
+
+    return FedSteps(
+        train_step=train_step,
+        build_ragged_step=build_ragged_step,
+        eval_step=eval_step,
+        fedavg_step=make_fedavg_step(sh),
+        server_tx=server_tx,
+        server_agg_step=server_agg_step,
+        dp_fedavg_step=dp_fedavg_step,
+        opt_init=opt_init,
+        replicate=replicate,
+    )
+
+
+def aggregate_round(
+    trainer,
+    state: FedState,
+    *,
+    weights: np.ndarray | None = None,
+    client_mask: np.ndarray | None = None,
+    anchor: Any | None = None,
+    round_index: int = 0,
+) -> FedState:
+    """The FedAvg round boundary. Enforces min_client_fraction (the
+    reference instead refuses unless exactly N models arrived,
+    server.py:69-71). With ``fed.dp_clip > 0`` the boundary runs
+    DP-FedAvg (parallel/dp.py): pass the ``round_anchor`` captured
+    before local training plus the round index (noise key)."""
+    cfg = trainer.cfg
+    C = trainer.C
+    if client_mask is not None:
+        surviving = float(np.asarray(client_mask).sum())
+        if surviving == 0.0 or surviving < cfg.fed.min_client_fraction * C:
+            raise RuntimeError(
+                f"only {int(surviving)}/{C} clients survived the round "
+                f"(min_client_fraction={cfg.fed.min_client_fraction})"
+            )
+    if weights is not None:
+        eff = np.asarray(weights, dtype=np.float64)
+        if client_mask is not None:
+            eff = eff * np.asarray(client_mask, dtype=np.float64)
+        if eff.sum() <= 0.0:
+            # fedavg's jitted mean clamps the divisor; a zero weight sum
+            # would silently zero every parameter.
+            raise ValueError(
+                "effective FedAvg weight sum is zero (all-zero weights, "
+                "or every weighted client masked out)"
+            )
+    w = None if weights is None else jnp.asarray(weights)
+    m = None if client_mask is None else jnp.asarray(client_mask)
+    needs_anchor = (
+        trainer.dp_fedavg_step is not None or trainer.server_agg_step is not None
+    )
+    if needs_anchor and anchor is None:
+        raise ValueError(
+            "DP and/or FedOpt aggregation needs the round-start anchor "
+            "— capture it with round_anchor(state) before fit_local"
+        )
+    if trainer.dp_fedavg_step is not None:
+        if w is not None:
+            raise ValueError(
+                "DP aggregation is a uniform mean (FedConfig forbids "
+                "weighted=True with dp_clip); do not pass weights"
+            )
+        base, norms = trainer.dp_fedavg_step(
+            state.params, anchor, trainer._dp_key(round_index), m
+        )
+        # DP output is already the (uniform, noised) aggregate
+        # replicated across rows; any server step consumes it as-is.
+        w_srv = m_srv = None
+        # Log stats over PARTICIPANTS only — masked-out clients' norms
+        # never touched the aggregate and would skew clip-rate tuning.
+        hn = np.asarray(trainer._host(norms))
+        if client_mask is not None:
+            hn = hn[np.asarray(client_mask) > 0]
+        clipped = int((hn > cfg.fed.dp_clip).sum())
+        log.info(
+            f"[DP] round {round_index}: participant update norms "
+            f"median {np.median(hn):.4g} max {hn.max():.4g}; "
+            f"{clipped}/{hn.size} participants clipped at "
+            f"{cfg.fed.dp_clip}"
+        )
+    else:
+        base, w_srv, m_srv = state.params, w, m
+    already_aggregated = trainer.dp_fedavg_step is not None
+    if trainer.server_agg_step is not None:
+        params, server_state = trainer.server_agg_step(
+            base, anchor, w_srv, m_srv, state.server_opt
+        )
+        return state._replace(params=params, server_opt=server_state)
+    if already_aggregated:
+        return state._replace(params=base)
+    return state._replace(params=trainer.fedavg_step(base, w_srv, m_srv))
