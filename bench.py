@@ -298,6 +298,19 @@ def _preflight() -> None:
     on the first executable. Retries init a few times, then bounds a tiny
     device round-trip with a watchdog."""
     attempts = max(1, int(os.environ.get("BENCH_INIT_RETRIES", "3")))
+    timeout = int(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "120"))
+    # The watchdog must cover backend init as well: the tunnel has been
+    # observed to HANG inside jax.devices() (not raise), which no
+    # try/except can bound.
+    guard = _watchdog(
+        timeout,
+        {
+            "metric": "bench_error",
+            "error": "tunnel_stalled",
+            "detail": f"backend init or the trivial jit round-trip exceeded "
+            f"{timeout}s; tunnel degraded — retry later",
+        },
+    )
     last = None
     for attempt in range(attempts):
         try:
@@ -308,6 +321,7 @@ def _preflight() -> None:
             if attempt + 1 < attempts:
                 time.sleep(5)
     else:
+        guard.cancel()
         _emit(
             {
                 "metric": "bench_error",
@@ -317,7 +331,9 @@ def _preflight() -> None:
             }
         )
         raise SystemExit(2)
-    timeout = int(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "120"))
+    # Fresh full budget for the first executable (init retries + sleeps may
+    # have eaten most of the first window on a slow-but-working tunnel).
+    guard.cancel()
     guard = _watchdog(
         timeout,
         {

@@ -252,7 +252,7 @@ def cmd_federated(args) -> int:
         ckpt.close()
 
     if cfg.fed.dp_clip > 0.0 and cfg.fed.dp_noise_multiplier > 0.0:
-        from ..parallel.dp import dp_epsilon
+        from ..parallel.dp import dp_epsilon_both
 
         # Only the rounds executed THIS launch are known to have run under
         # this DP config; a resumed checkpoint's earlier rounds may have
@@ -262,11 +262,9 @@ def cmd_federated(args) -> int:
         # privacy amplification (parallel/dp.py::sgm_rdp). The rate is the
         # EFFECTIVE cohort_size/C, not the nominal fraction — ceil rounding
         # can sample a much larger cohort than the flag says.
-        eps = dp_epsilon(
-            dp_rounds,
-            cfg.fed.dp_noise_multiplier,
-            1e-5,
-            sampling_rate=cfg.fed.effective_participation(),
+        q = cfg.fed.effective_participation()
+        eps_zeroed, eps_replace = dp_epsilon_both(
+            dp_rounds, cfg.fed.dp_noise_multiplier, 1e-5, sampling_rate=q
         )
         caveat = (
             ""
@@ -277,10 +275,20 @@ def cmd_federated(args) -> int:
                 "config they were run with"
             )
         )
+        # Both adjacency bounds, every run: the zeroed-contribution figure
+        # alone reads ~4x stronger than the same noise under the stricter
+        # replace-one adjacency (parallel/dp.py module docstring).
+        sampling_note = (
+            ""
+            if q >= 1.0
+            else f"; fixed-size cohort accounted as Poisson sampling q={q:.3g}"
+        )
         log.info(
             f"[DP] client-level guarantee for {dp_rounds} round(s): "
-            f"({eps:.3g}, 1e-05)-DP "
-            f"(clip {cfg.fed.dp_clip}, noise x{cfg.fed.dp_noise_multiplier})"
+            f"({eps_zeroed:.3g}, 1e-05)-DP under zeroed-contribution "
+            f"adjacency; ({eps_replace:.3g}, 1e-05)-DP under replace-one "
+            f"adjacency (clip {cfg.fed.dp_clip}, "
+            f"noise x{cfg.fed.dp_noise_multiplier}{sampling_note})"
             f"{caveat}"
         )
 

@@ -235,3 +235,29 @@ def dp_epsilon(
         eps += log_delta_inv / (a - 1.0)
         best = min(best, eps)
     return best
+
+
+def dp_epsilon_both(
+    rounds: int,
+    noise_multiplier: float,
+    delta: float,
+    *,
+    sampling_rate: float = 1.0,
+) -> tuple[float, float]:
+    """Epsilon under BOTH adjacency notions, same mechanism and noise:
+
+    * zeroed-contribution (McMahan et al. fixed-divisor, sensitivity
+      ``clip/n``) — the convention :func:`dp_epsilon` reports;
+    * replace-one (one client's update swapped for an arbitrary other,
+      sensitivity ``2*clip/n``) — the same noise is only half as many
+      sigmas of the doubled sensitivity, i.e. an effective noise
+      multiplier of ``noise_multiplier / 2``.
+
+    Operators should see both: the favorable bound alone overstates the
+    protection against the stricter, more common adjacency reading."""
+    return (
+        dp_epsilon(rounds, noise_multiplier, delta, sampling_rate=sampling_rate),
+        dp_epsilon(
+            rounds, noise_multiplier / 2.0, delta, sampling_rate=sampling_rate
+        ),
+    )

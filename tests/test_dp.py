@@ -399,6 +399,27 @@ def test_dp_epsilon_never_worse_than_full_bound():
             assert dp_epsilon(100, sigma, 1e-5, sampling_rate=q) <= full
 
 
+def test_dp_epsilon_both_adjacency_bounds_pinned():
+    """Both adjacency bounds the run banner prints, value-pinned for a
+    known (q, sigma, T) triple. Replace-one adjacency doubles the mean's
+    sensitivity (2*clip/n), equivalent to halving the effective noise
+    multiplier — the same mechanism reads ~3-4x weaker in epsilon."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.parallel.dp import (
+        dp_epsilon_both,
+    )
+
+    e_zeroed, e_replace = dp_epsilon_both(10, 1.0, 1e-5, sampling_rate=0.25)
+    assert abs(e_zeroed - 7.914871206627728) < 1e-9
+    assert abs(e_replace - 26.21441811260802) < 1e-9
+    # The replace-one figure IS the zeroed bound at half the multiplier.
+    assert e_replace == dp_epsilon(10, 0.5, 1e-5, sampling_rate=0.25)
+    # Full participation variant, also pinned.
+    f_zeroed, f_replace = dp_epsilon_both(3, 2.0, 1e-5)
+    assert abs(f_zeroed - 4.530759175449132) < 1e-9
+    assert abs(f_replace - 9.811759094632224) < 1e-9
+    assert e_replace > e_zeroed and f_replace > f_zeroed
+
+
 def test_effective_participation_feeds_accountant():
     """ceil-rounded cohorts: --participation 0.26 of 4 clients samples 2
     (q=0.5); the accountant and the sampler must agree on that rate."""
