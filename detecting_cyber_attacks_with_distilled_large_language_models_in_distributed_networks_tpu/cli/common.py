@@ -162,7 +162,9 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
                 ),
             ),
             mesh=MeshConfig(
-                clients=n, data=getattr(args, "data_parallel", None) or cfg.mesh.data
+                clients=n,
+                data=getattr(args, "data_parallel", None) or cfg.mesh.data,
+                seq=getattr(args, "seq_parallel", None) or cfg.mesh.seq,
             ),
         )
     if getattr(args, "output_dir", None):

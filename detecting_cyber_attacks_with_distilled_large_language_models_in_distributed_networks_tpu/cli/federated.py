@@ -63,6 +63,14 @@ def cmd_federated(args) -> int:
 
     tok, cfg, pretrained = _resolve_with_pretrained(args)
     C = cfg.fed.num_clients
+    if cfg.mesh.seq > 1 and jax.process_count() > 1:
+        # Knowable from argv + process count alone: fail before the (big)
+        # data load, like every other unfittable-config case here.
+        raise SystemExit(
+            "--seq-parallel is single-host for now (the 3-axis mesh would "
+            "place the seq ring across DCN); shard clients over hosts with "
+            "the 2-axis path instead"
+        )
     if jax.process_count() > 1:
         from ..parallel.multihost import local_client_slice, make_global_mesh
 
@@ -127,7 +135,15 @@ def cmd_federated(args) -> int:
         pad_id=tok.pad_id,
         target_rows=max(train_sizes),
     )
-    trainer = FederatedTrainer(cfg, pad_id=tok.pad_id, mesh=mesh)
+    if cfg.mesh.seq > 1:
+        # --seq-parallel N: the 3-axis clients x data x seq composition
+        # (ring attention per client) behind the identical trainer surface
+        # — eval, reports, checkpointing, DP all flow through unchanged.
+        from ..train.seqfed import FedSeqTrainer
+
+        trainer = FedSeqTrainer(cfg, pad_id=tok.pad_id, mesh=mesh)
+    else:
+        trainer = FederatedTrainer(cfg, pad_id=tok.pad_id, mesh=mesh)
 
     ckpt = None
     start_round = 0

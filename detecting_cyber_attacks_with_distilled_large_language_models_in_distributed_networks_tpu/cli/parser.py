@@ -157,6 +157,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-clients", type=int, default=None)  # None: config wins
     p.add_argument("--rounds", type=int)
     p.add_argument("--data-parallel", type=int, help="per-client data-parallel shards")
+    p.add_argument(
+        "--seq-parallel",
+        type=int,
+        help="sequence-parallel shards per client (ring attention over a "
+        "third 'seq' mesh axis; model.max_len must divide by it)",
+    )
     g = p.add_mutually_exclusive_group()
     g.add_argument(
         "--weighted",

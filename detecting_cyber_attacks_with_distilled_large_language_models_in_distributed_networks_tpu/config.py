@@ -62,6 +62,10 @@ class ModelConfig:
     # Mesh axis the sequence dimension is sharded over when attention_impl
     # is "ring" (the forward must run inside shard_map with this axis bound).
     ring_axis: str = "seq"
+    # Mesh axis the batch dimension shards over inside the same shard_map
+    # (fedseq): hash-dropout masks offset their row coordinate by this
+    # axis's shard index so data shards draw independent masks.
+    data_axis: str = "data"
     remat: bool = False
 
     def __post_init__(self) -> None:
@@ -77,13 +81,9 @@ class ModelConfig:
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
         if self.gelu not in ("exact", "tanh"):
             raise ValueError(f"unknown gelu {self.gelu!r} (exact|tanh)")
-        if self.attention_impl == "ring" and self.attention_dropout > 0.0:
-            raise ValueError(
-                "attention_impl='ring' does not implement attention "
-                "dropout; set attention_dropout=0.0 (the head/FFN dropouts "
-                "still apply). The flash kernel supports it (hash-based "
-                "masks, ops/flash_attention.py)."
-            )
+        # attention_impl='ring' supports attention dropout since the ring
+        # gained global-coordinate hash masks (parallel/ring_attention.py);
+        # no impl/dropout combination is invalid anymore.
 
     @property
     def head_dim(self) -> int:
@@ -384,7 +384,14 @@ class MeshConfig:
 
     clients: int = 2
     data: int = 1
+    # Sequence-parallel axis (ring attention): >1 adds a third ``seq`` mesh
+    # axis and routes `federated` through FedSeqTrainer (--seq-parallel N).
+    seq: int = 1
     axis_names: tuple[str, str] = ("clients", "data")
+
+    def __post_init__(self) -> None:
+        if self.seq < 1:
+            raise ValueError(f"mesh.seq={self.seq} must be >= 1")
 
 
 @dataclass(frozen=True)

@@ -47,6 +47,41 @@ def _axis_bound(axis_name: str) -> bool:
         return False
 
 
+def _drop_offsets(cfg: ModelConfig, batch_len: int, *, pos_len: int | None):
+    """Global-coordinate offsets for hash-dropout masks inside shard_map:
+    axis 0 (batch rows) offsets by the data-shard index — rows on
+    different data shards must not reuse one mask — and the position axis
+    by the seq-shard index. Unbound axes contribute offset 0."""
+    offsets: dict[int, Any] = {}
+    if _axis_bound(cfg.data_axis):
+        offsets[0] = jax.lax.axis_index(cfg.data_axis) * batch_len
+    if pos_len is not None:
+        offsets[1] = jax.lax.axis_index(cfg.ring_axis) * pos_len
+    return offsets
+
+
+def _seq_dropout(mod: nn.Module, cfg: ModelConfig, x, rate: float,
+                 deterministic: bool, *, pos: bool):
+    """Dropout whose mask survives sequence AND batch sharding: on the
+    ring path (inside shard_map over cfg.ring_axis) the keep mask is a
+    hash of the GLOBAL element coordinates (ops/hash_dropout.py), so
+    seq=1 and seq=N runs train identical trajectories and data shards
+    draw independent row masks; everywhere else it is plain nn.Dropout.
+    ``pos``: axis 1 of x is the (sharded) position axis."""
+    if deterministic or rate == 0.0:
+        return x
+    if cfg.attention_impl == "ring" and _axis_bound(cfg.ring_axis):
+        from ..ops.hash_dropout import hash_dropout
+
+        return hash_dropout(
+            x, rate, mod.make_rng("dropout"),
+            offsets=_drop_offsets(
+                cfg, x.shape[0], pos_len=x.shape[1] if pos else None
+            ),
+        )
+    return nn.Dropout(rate)(x, deterministic=False)
+
+
 class MultiHeadSelfAttention(nn.Module):
     cfg: ModelConfig
 
@@ -109,7 +144,19 @@ class MultiHeadSelfAttention(nn.Module):
             # Sequence-sharded forward inside shard_map over cfg.ring_axis.
             from ..parallel.ring_attention import ring_attention
 
-            ctx = ring_attention(q, k, v, bias, axis_name=cfg.ring_axis)
+            batch_off = (
+                jax.lax.axis_index(cfg.data_axis) * B
+                if _axis_bound(cfg.data_axis)
+                else 0
+            )
+            ctx = ring_attention(
+                q, k, v, bias,
+                axis_name=cfg.ring_axis,
+                dropout_rate=cfg.attention_dropout,
+                dropout_rng=dropout_rng,
+                deterministic=deterministic,
+                batch_offset=batch_off,
+            )
         elif cfg.attention_impl in ("dot", "ring"):
             # "ring" outside shard_map (e.g. init_params, unsharded eval)
             # runs the identical unsharded math.
@@ -138,7 +185,9 @@ class TransformerBlock(nn.Module):
             name=name,
         )
         attn_out = MultiHeadSelfAttention(cfg, name="attn")(x, bias, deterministic)
-        attn_out = nn.Dropout(cfg.dropout)(attn_out, deterministic=deterministic)
+        attn_out = _seq_dropout(
+            self, cfg, attn_out, cfg.dropout, deterministic, pos=True
+        )
         x = ln("sa_ln")(x + attn_out)
 
         h = nn.Dense(
@@ -159,7 +208,7 @@ class TransformerBlock(nn.Module):
             kernel_init=nn.initializers.normal(cfg.initializer_range),
             name="lin2",
         )(h)
-        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        h = _seq_dropout(self, cfg, h, cfg.dropout, deterministic, pos=True)
         return ln("out_ln")(x + h)
 
 
@@ -202,7 +251,7 @@ class Embeddings(nn.Module):
             param_dtype=_dtype(cfg.param_dtype),
             name="ln",
         )(x)
-        return nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        return _seq_dropout(self, cfg, x, cfg.dropout, deterministic, pos=True)
 
 
 class DistilBertEncoder(nn.Module):
@@ -241,7 +290,12 @@ class DDoSClassifier(nn.Module):
             # CLS; broadcast it so every shard computes identical logits.
             is_first = (jax.lax.axis_index(cfg.ring_axis) == 0).astype(pooled.dtype)
             pooled = jax.lax.psum(pooled * is_first, cfg.ring_axis)
-        pooled = nn.Dropout(cfg.head_dropout)(pooled, deterministic=deterministic)
+        # Head dropout ([B, dim], no position axis): still hash-keyed on
+        # the ring path so the [C]-vmapped fedseq step stays shard-count-
+        # invariant; the reference's Dropout(0.3) site (client1.py:57,63).
+        pooled = _seq_dropout(
+            self, cfg, pooled, cfg.head_dropout, deterministic, pos=False
+        )
         logits = nn.Dense(
             cfg.n_classes,
             dtype=jnp.float32,  # head + loss in fp32

@@ -1,0 +1,94 @@
+"""Position-keyed (counter-based) dropout for sequence-sharded forwards.
+
+Standard ``flax.linen.Dropout`` draws its mask from the rng stream in LOCAL
+array order, so the same rng produces DIFFERENT masks depending on how the
+sequence axis is sharded — a fedseq run at seq=2 would train a different
+trajectory than the identical run at seq=1, and the reference's dropout-0.3
+regularization (reference client1.py:57) could not be turned on under
+sequence parallelism without breaking shard-count reproducibility.
+
+Here the keep decision for every element is a pure hash of
+
+    (64-bit seed, element coordinates ... with the position coordinate
+     offset to its GLOBAL index)
+
+— the same construction as the Pallas flash-attention kernels' dropout
+(ops/flash_attention.py::_keep_mask), in plain XLA ops so it runs inside
+``shard_map``/``vmap`` anywhere. A shard at offset k hashes positions
+[k, k+L_local) and therefore reproduces exactly the mask slice the
+unsharded run computes for those positions: masks are invariant to the
+seq-axis shard count by construction.
+
+Distribution note: same Bernoulli(1-rate) marginals as ``nn.Dropout``,
+different bits (hash stream vs threefry stream) — the same contract the
+flash kernels already set.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# One odd mixing constant per coordinate axis (murmur/xxhash-style).
+_AXIS_CONSTS = (0x9E3779B1, 0x85EBCA77, 0xC2B2AE3D, 0x27D4EB2F, 0x165667B1)
+
+
+def hash_keep_mask(
+    seed: jax.Array,  # (2,) uint32 — 64-bit seed
+    shape: tuple[int, ...],
+    rate: float,
+    *,
+    offsets: dict[int, jax.Array] | None = None,
+) -> jnp.ndarray:
+    """fp32 0/1 keep mask of ``shape``: element (i0, i1, ...) keeps iff
+    murmur-finalized hash of (seed, i0+off0, i1+off1, ...) clears the rate
+    threshold. ``offsets`` maps axis -> (traced) global offset of this
+    shard along that axis."""
+    if len(shape) > len(_AXIS_CONSTS):
+        raise ValueError(f"hash_keep_mask supports rank <= {len(_AXIS_CONSTS)}")
+    offsets = offsets or {}
+    x = jnp.zeros(shape, jnp.uint32)
+    for axis in range(len(shape)):
+        idx = jax.lax.broadcasted_iota(jnp.uint32, shape, axis)
+        off = offsets.get(axis)
+        if off is not None:
+            idx = idx + jnp.asarray(off).astype(jnp.uint32)
+        # Mix each coordinate with its own odd constant; xor keeps the
+        # combination bijective per-axis before the finalizer avalanches.
+        x = x ^ (idx * jnp.uint32(_AXIS_CONSTS[axis]))
+    s0 = jnp.asarray(seed[0]).astype(jnp.uint32)
+    s1 = jnp.asarray(seed[1]).astype(jnp.uint32)
+    x = x ^ s0
+    x = x + s1 * jnp.uint32(0x632BE59B)
+    # murmur3 finalizer (identical to ops/flash_attention.py::_keep_mask).
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    thresh = jnp.uint32(min(2**32 - 1, int(round(rate * 4294967296.0))))
+    return (x >= thresh).astype(jnp.float32)
+
+
+def hash_dropout(
+    x: jnp.ndarray,
+    rate: float,
+    rng: jax.Array,
+    *,
+    offsets: dict[int, jax.Array] | None = None,
+    deterministic: bool = False,
+) -> jnp.ndarray:
+    """Inverted dropout with a coordinate-keyed hash mask.
+
+    ``offsets`` maps each SHARDED axis of ``x`` to this shard's global
+    start index along it — pass ``jax.lax.axis_index(axis_name) *
+    x.shape[axis]`` inside ``shard_map`` for the sequence axis AND the
+    batch axis (rows on different data shards must not reuse one mask).
+    The rng key must be identical on every shard (it is: flax
+    ``make_rng`` folds only the module path, which does not vary over
+    shards)."""
+    if deterministic or rate == 0.0:
+        return x
+    seed = jax.random.bits(rng, (2,), jnp.uint32)
+    keep = hash_keep_mask(seed, x.shape, rate, offsets=offsets)
+    return (x * keep.astype(x.dtype)) / jnp.asarray(1.0 - rate, x.dtype)

@@ -28,23 +28,45 @@ The reference has neither axis (three laptop processes, L=128,
 client1.py:27); this is the framework's "long sequences on a federated
 fleet" scaling story (SURVEY.md §5 long-context + §2.11 comm backend).
 
-Dropout note: the step runs the model deterministically — per-(client,
-seq-shard) dropout-key plumbing through shard_map is future work; the
-head/FFN/attention dropouts are off in this path.
+Dropout: ON in this path (the reference trains with head dropout 0.3,
+client1.py:57). Per-client keys enter the shard_map sharded over
+``clients``; inside, the model's ring path draws hash-based masks keyed on
+GLOBAL element coordinates (ops/hash_dropout.py, models/distilbert.py
+``_seq_dropout``, parallel/ring_attention.py), so the sampled masks — and
+therefore the training trajectory — are invariant to the seq-axis shard
+count.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..train.engine import apply_warmup
 from .fedavg import stack_params
+
+
+def make_seq_mesh(
+    clients: int,
+    data: int,
+    seq: int,
+    *,
+    devices: list | None = None,
+    axis_names: tuple[str, str, str] = ("clients", "data", "seq"),
+) -> Mesh:
+    """A ``clients x data x seq`` mesh — parallel/mesh.py's make_mesh with
+    the third (ring attention) axis."""
+    from .mesh import make_mesh
+
+    return make_mesh(
+        clients, data, seq=seq, devices=devices, axis_names=axis_names
+    )
 
 
 def make_fedseq_loss(
@@ -54,34 +76,104 @@ def make_fedseq_loss(
     clients_axis: str = "clients",
     data_axis: str = "data",
     seq_axis: str = "seq",
+    dropout: bool = False,
 ) -> Callable:
-    """``(stacked_params, ids [C,B,L], mask [C,B,L], labels [C,B]) -> [C]``
-    per-client mean losses, computed sequence- and batch-parallel. The
-    model must be built with ``attention_impl="ring"`` and
-    ``ring_axis=seq_axis``."""
+    """``(stacked_params, ids [C,B,L], mask [C,B,L], labels [C,B][, rngs
+    [C]]) -> [C]`` per-client mean losses, computed sequence- and
+    batch-parallel. The model must be built with ``attention_impl="ring"``
+    and ``ring_axis=seq_axis``. With ``dropout=True`` the call takes
+    per-client keys (sharded over ``clients``) and runs the model
+    stochastic — masks are seq-shard-invariant (module docstring)."""
 
-    def local_losses(params_l, ids_l, mask_l, labels_l):
-        def one(p, ids, mask, labels):
-            logits = model.apply({"params": p}, ids, mask, True)
+    def local_losses(params_l, ids_l, mask_l, labels_l, *rngs_l):
+        def one(p, ids, mask, labels, *key):
+            if dropout:
+                logits = model.apply(
+                    {"params": p}, ids, mask, False,
+                    rngs={"dropout": key[0]},
+                )
+            else:
+                logits = model.apply({"params": p}, ids, mask, True)
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits, labels
             ).mean()
 
-        losses = jax.vmap(one)(params_l, ids_l, mask_l, labels_l)  # [C_l]
+        losses = jax.vmap(one)(params_l, ids_l, mask_l, labels_l, *rngs_l)
         # Merge batch shards: each data instance saw B/data rows.
         return jax.lax.pmean(losses, data_axis)
 
     batch_spec = P(clients_axis, data_axis, seq_axis)
+    in_specs = [
+        P(clients_axis),
+        batch_spec,
+        batch_spec,
+        P(clients_axis, data_axis),
+    ]
+    if dropout:
+        in_specs.append(P(clients_axis))
     return jax.shard_map(
         local_losses,
         mesh=mesh,
-        in_specs=(
-            P(clients_axis),
-            batch_spec,
-            batch_spec,
-            P(clients_axis, data_axis),
-        ),
+        in_specs=tuple(in_specs),
         out_specs=P(clients_axis),
+    )
+
+
+def make_fedseq_masked_loss(
+    model,
+    mesh: Mesh,
+    *,
+    clients_axis: str = "clients",
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+    dropout: bool = False,
+) -> Callable:
+    """Ragged-stack variant: ``(stacked_params, ids, mask, labels, valid
+    [C,B][, rngs [C]]) -> ([C] masked mean losses, [C] 0/1 had-rows)``.
+    The per-client loss averages over the batch's valid rows only (global
+    across data shards — per-shard sums psum'd before the divide), so a
+    padded lockstep batch contributes loss 0 / has 0 exactly like the
+    dense ragged path (train/fedsteps.py per_client_step_masked)."""
+
+    def local_losses(params_l, ids_l, mask_l, labels_l, valid_l, *rngs_l):
+        def one(p, ids, mask, labels, valid, *key):
+            if dropout:
+                logits = model.apply(
+                    {"params": p}, ids, mask, False,
+                    rngs={"dropout": key[0]},
+                )
+            else:
+                logits = model.apply({"params": p}, ids, mask, True)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            )
+            v = valid.astype(jnp.float32)
+            return (ce * v).sum(), v.sum()
+
+        s_loss, s_cnt = jax.vmap(one)(
+            params_l, ids_l, mask_l, labels_l, valid_l, *rngs_l
+        )  # [C_l] per-shard sums
+        s_loss = jax.lax.psum(s_loss, data_axis)
+        s_cnt = jax.lax.psum(s_cnt, data_axis)
+        losses = s_loss / jnp.maximum(s_cnt, 1.0)
+        has = (s_cnt > 0).astype(jnp.float32)
+        return losses, has
+
+    batch_spec = P(clients_axis, data_axis, seq_axis)
+    in_specs = [
+        P(clients_axis),
+        batch_spec,
+        batch_spec,
+        P(clients_axis, data_axis),
+        P(clients_axis, data_axis),
+    ]
+    if dropout:
+        in_specs.append(P(clients_axis))
+    return jax.shard_map(
+        local_losses,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=(P(clients_axis), P(clients_axis)),
     )
 
 
@@ -147,6 +239,196 @@ def make_fedseq_train_step(
         return params, opt_state, losses
 
     return step
+
+
+class FedSeqSteps(NamedTuple):
+    """FedState-compatible jitted programs for the 3-axis composition —
+    the same call signatures as train/fedsteps.py's FedSteps train/eval
+    members, so FederatedTrainer's fit/eval loops drive either."""
+
+    train_step: Callable  # (FedState, batch) -> (FedState, [C] losses)
+    build_ragged_step: Callable  # () -> (FedState, batch) -> (FedState, ([C], [C]))
+    eval_step: Callable  # (params, batch, valid) -> (BinaryCounts [C], probs [C,B])
+
+
+def build_fedseq_steps(cfg, model, optimizer, mesh: Mesh) -> FedSeqSteps:
+    """Step closures over a ``clients x data x seq`` mesh. Dropout is ON
+    whenever the model config carries any (the reference's 0.3 head
+    dropout, client1.py:57): per-client keys fold (client rng, lockstep
+    step) exactly like the dense path (train/fedsteps.py), and the
+    model-side masks are seq-shard-invariant (module docstring)."""
+    from ..ops.metrics import binary_counts
+    from ..train.fedsteps import FedState
+
+    mcfg = model.cfg
+    dropout = (
+        float(mcfg.dropout) > 0.0
+        or float(mcfg.head_dropout) > 0.0
+        or float(mcfg.attention_dropout) > 0.0
+    )
+    wsteps = cfg.train.warmup_steps
+    csh = NamedSharding(mesh, P("clients"))
+    repl = NamedSharding(mesh, P())
+    seq_sh = NamedSharding(mesh, P("clients", "data", "seq"))
+    row_sh = NamedSharding(mesh, P("clients", "data"))
+    state_sh = FedState(csh, csh, repl, csh, repl)
+
+    loss = make_fedseq_loss(model, mesh, dropout=dropout)
+    batch_sh = {"input_ids": seq_sh, "attention_mask": seq_sh, "labels": row_sh}
+
+    @partial(
+        jax.jit,
+        donate_argnums=(0,),
+        in_shardings=(state_sh, batch_sh),
+        out_shardings=(state_sh, csh),
+    )
+    def train_step(state: FedState, batch):
+        keys = (
+            (jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+                state.rngs, state.step
+            ),)
+            if dropout
+            else ()
+        )
+
+        def total(p):
+            losses = loss(
+                p, batch["input_ids"], batch["attention_mask"],
+                batch["labels"], *keys,
+            )
+            # Clients are independent: d(sum)/d(params[c]) touches only
+            # client c's row — one grad call yields every per-client grad.
+            return losses.sum(), losses
+
+        (_, losses), grads = jax.value_and_grad(total, has_aux=True)(
+            state.params
+        )
+        updates, opt_state = jax.vmap(optimizer.update)(
+            grads, state.opt_state, state.params
+        )
+        updates = apply_warmup(updates, state.step, wsteps)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            state._replace(
+                params=params, opt_state=opt_state, step=state.step + 1
+            ),
+            losses,
+        )
+
+    ragged_batch_sh = dict(batch_sh, valid=row_sh, warmup_step=row_sh)
+    masked_loss = make_fedseq_masked_loss(model, mesh, dropout=dropout)
+
+    def build_ragged_step():
+        @partial(
+            jax.jit,
+            donate_argnums=(0,),
+            in_shardings=(state_sh, ragged_batch_sh),
+            out_shardings=(state_sh, (csh, csh)),
+        )
+        def ragged_step(state: FedState, batch):
+            keys = (
+                (jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+                    state.rngs, state.step
+                ),)
+                if dropout
+                else ()
+            )
+
+            def total(p):
+                losses, has = masked_loss(
+                    p, batch["input_ids"], batch["attention_mask"],
+                    batch["labels"], batch["valid"], *keys,
+                )
+                return losses.sum(), (losses, has)
+
+            (_, (losses, has)), grads = jax.value_and_grad(
+                total, has_aux=True
+            )(state.params)
+            updates, new_opt = jax.vmap(optimizer.update)(
+                grads, state.opt_state, state.params
+            )
+            # Warmup rides each client's OWN executed-step count
+            # (train/batches.py federated_batches_ragged), like the dense
+            # ragged path.
+            updates = jax.vmap(
+                lambda u, s: apply_warmup(u, s, wsteps)
+            )(updates, batch["warmup_step"][:, 0])
+            new_params = optax.apply_updates(state.params, updates)
+            gate = lambda n, o, h: jax.tree.map(  # noqa: E731
+                lambda a, b: jnp.where(h, a, b), n, o
+            )
+            params = jax.vmap(gate)(new_params, state.params, has > 0)
+            opt_state = jax.vmap(gate)(new_opt, state.opt_state, has > 0)
+            return (
+                state._replace(
+                    params=params, opt_state=opt_state, step=state.step + 1
+                ),
+                (losses, has),
+            )
+
+        return ragged_step
+
+    def local_eval(params_l, ids_l, mask_l, labels_l, valid_l):
+        def one(p, ids, mask, labels, valid):
+            logits = model.apply({"params": p}, ids, mask, True)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            )
+            probs = jax.nn.softmax(logits, axis=-1)[:, 1]
+            return ce, logits, probs
+
+        ce, logits, probs = jax.vmap(one)(
+            params_l, ids_l, mask_l, labels_l, valid_l
+        )
+
+        def counts_one(ce_c, logits_c, labels_c, valid_c):
+            v = valid_c.astype(jnp.float32)
+            # Batch-mean loss over GLOBAL valid rows: per-shard sums merged
+            # over the data axis before the divide (engine.eval_counts
+            # computes the same mean unsharded).
+            s_loss = jax.lax.psum((ce_c * v).sum(), "data")
+            s_cnt = jax.lax.psum(v.sum(), "data")
+            loss_c = s_loss / jnp.maximum(s_cnt, 1.0)
+            local = binary_counts(logits_c, labels_c, loss_c, valid_c)
+            # Sum the count fields over data shards; loss_sum/n_batches are
+            # already global (recompute them from the global mean).
+            has = (s_cnt > 0).astype(jnp.float32)
+            summed = jax.tree.map(lambda x: jax.lax.psum(x, "data"), local)
+            return summed._replace(
+                loss_sum=loss_c * has, n_batches=has
+            )
+
+        counts = jax.vmap(counts_one)(ce, logits, labels_l, valid_l)
+        return counts, probs
+
+    eval_inner = jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(
+            P("clients"),
+            P("clients", "data", "seq"),
+            P("clients", "data", "seq"),
+            P("clients", "data"),
+            P("clients", "data"),
+        ),
+        out_specs=(P("clients"), P("clients", "data")),
+    )
+
+    @partial(
+        jax.jit,
+        in_shardings=(csh, batch_sh, row_sh),
+    )
+    def eval_step(stacked_params, batch, valid):
+        return eval_inner(
+            stacked_params, batch["input_ids"], batch["attention_mask"],
+            batch["labels"], valid,
+        )
+
+    return FedSeqSteps(
+        train_step=train_step,
+        build_ragged_step=build_ragged_step,
+        eval_step=eval_step,
+    )
 
 
 def init_fedseq_state(

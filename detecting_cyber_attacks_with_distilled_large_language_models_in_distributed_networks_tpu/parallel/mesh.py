@@ -30,18 +30,26 @@ def make_mesh(
     clients: int = 1,
     data: int = 1,
     *,
+    seq: int | None = None,
     devices: list | None = None,
-    axis_names: tuple[str, str] = ("clients", "data"),
+    axis_names: tuple[str, ...] | None = None,
 ) -> Mesh:
-    """A ``clients x data`` mesh over the first ``clients*data`` devices."""
+    """A ``clients x data`` mesh over the first ``clients*data`` devices;
+    ``seq`` adds the third (ring attention) axis for the fedseq
+    composition (parallel/fedseq.py)."""
+    dims = (clients, data) if seq is None else (clients, data, seq)
+    if axis_names is None:
+        axis_names = ("clients", "data", "seq")[: len(dims)]
     devs = list(jax.devices() if devices is None else devices)
-    need = clients * data
+    need = 1
+    for d in dims:
+        need *= d
     if len(devs) < need:
         raise ValueError(
-            f"mesh {clients}x{data} needs {need} devices, have {len(devs)} "
-            "(tests: jax.config.update('jax_num_cpu_devices', N))"
+            f"mesh {'x'.join(map(str, dims))} needs {need} devices, have "
+            f"{len(devs)} (tests: jax.config.update('jax_num_cpu_devices', N))"
         )
-    grid = np.array(devs[:need]).reshape(clients, data)
+    grid = np.array(devs[:need]).reshape(dims)
     return Mesh(grid, axis_names)
 
 

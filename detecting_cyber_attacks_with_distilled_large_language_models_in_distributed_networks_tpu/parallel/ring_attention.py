@@ -31,12 +31,19 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _partial_attention(q_scaled, k, v, bias):
+def _partial_attention(q_scaled, k, v, bias, drop=None):
     """Unnormalized flash statistics of local queries vs one K/V chunk.
 
     Returns ``(pv, m, l)``: exp-weighted values, row max, row denominator —
     enough to merge chunks with the online-softmax recurrence.
-    """
+
+    ``drop = (seed, rate, b_off, q_off, k_off)`` applies attention dropout
+    with a GLOBAL-coordinate hash mask (ops/hash_dropout.py) — batch rows,
+    query and key positions all offset to their global indices: the pv
+    numerator is masked and inverse-scaled, the denominator ``l``
+    accumulates undropped weights — exactly the dot path's
+    drop-after-softmax semantics (ops/attention.py:56-61) expressed in the
+    online recurrence."""
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q_scaled, k.astype(jnp.float32),
         preferred_element_type=jnp.float32,
@@ -46,6 +53,14 @@ def _partial_attention(q_scaled, k, v, bias):
     m = s.max(axis=-1)  # [B,H,Lq]
     p = jnp.exp(s - m[..., None])
     l = p.sum(axis=-1)
+    if drop is not None:
+        from ..ops.hash_dropout import hash_keep_mask
+
+        seed, rate, b_off, q_off, k_off = drop
+        keep = hash_keep_mask(
+            seed, p.shape, rate, offsets={0: b_off, 2: q_off, 3: k_off}
+        )
+        p = p * keep * (1.0 / (1.0 - rate))
     pv = jnp.einsum(
         "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
         preferred_element_type=jnp.float32,
@@ -60,12 +75,23 @@ def ring_attention(
     bias: jnp.ndarray | None = None,  # [B, 1, 1, Lk_local] — mask for LOCAL keys
     *,
     axis_name: str = "seq",
+    dropout_rate: float = 0.0,
+    dropout_rng: jax.Array | None = None,
+    deterministic: bool = True,
+    batch_offset: jax.Array | int = 0,
 ) -> jnp.ndarray:
     """Sequence-parallel attention inside ``shard_map``; the key-position
     bias (when given) rotates around the ring together with its K/V chunk.
 
     Only key-position biases are accepted: a bias with a real query dimension
     would be applied to *other devices'* queries after the first rotation.
+
+    Attention dropout (``dropout_rate``/``dropout_rng``): masks come from a
+    hash of the GLOBAL (query, key) coordinates — each K/V chunk's global
+    offset rotates around the ring alongside it — so the sampled mask is
+    invariant to the seq-axis shard count (the same property the flash
+    kernels' forward/backward mask regeneration relies on). The rng must be
+    shard-invariant (flax ``make_rng`` keys are).
     """
     if bias is not None and (
         bias.ndim != 4 or bias.shape[1] != 1 or bias.shape[2] != 1
@@ -79,10 +105,24 @@ def ring_attention(
     q_scaled = q.astype(jnp.float32) * scale
     perm = [(i, (i + 1) % n) for i in range(n)]
     has_bias = bias is not None
+    rate = float(dropout_rate) if not deterministic else 0.0
+    if rate > 0.0 and dropout_rng is None:
+        raise ValueError("ring attention dropout needs dropout_rng")
+    lk = k.shape[2]
+    if rate > 0.0:
+        seed = jax.random.bits(dropout_rng, (2,), jnp.uint32)
+        q_off = jax.lax.axis_index(axis_name) * q.shape[2]
+    else:
+        seed = q_off = None
 
-    def merge(acc, m, l, k_c, v_c, b_c):
+    def merge(acc, m, l, k_c, v_c, b_c, k_off):
+        drop = (
+            None
+            if rate == 0.0
+            else (seed, rate, batch_offset, q_off, k_off)
+        )
         pv_i, m_i, l_i = _partial_attention(
-            q_scaled, k_c, v_c, b_c if has_bias else None
+            q_scaled, k_c, v_c, b_c if has_bias else None, drop
         )
         m_new = jnp.maximum(m, m_i)
         alpha = jnp.exp(m - m_new)
@@ -114,19 +154,24 @@ def ring_attention(
 
     acc0, m0, l0 = jax.tree.map(_vary, (acc0, m0, l0))
     b0 = bias if has_bias else ()  # empty pytree: nothing rotates when no mask
+    # Each chunk's global key offset rides the ring with its K/V (axis_index
+    # itself must be marked varying to enter the rotating carry).
+    k_off0 = _vary(jax.lax.axis_index(axis_name).astype(jnp.int32) * lk)
 
     def step(carry, _):
-        k_c, v_c, b_c, acc, m, l = carry
-        acc, m, l = merge(acc, m, l, k_c, v_c, b_c)
-        return (rotate(k_c), rotate(v_c), rotate(b_c), acc, m, l), None
+        k_c, v_c, b_c, k_off, acc, m, l = carry
+        acc, m, l = merge(acc, m, l, k_c, v_c, b_c, k_off)
+        return (
+            rotate(k_c), rotate(v_c), rotate(b_c), rotate(k_off), acc, m, l
+        ), None
 
     # n-1 compute+rotate steps; the final chunk is merged without the last
     # rotation (its rotated carry would be discarded — one wasted ICI hop
     # of full K/V per layer otherwise).
-    (k_f, v_f, b_f, acc, m, l), _ = jax.lax.scan(
-        step, (k, v, b0, acc0, m0, l0), None, length=n - 1
+    (k_f, v_f, b_f, k_off_f, acc, m, l), _ = jax.lax.scan(
+        step, (k, v, b0, k_off0, acc0, m0, l0), None, length=n - 1
     )
-    acc, m, l = merge(acc, m, l, k_f, v_f, b_f)
+    acc, m, l = merge(acc, m, l, k_f, v_f, b_f, k_off_f)
     # -1e9 mask addends keep l > 0 even for fully masked rows (parity with
     # the dot/flash paths).
     return (acc / l[..., None]).astype(q.dtype)
@@ -140,6 +185,9 @@ def ring_attention_sharded(
     *,
     mesh: Mesh,
     axis_name: str = "seq",
+    dropout_rate: float = 0.0,
+    dropout_rng: jax.Array | None = None,
+    deterministic: bool = True,
 ) -> jnp.ndarray:
     """Standalone wrapper: shards the sequence axis of full [B, H, L, D]
     arrays over ``axis_name`` and runs the ring. The model-integrated path
@@ -148,7 +196,13 @@ def ring_attention_sharded(
 
     seq_spec = P(None, None, axis_name, None)
     bias_spec = P(None, None, None, axis_name)
-    fn = functools.partial(ring_attention, axis_name=axis_name)
+    fn = functools.partial(
+        ring_attention,
+        axis_name=axis_name,
+        dropout_rate=dropout_rate,
+        dropout_rng=dropout_rng,
+        deterministic=deterministic,
+    )
     if bias is None:
         return shard_map(
             lambda q_, k_, v_: fn(q_, k_, v_),

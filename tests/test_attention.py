@@ -264,11 +264,47 @@ def test_ring_config_initializes_and_runs_outside_shard_map(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
-def test_config_rejects_attention_dropout_for_ring_only():
-    with pytest.raises(ValueError, match="attention dropout"):
-        ModelConfig.tiny(attention_impl="ring", attention_dropout=0.1)
-    # flash DOES implement attention dropout (hash-based masks).
-    ModelConfig.tiny(attention_impl="flash", attention_dropout=0.1)
+def test_ring_attention_dropout_matches_unsharded_and_is_invariant(eight_devices):
+    """Ring attention dropout (global-coordinate hash masks): the sampled
+    output is identical at any seq shard count, deterministic per key, and
+    different keys give different masks. (The former ring+dropout config
+    rejection is obsolete — every impl supports attention dropout now.)"""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.parallel.ring_attention import (
+        ring_attention_sharded,
+    )
+
+    r = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(r.normal(size=(1, 2, 16, 8)).astype(np.float32))
+        for _ in range(3)
+    )
+    key = jax.random.key(2)
+
+    def run(n, key=key):
+        mesh = Mesh(np.array(jax.devices()[:n]).reshape(n), ("seq",))
+        return np.asarray(
+            ring_attention_sharded(
+                q, k, v, mesh=mesh,
+                dropout_rate=0.3, dropout_rng=key, deterministic=False,
+            )
+        )
+
+    o1, o2, o4 = run(1), run(2), run(4)
+    np.testing.assert_allclose(o2, o1, atol=1e-5)
+    np.testing.assert_allclose(o4, o1, atol=1e-5)
+    np.testing.assert_array_equal(run(2), run(2))  # deterministic per key
+    assert not np.allclose(o1, run(2, jax.random.key(3)))  # key matters
+    # Clean (no-dropout) output differs from the dropped one.
+    clean = np.asarray(
+        ring_attention_sharded(
+            q, k, v,
+            mesh=Mesh(np.array(jax.devices()[:2]).reshape(2), ("seq",)),
+        )
+    )
+    assert not np.allclose(clean, o1, atol=1e-5)
 
 
 def test_flash_handles_non_multiple_block_lengths():

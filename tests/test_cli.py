@@ -78,6 +78,32 @@ def test_local_flow_writes_reference_artifacts(tmp_path):
     assert "client0_local_confusion_matrix.png" in plots
 
 
+@pytest.mark.slow
+def test_federated_seq_parallel_full_command(tmp_path, eight_devices):
+    """VERDICT r2 #2 done-criterion: the full `federated --seq-parallel 2`
+    command on the virtual mesh produces the standard artifact set
+    (metrics CSVs, plots, checkpoint), with dropout trained ON (the tiny
+    preset's defaults) through the ring path."""
+    out = tmp_path / "out"
+    ckpt = tmp_path / "ckpt"
+    rc = main(
+        [
+            "federated", "--synthetic", "160", "--num-clients", "2",
+            "--rounds", "1", "--epochs", "1", "--batch-size", "8",
+            "--preset", "tiny", "--seq-parallel", "2", "--data-parallel", "2",
+            "--output-dir", str(out), "--checkpoint-dir", str(ckpt),
+        ]
+    )
+    assert rc == 0
+    for c in range(2):
+        assert (out / f"client{c}_local_metrics.csv").exists()
+        assert (out / f"client{c}_aggregated_metrics.csv").exists()
+        plots = os.listdir(out / f"client{c}_plots")
+        assert f"client{c}_metrics_comparison.png" in plots
+        assert f"client{c}_aggregated_roc.png" in plots
+    assert ckpt.exists() and any(ckpt.iterdir())
+
+
 def test_federated_flow_writes_artifacts_and_checkpoints(tmp_path, eight_devices):
     out = tmp_path / "out"
     ckpt = tmp_path / "ckpt"
@@ -184,9 +210,11 @@ def test_attention_impl_and_remat_flags(tmp_path):
 
     cfg = resolve_config(ns(attention_impl="flash", remat=True), vocab_size=128)
     assert cfg.model.attention_impl == "flash" and cfg.model.remat is True
-    # ring + default attention_dropout: SystemExit, not a traceback.
-    with pytest.raises(SystemExit, match="attention dropout"):
-        resolve_config(ns(attention_impl="ring"), vocab_size=128)
+    # ring + default attention_dropout is now VALID (hash-mask dropout in
+    # the ring, parallel/ring_attention.py).
+    cfg = resolve_config(ns(attention_impl="ring"), vocab_size=128)
+    assert cfg.model.attention_impl == "ring"
+    assert cfg.model.attention_dropout > 0.0
     cfg = resolve_config(
         ns(attention_impl="ring", attention_dropout=0.0), vocab_size=128
     )

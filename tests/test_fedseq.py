@@ -156,3 +156,156 @@ def test_fedseq_train_step_and_fedavg(mesh3):
         + np.asarray(jax.tree.leaves(manual[1])[0])
     )
     np.testing.assert_allclose(leaf[0], want, atol=1e-5)
+
+
+# --------------------------------------------------------- dropout + trainer
+
+
+def _exp_cfg(seq, *, dropout=True, clients=2, data=1):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        FedConfig,
+        MeshConfig,
+        TrainConfig,
+    )
+
+    ML = 16
+    d = dict(dropout=0.1, attention_dropout=0.1, head_dropout=0.3)
+    if not dropout:
+        d = dict(dropout=0.0, attention_dropout=0.0, head_dropout=0.0)
+    return ExperimentConfig(
+        model=ModelConfig.tiny(max_len=ML, max_position_embeddings=ML, **d),
+        data=DataConfig(max_len=ML, batch_size=8, eval_batch_size=8),
+        train=TrainConfig(learning_rate=1e-3, epochs_per_round=1, seed=0),
+        fed=FedConfig(num_clients=clients, rounds=1),
+        mesh=MeshConfig(clients=clients, data=data, seq=seq),
+    )
+
+
+def _dense_train(ml=16, n=32, clients=2, seed=0):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.pipeline import (
+        TokenizedSplit,
+    )
+
+    rng = np.random.default_rng(seed)
+    return TokenizedSplit(
+        rng.integers(1, 200, (clients, n, ml)).astype(np.int32),
+        np.ones((clients, n, ml), np.int32),
+        rng.integers(0, 2, (clients, n)).astype(np.int32),
+    )
+
+
+@pytest.mark.slow
+def test_fedseq_dropout_invariant_to_seq_shard_count(eight_devices):
+    """VERDICT r2 #3 done-criterion: fedseq trains WITH dropout (incl. the
+    reference's head 0.3, client1.py:57) and the loss trajectory is
+    invariant to the seq-axis shard count (hash masks keyed on global
+    coordinates, ops/hash_dropout.py)."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.seqfed import (
+        FedSeqTrainer,
+    )
+
+    train = _dense_train()
+
+    def run(seq):
+        tr = FedSeqTrainer(_exp_cfg(seq))
+        state = tr.init_state()
+        state, losses = tr.fit_local(state, train, epochs=2)
+        return np.asarray(losses)
+
+    l1, l2, l4 = run(1), run(2), run(4)
+    np.testing.assert_allclose(l2, l1, atol=2e-4)
+    np.testing.assert_allclose(l4, l1, atol=2e-4)
+    # Dropout genuinely active: the deterministic trajectory differs.
+    tr = FedSeqTrainer(_exp_cfg(2, dropout=False))
+    state = tr.init_state()
+    _, l_det = tr.fit_local(state, train, epochs=2)
+    assert not np.allclose(np.asarray(l_det), l2, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_fedseq_trainer_dense_ragged_eval(eight_devices):
+    """FedSeqTrainer presents the FederatedTrainer surface: dense fit,
+    ragged fit (masked lockstep + gated updates), stacked eval with
+    probs, and FedAvg aggregate on the 3-axis mesh. (Slow: several
+    3-axis compiles; the fast lane covers the trainer via
+    test_fedseq_eval_counts_match_two_axis_trainer and the loss via
+    test_fedseq_loss_matches_unsharded.)"""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.pipeline import (
+        TokenizedSplit,
+        stack_clients_ragged,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.seqfed import (
+        FedSeqTrainer,
+    )
+
+    tr = FedSeqTrainer(_exp_cfg(2, clients=2, data=2))
+    state = tr.init_state()
+    state, losses = tr.fit_local(state, _dense_train())
+    assert np.isfinite(np.asarray(losses)).all()
+
+    rng = np.random.default_rng(3)
+
+    def split(n):
+        return TokenizedSplit(
+            rng.integers(1, 200, (n, 16)).astype(np.int32),
+            np.ones((n, 16), np.int32),
+            rng.integers(0, 2, n).astype(np.int32),
+        )
+
+    st = stack_clients_ragged([split(20), split(9)])
+    state, rl = tr.fit_local(state, st)
+    assert np.isfinite(np.asarray(rl)).all()
+
+    ms = tr.evaluate_clients(
+        state.params,
+        prepared=tr.prepare_eval([split(16), split(16)]),
+        collect_probs=True,
+    )
+    assert len(ms) == 2 and ms[0]["probs"].shape == (16,)
+    assert all(np.isfinite(m["Loss"]) for m in ms)
+
+    state = tr.aggregate(state, weights=np.array([20.0, 9.0]))
+    leaf = np.asarray(jax.tree.leaves(state.params)[0])
+    np.testing.assert_allclose(leaf[0], leaf[1], rtol=1e-6)
+
+
+def test_fedseq_eval_counts_match_two_axis_trainer(eight_devices):
+    """The fedseq eval step and the dense 2-axis eval step must produce
+    IDENTICAL metrics for the same params (both reduce to
+    engine.eval_counts semantics)."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.pipeline import (
+        TokenizedSplit,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.federated import (
+        FederatedTrainer,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.seqfed import (
+        FedSeqTrainer,
+    )
+
+    cfg3 = _exp_cfg(2, dropout=False, clients=2, data=2)
+    tr3 = FedSeqTrainer(cfg3)
+    state = tr3.init_state()
+    rng = np.random.default_rng(7)
+    evals = [
+        TokenizedSplit(
+            rng.integers(1, 200, (13, 16)).astype(np.int32),
+            np.ones((13, 16), np.int32),
+            rng.integers(0, 2, 13).astype(np.int32),
+        )
+        for _ in range(2)
+    ]
+    m3 = tr3.evaluate_clients(state.params, splits=evals)
+
+    import dataclasses as _dc
+
+    cfg2 = _dc.replace(cfg3, mesh=_dc.replace(cfg3.mesh, seq=1))
+    tr2 = FederatedTrainer(cfg2)
+    state2 = tr2.init_state()
+    m2 = tr2.evaluate_clients(state2.params, splits=evals)
+    for a, b in zip(m3, m2):
+        for k in ("Accuracy", "Precision", "Recall", "F1-Score"):
+            np.testing.assert_allclose(a[k], b[k], atol=1e-4, err_msg=k)
+        np.testing.assert_allclose(a["Loss"], b["Loss"], atol=1e-3)
