@@ -3,7 +3,7 @@
 deployment-shape documentation live in :mod:`.parser`; each command is its
 own module (common plumbing in :mod:`.common`)."""
 
-from .comm import _auth_key, _mask_secret, cmd_client, cmd_serve  # noqa: F401
+from .comm import _auth_key, cmd_client, cmd_serve  # noqa: F401
 from .common import (  # noqa: F401
     _load_client_splits,
     _load_clients,

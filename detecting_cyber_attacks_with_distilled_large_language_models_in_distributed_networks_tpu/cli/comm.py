@@ -23,19 +23,10 @@ def _auth_key() -> bytes | None:
     return secret.encode() if secret else None
 
 
-def _mask_secret(enabled: bool) -> bytes | None:
-    """Pairwise-mask secret for secure aggregation (comm/secure.py), from
-    the FEDTPU_MASK_SECRET env var. Shared among CLIENTS ONLY — the server
-    must not hold it, or it could unmask individual uploads."""
-    if not enabled:
-        return None
-    secret = os.environ.get("FEDTPU_MASK_SECRET")
-    if not secret:
-        raise SystemExit(
-            "--secure-agg needs FEDTPU_MASK_SECRET set (same value on every "
-            "client; NOT on the server)"
-        )
-    return secret.encode()
+# Secure aggregation needs no provisioned secret anymore: per-pair mask
+# keys come from fresh ephemeral Diffie-Hellman exchanges each round
+# (comm/secure.py), relayed through the server. The old FEDTPU_MASK_SECRET
+# single shared secret (any one client could unmask every pair) is gone.
 
 
 def cmd_serve(args) -> int:
@@ -97,7 +88,7 @@ def cmd_client(args) -> int:
         args.host, args.port, client_id=args.client_id,
         timeout=args.timeout, compression=args.compression,
         auth_key=_auth_key(),
-        secure_secret=_mask_secret(getattr(args, "secure_agg", False)),
+        secure_agg=bool(getattr(args, "secure_agg", False)),
         num_clients=cfg.fed.num_clients,
     )
     import jax.numpy as jnp

@@ -269,8 +269,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--secure-agg",
         action="store_true",
-        help="mask the upload with pairwise secrets (FEDTPU_MASK_SECRET, "
-        "shared by clients only) so the server sees only the sum",
+        help="mask the upload with per-pair Diffie-Hellman secrets (fresh "
+        "ephemeral keys each round, relayed through the server) so the "
+        "server sees only the sum and no client can unmask another pair",
     )
     p.add_argument(
         "--checkpoint-dir",

@@ -56,11 +56,11 @@ class FederatedClient:
         timeout: float = 300.0,  # the reference's TIMEOUT (client1.py:22)
         compression: str = "none",
         auth_key: bytes | None = None,
-        secure_secret: bytes | None = None,
+        secure_agg: bool = False,
         num_clients: int | None = None,
         fp_bits: int = secure.DEFAULT_FP_BITS,
     ):
-        if secure_secret is not None and num_clients is None:
+        if secure_agg and num_clients is None:
             raise ValueError(
                 "secure aggregation needs num_clients: each client must "
                 "mask against the full advertised participant set"
@@ -71,13 +71,26 @@ class FederatedClient:
         self.timeout = timeout
         self.compression = compression
         self.auth_key = auth_key
-        self.secure_secret = secure_secret
+        self.secure_agg = secure_agg
         self.num_clients = num_clients
         self.fp_bits = fp_bits
         # Highest (per session) round this instance has already masked an
         # upload for: a later exchange() refuses a replayed advert rather
         # than masking DIFFERENT weights under the same stream.
         self._used_rounds: dict[bytes, int] = {}
+        # Per-(session, round) DH keypair: retries of the same round MUST
+        # re-send the identical public key (the server accepts an
+        # idempotent re-hello; a fresh keypair after key distribution
+        # could never cancel and would doom the round).
+        self._round_keys: dict[tuple[bytes, int], tuple[int, bytes]] = {}
+        if secure_agg and auth_key is None:
+            log.warning(
+                f"[CLIENT {client_id}] --secure-agg without an auth key "
+                "(FEDTPU_SECRET): the DH key exchange has no integrity — "
+                "an ACTIVE on-path attacker could substitute keys and "
+                "unmask uploads; protection is against passive observers "
+                "and the curious server only"
+            )
 
     def exchange(
         self,
@@ -93,30 +106,27 @@ class FederatedClient:
         WireError (e.g. CRC mismatch after corruption) also retries with a
         fresh upload.
 
-        With ``secure_secret`` set, the upload is the pairwise-masked
+        With ``secure_agg`` set, the upload is the pairwise-masked
         fixed-point form (comm/secure.py): the server sees only uniform
-        ring elements, never this client's raw weights. Mask streams are
-        keyed by the server's advertised round number (received on
-        connect), so all participants mask consistently and a stream is
-        never reused across rounds — reuse would let the server difference
-        two uploads and unmask this client's weight delta.
+        ring elements, never this client's raw weights. A fresh ephemeral
+        DH keypair is drawn per attempt; the server relays every
+        participant's public key, and each pair's mask stream is keyed by
+        the DH pair secret plus the advertised (session, round) — fresh
+        across rounds, and no client holds key material for pairs it does
+        not belong to.
         """
         base_meta = {
             "client_id": self.client_id,
             "n_samples": int(n_samples),
             **dict(meta or {}),
         }
-        flat = (
-            wire.flatten_params(params)
-            if self.secure_secret is not None
-            else None
-        )
+        flat = wire.flatten_params(params) if self.secure_agg else None
         # The plain (no auth, no masking) upload encodes once; auth embeds
         # the per-connection challenge and secure mode embeds the per-round
         # masks, so those encode inside the attempt loop.
         msg = (
             wire.encode(params, meta=base_meta, compression=self.compression)
-            if self.auth_key is None and self.secure_secret is None
+            if self.auth_key is None and not self.secure_agg
             else None
         )
         last: Exception | None = None
@@ -137,7 +147,7 @@ class FederatedClient:
                         raise wire.WireError("bad auth challenge from server")
                     nonce_hex = chal[len(wire.NONCE_MAGIC) :].hex()
                     attempt_meta.update(role="client", nonce=nonce_hex)
-                if self.secure_secret is not None:
+                if self.secure_agg:
                     import struct as _struct
 
                     # A secure server adverts immediately after accept; if
@@ -179,9 +189,34 @@ class FederatedClient:
                             "refusing to reuse a mask stream"
                         )
                     this_call = (session, round_no)
+                    # DH key exchange (relayed by the server): send our
+                    # ephemeral public key, receive every participant's,
+                    # derive per-pair mask secrets. One keypair per
+                    # (session, round), REUSED across retries — the server
+                    # treats a same-key re-hello as idempotent, so a retry
+                    # after a transient wire error still completes the
+                    # round instead of being dropped as a key swap.
+                    if (session, round_no) not in self._round_keys:
+                        self._round_keys[(session, round_no)] = secure.dh_keypair()
+                    priv, pub = self._round_keys[(session, round_no)]
+                    hello = (
+                        wire.PUBKEY_MAGIC
+                        + _struct.pack("<q", self.client_id)
+                        + pub
+                    )
+                    if self.auth_key is not None:
+                        hello += secure.pubkey_tag(
+                            self.auth_key, session, round_no,
+                            self.client_id, pub,
+                        )
+                    framing.send_frame(sock, hello)
+                    keys_frame = framing.recv_frame(sock)
+                    pair_secrets = self._parse_keys_frame(
+                        keys_frame, priv, session, round_no
+                    )
                     upload = secure.masked_upload(
                         flat,
-                        mask_secret=self.secure_secret,
+                        pair_secrets=pair_secrets,
                         round_index=round_no,
                         client_id=self.client_id,
                         participants=range(self.num_clients),
@@ -195,7 +230,7 @@ class FederatedClient:
                         round=round_no,
                         participants=self.num_clients,
                     )
-                if self.auth_key is not None or self.secure_secret is not None:
+                if self.auth_key is not None or self.secure_agg:
                     # Fresh encode per attempt: the nonce and/or round (and
                     # with them the masks) change between connections.
                     msg = wire.encode(
@@ -235,3 +270,40 @@ class FederatedClient:
         raise ConnectionError(
             f"client {self.client_id}: round failed after {max_retries} attempts: {last}"
         )
+
+    def _parse_keys_frame(
+        self, frame: bytes, priv: int, session: bytes, round_no: int
+    ) -> dict[int, bytes]:
+        """KEYS frame -> {partner id: DH pair secret}. Validates the magic,
+        the exact participant set, every public value, and (in auth mode)
+        each key's HMAC binding to (session, round, owner id)."""
+        import struct as _struct
+
+        entry = 8 + secure.DH_PUB_LEN + (
+            wire.AUTH_TAG_LEN if self.auth_key is not None else 0
+        )
+        n_magic = len(wire.KEYS_MAGIC)
+        if not frame.startswith(wire.KEYS_MAGIC) or (
+            (len(frame) - n_magic) % entry != 0
+        ):
+            raise wire.WireError("bad DH keys frame from server")
+        seen: dict[int, bytes] = {}
+        for off in range(n_magic, len(frame), entry):
+            cid = _struct.unpack("<q", frame[off : off + 8])[0]
+            pub = frame[off + 8 : off + 8 + secure.DH_PUB_LEN]
+            if self.auth_key is not None:
+                secure.verify_pubkey_tag(
+                    self.auth_key, session, round_no, cid, pub,
+                    frame[off + 8 + secure.DH_PUB_LEN : off + entry],
+                )
+            seen[cid] = pub
+        if sorted(seen) != list(range(self.num_clients)):
+            raise wire.WireError(
+                f"DH keys frame covers clients {sorted(seen)}, expected "
+                f"exactly 0..{self.num_clients - 1}"
+            )
+        return {
+            cid: secure.dh_pair_secret(priv, pub)
+            for cid, pub in seen.items()
+            if cid != self.client_id
+        }

@@ -27,23 +27,35 @@ reused across rounds or server restarts; a client instance additionally
 refuses a (session, round) it has already masked different weights for.
 
 Threat model: honest-but-curious server and passive wire observers (the
-semi-honest setting of the Bonawitz paper), with **mutually trusted
-clients**: all pairwise streams derive from the ONE shared mask secret, so
-any single client — or anyone who obtains that secret — can regenerate
-every pair's stream and unmask every other client's upload from the wire.
-Privacy here is against the server/wire only, not between clients; full
-Bonawitz derives per-pair keys by Diffie-Hellman agreement so each client
-can reconstruct only its own pairs. Also out of scope for this minimal
-form: a fully malicious server actively replaying session nonces across
-its own restarts (full Bonawitz adds signed key agreement), and client
-dropout recovery — every advertised participant must upload; the server
-enforces ``participants == all clients`` and fails the round otherwise,
-which the caller sees as the reference-style failed-round path.
+semi-honest setting of the Bonawitz paper). Pairwise streams derive from
+PER-PAIR Diffie-Hellman secrets (fresh ephemeral keypairs every round,
+public keys relayed through the server): client i holds only the secrets
+of pairs it belongs to, so no PASSIVE party — a curious client reading
+transcripts, or anyone holding a leaked client's key material — can
+regenerate another pair's stream or unmask a third party's upload;
+compromising one client reveals only that client's own masks. Precise
+limits of the guarantee:
+
+* ACTIVE in-group adversaries are out of scope: the pubkey HMAC is keyed
+  by the GROUP auth key, which proves membership, not identity — a
+  malicious *client* could impersonate another id in the key exchange
+  (first-registration-wins limits this to a race, but does not remove
+  it). Binding identity needs per-client signing keys (full Bonawitz).
+* A MALICIOUS (not just curious) server can substitute public keys in
+  transit — it also holds the group auth key. Same fix, same scope-out.
+* WITHOUT a group auth key (``FEDTPU_SECRET`` unset) the exchange has no
+  integrity at all: an active on-path attacker can MITM the relay and
+  unmask every upload. No-auth secure-agg protects against passive
+  observers only; the client logs a warning.
+* Client dropout recovery: none — every advertised participant must
+  upload; the server enforces ``participants == all clients`` and fails
+  the round otherwise (the reference-style failed-round path).
 """
 
 from __future__ import annotations
 
 import hashlib
+import os
 import struct
 from typing import Mapping, Sequence
 
@@ -53,7 +65,94 @@ import numpy as np
 #: weight — far below bf16 wire compression and Adam-step noise.
 DEFAULT_FP_BITS = 24
 
-_DOMAIN = b"fedtpu-secagg-v1"
+_DOMAIN = b"fedtpu-secagg-v2"
+
+# RFC 3526 group 14: 2048-bit MODP, generator 2 — finite-field DH from the
+# stdlib alone (pow(g, x, P); no external crypto dependency in this image).
+DH_PRIME = int(
+    "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD129024E088A67CC74"
+    "020BBEA63B139B22514A08798E3404DDEF9519B3CD3A431B302B0A6DF25F1437"
+    "4FE1356D6D51C245E485B576625E7EC6F44C42E9A637ED6B0BFF5CB6F406B7ED"
+    "EE386BFB5A899FA5AE9F24117C4B1FE649286651ECE45B3DC2007CB8A163BF05"
+    "98DA48361C55D39A69163FA8FD24CF5F83655D23DCA3AD961C62F356208552BB"
+    "9ED529077096966D670C354E4ABC9804F1746C08CA18217C32905E462E36CE3B"
+    "E39E772C180E86039B2783A2EC07A28FB5C55DF06F4C52C9DE2BCBF695581718"
+    "3995497CEA956AE515D2261898FA051015728E5A8AACAA68FFFFFFFFFFFFFFFF",
+    16,
+)
+DH_GENERATOR = 2
+DH_PUB_LEN = 256  # 2048-bit public values, fixed-width big-endian
+
+
+def dh_keypair(entropy: bytes | None = None) -> tuple[int, bytes]:
+    """Fresh ephemeral DH keypair: (private exponent, 256-byte public).
+
+    256-bit private exponents — standard for a 2048-bit MODP group
+    (~112-bit security either way). ``entropy`` pins the key for tests."""
+    raw = os.urandom(32) if entropy is None else hashlib.sha256(entropy).digest()
+    x = int.from_bytes(raw, "big") | (1 << 255)  # top bit set: full length
+    y = pow(DH_GENERATOR, x, DH_PRIME)
+    return x, y.to_bytes(DH_PUB_LEN, "big")
+
+
+def check_dh_public(pub: bytes) -> int:
+    """Parse + validate a peer public value; rejects the degenerate
+    elements (0, 1, p-1, >= p) that would collapse the shared secret."""
+    if len(pub) != DH_PUB_LEN:
+        raise SecureAggError(f"DH public value is {len(pub)} bytes, want {DH_PUB_LEN}")
+    y = int.from_bytes(pub, "big")
+    if not 2 <= y <= DH_PRIME - 2:
+        raise SecureAggError("degenerate DH public value")
+    return y
+
+
+def dh_pair_secret(private: int, peer_pub: bytes) -> bytes:
+    """The (i, j) pair's shared mask secret: SHA-256 of the fixed-width
+    DH shared value. Symmetric — both ends derive the same bytes; nobody
+    without one of the two private exponents can."""
+    shared = pow(check_dh_public(peer_pub), private, DH_PRIME)
+    return hashlib.sha256(
+        _DOMAIN + b"-dh" + shared.to_bytes(DH_PUB_LEN, "big")
+    ).digest()
+
+
+def pubkey_tag(
+    auth_key: bytes, session: bytes, round_index: int, client_id: int, pub: bytes
+) -> bytes:
+    """HMAC binding a relayed public key to (session, round, client id):
+    protects the DH exchange against tampering by anyone WITHOUT the group
+    auth key (the server holds it, so server MITM stays out of scope —
+    see the module threat model)."""
+    import hmac
+
+    return hmac.new(
+        auth_key,
+        _DOMAIN + b"-pk" + session + struct.pack("<Qq", round_index, client_id) + pub,
+        hashlib.sha256,
+    ).digest()
+
+
+def verify_pubkey_tag(
+    auth_key: bytes,
+    session: bytes,
+    round_index: int,
+    client_id: int,
+    pub: bytes,
+    tag: bytes,
+) -> None:
+    """Constant-time check of :func:`pubkey_tag`; raises on mismatch.
+    The single verification used by BOTH the server (on hellos) and the
+    client (on the relayed keys frame), so the binding can never drift
+    between the two ends."""
+    import hmac
+
+    if not hmac.compare_digest(
+        tag, pubkey_tag(auth_key, session, round_index, client_id, pub)
+    ):
+        raise SecureAggError(
+            f"DH public key for client {client_id} failed its authenticity "
+            "check — possible tampering"
+        )
 
 
 class SecureAggError(ValueError):
@@ -91,19 +190,20 @@ def dequantize_sum(
 
 
 def _pair_stream(
-    mask_secret: bytes, session: bytes, round_index: int, lo: int, hi: int
+    pair_secret: bytes, session: bytes, round_index: int, lo: int, hi: int
 ) -> np.random.Generator:
     """The (lo, hi) client pair's shared mask PRG for one round. Both ends
-    derive the identical stream; nobody without the mask secret can.
+    derive the identical stream from their DH pair secret; nobody without
+    one of the pair's private keys can.
 
     ``session`` is the server run's random nonce (delivered in the round
     advert): it domain-separates mask streams across server restarts, so
-    re-running the pipeline with the same secret and the same round
+    re-running the pipeline with the same pair secret and the same round
     numbers never reuses a stream."""
     if not 0 <= round_index < 2**63:
         raise SecureAggError(f"round_index {round_index} out of range [0, 2^63)")
     digest = hashlib.sha256(
-        _DOMAIN + mask_secret + session + struct.pack("<Qqq", round_index, lo, hi)
+        _DOMAIN + pair_secret + session + struct.pack("<Qqq", round_index, lo, hi)
     ).digest()
     return np.random.Generator(
         np.random.Philox(key=int.from_bytes(digest[:16], "little"))
@@ -113,7 +213,7 @@ def _pair_stream(
 def mask(
     quantized: Mapping[str, np.ndarray],
     *,
-    mask_secret: bytes,
+    pair_secrets: Mapping[int, bytes],
     round_index: int,
     client_id: int,
     participants: Sequence[int],
@@ -121,7 +221,11 @@ def mask(
 ) -> dict[str, np.ndarray]:
     """Add this client's pairwise masks: +stream for partners above it,
     -stream for partners below (mod 2^64), per sorted tensor key. Summing
-    every participant's masked upload cancels all masks bit-exactly."""
+    every participant's masked upload cancels all masks bit-exactly.
+
+    ``pair_secrets`` maps each partner id to THIS client's shared secret
+    with that partner (:func:`dh_pair_secret`) — per-pair keys, so this
+    client's key material never covers pairs it does not belong to."""
     ids = sorted(set(int(p) for p in participants))
     if int(client_id) not in ids:
         raise SecureAggError(f"client {client_id} not in participants {ids}")
@@ -129,12 +233,17 @@ def mask(
         # A single participant has nobody to pair with; masking would be a
         # no-op that still leaks the raw update — refuse loudly.
         raise SecureAggError("secure aggregation needs >= 2 participants")
+    missing = [p for p in ids if p != client_id and p not in pair_secrets]
+    if missing:
+        raise SecureAggError(
+            f"client {client_id} lacks pair secrets for partners {missing}"
+        )
     out = {k: np.array(quantized[k], dtype=np.uint64, copy=True) for k in sorted(quantized)}
     for other in ids:
         if other == client_id:
             continue
         lo, hi = min(client_id, other), max(client_id, other)
-        rng = _pair_stream(mask_secret, session, round_index, lo, hi)
+        rng = _pair_stream(pair_secrets[other], session, round_index, lo, hi)
         for key in sorted(out):
             stream = rng.integers(
                 0, 2**64, size=out[key].shape, dtype=np.uint64, endpoint=False
@@ -149,7 +258,7 @@ def mask(
 def masked_upload(
     flat: Mapping[str, np.ndarray],
     *,
-    mask_secret: bytes,
+    pair_secrets: Mapping[int, bytes],
     round_index: int,
     client_id: int,
     participants: Sequence[int],
@@ -159,7 +268,7 @@ def masked_upload(
     """Client-side one-call path: quantize then mask."""
     return mask(
         quantize(flat, fp_bits),
-        mask_secret=mask_secret,
+        pair_secrets=pair_secrets,
         round_index=round_index,
         client_id=client_id,
         participants=participants,

@@ -78,6 +78,11 @@ class _Round:
     n_samples: dict[int, float] = field(default_factory=dict)
     conns: dict[int, socket.socket] = field(default_factory=dict)
     nonces: dict[int, str] = field(default_factory=dict)  # auth mode only
+    # Secure mode: each participant's (pubkey, tag) hello, relayed to all
+    # once everyone's arrived (keys_ready). The server never holds any
+    # private key — it only forwards public values.
+    pubkeys: dict[int, bytes] = field(default_factory=dict)
+    keys_ready: threading.Event = field(default_factory=threading.Event)
     lock: threading.Lock = field(default_factory=threading.Lock)
     complete: threading.Event = field(default_factory=threading.Event)
     # Set (under lock) when serve_round snapshots the round; a handler that
@@ -153,7 +158,11 @@ class AggregationServer:
         self.close()
 
     # ----------------------------------------------------------------- round
-    def _handle_upload(self, conn: socket.socket, rnd: _Round) -> None:
+    def _handle_upload(
+        self, conn: socket.socket, rnd: _Round, deadline: float | None = None
+    ) -> None:
+        if deadline is None:
+            deadline = time.monotonic() + self.timeout
         try:
             conn.settimeout(self.timeout)
             nonce_hex = None
@@ -179,6 +188,87 @@ class AggregationServer:
                     + _struct.pack("<Q", rnd.round_no)
                     + self._session,
                 )
+                # DH relay: collect this client's ephemeral public key,
+                # wait for the full fleet's, then hand everyone the whole
+                # set. The server only forwards public values — it cannot
+                # derive any pair's mask secret.
+                hello = framing.recv_frame(conn)
+                tag_len = wire.AUTH_TAG_LEN if self.auth_key is not None else 0
+                want_len = len(wire.PUBKEY_MAGIC) + 8 + secure.DH_PUB_LEN + tag_len
+                if len(hello) != want_len or not hello.startswith(wire.PUBKEY_MAGIC):
+                    raise wire.WireError("bad DH pubkey hello")
+                off = len(wire.PUBKEY_MAGIC)
+                hello_id = _struct.unpack("<q", hello[off : off + 8])[0]
+                pub_and_tag = hello[off + 8 :]
+                secure.check_dh_public(pub_and_tag[: secure.DH_PUB_LEN])
+                if self.auth_key is not None:
+                    secure.verify_pubkey_tag(
+                        self.auth_key, self._session, rnd.round_no,
+                        hello_id, pub_and_tag[: secure.DH_PUB_LEN],
+                        pub_and_tag[secure.DH_PUB_LEN :],
+                    )
+                with rnd.lock:
+                    if rnd.closed:
+                        conn.close()
+                        return
+                    if not 0 <= hello_id < self.num_clients:
+                        raise wire.WireError(
+                            f"DH hello from unknown client id {hello_id}"
+                        )
+                    prev_hello = rnd.pubkeys.get(hello_id)
+                    if prev_hello is not None and prev_hello != pub_and_tag:
+                        # First registration wins. A DIFFERENT key for an
+                        # already-registered id is either an impersonation
+                        # attempt or a client that lost its per-round
+                        # keypair — after distribution a new key could
+                        # never cancel, and before it, honoring the swap
+                        # would let a group member evict the honest holder.
+                        log.info(
+                            f"[SERVER] conflicting DH hello for client "
+                            f"{hello_id}; dropping connection"
+                        )
+                        conn.close()
+                        return
+                    if prev_hello is None and rnd.keys_ready.is_set():
+                        # Keys already relayed: a NEW participant key now
+                        # would break mask cancellation for everyone who
+                        # already derived pair secrets.
+                        log.info(
+                            f"[SERVER] late DH hello from client {hello_id} "
+                            "after key distribution; dropping connection"
+                        )
+                        conn.close()
+                        return
+                    # Fresh registration, or an idempotent re-hello (same
+                    # pubkey — a retrying client reuses its per-round
+                    # keypair) which re-binds the connection.
+                    old = rnd.conns.pop(hello_id, None)
+                    if old is not None and old is not conn:
+                        old.close()
+                    rnd.pubkeys[hello_id] = pub_and_tag
+                    # Register now so a failed round's cleanup closes this
+                    # socket instead of leaving the client blocked on the
+                    # keys frame until its own timeout.
+                    rnd.conns[hello_id] = conn
+                    if len(rnd.pubkeys) >= rnd.expected:
+                        rnd.keys_ready.set()
+                log.info(
+                    f"[SERVER] DH pubkey from client {hello_id} "
+                    f"({len(rnd.pubkeys)}/{rnd.expected})"
+                )
+                if not rnd.keys_ready.wait(
+                    timeout=max(0.0, deadline - time.monotonic())
+                ):
+                    raise wire.WireError(
+                        "round deadline passed waiting for the remaining "
+                        "participants' DH public keys"
+                    )
+                with rnd.lock:
+                    entries = b"".join(
+                        _struct.pack("<q", cid) + rnd.pubkeys[cid]
+                        for cid in sorted(rnd.pubkeys)
+                    )
+                framing.send_frame(conn, wire.KEYS_MAGIC + entries)
             payload = framing.recv_frame(conn)
             flat, meta = wire.decode(payload, auth_key=self.auth_key)
             if self.auth_key is not None and (
@@ -244,7 +334,7 @@ class AggregationServer:
             )
             if done:
                 rnd.complete.set()
-        except (OSError, wire.WireError, ConnectionError) as e:
+        except (OSError, wire.WireError, secure.SecureAggError, ConnectionError) as e:
             log.info(f"[SERVER] upload failed: {e}")
             conn.close()
 
@@ -271,7 +361,9 @@ class AggregationServer:
                 continue
             except OSError:
                 break  # closed
-            t = threading.Thread(target=self._handle_upload, args=(conn, rnd), daemon=True)
+            t = threading.Thread(
+                target=self._handle_upload, args=(conn, rnd, deadline), daemon=True
+            )
             t.start()
             threads.append(t)
         rnd.complete.wait(timeout=max(0.0, deadline - time.monotonic()))

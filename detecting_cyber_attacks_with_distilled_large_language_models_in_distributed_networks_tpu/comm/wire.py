@@ -52,6 +52,13 @@ NONCE_LEN = 16
 #: difference two uploads and unmask a client's weight delta).
 ROUND_MAGIC = b"RNDX"
 SESSION_LEN = 16
+#: DH key-exchange frames for per-pair secure-aggregation masks
+#: (comm/secure.py): the client answers the round advert with
+#: PUBKEY_MAGIC + u64 client_id + its 256-byte ephemeral public value
+#: (+ an HMAC tag in auth mode); the server, once every participant's key
+#: arrived, replies KEYS_MAGIC + num_clients x (u64 id + pubkey [+ tag]).
+PUBKEY_MAGIC = b"DHPK"
+KEYS_MAGIC = b"DHKS"
 _ALLOWED_DTYPES = {
     "float32", "float64", "float16", "bfloat16",
     "int8", "int16", "int32", "int64",

@@ -21,13 +21,24 @@ from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed
     SecureAggError,
     aggregate_masked,
     dequantize_sum,
+    dh_keypair,
+    dh_pair_secret,
     mask,
     masked_upload,
     quantize,
     sum_masked,
 )
 
-SECRET = b"clients-only-mask-secret"
+
+def _fleet_keys(n, tag=b"t"):
+    """Deterministic DH keypairs + per-client pair-secret dicts, the
+    artifact each client derives from the relayed public keys."""
+    pairs = [dh_keypair(entropy=tag + bytes([i])) for i in range(n)]
+    secrets = [
+        {j: dh_pair_secret(pairs[i][0], pairs[j][1]) for j in range(n) if j != i}
+        for i in range(n)
+    ]
+    return pairs, secrets
 
 
 def _params(rng, scale=1.0):
@@ -58,10 +69,11 @@ def test_masks_cancel_to_exact_quantized_sum(rng):
     C = 3
     flats = _flats(rng, C)
     ids = list(range(C))
+    _, secrets = _fleet_keys(C)
     masked = [
         masked_upload(
             flats[i],
-            mask_secret=SECRET,
+            pair_secrets=secrets[i],
             round_index=4,
             client_id=i,
             participants=ids,
@@ -78,10 +90,11 @@ def test_masks_cancel_to_exact_quantized_sum(rng):
 def test_secure_mean_matches_plain_fedavg(rng):
     C = 4
     flats = _flats(rng, C)
+    _, secrets = _fleet_keys(C)
     masked = [
         masked_upload(
             flats[i],
-            mask_secret=SECRET,
+            pair_secrets=secrets[i],
             round_index=0,
             client_id=i,
             participants=range(C),
@@ -101,20 +114,21 @@ def test_single_upload_reveals_nothing(rng):
     and two uploads of the SAME weights under different pair partners or
     rounds must differ (fresh masks per round)."""
     flat = flatten_params(_params(rng))
+    _, secrets = _fleet_keys(2)
     m1 = masked_upload(
-        flat, mask_secret=SECRET, round_index=0, client_id=0, participants=[0, 1]
+        flat, pair_secrets=secrets[0], round_index=0, client_id=0, participants=[0, 1]
     )
     q = quantize(flat)
     for key in q:
         assert not np.array_equal(m1[key], q[key])
     m2 = masked_upload(
-        flat, mask_secret=SECRET, round_index=1, client_id=0, participants=[0, 1]
+        flat, pair_secrets=secrets[0], round_index=1, client_id=0, participants=[0, 1]
     )
     for key in q:
         assert not np.array_equal(m1[key], m2[key])
     # Deterministic per (secret, round, pair): same inputs, same masks.
     m1_again = masked_upload(
-        flat, mask_secret=SECRET, round_index=0, client_id=0, participants=[0, 1]
+        flat, pair_secrets=secrets[0], round_index=0, client_id=0, participants=[0, 1]
     )
     for key in q:
         np.testing.assert_array_equal(m1[key], m1_again[key])
@@ -126,10 +140,11 @@ def test_missing_participant_leaves_garbage(rng):
     participant set."""
     C = 3
     flats = _flats(rng, C)
+    _, secrets = _fleet_keys(C)
     masked = [
         masked_upload(
             flats[i],
-            mask_secret=SECRET,
+            pair_secrets=secrets[i],
             round_index=0,
             client_id=i,
             participants=range(C),
@@ -149,7 +164,10 @@ def test_session_nonce_separates_mask_streams(rng):
     masks: re-running the pipeline never reuses a stream (an observer
     can't difference uploads across server restarts)."""
     flat = flatten_params(_params(rng))
-    kw = dict(mask_secret=SECRET, round_index=0, client_id=0, participants=[0, 1])
+    _, secrets = _fleet_keys(2)
+    kw = dict(
+        pair_secrets=secrets[0], round_index=0, client_id=0, participants=[0, 1]
+    )
     a = masked_upload(flat, session=b"A" * 16, **kw)
     b = masked_upload(flat, session=b"B" * 16, **kw)
     for key in a:
@@ -171,6 +189,8 @@ def test_client_refuses_replayed_round(rng):
         send_frame,
     )
     from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.wire import (
+        KEYS_MAGIC,
+        PUBKEY_MAGIC,
         ROUND_MAGIC,
         encode,
     )
@@ -182,6 +202,7 @@ def test_client_refuses_replayed_round(rng):
     srv.bind(("127.0.0.1", 0))
     srv.listen(4)
     port = srv.getsockname()[1]
+    _, pub1 = dh_keypair(entropy=b"peer")
 
     def _fake_server():
         for _ in range(2):  # two connections, SAME advertised round
@@ -189,7 +210,16 @@ def test_client_refuses_replayed_round(rng):
             conn.settimeout(10)
             try:
                 send_frame(conn, ROUND_MAGIC + struct.pack("<Q", 3) + session)
-                recv_frame(conn)
+                hello = recv_frame(conn)  # client's DH pubkey
+                assert hello.startswith(PUBKEY_MAGIC)
+                pub0 = hello[len(PUBKEY_MAGIC) + 8 :]
+                send_frame(
+                    conn,
+                    KEYS_MAGIC
+                    + struct.pack("<q", 0) + pub0
+                    + struct.pack("<q", 1) + pub1,
+                )
+                recv_frame(conn)  # masked upload
                 send_frame(conn, reply)
             except Exception:
                 pass  # second connection dies when the client refuses
@@ -200,7 +230,7 @@ def test_client_refuses_replayed_round(rng):
     t.start()
     client = FederatedClient(
         "127.0.0.1", port, client_id=0, timeout=10,
-        secure_secret=SECRET, num_clients=2,
+        secure_agg=True, num_clients=2,
     )
     params = _params(rng)
     client.exchange(params, max_retries=1)  # first use of round 3: fine
@@ -211,10 +241,16 @@ def test_client_refuses_replayed_round(rng):
 
 def test_mask_input_validation(rng):
     flat = quantize(flatten_params(_params(rng)))
+    _, secrets = _fleet_keys(2)
     with pytest.raises(SecureAggError, match="participants"):
-        mask(flat, mask_secret=SECRET, round_index=0, client_id=5, participants=[0, 1])
+        mask(flat, pair_secrets=secrets[0], round_index=0, client_id=5,
+             participants=[0, 1])
     with pytest.raises(SecureAggError, match=">= 2"):
-        mask(flat, mask_secret=SECRET, round_index=0, client_id=0, participants=[0])
+        mask(flat, pair_secrets=secrets[0], round_index=0, client_id=0,
+             participants=[0])
+    with pytest.raises(SecureAggError, match="lacks pair secrets"):
+        mask(flat, pair_secrets={}, round_index=0, client_id=0,
+             participants=[0, 1])
     with pytest.raises(SecureAggError, match="expected float"):
         quantize({"a": np.arange(3, dtype=np.int32)})
 
@@ -250,7 +286,7 @@ def test_server_constructor_guards():
     with pytest.raises(ValueError, match="min_clients"):
         AggregationServer(port=0, num_clients=3, min_clients=2, secure_agg=True)
     with pytest.raises(ValueError, match="num_clients"):
-        FederatedClient("h", 1, client_id=0, secure_secret=SECRET)
+        FederatedClient("h", 1, client_id=0, secure_agg=True)
 
 
 @pytest.mark.parametrize("auth", [False, True])
@@ -278,7 +314,7 @@ def test_secure_tcp_round_end_to_end(rng, auth):
                 client_id=cid,
                 timeout=30,
                 auth_key=auth_key,
-                secure_secret=SECRET,
+                secure_agg=True,
                 num_clients=C,
             )
             results[cid] = client.exchange(params[cid])
@@ -315,7 +351,7 @@ def _secure_round(server, params, *, num_clients, results):
             server.port,
             client_id=cid,
             timeout=20,
-            secure_secret=SECRET,
+            secure_agg=True,
             num_clients=num_clients,
         ).exchange(params[cid])
 
@@ -366,7 +402,7 @@ def test_participant_set_mismatch_rejected(rng):
                     server.port,
                     client_id=cid,
                     timeout=5,
-                    secure_secret=SECRET,
+                    secure_agg=True,
                     num_clients=3,  # wrong fleet size
                 ).exchange(params[cid], max_retries=1)
             except ConnectionError as e:
@@ -382,3 +418,145 @@ def test_participant_set_mismatch_rejected(rng):
         for t in ts:
             t.join(timeout=5)
     assert set(errs) == {0, 1}
+
+
+def test_one_clients_keys_cannot_unmask_another_pair(rng):
+    """VERDICT r2 #4 done-criterion: per-pair DH keys mean one client's
+    ENTIRE key material (its private exponent, all public keys, and every
+    pair secret it legitimately holds) cannot reconstruct another pair's
+    mask stream — unlike the old single shared FEDTPU_MASK_SECRET, where
+    any client could unmask everyone."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.secure import (
+        _pair_stream,
+    )
+
+    pairs, secrets = _fleet_keys(3)
+    (x0, _), (x1, pub1), (x2, pub2) = pairs
+    s12 = dh_pair_secret(x1, pub2)  # the (1, 2) pair's true secret
+    assert s12 == dh_pair_secret(x2, pub1)  # both ends agree
+    # Everything client 0 can derive differs from the (1,2) secret ...
+    derivable = {
+        dh_pair_secret(x0, pub1),
+        dh_pair_secret(x0, pub2),
+        *secrets[0].values(),
+    }
+    assert s12 not in derivable
+    # ... and none of it keys the (1,2) stream: the true stream's bytes
+    # differ from a stream keyed by anything client 0 holds.
+    true_stream = _pair_stream(s12, b"s" * 16, 7, 1, 2).integers(
+        0, 2**64, size=64, dtype=np.uint64, endpoint=False
+    )
+    for guess in derivable:
+        guess_stream = _pair_stream(guess, b"s" * 16, 7, 1, 2).integers(
+            0, 2**64, size=64, dtype=np.uint64, endpoint=False
+        )
+        assert not np.array_equal(guess_stream, true_stream)
+    # Functional consequence: client 0 cannot strip client 1's masks from
+    # its upload, but client 1's own secrets regenerate them exactly.
+    flat = flatten_params(_params(rng))
+    q = quantize(flat)
+    m1 = mask(
+        q, pair_secrets=secrets[1], round_index=7, client_id=1,
+        participants=[0, 1, 2], session=b"s" * 16,
+    )
+    key = sorted(q)[0]
+    shape = q[key].shape
+    # Client 1 (legitimate): subtract its own streams -> exact raw values.
+    recovered = np.array(m1[key], copy=True)
+    for other, sign in ((0, -1), (2, +1)):
+        # client 1 is hi of pair (0,1) [subtracted on mask] and lo of
+        # (1,2) [added on mask]; invert each.
+        st = _pair_stream(secrets[1][other], b"s" * 16, 7,
+                          min(1, other), max(1, other))
+        stream = st.integers(0, 2**64, size=shape, dtype=np.uint64,
+                             endpoint=False)
+        recovered = recovered - stream if sign == 1 else recovered + stream
+    np.testing.assert_array_equal(recovered, q[key])
+    # Client 0 (attacker): its best guesses leave the upload masked.
+    attacked = np.array(m1[key], copy=True)
+    for guess in (dh_pair_secret(x0, pub1), dh_pair_secret(x0, pub2)):
+        st = _pair_stream(guess, b"s" * 16, 7, 1, 2)
+        attacked -= st.integers(0, 2**64, size=shape, dtype=np.uint64,
+                                endpoint=False)
+    assert not np.array_equal(attacked, q[key])
+
+
+def test_dh_public_value_validation():
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.secure import (
+        DH_PRIME,
+        DH_PUB_LEN,
+        check_dh_public,
+    )
+
+    x, pub = dh_keypair(entropy=b"ok")
+    assert check_dh_public(pub) == int.from_bytes(pub, "big")
+    for bad in (
+        b"\x00" * DH_PUB_LEN,  # 0
+        (1).to_bytes(DH_PUB_LEN, "big"),  # 1
+        (DH_PRIME - 1).to_bytes(DH_PUB_LEN, "big"),  # p-1 (order 2)
+        b"\xff" * DH_PUB_LEN,  # >= p
+        b"short",
+    ):
+        with pytest.raises(SecureAggError):
+            check_dh_public(bad)
+
+
+def test_retry_after_wire_error_reuses_keypair_and_completes(rng):
+    """A transient wire error after key distribution must not doom the
+    round: the client reuses its per-(session, round) DH keypair on retry
+    and the server accepts the idempotent re-hello."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        recv_frame,
+        send_frame,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.wire import (
+        KEYS_MAGIC,
+        PUBKEY_MAGIC,
+        ROUND_MAGIC,
+        encode,
+    )
+    import socket as socket_mod
+
+    session = b"R" * 16
+    reply = encode({"w": np.zeros(3, np.float32)}, meta={"round_clients": [0, 1]})
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+    _, pub1 = dh_keypair(entropy=b"peer2")
+    pubs = []
+
+    def _flaky_server():
+        for attempt in range(2):
+            conn, _ = srv.accept()
+            conn.settimeout(10)
+            try:
+                send_frame(conn, ROUND_MAGIC + struct.pack("<Q", 5) + session)
+                hello = recv_frame(conn)
+                assert hello.startswith(PUBKEY_MAGIC)
+                pubs.append(hello[len(PUBKEY_MAGIC) + 8 :])
+                send_frame(
+                    conn,
+                    KEYS_MAGIC
+                    + struct.pack("<q", 0) + pubs[-1]
+                    + struct.pack("<q", 1) + pub1,
+                )
+                recv_frame(conn)  # masked upload
+                if attempt == 0:
+                    conn.close()  # transient failure: no reply
+                    continue
+                send_frame(conn, reply)
+            finally:
+                conn.close()
+
+    t = threading.Thread(target=_flaky_server, daemon=True)
+    t.start()
+    client = FederatedClient(
+        "127.0.0.1", port, client_id=0, timeout=10,
+        secure_agg=True, num_clients=2,
+    )
+    out = client.exchange(_params(rng), max_retries=3)
+    assert "w" in flatten_params(out)
+    # Both attempts sent the IDENTICAL public key (per-round keypair reuse).
+    assert len(pubs) == 2 and pubs[0] == pubs[1]
+    srv.close()
