@@ -11,7 +11,8 @@ matrix finalize on host from eight scalars.
 
 from __future__ import annotations
 
-from functools import partial
+from dataclasses import replace
+from functools import lru_cache, partial
 from typing import Any, Callable, Iterator, NamedTuple
 
 import jax
@@ -208,6 +209,33 @@ def make_eval_step(model: DDoSClassifier) -> Callable:
     return eval_step
 
 
+@lru_cache(maxsize=None)
+def _cached_engine_steps(model_cfg: ModelConfig, train_cfg: TrainConfig):
+    """Process-wide memo of the jitted single-client programs, keyed on
+    the frozen configs they are pure functions of: every Trainer built
+    with equal configs (multi-round CLI flows, warm starts, the test
+    suite) shares one set of compiled executables. Callers go through
+    :func:`_engine_steps`, which canonicalizes step-irrelevant fields out
+    of the key."""
+    model = DDoSClassifier(model_cfg)
+    optimizer = make_optimizer(train_cfg)
+    return (
+        model,
+        optimizer,
+        make_train_step(model, optimizer, warmup_steps=train_cfg.warmup_steps),
+        make_eval_step(model),
+    )
+
+
+def _engine_steps(model_cfg: ModelConfig, train_cfg: TrainConfig):
+    """Zero the TrainConfig fields the compiled programs don't read (host
+    loop/init/telemetry knobs) so e.g. seed-only variations share one
+    cache entry. Conservative direction: a newly added field defaults to
+    being part of the key (worst case a lost share, never wrong sharing)."""
+    key_cfg = replace(train_cfg, seed=0, epochs_per_round=1, log_every=0)
+    return _cached_engine_steps(model_cfg, key_cfg)
+
+
 class Trainer:
     """Single-client engine: fit for E epochs, evaluate with full metrics."""
 
@@ -223,12 +251,9 @@ class Trainer:
         self.train_cfg = train_cfg
         self.pad_id = pad_id
         self.drop_remainder = drop_remainder
-        self.model = DDoSClassifier(model_cfg)
-        self.optimizer = make_optimizer(train_cfg)
-        self.train_step = make_train_step(
-            self.model, self.optimizer, warmup_steps=train_cfg.warmup_steps
+        self.model, self.optimizer, self.train_step, self.eval_step = (
+            _engine_steps(model_cfg, train_cfg)
         )
-        self.eval_step = make_eval_step(self.model)
 
     def init_state(self, seed: int | None = None, params: Any | None = None) -> TrainState:
         seed = self.train_cfg.seed if seed is None else seed

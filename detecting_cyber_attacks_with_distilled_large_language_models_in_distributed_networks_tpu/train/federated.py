@@ -49,7 +49,7 @@ from .fedeval import (  # noqa: F401
 from .fedsteps import (  # noqa: F401
     FedState,
     aggregate_round,
-    build_federated_steps,
+    cached_federated_steps,
 )
 
 log = get_logger()
@@ -116,9 +116,7 @@ class FederatedTrainer:
         of config/model/optimizer/shardings); keeps only the lifecycle
         state this trainer owns — lazy ragged compilation and the DP noise
         seed (OS entropy + multi-host agreement)."""
-        steps = build_federated_steps(
-            self.cfg, self.model, self.optimizer, self.sh
-        )
+        steps = cached_federated_steps(self.cfg, self.mesh)
         self.train_step = steps.train_step
         self.eval_step = steps.eval_step
         self.fedavg_step = steps.fedavg_step

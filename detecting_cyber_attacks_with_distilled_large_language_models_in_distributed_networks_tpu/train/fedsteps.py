@@ -13,7 +13,7 @@ train/federated.py.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -185,9 +185,11 @@ def build_federated_steps(cfg, model, optimizer, sh) -> FedSteps:
             (losses, has),  # [C] masked losses, [C] 0/1 batch-had-rows
         )
 
+    @lru_cache(maxsize=1)
     def build_ragged_step():
         """Built on first ragged fit_local (equal-client runs never pay
-        the extra compilation)."""
+        the extra compilation); memoized so same-config trainers share the
+        compiled executable."""
         if mu > 0.0:
             return partial(
                 jax.jit,
@@ -283,6 +285,50 @@ def build_federated_steps(cfg, model, optimizer, sh) -> FedSteps:
         opt_init=opt_init,
         replicate=replicate,
     )
+
+
+@lru_cache(maxsize=None)
+def _cached_federated_steps(cfg, mesh) -> FedSteps:
+    from ..models.distilbert import DDoSClassifier
+    from ..parallel.mesh import FedShardings
+    from .engine import make_optimizer
+
+    return build_federated_steps(
+        cfg, DDoSClassifier(cfg.model), make_optimizer(cfg.train), FedShardings(mesh)
+    )
+
+
+def cached_federated_steps(cfg, mesh) -> FedSteps:
+    """Process-wide memo of ``build_federated_steps`` keyed on the inputs
+    it is a pure function of: every FederatedTrainer built with an
+    equivalent (config, mesh) pair — CLI resume paths, multi-round
+    drivers, the test suite — shares one set of compiled executables
+    instead of re-tracing identical programs.
+
+    The key canonicalizes the config fields the compiled programs never
+    read (data pipeline, distill, output paths, host-side round/epoch/
+    telemetry counts), so runs differing only in e.g. --output-dir still
+    share. Conservative direction: a newly added field defaults to being
+    part of the key — worst case a lost share, never wrong sharing. The
+    mesh *config* stays in the key only because ExperimentConfig
+    validation couples it to fed.num_clients; the mesh object itself is
+    what the shardings derive from."""
+    from dataclasses import replace
+
+    from ..config import DataConfig, DistillConfig
+
+    key_cfg = replace(
+        cfg,
+        # max_len rides along: ExperimentConfig validates it against the
+        # model's position table.
+        data=DataConfig(max_len=cfg.model.max_len),
+        distill=DistillConfig(),
+        train=replace(cfg.train, seed=0, epochs_per_round=1, log_every=0),
+        fed=replace(cfg.fed, rounds=1),
+        output_dir="outputs",
+        checkpoint_dir=None,
+    )
+    return _cached_federated_steps(key_cfg, mesh)
 
 
 def aggregate_round(

@@ -235,7 +235,14 @@ def test_attention_impl_and_remat_flags(tmp_path):
     assert resolve_config(ns(config=str(cfg_file)), vocab_size=256).model.remat
     cfg = resolve_config(ns(config=str(cfg_file), remat=False), vocab_size=256)
     assert cfg.model.remat is False
-    # End-to-end: a flash+remat local run trains and reports.
+
+
+@pytest.mark.slow
+def test_flash_remat_local_run_end_to_end(tmp_path):
+    """A flash+remat local run trains and reports (slow: the Pallas kernel
+    compiles through the CPU interpreter here; flash numerics are
+    fast-lane-covered by test_flash_in_model_forward, the CLI local flow
+    by test_local_flow_writes_reference_artifacts)."""
     rc = main(
         [
             "local", "--synthetic", "200", "--epochs", "1",

@@ -62,20 +62,19 @@ def test_fedseq_loss_matches_unsharded(mesh3):
     stacked = stack_params(params, C)
     ids, mask, labels = _data()
 
-    loss_fn = make_fedseq_loss(model_ring, mesh3)
+    # jit both sides: the eager shard_map dispatch alone costs ~10x the
+    # compile on this single-core 8-virtual-device host.
+    loss_fn = jax.jit(make_fedseq_loss(model_ring, mesh3))
     got = np.asarray(loss_fn(stacked, ids, mask, labels))
 
-    want = np.array(
-        [
-            float(
-                optax.softmax_cross_entropy_with_integer_labels(
-                    model_dot.apply({"params": params}, ids[c], mask[c], True),
-                    labels[c],
-                ).mean()
-            )
-            for c in range(C)
-        ]
-    )
+    @jax.jit
+    def solo_loss(ids_c, mask_c, labels_c):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            model_dot.apply({"params": params}, ids_c, mask_c, True),
+            labels_c,
+        ).mean()
+
+    want = np.array([float(solo_loss(ids[c], mask[c], labels[c])) for c in range(C)])
     np.testing.assert_allclose(got, want, atol=2e-5)
 
 
@@ -91,17 +90,21 @@ def test_fedseq_grads_match_unsharded(mesh3):
     ids, mask, labels = _data()
     loss_fn = make_fedseq_loss(model_ring, mesh3)
 
-    g_stacked = jax.grad(
-        lambda p: loss_fn(p, ids, mask, labels).sum()
+    g_stacked = jax.jit(
+        jax.grad(lambda p: loss_fn(p, ids, mask, labels).sum())
     )(stacked)
 
-    for c in range(C):
-        g_solo = jax.grad(
-            lambda p: optax.softmax_cross_entropy_with_integer_labels(
-                model_dot.apply({"params": p}, ids[c], mask[c], True),
-                labels[c],
+    solo_grad = jax.jit(
+        jax.grad(
+            lambda p, ids_c, mask_c, labels_c:
+            optax.softmax_cross_entropy_with_integer_labels(
+                model_dot.apply({"params": p}, ids_c, mask_c, True),
+                labels_c,
             ).mean()
-        )(params)
+        )
+    )
+    for c in range(C):
+        g_solo = solo_grad(params, ids[c], mask[c], labels[c])
         for a, b in zip(jax.tree.leaves(g_stacked), jax.tree.leaves(g_solo)):
             np.testing.assert_allclose(
                 np.asarray(a)[c], np.asarray(b), atol=5e-4
@@ -128,16 +131,18 @@ def test_fedseq_train_step_and_fedavg(mesh3):
     assert losses.shape == (C,)
 
     # Manual per-client Adam on the unsharded program.
-    manual = []
-    for c in range(C):
+    @jax.jit
+    def manual_step(ids_c, mask_c, labels_c):
         g = jax.grad(
             lambda p: optax.softmax_cross_entropy_with_integer_labels(
-                model_dot.apply({"params": p}, ids[c], mask[c], True),
-                labels[c],
+                model_dot.apply({"params": p}, ids_c, mask_c, True),
+                labels_c,
             ).mean()
         )(params)
         u, _ = opt.update(g, opt.init(params), params)
-        manual.append(optax.apply_updates(params, u))
+        return optax.apply_updates(params, u)
+
+    manual = [manual_step(ids[c], mask[c], labels[c]) for c in range(C)]
     for a, m0, m1 in zip(
         jax.tree.leaves(new_stacked),
         jax.tree.leaves(manual[0]),

@@ -35,14 +35,12 @@ def _read(path):
     return df
 
 
-def test_predict_requires_weights(flows_csv, tmp_path):
-    with pytest.raises(SystemExit, match="trained weights"):
-        main(["predict", "--csv", flows_csv, "--output", str(tmp_path / "p.csv")])
-
-
-def test_predict_from_local_checkpoint(flows_csv, tmp_path):
-    ckpt = str(tmp_path / "ckpt")
-    out = str(tmp_path / "preds.csv")
+@pytest.fixture(scope="module")
+def local_ckpt(tmp_path_factory):
+    """One trained local checkpoint shared by every predict test (training
+    is the expensive part; predict reads it read-only)."""
+    root = tmp_path_factory.mktemp("predict_ckpt")
+    ckpt = str(root / "ckpt")
     assert (
         main(
             [
@@ -51,12 +49,22 @@ def test_predict_from_local_checkpoint(flows_csv, tmp_path):
                 "--learning-rate", "1e-3",  # random-init tiny model: the
                 # reference's 2e-5 assumes a pretrained encoder
                 "--batch-size", "16", "--checkpoint-dir", ckpt,
-                "--output-dir", str(tmp_path / "reports"),
+                "--output-dir", str(root / "reports"),
             ]
         )
         == 0
     )
-    assert main(["predict", "--csv", flows_csv, "--checkpoint-dir", ckpt, "--output", out]) == 0
+    return ckpt
+
+
+def test_predict_requires_weights(flows_csv, tmp_path):
+    with pytest.raises(SystemExit, match="trained weights"):
+        main(["predict", "--csv", flows_csv, "--output", str(tmp_path / "p.csv")])
+
+
+def test_predict_from_local_checkpoint(flows_csv, local_ckpt, tmp_path):
+    out = str(tmp_path / "preds.csv")
+    assert main(["predict", "--csv", flows_csv, "--checkpoint-dir", local_ckpt, "--output", out]) == 0
     df = _read(out)
     assert len(df) == 400
     # A trained tiny model on separable synthetic flows must not be
@@ -84,26 +92,18 @@ def test_predict_from_federated_checkpoint(flows_csv, tmp_path):
     assert len(df) == 400
 
 
-def test_predict_unlabeled_csv_and_threshold(flows_csv, tmp_path):
-    ckpt = str(tmp_path / "ckpt2")
-    main(
-        [
-            "local", "--synthetic", "400", "--epochs", "1",
-            "--batch-size", "16", "--checkpoint-dir", ckpt,
-            "--output-dir", str(tmp_path / "r2"),
-        ]
-    )
+def test_predict_unlabeled_csv_and_threshold(flows_csv, local_ckpt, tmp_path):
     unlabeled = str(tmp_path / "unlabeled.csv")
     pd.read_csv(flows_csv).drop(columns=["Label"]).to_csv(unlabeled, index=False)
     out = str(tmp_path / "u.csv")
-    assert main(["predict", "--csv", unlabeled, "--checkpoint-dir", ckpt, "--output", out]) == 0
+    assert main(["predict", "--csv", unlabeled, "--checkpoint-dir", local_ckpt, "--output", out]) == 0
     df = _read(out)
     assert len(df) == 400
 
     # threshold 1.01 can never flag anything; 0.0 flags everything.
     out_hi = str(tmp_path / "hi.csv")
     main(
-        ["predict", "--csv", unlabeled, "--checkpoint-dir", ckpt,
+        ["predict", "--csv", unlabeled, "--checkpoint-dir", local_ckpt,
          "--output", out_hi, "--threshold", "1.01"]
     )
     assert pd.read_csv(out_hi)["prediction"].sum() == 0
