@@ -38,6 +38,32 @@ from .local import cmd_local
 from .predict import cmd_export_hf, cmd_predict
 
 
+def _wire_compression(spec: str) -> str:
+    """argparse type for the client's --compression: validates
+    none|bf16|int8|topk[:frac] (wire.parse_compression) so a typo fails at
+    parse time, not mid-round."""
+    from ..comm import wire
+
+    try:
+        wire.parse_compression(spec)
+    except wire.WireError as e:
+        raise argparse.ArgumentTypeError(str(e)) from None
+    return spec
+
+
+def _reply_compression(spec: str) -> str:
+    """argparse type for the server's --compression: like
+    :func:`_wire_compression` but rejects topk at parse time too — the
+    reply is an absolute aggregate, sparse round deltas are upload-only."""
+    spec = _wire_compression(spec)
+    if spec.startswith("topk"):
+        raise argparse.ArgumentTypeError(
+            "topk is an upload-side (sparse round-delta) compression; "
+            "the reply is an absolute aggregate — use none/bf16/int8"
+        )
+    return spec
+
+
 def cmd_export_config(args) -> int:
     from ..data import default_tokenizer
 
@@ -243,7 +269,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--min-clients", type=int, default=None)
     p.add_argument("--weighted", action="store_true")
     p.add_argument("--timeout", type=float, default=300.0)
-    p.add_argument("--compression", default="none", choices=["none", "bf16", "int8"])
+    p.add_argument(
+        "--compression",
+        default="none",
+        type=_reply_compression,
+        help="reply encoding: none|bf16|int8 (topk is upload-side only)",
+    )
     p.add_argument(
         "--secure-agg",
         action="store_true",
@@ -265,7 +296,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--client-id", type=int, required=True)
     p.add_argument("--num-clients", type=int, default=None)  # None: config wins
     p.add_argument("--timeout", type=float, default=300.0)
-    p.add_argument("--compression", default="none", choices=["none", "bf16", "int8"])
+    p.add_argument(
+        "--compression",
+        default="none",
+        type=_wire_compression,
+        help="upload encoding: none|bf16|int8|topk[:frac]. topk switches "
+        "the exchange to sparse round deltas with client-side error "
+        "feedback (~50x smaller uploads at the default frac 0.01 after "
+        "the first, dense round)",
+    )
     p.add_argument(
         "--secure-agg",
         action="store_true",

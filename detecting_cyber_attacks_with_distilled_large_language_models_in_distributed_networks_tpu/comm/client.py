@@ -16,6 +16,8 @@ import socket
 import time
 from typing import Any, Mapping
 
+import numpy as np
+
 from ..utils.logging import get_logger
 from . import framing, secure, wire
 
@@ -65,6 +67,19 @@ class FederatedClient:
                 "secure aggregation needs num_clients: each client must "
                 "mask against the full advertised participant set"
             )
+        self._topk_frac: float | None = None
+        if compression.startswith("topk"):
+            # Sparse ROUND-DELTA exchange: after the first (dense) round,
+            # uploads carry topk(params - last_aggregate + residual) and the
+            # dropped mass is accumulated client-side (error feedback), so
+            # over rounds every coordinate's drift still reaches the server.
+            _, self._topk_frac = wire.parse_compression(compression)
+            if secure_agg:
+                raise ValueError(
+                    "topk compression is incompatible with secure "
+                    "aggregation: masked uploads are uniform ring elements "
+                    "with no sparsity to exploit"
+                )
         self.host = host
         self.port = port
         self.client_id = client_id
@@ -83,6 +98,13 @@ class FederatedClient:
         # idempotent re-hello; a fresh keypair after key distribution
         # could never cancel and would doom the round).
         self._round_keys: dict[tuple[bytes, int], tuple[int, bytes]] = {}
+        # Sparse-delta state (topk mode): the last aggregate this client
+        # received (the delta base BOTH sides agree on, keyed by the
+        # server's agg_round) and the error-feedback residual.
+        self._base: dict | None = None
+        self._base_round: int | None = None
+        self._residual: dict | None = None
+        self._warned_lossy_base = False
         if secure_agg and auth_key is None:
             log.warning(
                 f"[CLIENT {client_id}] --secure-agg without an auth key "
@@ -114,6 +136,14 @@ class FederatedClient:
         the DH pair secret plus the advertised (session, round) — fresh
         across rounds, and no client holds key material for pairs it does
         not belong to.
+
+        With a ``topk`` compression, rounds after the first upload sparse
+        deltas with an error-feedback residual. CONTRACT: the caller must
+        adopt the returned aggregate as its model (continue local training
+        FROM it, as cli/comm.py's client loop does — the standard FedAvg
+        client). A caller that keeps training from its own pre-exchange
+        params would carry the undelivered drift in its params AND in the
+        residual, over-correcting those coordinates roughly 2x per round.
         """
         base_meta = {
             "client_id": self.client_id,
@@ -121,12 +151,15 @@ class FederatedClient:
             **dict(meta or {}),
         }
         flat = wire.flatten_params(params) if self.secure_agg else None
-        # The plain (no auth, no masking) upload encodes once; auth embeds
-        # the per-connection challenge and secure mode embeds the per-round
-        # masks, so those encode inside the attempt loop.
+        # The plain (no auth, no masking, no sparse-delta) upload encodes
+        # once; auth embeds the per-connection challenge, secure mode embeds
+        # the per-round masks, and topk mode picks sparse-vs-dense per
+        # attempt, so those encode inside the attempt loop.
         msg = (
             wire.encode(params, meta=base_meta, compression=self.compression)
-            if self.auth_key is None and not self.secure_agg
+            if self.auth_key is None
+            and not self.secure_agg
+            and self._topk_frac is None
             else None
         )
         last: Exception | None = None
@@ -230,13 +263,24 @@ class FederatedClient:
                         round=round_no,
                         participants=self.num_clients,
                     )
-                if self.auth_key is not None or self.secure_agg:
+                attempt_compression = self.compression
+                delta_flat = sent_flat = None
+                if self._topk_frac is not None:
+                    upload, attempt_compression, delta_flat, sent_flat = (
+                        self._prepare_topk_upload(params, attempt, attempt_meta)
+                    )
+                if (
+                    self.auth_key is not None
+                    or self.secure_agg
+                    or self._topk_frac is not None
+                ):
                     # Fresh encode per attempt: the nonce and/or round (and
-                    # with them the masks) change between connections.
+                    # with them the masks), or the sparse-vs-dense choice,
+                    # change between connections.
                     msg = wire.encode(
                         upload,
                         meta=attempt_meta,
-                        compression=self.compression,
+                        compression=attempt_compression,
                         auth_key=self.auth_key,
                     )
                 log.info(
@@ -258,6 +302,8 @@ class FederatedClient:
                     f"[CLIENT {self.client_id}] received aggregated model "
                     f"({len(reply) / 1e6:.1f} MB, clients {agg_meta.get('round_clients')})"
                 )
+                if self._topk_frac is not None:
+                    self._finish_topk(agg, agg_meta, delta_flat, sent_flat)
                 return agg
             except (OSError, ConnectionError, wire.WireError) as e:
                 last = e
@@ -270,6 +316,95 @@ class FederatedClient:
         raise ConnectionError(
             f"client {self.client_id}: round failed after {max_retries} attempts: {last}"
         )
+
+    # ------------------------------------------------- sparse round deltas
+    def _prepare_topk_upload(
+        self, params: Any, attempt: int, attempt_meta: dict
+    ) -> tuple[Any, str, dict | None, dict | None]:
+        """Choose this attempt's upload form in topk mode.
+
+        Returns ``(upload, compression, delta_flat, sent_flat)``. Sparse
+        needs a shared base: round 1 (no aggregate yet), a server that
+        never echoed an ``agg_round``, or any retry after a failed attempt
+        (the failure may have been the server rejecting a stale base, e.g.
+        after a restart — dense is always correct, so retries pay the full
+        payload rather than risk a doomed round) all fall back to dense."""
+        use_sparse = (
+            attempt == 1 and self._base is not None and self._base_round is not None
+        )
+        flatp = wire.flatten_params(params)
+        if use_sparse and set(flatp) != set(self._base):
+            # A changed architecture can't be expressed as a delta; dense
+            # is always correct, so fall back instead of burning a retry.
+            log.warning(
+                f"[CLIENT {self.client_id}] param key set changed since the "
+                "last aggregate — uploading dense this round"
+            )
+            use_sparse = False
+        if not use_sparse:
+            attempt_meta.update(delta=False)
+            return params, "none", None, None
+        delta: dict[str, np.ndarray] = {}
+        sent: dict[str, np.ndarray] = {}
+        upload: dict[str, wire.PreEncoded] = {}
+        for k, v in flatp.items():
+            d = np.asarray(v, np.float32) - self._base[k]
+            if self._residual is not None:
+                d = d + self._residual[k]
+            delta[k] = d
+            # One top-k selection per tensor: the payload goes to the wire
+            # as-is (PreEncoded), and its densified mirror feeds the
+            # residual — no second argpartition inside encode.
+            buf = wire.sparsify_topk(d, self._topk_frac)
+            sent[k] = wire.densify_topk(buf, d.shape)
+            upload[k] = wire.PreEncoded("topk", buf, d.shape)
+        attempt_meta.update(delta=True, base_agg_round=self._base_round)
+        return upload, "none", delta, sent
+
+    def _finish_topk(
+        self, agg: dict, agg_meta: Mapping[str, Any], delta_flat, sent_flat
+    ) -> None:
+        """Post-round bookkeeping: adopt the new aggregate as the next
+        round's delta base and fold this round's dropped mass into the
+        error-feedback residual (zero if the upload went dense)."""
+        if delta_flat is not None:
+            self._residual = {
+                k: delta_flat[k] - sent_flat[k] for k in delta_flat
+            }
+        else:
+            self._residual = None
+        agg_round = agg_meta.get("agg_round")
+        if agg_round is None:
+            # Server without delta support: stay dense forever.
+            self._base = self._base_round = None
+            return
+        base = {
+            k: np.asarray(v, np.float32)
+            for k, v in wire.flatten_params(agg).items()
+        }
+        # Base-agreement contract: only adopt the reply as a delta base if
+        # it is bit-identical to the server's fp32 aggregate (the stamped
+        # crc). A lossy reply compression (serve --compression bf16/int8)
+        # would otherwise make every later sparse round reconstruct
+        # against a base the server doesn't hold, silently biasing the
+        # model by the base's quantization error.
+        try:
+            matches = wire.flat_crc32(base) == int(agg_meta["agg_crc"])
+        except (KeyError, TypeError, ValueError):
+            matches = False
+        if not matches:
+            if not self._warned_lossy_base:
+                self._warned_lossy_base = True
+                log.warning(
+                    f"[CLIENT {self.client_id}] reply aggregate does not "
+                    "match the server's exact fp32 base (lossy reply "
+                    "compression, or a pre-delta server) — uploads stay "
+                    "dense"
+                )
+            self._base = self._base_round = self._residual = None
+            return
+        self._base = base
+        self._base_round = int(agg_round)
 
     def _parse_keys_frame(
         self, frame: bytes, priv: int, session: bytes, round_no: int

@@ -75,6 +75,9 @@ class _Round:
     expected: int
     round_no: int = 0
     models: dict[int, dict] = field(default_factory=dict)  # client_id -> flat params
+    # Sparse-delta uploads (topk clients): flat params holds the DENSIFIED
+    # round delta; the absolute model is base + delta at aggregation time.
+    deltas: dict[int, bool] = field(default_factory=dict)
     n_samples: dict[int, float] = field(default_factory=dict)
     conns: dict[int, socket.socket] = field(default_factory=dict)
     nonces: dict[int, str] = field(default_factory=dict)  # auth mode only
@@ -123,6 +126,11 @@ class AggregationServer:
                 "secure aggregation needs every advertised participant's "
                 "masks to cancel: min_clients must equal num_clients"
             )
+        if compression.startswith("topk"):
+            raise ValueError(
+                "topk is an upload-side (sparse round-delta) compression; "
+                "the reply is an absolute aggregate — use none/bf16/int8"
+            )
         self.num_clients = num_clients
         self.weighted = weighted
         self.min_clients = num_clients if min_clients is None else min_clients
@@ -138,6 +146,13 @@ class AggregationServer:
         # letting an observer difference two runs' uploads).
         self._round_counter = 0
         self._session = os.urandom(16)
+        # Last completed aggregate (flat fp32) + its round index: the base
+        # that sparse-delta (topk) uploads difference against. Advertised
+        # to clients via the reply's ``agg_round`` meta; a restarted server
+        # has no base and rejects delta uploads, which makes clients fall
+        # back to a dense resend.
+        self._last_agg: dict | None = None
+        self._last_agg_round = -1
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -280,6 +295,34 @@ class AggregationServer:
                 )
             flat = wire.flatten_params(flat)
             client_id = int(meta.get("client_id", -1))
+            is_delta = bool(meta.get("delta", False))
+            if is_delta:
+                if self.secure_agg:
+                    raise wire.WireError(
+                        "sparse-delta upload in secure-aggregation mode"
+                    )
+                base = self._last_agg
+                try:
+                    base_round = int(meta.get("base_agg_round", -2))
+                except (TypeError, ValueError):
+                    raise wire.WireError(
+                        f"malformed base_agg_round "
+                        f"{meta.get('base_agg_round')!r} in delta upload"
+                    ) from None
+                if base is None or base_round != self._last_agg_round:
+                    raise wire.WireError(
+                        f"delta upload against base round "
+                        f"{meta.get('base_agg_round')} but server base is "
+                        f"{self._last_agg_round if base is not None else 'absent'} "
+                        "(restart or stale client) — client will resend dense"
+                    )
+                if set(flat) != set(base) or any(
+                    np.asarray(flat[k]).shape != np.asarray(base[k]).shape
+                    for k in flat
+                ):
+                    raise wire.WireError(
+                        "delta upload's tensor set/shapes do not match the base"
+                    )
             if bool(meta.get("secure", False)) != self.secure_agg:
                 raise wire.WireError(
                     f"secure-aggregation mode mismatch: server "
@@ -323,6 +366,7 @@ class AggregationServer:
                     if old is not None:
                         old.close()
                 rnd.models[client_id] = flat
+                rnd.deltas[client_id] = is_delta
                 rnd.n_samples[client_id] = float(meta.get("n_samples", 1.0))
                 rnd.conns[client_id] = conn
                 if nonce_hex is not None:
@@ -334,7 +378,18 @@ class AggregationServer:
             )
             if done:
                 rnd.complete.set()
-        except (OSError, wire.WireError, secure.SecureAggError, ConnectionError) as e:
+        except (
+            OSError,
+            wire.WireError,
+            secure.SecureAggError,
+            ConnectionError,
+            # Defense in depth: meta fields are attacker-controlled, and a
+            # parse slipping through as ValueError/TypeError must still
+            # close the connection instead of killing the thread and
+            # leaving the client blocked until its socket timeout.
+            ValueError,
+            TypeError,
+        ) as e:
             log.info(f"[SERVER] upload failed: {e}")
             conn.close()
 
@@ -373,6 +428,7 @@ class AggregationServer:
         with rnd.lock:
             rnd.closed = True
             models = dict(rnd.models)
+            deltas = dict(rnd.deltas)
             conns = dict(rnd.conns)
             n_samples = dict(rnd.n_samples)
             nonces = dict(rnd.nonces)
@@ -401,12 +457,43 @@ class AggregationServer:
                 )
             else:
                 weights = [n_samples[i] for i in ids] if self.weighted else None
-                agg = aggregate_flat([models[i] for i in ids], weights)
-                log.info(f"[SERVER] aggregated {len(ids)} models (clients {ids})")
+                # Sparse-delta uploads become absolute models against the
+                # last aggregate (validated against it at upload time), so
+                # dense and sparse clients mix freely in one round.
+                absolute = [
+                    {
+                        k: self._last_agg[k] + np.asarray(v, np.float32)
+                        for k, v in models[i].items()
+                    }
+                    if deltas.get(i)
+                    else models[i]
+                    for i in ids
+                ]
+                agg = aggregate_flat(absolute, weights)
+                n_sparse = sum(bool(deltas.get(i)) for i in ids)
+                log.info(
+                    f"[SERVER] aggregated {len(ids)} models (clients {ids}"
+                    + (f", {n_sparse} sparse-delta" if n_sparse else "")
+                    + ")"
+                )
+            # The new base for next round's sparse deltas, advertised in
+            # every reply. Secure mode tracks it too (harmless), but delta
+            # uploads are refused there (mask streams carry no sparsity).
+            self._last_agg = agg
+            self._last_agg_round = rnd.round_no
+            # agg_crc: the base-agreement contract. Clients only adopt the
+            # decoded reply as their next delta base when it hashes to the
+            # server's exact fp32 aggregate — under a lossy reply
+            # compression (bf16/int8) it never will, and they stay dense.
+            reply_meta = {
+                "round_clients": ids,
+                "agg_round": rnd.round_no,
+                "agg_crc": wire.flat_crc32(agg),
+            }
             if self.auth_key is None:
                 # One shared reply blob, referenced by every client.
                 shared = wire.encode(
-                    agg, meta={"round_clients": ids}, compression=self.compression
+                    agg, meta=reply_meta, compression=self.compression
                 )
                 replies = {cid: shared for cid in ids}
             else:
@@ -417,7 +504,7 @@ class AggregationServer:
                     cid: wire.encode(
                         agg,
                         meta={
-                            "round_clients": ids,
+                            **reply_meta,
                             "role": "server",
                             "nonce": nonces.get(cid),
                         },

@@ -20,6 +20,16 @@ quantization, each leading-axis row carrying its own fp32 scale
 (``max|row| / 127``) prepended to the tensor's payload segment. Worst-case
 per-weight error is half a quantization step (~0.4% of the row's max) —
 lossier than bf16; an opt-in bandwidth/fidelity trade for slow links.
+
+``compression="topk"`` / ``"topk:<frac>"`` keeps only the largest-magnitude
+``frac`` of each fp32 tensor's entries (default 1%): per-tensor payload is
+``u32 k | int32 indices[k] | fp32 values[k]`` — 8 bytes per kept entry, so
+~50x smaller than fp32 at frac=0.01; decode scatters back to a dense
+zero-filled tensor. On its own
+this is extremely lossy — it exists for the *sparse round-delta* exchange
+(comm/client.py ``FederatedClient`` with a topk compression: uploads become
+top-k round deltas with client-held error feedback, so dropped mass is
+carried to the next round, never lost).
 """
 
 from __future__ import annotations
@@ -103,6 +113,96 @@ def dequantize_int8(raw, shape: tuple[int, ...]) -> np.ndarray:
     return (q.astype(np.float32) * scales[:, None]).reshape(shape)
 
 
+# ------------------------------------------------------ top-k sparsification
+DEFAULT_TOPK_FRAC = 0.01
+
+
+def parse_compression(spec: str) -> tuple[str, float | None]:
+    """``"topk:0.05"`` -> ``("topk", 0.05)``; plain modes -> ``(spec, None)``."""
+    if spec.startswith("topk"):
+        frac = DEFAULT_TOPK_FRAC
+        if spec != "topk":
+            if not spec.startswith("topk:"):
+                raise WireError(f"unknown compression {spec!r}")
+            try:
+                frac = float(spec.split(":", 1)[1])
+            except ValueError:
+                raise WireError(f"bad topk fraction in {spec!r}") from None
+        if not 0.0 < frac <= 1.0:
+            raise WireError(f"topk fraction {frac} outside (0, 1]")
+        return "topk", frac
+    if spec not in ("none", "bf16", "int8"):
+        raise WireError(f"unknown compression {spec!r}")
+    return spec, None
+
+
+def sparsify_topk(arr: np.ndarray, frac: float) -> bytes:
+    """fp32 tensor -> ``u32 k | int32 idx[k] | fp32 vals[k]`` payload,
+    keeping the ``k = max(1, round(frac * size))`` largest-|value| entries.
+    Indices are sorted so decode's scatter is sequential."""
+    a = np.ascontiguousarray(arr, np.float32).reshape(-1)
+    if a.size == 0:
+        return struct.pack("<I", 0)
+    k = max(1, int(round(frac * a.size)))
+    if k >= a.size:
+        idx = np.arange(a.size, dtype=np.int32)
+    else:
+        idx = np.sort(np.argpartition(np.abs(a), -k)[-k:]).astype(np.int32)
+    return struct.pack("<I", len(idx)) + idx.tobytes() + a[idx].tobytes()
+
+
+def densify_topk(raw, shape: tuple[int, ...]) -> np.ndarray:
+    """Inverse of :func:`sparsify_topk`: zeros everywhere but the kept
+    entries. Bounds-checks everything — the payload is untrusted."""
+    size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    if len(raw) < 4:
+        raise WireError("topk tensor payload shorter than its count field")
+    (k,) = struct.unpack("<I", bytes(raw[:4]))
+    if len(raw) != 4 + 8 * k:
+        raise WireError(
+            f"topk tensor payload is {len(raw)} bytes, expected {4 + 8 * k}"
+        )
+    idx = np.frombuffer(raw, np.int32, count=k, offset=4)
+    vals = np.frombuffer(raw, np.float32, count=k, offset=4 + 4 * k)
+    out = np.zeros(size, np.float32)
+    if k:
+        if idx.min() < 0 or idx.max() >= size:
+            raise WireError("topk index out of tensor bounds")
+        out[idx] = vals
+    return out.reshape(shape)
+
+
+class PreEncoded:
+    """A tensor whose wire payload is already built (``enc``/``buf``/
+    ``shape``/``dtype``): lets a caller that must inspect the encoded form
+    anyway (the sparse-delta client mirrors the kept entries for its
+    error-feedback residual) hand the bytes straight to :func:`encode`
+    instead of paying the top-k selection twice."""
+
+    __slots__ = ("enc", "buf", "shape", "dtype")
+
+    def __init__(self, enc: str, buf: bytes, shape: tuple, dtype: str = "float32"):
+        self.enc = enc
+        self.buf = buf
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = dtype
+
+
+def flat_crc32(flat: Mapping[str, Any]) -> int:
+    """Order-independent-of-construction checksum of a flat fp32 param
+    dict: CRC-32 over the sorted-key concatenation of raw tensor bytes.
+    The sparse-delta exchange uses it as the base-agreement contract —
+    the server stamps its exact aggregate's crc into the reply, and a
+    client only adopts the decoded reply as a delta base when its own
+    crc matches (a lossy reply compression, e.g. int8, would silently
+    bias every sparse round otherwise)."""
+    crc = 0
+    for key in sorted(flat):
+        arr = np.ascontiguousarray(np.asarray(flat[key], np.float32))
+        crc = native.crc32(np.frombuffer(arr.tobytes(), np.uint8), crc)
+    return crc & 0xFFFFFFFF
+
+
 # ------------------------------------------------------- pytree <-> flat
 def flatten_params(tree: Any, *, sep: str = "/") -> dict[str, np.ndarray]:
     """Nested dict of arrays -> sorted flat ``{'a/b/c': ndarray}``."""
@@ -150,8 +250,7 @@ def encode(
     authentication at all (any peer that can connect injects weights,
     server.py:57-65); a keyed decoder rejects unauthenticated or tampered
     messages."""
-    if compression not in ("none", "bf16", "int8"):
-        raise WireError(f"unknown compression {compression!r}")
+    compression, topk_frac = parse_compression(compression)
     flat = (
         dict(params)
         if isinstance(params, Mapping) and all(not isinstance(v, Mapping) for v in params.values())
@@ -161,6 +260,20 @@ def encode(
     chunks: list[bytes] = []
     offset = 0
     for key, arr in flat.items():
+        if isinstance(arr, PreEncoded):
+            tensors.append(
+                {
+                    "key": key,
+                    "dtype": arr.dtype,
+                    "shape": list(arr.shape),
+                    "enc": arr.enc,
+                    "offset": offset,
+                    "nbytes": len(arr.buf),
+                }
+            )
+            chunks.append(arr.buf)
+            offset += len(arr.buf)
+            continue
         arr = np.asarray(arr)
         dtype = str(arr.dtype)
         if dtype not in _ALLOWED_DTYPES:
@@ -171,6 +284,9 @@ def encode(
         elif compression == "int8" and arr.dtype == np.float32:
             buf = quantize_int8(arr)
             enc = "int8"
+        elif compression == "topk" and arr.dtype == np.float32:
+            buf = sparsify_topk(arr, topk_frac)
+            enc = "topk"
         else:
             buf = np.ascontiguousarray(arr).tobytes()
             enc = "raw"
@@ -276,6 +392,8 @@ def decode(
                 arr = native.unpack_bf16(packed, shape=tuple(t["shape"]))
             elif t["enc"] == "int8":
                 arr = dequantize_int8(raw, tuple(t["shape"]))
+            elif t["enc"] == "topk":
+                arr = densify_topk(raw, tuple(t["shape"]))
             elif t["enc"] == "raw":
                 arr = np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(t["shape"])
             else:
