@@ -160,6 +160,15 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
                     if getattr(args, "server_momentum", None) is None
                     else args.server_momentum
                 ),
+                personalize_epochs=(
+                    cfg.fed.personalize_epochs
+                    if getattr(args, "personalize_epochs", None) is None
+                    else args.personalize_epochs
+                ),
+                personalize_scope=(
+                    getattr(args, "personalize_scope", None)
+                    or cfg.fed.personalize_scope
+                ),
             ),
             mesh=MeshConfig(
                 clients=n,

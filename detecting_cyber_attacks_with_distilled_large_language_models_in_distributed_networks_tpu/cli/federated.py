@@ -71,6 +71,13 @@ def cmd_federated(args) -> int:
             "place the seq ring across DCN); shard clients over hosts with "
             "the 2-axis path instead"
         )
+    if cfg.fed.personalize_epochs > 0 and cfg.mesh.seq > 1:
+        # Also knowable up front — do not let a multi-round run train to
+        # completion and die at the personalization phase.
+        raise SystemExit(
+            "--personalize-epochs is not supported with --seq-parallel "
+            "yet; drop one of the two flags"
+        )
     if jax.process_count() > 1:
         from ..parallel.multihost import local_client_slice, make_global_mesh
 
@@ -317,26 +324,65 @@ def cmd_federated(args) -> int:
     final_agg = trainer.evaluate_clients(
         state.params, prepared=prepared, collect_probs=True
     )
+    final_pers = None
+    if cfg.fed.personalize_epochs > 0:
+        # FedAvg + local fine-tuning: each client adapts the aggregate on
+        # its own shard (scope 'head' = FedPer) — evaluated as a third
+        # phase; the aggregate itself (already evaluated above) is NOT
+        # touched, so the standard artifact set stays comparable.
+        with phase(
+            f"personalization ({cfg.fed.personalize_epochs} epoch(s), "
+            f"scope {cfg.fed.personalize_scope})",
+            tag="FED",
+        ):
+            pstate, _ = trainer.personalize(state, stacked_train)
+            final_pers = trainer.evaluate_clients(
+                pstate.params, prepared=prepared
+            )
+        for c in range(C):
+            log.info(
+                f"[FED] client {c}: aggregated test acc "
+                f"{final_agg[c]['Accuracy']:.4f} -> personalized "
+                f"{final_pers[c]['Accuracy']:.4f}"
+            )
+        if getattr(args, "metrics_jsonl", None) and jax.process_index() == 0:
+            from ..reporting import append_metrics_jsonl
+
+            for c in range(C):
+                append_metrics_jsonl(
+                    args.metrics_jsonl,
+                    {
+                        "round": cfg.fed.rounds,
+                        "client": c,
+                        "phase": "personalized",
+                        "split": "test",
+                        **final_pers[c],
+                    },
+                )
     if not multihost or jax.process_index() == 0:
         if final_local is None:
             # No round trained this launch (e.g. relaunching a completed
             # checkpointed run): there ARE no local-model metrics — write
             # aggregated artifacts only rather than mislabeling.
-            from .. import reporting
-
             log.info(
                 "[FED] all rounds already complete; writing aggregated "
                 "reports only"
             )
-            os.makedirs(cfg.output_dir, exist_ok=True)
-            for c in range(C):
-                reporting.save_metrics(
-                    final_agg[c],
-                    os.path.join(
-                        cfg.output_dir, f"client{c}_aggregated_metrics.csv"
-                    ),
-                )
+            _save_phase_csvs(final_agg, "aggregated", cfg.output_dir)
         else:
             for c in range(C):
                 _write_reports(c, final_local[c], final_agg[c], cfg.output_dir)
+        if final_pers is not None:
+            _save_phase_csvs(final_pers, "personalized", cfg.output_dir)
     return 0
+
+
+def _save_phase_csvs(metrics: list, phase_name: str, out_dir: str) -> None:
+    """One `client{c}_{phase}_metrics.csv` per client (reference schema)."""
+    from .. import reporting
+
+    os.makedirs(out_dir, exist_ok=True)
+    for c, m in enumerate(metrics):
+        reporting.save_metrics(
+            m, os.path.join(out_dir, f"client{c}_{phase_name}_metrics.csv")
+        )

@@ -215,6 +215,20 @@ def build_parser() -> argparse.ArgumentParser:
         "non-IID partitions",
     )
     p.add_argument(
+        "--personalize-epochs",
+        type=int,
+        help="after the final round, fine-tune the aggregate on each "
+        "client's own shard for this many epochs and report a third "
+        "'personalized' evaluation phase (0 = off)",
+    )
+    p.add_argument(
+        "--personalize-scope",
+        choices=["full", "head"],
+        help="personalization scope: 'full' fine-tunes everything "
+        "(FedAvg+FT); 'head' freezes the shared encoder and adapts only "
+        "the classifier head (FedPer)",
+    )
+    p.add_argument(
         "--participation",
         type=float,
         help="fraction of clients aggregated per round (sampled, seeded); "

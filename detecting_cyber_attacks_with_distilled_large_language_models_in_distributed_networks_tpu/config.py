@@ -206,10 +206,20 @@ class TrainConfig:
     # TPU choice for dropout masks) is ~10 points of MFU cheaper than
     # "threefry2x32" on the flagship model; both are valid JAX key impls.
     prng_impl: str = "rbg"
+    # Which parameters the optimizer updates. "all" (default) is normal
+    # training; "head" freezes the encoder and trains only the classifier
+    # head (updates zeroed via optax.multi_transform) — the FedPer-style
+    # personalization scope, also usable standalone for linear probing of
+    # a pretrained encoder.
+    trainable: str = "all"
 
     def __post_init__(self) -> None:
         if self.prng_impl not in ("rbg", "threefry2x32", "unsafe_rbg"):
             raise ValueError(f"unknown prng_impl {self.prng_impl!r}")
+        if self.trainable not in ("all", "head"):
+            raise ValueError(
+                f"trainable={self.trainable!r} must be 'all' or 'head'"
+            )
 
 
 @dataclass(frozen=True)
@@ -272,6 +282,14 @@ class FedConfig:
     server_opt: str = "none"
     server_lr: float = 1.0
     server_momentum: float = 0.9
+    # Personalization (FedAvg + local fine-tuning): after the final round,
+    # each client fine-tunes the aggregate on its own shard for this many
+    # epochs and is evaluated as a THIRD phase ("personalized") next to
+    # the reference's local/aggregated pair. 0 = off. Scope "full"
+    # fine-tunes everything (FedAvg+FT); "head" freezes the shared encoder
+    # and adapts only the classifier head (FedPer, Arivazhagan et al.).
+    personalize_epochs: int = 0
+    personalize_scope: str = "full"
 
     def server_opt_enabled(self) -> bool:
         return self.server_opt != "none"
@@ -309,6 +327,15 @@ class FedConfig:
         if not 0.0 < self.participation <= 1.0:
             raise ValueError(
                 f"participation={self.participation} must be in (0, 1]"
+            )
+        if self.personalize_epochs < 0:
+            raise ValueError(
+                f"personalize_epochs={self.personalize_epochs} must be >= 0"
+            )
+        if self.personalize_scope not in ("full", "head"):
+            raise ValueError(
+                f"personalize_scope={self.personalize_scope!r} must be "
+                "'full' or 'head'"
             )
         if self.participation < self.min_client_fraction:
             raise ValueError(

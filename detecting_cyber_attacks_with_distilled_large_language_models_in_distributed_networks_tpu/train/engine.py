@@ -80,6 +80,21 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     else:
         tx.append(optax.adam(cfg.learning_rate, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps))
     opt = optax.chain(*tx)
+    if cfg.trainable == "head":
+        # FedPer-style scope: zero every update outside the classifier
+        # head. Labels derive from the params' top-level structure
+        # ({"encoder": ..., "classifier": ...}, models/distilbert.py), so
+        # the same optimizer serves the single-client engine and the
+        # stacked federated steps unchanged.
+        opt = optax.multi_transform(
+            {"train": opt, "freeze": optax.set_to_zero()},
+            param_labels=lambda params: {
+                k: jax.tree.map(
+                    lambda _: "train" if k == "classifier" else "freeze", v
+                )
+                for k, v in params.items()
+            },
+        )
     if cfg.grad_accum_steps > 1:
         opt = optax.MultiSteps(opt, cfg.grad_accum_steps)
     return opt

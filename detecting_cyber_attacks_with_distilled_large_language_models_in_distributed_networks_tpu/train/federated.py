@@ -227,6 +227,65 @@ class FederatedTrainer:
     def reset_optimizer(self, state: FedState) -> FedState:
         return state._replace(opt_state=self._opt_init(state.params))
 
+    def personalize(
+        self,
+        state: FedState,
+        stacked_train,
+        *,
+        epochs: int | None = None,
+        scope: str | None = None,
+    ) -> tuple[FedState, "np.ndarray"]:
+        """FedAvg + local fine-tuning: train each client's replica on its
+        own shard from the current (typically just-aggregated) params,
+        WITHOUT a closing aggregate — the result is per-client
+        personalized models, the third evaluation phase next to the
+        reference's local/aggregated pair. ``scope="head"`` freezes the
+        shared encoder and adapts only the classifier (FedPer); ``"full"``
+        fine-tunes everything (FedAvg+FT). Runs the same SPMD fit as a
+        round, so it composes with ragged stacks and multi-host meshes."""
+        from dataclasses import replace as dc_replace
+
+        epochs = self.cfg.fed.personalize_epochs if epochs is None else epochs
+        scope = self.cfg.fed.personalize_scope if scope is None else scope
+        if epochs <= 0:
+            raise ValueError("personalize needs epochs > 0")
+        if scope not in ("full", "head"):
+            raise ValueError(f"personalize scope {scope!r} must be full|head")
+        # Build a scope-matched trainer in EITHER direction: head scope on
+        # an all-params config, or full scope on a linear-probing
+        # (trainable='head') base config.
+        want_trainable = "head" if scope == "head" else "all"
+        if self.cfg.train.trainable != want_trainable:
+            ptrainer = FederatedTrainer(
+                dc_replace(
+                    self.cfg,
+                    train=dc_replace(self.cfg.train, trainable=want_trainable),
+                ),
+                pad_id=self.pad_id,
+                mesh=self.mesh,
+            )
+        else:
+            ptrainer = self
+        # Personalization is a SIDE BRANCH: the jitted steps donate their
+        # input buffers, so train on copies of the leaves that survive
+        # into the branch (params/rngs/step/server state) — the caller's
+        # aggregate state stays alive for reporting/checkpointing. The
+        # optimizer state is NOT copied: it is rebuilt fresh under the
+        # (possibly masked) personal optimizer (same policy as the
+        # per-round reset), and copying the stacked Adam moments first
+        # would transiently double the largest allocation on the mesh.
+        import jax.numpy as jnp
+
+        params = jax.tree.map(jnp.copy, state.params)
+        state = state._replace(
+            params=params,
+            opt_state=ptrainer._opt_init(params),
+            step=jnp.copy(state.step),
+            rngs=jnp.copy(state.rngs),
+            server_opt=jax.tree.map(jnp.copy, state.server_opt),
+        )
+        return ptrainer.fit_local(state, stacked_train, epochs=epochs)
+
     # ---------------------------------------------------------------- phases
     def fit_local(
         self,

@@ -1,0 +1,191 @@
+"""Personalization: FedAvg + local fine-tuning (scope 'full' = FedAvg+FT,
+scope 'head' = FedPer) — a third evaluation phase beyond the reference's
+local/aggregated pair; each client adapts the aggregate to its own shard.
+
+Fast lane: the engine-level frozen-encoder proof + the CLI e2e third-phase
+artifact run. Slow lane: the trainer-level conflicting-clients win,
+bit-frozen encoder, and scope override-direction proofs.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.pipeline import (
+    TokenizedSplit,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.federated import (
+    FederatedTrainer,
+)
+
+ML = 16
+
+
+def _cfg(**fed_kw):
+    return ExperimentConfig(
+        model=ModelConfig.tiny(max_len=ML, max_position_embeddings=ML),
+        data=DataConfig(max_len=ML, batch_size=8, eval_batch_size=8),
+        train=TrainConfig(learning_rate=1e-3, epochs_per_round=1, seed=0),
+        fed=FedConfig(num_clients=2, **fed_kw),
+        mesh=MeshConfig(clients=2, data=1),
+    )
+
+
+def _clientwise_data(seed=0, n=48):
+    """Two clients with OPPOSITE label rules for the same token pattern —
+    the aggregate cannot satisfy both, so personalization must help."""
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, 200, (2, n, ML)).astype(np.int32)
+    mask = np.ones((2, n, ML), np.int32)
+    feature = ids[:, :, 1] % 2  # a trivially learnable per-row bit
+    labels = np.stack([feature[0], 1 - feature[1]]).astype(np.int32)
+    return TokenizedSplit(ids, mask, labels)
+
+
+@pytest.mark.slow
+def test_personalize_full_beats_aggregate_on_conflicting_clients(eight_devices):
+    train = _clientwise_data()
+    cfg = _cfg(personalize_epochs=3, personalize_scope="full")
+    trainer = FederatedTrainer(cfg)
+    state = trainer.init_state(seed=0)
+    state, _ = trainer.fit_local(state, train, epochs=3)
+    state = trainer.aggregate(state)
+
+    prepared = trainer.prepare_eval(
+        [
+            TokenizedSplit(train.input_ids[c], train.attention_mask[c], train.labels[c])
+            for c in range(2)
+        ]
+    )
+    agg_m = trainer.evaluate_clients(state.params, prepared=prepared)
+
+    pstate, losses = trainer.personalize(state, train)
+    pers_m = trainer.evaluate_clients(pstate.params, prepared=prepared)
+    assert losses.shape[-1] == 2
+    # Conflicting label rules: the shared aggregate can't fit both clients;
+    # per-client fine-tuning must (weakly) improve each one and give a
+    # clear net win.
+    for c in range(2):
+        assert pers_m[c]["Accuracy"] >= agg_m[c]["Accuracy"] - 1.0
+    assert sum(pers_m[c]["Accuracy"] for c in range(2)) > sum(
+        agg_m[c]["Accuracy"] for c in range(2)
+    )
+    # Personalized replicas DIVERGE (no closing aggregate).
+    leaf = np.asarray(jax.tree.leaves(pstate.params)[0])
+    assert not np.allclose(leaf[0], leaf[1])
+
+
+@pytest.mark.slow
+def test_personalize_head_freezes_encoder(eight_devices):
+    train = _clientwise_data(seed=1)
+    cfg = _cfg(personalize_epochs=2, personalize_scope="head")
+    trainer = FederatedTrainer(cfg)
+    state = trainer.init_state(seed=0)
+    state, _ = trainer.fit_local(state, train, epochs=1)
+    state = trainer.aggregate(state)
+
+    pstate, _ = trainer.personalize(state, train)
+    # FedPer: the shared encoder is bit-frozen; only the head moved.
+    for a, b in zip(
+        jax.tree.leaves(state.params["encoder"]),
+        jax.tree.leaves(pstate.params["encoder"]),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    moved = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree.leaves(state.params["classifier"]),
+            jax.tree.leaves(pstate.params["classifier"]),
+        )
+    )
+    assert moved
+
+
+@pytest.mark.slow
+def test_personalize_full_overrides_head_base_config(eight_devices):
+    """The scope override works in BOTH directions: scope='full' on a
+    linear-probing base config (trainable='head') must unfreeze the
+    encoder."""
+    import dataclasses
+
+    train = _clientwise_data(seed=2, n=16)
+    cfg = _cfg(personalize_epochs=1, personalize_scope="full")
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, trainable="head")
+    )
+    trainer = FederatedTrainer(cfg)
+    state = trainer.init_state(seed=0)
+    pstate, _ = trainer.personalize(state, train)
+    moved = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree.leaves(state.params["encoder"]),
+            jax.tree.leaves(pstate.params["encoder"]),
+        )
+    )
+    assert moved, "scope='full' left the encoder frozen"
+
+
+def test_trainable_head_engine_scope():
+    """TrainConfig.trainable='head' works standalone in the single-client
+    engine (linear probing)."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.engine import (
+        Trainer,
+    )
+
+    cfg = ModelConfig.tiny(max_len=ML, max_position_embeddings=ML)
+    rng = np.random.default_rng(0)
+    split = TokenizedSplit(
+        rng.integers(1, 200, (24, ML)).astype(np.int32),
+        np.ones((24, ML), np.int32),
+        rng.integers(0, 2, 24).astype(np.int32),
+    )
+    tr = Trainer(cfg, TrainConfig(learning_rate=1e-3, trainable="head", epochs_per_round=1))
+    st = tr.init_state(seed=0)
+    before = jax.tree.map(np.asarray, st.params)
+    st, _ = tr.fit(st, split, batch_size=8)
+    for a, b in zip(
+        jax.tree.leaves(before["encoder"]), jax.tree.leaves(st.params["encoder"])
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not all(
+        np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree.leaves(before["classifier"]),
+            jax.tree.leaves(st.params["classifier"]),
+        )
+    )
+    with pytest.raises(ValueError, match="trainable"):
+        TrainConfig(trainable="encoder")
+
+
+def test_cli_personalize_writes_third_metrics_csv(tmp_path, eight_devices):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
+        main,
+    )
+
+    out = tmp_path / "out"
+    rc = main(
+        [
+            "federated", "--synthetic", "300", "--num-clients", "2",
+            "--rounds", "1", "--epochs", "1", "--batch-size", "8",
+            "--personalize-epochs", "1", "--personalize-scope", "head",
+            "--output-dir", str(out),
+        ]
+    )
+    assert rc == 0
+    for c in range(2):
+        assert (out / f"client{c}_local_metrics.csv").exists()
+        assert (out / f"client{c}_aggregated_metrics.csv").exists()
+        assert (out / f"client{c}_personalized_metrics.csv").exists()
