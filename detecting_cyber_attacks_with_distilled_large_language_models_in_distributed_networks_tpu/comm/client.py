@@ -49,6 +49,10 @@ def connect_with_retry(
 class FederatedClient:
     """One client's view of a federated round over TCP."""
 
+    #: After giving up on sparse mode, re-advertise wants_delta once every
+    #: this many dense uploads (recovery probe; see _gave_up_delta).
+    PROBE_EVERY = 8
+
     def __init__(
         self,
         host: str,
@@ -105,6 +109,14 @@ class FederatedClient:
         self._base_round: int | None = None
         self._residual: dict | None = None
         self._warned_lossy_base = False
+        # Set when this client has refused sparse mode (lossy reply
+        # compression / pre-delta server): suppresses the wants_delta
+        # advert so the server stops computing agg_crc for nothing — but
+        # NOT permanently: every PROBE_EVERY-th dense round re-advertises,
+        # so a server restarted with lossless compression is rediscovered
+        # and sparse mode resumes without a client restart.
+        self._gave_up_delta = False
+        self._dense_rounds_since_giveup = 0
         if secure_agg and auth_key is None:
             log.warning(
                 f"[CLIENT {client_id}] --secure-agg without an auth key "
@@ -166,6 +178,7 @@ class FederatedClient:
         this_call: tuple[bytes, int] | None = None  # (session, round) masked now
         for attempt in range(1, max_retries + 1):
             sock = None
+            sparse_in_flight = False  # this attempt's delta hit the wire
             try:
                 sock = connect_with_retry(self.host, self.port, timeout=self.timeout)
                 sock.settimeout(self.timeout)
@@ -287,6 +300,7 @@ class FederatedClient:
                     f"[CLIENT {self.client_id}] uploading {len(msg) / 1e6:.1f} MB "
                     f"(attempt {attempt}/{max_retries})"
                 )
+                sparse_in_flight = delta_flat is not None
                 framing.send_frame(sock, msg)
                 reply = framing.recv_frame(sock)
                 agg, agg_meta = wire.decode(reply, auth_key=self.auth_key)
@@ -307,6 +321,16 @@ class FederatedClient:
                 return agg
             except (OSError, ConnectionError, wire.WireError) as e:
                 last = e
+                if sparse_in_flight:
+                    # The sparse upload reached (or may have reached) the
+                    # server before the failure — e.g. the round was
+                    # aggregated but the reply frame was lost. Its delta
+                    # embedded the residual, so retaining the residual
+                    # across the dense retry could deliver that mass twice
+                    # (the dense retry ships the full params, and the next
+                    # sparse delta would re-add the residual). The
+                    # ambiguity resolves conservatively: drop it.
+                    self._residual = None
                 log.info(f"[CLIENT {self.client_id}] round attempt {attempt} failed: {e}")
                 if attempt < max_retries:
                     time.sleep(min(2.0**attempt, 10.0))
@@ -333,24 +357,45 @@ class FederatedClient:
             attempt == 1 and self._base is not None and self._base_round is not None
         )
         flatp = wire.flatten_params(params)
-        if use_sparse and set(flatp) != set(self._base):
-            # A changed architecture can't be expressed as a delta; dense
-            # is always correct, so fall back instead of burning a retry.
+        if use_sparse and not wire.shapes_compatible(flatp, self._base):
+            # A changed architecture (keys OR same-key shapes) can't be
+            # expressed as a delta; dense is always correct, so fall back
+            # instead of crashing on the subtraction or burning a retry.
             log.warning(
-                f"[CLIENT {self.client_id}] param key set changed since the "
-                "last aggregate — uploading dense this round"
+                f"[CLIENT {self.client_id}] param key set or shapes changed "
+                "since the last aggregate — uploading dense this round"
             )
             use_sparse = False
         if not use_sparse:
-            attempt_meta.update(delta=False)
+            # wants_delta tells the server a delta-capable client is in the
+            # round, so the reply carries the agg_crc base-agreement stamp
+            # even though THIS upload went dense (the stamp is what lets
+            # the next round go sparse). A client that has given up on
+            # sparse mode (lossy reply compression or a pre-delta server)
+            # mostly stops asking — the server shouldn't pay a full-model
+            # crc pass every round for a stamp nobody uses — but probes
+            # again every PROBE_EVERY rounds so a server that became
+            # lossless is rediscovered.
+            if self._gave_up_delta:
+                probe = self._dense_rounds_since_giveup % self.PROBE_EVERY == 0
+                self._dense_rounds_since_giveup += 1
+                attempt_meta.update(delta=False, wants_delta=probe)
+            else:
+                attempt_meta.update(delta=False, wants_delta=True)
             return params, "none", None, None
+        # A residual accumulated before an architecture change (or carried
+        # across a dense-fallback round) is only usable if it still matches
+        # the current tensor set/shapes.
+        residual = self._residual
+        if residual is not None and not wire.shapes_compatible(residual, flatp):
+            residual = self._residual = None
         delta: dict[str, np.ndarray] = {}
         sent: dict[str, np.ndarray] = {}
         upload: dict[str, wire.PreEncoded] = {}
         for k, v in flatp.items():
             d = np.asarray(v, np.float32) - self._base[k]
-            if self._residual is not None:
-                d = d + self._residual[k]
+            if residual is not None:
+                d = d + residual[k]
             delta[k] = d
             # One top-k selection per tensor: the payload goes to the wire
             # as-is (PreEncoded), and its densified mirror feeds the
@@ -366,17 +411,28 @@ class FederatedClient:
     ) -> None:
         """Post-round bookkeeping: adopt the new aggregate as the next
         round's delta base and fold this round's dropped mass into the
-        error-feedback residual (zero if the upload went dense)."""
+        error-feedback residual.
+
+        A round that went dense (retry fallback, fresh base, key-set
+        change) RETAINS the residual: the dense upload shipped the current
+        params exactly, but the residual holds drift from *earlier* local
+        training that was dropped by top-k and then discarded when the
+        client adopted the aggregate — mass the module's contract promises
+        is "carried to the next round, never lost". The next sparse
+        delta (params - base + residual) remains correct. It is cleared
+        only when the base is abandoned (lossy-base refusal below) or no
+        longer shape-compatible (_prepare_topk_upload)."""
         if delta_flat is not None:
             self._residual = {
                 k: delta_flat[k] - sent_flat[k] for k in delta_flat
             }
-        else:
-            self._residual = None
         agg_round = agg_meta.get("agg_round")
         if agg_round is None:
-            # Server without delta support: stay dense forever.
+            # Server without delta support: stay dense (probe occasionally).
             self._base = self._base_round = None
+            if not self._gave_up_delta:
+                self._gave_up_delta = True
+                self._dense_rounds_since_giveup = 1
             return
         base = {
             k: np.asarray(v, np.float32)
@@ -402,9 +458,14 @@ class FederatedClient:
                     "dense"
                 )
             self._base = self._base_round = self._residual = None
+            if not self._gave_up_delta:
+                self._gave_up_delta = True
+                self._dense_rounds_since_giveup = 1
             return
         self._base = base
         self._base_round = int(agg_round)
+        # A matching base (possibly via a recovery probe) re-arms sparse mode.
+        self._gave_up_delta = False
 
     def _parse_keys_frame(
         self, frame: bytes, priv: int, session: bytes, round_no: int

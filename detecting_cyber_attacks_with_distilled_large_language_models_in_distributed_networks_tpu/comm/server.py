@@ -92,6 +92,11 @@ class _Round:
     # finishes its recv after this must drop the connection, not register
     # into an abandoned round.
     closed: bool = False
+    # True once any upload this round came from a sparse-delta-capable
+    # client (meta ``delta`` or ``wants_delta``): gates the reply's
+    # ``agg_crc`` stamp, a full fp32 pass + tobytes() copy over the whole
+    # model that deployments with no topk client shouldn't pay every round.
+    wants_delta: bool = False
 
 
 class AggregationServer:
@@ -316,10 +321,7 @@ class AggregationServer:
                         f"{self._last_agg_round if base is not None else 'absent'} "
                         "(restart or stale client) — client will resend dense"
                     )
-                if set(flat) != set(base) or any(
-                    np.asarray(flat[k]).shape != np.asarray(base[k]).shape
-                    for k in flat
-                ):
+                if not wire.shapes_compatible(flat, base):
                     raise wire.WireError(
                         "delta upload's tensor set/shapes do not match the base"
                     )
@@ -367,6 +369,8 @@ class AggregationServer:
                         old.close()
                 rnd.models[client_id] = flat
                 rnd.deltas[client_id] = is_delta
+                if is_delta or bool(meta.get("wants_delta", False)):
+                    rnd.wants_delta = True
                 rnd.n_samples[client_id] = float(meta.get("n_samples", 1.0))
                 rnd.conns[client_id] = conn
                 if nonce_hex is not None:
@@ -389,6 +393,10 @@ class AggregationServer:
             # leaving the client blocked until its socket timeout.
             ValueError,
             TypeError,
+            # A decode that survives the size caps but still overcommits
+            # (many large-claiming tensors in one message) must close the
+            # connection, not kill the handler thread.
+            MemoryError,
         ) as e:
             log.info(f"[SERVER] upload failed: {e}")
             conn.close()
@@ -485,11 +493,15 @@ class AggregationServer:
             # decoded reply as their next delta base when it hashes to the
             # server's exact fp32 aggregate — under a lossy reply
             # compression (bf16/int8) it never will, and they stay dense.
+            # Lazily computed: it is a full fp32 pass over the model, paid
+            # only when a delta-capable client showed up this round (and
+            # never in secure mode, where delta uploads are refused).
             reply_meta = {
                 "round_clients": ids,
                 "agg_round": rnd.round_no,
-                "agg_crc": wire.flat_crc32(agg),
             }
+            if rnd.wants_delta and not self.secure_agg:
+                reply_meta["agg_crc"] = wire.flat_crc32(agg)
             if self.auth_key is None:
                 # One shared reply blob, referenced by every client.
                 shared = wire.encode(

@@ -115,6 +115,14 @@ def dequantize_int8(raw, shape: tuple[int, ...]) -> np.ndarray:
 
 # ------------------------------------------------------ top-k sparsification
 DEFAULT_TOPK_FRAC = 0.01
+#: Densified-tensor allocation cap. For raw/bf16/int8 the payload itself
+#: scales with the claimed shape (and is bounded by framing.MAX_FRAME =
+#: 8 GiB), but a topk payload is ~8 bytes per kept entry regardless of the
+#: claimed dense shape — a ~50-byte message claiming shape [1e12] would
+#: otherwise trigger a multi-TB np.zeros on the receiver (memory-
+#: amplification DoS on the default unauthenticated server). Mirror the
+#: frame bound: no legitimate tensor can exceed what one frame can carry.
+MAX_DENSE_TENSOR_BYTES = 8 << 30
 
 
 def parse_compression(spec: str) -> tuple[str, float | None]:
@@ -155,9 +163,18 @@ def densify_topk(raw, shape: tuple[int, ...]) -> np.ndarray:
     """Inverse of :func:`sparsify_topk`: zeros everywhere but the kept
     entries. Bounds-checks everything — the payload is untrusted."""
     size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    if size < 0 or 4 * size > MAX_DENSE_TENSOR_BYTES:
+        # Checked BEFORE any allocation: the shape is attacker-controlled
+        # and, unlike the dense encodings, unbacked by payload bytes.
+        raise WireError(
+            f"topk tensor claims dense size {size} "
+            f"(> {MAX_DENSE_TENSOR_BYTES // 4} elements)"
+        )
     if len(raw) < 4:
         raise WireError("topk tensor payload shorter than its count field")
     (k,) = struct.unpack("<I", bytes(raw[:4]))
+    if k > size:
+        raise WireError(f"topk count {k} exceeds dense tensor size {size}")
     if len(raw) != 4 + 8 * k:
         raise WireError(
             f"topk tensor payload is {len(raw)} bytes, expected {4 + 8 * k}"
@@ -186,6 +203,16 @@ class PreEncoded:
         self.buf = buf
         self.shape = tuple(int(s) for s in shape)
         self.dtype = dtype
+
+
+def shapes_compatible(a: Mapping[str, Any], b: Mapping[str, Any]) -> bool:
+    """True when two flat param dicts have identical key sets and per-key
+    array shapes — i.e. delta/residual arithmetic between them is
+    well-defined. Shared by the sparse-delta client (params vs base,
+    residual vs params) and server (delta upload vs base)."""
+    if set(a) != set(b):
+        return False
+    return all(np.asarray(a[k]).shape == np.asarray(b[k]).shape for k in a)
 
 
 def flat_crc32(flat: Mapping[str, Any]) -> int:
@@ -377,6 +404,21 @@ def decode(
     # leak as ValueError/KeyError and kill a server thread.
     try:
         tensors = header["tensors"]
+        # Per-MESSAGE dense-size cap: the per-tensor cap alone still lets
+        # one small frame list many near-cap topk tensors (claimed shapes
+        # are unbacked by payload bytes), amplifying to hundreds of GiB of
+        # np.zeros — and under Linux overcommit that is an OOM-kill of the
+        # whole process later, not a catchable MemoryError now.
+        claimed = sum(
+            int(np.prod(t["shape"], dtype=np.int64)) * 4
+            for t in tensors
+            if t.get("enc") == "topk"
+        )
+        if claimed > MAX_DENSE_TENSOR_BYTES:
+            raise WireError(
+                f"message claims {claimed} dense bytes across topk tensors "
+                f"(> {MAX_DENSE_TENSOR_BYTES})"
+            )
         for t in tensors:
             key, dtype = t["key"], t["dtype"]
             if dtype not in _ALLOWED_DTYPES:
@@ -402,5 +444,9 @@ def decode(
         return unflatten_params(flat), dict(header.get("meta", {}))
     except WireError:
         raise
-    except (KeyError, ValueError, TypeError) as e:
+    except (KeyError, ValueError, TypeError, OverflowError, AttributeError) as e:
+        # OverflowError: a claimed dim too large for int64 (np.prod cap
+        # math); AttributeError: a tensor entry that isn't a dict. Both
+        # reachable from attacker-controlled headers and must surface as
+        # WireError, not kill a server thread.
         raise WireError(f"malformed tensor table: {e}") from None

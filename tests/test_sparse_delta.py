@@ -8,6 +8,8 @@ carried into later rounds, not lost. Round 1 (and any retry or
 server-restart recovery) is dense — always-correct fallback.
 """
 
+import json
+import struct
 import threading
 
 import numpy as np
@@ -70,6 +72,96 @@ def test_densify_rejects_corrupt_payloads():
         wire.densify_topk(bytes(bad), (8,))
 
 
+def test_densify_rejects_giant_claimed_shape():
+    """A ~50-byte payload claiming a multi-TB dense shape must be rejected
+    BEFORE any allocation — the shape is attacker-controlled and, unlike
+    the dense encodings, not backed by payload bytes (memory-amplification
+    DoS on the unauthenticated server)."""
+    raw = (
+        struct.pack("<I", 1)
+        + np.int32(0).tobytes()
+        + np.float32(1.0).tobytes()
+    )
+    with pytest.raises(WireError, match="dense size"):
+        wire.densify_topk(raw, (1_000_000_000_000,))
+
+
+def test_decode_rejects_summed_topk_claims():
+    """Per-MESSAGE cap: many topk tensors each under the per-tensor cap
+    but summing past it must be rejected before any allocation."""
+    big = (wire.MAX_DENSE_TENSOR_BYTES // 4,)
+    empty = struct.pack("<I", 0)  # k = 0: a few payload bytes per tensor
+    msg = encode(
+        {
+            "a": wire.PreEncoded("topk", empty, big),
+            "b": wire.PreEncoded("topk", empty, big),
+        }
+    )
+    with pytest.raises(WireError, match="dense bytes"):
+        decode(msg)
+
+
+def test_decode_rejects_hostile_tensor_tables():
+    """Attacker-controlled headers whose cap math would raise
+    OverflowError (dim too large for int64) or AttributeError (tensor
+    entry not a dict) must surface as WireError, not kill a server
+    thread."""
+    empty_crc = wire.native.crc32(np.frombuffer(b"", np.uint8))
+    base = {"payload_nbytes": 0, "payload_crc32": empty_crc, "meta": {}}
+    hostile_tables = [
+        ["x"],  # not a dict
+        [  # dim overflows int64 inside the summed-claim computation
+            {
+                "key": "w", "dtype": "float32", "shape": [10**30],
+                "enc": "topk", "offset": 0, "nbytes": 0,
+            }
+        ],
+    ]
+    for tensors in hostile_tables:
+        hb = json.dumps({**base, "tensors": tensors}).encode()
+        msg = wire.MAGIC + struct.pack("<II", wire.VERSION, len(hb)) + hb
+        with pytest.raises(WireError):
+            decode(msg)
+
+
+def test_probe_rediscovers_delta_capable_server(rng):
+    """After giving up on sparse mode (pre-delta or lossy server), the
+    client re-advertises wants_delta once every PROBE_EVERY rounds, and a
+    probe reply with a matching crc re-arms sparse mode — no client
+    restart needed when the server becomes lossless."""
+    params = {"w": rng.normal(size=(6, 3)).astype(np.float32)}
+    client = FederatedClient(
+        "127.0.0.1", 1, client_id=0, compression="topk:0.5"
+    )
+    client._finish_topk({"w": params["w"]}, {"agg_round": 0}, None, None)
+    assert client._gave_up_delta
+    wants = []
+    for _ in range(client.PROBE_EVERY + 1):
+        meta: dict = {}
+        client._prepare_topk_upload(params, 1, meta)
+        wants.append(meta["wants_delta"])
+    assert wants[: client.PROBE_EVERY - 1] == [False] * (client.PROBE_EVERY - 1)
+    assert wants[client.PROBE_EVERY - 1] is True
+    agg = {"w": params["w"]}
+    client._finish_topk(
+        agg,
+        {"agg_round": 3, "agg_crc": wire.flat_crc32(flatten_params(agg))},
+        None,
+        None,
+    )
+    assert not client._gave_up_delta
+    meta = {}
+    client._prepare_topk_upload(params, 1, meta)
+    assert meta["delta"] is True
+
+
+def test_densify_rejects_k_exceeding_size():
+    a = np.arange(8, dtype=np.float32)
+    raw = wire.sparsify_topk(a, 1.0)  # k = 8
+    with pytest.raises(WireError, match="exceeds"):
+        wire.densify_topk(raw, (4,))
+
+
 def test_encode_topk_payload_shrinks_and_decodes(rng):
     params = {"w": rng.normal(size=(100, 100)).astype(np.float32)}
     dense = encode(params, compression="none")
@@ -126,10 +218,35 @@ def test_single_client_sparse_rounds_track_target(rng):
     assert gaps[-1] < 0.45 * gaps[0], f"sparse rounds stalled: {gaps}"
 
 
+def _both_exchange(clients, locals_):
+    """Run both clients' exchange() concurrently (the server waits for the
+    full fleet) and return their aggregates."""
+    out = [None, None]
+    errs = [None, None]
+
+    def _one(c):
+        try:
+            out[c] = clients[c].exchange(locals_[c])
+        except Exception as e:  # surfaced in the main thread
+            errs[c] = e
+
+    ths = [threading.Thread(target=_one, args=(c,)) for c in range(2)]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join(timeout=90)
+    for e in errs:
+        if e is not None:
+            raise e
+    return out
+
+
 def test_two_client_sparse_rounds_agree_and_mix_dense(rng):
-    """2 clients, 3 rounds: round 1 dense, then sparse deltas. Both receive
-    identical aggregates every round; a mid-experiment fresh client (no
-    base) mixes its dense upload into a sparse round."""
+    """2 clients, 3 rounds: round 1 dense, round 2 sparse for both. Before
+    round 3, client 1 is replaced by a fresh instance (a mid-experiment
+    join with no delta base), so round 3 genuinely mixes one sparse-delta
+    and one dense upload in a single aggregation — the server's
+    absolute-reconstruction branch with n_sparse < len(ids)."""
     p = [
         {"w": rng.normal(size=(30, 10)).astype(np.float32)},
         {"w": rng.normal(size=(30, 10)).astype(np.float32)},
@@ -144,38 +261,43 @@ def test_two_client_sparse_rounds_agree_and_mix_dense(rng):
             for c in range(2)
         ]
         t = _serve_rounds(server, 3, results)
-
-        def _rounds(c):
-            out = []
-            local = p[c]
-            for _ in range(3):
-                agg = _sync_exchange(clients[c], local)
-                local = {"w": np.asarray(agg["w"], np.float32) * 1.01}
-                out.append(agg)
-            results[c] = out
-
-        barrier = threading.Barrier(2)
-
-        def _sync_exchange(cl, params):
-            barrier.wait(timeout=30)
-            return cl.exchange(params)
-
-        ths = [threading.Thread(target=_rounds, args=(c,)) for c in range(2)]
-        for th in ths:
-            th.start()
-        for th in ths:
-            th.join(timeout=90)
+        # Round 1: both dense (no base yet); exact mean.
+        aggs1 = _both_exchange(clients, p)
+        np.testing.assert_array_equal(aggs1[0]["w"], aggs1[1]["w"])
+        np.testing.assert_allclose(
+            aggs1[0]["w"], 0.5 * (p[0]["w"] + p[1]["w"]), rtol=1e-6
+        )
+        assert all(cl._base is not None for cl in clients)
+        # Round 2: both sparse.
+        locals2 = [
+            {"w": np.asarray(aggs1[c]["w"], np.float32) * np.float32(1.01)}
+            for c in range(2)
+        ]
+        aggs2 = _both_exchange(clients, locals2)
+        np.testing.assert_array_equal(aggs2[0]["w"], aggs2[1]["w"])
+        assert not np.allclose(aggs2[0]["w"], aggs1[0]["w"])
+        # Fresh client 1: no base -> its round-3 upload is dense while
+        # client 0's stays sparse.
+        clients[1] = FederatedClient(
+            "127.0.0.1", server.port, client_id=1, timeout=30,
+            compression="topk:0.2",
+        )
+        locals3 = [
+            {"w": np.asarray(aggs2[c]["w"], np.float32) * np.float32(1.01)}
+            for c in range(2)
+        ]
+        base2 = np.asarray(clients[0]._base["w"])
+        res2 = np.asarray(clients[0]._residual["w"]).copy()
+        aggs3 = _both_exchange(clients, locals3)
         t.join(timeout=30)
 
-    assert 0 in results and 1 in results
-    for r in range(3):
-        np.testing.assert_array_equal(results[0][r]["w"], results[1][r]["w"])
-    # Round 1 is the exact dense mean.
-    np.testing.assert_allclose(
-        results[0][0]["w"], 0.5 * (p[0]["w"] + p[1]["w"]), rtol=1e-6
-    )
-    # Sparse rounds moved the aggregate (deltas were nonzero).
-    assert not np.allclose(results[0][1]["w"], results[0][0]["w"])
+    np.testing.assert_array_equal(aggs3[0]["w"], aggs3[1]["w"])
+    # Mixed-round math: client 0's absolute = base + densify(topk(delta)),
+    # client 1's = its dense params; the aggregate is their mean.
+    delta0 = locals3[0]["w"] - base2 + res2
+    sent0 = wire.densify_topk(wire.sparsify_topk(delta0, 0.2), delta0.shape)
+    expected = 0.5 * ((base2 + sent0) + locals3[1]["w"])
+    np.testing.assert_allclose(aggs3[0]["w"], expected, rtol=1e-5)
 
 
 def test_server_restart_forces_dense_resend(rng):
@@ -260,6 +382,77 @@ def test_residual_carries_dropped_mass(rng):
     np.testing.assert_array_equal(
         sent2["w"], [0, 4, 0, 0, 0, 0, 0, 0, 0, 0]
     )
+
+
+def test_residual_survives_dense_fallback(rng):
+    """A round that goes dense (retry fallback, fresh base) must NOT
+    discard error-feedback mass accumulated over prior sparse rounds —
+    the residual holds drift from earlier local training that was dropped
+    by top-k and then discarded when the client adopted the aggregate."""
+    client = FederatedClient(
+        "127.0.0.1", 1, client_id=0, compression="topk:0.1"
+    )
+    client._base = {"w": np.zeros(10, np.float32)}
+    client._base_round = 0
+    carried = np.asarray([0, 4, 3, 2, 1, 0, 0, 0, 0, 0], np.float32)
+    client._residual = {"w": carried.copy()}
+    # A dense round completes (delta_flat/sent_flat are None).
+    agg = {"w": np.ones(10, np.float32)}
+    client._finish_topk(
+        agg,
+        {"agg_round": 1, "agg_crc": wire.flat_crc32(flatten_params(agg))},
+        None,
+        None,
+    )
+    np.testing.assert_array_equal(client._residual["w"], carried)
+    # The next sparse delta still carries it: local == base, so the
+    # intended delta is exactly the retained residual.
+    meta: dict = {}
+    _, _, delta, sent = client._prepare_topk_upload(
+        {"w": np.asarray(client._base["w"]).copy()}, 1, meta
+    )
+    assert meta["delta"] is True
+    np.testing.assert_array_equal(delta["w"], carried)
+    np.testing.assert_array_equal(
+        sent["w"], [0, 4, 0, 0, 0, 0, 0, 0, 0, 0]
+    )
+    # But a residual that no longer matches the architecture is dropped.
+    client._residual = {"stale": carried.copy()}
+    _, _, delta2, _ = client._prepare_topk_upload(
+        {"w": np.asarray(client._base["w"]).copy()}, 1, {}
+    )
+    np.testing.assert_array_equal(delta2["w"], np.zeros(10, np.float32))
+    assert client._residual is None
+
+
+def test_reply_omits_agg_crc_without_delta_clients(rng):
+    """agg_crc is a full fp32 pass over the model; a round with no
+    delta-capable client must not pay it (and plain clients don't need
+    it). A topk client's first — dense — upload advertises wants_delta,
+    which is covered by the e2e tests adopting a base."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        client as client_mod,
+        framing,
+    )
+
+    params = {"w": rng.normal(size=(8, 4)).astype(np.float32)}
+    with AggregationServer(port=0, num_clients=1, timeout=30) as server:
+        results = {}
+        t = _serve_rounds(server, 1, results)
+        sock = client_mod.connect_with_retry(
+            "127.0.0.1", server.port, timeout=30
+        )
+        try:
+            sock.settimeout(30)
+            framing.send_frame(
+                sock, encode(params, meta={"client_id": 0, "n_samples": 1})
+            )
+            _, meta = decode(framing.recv_frame(sock))
+        finally:
+            sock.close()
+        t.join(timeout=30)
+    assert "agg_crc" not in meta
+    assert meta["agg_round"] == 0
 
 
 def test_lossy_reply_compression_keeps_clients_dense(rng):
