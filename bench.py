@@ -20,6 +20,13 @@ Secondary modes via BENCH_MODE:
                       (server_terminal_output.txt:14-15)
     flash             long-context flash-attention grad step vs the XLA
                       dot path at L=8192 (BENCH_SEQ overrides)
+    ring              ring-schedule blockwise attention grad step (the
+                      per-chunk math of parallel/ring_attention.py, single
+                      chip, chunked K/V + online-softmax merge) vs the XLA
+                      dot path at L=8192 (BENCH_SEQ / BENCH_RING_CHUNKS)
+    fedseq            the 3-axis (clients x data x seq) fedseq train step
+                      on stacked client replicas, single chip — the
+                      --seq-parallel product path's measured MFU
 
 Prints exactly one JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
@@ -89,7 +96,8 @@ def bench_train(model_cfg: ModelConfig, name: str) -> None:
     # configuration exactly.
     batch_size = int(os.environ.get("BENCH_BATCH", "64"))
     steps = int(os.environ.get("BENCH_STEPS", "100"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "10"))
+    # >=1: warmup 0 would leave `loss` unbound and time the compile.
+    warmup = max(1, int(os.environ.get("BENCH_WARMUP", "10")))
 
     # TrainConfig defaults are the production path (incl. prng_impl="rbg"
     # dropout keys); BENCH_PRNG=threefry2x32 measures the costlier impl.
@@ -152,7 +160,7 @@ def bench_train(model_cfg: ModelConfig, name: str) -> None:
 def bench_eval() -> None:
     batch_size = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "100"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "10"))
+    warmup = max(1, int(os.environ.get("BENCH_WARMUP", "10")))
     model_cfg = ModelConfig()
     trainer = Trainer(model_cfg, TrainConfig())
     state = trainer.init_state(seed=0)
@@ -276,6 +284,140 @@ def bench_flash() -> None:
     )
 
 
+def bench_ring() -> None:
+    """Ring-attention per-chunk math on one chip: the ring schedule's
+    chunked K/V + online-softmax merge (parallel/ring_attention.py
+    ``blockwise_attention_local`` — numerically the n-device ring minus
+    the ppermute hops) fwd+bwd vs the XLA dot path at long L. This is the
+    --seq-parallel path's compute kernel; the transport it omits rides
+    ICI on real multi-chip."""
+    import jax.numpy as jnp
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.ops.attention import (
+        dot_product_attention,
+        make_attention_bias,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.parallel.ring_attention import (
+        blockwise_attention_local,
+    )
+
+    B, H, L, D = 1, 12, int(os.environ.get("BENCH_SEQ", "8192")), 64
+    n_chunks = int(os.environ.get("BENCH_RING_CHUNKS", "8"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jax.device_put(rng.normal(size=(B, H, L, D)).astype(np.float32)).astype(
+            jnp.bfloat16
+        )
+        for _ in range(3)
+    )
+    bias = make_attention_bias(jax.device_put(np.ones((B, L), np.int32)))
+
+    def time_grad(fn):
+        g = jax.jit(
+            jax.grad(lambda qkv: fn(*qkv, bias).astype(jnp.float32).sum())
+        )
+        out = g((q, k, v))
+        _sync(out)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = g((q, k, v))
+        _sync(out)
+        return (time.perf_counter() - t0) / steps
+
+    ring_s = time_grad(
+        lambda q, k, v, b: blockwise_attention_local(
+            q, k, v, b, n_chunks=n_chunks
+        )
+    )
+    dot_s = time_grad(dot_product_attention)
+    _emit(
+        {
+            "metric": f"ring_attn_grad_ms_L{L}_c{n_chunks}",
+            "value": round(ring_s * 1e3, 2),
+            "unit": "ms",
+            # Higher is better: the XLA dot path's time over the ring math's.
+            "vs_baseline": round(dot_s / ring_s, 2),
+            "baseline_note": f"vs XLA dot-attention grad {dot_s * 1e3:.1f} ms",
+            "device": jax.devices()[0].device_kind,
+        }
+    )
+
+
+def bench_fedseq() -> None:
+    """The --seq-parallel product path on one chip: FedSeqTrainer's 3-axis
+    (clients x data x seq) jitted train step over stacked client replicas
+    (mesh 1x1x1, C=2 replicas on the chip, ring path with a degenerate
+    1-hop ring — the same program the driver's dryrun_multichip(8) runs
+    sharded). Reports samples/sec across all clients plus MFU."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        ExperimentConfig,
+        FedConfig,
+        MeshConfig,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.seqfed import (
+        FedSeqTrainer,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.utils.profiling import (
+        device_peak_flops,
+        mfu,
+        train_step_flops,
+    )
+
+    n_clients = int(os.environ.get("BENCH_CLIENTS", "2"))
+    batch_size = int(os.environ.get("BENCH_BATCH", "64"))  # per client
+    steps = int(os.environ.get("BENCH_STEPS", "50"))
+    # >=1: warmup 0 would leave `losses` unbound and time the compile.
+    warmup = max(1, int(os.environ.get("BENCH_WARMUP", "5")))
+    cfg = ExperimentConfig(
+        fed=FedConfig(num_clients=n_clients),
+        mesh=MeshConfig(clients=1, data=1, seq=1),
+    )
+    trainer = FedSeqTrainer(cfg)
+    state = trainer.init_state(seed=0)
+    rng = np.random.default_rng(0)
+    L = cfg.model.max_len
+    batch = trainer._feed(
+        {
+            "input_ids": rng.integers(
+                0, cfg.model.vocab_size, (n_clients, batch_size, L)
+            ).astype(np.int32),
+            "attention_mask": np.ones((n_clients, batch_size, L), np.int32),
+            "labels": rng.integers(0, 2, (n_clients, batch_size)).astype(
+                np.int32
+            ),
+        }
+    )
+    for _ in range(warmup):
+        state, losses = trainer.train_step(state, batch)
+    _sync(losses)
+    repeats = max(1, int(os.environ.get("BENCH_REPEATS", "3")))
+    dt = None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, losses = trainer.train_step(state, batch)
+        _sync(losses)
+        window = time.perf_counter() - t0
+        dt = window if dt is None else min(dt, window)
+
+    total = n_clients * batch_size
+    sps = total * steps / dt
+    flops = train_step_flops(cfg.model, total)
+    util = mfu(flops, dt / steps, peak_flops_per_device=device_peak_flops())
+    record = {
+        "metric": f"fedseq_samples_per_sec_c{n_clients}_bs{batch_size}",
+        "value": round(sps, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(sps / REFERENCE_TRAIN_SAMPLES_PER_SEC, 2),
+        "device": jax.devices()[0].device_kind,
+        "tflops_per_sec": round(flops * steps / dt / 1e12, 2),
+    }
+    if util is not None:
+        record["mfu"] = round(util, 4)
+    _emit(record)
+
+
 def _watchdog(seconds: int, record: dict) -> threading.Timer:
     """Hard deadline that fires even while the main thread is blocked inside
     an XLA C++ call (the tunnel's observed stall mode) — a SIGALRM handler
@@ -363,7 +505,7 @@ def _preflight() -> None:
         guard.cancel()
 
 
-MODES = ("train", "bert", "bertlarge", "eval", "fedavg", "flash")
+MODES = ("train", "bert", "bertlarge", "eval", "fedavg", "flash", "ring", "fedseq")
 
 
 def main() -> None:
@@ -399,6 +541,10 @@ def main() -> None:
             bench_fedavg()
         elif mode == "flash":
             bench_flash()
+        elif mode == "ring":
+            bench_ring()
+        elif mode == "fedseq":
+            bench_fedseq()
     finally:
         if guard is not None:
             guard.cancel()

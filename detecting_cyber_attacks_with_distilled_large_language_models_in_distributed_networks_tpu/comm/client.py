@@ -117,6 +117,7 @@ class FederatedClient:
         # and sparse mode resumes without a client restart.
         self._gave_up_delta = False
         self._dense_rounds_since_giveup = 0
+        self._probe_this_round = False
         if secure_agg and auth_key is None:
             log.warning(
                 f"[CLIENT {client_id}] --secure-agg without an auth key "
@@ -377,9 +378,17 @@ class FederatedClient:
             # again every PROBE_EVERY rounds so a server that became
             # lossless is rediscovered.
             if self._gave_up_delta:
-                probe = self._dense_rounds_since_giveup % self.PROBE_EVERY == 0
-                self._dense_rounds_since_giveup += 1
-                attempt_meta.update(delta=False, wants_delta=probe)
+                # Counted once per ROUND (attempt 1), not per retry: a
+                # transient failure must neither consume a probe before
+                # the server saw it nor skew the PROBE_EVERY cadence.
+                if attempt == 1:
+                    self._probe_this_round = (
+                        self._dense_rounds_since_giveup % self.PROBE_EVERY == 0
+                    )
+                    self._dense_rounds_since_giveup += 1
+                attempt_meta.update(
+                    delta=False, wants_delta=self._probe_this_round
+                )
             else:
                 attempt_meta.update(delta=False, wants_delta=True)
             return params, "none", None, None

@@ -31,11 +31,19 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _partial_attention(q_scaled, k, v, bias, drop=None):
+def _partial_attention(q, k, v, bias, scale, drop=None):
     """Unnormalized flash statistics of local queries vs one K/V chunk.
 
     Returns ``(pv, m, l)``: exp-weighted values, row max, row denominator —
     enough to merge chunks with the online-softmax recurrence.
+
+    Numerics contract: matmul INPUTS stay in the activation dtype (bf16 on
+    TPU — both einsums feed the MXU half-width operands) with fp32
+    accumulation via ``preferred_element_type``; scaling, softmax
+    statistics and the merge recurrence run fp32. Same contract as the dot
+    path (ops/attention.py) and the flash kernels
+    (ops/flash_attention.py) — under fp32 activations (CPU tests) it
+    degenerates to full fp32, so dot-path parity stays exact.
 
     ``drop = (seed, rate, b_off, q_off, k_off)`` applies attention dropout
     with a GLOBAL-coordinate hash mask (ops/hash_dropout.py) — batch rows,
@@ -45,9 +53,8 @@ def _partial_attention(q_scaled, k, v, bias, drop=None):
     drop-after-softmax semantics (ops/attention.py:56-61) expressed in the
     online recurrence."""
     s = jnp.einsum(
-        "bhqd,bhkd->bhqk", q_scaled, k.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    )
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
     m = s.max(axis=-1)  # [B,H,Lq]
@@ -62,10 +69,23 @@ def _partial_attention(q_scaled, k, v, bias, drop=None):
         )
         p = p * keep * (1.0 / (1.0 - rate))
     pv = jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        "bhqk,bhkd->bhqd", p.astype(q.dtype), v,
         preferred_element_type=jnp.float32,
     )
     return pv, m, l
+
+
+def _merge_partial(acc, m, l, pv_i, m_i, l_i):
+    """Online-softmax merge of one chunk's partial statistics into the
+    running ``(acc, m, l)`` — shared by the sharded ring and the
+    single-device blockwise variant so their numerics stay structurally
+    identical."""
+    m_new = jnp.maximum(m, m_i)
+    alpha = jnp.exp(m - m_new)
+    alpha_i = jnp.exp(m_i - m_new)
+    acc = acc * alpha[..., None] + pv_i * alpha_i[..., None]
+    l = l * alpha + l_i * alpha_i
+    return acc, m_new, l
 
 
 def ring_attention(
@@ -102,7 +122,6 @@ def ring_attention(
         )
     n = jax.lax.psum(1, axis_name)
     scale = 1.0 / (q.shape[-1] ** 0.5)
-    q_scaled = q.astype(jnp.float32) * scale
     perm = [(i, (i + 1) % n) for i in range(n)]
     has_bias = bias is not None
     rate = float(dropout_rate) if not deterministic else 0.0
@@ -122,14 +141,9 @@ def ring_attention(
             else (seed, rate, batch_offset, q_off, k_off)
         )
         pv_i, m_i, l_i = _partial_attention(
-            q_scaled, k_c, v_c, b_c if has_bias else None, drop
+            q, k_c, v_c, b_c if has_bias else None, scale, drop
         )
-        m_new = jnp.maximum(m, m_i)
-        alpha = jnp.exp(m - m_new)
-        alpha_i = jnp.exp(m_i - m_new)
-        acc = acc * alpha[..., None] + pv_i * alpha_i[..., None]
-        l = l * alpha + l_i * alpha_i
-        return acc, m_new, l
+        return _merge_partial(acc, m, l, pv_i, m_i, l_i)
 
     def rotate(x):
         return jax.tree.map(lambda t: jax.lax.ppermute(t, axis_name, perm), x)
@@ -174,6 +188,52 @@ def ring_attention(
     acc, m, l = merge(acc, m, l, k_f, v_f, b_f, k_off_f)
     # -1e9 mask addends keep l > 0 even for fully masked rows (parity with
     # the dot/flash paths).
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def blockwise_attention_local(
+    q: jnp.ndarray,  # [B, H, L, D] — full arrays, ONE device
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: jnp.ndarray | None = None,  # [B, 1, 1, L] key-position mask
+    *,
+    n_chunks: int = 8,
+) -> jnp.ndarray:
+    """The ring schedule's compute on one device: K/V split into
+    ``n_chunks`` chunks merged with the same ``_partial_attention`` +
+    online-softmax recurrence, ppermute hops removed. Numerically it is
+    ``ring_attention`` on an ``n_chunks``-device mesh (the recurrence and
+    chunk order are identical; only the transport differs), so it serves
+    as (a) the single-chip benchmark proxy for the ring path's per-chunk
+    math (BENCH_MODE=ring) and (b) a parity anchor against the dot path.
+    Deterministic only — the dropout story lives in the sharded path."""
+    b_sz, h, lq, d = q.shape
+    lk = k.shape[2]
+    if lk % n_chunks:
+        raise ValueError(f"L={lk} must divide into n_chunks={n_chunks}")
+    ck = lk // n_chunks
+    scale = 1.0 / (d**0.5)
+    # [n, B, H, ck, D] chunk-major stacks feed the scan.
+    kc = jnp.moveaxis(k.reshape(b_sz, h, n_chunks, ck, d), 2, 0)
+    vc = jnp.moveaxis(v.reshape(b_sz, h, n_chunks, ck, d), 2, 0)
+    if bias is not None:
+        bc = jnp.moveaxis(bias.reshape(b_sz, 1, 1, n_chunks, ck), 3, 0)
+        xs = (kc, vc, bc)
+    else:
+        xs = (kc, vc)
+
+    acc0 = jnp.zeros((b_sz, h, lq, d), jnp.float32)
+    m0 = jnp.full((b_sz, h, lq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b_sz, h, lq), jnp.float32)
+
+    def step(carry, chunk):
+        acc, m, l = carry
+        k_c, v_c = chunk[0], chunk[1]
+        b_c = chunk[2] if bias is not None else None
+        pv_i, m_i, l_i = _partial_attention(q, k_c, v_c, b_c, scale)
+        return _merge_partial(acc, m, l, pv_i, m_i, l_i), None
+
+    (acc, m, l), _ = jax.lax.scan(step, (acc0, m0, l0), xs)
     return (acc / l[..., None]).astype(q.dtype)
 
 

@@ -104,6 +104,33 @@ def test_ring_matches_dot(rng, eight_devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_blockwise_local_matches_dot_and_ring(rng, eight_devices):
+    """blockwise_attention_local (the BENCH_MODE=ring kernel: ring
+    schedule minus transport) matches the dot path and the real sharded
+    ring bit-for-bit-close on the same inputs."""
+    from jax.sharding import Mesh
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.parallel.ring_attention import (
+        blockwise_attention_local,
+    )
+
+    q, k, v = _qkv(rng, b=1, h=2, l=32, d=8)
+    bias = _mask_bias(rng, b=1, l=32)
+    ref = dot_product_attention(q, k, v, bias)
+    out = blockwise_attention_local(q, k, v, bias, n_chunks=4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    mesh = Mesh(np.array(eight_devices[:4]), ("seq",))
+    ring = ring_attention_sharded(q, k, v, bias, mesh=mesh, axis_name="seq")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ring), atol=2e-6)
+    # No-bias path too.
+    out_nb = blockwise_attention_local(q, k, v, n_chunks=8)
+    np.testing.assert_allclose(
+        np.asarray(out_nb),
+        np.asarray(dot_product_attention(q, k, v, None)),
+        atol=2e-5,
+    )
+
+
 def test_ring_no_bias_matches_dot(rng, eight_devices):
     from jax.sharding import Mesh
 
