@@ -71,13 +71,6 @@ def cmd_federated(args) -> int:
             "place the seq ring across DCN); shard clients over hosts with "
             "the 2-axis path instead"
         )
-    if cfg.fed.personalize_epochs > 0 and cfg.mesh.seq > 1:
-        # Also knowable up front — do not let a multi-round run train to
-        # completion and die at the personalization phase.
-        raise SystemExit(
-            "--personalize-epochs is not supported with --seq-parallel "
-            "yet; drop one of the two flags"
-        )
     if jax.process_count() > 1:
         from ..parallel.multihost import local_client_slice, make_global_mesh
 

@@ -48,7 +48,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..train.engine import apply_warmup
+from ..train.engine import apply_warmup, prox_sq
 from .fedavg import stack_params
 
 
@@ -77,15 +77,27 @@ def make_fedseq_loss(
     data_axis: str = "data",
     seq_axis: str = "seq",
     dropout: bool = False,
+    prox_mu: float = 0.0,
 ) -> Callable:
     """``(stacked_params, ids [C,B,L], mask [C,B,L], labels [C,B][, rngs
     [C]]) -> [C]`` per-client mean losses, computed sequence- and
     batch-parallel. The model must be built with ``attention_impl="ring"``
     and ``ring_axis=seq_axis``. With ``dropout=True`` the call takes
     per-client keys (sharded over ``clients``) and runs the model
-    stochastic — masks are seq-shard-invariant (module docstring)."""
+    stochastic — masks are seq-shard-invariant (module docstring).
 
-    def local_losses(params_l, ids_l, mask_l, labels_l, *rngs_l):
+    With ``prox_mu > 0`` (FedProx) the call takes a stacked ``anchor``
+    (the round-start params, sharded over ``clients``) right after the
+    params and returns ``(objective [C], task [C])``: gradients flow from
+    the objective (task + mu/2 ||p - anchor||^2, the dense path's exact
+    term), logs report the task loss so FedProx and FedAvg curves stay
+    comparable."""
+
+    def local_losses(params_l, *rest):
+        if prox_mu > 0.0:
+            anchor_l, rest = rest[0], rest[1:]
+        ids_l, mask_l, labels_l, *rngs_l = rest
+
         def one(p, ids, mask, labels, *key):
             if dropout:
                 logits = model.apply(
@@ -100,11 +112,19 @@ def make_fedseq_loss(
 
         losses = jax.vmap(one)(params_l, ids_l, mask_l, labels_l, *rngs_l)
         # Merge batch shards: each data instance saw B/data rows.
-        return jax.lax.pmean(losses, data_axis)
+        task = jax.lax.pmean(losses, data_axis)
+        if prox_mu == 0.0:
+            return task
+        # Params (and the anchor) are replicated over data/seq, so the
+        # prox term needs no collective.
+        sq = jax.vmap(prox_sq)(params_l, anchor_l)
+        return task + 0.5 * prox_mu * sq, task
 
     batch_spec = P(clients_axis, data_axis, seq_axis)
-    in_specs = [
-        P(clients_axis),
+    in_specs = [P(clients_axis)]
+    if prox_mu > 0.0:
+        in_specs.append(P(clients_axis))
+    in_specs += [
         batch_spec,
         batch_spec,
         P(clients_axis, data_axis),
@@ -115,7 +135,11 @@ def make_fedseq_loss(
         local_losses,
         mesh=mesh,
         in_specs=tuple(in_specs),
-        out_specs=P(clients_axis),
+        out_specs=(
+            P(clients_axis)
+            if prox_mu == 0.0
+            else (P(clients_axis), P(clients_axis))
+        ),
     )
 
 
@@ -127,15 +151,23 @@ def make_fedseq_masked_loss(
     data_axis: str = "data",
     seq_axis: str = "seq",
     dropout: bool = False,
+    prox_mu: float = 0.0,
 ) -> Callable:
     """Ragged-stack variant: ``(stacked_params, ids, mask, labels, valid
     [C,B][, rngs [C]]) -> ([C] masked mean losses, [C] 0/1 had-rows)``.
     The per-client loss averages over the batch's valid rows only (global
     across data shards — per-shard sums psum'd before the divide), so a
     padded lockstep batch contributes loss 0 / has 0 exactly like the
-    dense ragged path (train/fedsteps.py per_client_step_masked)."""
+    dense ragged path (train/fedsteps.py per_client_step_masked).
 
-    def local_losses(params_l, ids_l, mask_l, labels_l, valid_l, *rngs_l):
+    With ``prox_mu > 0`` a stacked ``anchor`` follows the params and the
+    return is ``(objective [C], task [C], has [C])`` — see
+    :func:`make_fedseq_loss`."""
+
+    def local_losses(params_l, *rest):
+        if prox_mu > 0.0:
+            anchor_l, rest = rest[0], rest[1:]
+        ids_l, mask_l, labels_l, valid_l, *rngs_l = rest
         def one(p, ids, mask, labels, valid, *key):
             if dropout:
                 logits = model.apply(
@@ -157,11 +189,18 @@ def make_fedseq_masked_loss(
         s_cnt = jax.lax.psum(s_cnt, data_axis)
         losses = s_loss / jnp.maximum(s_cnt, 1.0)
         has = (s_cnt > 0).astype(jnp.float32)
-        return losses, has
+        if prox_mu == 0.0:
+            return losses, has
+        sq = jax.vmap(prox_sq)(params_l, anchor_l)
+        # A no-row client's objective still carries the prox term, like
+        # the dense masked step; its update is gated away on `has` anyway.
+        return losses + 0.5 * prox_mu * sq, losses, has
 
     batch_spec = P(clients_axis, data_axis, seq_axis)
-    in_specs = [
-        P(clients_axis),
+    in_specs = [P(clients_axis)]
+    if prox_mu > 0.0:
+        in_specs.append(P(clients_axis))
+    in_specs += [
         batch_spec,
         batch_spec,
         P(clients_axis, data_axis),
@@ -173,7 +212,9 @@ def make_fedseq_masked_loss(
         local_losses,
         mesh=mesh,
         in_specs=tuple(in_specs),
-        out_specs=(P(clients_axis), P(clients_axis)),
+        out_specs=(
+            (P(clients_axis),) * (2 if prox_mu == 0.0 else 3)
+        ),
     )
 
 
@@ -267,22 +308,17 @@ def build_fedseq_steps(cfg, model, optimizer, mesh: Mesh) -> FedSeqSteps:
         or float(mcfg.attention_dropout) > 0.0
     )
     wsteps = cfg.train.warmup_steps
+    mu = float(cfg.fed.prox_mu)
     csh = NamedSharding(mesh, P("clients"))
     repl = NamedSharding(mesh, P())
     seq_sh = NamedSharding(mesh, P("clients", "data", "seq"))
     row_sh = NamedSharding(mesh, P("clients", "data"))
     state_sh = FedState(csh, csh, repl, csh, repl)
 
-    loss = make_fedseq_loss(model, mesh, dropout=dropout)
+    loss = make_fedseq_loss(model, mesh, dropout=dropout, prox_mu=mu)
     batch_sh = {"input_ids": seq_sh, "attention_mask": seq_sh, "labels": row_sh}
 
-    @partial(
-        jax.jit,
-        donate_argnums=(0,),
-        in_shardings=(state_sh, batch_sh),
-        out_shardings=(state_sh, csh),
-    )
-    def train_step(state: FedState, batch):
+    def _train_body(state: FedState, batch, anchor):
         keys = (
             (jax.vmap(jax.random.fold_in, in_axes=(0, None))(
                 state.rngs, state.step
@@ -292,13 +328,17 @@ def build_fedseq_steps(cfg, model, optimizer, mesh: Mesh) -> FedSeqSteps:
         )
 
         def total(p):
-            losses = loss(
-                p, batch["input_ids"], batch["attention_mask"],
+            args = (p,) if mu == 0.0 else (p, anchor)
+            out = loss(
+                *args, batch["input_ids"], batch["attention_mask"],
                 batch["labels"], *keys,
             )
             # Clients are independent: d(sum)/d(params[c]) touches only
             # client c's row — one grad call yields every per-client grad.
-            return losses.sum(), losses
+            # Under FedProx the objective carries the prox term; the task
+            # loss is what gets reported (dense-path parity).
+            obj, task = out if mu > 0.0 else (out, out)
+            return obj.sum(), task
 
         (_, losses), grads = jax.value_and_grad(total, has_aux=True)(
             state.params
@@ -315,17 +355,30 @@ def build_fedseq_steps(cfg, model, optimizer, mesh: Mesh) -> FedSeqSteps:
             losses,
         )
 
-    ragged_batch_sh = dict(batch_sh, valid=row_sh, warmup_step=row_sh)
-    masked_loss = make_fedseq_masked_loss(model, mesh, dropout=dropout)
-
-    def build_ragged_step():
-        @partial(
+    if mu > 0.0:
+        # FedProx signature: (state, batch, anchor) — the same contract
+        # FederatedTrainer.fit_local drives on the dense path.
+        train_step = partial(
             jax.jit,
             donate_argnums=(0,),
-            in_shardings=(state_sh, ragged_batch_sh),
-            out_shardings=(state_sh, (csh, csh)),
-        )
-        def ragged_step(state: FedState, batch):
+            in_shardings=(state_sh, batch_sh, csh),
+            out_shardings=(state_sh, csh),
+        )(_train_body)
+    else:
+        train_step = partial(
+            jax.jit,
+            donate_argnums=(0,),
+            in_shardings=(state_sh, batch_sh),
+            out_shardings=(state_sh, csh),
+        )(lambda state, batch: _train_body(state, batch, None))
+
+    ragged_batch_sh = dict(batch_sh, valid=row_sh, warmup_step=row_sh)
+    masked_loss = make_fedseq_masked_loss(
+        model, mesh, dropout=dropout, prox_mu=mu
+    )
+
+    def build_ragged_step():
+        def ragged_body(state: FedState, batch, anchor):
             keys = (
                 (jax.vmap(jax.random.fold_in, in_axes=(0, None))(
                     state.rngs, state.step
@@ -335,11 +388,13 @@ def build_fedseq_steps(cfg, model, optimizer, mesh: Mesh) -> FedSeqSteps:
             )
 
             def total(p):
-                losses, has = masked_loss(
-                    p, batch["input_ids"], batch["attention_mask"],
+                args = (p,) if mu == 0.0 else (p, anchor)
+                out = masked_loss(
+                    *args, batch["input_ids"], batch["attention_mask"],
                     batch["labels"], batch["valid"], *keys,
                 )
-                return losses.sum(), (losses, has)
+                obj, losses, has = out if mu > 0.0 else (out[0], *out)
+                return obj.sum(), (losses, has)
 
             (_, (losses, has)), grads = jax.value_and_grad(
                 total, has_aux=True
@@ -366,7 +421,19 @@ def build_fedseq_steps(cfg, model, optimizer, mesh: Mesh) -> FedSeqSteps:
                 (losses, has),
             )
 
-        return ragged_step
+        if mu > 0.0:
+            return partial(
+                jax.jit,
+                donate_argnums=(0,),
+                in_shardings=(state_sh, ragged_batch_sh, csh),
+                out_shardings=(state_sh, (csh, csh)),
+            )(ragged_body)
+        return partial(
+            jax.jit,
+            donate_argnums=(0,),
+            in_shardings=(state_sh, ragged_batch_sh),
+            out_shardings=(state_sh, (csh, csh)),
+        )(lambda state, batch: ragged_body(state, batch, None))
 
     def local_eval(params_l, ids_l, mask_l, labels_l, valid_l):
         def one(p, ids, mask, labels, valid):

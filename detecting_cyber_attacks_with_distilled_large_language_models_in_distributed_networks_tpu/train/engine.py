@@ -60,6 +60,17 @@ def apply_warmup(updates: Any, step: jnp.ndarray, warmup_steps: int) -> Any:
     return jax.tree.map(lambda u: u * w, updates)
 
 
+def prox_sq(params: Any, anchor: Any) -> jnp.ndarray:
+    """FedProx squared distance ``sum ||p - anchor||^2`` over a param
+    pytree — the proximal term's single shared implementation for the
+    dense (train/fedsteps.py) and sequence-parallel (parallel/fedseq.py)
+    federated steps, so their trajectories can't silently diverge."""
+    return sum(
+        jnp.sum(jnp.square(a - b))
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(anchor))
+    )
+
+
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     """Adam(lr=2e-5) as the reference (client1.py:380); optional grad clip
     and decoupled weight decay the reference lacks. LR warmup is applied by

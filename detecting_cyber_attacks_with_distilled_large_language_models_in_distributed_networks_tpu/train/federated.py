@@ -253,10 +253,12 @@ class FederatedTrainer:
             raise ValueError(f"personalize scope {scope!r} must be full|head")
         # Build a scope-matched trainer in EITHER direction: head scope on
         # an all-params config, or full scope on a linear-probing
-        # (trainable='head') base config.
+        # (trainable='head') base config. type(self) keeps the subclass'
+        # step builders — a FedSeqTrainer personalizes with the same
+        # 3-axis sequence-parallel programs it trained with.
         want_trainable = "head" if scope == "head" else "all"
         if self.cfg.train.trainable != want_trainable:
-            ptrainer = FederatedTrainer(
+            ptrainer = type(self)(
                 dc_replace(
                     self.cfg,
                     train=dc_replace(self.cfg.train, trainable=want_trainable),

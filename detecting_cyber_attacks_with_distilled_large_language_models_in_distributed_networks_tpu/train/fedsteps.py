@@ -28,6 +28,7 @@ from ..train.engine import (
     eval_counts,
     loss_fn,
     masked_loss_fn,
+    prox_sq,
 )
 from ..utils.logging import get_logger
 
@@ -80,11 +81,7 @@ def build_federated_steps(cfg, model, optimizer, sh) -> FedSteps:
         if mu > 0.0:
             # FedProx proximal term vs the round-start globals —
             # trace-time constant, zero cost at mu=0 (plain FedAvg).
-            sq = sum(
-                jnp.sum(jnp.square(a - b))
-                for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(anchor))
-            )
-            total = task + 0.5 * mu * sq
+            total = task + 0.5 * mu * prox_sq(p, anchor)
         return total, task
 
     def per_client_step(params, opt_state, batch, rng, anchor, step):
@@ -143,13 +140,7 @@ def build_federated_steps(cfg, model, optimizer, sh) -> FedSteps:
             task = masked_loss_fn(model, p, batch, rng)
             total = task
             if mu > 0.0:
-                sq = sum(
-                    jnp.sum(jnp.square(a - b))
-                    for a, b in zip(
-                        jax.tree.leaves(p), jax.tree.leaves(anchor)
-                    )
-                )
-                total = task + 0.5 * mu * sq
+                total = task + 0.5 * mu * prox_sq(p, anchor)
             return total, task
 
         (_, task), grads = jax.value_and_grad(obj, has_aux=True)(params)
