@@ -258,7 +258,10 @@ class FederatedClient:
                         )
                     framing.send_frame(sock, hello)
                     keys_frame = framing.recv_frame(sock)
-                    pair_secrets = self._parse_keys_frame(
+                    # The keys frame defines the round's participant set —
+                    # the full fleet, or the quorum subset that survived
+                    # the server's key grace window (dropout recovery).
+                    participants, pair_secrets = self._parse_keys_frame(
                         keys_frame, priv, session, round_no
                     )
                     upload = secure.masked_upload(
@@ -266,7 +269,7 @@ class FederatedClient:
                         pair_secrets=pair_secrets,
                         round_index=round_no,
                         client_id=self.client_id,
-                        participants=range(self.num_clients),
+                        participants=participants,
                         fp_bits=self.fp_bits,
                         session=session,
                     )
@@ -275,7 +278,7 @@ class FederatedClient:
                         secure=True,
                         fp_bits=self.fp_bits,
                         round=round_no,
-                        participants=self.num_clients,
+                        participants=len(participants),
                     )
                 attempt_compression = self.compression
                 delta_flat = sent_flat = None
@@ -304,6 +307,38 @@ class FederatedClient:
                 sparse_in_flight = delta_flat is not None
                 framing.send_frame(sock, msg)
                 reply = framing.recv_frame(sock)
+                if self.secure_agg and bytes(reply[:4]) == secure.REVEAL_MAGIC:
+                    # Dropout reveal round: some keyed participant never
+                    # uploaded; disclose our pair secrets with the dead so
+                    # the server can cancel their mask halves (privacy
+                    # analysis in comm/secure.py — a revealed secret only
+                    # unlocks THIS round's streams for pairs whose other
+                    # end contributed nothing).
+                    dead = secure.parse_reveal_request(
+                        bytes(reply),
+                        session=session,
+                        round_index=round_no,
+                        auth_key=self.auth_key,
+                    )
+                    bad = [
+                        d for d in dead
+                        if d == self.client_id or d not in pair_secrets
+                    ]
+                    if bad:
+                        raise secure.SecureAggError(
+                            f"reveal request names invalid partners {bad}"
+                        )
+                    framing.send_frame(
+                        sock,
+                        secure.build_reveal_response(
+                            {d: pair_secrets[d] for d in dead},
+                            session=session,
+                            round_index=round_no,
+                            client_id=self.client_id,
+                            auth_key=self.auth_key,
+                        ),
+                    )
+                    reply = framing.recv_frame(sock)
                 agg, agg_meta = wire.decode(reply, auth_key=self.auth_key)
                 if self.auth_key is not None and (
                     agg_meta.get("role") != "server"
@@ -478,10 +513,14 @@ class FederatedClient:
 
     def _parse_keys_frame(
         self, frame: bytes, priv: int, session: bytes, round_no: int
-    ) -> dict[int, bytes]:
-        """KEYS frame -> {partner id: DH pair secret}. Validates the magic,
-        the exact participant set, every public value, and (in auth mode)
-        each key's HMAC binding to (session, round, owner id)."""
+    ) -> tuple[list[int], dict[int, bytes]]:
+        """KEYS frame -> (sorted participant ids, {partner id: DH pair
+        secret}). Validates the magic, every public value, and (in auth
+        mode) each key's HMAC binding to (session, round, owner id). The
+        set may be a quorum SUBSET of the fleet (the server closes the key
+        set after its grace window when clients die before the exchange);
+        it must contain this client, at least one partner, and only known
+        ids — masking over it is then exactly as safe as the full fleet."""
         import struct as _struct
 
         entry = 8 + secure.DH_PUB_LEN + (
@@ -495,6 +534,8 @@ class FederatedClient:
         seen: dict[int, bytes] = {}
         for off in range(n_magic, len(frame), entry):
             cid = _struct.unpack("<q", frame[off : off + 8])[0]
+            if cid in seen:
+                raise wire.WireError(f"duplicate client {cid} in keys frame")
             pub = frame[off + 8 : off + 8 + secure.DH_PUB_LEN]
             if self.auth_key is not None:
                 secure.verify_pubkey_tag(
@@ -502,12 +543,18 @@ class FederatedClient:
                     frame[off + 8 + secure.DH_PUB_LEN : off + entry],
                 )
             seen[cid] = pub
-        if sorted(seen) != list(range(self.num_clients)):
+        participants = sorted(seen)
+        if not all(0 <= c < self.num_clients for c in participants):
             raise wire.WireError(
-                f"DH keys frame covers clients {sorted(seen)}, expected "
-                f"exactly 0..{self.num_clients - 1}"
+                f"DH keys frame covers unknown clients {participants} "
+                f"(fleet is 0..{self.num_clients - 1})"
             )
-        return {
+        if self.client_id not in seen or len(seen) < 2:
+            raise wire.WireError(
+                f"DH keys frame covers {participants}: it must include "
+                f"this client ({self.client_id}) and at least one partner"
+            )
+        return participants, {
             cid: secure.dh_pair_secret(priv, pub)
             for cid, pub in seen.items()
             if cid != self.client_id

@@ -47,9 +47,31 @@ limits of the guarantee:
   integrity at all: an active on-path attacker can MITM the relay and
   unmask every upload. No-auth secure-agg protects against passive
   observers only; the client logs a warning.
-* Client dropout recovery: none — every advertised participant must
-  upload; the server enforces ``participants == all clients`` and fails
-  the round otherwise (the reference-style failed-round path).
+* Client dropout recovery: the REVEAL-ROUND variant of Bonawitz §6 (no
+  Shamir/self-mask double-masking). Two recovery layers compose with the
+  server's ``min_clients``/deadline machinery:
+
+  - dropout BEFORE key distribution: the key set finalizes as the quorum
+    of clients whose DH hellos arrived within the key grace window; the
+    keys frame advertises that subset and everyone masks over it.
+  - dropout AFTER key distribution but before upload: survivors are asked
+    (``REVEAL_REQ`` frame) to disclose the per-pair DH secrets they share
+    with the dead; the server regenerates those pairs' mask streams and
+    subtracts the uncancelled halves from the ring sum
+    (:func:`residual_mask_sum`), then de-quantizes over the survivors.
+
+  Privacy cost of a reveal: per-round DH keypairs mean a revealed pair
+  secret unlocks ONLY that round's (survivor, dead) mask stream — and the
+  dead client contributed nothing to the sum, so nothing of a
+  participant's data is exposed. The known limit of skipping
+  double-masking: a MALICIOUS server that receives client j's upload yet
+  falsely declares j dead can collect j's pair secrets from the others
+  and unmask j's single upload. That is active misbehavior — outside the
+  honest-but-curious model above, where the server follows the protocol
+  — and removing it requires the full Bonawitz double-mask (each client
+  self-masks and Shamir-shares the self-mask seed). A dropout DURING the
+  reveal phase itself fails the round (survivors' secrets for the
+  newly-dead are unrecoverable without Shamir shares).
 """
 
 from __future__ import annotations
@@ -274,6 +296,161 @@ def masked_upload(
         participants=participants,
         session=session,
     )
+
+
+# ------------------------------------------------- dropout reveal round
+#: Server -> survivor: "these keyed participants never uploaded; disclose
+#: your pair secrets with them". REVEAL_MAGIC + u32 n + n x i64 dead ids
+#: [+ HMAC tag]. The survivor answers REVEAL_RESP_MAGIC + n x (i64 id +
+#: 32-byte pair secret) [+ tag].
+REVEAL_MAGIC = b"RVLQ"
+REVEAL_RESP_MAGIC = b"RVLA"
+PAIR_SECRET_LEN = 32
+_TAG_LEN = 32
+
+
+def _reveal_tag(auth_key: bytes, kind: bytes, session: bytes,
+                round_index: int, body: bytes) -> bytes:
+    import hmac
+
+    return hmac.new(
+        auth_key,
+        _DOMAIN + kind + session + struct.pack("<Q", round_index) + body,
+        hashlib.sha256,
+    ).digest()
+
+
+def build_reveal_request(
+    dead: Sequence[int], *, session: bytes, round_index: int,
+    auth_key: bytes | None = None,
+) -> bytes:
+    ids = sorted(set(int(d) for d in dead))
+    body = struct.pack("<I", len(ids)) + b"".join(
+        struct.pack("<q", d) for d in ids
+    )
+    msg = REVEAL_MAGIC + body
+    if auth_key is not None:
+        msg += _reveal_tag(auth_key, b"-rq", session, round_index, body)
+    return msg
+
+
+def parse_reveal_request(
+    frame: bytes, *, session: bytes, round_index: int,
+    auth_key: bytes | None = None,
+) -> list[int]:
+    """Validate + parse a reveal request; raises :class:`SecureAggError`
+    on malformed frames or (in auth mode) a bad tag."""
+    import hmac
+
+    if not frame.startswith(REVEAL_MAGIC):
+        raise SecureAggError("not a reveal request")
+    body_end = len(frame) - (_TAG_LEN if auth_key is not None else 0)
+    body = frame[len(REVEAL_MAGIC) : body_end]
+    if auth_key is not None and not hmac.compare_digest(
+        frame[body_end:],
+        _reveal_tag(auth_key, b"-rq", session, round_index, body),
+    ):
+        raise SecureAggError("reveal request failed its authenticity check")
+    if len(body) < 4:
+        raise SecureAggError("truncated reveal request")
+    (n,) = struct.unpack("<I", body[:4])
+    if len(body) != 4 + 8 * n or n == 0:
+        raise SecureAggError("malformed reveal request body")
+    ids = list(struct.unpack(f"<{n}q", body[4:]))
+    if len(set(ids)) != n:
+        raise SecureAggError("duplicate ids in reveal request")
+    return ids
+
+
+def build_reveal_response(
+    secrets: Mapping[int, bytes], *, session: bytes, round_index: int,
+    client_id: int, auth_key: bytes | None = None,
+) -> bytes:
+    body = b"".join(
+        struct.pack("<q", d) + secrets[d] for d in sorted(secrets)
+    )
+    msg = REVEAL_RESP_MAGIC + body
+    if auth_key is not None:
+        msg += _reveal_tag(
+            auth_key, b"-ra" + struct.pack("<q", client_id),
+            session, round_index, body,
+        )
+    return msg
+
+
+def parse_reveal_response(
+    frame: bytes, *, session: bytes, round_index: int, client_id: int,
+    expect_dead: Sequence[int], auth_key: bytes | None = None,
+) -> dict[int, bytes]:
+    import hmac
+
+    if not frame.startswith(REVEAL_RESP_MAGIC):
+        raise SecureAggError("not a reveal response")
+    body_end = len(frame) - (_TAG_LEN if auth_key is not None else 0)
+    body = frame[len(REVEAL_RESP_MAGIC) : body_end]
+    if auth_key is not None and not hmac.compare_digest(
+        frame[body_end:],
+        _reveal_tag(
+            auth_key, b"-ra" + struct.pack("<q", client_id),
+            session, round_index, body,
+        ),
+    ):
+        raise SecureAggError(
+            f"reveal response from client {client_id} failed its "
+            "authenticity check"
+        )
+    entry = 8 + PAIR_SECRET_LEN
+    if len(body) % entry:
+        raise SecureAggError("malformed reveal response body")
+    out: dict[int, bytes] = {}
+    for off in range(0, len(body), entry):
+        (d,) = struct.unpack("<q", body[off : off + 8])
+        out[d] = body[off + 8 : off + entry]
+    if sorted(out) != sorted(set(int(x) for x in expect_dead)):
+        raise SecureAggError(
+            f"reveal response covers {sorted(out)}, expected "
+            f"{sorted(expect_dead)}"
+        )
+    return out
+
+
+def residual_mask_sum(
+    template: Mapping[str, np.ndarray],
+    revealed: Mapping[int, Mapping[int, bytes]],  # survivor -> dead -> secret
+    *,
+    session: bytes,
+    round_index: int,
+) -> dict[str, np.ndarray]:
+    """The uncancelled mask residue a dropout leaves in the ring sum:
+    ``sum over survivors i, dead j of sign(i,j) * stream(i,j)`` where
+    ``sign`` is + when the survivor is the pair's low id (it ADDED the
+    stream in :func:`mask`) and - otherwise. Streams are regenerated in
+    the exact draw order ``mask`` used (one PRG per pair, tensors in
+    sorted-key order), so subtracting this from the sum restores exact
+    modular cancellation over the survivors."""
+    out = {
+        k: np.zeros_like(np.asarray(template[k], np.uint64))
+        for k in sorted(template)
+    }
+    for survivor, secrets in sorted(revealed.items()):
+        for dead_id, secret in sorted(secrets.items()):
+            if len(secret) != PAIR_SECRET_LEN:
+                raise SecureAggError(
+                    f"pair secret for ({survivor}, {dead_id}) has length "
+                    f"{len(secret)}"
+                )
+            lo, hi = min(survivor, dead_id), max(survivor, dead_id)
+            rng = _pair_stream(secret, session, round_index, lo, hi)
+            for key in sorted(out):
+                stream = rng.integers(
+                    0, 2**64, size=out[key].shape, dtype=np.uint64,
+                    endpoint=False,
+                )
+                if survivor == lo:
+                    out[key] += stream
+                else:
+                    out[key] -= stream
+    return out
 
 
 def sum_masked(models: Sequence[Mapping[str, np.ndarray]]) -> dict[str, np.ndarray]:

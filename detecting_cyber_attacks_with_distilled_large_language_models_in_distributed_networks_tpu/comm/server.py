@@ -82,9 +82,11 @@ class _Round:
     conns: dict[int, socket.socket] = field(default_factory=dict)
     nonces: dict[int, str] = field(default_factory=dict)  # auth mode only
     # Secure mode: each participant's (pubkey, tag) hello, relayed to all
-    # once everyone's arrived (keys_ready). The server never holds any
-    # private key — it only forwards public values.
+    # once everyone's arrived (keys_ready) — or, after the key grace
+    # window, to the quorum subset that did arrive (key_set). The server
+    # never holds any private key — it only forwards public values.
     pubkeys: dict[int, bytes] = field(default_factory=dict)
+    key_set: list | None = None  # sorted ids the keys frame covered
     keys_ready: threading.Event = field(default_factory=threading.Event)
     lock: threading.Lock = field(default_factory=threading.Lock)
     complete: threading.Event = field(default_factory=threading.Event)
@@ -120,16 +122,17 @@ class AggregationServer:
         auth_key: bytes | None = None,
         secure_agg: bool = False,
         fp_bits: int = secure.DEFAULT_FP_BITS,
+        key_grace: float | None = None,
     ):
         if secure_agg and weighted:
             raise ValueError(
                 "secure aggregation is an unweighted ring sum; "
                 "weighted=True is incompatible"
             )
-        if secure_agg and min_clients is not None and min_clients != num_clients:
+        if secure_agg and min_clients is not None and min_clients < 2:
             raise ValueError(
-                "secure aggregation needs every advertised participant's "
-                "masks to cancel: min_clients must equal num_clients"
+                "secure aggregation needs min_clients >= 2: a lone "
+                "survivor's 'sum' is its raw update"
             )
         if compression.startswith("topk"):
             raise ValueError(
@@ -144,6 +147,13 @@ class AggregationServer:
         self.auth_key = auth_key
         self.secure_agg = secure_agg
         self.fp_bits = fp_bits
+        # Dropout-before-keys window: once a connected participant has
+        # waited this long without the full fleet's DH hellos, the key set
+        # closes at the min_clients quorum and the round proceeds without
+        # the missing clients (secure.py "dropout recovery").
+        self.key_grace = (
+            min(30.0, timeout / 3.0) if key_grace is None else key_grace
+        )
         # Monotonic round counter plus a per-run random session nonce,
         # advertised to secure clients on connect: mask streams are keyed
         # by (session, round), so they are fresh across rounds AND across
@@ -271,23 +281,61 @@ class AggregationServer:
                     # keys frame until its own timeout.
                     rnd.conns[hello_id] = conn
                     if len(rnd.pubkeys) >= rnd.expected:
+                        rnd.key_set = sorted(rnd.pubkeys)
                         rnd.keys_ready.set()
                 log.info(
                     f"[SERVER] DH pubkey from client {hello_id} "
                     f"({len(rnd.pubkeys)}/{rnd.expected})"
                 )
-                if not rnd.keys_ready.wait(
-                    timeout=max(0.0, deadline - time.monotonic())
-                ):
-                    raise wire.WireError(
-                        "round deadline passed waiting for the remaining "
-                        "participants' DH public keys"
-                    )
+                # Wait for the full fleet's hellos — but after key_grace
+                # without completion, close the key set at the quorum that
+                # did arrive (dropout-before-keys recovery): the round
+                # proceeds over the subset instead of failing outright.
+                grace_end = time.monotonic() + self.key_grace
+                while not rnd.keys_ready.is_set():
+                    now = time.monotonic()
+                    if now >= deadline:
+                        raise wire.WireError(
+                            "round deadline passed waiting for the "
+                            "remaining participants' DH public keys"
+                        )
+                    # Before grace expiry, wake at grace_end to try the
+                    # quorum close; after (quorum not met yet), sleep
+                    # until the deadline — another hello's handler will
+                    # close the set and wake everyone if a quorum forms.
+                    wait_until = grace_end if now < grace_end else deadline
+                    if rnd.keys_ready.wait(
+                        timeout=max(0.0, wait_until - now)
+                    ):
+                        break
+                    with rnd.lock:
+                        if (
+                            not rnd.keys_ready.is_set()
+                            and time.monotonic() >= grace_end
+                            and len(rnd.pubkeys) >= max(2, self.min_clients)
+                        ):
+                            rnd.key_set = sorted(rnd.pubkeys)
+                            rnd.keys_ready.set()
+                            log.info(
+                                f"[SERVER] key grace expired; closing the "
+                                f"key set at quorum {rnd.key_set}"
+                            )
+                            break
                 with rnd.lock:
+                    key_set = list(rnd.key_set or [])
                     entries = b"".join(
                         _struct.pack("<q", cid) + rnd.pubkeys[cid]
-                        for cid in sorted(rnd.pubkeys)
+                        for cid in key_set
                     )
+                if hello_id not in key_set:
+                    # Arrived during finalization but after the cut: a key
+                    # outside the distributed set could never cancel.
+                    log.info(
+                        f"[SERVER] client {hello_id} missed the key set "
+                        f"{key_set}; dropping connection"
+                    )
+                    conn.close()
+                    return
                 framing.send_frame(conn, wire.KEYS_MAGIC + entries)
             payload = framing.recv_frame(conn)
             flat, meta = wire.decode(payload, auth_key=self.auth_key)
@@ -337,14 +385,16 @@ class AggregationServer:
                         f"secure upload fp_bits={meta.get('fp_bits')} != server "
                         f"fp_bits={self.fp_bits}: de-quantization would be wrong"
                     )
-                if int(meta.get("participants", -1)) != self.num_clients:
-                    # A client masking against a different fleet size would
-                    # carry uncancelled pair masks — the sum would silently
-                    # de-quantize to ring noise.
+                with rnd.lock:
+                    n_keyed = len(rnd.key_set or [])
+                if int(meta.get("participants", -1)) != n_keyed:
+                    # A client masking against a different participant set
+                    # would carry uncancelled pair masks — the sum would
+                    # silently de-quantize to ring noise.
                     raise wire.WireError(
                         f"secure upload masked for "
                         f"{meta.get('participants')} participants, server "
-                        f"expects {self.num_clients}"
+                        f"distributed keys to {n_keyed}"
                     )
                 if int(meta.get("round", -1)) != rnd.round_no:
                     raise wire.WireError(
@@ -375,7 +425,14 @@ class AggregationServer:
                 rnd.conns[client_id] = conn
                 if nonce_hex is not None:
                     rnd.nonces[client_id] = nonce_hex
-                done = len(rnd.models) >= rnd.expected
+                done = len(rnd.models) >= rnd.expected or (
+                    # Secure subset round (dropout before keys): complete
+                    # as soon as every KEYED participant uploaded — the
+                    # unkeyed never will.
+                    self.secure_agg
+                    and rnd.key_set is not None
+                    and set(rnd.key_set).issubset(rnd.models)
+                )
             log.info(
                 f"[SERVER] received model from client {client_id} "
                 f"({len(rnd.models)}/{rnd.expected})"
@@ -448,20 +505,102 @@ class AggregationServer:
                 )
             ids = sorted(models)
             if self.secure_agg:
-                # Masks only cancel over the FULL advertised participant
-                # set; a partial round would de-quantize uniform noise.
-                expected_ids = list(range(self.num_clients))
-                if ids != expected_ids:
+                key_set = list(rnd.key_set or [])
+                extra = [i for i in ids if i not in key_set]
+                if extra:
+                    # Can't happen via the protocol (uploads require the
+                    # keys frame) but a forged upload must not poison the
+                    # ring sum.
                     raise RuntimeError(
-                        f"secure round incomplete: got clients {ids}, "
-                        f"need exactly {expected_ids}"
+                        f"secure uploads from clients {extra} outside the "
+                        f"key set {key_set}"
                     )
-                agg = secure.aggregate_masked(
-                    [models[i] for i in ids], self.fp_bits
-                )
+                dead = [i for i in key_set if i not in models]
+                if dead:
+                    # Reveal round (secure.py "dropout recovery"):
+                    # survivors disclose their pair secrets with the dead,
+                    # and the uncancelled mask halves are subtracted from
+                    # the ring sum before de-quantizing over survivors.
+                    log.info(
+                        f"[SERVER] secure round lost clients {dead}; "
+                        f"asking {ids} to reveal their pair secrets"
+                    )
+                    req = secure.build_reveal_request(
+                        dead,
+                        session=self._session,
+                        round_index=rnd.round_no,
+                        auth_key=self.auth_key,
+                    )
+                    # Parallel per-survivor exchange with a bounded budget
+                    # (same rationale as the reply fan-out below): a
+                    # stalled survivor must neither block the others'
+                    # requests nor extend the round by a full socket
+                    # timeout. Healthy survivors are already blocked in
+                    # recv and answer in milliseconds.
+                    reveal_budget = min(self.timeout, 30.0)
+                    revealed: dict[int, dict] = {}
+                    reveal_errs: dict[int, Exception] = {}
+
+                    def _reveal_from(cid: int) -> None:
+                        conn = conns[cid]
+                        try:
+                            conn.settimeout(reveal_budget)
+                            framing.send_frame(conn, req)
+                            revealed[cid] = secure.parse_reveal_response(
+                                framing.recv_frame(conn),
+                                session=self._session,
+                                round_index=rnd.round_no,
+                                client_id=cid,
+                                expect_dead=dead,
+                                auth_key=self.auth_key,
+                            )
+                            conn.settimeout(self.timeout)
+                        except (
+                            OSError,
+                            ConnectionError,
+                            wire.WireError,
+                            secure.SecureAggError,
+                        ) as e:
+                            reveal_errs[cid] = e
+
+                    rthreads = [
+                        threading.Thread(
+                            target=_reveal_from, args=(cid,), daemon=True
+                        )
+                        for cid in ids
+                    ]
+                    for t in rthreads:
+                        t.start()
+                    for t in rthreads:
+                        t.join(timeout=reveal_budget + 5.0)
+                    if reveal_errs or set(revealed) != set(ids):
+                        # A dropout DURING the reveal is unrecoverable
+                        # without Shamir shares (secure.py threat model).
+                        raise RuntimeError(
+                            f"reveal round failed for clients "
+                            f"{sorted(set(ids) - set(revealed))}: "
+                            f"{ {c: str(e) for c, e in reveal_errs.items()} }"
+                        )
+                    summed = secure.sum_masked([models[i] for i in ids])
+                    residue = secure.residual_mask_sum(
+                        summed,
+                        revealed,
+                        session=self._session,
+                        round_index=rnd.round_no,
+                    )
+                    agg = secure.dequantize_sum(
+                        {k: summed[k] - residue[k] for k in summed},
+                        len(ids),
+                        self.fp_bits,
+                    )
+                else:
+                    agg = secure.aggregate_masked(
+                        [models[i] for i in ids], self.fp_bits
+                    )
                 log.info(
                     f"[SERVER] secure-aggregated {len(ids)} masked models "
-                    f"(server never saw raw weights)"
+                    + (f"after revealing {len(dead)} dropout(s) " if dead else "")
+                    + "(server never saw raw weights)"
                 )
             else:
                 weights = [n_samples[i] for i in ids] if self.weighted else None
