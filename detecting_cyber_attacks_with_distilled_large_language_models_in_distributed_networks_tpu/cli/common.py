@@ -138,6 +138,10 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
                     else args.prox_mu
                 ),
                 participation=participation,
+                participation_mode=(
+                    getattr(args, "participation_mode", None)
+                    or cfg.fed.participation_mode
+                ),
                 min_client_fraction=min_frac,
                 dp_clip=(
                     cfg.fed.dp_clip

@@ -208,15 +208,14 @@ def cmd_federated(args) -> int:
                     state.params, prepared=prepared_val
                 )
                 local = trainer.evaluate_clients(state.params, prepared=prepared)
-                mask = trainer.participation_mask(r)
-                if base_mask is not None:
-                    mask = base_mask if mask is None else mask * base_mask
-                state = trainer.aggregate(
+                # Shared sampling/gating/aggregation (incl. the Poisson
+                # empty-cohort no-op round, train/federated.py).
+                state = trainer.round_aggregate(
                     state,
-                    weights=weights,
-                    client_mask=mask,
-                    anchor=anchor,
                     round_index=r,
+                    weights=weights,
+                    base_mask=base_mask,
+                    anchor=anchor,
                 )
                 aggregated_val = trainer.evaluate_clients(
                     state.params, prepared=prepared_val
@@ -275,10 +274,11 @@ def cmd_federated(args) -> int:
         # been trained without noise, so the guarantee must not cover them.
         dp_rounds = cfg.fed.rounds - start_round
         # participation < 1: the subsampled-Gaussian accountant credits
-        # privacy amplification (parallel/dp.py::sgm_rdp). The rate is the
-        # EFFECTIVE cohort_size/C, not the nominal fraction — ceil rounding
-        # can sample a much larger cohort than the flag says.
-        q = cfg.fed.effective_participation()
+        # privacy amplification (parallel/dp.py::sgm_rdp). Under the
+        # Poisson sampler (the default with DP on) q is the exact
+        # Bernoulli rate; under the fixed sampler it is the EFFECTIVE
+        # cohort_size/C approximation.
+        q, q_exact = cfg.fed.dp_sampling_rate()
         eps_zeroed, eps_replace = dp_epsilon_both(
             dp_rounds, cfg.fed.dp_noise_multiplier, 1e-5, sampling_rate=q
         )
@@ -294,11 +294,16 @@ def cmd_federated(args) -> int:
         # Both adjacency bounds, every run: the zeroed-contribution figure
         # alone reads ~4x stronger than the same noise under the stricter
         # replace-one adjacency (parallel/dp.py module docstring).
-        sampling_note = (
-            ""
-            if q >= 1.0
-            else f"; fixed-size cohort accounted as Poisson sampling q={q:.3g}"
-        )
+        if q >= 1.0:
+            sampling_note = ""
+        elif q_exact:
+            sampling_note = f"; Poisson sampling q={q:.3g} (accountant exact)"
+        else:
+            sampling_note = (
+                f"; fixed-size cohort accounted as Poisson sampling "
+                f"q={q:.3g} (approximation — use "
+                f"--participation-mode poisson for an exact bound)"
+            )
         log.info(
             f"[DP] client-level guarantee for {dp_rounds} round(s): "
             f"({eps_zeroed:.3g}, 1e-05)-DP under zeroed-contribution "

@@ -235,6 +235,14 @@ def build_parser() -> argparse.ArgumentParser:
         "1.0 = everyone (reference behavior)",
     )
     p.add_argument(
+        "--participation-mode",
+        choices=["auto", "fixed", "poisson"],
+        help="cohort sampler under --participation < 1: 'fixed' draws an "
+        "exact-size cohort; 'poisson' draws each client independently "
+        "(the DP accountant's assumption, making epsilon exact); 'auto' "
+        "(default) = poisson when DP is on",
+    )
+    p.add_argument(
         "--dp-clip",
         type=float,
         help="DP-FedAvg: clip each client's round update to this L2 norm "

@@ -232,6 +232,32 @@ def _pair_stream(
     )
 
 
+def _apply_pair_stream(
+    out: dict[str, np.ndarray],
+    pair_secret: bytes,
+    session: bytes,
+    round_index: int,
+    lo: int,
+    hi: int,
+    *,
+    add: bool,
+) -> None:
+    """Add (or subtract) the (lo, hi) pair's mask stream into ``out``
+    in place, drawing per tensor in sorted-key order from one PRG. The
+    SINGLE stream-expansion implementation shared by :func:`mask` and
+    :func:`residual_mask_sum` — bit-exact cancellation (and reveal-round
+    recovery) depends on both ends expanding identically."""
+    rng = _pair_stream(pair_secret, session, round_index, lo, hi)
+    for key in sorted(out):
+        stream = rng.integers(
+            0, 2**64, size=out[key].shape, dtype=np.uint64, endpoint=False
+        )
+        if add:
+            out[key] += stream  # uint64 wraps mod 2^64
+        else:
+            out[key] -= stream
+
+
 def mask(
     quantized: Mapping[str, np.ndarray],
     *,
@@ -265,15 +291,10 @@ def mask(
         if other == client_id:
             continue
         lo, hi = min(client_id, other), max(client_id, other)
-        rng = _pair_stream(pair_secrets[other], session, round_index, lo, hi)
-        for key in sorted(out):
-            stream = rng.integers(
-                0, 2**64, size=out[key].shape, dtype=np.uint64, endpoint=False
-            )
-            if client_id == lo:
-                out[key] += stream  # uint64 wraps mod 2^64
-            else:
-                out[key] -= stream
+        _apply_pair_stream(
+            out, pair_secrets[other], session, round_index, lo, hi,
+            add=client_id == lo,
+        )
     return out
 
 
@@ -440,16 +461,10 @@ def residual_mask_sum(
                     f"{len(secret)}"
                 )
             lo, hi = min(survivor, dead_id), max(survivor, dead_id)
-            rng = _pair_stream(secret, session, round_index, lo, hi)
-            for key in sorted(out):
-                stream = rng.integers(
-                    0, 2**64, size=out[key].shape, dtype=np.uint64,
-                    endpoint=False,
-                )
-                if survivor == lo:
-                    out[key] += stream
-                else:
-                    out[key] -= stream
+            _apply_pair_stream(
+                out, secret, session, round_index, lo, hi,
+                add=survivor == lo,
+            )
     return out
 
 

@@ -150,10 +150,13 @@ class AggregationServer:
         # Dropout-before-keys window: once a connected participant has
         # waited this long without the full fleet's DH hellos, the key set
         # closes at the min_clients quorum and the round proceeds without
-        # the missing clients (secure.py "dropout recovery").
-        self.key_grace = (
-            min(30.0, timeout / 3.0) if key_grace is None else key_grace
-        )
+        # the missing clients (secure.py "dropout recovery"). This is the
+        # liveness/straggler trade-off knob: a client arriving after the
+        # cut is ejected for the ROUND (its retries fail fast; it rejoins
+        # next round), so the default is half the round budget — generous
+        # to compute/shard skew, while a genuinely dead client still costs
+        # at most half the deadline instead of failing the round outright.
+        self.key_grace = timeout / 2.0 if key_grace is None else key_grace
         # Monotonic round counter plus a per-run random session nonce,
         # advertised to secure clients on connect: mask streams are keyed
         # by (session, round), so they are fresh across rounds AND across

@@ -261,6 +261,15 @@ class FedConfig:
     # excluded from the masked mean and overwritten by its result. 1.0 =
     # everyone, the reference's behavior.
     participation: float = 1.0
+    # How the per-round cohort is drawn when participation < 1:
+    #   "fixed"   — exactly cohort_size() clients without replacement (the
+    #               classic FL sampler; the DP accountant's Poisson bound
+    #               is then the standard approximation);
+    #   "poisson" — each client joins independently with probability
+    #               `participation` (variable cohort; the subsampled-
+    #               Gaussian accountant's assumption holds EXACTLY);
+    #   "auto"    — poisson when DP is on (exact epsilon), fixed otherwise.
+    participation_mode: str = "auto"
     # DP-FedAvg (parallel/dp.py): clip each client's round update to this
     # global L2 norm before aggregation. 0 = off (plain FedAvg, the
     # reference's algorithm — which ships raw unclipped state dicts,
@@ -317,16 +326,45 @@ class FedConfig:
 
     def effective_participation(self) -> float:
         """The ACTUAL per-round sampling rate ``cohort_size / C`` — what
-        the DP accountant must see: ceil rounding makes it >= the nominal
-        ``participation`` (e.g. 0.26 of 4 clients samples 2/4 = 0.5), and
-        feeding the accountant the nominal fraction would overstate the
-        privacy guarantee."""
+        the DP accountant must see under the FIXED sampler: ceil rounding
+        makes it >= the nominal ``participation`` (e.g. 0.26 of 4 clients
+        samples 2/4 = 0.5), and feeding the accountant the nominal
+        fraction would overstate the privacy guarantee."""
         return self.cohort_size() / self.num_clients
+
+    def dp_enabled(self) -> bool:
+        return self.dp_clip > 0.0 and self.dp_noise_multiplier > 0.0
+
+    def resolve_participation_mode(self) -> str:
+        """The effective cohort sampler: "auto" picks poisson when DP is
+        on (the accountant's Poisson-sampling assumption then holds
+        exactly) and the classic fixed-size sampler otherwise."""
+        if self.participation >= 1.0:
+            return "fixed"  # everyone participates; no sampling at all
+        if self.participation_mode == "auto":
+            return "poisson" if self.dp_enabled() else "fixed"
+        return self.participation_mode
+
+    def dp_sampling_rate(self) -> tuple[float, bool]:
+        """(q for the DP accountant, whether the SGM bound's sampling
+        assumption is exact for the sampler in use). Poisson mode: q is
+        the nominal participation, exactly the sampler's Bernoulli rate.
+        Fixed mode: q = cohort_size/C, the standard approximation."""
+        if self.participation >= 1.0:
+            return 1.0, True
+        if self.resolve_participation_mode() == "poisson":
+            return self.participation, True
+        return self.effective_participation(), False
 
     def __post_init__(self) -> None:
         if not 0.0 < self.participation <= 1.0:
             raise ValueError(
                 f"participation={self.participation} must be in (0, 1]"
+            )
+        if self.participation_mode not in ("auto", "fixed", "poisson"):
+            raise ValueError(
+                f"participation_mode={self.participation_mode!r} must be "
+                "'auto', 'fixed' or 'poisson'"
             )
         if self.personalize_epochs < 0:
             raise ValueError(

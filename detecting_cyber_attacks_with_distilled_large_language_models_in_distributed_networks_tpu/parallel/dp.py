@@ -34,9 +34,12 @@ plain Gaussian mechanism; with ``sampling_rate < 1`` it uses the
 subsampled-Gaussian-mechanism RDP bound (Mironov, Talwar & Zhang 2019,
 integer orders), which is the privacy-amplification-tight accountant —
 the plain bound stays valid under subsampling but wastes the
-amplification exactly where small-cohort DP needs it. Caveat: the SGM
-bound assumes Poisson sampling; ``participation_mask`` samples a fixed-
-size cohort, for which q = cohort/C is the standard approximation.
+amplification exactly where small-cohort DP needs it. The SGM bound
+assumes Poisson sampling: with ``FedConfig.participation_mode="poisson"``
+(the default whenever DP is on) ``participation_mask`` draws each client
+independently with probability q, so the bound's assumption holds EXACTLY;
+the legacy fixed-size sampler remains available, accounted with the
+standard q = cohort/C approximation (the banner says which applies).
 """
 
 from __future__ import annotations

@@ -491,17 +491,90 @@ class FederatedTrainer:
     def participation_mask(self, round_index: int) -> np.ndarray | None:
         """Per-round participant sampling (FedConfig.participation < 1):
         a seeded 0/1 mask over clients, identical on every host. None when
-        everyone participates (the reference's behavior)."""
+        everyone participates (the reference's behavior).
+
+        Two samplers (FedConfig.participation_mode): "fixed" draws exactly
+        ``cohort_size()`` clients without replacement; "poisson" draws
+        each client independently with probability ``participation`` —
+        the sampler the DP accountant's subsampled-Gaussian bound assumes,
+        making the reported epsilon exact (the default whenever DP is on).
+        A Poisson cohort may be empty; ``run`` treats such a round as a
+        no-op instead of failing (skipping on this data-INDEPENDENT event
+        does not weaken the accountant's bound — both branches are
+        identically distributed under adjacent datasets)."""
         if self.cfg.fed.participation >= 1.0:
             return None
+        rng = np.random.default_rng(self.cfg.train.seed * 7919 + round_index)
+        if self.cfg.fed.resolve_participation_mode() == "poisson":
+            return (
+                rng.random(self.C) < self.cfg.fed.participation
+            ).astype(np.float64)
         # FedConfig.cohort_size is the single source of truth for k — the
         # DP accountant derives its effective sampling rate from the same
         # number (ceil keeps the sampled round above min_client_fraction).
         k = self.cfg.fed.cohort_size()
-        rng = np.random.default_rng(self.cfg.train.seed * 7919 + round_index)
         mask = np.zeros(self.C, np.float64)
         mask[rng.choice(self.C, size=k, replace=False)] = 1.0
         return mask
+
+    def round_aggregate(
+        self,
+        state: FedState,
+        *,
+        round_index: int,
+        weights: np.ndarray | None = None,
+        base_mask: np.ndarray | None = None,
+        faults: np.ndarray | None = None,
+        anchor: Any | None = None,
+    ) -> FedState:
+        """One round's participation sampling + gating + aggregation,
+        shared by :meth:`run` and the CLI round loop.
+
+        min_client_fraction gates CRASHED/empty clients (``base_mask``
+        and ``faults``) — never the Poisson draw: a small (even empty)
+        Poisson cohort is a legitimate sample the DP accountant's bound
+        already covers, and gating on it would condition the sampler and
+        un-exact the reported epsilon. An empty Poisson round is a no-op
+        (skipping on this data-INDEPENDENT event costs no privacy — both
+        branches are identically distributed under adjacent datasets)."""
+        from .fedsteps import check_survivors
+
+        mask = self.participation_mask(round_index)
+        poisson = (
+            mask is not None
+            and self.cfg.fed.resolve_participation_mode() == "poisson"
+        )
+        # The no-op branch keys on the PURE draw being empty: a non-empty
+        # draw whose every member then crashed is a fault event and must
+        # abort loudly (same as the fixed sampler), not read as a benign
+        # sampler outcome.
+        draw_empty = poisson and float(mask.sum()) == 0.0
+        gate = base_mask
+        if base_mask is not None:
+            mask = base_mask if mask is None else mask * base_mask
+        if faults is not None:
+            faults = np.asarray(faults, np.float64)
+            mask = faults if mask is None else mask * faults
+            gate = faults if gate is None else gate * faults
+        if poisson and gate is not None:
+            check_survivors(
+                float(gate.sum()), self.C, self.cfg.fed.min_client_fraction
+            )
+        if draw_empty:
+            log.info(
+                f"[FED] round {round_index + 1}: empty Poisson cohort — "
+                "aggregation skipped (no-op round; the DP accountant "
+                "already covers this branch)"
+            )
+            return state
+        return self.aggregate(
+            state,
+            weights=weights,
+            client_mask=mask,
+            anchor=anchor,
+            round_index=round_index,
+            enforce_min_fraction=not poisson,
+        )
 
     def round_anchor(self, state: FedState) -> Any | None:
         """Round-start params snapshot for DP and/or FedOpt aggregation —
@@ -525,9 +598,12 @@ class FederatedTrainer:
         client_mask: np.ndarray | None = None,
         anchor: Any | None = None,
         round_index: int = 0,
+        enforce_min_fraction: bool = True,
     ) -> FedState:
         """The FedAvg round boundary — dispatch in fedsteps.aggregate_round
-        (plain/weighted/masked FedAvg, DP-FedAvg, FedOpt)."""
+        (plain/weighted/masked FedAvg, DP-FedAvg, FedOpt).
+        ``enforce_min_fraction=False``: the Poisson-sampled path, where the
+        run loop gates faults itself and a small cohort is legitimate."""
         return aggregate_round(
             self,
             state,
@@ -535,6 +611,7 @@ class FederatedTrainer:
             client_mask=client_mask,
             anchor=anchor,
             round_index=round_index,
+            enforce_min_fraction=enforce_min_fraction,
         )
 
     # ------------------------------------------------------------------- run
@@ -624,14 +701,11 @@ class FederatedTrainer:
                     state, stacked_train, epoch_offset=r * E
                 )
             local = self.evaluate_clients(state.params, prepared=prepared)
-            mask = self.participation_mask(r)
-            if base_mask is not None:
-                mask = base_mask if mask is None else mask * base_mask
+            faults = None
             if fault_mask_fn is not None:
                 faults = fault_mask_fn(r)
                 if faults is not None:
                     faults = np.asarray(faults, np.float64)
-                    mask = faults if mask is None else mask * faults
                     dropped = [c for c in range(self.C) if faults[c] == 0]
                     if dropped:
                         log.info(
@@ -639,12 +713,13 @@ class FederatedTrainer:
                             f"clients {dropped}"
                         )
             with phase(f"round {r + 1}/{R} FedAvg", tag="FED"):
-                state = self.aggregate(
+                state = self.round_aggregate(
                     state,
-                    weights=weights,
-                    client_mask=mask,
-                    anchor=anchor,
                     round_index=r,
+                    weights=weights,
+                    base_mask=base_mask,
+                    faults=faults,
+                    anchor=anchor,
                 )
             aggregated = self.evaluate_clients(state.params, prepared=prepared)
             history.append(RoundRecord(r, losses, local, aggregated))

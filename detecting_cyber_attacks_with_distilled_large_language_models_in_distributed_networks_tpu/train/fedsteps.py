@@ -322,6 +322,16 @@ def cached_federated_steps(cfg, mesh) -> FedSteps:
     return _cached_federated_steps(key_cfg, mesh)
 
 
+def check_survivors(surviving: float, C: int, min_frac: float) -> None:
+    """Single enforcement of the survivor floor (zero survivors always
+    abort — a zero-mask mean would silently zero or NaN the params)."""
+    if surviving == 0.0 or surviving < min_frac * C:
+        raise RuntimeError(
+            f"only {int(surviving)}/{C} clients survived the round "
+            f"(min_client_fraction={min_frac})"
+        )
+
+
 def aggregate_round(
     trainer,
     state: FedState,
@@ -330,21 +340,23 @@ def aggregate_round(
     client_mask: np.ndarray | None = None,
     anchor: Any | None = None,
     round_index: int = 0,
+    enforce_min_fraction: bool = True,
 ) -> FedState:
     """The FedAvg round boundary. Enforces min_client_fraction (the
     reference instead refuses unless exactly N models arrived,
-    server.py:69-71). With ``fed.dp_clip > 0`` the boundary runs
-    DP-FedAvg (parallel/dp.py): pass the ``round_anchor`` captured
+    server.py:69-71) unless ``enforce_min_fraction=False`` (the Poisson
+    participation path — the caller gates faults itself and a small
+    sampled cohort must not abort). With ``fed.dp_clip > 0`` the boundary
+    runs DP-FedAvg (parallel/dp.py): pass the ``round_anchor`` captured
     before local training plus the round index (noise key)."""
     cfg = trainer.cfg
     C = trainer.C
     if client_mask is not None:
-        surviving = float(np.asarray(client_mask).sum())
-        if surviving == 0.0 or surviving < cfg.fed.min_client_fraction * C:
-            raise RuntimeError(
-                f"only {int(surviving)}/{C} clients survived the round "
-                f"(min_client_fraction={cfg.fed.min_client_fraction})"
-            )
+        check_survivors(
+            float(np.asarray(client_mask).sum()),
+            C,
+            cfg.fed.min_client_fraction if enforce_min_fraction else 0.0,
+        )
     if weights is not None:
         eff = np.asarray(weights, dtype=np.float64)
         if client_mask is not None:
