@@ -32,6 +32,29 @@ def _auth_key() -> bytes | None:
 def cmd_serve(args) -> int:
     from ..comm import AggregationServer
 
+    dp_clip = float(getattr(args, "dp_clip", 0.0) or 0.0)
+    dp_noise = float(getattr(args, "dp_noise_multiplier", 0.0) or 0.0)
+    rounds = args.rounds or 1
+    if dp_clip > 0.0 and dp_noise > 0.0:
+        # Same dual-adjacency accountant banner as the mesh tier
+        # (cli/federated.py): every client participates in every TCP
+        # round, so q = 1 and the bound is the plain Gaussian-mechanism
+        # RDP composition — exact, no sampling caveat.
+        from ..parallel.dp import dp_epsilon_both
+
+        eps_zeroed, eps_replace = dp_epsilon_both(rounds, dp_noise, 1e-5)
+        log.info(
+            f"[DP] client-level guarantee for {rounds} round(s): "
+            f"({eps_zeroed:.3g}, 1e-05)-DP under zeroed-contribution "
+            f"adjacency; ({eps_replace:.3g}, 1e-05)-DP under replace-one "
+            f"adjacency (clip {dp_clip}, noise x{dp_noise}; full "
+            "participation, accountant exact)"
+        )
+    elif dp_clip > 0.0:
+        log.warning(
+            "[DP] --dp-clip without --dp-noise-multiplier clips uploads "
+            "but adds NO noise: no (epsilon, delta) guarantee"
+        )
     with AggregationServer(
         host=args.host,
         port=args.port,
@@ -42,9 +65,11 @@ def cmd_serve(args) -> int:
         compression=args.compression,
         auth_key=_auth_key(),
         secure_agg=bool(getattr(args, "secure_agg", False)),
+        dp_clip=dp_clip,
+        dp_noise_multiplier=dp_noise,
     ) as server:
         log.info(f"[SERVER] listening on {args.host}:{server.port}")
-        server.serve(rounds=args.rounds or 1)
+        server.serve(rounds=rounds)
     return 0
 
 
@@ -90,6 +115,7 @@ def cmd_client(args) -> int:
         auth_key=_auth_key(),
         secure_agg=bool(getattr(args, "secure_agg", False)),
         num_clients=cfg.fed.num_clients,
+        dp=bool(getattr(args, "dp", False)),
     )
     import jax.numpy as jnp
 
@@ -104,6 +130,17 @@ def cmd_client(args) -> int:
     if ckpt is not None:
         save_seq = max(save_seq, ckpt.latest_step() or 0)
     for r in range(rounds):
+        # Central DP: the round base is what THIS round's training starts
+        # from — the shared init in round 1 (every client must launch from
+        # the same weights; the server enforces crc equality), the adopted
+        # aggregate afterwards. np.array(copy=True), NOT np.asarray: the
+        # jitted train step donates its input buffers, and a zero-copy
+        # view would silently alias the POST-training params (zero delta).
+        round_base = (
+            jax.tree.map(lambda x: np.array(x, copy=True), state.params)
+            if fed.dp
+            else None
+        )
         with phase(f"client {args.client_id} round {r + 1}/{rounds} training", tag="TRAIN"):
             state, _ = trainer.fit(
                 state, client_data.train, batch_size=cfg.data.batch_size,
@@ -126,7 +163,9 @@ def cmd_client(args) -> int:
         try:
             with phase("federated exchange", tag="COMM"):
                 aggregated = fed.exchange(
-                    host_params, n_samples=len(client_data.train)
+                    host_params,
+                    n_samples=len(client_data.train),
+                    round_base=round_base,
                 )
             with phase("aggregated evaluation", tag="EVAL"):
                 agg_metrics = trainer.evaluate(aggregated, client_data.test)

@@ -304,6 +304,24 @@ def build_parser() -> argparse.ArgumentParser:
         "recover only their sum — individual client weights are never "
         "visible to the server",
     )
+    p.add_argument(
+        "--dp-clip",
+        type=float,
+        default=0.0,
+        help="central DP: require clipped round-delta uploads (clients "
+        "run with --dp), aggregate mean(clipped deltas) + Gaussian noise, "
+        "reply with the noised mean delta — the server never holds "
+        "absolute weights; composes with --secure-agg (noise on the "
+        "recovered sum)",
+    )
+    p.add_argument(
+        "--dp-noise-multiplier",
+        type=float,
+        default=0.0,
+        help="Gaussian noise std on the mean delta is "
+        "multiplier * clip / n_clients; the accountant banner reports "
+        "the (epsilon, delta) guarantee for the served rounds",
+    )
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
@@ -333,6 +351,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="mask the upload with per-pair Diffie-Hellman secrets (fresh "
         "ephemeral keys each round, relayed through the server) so the "
         "server sees only the sum and no client can unmask another pair",
+    )
+    p.add_argument(
+        "--dp",
+        action="store_true",
+        help="central DP (server runs with --dp-clip): upload the clipped "
+        "round delta vs this round's starting params; the clip bound and "
+        "noise multiplier come from the server's advert",
     )
     p.add_argument(
         "--checkpoint-dir",

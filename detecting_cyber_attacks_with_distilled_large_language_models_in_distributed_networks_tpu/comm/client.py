@@ -65,7 +65,14 @@ class FederatedClient:
         secure_agg: bool = False,
         num_clients: int | None = None,
         fp_bits: int = secure.DEFAULT_FP_BITS,
+        dp: bool = False,
     ):
+        if dp and compression.startswith("topk"):
+            raise ValueError(
+                "central DP uploads are clipped dense deltas; the sparse "
+                "error-feedback tier would carry unclipped mass across "
+                "rounds — drop --dp or topk"
+            )
         if secure_agg and num_clients is None:
             raise ValueError(
                 "secure aggregation needs num_clients: each client must "
@@ -93,6 +100,12 @@ class FederatedClient:
         self.secure_agg = secure_agg
         self.num_clients = num_clients
         self.fp_bits = fp_bits
+        # Central DP (comm/server.py dp_clip): uploads become clipped
+        # round deltas vs the caller-supplied round base; the reply is the
+        # noised mean delta, applied to the base before exchange() returns
+        # (callers still see an absolute aggregate). clip/noise come from
+        # the server's advert.
+        self.dp = dp
         # Highest (per session) round this instance has already masked an
         # upload for: a later exchange() refuses a replayed advert rather
         # than masking DIFFERENT weights under the same stream.
@@ -134,6 +147,7 @@ class FederatedClient:
         n_samples: int = 1,
         meta: Mapping[str, Any] | None = None,
         max_retries: int = 5,  # the reference's retry budget (client1.py:314)
+        round_base: Any | None = None,
     ) -> dict:
         """Upload local params, return the aggregated params (nested dict).
 
@@ -163,7 +177,40 @@ class FederatedClient:
             "n_samples": int(n_samples),
             **dict(meta or {}),
         }
-        flat = wire.flatten_params(params) if self.secure_agg else None
+        dp_base_flat = dp_delta = None
+        if self.dp:
+            # ``round_base``: the params this round's local training
+            # STARTED from (the previously adopted aggregate; the shared
+            # init in round 1 — every client must start from the same
+            # weights, enforced by the server's crc-equality check). The
+            # upload is clip(params - round_base); the clip value arrives
+            # in the server's advert, so the final clipping happens inside
+            # the attempt loop.
+            if round_base is None:
+                raise ValueError(
+                    "central DP needs round_base: the params this round's "
+                    "training started from"
+                )
+            dp_base_flat = {
+                k: np.asarray(v, np.float32)
+                for k, v in wire.flatten_params(round_base).items()
+            }
+            flatp = wire.flatten_params(params)
+            if not wire.shapes_compatible(flatp, dp_base_flat):
+                raise ValueError(
+                    "round_base tensor set/shapes do not match params"
+                )
+            dp_delta = {
+                k: np.asarray(flatp[k], np.float32) - dp_base_flat[k]
+                for k in flatp
+            }
+            base_meta["dp"] = True
+            base_meta["dp_base_crc"] = wire.flat_crc32(dp_base_flat)
+        flat = (
+            wire.flatten_params(params)
+            if self.secure_agg and not self.dp
+            else None
+        )
         # The plain (no auth, no masking, no sparse-delta) upload encodes
         # once; auth embeds the per-connection challenge, secure mode embeds
         # the per-round masks, and topk mode picks sparse-vs-dense per
@@ -173,6 +220,7 @@ class FederatedClient:
             if self.auth_key is None
             and not self.secure_agg
             and self._topk_frac is None
+            and not self.dp
             else None
         )
         last: Exception | None = None
@@ -194,6 +242,49 @@ class FederatedClient:
                         raise wire.WireError("bad auth challenge from server")
                     nonce_hex = chal[len(wire.NONCE_MAGIC) :].hex()
                     attempt_meta.update(role="client", nonce=nonce_hex)
+                if self.dp:
+                    # DP advert: the clip bound + noise multiplier this
+                    # server enforces. Fail fast if the server isn't in DP
+                    # mode (its first frame would be something else).
+                    import struct as _struct
+
+                    sock.settimeout(min(self.timeout, 30.0))
+                    try:
+                        adv = framing.recv_frame(sock)
+                    except socket.timeout:
+                        # ModeError, not WireError: retries would stall
+                        # identically against a non-DP server.
+                        raise wire.ModeError(
+                            "server sent no DP advert — is it running "
+                            "with --dp-clip?"
+                        ) from None
+                    finally:
+                        sock.settimeout(self.timeout)
+                    n_magic = len(wire.DP_MAGIC)
+                    if len(adv) != n_magic + 16 or not adv.startswith(
+                        wire.DP_MAGIC
+                    ):
+                        raise wire.ModeError("bad DP advert from server")
+                    dp_clip, dp_noise = _struct.unpack(
+                        "<dd", adv[n_magic:]
+                    )
+                    if not dp_clip > 0.0:
+                        raise wire.WireError(
+                            f"DP advert carries clip={dp_clip}"
+                        )
+                    # Client-side clipping (the server re-clips in plain
+                    # mode; under secure-agg it cannot, so this is the
+                    # honest-client clip the guarantee assumes).
+                    clipped, norm, scale = wire.clip_flat(dp_delta, dp_clip)
+                    log.info(
+                        f"[CLIENT {self.client_id}] DP round: update norm "
+                        f"{norm:.4g}, clip {dp_clip} (scale {scale:.3g}), "
+                        f"noise x{dp_noise}"
+                    )
+                    if self.secure_agg:
+                        flat = clipped  # quantize+mask the clipped delta
+                    else:
+                        upload = clipped
                 if self.secure_agg:
                     import struct as _struct
 
@@ -290,6 +381,7 @@ class FederatedClient:
                     self.auth_key is not None
                     or self.secure_agg
                     or self._topk_frac is not None
+                    or self.dp
                 ):
                     # Fresh encode per attempt: the nonce and/or round (and
                     # with them the masks), or the sparse-vs-dense choice,
@@ -352,6 +444,25 @@ class FederatedClient:
                     f"[CLIENT {self.client_id}] received aggregated model "
                     f"({len(reply) / 1e6:.1f} MB, clients {agg_meta.get('round_clients')})"
                 )
+                if self.dp:
+                    # The DP reply is the noised mean DELTA (the server
+                    # never held absolute weights); apply it to the round
+                    # base so callers still receive an absolute aggregate.
+                    if agg_meta.get("dp_reply") != "delta":
+                        raise wire.WireError(
+                            "DP reply missing dp_reply=delta marker"
+                        )
+                    agg_flat = wire.flatten_params(agg)
+                    if not wire.shapes_compatible(agg_flat, dp_base_flat):
+                        raise wire.WireError(
+                            "DP reply delta shapes do not match the base"
+                        )
+                    absolute = {
+                        k: dp_base_flat[k]
+                        + np.asarray(agg_flat[k], np.float32)
+                        for k in agg_flat
+                    }
+                    return wire.unflatten_params(absolute)
                 if self._topk_frac is not None:
                     self._finish_topk(agg, agg_meta, delta_flat, sent_flat)
                 return agg

@@ -88,6 +88,10 @@ class _Round:
     pubkeys: dict[int, bytes] = field(default_factory=dict)
     key_set: list | None = None  # sorted ids the keys frame covered
     keys_ready: threading.Event = field(default_factory=threading.Event)
+    # Central DP: each upload's declared round-base crc; the round only
+    # aggregates when all are identical (a common anchor is what makes
+    # the clipped-delta mean well-defined).
+    dp_crcs: dict[int, int] = field(default_factory=dict)
     lock: threading.Lock = field(default_factory=threading.Lock)
     complete: threading.Event = field(default_factory=threading.Event)
     # Set (under lock) when serve_round snapshots the round; a handler that
@@ -123,7 +127,16 @@ class AggregationServer:
         secure_agg: bool = False,
         fp_bits: int = secure.DEFAULT_FP_BITS,
         key_grace: float | None = None,
+        dp_clip: float = 0.0,
+        dp_noise_multiplier: float = 0.0,
     ):
+        if dp_noise_multiplier > 0.0 and dp_clip <= 0.0:
+            raise ValueError("dp_noise_multiplier needs dp_clip > 0")
+        if dp_clip > 0.0 and weighted:
+            raise ValueError(
+                "central DP is a uniform mean over clipped updates; "
+                "weighted=True is incompatible"
+            )
         if secure_agg and weighted:
             raise ValueError(
                 "secure aggregation is an unweighted ring sum; "
@@ -147,6 +160,15 @@ class AggregationServer:
         self.auth_key = auth_key
         self.secure_agg = secure_agg
         self.fp_bits = fp_bits
+        # Central DP (dp_clip > 0): uploads must be clipped round deltas
+        # (the client flag --dp; the advert carries clip+noise); the
+        # aggregate is mean(clipped deltas) + Gaussian(noise*clip/n), and
+        # the reply is that noised mean DELTA — this server never holds
+        # absolute model weights in DP mode. Base agreement is enforced by
+        # requiring every upload's dp_base_crc to be identical.
+        self.dp_clip = float(dp_clip)
+        self.dp_noise_multiplier = float(dp_noise_multiplier)
+        self._dp_rng = np.random.default_rng()  # OS entropy; never seeded
         # Dropout-before-keys window: once a connected participant has
         # waited this long without the full fleet's DH hellos, the key set
         # closes at the min_clients quorum and the round proceeds without
@@ -209,6 +231,16 @@ class AggregationServer:
                 nonce_hex = os.urandom(wire.NONCE_LEN).hex()
                 framing.send_frame(
                     conn, wire.NONCE_MAGIC + bytes.fromhex(nonce_hex)
+                )
+            if self.dp_clip > 0.0:
+                import struct as _dstruct
+
+                framing.send_frame(
+                    conn,
+                    wire.DP_MAGIC
+                    + _dstruct.pack(
+                        "<dd", self.dp_clip, self.dp_noise_multiplier
+                    ),
                 )
             if self.secure_agg:
                 # Advertise (round, session) so every participant keys its
@@ -382,6 +414,39 @@ class AggregationServer:
                     f"secure_agg={self.secure_agg}, upload "
                     f"secure={meta.get('secure', False)}"
                 )
+            dp_mode = self.dp_clip > 0.0
+            if bool(meta.get("dp", False)) != dp_mode:
+                raise wire.WireError(
+                    f"central-DP mode mismatch: server dp={dp_mode}, "
+                    f"upload dp={meta.get('dp', False)} — run the client "
+                    f"with --dp iff the server has --dp-clip"
+                )
+            dp_crc = None
+            if dp_mode:
+                if is_delta:
+                    raise wire.WireError(
+                        "sparse-delta upload in central-DP mode"
+                    )
+                try:
+                    dp_crc = int(meta["dp_base_crc"])
+                except (KeyError, TypeError, ValueError):
+                    raise wire.WireError(
+                        "DP upload missing its dp_base_crc"
+                    ) from None
+                if not self.secure_agg:
+                    # ENFORCED clipping (not just trusted): a client that
+                    # skipped its clip cannot widen the mechanism's
+                    # sensitivity for anyone. (Masked uploads can't be
+                    # re-clipped; there the guarantee assumes honest
+                    # clients clip, as standard for secure-agg DP.)
+                    norm = wire.flat_l2_norm(flat)
+                    if norm > self.dp_clip * (1.0 + 1e-5):
+                        flat, _, _ = wire.clip_flat(flat, self.dp_clip)
+                        log.info(
+                            f"[SERVER] re-clipped client "
+                            f"{meta.get('client_id')}'s delta "
+                            f"({norm:.4g} -> {self.dp_clip})"
+                        )
             if self.secure_agg:
                 if int(meta.get("fp_bits", -1)) != self.fp_bits:
                     raise wire.WireError(
@@ -422,6 +487,8 @@ class AggregationServer:
                         old.close()
                 rnd.models[client_id] = flat
                 rnd.deltas[client_id] = is_delta
+                if dp_crc is not None:
+                    rnd.dp_crcs[client_id] = dp_crc
                 if is_delta or bool(meta.get("wants_delta", False)):
                     rnd.wants_delta = True
                 rnd.n_samples[client_id] = float(meta.get("n_samples", 1.0))
@@ -500,6 +567,7 @@ class AggregationServer:
             conns = dict(rnd.conns)
             n_samples = dict(rnd.n_samples)
             nonces = dict(rnd.nonces)
+            dp_crcs = dict(rnd.dp_crcs)
         try:
             if len(models) < self.min_clients:
                 raise RuntimeError(
@@ -507,6 +575,19 @@ class AggregationServer:
                     f"(min_clients={self.min_clients})"
                 )
             ids = sorted(models)
+            dp_mode = self.dp_clip > 0.0
+            if dp_mode:
+                crc_set = {dp_crcs[i] for i in ids}
+                if len(crc_set) != 1:
+                    # A stale client (missed a round / different init)
+                    # would shift the mean by an unbounded base gap.
+                    raise RuntimeError(
+                        "DP round base mismatch: clients disagree on the "
+                        f"round base (crcs per client: "
+                        f"{ {i: f'{dp_crcs[i]:#010x}' for i in ids} }) — "
+                        "every client must start the round from the same "
+                        "adopted aggregate / shared init"
+                    )
             if self.secure_agg:
                 key_set = list(rnd.key_set or [])
                 extra = [i for i in ids if i not in key_set]
@@ -626,24 +707,57 @@ class AggregationServer:
                     + (f", {n_sparse} sparse-delta" if n_sparse else "")
                     + ")"
                 )
-            # The new base for next round's sparse deltas, advertised in
-            # every reply. Secure mode tracks it too (harmless), but delta
-            # uploads are refused there (mask streams carry no sparsity).
-            self._last_agg = agg
-            self._last_agg_round = rnd.round_no
-            # agg_crc: the base-agreement contract. Clients only adopt the
-            # decoded reply as their next delta base when it hashes to the
-            # server's exact fp32 aggregate — under a lossy reply
-            # compression (bf16/int8) it never will, and they stay dense.
-            # Lazily computed: it is a full fp32 pass over the model, paid
-            # only when a delta-capable client showed up this round (and
-            # never in secure mode, where delta uploads are refused).
-            reply_meta = {
-                "round_clients": ids,
-                "agg_round": rnd.round_no,
-            }
-            if rnd.wants_delta and not self.secure_agg:
-                reply_meta["agg_crc"] = wire.flat_crc32(agg)
+            if dp_mode:
+                # agg is the uniform mean of CLIPPED DELTAS (plain mode:
+                # aggregate_flat over re-clipped uploads; secure mode: the
+                # de-quantized masked sum of client-clipped deltas). Add
+                # the Gaussian mechanism's noise and reply with the noised
+                # mean delta — no absolute weights ever exist server-side,
+                # and the sparse-tier base bookkeeping does not apply.
+                n = len(ids)
+                sigma = self.dp_noise_multiplier * self.dp_clip / n
+                if sigma > 0.0:
+                    # fp32 draws: Generator.normal would materialize a
+                    # float64 model-sized array per tensor first.
+                    agg = {
+                        k: np.asarray(v, np.float32)
+                        + self._dp_rng.standard_normal(
+                            np.shape(v), dtype=np.float32
+                        )
+                        * np.float32(sigma)
+                        for k, v in agg.items()
+                    }
+                log.info(
+                    f"[SERVER] central DP: mean of {n} clipped deltas "
+                    f"(clip {self.dp_clip}) + Gaussian noise "
+                    f"std {sigma:.3g}/coordinate"
+                )
+                reply_meta = {
+                    "round_clients": ids,
+                    "agg_round": rnd.round_no,
+                    "dp_reply": "delta",
+                }
+            else:
+                # The new base for next round's sparse deltas, advertised
+                # in every reply. Secure mode tracks it too (harmless), but
+                # delta uploads are refused there (mask streams carry no
+                # sparsity).
+                self._last_agg = agg
+                self._last_agg_round = rnd.round_no
+                # agg_crc: the base-agreement contract. Clients only adopt
+                # the decoded reply as their next delta base when it hashes
+                # to the server's exact fp32 aggregate — under a lossy
+                # reply compression (bf16/int8) it never will, and they
+                # stay dense. Lazily computed: it is a full fp32 pass over
+                # the model, paid only when a delta-capable client showed
+                # up this round (and never in secure mode, where delta
+                # uploads are refused).
+                reply_meta = {
+                    "round_clients": ids,
+                    "agg_round": rnd.round_no,
+                }
+                if rnd.wants_delta and not self.secure_agg:
+                    reply_meta["agg_crc"] = wire.flat_crc32(agg)
             if self.auth_key is None:
                 # One shared reply blob, referenced by every client.
                 shared = wire.encode(
@@ -695,6 +809,14 @@ class AggregationServer:
         return agg
 
     def serve(self, rounds: int = 1) -> None:
+        """Multi-round loop: one failed round (quorum missed, DP base
+        mismatch, reveal dropout) must not kill the server for every
+        remaining round — the reference hangs forever in this situation
+        (server.py:124-132); here the round is logged and the next one
+        proceeds, so retrying clients can still complete it."""
         for r in range(rounds):
             log.info(f"[SERVER] round {r + 1}/{rounds}")
-            self.serve_round()
+            try:
+                self.serve_round()
+            except RuntimeError as e:
+                log.info(f"[SERVER] round {r + 1} failed: {e}")

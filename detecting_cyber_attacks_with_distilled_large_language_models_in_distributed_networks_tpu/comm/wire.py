@@ -69,6 +69,11 @@ SESSION_LEN = 16
 #: arrived, replies KEYS_MAGIC + num_clients x (u64 id + pubkey [+ tag]).
 PUBKEY_MAGIC = b"DHPK"
 KEYS_MAGIC = b"DHKS"
+#: Central-DP advert sent by a DP server on connect (after the nonce, if
+#: any; before the secure round advert): DP_MAGIC + f64 clip + f64 noise
+#: multiplier. DP uploads are CLIPPED ROUND DELTAS and the DP reply is the
+#: noised mean delta (the server never holds absolute weights).
+DP_MAGIC = b"DPAD"
 _ALLOWED_DTYPES = {
     "float32", "float64", "float16", "bfloat16",
     "int8", "int16", "int32", "int64",
@@ -78,6 +83,12 @@ _ALLOWED_DTYPES = {
 
 class WireError(ValueError):
     """Malformed, corrupt, or version-mismatched message."""
+
+
+class ModeError(ValueError):
+    """Client/server protocol-mode mismatch (e.g. --dp against a non-DP
+    server). Deliberately NOT a WireError: retrying cannot help, so the
+    client's retry loop must let it propagate immediately."""
 
 
 # --------------------------------------------------- int8 row quantization
@@ -203,6 +214,38 @@ class PreEncoded:
         self.buf = buf
         self.shape = tuple(int(s) for s in shape)
         self.dtype = dtype
+
+
+def flat_l2_norm(flat: Mapping[str, Any]) -> float:
+    """Global L2 norm across all tensors of a flat param/delta dict,
+    accumulated in float64 — the single norm both the DP client's clip
+    and the DP server's re-clip enforcement compute (their tolerance
+    contract depends on both sides agreeing)."""
+    return float(
+        np.sqrt(
+            sum(
+                float(np.sum(np.asarray(v, np.float64) ** 2))
+                for v in flat.values()
+            )
+        )
+    )
+
+
+def clip_flat(
+    flat: Mapping[str, Any], clip: float
+) -> tuple[dict[str, np.ndarray], float, float]:
+    """Scale a flat delta dict to global L2 norm <= ``clip``; returns
+    ``(clipped fp32 dict, original norm, applied scale)``."""
+    norm = flat_l2_norm(flat)
+    scale = min(1.0, clip / max(norm, 1e-12))
+    return (
+        {
+            k: np.asarray(v, np.float32) * np.float32(scale)
+            for k, v in flat.items()
+        },
+        norm,
+        scale,
+    )
 
 
 def shapes_compatible(a: Mapping[str, Any], b: Mapping[str, Any]) -> bool:

@@ -1,0 +1,291 @@
+"""Central DP on the TCP tier (comm/server.py dp_clip): clipped
+round-delta uploads, server-side Gaussian noise on the mean, delta
+replies — privacy reachable from `serve`/`client`, composing with
+secure aggregation. The reference's TCP deployment has no privacy
+mechanism of any kind (reference server.py:57-65)."""
+
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+    AggregationServer,
+    FederatedClient,
+    flatten_params,
+    framing,
+    wire,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.client import (
+    connect_with_retry,
+)
+
+
+def _serve_one(server, results, deadline=20):
+    t = threading.Thread(
+        target=lambda: results.__setitem__(
+            "agg", server.serve_round(deadline=deadline)
+        )
+    )
+    t.start()
+    return t
+
+
+def _run_clients(clients, params_list, bases, results, n_samples=1):
+    def _go(i):
+        results[i] = clients[i].exchange(
+            params_list[i], n_samples=n_samples, round_base=bases[i]
+        )
+
+    ts = [threading.Thread(target=_go, args=(i,)) for i in range(len(clients))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    return ts
+
+
+def test_plain_dp_round_is_clipped_mean_of_deltas(rng):
+    """Noiseless DP round: the returned aggregate is exactly
+    base + mean(clip(delta_i)) — client 1's oversized delta is clipped,
+    client 0's small one passes through."""
+    base = {"w": np.zeros((8, 4), np.float32), "b": np.zeros(4, np.float32)}
+    small = {"w": rng.normal(size=(8, 4)).astype(np.float32) * 0.01,
+             "b": rng.normal(size=4).astype(np.float32) * 0.01}
+    big = {"w": rng.normal(size=(8, 4)).astype(np.float32) * 100.0,
+           "b": rng.normal(size=4).astype(np.float32) * 100.0}
+    clip = 1.0
+    params = [
+        {k: base[k] + small[k] for k in base},
+        {k: base[k] + big[k] for k in base},
+    ]
+    results = {}
+    with AggregationServer(
+        port=0, num_clients=2, timeout=20, dp_clip=clip,
+        dp_noise_multiplier=0.0,
+    ) as server:
+        st = _serve_one(server, results)
+        clients = [
+            FederatedClient(
+                "127.0.0.1", server.port, client_id=i, timeout=20, dp=True
+            )
+            for i in range(2)
+        ]
+        _run_clients(clients, params, [base, base], results)
+        st.join(timeout=30)
+
+    def _clip(d):
+        n = np.sqrt(sum(float((v.astype(np.float64) ** 2).sum()) for v in d.values()))
+        s = min(1.0, clip / n)
+        return {k: v * np.float32(s) for k, v in d.items()}
+
+    cs, cb = _clip(small), _clip(big)
+    for key in base:
+        want = base[key] + 0.5 * (cs[key] + cb[key])
+        np.testing.assert_allclose(
+            flatten_params(results[0])[key], want, atol=1e-5
+        )
+        np.testing.assert_array_equal(
+            flatten_params(results[0])[key], flatten_params(results[1])[key]
+        )
+    # The server's reply itself was a delta (never absolute weights).
+    agg_delta = results["agg"]
+    np.testing.assert_allclose(
+        agg_delta["w"], 0.5 * (cs["w"] + cb["w"]), atol=1e-5
+    )
+
+
+def test_dp_noise_is_calibrated(rng):
+    """With params == base (zero delta), the aggregate's deviation from
+    the base IS the Gaussian noise: per-coordinate std must match
+    multiplier * clip / n."""
+    base = {"w": np.zeros((200, 100), np.float32)}
+    clip, mult = 2.0, 0.5
+    results = {}
+    with AggregationServer(
+        port=0, num_clients=1, timeout=20, dp_clip=clip,
+        dp_noise_multiplier=mult,
+    ) as server:
+        st = _serve_one(server, results)
+        client = FederatedClient(
+            "127.0.0.1", server.port, client_id=0, timeout=20, dp=True
+        )
+        _run_clients([client], [dict(base)], [base], results)
+        st.join(timeout=30)
+    noise = flatten_params(results[0])["w"]
+    sigma = mult * clip / 1
+    assert abs(float(noise.std()) - sigma) < 0.1 * sigma
+    assert abs(float(noise.mean())) < 3 * sigma / np.sqrt(noise.size)
+
+
+def test_dp_base_mismatch_fails_the_round(rng):
+    """Clients starting from different bases must be refused — a stale
+    base would shift the mean by an unbounded gap."""
+    b0 = {"w": np.zeros((4, 4), np.float32)}
+    b1 = {"w": np.ones((4, 4), np.float32)}
+    params = [dict(b0), dict(b1)]
+    errs = {}
+    with AggregationServer(
+        port=0, num_clients=2, timeout=10, dp_clip=1.0
+    ) as server:
+
+        def _go(i, base):
+            try:
+                FederatedClient(
+                    "127.0.0.1", server.port, client_id=i, timeout=10,
+                    dp=True,
+                ).exchange(params[i], round_base=base, max_retries=1)
+            except (ConnectionError, wire.WireError) as e:
+                errs[i] = e
+
+        ts = [
+            threading.Thread(target=_go, args=(i, b), daemon=True)
+            for i, b in enumerate([b0, b1])
+        ]
+        for t in ts:
+            t.start()
+        with pytest.raises(RuntimeError, match="base mismatch"):
+            server.serve_round(deadline=8)
+        for t in ts:
+            t.join(timeout=15)
+    assert set(errs) == {0, 1}
+
+
+def test_server_enforces_the_clip(rng):
+    """A client that skips its clip cannot widen the sensitivity: the
+    server re-clips the decoded delta before aggregating (plain mode)."""
+    base_crc = wire.flat_crc32({"w": np.zeros(4, np.float32)})
+    huge = {"w": np.full(4, 100.0, np.float32)}  # norm 200 >> clip 1
+    results = {}
+    with AggregationServer(
+        port=0, num_clients=1, timeout=10, dp_clip=1.0
+    ) as server:
+        st = _serve_one(server, results, deadline=10)
+        sock = connect_with_retry("127.0.0.1", server.port, timeout=10)
+        try:
+            sock.settimeout(10)
+            adv = framing.recv_frame(sock)
+            assert bytes(adv[:4]) == wire.DP_MAGIC
+            clip, _ = struct.unpack("<dd", adv[4:])
+            assert clip == 1.0
+            framing.send_frame(
+                sock,
+                wire.encode(
+                    huge,
+                    meta={
+                        "client_id": 0, "n_samples": 1,
+                        "dp": True, "dp_base_crc": base_crc,
+                    },
+                ),
+            )
+            reply, meta = wire.decode(framing.recv_frame(sock))
+        finally:
+            sock.close()
+        st.join(timeout=20)
+    assert meta["dp_reply"] == "delta"
+    got = np.asarray(reply["w"], np.float32)
+    assert np.sqrt(float((got**2).sum())) == pytest.approx(1.0, rel=1e-5)
+
+
+@pytest.mark.parametrize("auth", [False, True])
+def test_secure_dp_composition(rng, auth):
+    """--secure-agg + central DP: masked clipped-delta uploads, noise on
+    the recovered sum — the server sees neither weights nor individual
+    deltas, yet the noiseless mean matches the plain-DP math to
+    fixed-point tolerance."""
+    auth_key = b"dp-secure" if auth else None
+    base = {"w": rng.normal(size=(6, 3)).astype(np.float32)}
+    deltas = [
+        {"w": rng.normal(size=(6, 3)).astype(np.float32) * 0.05}
+        for _ in range(2)
+    ]
+    params = [{"w": base["w"] + d["w"]} for d in deltas]
+    clip = 10.0  # no clipping bites: the mean must be the exact delta mean
+    results = {}
+    with AggregationServer(
+        port=0, num_clients=2, timeout=20, secure_agg=True,
+        dp_clip=clip, dp_noise_multiplier=0.0, auth_key=auth_key,
+    ) as server:
+        st = _serve_one(server, results)
+        clients = [
+            FederatedClient(
+                "127.0.0.1", server.port, client_id=i, timeout=20,
+                dp=True, secure_agg=True, num_clients=2, auth_key=auth_key,
+            )
+            for i in range(2)
+        ]
+        _run_clients(clients, params, [base, base], results)
+        st.join(timeout=30)
+    want = base["w"] + 0.5 * (deltas[0]["w"] + deltas[1]["w"])
+    np.testing.assert_allclose(
+        flatten_params(results[0])["w"], want, atol=1e-5
+    )
+    np.testing.assert_array_equal(
+        flatten_params(results[0])["w"], flatten_params(results[1])["w"]
+    )
+
+
+def test_dp_constructor_and_mode_guards():
+    with pytest.raises(ValueError, match="dp_clip"):
+        AggregationServer(port=0, num_clients=2, dp_noise_multiplier=1.0)
+    with pytest.raises(ValueError, match="uniform mean"):
+        AggregationServer(
+            port=0, num_clients=2, weighted=True, dp_clip=1.0
+        )
+    with pytest.raises(ValueError, match="topk"):
+        FederatedClient(
+            "h", 1, client_id=0, dp=True, compression="topk"
+        )
+    with pytest.raises(ValueError, match="round_base"):
+        FederatedClient("h", 1, client_id=0, dp=True).exchange(
+            {"w": np.zeros(2, np.float32)}
+        )
+
+
+def test_plain_client_rejected_by_dp_server(rng):
+    """A non-DP client's absolute upload must be refused by a DP server
+    (mode mismatch), not silently averaged as a 'delta'."""
+    params = {"w": np.ones(4, np.float32)}
+    errs = {}
+    with AggregationServer(
+        port=0, num_clients=1, timeout=6, dp_clip=1.0
+    ) as server:
+
+        def _client():
+            try:
+                FederatedClient(
+                    "127.0.0.1", server.port, client_id=0, timeout=6
+                ).exchange(params, max_retries=1)
+            except (ConnectionError, wire.WireError) as e:
+                errs["c"] = e
+
+        ct = threading.Thread(target=_client, daemon=True)
+        ct.start()
+        # The round itself fails (no valid DP upload ever registered) —
+        # asserted on the MAIN thread so a regression can't be swallowed.
+        with pytest.raises(RuntimeError, match="clients"):
+            server.serve_round(deadline=5)
+        ct.join(timeout=15)
+    assert "c" in errs
+
+
+def test_dp_client_fails_fast_against_non_dp_server(rng):
+    """--dp against a server without --dp-clip: no advert ever comes; the
+    client must raise a non-retryable ModeError instead of burning its
+    full retry budget at ~30s per attempt."""
+    import time
+
+    with AggregationServer(port=0, num_clients=1, timeout=5) as server:
+        client = FederatedClient(
+            "127.0.0.1", server.port, client_id=0, timeout=5, dp=True
+        )
+        t0 = time.monotonic()
+        with pytest.raises(wire.ModeError, match="DP advert"):
+            client.exchange(
+                {"w": np.zeros(2, np.float32)},
+                round_base={"w": np.zeros(2, np.float32)},
+                max_retries=5,
+            )
+        # One advert-wait (<= min(timeout, 30) = 5s), not five.
+        assert time.monotonic() - t0 < 12.0
