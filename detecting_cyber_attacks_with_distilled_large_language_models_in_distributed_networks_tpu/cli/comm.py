@@ -23,6 +23,38 @@ def _auth_key() -> bytes | None:
     return secret.encode() if secret else None
 
 
+def _client_identity_key() -> bytes | None:
+    """This client's OWN DH-identity secret (FEDTPU_CLIENT_SECRET): with
+    it set, the secure-agg key exchange binds the hello to this id —
+    no other group member can impersonate it (comm/secure.py)."""
+    secret = os.environ.get("FEDTPU_CLIENT_SECRET")
+    return secret.encode() if secret else None
+
+
+def _server_client_keys() -> dict[int, bytes] | None:
+    """Server-side registry of per-client identity secrets
+    (FEDTPU_CLIENT_SECRETS='0:alpha,1:bravo'): ids not listed are
+    refused in the secure key exchange."""
+    raw = os.environ.get("FEDTPU_CLIENT_SECRETS")
+    if not raw:
+        return None
+    keys: dict[int, bytes] = {}
+    for entry in raw.split(","):
+        cid, _, secret = entry.partition(":")
+        try:
+            keys[int(cid.strip())] = secret.encode()
+        except ValueError:
+            raise SystemExit(
+                f"malformed FEDTPU_CLIENT_SECRETS entry {entry!r} "
+                "(want 'id:secret,id:secret,...')"
+            ) from None
+        if not secret:
+            raise SystemExit(
+                f"empty secret for client {cid} in FEDTPU_CLIENT_SECRETS"
+            )
+    return keys
+
+
 # Secure aggregation needs no provisioned secret anymore: per-pair mask
 # keys come from fresh ephemeral Diffie-Hellman exchanges each round
 # (comm/secure.py), relayed through the server. The old FEDTPU_MASK_SECRET
@@ -67,6 +99,7 @@ def cmd_serve(args) -> int:
         secure_agg=bool(getattr(args, "secure_agg", False)),
         dp_clip=dp_clip,
         dp_noise_multiplier=dp_noise,
+        client_keys=_server_client_keys(),
     ) as server:
         log.info(f"[SERVER] listening on {args.host}:{server.port}")
         server.serve(rounds=rounds)
@@ -116,6 +149,7 @@ def cmd_client(args) -> int:
         secure_agg=bool(getattr(args, "secure_agg", False)),
         num_clients=cfg.fed.num_clients,
         dp=bool(getattr(args, "dp", False)),
+        client_key=_client_identity_key(),
     )
     import jax.numpy as jnp
 

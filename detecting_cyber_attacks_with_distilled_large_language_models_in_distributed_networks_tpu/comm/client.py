@@ -66,7 +66,14 @@ class FederatedClient:
         num_clients: int | None = None,
         fp_bits: int = secure.DEFAULT_FP_BITS,
         dp: bool = False,
+        client_key: bytes | None = None,
     ):
+        if client_key is not None and auth_key is None:
+            raise ValueError(
+                "client_key (per-client DH identity binding) requires "
+                "auth_key: the rest of the exchange is authenticated "
+                "under the group key"
+            )
         if dp and compression.startswith("topk"):
             raise ValueError(
                 "central DP uploads are clipped dense deltas; the sparse "
@@ -106,6 +113,10 @@ class FederatedClient:
         # (callers still see an absolute aggregate). clip/noise come from
         # the server's advert.
         self.dp = dp
+        # Per-client DH identity key (comm/secure.py threat model): tags
+        # this client's hello under its OWN key so no other group member
+        # can impersonate it; the relayed keys frame stays group-keyed.
+        self.client_key = client_key
         # Highest (per session) round this instance has already masked an
         # upload for: a later exchange() refuses a replayed advert rather
         # than masking DIFFERENT weights under the same stream.
@@ -344,8 +355,10 @@ class FederatedClient:
                     )
                     if self.auth_key is not None:
                         hello += secure.pubkey_tag(
-                            self.auth_key, session, round_no,
-                            self.client_id, pub,
+                            self.client_key
+                            if self.client_key is not None
+                            else self.auth_key,
+                            session, round_no, self.client_id, pub,
                         )
                     framing.send_frame(sock, hello)
                     keys_frame = framing.recv_frame(sock)

@@ -36,13 +36,20 @@ regenerate another pair's stream or unmask a third party's upload;
 compromising one client reveals only that client's own masks. Precise
 limits of the guarantee:
 
-* ACTIVE in-group adversaries are out of scope: the pubkey HMAC is keyed
-  by the GROUP auth key, which proves membership, not identity — a
-  malicious *client* could impersonate another id in the key exchange
-  (first-registration-wins limits this to a race, but does not remove
-  it). Binding identity needs per-client signing keys (full Bonawitz).
+* ACTIVE in-group adversaries: with per-client keys provisioned
+  (``AggregationServer(client_keys={id: key})`` +
+  ``FederatedClient(client_key=...)``; CLI ``FEDTPU_CLIENT_SECRETS`` /
+  ``FEDTPU_CLIENT_SECRET``) each DH hello is HMAC-bound by that client's
+  OWN key, so a malicious member cannot impersonate another id in the
+  key exchange — the forgery fails closed at the server. The server
+  re-tags verified keys under the group key for the relay (receivers
+  hold the group key, not each other's). With only the group key, the
+  HMAC proves membership, not identity, and the in-group impersonation
+  race remains (first-registration-wins limits, not removes, it).
 * A MALICIOUS (not just curious) server can substitute public keys in
-  transit — it also holds the group auth key. Same fix, same scope-out.
+  transit — it verifies and re-signs the relay, so per-client keys do
+  not constrain it. This is the one remaining active adversary;
+  removing it needs client-to-client signatures (full Bonawitz PKI).
 * WITHOUT a group auth key (``FEDTPU_SECRET`` unset) the exchange has no
   integrity at all: an active on-path attacker can MITM the relay and
   unmask every upload. No-auth secure-agg protects against passive

@@ -129,7 +129,14 @@ class AggregationServer:
         key_grace: float | None = None,
         dp_clip: float = 0.0,
         dp_noise_multiplier: float = 0.0,
+        client_keys: dict[int, bytes] | None = None,
     ):
+        if client_keys is not None and auth_key is None:
+            raise ValueError(
+                "client_keys (per-client DH identity binding) requires "
+                "auth_key: the wire messages and the relayed keys frame "
+                "are authenticated under the group key"
+            )
         if dp_noise_multiplier > 0.0 and dp_clip <= 0.0:
             raise ValueError("dp_noise_multiplier needs dp_clip > 0")
         if dp_clip > 0.0 and weighted:
@@ -169,6 +176,10 @@ class AggregationServer:
         self.dp_clip = float(dp_clip)
         self.dp_noise_multiplier = float(dp_noise_multiplier)
         self._dp_rng = np.random.default_rng()  # OS entropy; never seeded
+        # Per-client DH identity keys (secure.py threat model): a hello
+        # claiming id i must carry a tag under client i's OWN key, so no
+        # group member can impersonate another in the key exchange.
+        self.client_keys = dict(client_keys) if client_keys else None
         # Dropout-before-keys window: once a connected participant has
         # waited this long without the full fleet's DH hellos, the key set
         # closes at the min_clients quorum and the round proceeds without
@@ -265,13 +276,36 @@ class AggregationServer:
                 off = len(wire.PUBKEY_MAGIC)
                 hello_id = _struct.unpack("<q", hello[off : off + 8])[0]
                 pub_and_tag = hello[off + 8 :]
-                secure.check_dh_public(pub_and_tag[: secure.DH_PUB_LEN])
+                pub = pub_and_tag[: secure.DH_PUB_LEN]
+                secure.check_dh_public(pub)
                 if self.auth_key is not None:
+                    if self.client_keys is not None:
+                        # Identity binding: the tag must verify under the
+                        # CLAIMED id's own key — a member holding only its
+                        # own key (and the group key) cannot forge it.
+                        hello_key = self.client_keys.get(hello_id)
+                        if hello_key is None:
+                            raise wire.WireError(
+                                f"DH hello from client {hello_id} with no "
+                                "registered per-client key"
+                            )
+                    else:
+                        hello_key = self.auth_key
                     secure.verify_pubkey_tag(
-                        self.auth_key, self._session, rnd.round_no,
-                        hello_id, pub_and_tag[: secure.DH_PUB_LEN],
+                        hello_key, self._session, rnd.round_no,
+                        hello_id, pub,
                         pub_and_tag[secure.DH_PUB_LEN :],
                     )
+                    if self.client_keys is not None:
+                        # Re-tag under the GROUP key for the relay:
+                        # receivers hold the group key, not each other's
+                        # identity keys. (The server attests what it
+                        # verified — a malicious server could lie, which
+                        # is the documented remaining adversary.)
+                        pub_and_tag = pub + secure.pubkey_tag(
+                            self.auth_key, self._session, rnd.round_no,
+                            hello_id, pub,
+                        )
                 with rnd.lock:
                     if rnd.closed:
                         conn.close()

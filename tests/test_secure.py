@@ -612,6 +612,108 @@ def test_secure_round_survives_dropout_before_keys(rng):
         )
 
 
+def test_per_client_identity_keys_round_and_impersonation(rng):
+    """Per-client DH identity binding (VERDICT r3 #6): a round with
+    registered per-client keys completes exactly; a malicious member
+    holding the group key + its OWN key but claiming ANOTHER id fails
+    closed at the server (its forged hello is rejected, the honest
+    holder completes the round)."""
+    group = b"group-secret"
+    ckeys = {0: b"id-key-0", 1: b"id-key-1"}
+    params = [_params(rng) for _ in range(2)]
+    results = {}
+    with AggregationServer(
+        port=0, num_clients=2, timeout=20, secure_agg=True,
+        auth_key=group, client_keys=ckeys,
+    ) as server:
+        st = threading.Thread(
+            target=lambda: results.__setitem__(
+                "agg", server.serve_round(deadline=20)
+            )
+        )
+        st.start()
+
+        # The attacker: group member 1's key material, claiming id 0.
+        # Its hello tag can only be under b"id-key-1" (or the group key)
+        # — never id 0's key — so the server must drop it.
+        def _impersonate():
+            from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+                framing,
+                wire,
+            )
+            from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.client import (
+                connect_with_retry,
+            )
+            from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.secure import (
+                pubkey_tag,
+            )
+
+            sock = connect_with_retry("127.0.0.1", server.port, timeout=10)
+            try:
+                sock.settimeout(10)
+                framing.recv_frame(sock)  # nonce
+                adv = framing.recv_frame(sock)  # round advert
+                n = len(wire.ROUND_MAGIC)
+                round_no = struct.unpack("<Q", adv[n : n + 8])[0]
+                session = bytes(adv[n + 8 :])
+                _, pub = dh_keypair(entropy=b"attacker")
+                # Best available forgery: claim id 0, tag with key 1.
+                hello = (
+                    wire.PUBKEY_MAGIC + struct.pack("<q", 0) + pub
+                    + pubkey_tag(ckeys[1], session, round_no, 0, pub)
+                )
+                framing.send_frame(sock, hello)
+                try:
+                    framing.recv_frame(sock)
+                    results["forged"] = "accepted"
+                except Exception:
+                    results["forged"] = "rejected"
+            finally:
+                sock.close()
+
+        at = threading.Thread(target=_impersonate, daemon=True)
+        at.start()
+        at.join(timeout=15)
+        assert results.get("forged") == "rejected"
+
+        def _go(cid):
+            results[cid] = FederatedClient(
+                "127.0.0.1",
+                server.port,
+                client_id=cid,
+                timeout=20,
+                secure_agg=True,
+                num_clients=2,
+                auth_key=group,
+                client_key=ckeys[cid],
+            ).exchange(params[cid])
+
+        ts = [threading.Thread(target=_go, args=(c,)) for c in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        st.join(timeout=30)
+    expected = aggregate_flat([flatten_params(p) for p in params])
+    for key, arr in flatten_params(results[0]).items():
+        np.testing.assert_allclose(
+            arr, expected[key], atol=2.0 / (1 << DEFAULT_FP_BITS)
+        )
+
+
+def test_unregistered_id_refused_with_client_keys():
+    with pytest.raises(ValueError, match="auth_key"):
+        AggregationServer(
+            port=0, num_clients=2, secure_agg=True,
+            client_keys={0: b"k0", 1: b"k1"},
+        )
+    with pytest.raises(ValueError, match="auth_key"):
+        FederatedClient(
+            "h", 1, client_id=0, secure_agg=True, num_clients=2,
+            client_key=b"k0",
+        )
+
+
 def test_one_clients_keys_cannot_unmask_another_pair(rng):
     """VERDICT r2 #4 done-criterion: per-pair DH keys mean one client's
     ENTIRE key material (its private exponent, all public keys, and every
