@@ -80,7 +80,10 @@ def cmd_serve(args) -> int:
             f"({eps_zeroed:.3g}, 1e-05)-DP under zeroed-contribution "
             f"adjacency; ({eps_replace:.3g}, 1e-05)-DP under replace-one "
             f"adjacency (clip {dp_clip}, noise x{dp_noise}; full "
-            "participation, accountant exact)"
+            "participation, accountant exact). Noise caveat: float32 "
+            "Gaussian draws (OS-entropy Philox) — not hardened against "
+            "the Mironov floating-point precision attack (no discrete "
+            "Gaussian)"
         )
     elif dp_clip > 0.0:
         log.warning(
@@ -150,6 +153,7 @@ def cmd_client(args) -> int:
         num_clients=cfg.fed.num_clients,
         dp=bool(getattr(args, "dp", False)),
         client_key=_client_identity_key(),
+        min_participants=getattr(args, "min_participants", None),
     )
     import jax.numpy as jnp
 

@@ -353,6 +353,15 @@ def build_parser() -> argparse.ArgumentParser:
         "server sees only the sum and no client can unmask another pair",
     )
     p.add_argument(
+        "--min-participants",
+        type=int,
+        default=None,
+        help="secure-agg quorum floor THIS client will mask over (default: "
+        "the full fleet). Set to the server's --min-clients to opt into "
+        "dropout-recovery quorums; a keys frame below the floor is "
+        "refused without retry (anti-downgrade)",
+    )
+    p.add_argument(
         "--dp",
         action="store_true",
         help="central DP (server runs with --dp-clip): upload the clipped "

@@ -67,6 +67,7 @@ class FederatedClient:
         fp_bits: int = secure.DEFAULT_FP_BITS,
         dp: bool = False,
         client_key: bytes | None = None,
+        min_participants: int | None = None,
     ):
         if client_key is not None and auth_key is None:
             raise ValueError(
@@ -85,6 +86,30 @@ class FederatedClient:
                 "secure aggregation needs num_clients: each client must "
                 "mask against the full advertised participant set"
             )
+        # Client-side quorum floor on the secure participant set. The
+        # server's keys frame defines the round's set (dropout recovery
+        # shrinks it to a quorum); WITHOUT a client-side floor, a
+        # compromised server (or an on-path MITM in no-auth mode) could
+        # silently downgrade a client's mask-partner set to one colluding
+        # member and recover its raw update. Default: the FULL fleet —
+        # dropout-tolerant deployments opt in by setting this to the
+        # operator's intended quorum (mirror the server's min_clients).
+        if secure_agg:
+            floor = num_clients if min_participants is None else int(min_participants)
+            if not 2 <= floor <= num_clients:
+                raise ValueError(
+                    f"min_participants={min_participants} must be in "
+                    f"[2, num_clients={num_clients}]"
+                )
+            self.min_participants = floor
+        else:
+            if min_participants is not None:
+                raise ValueError(
+                    "min_participants is a secure-aggregation knob (the "
+                    "mask-partner quorum floor); it has no meaning "
+                    "without secure_agg"
+                )
+            self.min_participants = None
         self._topk_frac: float | None = None
         if compression.startswith("topk"):
             # Sparse ROUND-DELTA exchange: after the first (dense) round,
@@ -114,9 +139,12 @@ class FederatedClient:
         # the server's advert.
         self.dp = dp
         # Per-client DH identity key (comm/secure.py threat model): tags
-        # this client's hello under its OWN key so no other group member
-        # can impersonate it; the relayed keys frame stays group-keyed.
+        # this client's hello and reveal frames under its OWN key so no
+        # other group member can impersonate it; the relayed keys frame
+        # stays group-keyed. _identity_key is the single selection both
+        # tagging sites use (own key when provisioned, group otherwise).
         self.client_key = client_key
+        self._identity_key = client_key if client_key is not None else auth_key
         # Highest (per session) round this instance has already masked an
         # upload for: a later exchange() refuses a replayed advert rather
         # than masking DIFFERENT weights under the same stream.
@@ -355,9 +383,7 @@ class FederatedClient:
                     )
                     if self.auth_key is not None:
                         hello += secure.pubkey_tag(
-                            self.client_key
-                            if self.client_key is not None
-                            else self.auth_key,
+                            self._identity_key,
                             session, round_no, self.client_id, pub,
                         )
                     framing.send_frame(sock, hello)
@@ -419,11 +445,15 @@ class FederatedClient:
                     # analysis in comm/secure.py — a revealed secret only
                     # unlocks THIS round's streams for pairs whose other
                     # end contributed nothing).
+                    # Reveal frames ride this client's OWN identity key
+                    # when provisioned (comm/secure.py threat model): a
+                    # group-keyed forgery naming a victim that actually
+                    # uploaded then fails closed here.
                     dead = secure.parse_reveal_request(
                         bytes(reply),
                         session=session,
                         round_index=round_no,
-                        auth_key=self.auth_key,
+                        auth_key=self._identity_key,
                     )
                     bad = [
                         d for d in dead
@@ -440,7 +470,7 @@ class FederatedClient:
                             session=session,
                             round_index=round_no,
                             client_id=self.client_id,
-                            auth_key=self.auth_key,
+                            auth_key=self._identity_key,
                         ),
                     )
                     reply = framing.recv_frame(sock)
@@ -643,8 +673,14 @@ class FederatedClient:
         mode) each key's HMAC binding to (session, round, owner id). The
         set may be a quorum SUBSET of the fleet (the server closes the key
         set after its grace window when clients die before the exchange);
-        it must contain this client, at least one partner, and only known
-        ids — masking over it is then exactly as safe as the full fleet."""
+        it must contain this client, at least ``min_participants`` members
+        (default: the full fleet — the client-side floor that stops a
+        compromised server or MITM from shrinking a client's mask-partner
+        set to a colluding singleton), and only known ids. Masking over a
+        set meeting the operator's floor is as safe as the full fleet
+        against the module's threat model; refusing a smaller one raises
+        :class:`~.secure.SecureAggError`, which ``exchange`` does NOT
+        retry (a downgraded advert would repeat identically)."""
         import struct as _struct
 
         entry = 8 + secure.DH_PUB_LEN + (
@@ -677,6 +713,17 @@ class FederatedClient:
             raise wire.WireError(
                 f"DH keys frame covers {participants}: it must include "
                 f"this client ({self.client_id}) and at least one partner"
+            )
+        if len(seen) < self.min_participants:
+            # Fail closed and non-retryably: below the operator's floor the
+            # set may have been shrunk to colluders (downgrade attack), and
+            # a retry would receive the same set.
+            raise secure.SecureAggError(
+                f"DH keys frame covers only {len(seen)} participants "
+                f"{participants}; this client's floor is "
+                f"min_participants={self.min_participants} — refusing the "
+                "downgraded set (pass min_participants to opt into "
+                "dropout-recovery quorums)"
             )
         return participants, {
             cid: secure.dh_pair_secret(priv, pub)

@@ -41,11 +41,20 @@ limits of the guarantee:
   ``FederatedClient(client_key=...)``; CLI ``FEDTPU_CLIENT_SECRETS`` /
   ``FEDTPU_CLIENT_SECRET``) each DH hello is HMAC-bound by that client's
   OWN key, so a malicious member cannot impersonate another id in the
-  key exchange — the forgery fails closed at the server. The server
-  re-tags verified keys under the group key for the relay (receivers
-  hold the group key, not each other's). With only the group key, the
-  HMAC proves membership, not identity, and the in-group impersonation
-  race remains (first-registration-wins limits, not removes, it).
+  key exchange — the forgery fails closed at the server. Reveal
+  request/response frames likewise ride the per-client key when
+  provisioned (request tagged under the recipient survivor's key,
+  response under the sender's), so an in-group active adversary holding
+  only the group key can neither forge a REVEAL_REQ naming a victim
+  that actually uploaded (to harvest its pair secrets from survivors)
+  nor spoof a survivor's response. The server re-tags verified keys
+  under the group key for the relay (receivers hold the group key, not
+  each other's). With only the group key, the HMAC proves membership,
+  not identity, and the in-group impersonation race remains
+  (first-registration-wins limits, not removes, it). A client-side
+  ``min_participants`` floor (default: the full fleet) additionally
+  stops a compromised server/MITM from shrinking a client's
+  mask-partner set to a colluding singleton.
 * A MALICIOUS (not just curious) server can substitute public keys in
   transit — it verifies and re-signs the relay, so per-client keys do
   not constrain it. This is the one remaining active adversary;

@@ -175,7 +175,16 @@ class AggregationServer:
         # requiring every upload's dp_base_crc to be identical.
         self.dp_clip = float(dp_clip)
         self.dp_noise_multiplier = float(dp_noise_multiplier)
-        self._dp_rng = np.random.default_rng()  # OS entropy; never seeded
+        # Noise generator: Philox (counter-based, 128-bit crypto-derived
+        # keying) keyed from OS entropy, never seeded deterministically —
+        # the draw sequence is not predictable from any run artifact.
+        # Residual caveat (stated in the serve banner): the samples are
+        # float32 Gaussians, which the Mironov (2012) floating-point
+        # precision attack applies to; a fully attack-hardened mechanism
+        # would use a discrete Gaussian over the integers.
+        self._dp_rng = np.random.Generator(
+            np.random.Philox(key=int.from_bytes(os.urandom(16), "little"))
+        )
         # Per-client DH identity keys (secure.py threat model): a hello
         # claiming id i must carry a tag under client i's OWN key, so no
         # group member can impersonate another in the key exchange.
@@ -643,12 +652,16 @@ class AggregationServer:
                         f"[SERVER] secure round lost clients {dead}; "
                         f"asking {ids} to reveal their pair secrets"
                     )
-                    req = secure.build_reveal_request(
-                        dead,
-                        session=self._session,
-                        round_index=rnd.round_no,
-                        auth_key=self.auth_key,
-                    )
+                    # Reveal frames are tagged under each survivor's OWN
+                    # identity key when per-client keys are provisioned
+                    # (group key otherwise): an in-group adversary holding
+                    # only the group key can then neither forge a
+                    # REVEAL_REQ naming a victim that actually uploaded nor
+                    # spoof a survivor's response (secure.py threat model).
+                    def _reveal_key(cid: int) -> bytes | None:
+                        if self.client_keys is not None:
+                            return self.client_keys[cid]
+                        return self.auth_key
                     # Parallel per-survivor exchange with a bounded budget
                     # (same rationale as the reply fan-out below): a
                     # stalled survivor must neither block the others'
@@ -663,14 +676,22 @@ class AggregationServer:
                         conn = conns[cid]
                         try:
                             conn.settimeout(reveal_budget)
-                            framing.send_frame(conn, req)
+                            framing.send_frame(
+                                conn,
+                                secure.build_reveal_request(
+                                    dead,
+                                    session=self._session,
+                                    round_index=rnd.round_no,
+                                    auth_key=_reveal_key(cid),
+                                ),
+                            )
                             revealed[cid] = secure.parse_reveal_response(
                                 framing.recv_frame(conn),
                                 session=self._session,
                                 round_index=rnd.round_no,
                                 client_id=cid,
                                 expect_dead=dead,
-                                auth_key=self.auth_key,
+                                auth_key=_reveal_key(cid),
                             )
                             conn.settimeout(self.timeout)
                         except (

@@ -544,14 +544,18 @@ class FederatedTrainer:
             mask is not None
             and self.cfg.fed.resolve_participation_mode() == "poisson"
         )
-        # The no-op branch keys on the PURE draw being empty: a non-empty
-        # draw whose every member then crashed is a fault event and must
-        # abort loudly (same as the fixed sampler), not read as a benign
-        # sampler outcome.
-        draw_empty = poisson and float(mask.sum()) == 0.0
         gate = base_mask
         if base_mask is not None:
             mask = base_mask if mask is None else mask * base_mask
+        # The no-op branch keys on the draw gated by the STRUCTURAL
+        # base_mask (the product just computed): clients with empty
+        # shards (ragged fleets) never participate, which is a fixed,
+        # data-independent property — a draw landing only on them is the
+        # same benign sampling event as an empty draw. A non-empty
+        # effective draw whose every member then CRASHED (faults, below)
+        # is a fault event and must abort loudly (same as the fixed
+        # sampler), not read as a benign sampler outcome.
+        draw_empty = poisson and float(mask.sum()) == 0.0
         if faults is not None:
             faults = np.asarray(faults, np.float64)
             mask = faults if mask is None else mask * faults
@@ -562,9 +566,10 @@ class FederatedTrainer:
             )
         if draw_empty:
             log.info(
-                f"[FED] round {round_index + 1}: empty Poisson cohort — "
-                "aggregation skipped (no-op round; the DP accountant "
-                "already covers this branch)"
+                f"[FED] round {round_index + 1}: empty effective Poisson "
+                "cohort (no sampled client holds data) — aggregation "
+                "skipped (no-op round; the DP accountant already covers "
+                "this branch)"
             )
             return state
         return self.aggregate(

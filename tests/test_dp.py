@@ -574,3 +574,40 @@ def test_effective_participation_feeds_accountant():
     assert dp_epsilon(50, 1.0, 1e-5, sampling_rate=0.26) < dp_epsilon(
         50, 1.0, 1e-5, sampling_rate=0.5
     )
+
+
+def test_poisson_ragged_empty_effective_cohort_is_noop(eight_devices):
+    """ADVICE r4: a non-empty Poisson draw whose every member is
+    STRUCTURALLY absent (base_mask — ragged fleets where some clients
+    hold no data) is the same benign, data-independent sampling event as
+    an empty draw: a no-op round, not a zero-survivor abort. A crash
+    (faults) wiping the effective cohort still aborts loudly."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.federated import (
+        FederatedTrainer,
+    )
+
+    cfg = _tiny_cfg(
+        clients=2, participation=0.4, min_client_fraction=0.4,
+        participation_mode="poisson",
+    )
+    mesh = make_mesh(2, 1, devices=eight_devices[:2])
+    trainer = FederatedTrainer(cfg, mesh=mesh)
+    r = next(
+        r for r in range(1000)
+        if float(trainer.participation_mask(r).sum()) == 1.0
+    )
+    draw = trainer.participation_mask(r)
+    state = trainer.init_state(seed=0)
+    # The one drawn client holds no data: benign no-op, params untouched.
+    out = trainer.round_aggregate(
+        state, round_index=r, base_mask=1.0 - draw
+    )
+    assert out is state
+    # Same shape of emptiness via faults = a crashed cohort: abort.
+    with pytest.raises(RuntimeError, match="survived"):
+        trainer.round_aggregate(
+            state,
+            round_index=r,
+            base_mask=np.ones(2),
+            faults=np.zeros(2),
+        )

@@ -393,11 +393,11 @@ def test_consecutive_rounds_use_fresh_masks(rng):
 def test_client_masks_over_keys_frame_not_config(rng):
     """The keys frame, not the client's num_clients config, defines the
     mask participant set: a client configured for a 3-party fleet served
-    by a 2-party server masks over the 2-party key set and the round
-    completes with the exact mean (num_clients is only an id-validation
-    bound). This is the invariant that makes subset rounds safe — a
-    client can never mask against a set different from the keys it was
-    handed."""
+    by a 2-party server masks over the 2-party key set (having opted into
+    subset quorums via min_participants) and the round completes with the
+    exact mean (num_clients is only an id-validation bound). This is the
+    invariant that makes subset rounds safe — a client can never mask
+    against a set different from the keys it was handed."""
     params = [_params(rng) for _ in range(2)]
     results = {}
     with AggregationServer(
@@ -418,6 +418,7 @@ def test_client_masks_over_keys_frame_not_config(rng):
                 timeout=20,
                 secure_agg=True,
                 num_clients=3,  # larger than the actual fleet
+                min_participants=2,  # opt into subset quorums
             ).exchange(params[cid])
 
         ts = [threading.Thread(target=_go, args=(c,)) for c in range(2)]
@@ -470,7 +471,7 @@ def test_reveal_residual_restores_survivor_mean(rng):
         )
 
 
-def _keyed_then_dead_client(port, cid, *, died, auth_key=None):
+def _keyed_then_dead_client(port, cid, *, died, auth_key=None, tag_key=None):
     """Speak the secure protocol up to the keys frame, then vanish — the
     dropout window the reveal round exists for."""
     from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
@@ -497,7 +498,13 @@ def _keyed_then_dead_client(port, cid, *, died, auth_key=None):
         _, pub = dh_keypair()
         hello = wire.PUBKEY_MAGIC + struct.pack("<q", cid) + pub
         if auth_key is not None:
-            hello += pubkey_tag(auth_key, session, round_no, cid, pub)
+            # tag_key: the per-client identity key when the server runs
+            # with a client_keys registry (the hello must verify under
+            # the CLAIMED id's own key).
+            hello += pubkey_tag(
+                tag_key if tag_key is not None else auth_key,
+                session, round_no, cid, pub,
+            )
         framing.send_frame(sock, hello)
         framing.recv_frame(sock)  # keys frame — then die before uploading
     finally:
@@ -567,8 +574,9 @@ def test_secure_round_survives_dropout_after_keys(rng, auth):
 
 def test_secure_round_survives_dropout_before_keys(rng):
     """A client that never connects at all: the key grace window closes
-    the key set at the min_clients quorum, survivors mask over the subset,
-    and the round completes as soon as they all upload."""
+    the key set at the min_clients quorum, survivors (whose
+    min_participants floor matches the server's min_clients) mask over
+    the subset, and the round completes as soon as they all upload."""
     C = 3
     params = [_params(rng) for _ in range(C)]
     results = {}
@@ -595,6 +603,7 @@ def test_secure_round_survives_dropout_before_keys(rng):
                 timeout=20,
                 secure_agg=True,
                 num_clients=C,
+                min_participants=2,  # mirror the server's min_clients
             ).exchange(params[cid])
 
         # Client 2 never shows up.
@@ -854,3 +863,184 @@ def test_retry_after_wire_error_reuses_keypair_and_completes(rng):
     # Both attempts sent the IDENTICAL public key (per-round keypair reuse).
     assert len(pubs) == 2 and pubs[0] == pubs[1]
     srv.close()
+
+
+def test_min_participants_validation():
+    """The quorum floor must sit in [2, num_clients]; outside secure mode
+    the knob is meaningless and refused."""
+    with pytest.raises(ValueError, match="min_participants"):
+        FederatedClient(
+            "h", 1, client_id=0, secure_agg=True, num_clients=3,
+            min_participants=1,
+        )
+    with pytest.raises(ValueError, match="min_participants"):
+        FederatedClient(
+            "h", 1, client_id=0, secure_agg=True, num_clients=3,
+            min_participants=4,
+        )
+    with pytest.raises(ValueError, match="secure"):
+        FederatedClient("h", 1, client_id=0, min_participants=2)
+
+
+def test_keys_frame_below_default_floor_fails_closed(rng):
+    """Anti-downgrade (ADVICE r4 medium): with no explicit
+    min_participants a client's floor is its FULL fleet, so a server
+    handing it a shrunken participant set — the compromised-server /
+    no-auth-MITM move that reduces a client's mask partners to one
+    colluding member — is refused before any masked bytes go out, and
+    the refusal is non-retryable (exactly one connection)."""
+    import socket as socket_mod
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        recv_frame,
+        send_frame,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.wire import (
+        KEYS_MAGIC,
+        PUBKEY_MAGIC,
+        ROUND_MAGIC,
+    )
+
+    session = b"D" * 16
+    _, colluder_pub = dh_keypair(entropy=b"colluder")
+    accepts = []
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    srv.settimeout(15)
+    port = srv.getsockname()[1]
+
+    def _downgrading_server():
+        try:
+            while True:
+                conn, _ = srv.accept()
+                accepts.append(1)
+                conn.settimeout(10)
+                try:
+                    send_frame(
+                        conn, ROUND_MAGIC + struct.pack("<Q", 1) + session
+                    )
+                    hello = recv_frame(conn)
+                    pub0 = hello[len(PUBKEY_MAGIC) + 8 :]
+                    # 2-member set for a client expecting a 3-party fleet.
+                    send_frame(
+                        conn,
+                        KEYS_MAGIC
+                        + struct.pack("<q", 0) + pub0
+                        + struct.pack("<q", 1) + colluder_pub,
+                    )
+                    recv_frame(conn)  # the masked upload, if any
+                finally:
+                    conn.close()
+        except OSError:
+            pass  # listener closed: test over
+
+    t = threading.Thread(target=_downgrading_server, daemon=True)
+    t.start()
+    client = FederatedClient(
+        "127.0.0.1", port, client_id=0, timeout=10,
+        secure_agg=True, num_clients=3,  # floor defaults to the fleet: 3
+    )
+    with pytest.raises(SecureAggError, match="min_participants"):
+        client.exchange(_params(rng), max_retries=3)
+    srv.close()
+    t.join(timeout=5)
+    assert len(accepts) == 1  # refused WITHOUT retry
+
+
+def test_reveal_frames_ride_per_client_keys():
+    """Reveal request/response tags switch to the per-client identity key
+    when provisioned: a group-keyed forgery (an in-group adversary trying
+    to harvest a victim's pair secrets) does not parse under the client's
+    own key, and vice versa."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.secure import (
+        build_reveal_request,
+        build_reveal_response,
+        parse_reveal_request,
+        parse_reveal_response,
+    )
+
+    session, rnd_no = b"s" * 16, 3
+    group, own = b"group-key", b"id-key-0"
+    forged = build_reveal_request(
+        [1], session=session, round_index=rnd_no, auth_key=group
+    )
+    with pytest.raises(SecureAggError):
+        parse_reveal_request(
+            forged, session=session, round_index=rnd_no, auth_key=own
+        )
+    good = build_reveal_request(
+        [1], session=session, round_index=rnd_no, auth_key=own
+    )
+    assert parse_reveal_request(
+        good, session=session, round_index=rnd_no, auth_key=own
+    ) == [1]
+    resp = build_reveal_response(
+        {1: b"p" * 32}, session=session, round_index=rnd_no,
+        client_id=0, auth_key=own,
+    )
+    with pytest.raises(SecureAggError):
+        parse_reveal_response(
+            resp, session=session, round_index=rnd_no, client_id=0,
+            expect_dead=[1], auth_key=group,
+        )
+    assert parse_reveal_response(
+        resp, session=session, round_index=rnd_no, client_id=0,
+        expect_dead=[1], auth_key=own,
+    ) == {1: b"p" * 32}
+
+
+def test_secure_dropout_reveal_with_per_client_keys(rng):
+    """End-to-end dropout reveal under per-client identity keys: the
+    reveal exchange rides each survivor's OWN key (request under the
+    recipient's, response under the sender's) and the round completes
+    with the survivors' exact mean."""
+    C = 3
+    group = b"group-secret"
+    ckeys = {i: b"id-key-%d" % i for i in range(C)}
+    params = [_params(rng) for _ in range(C)]
+    results = {}
+    died = threading.Event()
+    with AggregationServer(
+        port=0, num_clients=C, timeout=20, secure_agg=True, min_clients=2,
+        auth_key=group, client_keys=ckeys,
+    ) as server:
+        st = threading.Thread(
+            target=lambda: results.__setitem__(
+                "agg", server.serve_round(deadline=8)
+            )
+        )
+        st.start()
+        dead = threading.Thread(
+            target=_keyed_then_dead_client,
+            args=(server.port, 2),
+            kwargs={"died": died, "auth_key": group, "tag_key": ckeys[2]},
+        )
+        dead.start()
+
+        def _go(cid):
+            results[cid] = FederatedClient(
+                "127.0.0.1",
+                server.port,
+                client_id=cid,
+                timeout=20,
+                secure_agg=True,
+                num_clients=C,
+                auth_key=group,
+                client_key=ckeys[cid],
+            ).exchange(params[cid])
+
+        ts = [threading.Thread(target=_go, args=(c,)) for c in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        st.join(timeout=30)
+        dead.join(timeout=10)
+
+    assert died.is_set() and "agg" in results
+    expected = aggregate_flat([flatten_params(p) for p in params[:2]])
+    for key, arr in flatten_params(results[0]).items():
+        np.testing.assert_allclose(
+            arr, expected[key], atol=2.0 / (1 << DEFAULT_FP_BITS)
+        )
