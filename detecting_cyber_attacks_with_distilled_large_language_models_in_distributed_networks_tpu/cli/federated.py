@@ -63,25 +63,28 @@ def cmd_federated(args) -> int:
 
     tok, cfg, pretrained = _resolve_with_pretrained(args)
     C = cfg.fed.num_clients
-    if cfg.mesh.seq > 1 and jax.process_count() > 1:
-        # Knowable from argv + process count alone: fail before the (big)
-        # data load, like every other unfittable-config case here.
-        raise SystemExit(
-            "--seq-parallel is single-host for now (the 3-axis mesh would "
-            "place the seq ring across DCN); shard clients over hosts with "
-            "the 2-axis path instead"
-        )
     if jax.process_count() > 1:
-        from ..parallel.multihost import local_client_slice, make_global_mesh
+        from ..parallel.multihost import (
+            local_client_slice,
+            make_global_mesh,
+            make_global_seq_mesh,
+        )
 
         if C != cfg.mesh.clients:
             raise SystemExit(
                 f"multi-host runs need one mesh row per client "
                 f"(num_clients={C}, mesh.clients={cfg.mesh.clients})"
             )
-        mesh = make_global_mesh(
-            cfg.mesh.clients, cfg.mesh.data, axis_names=cfg.mesh.axis_names
-        )
+        if cfg.mesh.seq > 1:
+            # --seq-parallel multi-host: clients over DCN, each client's
+            # seq ring (and data psum) inside one host's ICI domain.
+            mesh = make_global_seq_mesh(
+                cfg.mesh.clients, cfg.mesh.data, cfg.mesh.seq
+            )
+        else:
+            mesh = make_global_mesh(
+                cfg.mesh.clients, cfg.mesh.data, axis_names=cfg.mesh.axis_names
+            )
         local_sl = local_client_slice(mesh)
         log.info(
             f"[FED] process {jax.process_index()}/{jax.process_count()} owns "

@@ -94,38 +94,87 @@ def make_global_mesh(
 
     Single-process: identical to :func:`..mesh.make_mesh`.
     """
-    P = jax.process_count()
-    if P == 1:
+    if jax.process_count() == 1:
         return make_mesh(clients, data, axis_names=axis_names)
+    return Mesh(_global_grid((clients, data)), axis_names)
+
+
+def _global_grid(dims: tuple[int, ...]) -> np.ndarray:
+    """Process-major device grid for a clients-leading global mesh: the
+    one layout/validation pipeline under :func:`make_global_mesh` and
+    :func:`make_global_seq_mesh`. Client c's trailing-axes block lives
+    entirely on process ``c // (clients / process_count)``: within-client
+    collectives (data psum, seq ring) stay on-host; only the clients-axis
+    FedAvg crosses DCN."""
+    P = jax.process_count()
+    clients = dims[0]
+    shape = "x".join(map(str, dims))
     if clients % P:
         raise ValueError(
             f"clients={clients} must be a multiple of process_count={P} so "
-            "each host owns whole client replicas (FedAvg crosses DCN, the "
-            "data axis stays on-host)"
+            "each host owns whole client replicas (within-client axes stay "
+            "on-host; only FedAvg crosses DCN)"
         )
     devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
-    need = clients * data
+    need = int(np.prod(dims))
     if len(devs) != need:
         raise ValueError(
-            f"global mesh {clients}x{data} needs exactly {need} devices "
-            f"across {P} processes, have {len(devs)}"
+            f"global mesh {shape} needs exactly {need} devices across "
+            f"{P} processes, have {len(devs)}"
         )
-    per_proc = len(devs) // P
-    if (clients // P) * data != per_proc:
+    per_client = need // clients
+    if (clients // P) * per_client != len(devs) // P:
         raise ValueError(
-            f"each process must contribute (clients/P)*data = "
-            f"{(clients // P) * data} devices, has {per_proc}"
+            f"each process must contribute (clients/P) client blocks = "
+            f"{(clients // P) * per_client} devices, has {len(devs) // P}"
         )
-    grid = np.array(devs).reshape(clients, data)
-    return Mesh(grid, axis_names)
+    grid = np.array(devs).reshape(dims)
+    # Backstop the layout math (e.g. heterogeneous per-host device counts
+    # that pass the average check above): no client's within-client block
+    # may span processes — a cross-DCN ring/psum would silently serialize
+    # on the slowest link.
+    for c in range(clients):
+        block_procs = {d.process_index for d in grid[c].ravel()}
+        if len(block_procs) != 1:
+            raise ValueError(
+                f"client {c}'s within-client device block spans processes "
+                f"{sorted(block_procs)}; each client must stay on one host"
+            )
+    return grid
+
+
+def make_global_seq_mesh(
+    clients: int,
+    data: int,
+    seq: int,
+    *,
+    axis_names: tuple[str, str, str] = ("clients", "data", "seq"),
+) -> Mesh:
+    """``clients x data x seq`` mesh over ALL processes' devices, clients
+    process-major: each host owns whole client replicas, so every seq ring
+    (the latency-critical ppermute loop of ring attention) and every
+    data-axis gradient psum stay INSIDE one host's ICI domain — only the
+    FedAvg pmean over ``clients`` crosses DCN, once per round. This is the
+    flagship composition on the BASELINE north-star hardware (a v4-64:
+    multi-host by definition): clients over DCN x seq ring on ICI.
+
+    Single-process: identical to :func:`..fedseq.make_seq_mesh`.
+    """
+    if jax.process_count() == 1:
+        from .fedseq import make_seq_mesh
+
+        return make_seq_mesh(clients, data, seq, axis_names=axis_names)
+    return Mesh(_global_grid((clients, data, seq)), axis_names)
 
 
 def local_client_slice(mesh: Mesh) -> slice:
     """Which block of the stacked ``[C, ...]`` client axis this process
-    feeds. With the process-major layout of :func:`make_global_mesh`, that
-    is one contiguous slice."""
+    feeds. With the process-major layout of :func:`make_global_mesh` /
+    :func:`make_global_seq_mesh`, that is one contiguous slice. Works for
+    any mesh whose FIRST axis is ``clients`` (2-axis and 3-axis alike)."""
     C = mesh.devices.shape[0]
-    procs = [d.process_index for d in mesh.devices[:, 0]]
+    lead = mesh.devices.reshape(C, -1)[:, 0]
+    procs = [d.process_index for d in lead]
     mine = [c for c, p in enumerate(procs) if p == jax.process_index()]
     if not mine:  # a process holding no client shards feeds nothing
         return slice(0, 0)
@@ -138,22 +187,29 @@ def local_client_slice(mesh: Mesh) -> slice:
     return slice(lo, hi)
 
 
+def global_rows(
+    sharding: NamedSharding, arr: np.ndarray, num_clients: int
+) -> jax.Array:
+    """One global ``[C, ...]`` array from this process's local client block
+    ``[C_local, ...]`` (the :func:`local_client_slice` rows). The single
+    assembly primitive under :func:`global_batch` and the fedseq feed
+    (train/seqfed.py), whose per-key shardings differ.
+
+    Single-process: plain ``device_put`` (local IS global)."""
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    global_shape = (num_clients, *arr.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sharding, np.ascontiguousarray(arr), global_shape
+    )
+
+
 def global_batch(
     sharding: NamedSharding, local: Mapping[str, np.ndarray], num_clients: int
 ) -> dict[str, jax.Array]:
     """Assemble global ``[C, ...]`` arrays from this process's local client
-    block ``[C_local, ...]`` (the :func:`local_client_slice` rows).
-
-    Single-process: plain ``device_put`` (local IS global)."""
-    if jax.process_count() == 1:
-        return {k: jax.device_put(v, sharding) for k, v in local.items()}
-    out = {}
-    for k, v in local.items():
-        global_shape = (num_clients, *v.shape[1:])
-        out[k] = jax.make_array_from_process_local_data(
-            sharding, np.ascontiguousarray(v), global_shape
-        )
-    return out
+    block ``[C_local, ...]`` (the :func:`local_client_slice` rows)."""
+    return {k: global_rows(sharding, v, num_clients) for k, v in local.items()}
 
 
 def allgather_hosts(value: int) -> np.ndarray:
