@@ -103,6 +103,8 @@ def cmd_serve(args) -> int:
         dp_clip=dp_clip,
         dp_noise_multiplier=dp_noise,
         client_keys=_server_client_keys(),
+        secure_protocol=getattr(args, "secure_protocol", "double"),
+        secure_threshold=getattr(args, "secure_threshold", None),
     ) as server:
         log.info(f"[SERVER] listening on {args.host}:{server.port}")
         server.serve(rounds=rounds)
@@ -154,6 +156,8 @@ def cmd_client(args) -> int:
         dp=bool(getattr(args, "dp", False)),
         client_key=_client_identity_key(),
         min_participants=getattr(args, "min_participants", None),
+        secure_protocol=getattr(args, "secure_protocol", "double"),
+        secure_threshold=getattr(args, "secure_threshold", None),
     )
     import jax.numpy as jnp
 

@@ -305,6 +305,24 @@ def build_parser() -> argparse.ArgumentParser:
         "visible to the server",
     )
     p.add_argument(
+        "--secure-protocol",
+        choices=["double", "reveal"],
+        default="double",
+        help="dropout recovery: double (default, full Bonawitz "
+        "double-masking — Shamir-shared seeds, survives unmask-phase "
+        "dropouts, false death claims recover nothing) or reveal "
+        "(cheaper; a reveal-phase dropout fails the round). Set "
+        "identically on clients",
+    )
+    p.add_argument(
+        "--secure-threshold",
+        type=int,
+        default=None,
+        help="Shamir threshold for double-masking (default: strict "
+        "majority of the keyed participants — the value that makes the "
+        "either/or share-reveal rule binding). Set identically on clients",
+    )
+    p.add_argument(
         "--dp-clip",
         type=float,
         default=0.0,
@@ -360,6 +378,22 @@ def build_parser() -> argparse.ArgumentParser:
         "the full fleet). Set to the server's --min-clients to opt into "
         "dropout-recovery quorums; a keys frame below the floor is "
         "refused without retry (anti-downgrade)",
+    )
+    p.add_argument(
+        "--secure-protocol",
+        choices=["double", "reveal"],
+        default="double",
+        help="secure-agg dropout recovery; must match the server's "
+        "--secure-protocol (a mismatched advert is refused — downgrade "
+        "protection)",
+    )
+    p.add_argument(
+        "--secure-threshold",
+        type=int,
+        default=None,
+        help="Shamir threshold for double-masking; must match the "
+        "server's --secure-threshold (default: majority of the keyed "
+        "participants)",
     )
     p.add_argument(
         "--dp",

@@ -12,6 +12,7 @@ probe-kills-server race (SURVEY.md §5).
 
 from __future__ import annotations
 
+import os
 import socket
 import time
 from typing import Any, Mapping
@@ -68,6 +69,8 @@ class FederatedClient:
         dp: bool = False,
         client_key: bytes | None = None,
         min_participants: int | None = None,
+        secure_protocol: str = "double",
+        secure_threshold: int | None = None,
     ):
         if client_key is not None and auth_key is None:
             raise ValueError(
@@ -94,6 +97,24 @@ class FederatedClient:
         # member and recover its raw update. Default: the FULL fleet —
         # dropout-tolerant deployments opt in by setting this to the
         # operator's intended quorum (mirror the server's min_clients).
+        if secure_protocol not in ("reveal", "double"):
+            raise ValueError(
+                f"secure_protocol {secure_protocol!r} must be reveal|double"
+            )
+        if secure_threshold is not None and secure_threshold < 2:
+            raise ValueError(
+                "secure_threshold < 2 would let the server reconstruct "
+                "secrets from a single holder"
+            )
+        if (
+            secure_agg
+            and secure_protocol == "double"
+            and num_clients is not None
+            and num_clients > 254
+        ):
+            raise ValueError(
+                "double-masking Shamir x-coordinates support <= 254 clients"
+            )
         if secure_agg:
             floor = num_clients if min_participants is None else int(min_participants)
             if not 2 <= floor <= num_clients:
@@ -145,6 +166,16 @@ class FederatedClient:
         # tagging sites use (own key when provisioned, group otherwise).
         self.client_key = client_key
         self._identity_key = client_key if client_key is not None else auth_key
+        # "double" (default): full Bonawitz double-masking — the client
+        # REFUSES a server advertising the cheaper reveal protocol
+        # (downgrade protection); run both ends with the same setting.
+        self.secure_protocol = secure_protocol
+        self.secure_threshold = secure_threshold
+        # Per-(session, round) double-masking state: dealt Shamir shares
+        # (retries must resend IDENTICAL shares — the server enforces
+        # first-deal-wins), the self-mask seed, and the holder-side shares
+        # decrypted from the shareset.
+        self._round_shares: dict[tuple[bytes, int], dict] = {}
         # Highest (per session) round this instance has already masked an
         # upload for: a later exchange() refuses a replayed advert rather
         # than masking DIFFERENT weights under the same stream.
@@ -153,7 +184,7 @@ class FederatedClient:
         # re-send the identical public key (the server accepts an
         # idempotent re-hello; a fresh keypair after key distribution
         # could never cancel and would doom the round).
-        self._round_keys: dict[tuple[bytes, int], tuple[int, bytes]] = {}
+        self._round_keys: dict[tuple[bytes, int], tuple[bytes, int, bytes]] = {}
         # Sparse-delta state (topk mode): the last aggregate this client
         # received (the delta base BOTH sides agree on, keyed by the
         # server's agg_round) and the error-feedback residual.
@@ -343,7 +374,7 @@ class FederatedClient:
                     finally:
                         sock.settimeout(self.timeout)
                     n_magic = len(wire.ROUND_MAGIC)
-                    if len(adv) != n_magic + 8 + wire.SESSION_LEN or (
+                    if len(adv) != n_magic + 8 + wire.SESSION_LEN + 1 or (
                         not adv.startswith(wire.ROUND_MAGIC)
                     ):
                         raise wire.WireError("bad round advert from server")
@@ -352,7 +383,25 @@ class FederatedClient:
                         raise wire.WireError(
                             f"round advert {round_no} out of range"
                         )
-                    session = bytes(adv[n_magic + 8 :])
+                    session = bytes(
+                        adv[n_magic + 8 : n_magic + 8 + wire.SESSION_LEN]
+                    )
+                    # Protocol pin, not negotiation: a mismatch is refused
+                    # non-retryably — otherwise a malicious advert could
+                    # downgrade double-masking to the weaker reveal round.
+                    want_proto = (
+                        secure.PROTO_DOUBLE
+                        if self.secure_protocol == "double"
+                        else secure.PROTO_REVEAL
+                    )
+                    if adv[-1] != want_proto:
+                        raise secure.SecureAggError(
+                            f"server advertises secure protocol "
+                            f"{'double' if adv[-1] else 'reveal'}, this "
+                            f"client is configured for "
+                            f"{self.secure_protocol} — refusing (set "
+                            "--secure-protocol identically on both ends)"
+                        )
                     # Freshness: retries of THIS exchange may legitimately
                     # re-mask the same weights for the same (session,
                     # round); a replay of an earlier exchange's round would
@@ -374,8 +423,15 @@ class FederatedClient:
                     # after a transient wire error still completes the
                     # round instead of being dropped as a key swap.
                     if (session, round_no) not in self._round_keys:
-                        self._round_keys[(session, round_no)] = secure.dh_keypair()
-                    priv, pub = self._round_keys[(session, round_no)]
+                        # Seed-derived keypair: double-masking Shamir-shares
+                        # the seed so the fleet can reconstruct this
+                        # client's pair masks if it dies mid-round.
+                        sk_seed = os.urandom(secure.SEED_LEN)
+                        kpriv, kpub = secure.dh_keypair(entropy=sk_seed)
+                        self._round_keys[(session, round_no)] = (
+                            sk_seed, kpriv, kpub,
+                        )
+                    sk_seed, priv, pub = self._round_keys[(session, round_no)]
                     hello = (
                         wire.PUBKEY_MAGIC
                         + _struct.pack("<q", self.client_id)
@@ -394,21 +450,41 @@ class FederatedClient:
                     participants, pair_secrets = self._parse_keys_frame(
                         keys_frame, priv, session, round_no
                     )
+                    share_st = None
+                    if self.secure_protocol == "double":
+                        # Share distribution (Bonawitz §6): deal Shamir
+                        # shares of (b seed, key seed) through the server;
+                        # the share-complete set U2 becomes the mask set.
+                        share_st = self._double_share_exchange(
+                            sock, participants, pair_secrets, sk_seed,
+                            session, round_no,
+                        )
+                        mask_set = share_st["u2"]
+                    else:
+                        mask_set = participants
                     upload = secure.masked_upload(
                         flat,
                         pair_secrets=pair_secrets,
                         round_index=round_no,
                         client_id=self.client_id,
-                        participants=participants,
+                        participants=mask_set,
                         fp_bits=self.fp_bits,
                         session=session,
                     )
+                    if share_st is not None:
+                        # The self-mask: stays on this upload until the
+                        # unmask round reconstructs b from OTHER holders'
+                        # shares — what makes a false death claim useless.
+                        secure.apply_self_stream(
+                            upload, share_st["b_seed"], session, round_no,
+                            self.client_id, add=True,
+                        )
                     self._used_rounds[session] = max(prev, round_no)
                     attempt_meta.update(
                         secure=True,
                         fp_bits=self.fp_bits,
                         round=round_no,
-                        participants=len(participants),
+                        participants=len(mask_set),
                     )
                 attempt_compression = self.compression
                 delta_flat = sent_flat = None
@@ -438,7 +514,24 @@ class FederatedClient:
                 sparse_in_flight = delta_flat is not None
                 framing.send_frame(sock, msg)
                 reply = framing.recv_frame(sock)
-                if self.secure_agg and bytes(reply[:4]) == secure.REVEAL_MAGIC:
+                if (
+                    self.secure_agg
+                    and self.secure_protocol == "double"
+                    and bytes(reply[:4]) == secure.UNMASK_MAGIC
+                ):
+                    # Unmask round (every double-mask round): respond with
+                    # b-shares for ALIVE dealers and key-seed shares for
+                    # DEAD ones — never both for the same id; the parse
+                    # refuses overlapping claims, and the checks below pin
+                    # the claimed partition to this round's U2.
+                    reply = self._answer_unmask(
+                        sock, bytes(reply), share_st, session, round_no
+                    )
+                elif (
+                    self.secure_agg
+                    and self.secure_protocol == "reveal"
+                    and bytes(reply[:4]) == secure.REVEAL_MAGIC
+                ):
                     # Dropout reveal round: some keyed participant never
                     # uploaded; disclose our pair secrets with the dead so
                     # the server can cancel their mask halves (privacy
@@ -483,6 +576,18 @@ class FederatedClient:
                         "aggregated reply failed the freshness check "
                         "(stale nonce or wrong role) — possible replay"
                     )
+                if self.secure_agg:
+                    # Round complete: drop this round's (and any older)
+                    # per-round keypair/share state — _used_rounds already
+                    # forbids re-entering them, and seeds for finished
+                    # rounds must not linger in memory round after round.
+                    for store in (self._round_keys, self._round_shares):
+                        for k in [
+                            k
+                            for k in store
+                            if k[0] == session and k[1] <= round_no
+                        ]:
+                            del store[k]
                 log.info(
                     f"[CLIENT {self.client_id}] received aggregated model "
                     f"({len(reply) / 1e6:.1f} MB, clients {agg_meta.get('round_clients')})"
@@ -730,3 +835,178 @@ class FederatedClient:
             for cid, pub in seen.items()
             if cid != self.client_id
         }
+
+    def _double_share_exchange(
+        self,
+        sock,
+        participants: list[int],
+        pair_secrets: dict[int, bytes],
+        sk_seed: bytes,
+        session: bytes,
+        round_no: int,
+    ) -> dict:
+        """Double-masking share distribution: deal Shamir shares of this
+        client's self-mask seed and DH key seed to the keyed participants
+        (encrypted per holder under the pair secret), send them through
+        the server, and adopt the relayed share-complete set U2 as the
+        round's mask set. Returns the per-round share state (cached so
+        RETRIES resend byte-identical shares — the server enforces
+        first-deal-wins)."""
+        from . import shamir
+
+        t = (
+            self.secure_threshold
+            if self.secure_threshold is not None
+            else secure.majority_threshold(len(participants))
+        )
+        if not 2 <= t <= len(participants):
+            raise secure.SecureAggError(
+                f"Shamir threshold {t} infeasible for "
+                f"{len(participants)} participants"
+            )
+        key = (session, round_no)
+        st = self._round_shares.get(key)
+        if st is not None and (
+            st["participants"] != list(participants) or st["t"] != t
+        ):
+            # The keyed set is fixed once distributed; a different set on
+            # a retry means the server is playing games — fail closed.
+            raise secure.SecureAggError(
+                "keyed participant set changed across retries of one round"
+            )
+        if st is None:
+            b_seed = os.urandom(secure.SEED_LEN)
+            xs = [secure.share_x(p) for p in participants]
+            shares_b = shamir.split(b_seed, xs, t)
+            shares_sk = shamir.split(sk_seed, xs, t)
+            blobs = {
+                p: secure.encrypt_share_blob(
+                    pair_secrets[p], session, round_no,
+                    self.client_id, p,
+                    shares_b[secure.share_x(p)],
+                    shares_sk[secure.share_x(p)],
+                )
+                for p in participants
+                if p != self.client_id
+            }
+            st = {
+                "participants": list(participants),
+                "t": t,
+                "b_seed": b_seed,
+                "own_b_share": shares_b[secure.share_x(self.client_id)],
+                "commit": secure.b_seed_commitment(
+                    b_seed, session, round_no, self.client_id
+                ),
+                "blobs": blobs,
+            }
+            self._round_shares[key] = st
+        framing.send_frame(
+            sock,
+            secure.build_shares_frame(
+                self.client_id,
+                st["commit"],
+                st["blobs"],
+                threshold=t,
+                session=session,
+                round_index=round_no,
+                auth_key=(
+                    self._identity_key if self.auth_key is not None else None
+                ),
+            ),
+        )
+        u2, entries = secure.parse_shareset_frame(
+            framing.recv_frame(sock),
+            session=session,
+            round_index=round_no,
+            auth_key=(
+                self._identity_key if self.auth_key is not None else None
+            ),
+        )
+        u2_sorted = sorted(u2)
+        u2set = set(u2_sorted)
+        if self.client_id not in u2set:
+            raise secure.SecureAggError(
+                f"share-complete set {u2_sorted} excludes this client"
+            )
+        if not u2set.issubset(set(participants)):
+            raise wire.WireError(
+                f"shareset U2 {u2_sorted} is not a subset of the keyed "
+                f"participants {sorted(participants)}"
+            )
+        if len(u2_sorted) < self.min_participants:
+            raise secure.SecureAggError(
+                f"share-complete set covers only {len(u2_sorted)} "
+                f"participants {u2_sorted}; this client's floor is "
+                f"min_participants={self.min_participants} — refusing the "
+                "downgraded set"
+            )
+        if len(u2_sorted) < t:
+            # Fewer dealers than the Shamir threshold could never unmask:
+            # masking and uploading into such a round is wasted work that
+            # ends in a guaranteed server-side failure.
+            raise secure.SecureAggError(
+                f"share-complete set {u2_sorted} is smaller than the "
+                f"Shamir threshold {t} — the round could never unmask"
+            )
+        if set(entries) != u2set - {self.client_id}:
+            raise wire.WireError(
+                f"shareset entries cover dealers {sorted(entries)}, "
+                f"expected {sorted(u2set - {self.client_id})}"
+            )
+        holder_shares = {}
+        for dealer, blob in entries.items():
+            holder_shares[dealer] = secure.decrypt_share_blob(
+                pair_secrets[dealer], session, round_no,
+                dealer, self.client_id, blob,
+            )
+        st["u2"] = u2_sorted
+        st["holder_shares"] = holder_shares
+        return st
+
+    def _answer_unmask(
+        self, sock, request: bytes, share_st: dict, session: bytes,
+        round_no: int,
+    ) -> bytes:
+        """Validate an unmask request against this round's U2, answer with
+        the either/or share set, and return the next (final) reply frame."""
+        alive, dead = secure.parse_unmask_request(
+            request,
+            session=session,
+            round_index=round_no,
+            auth_key=self._identity_key,
+        )
+        u2set = set(share_st["u2"])
+        if self.client_id not in alive:
+            raise secure.SecureAggError(
+                "unmask request claims this client did not contribute — "
+                "refusing (it would expose our self-mask while the server "
+                "holds our upload)"
+            )
+        if set(alive) | set(dead) != u2set:
+            raise secure.SecureAggError(
+                f"unmask request partition alive={sorted(alive)} / "
+                f"dead={sorted(dead)} does not cover this round's "
+                f"participant set {sorted(u2set)} exactly"
+            )
+        holder = share_st["holder_shares"]
+        b_shares = {
+            d: (
+                share_st["own_b_share"]
+                if d == self.client_id
+                else holder[d][0]
+            )
+            for d in alive
+        }
+        sk_shares = {d: holder[d][1] for d in dead}
+        framing.send_frame(
+            sock,
+            secure.build_unmask_response(
+                b_shares,
+                sk_shares,
+                session=session,
+                round_index=round_no,
+                client_id=self.client_id,
+                auth_key=self._identity_key,
+            ),
+        )
+        return framing.recv_frame(sock)

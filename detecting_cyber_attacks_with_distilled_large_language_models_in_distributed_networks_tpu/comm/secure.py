@@ -63,31 +63,51 @@ limits of the guarantee:
   integrity at all: an active on-path attacker can MITM the relay and
   unmask every upload. No-auth secure-agg protects against passive
   observers only; the client logs a warning.
-* Client dropout recovery: the REVEAL-ROUND variant of Bonawitz §6 (no
-  Shamir/self-mask double-masking). Two recovery layers compose with the
-  server's ``min_clients``/deadline machinery:
+* Client dropout recovery — two protocols, selected by
+  ``secure_protocol`` and pinned by the client (a mismatched advert is
+  refused, so a malicious server cannot downgrade):
 
-  - dropout BEFORE key distribution: the key set finalizes as the quorum
-    of clients whose DH hellos arrived within the key grace window; the
-    keys frame advertises that subset and everyone masks over it.
-  - dropout AFTER key distribution but before upload: survivors are asked
-    (``REVEAL_REQ`` frame) to disclose the per-pair DH secrets they share
-    with the dead; the server regenerates those pairs' mask streams and
-    subtracts the uncancelled halves from the ring sum
-    (:func:`residual_mask_sum`), then de-quantizes over the survivors.
+  **"double" (default): full Bonawitz §6 double-masking.** Every upload
+  additionally carries a self-mask stream from a per-round seed b_i, and
+  each client Shamir-shares both b_i and its DH key seed among the keyed
+  participants at threshold t (default: strict majority), the share
+  blobs relayed through the server encrypted+MAC'd under the pair
+  secrets. Recovery layers:
 
-  Privacy cost of a reveal: per-round DH keypairs mean a revealed pair
-  secret unlocks ONLY that round's (survivor, dead) mask stream — and the
-  dead client contributed nothing to the sum, so nothing of a
-  participant's data is exposed. The known limit of skipping
-  double-masking: a MALICIOUS server that receives client j's upload yet
-  falsely declares j dead can collect j's pair secrets from the others
-  and unmask j's single upload. That is active misbehavior — outside the
-  honest-but-curious model above, where the server follows the protocol
-  — and removing it requires the full Bonawitz double-mask (each client
-  self-masks and Shamir-shares the self-mask seed). A dropout DURING the
-  reveal phase itself fails the round (survivors' secrets for the
-  newly-dead are unrecoverable without Shamir shares).
+  - dropout BEFORE key distribution: the key set U1 finalizes at the
+    quorum whose hellos arrived within the grace window (as before).
+  - dropout AFTER keys but BEFORE share distribution: the
+    share-complete set U2 finalizes at the dealers that delivered;
+    nobody has masked against the missing yet, so the round proceeds.
+  - dropout AFTER shares but before upload: the unmask round
+    reconstructs the dead client's key seed from any t holders'
+    shares (verified against its registered public key), regenerates
+    its pair masks, and subtracts them from the ring sum.
+  - dropout DURING the unmask round: tolerated while t holders keep
+    answering — reconstruction needs any t shares, not everyone.
+
+  The FALSE-DEATH attack of the reveal variant is closed: an honest
+  holder reveals, per dealer, EITHER its b-share (dealer claimed alive)
+  OR its key-seed share (claimed dead), never both — and the parse
+  refuses overlapping claims. With the majority threshold, assembling t
+  shares of both kinds for one dealer would need more answers than
+  there are holders, so a server that received client j's upload yet
+  declares j dead reconstructs j's pair masks but NOT j's self-mask:
+  the upload stays hidden. (A malicious server sending DIFFERENT
+  alive/dead partitions to different holders is bounded by the same
+  counting argument; full resistance to arbitrary active servers still
+  needs the consistency-check signatures of Bonawitz §7, out of scope
+  with the rest of the active-server vector above.) Reconstructed
+  self-mask seeds are verified against dealt commitments, so corrupted
+  shares fail loudly rather than silently skewing the aggregate.
+
+  **"reveal": the cheaper pre-r5 variant** (no shares, no self-masks;
+  an unmask round only when someone died). Survivors disclose their
+  per-pair DH secrets with the dead (``REVEAL_REQ``); per-round
+  keypairs mean a revealed secret unlocks only that round's
+  (survivor, dead) streams, and the dead contributed nothing to the
+  sum. Known limits (why "double" is the default): the false-death
+  unmask above, and a dropout DURING the reveal phase fails the round.
 """
 
 from __future__ import annotations
@@ -514,3 +534,476 @@ def aggregate_masked(
 ) -> dict[str, np.ndarray]:
     """Server-side: masked uploads (all participants!) -> float32 mean."""
     return dequantize_sum(sum_masked(models), len(models), fp_bits)
+
+
+# ---------------------------------------------- double-masking (Bonawitz §6)
+# The full construction: every upload additionally carries a SELF-mask
+# stream from a per-round seed b_i, and each client Shamir-shares (at
+# threshold t) both b_i and the seed of its per-round DH keypair among the
+# participants. The unmask round asks survivors for the b-shares of ALIVE
+# (contributing) clients and the key-seed shares of DEAD ones; the server
+# reconstructs self-masks of contributors and pair masks of the dead, and
+# subtracts both from the ring sum. Properties the reveal-round variant
+# lacked (comm/secure.py module threat model):
+#
+# * FALSE-DEATH CLOSURE: an honest holder reveals, per dealer and round,
+#   EITHER its b-share (dealer claimed alive) OR its key-seed share
+#   (claimed dead), never both. With the default majority threshold
+#   t = floor(n/2)+1, assembling t shares of BOTH kinds for one dealer
+#   would need more answers than there are holders — so a server that
+#   received client j's upload yet declares j dead can reconstruct j's
+#   pair masks but NOT j's self-mask: the upload stays hidden.
+# * UNMASK-PHASE DROPOUT: reconstruction needs any t holders, so clients
+#   may keep dying during the unmask round as long as t survive.
+#
+# Share blobs travel dealer->server->holder encrypted and MAC'd under the
+# (dealer, holder) pair secret — the server relays ciphertext it cannot
+# read or undetectably alter.
+
+SHARES_MAGIC = b"SHRS"
+SHARESET_MAGIC = b"SHST"
+UNMASK_MAGIC = b"UMRQ"
+UNMASK_RESP_MAGIC = b"UMRS"
+SEED_LEN = 32
+SHARE_BLOB_LEN = 2 * SEED_LEN + 32  # enc(b_share || sk_share) + MAC
+#: Protocol selector carried in the round advert: the reveal-round
+#: variant (cheaper: no share distribution or unmask round when nobody
+#: drops) vs full double-masking (the default).
+PROTO_REVEAL = 0
+PROTO_DOUBLE = 1
+
+
+def majority_threshold(n: int) -> int:
+    """The default Shamir threshold: a strict majority of the n
+    participants. This is what makes the either/or reveal rule binding —
+    t-of-both-kinds would need > n answers."""
+    return n // 2 + 1
+
+
+def share_x(client_id: int) -> int:
+    """A client's fixed share x-coordinate (ids must stay < 255)."""
+    cid = int(client_id)
+    if not 0 <= cid < 255:
+        raise SecureAggError(
+            f"double-masking supports client ids 0..254, got {cid}"
+        )
+    return cid + 1
+
+
+def apply_self_stream(
+    out: dict[str, np.ndarray],
+    seed: bytes,
+    session: bytes,
+    round_index: int,
+    client_id: int,
+    *,
+    add: bool,
+) -> None:
+    """Add/subtract client ``client_id``'s self-mask stream (PRG keyed by
+    its per-round seed b_i) into ``out`` in place — same sorted-tensor
+    draw order as the pair streams, so server-side reconstruction expands
+    identically."""
+    if len(seed) != SEED_LEN:
+        raise SecureAggError(f"self-mask seed has length {len(seed)}")
+    digest = hashlib.sha256(
+        _DOMAIN + b"-self" + seed + session
+        + struct.pack("<Qq", round_index, int(client_id))
+    ).digest()
+    rng = np.random.Generator(
+        np.random.Philox(key=int.from_bytes(digest[:16], "little"))
+    )
+    for key in sorted(out):
+        stream = rng.integers(
+            0, 2**64, size=out[key].shape, dtype=np.uint64, endpoint=False
+        )
+        if add:
+            out[key] += stream
+        else:
+            out[key] -= stream
+
+
+def self_mask_sum(
+    template: Mapping[str, np.ndarray],
+    seeds: Mapping[int, bytes],
+    *,
+    session: bytes,
+    round_index: int,
+) -> dict[str, np.ndarray]:
+    """The summed self-mask streams of the given (client id -> b seed)
+    set — what the server subtracts for the round's contributors."""
+    out = {
+        k: np.zeros_like(np.asarray(template[k], np.uint64))
+        for k in sorted(template)
+    }
+    for cid, seed in sorted(seeds.items()):
+        apply_self_stream(out, seed, session, round_index, cid, add=True)
+    return out
+
+
+def _share_keys(
+    pair_secret: bytes, session: bytes, round_index: int,
+    dealer: int, holder: int,
+) -> tuple[bytes, bytes]:
+    """(keystream, MAC key) for one share blob, domain-separated from the
+    mask streams and bound to (session, round, dealer, holder)."""
+    ctx = session + struct.pack("<Qqq", round_index, int(dealer), int(holder))
+    stream = hashlib.shake_256(
+        _DOMAIN + b"-shenc" + pair_secret + ctx
+    ).digest(2 * SEED_LEN)
+    mac_key = hashlib.sha256(
+        _DOMAIN + b"-shmac" + pair_secret + ctx
+    ).digest()
+    return stream, mac_key
+
+
+def encrypt_share_blob(
+    pair_secret: bytes,
+    session: bytes,
+    round_index: int,
+    dealer: int,
+    holder: int,
+    b_share: bytes,
+    sk_share: bytes,
+) -> bytes:
+    """Encrypt-and-MAC one (b-share, key-seed-share) pair for its holder.
+    The relaying server sees ciphertext only; tampering fails the MAC at
+    the holder."""
+    import hmac
+
+    if len(b_share) != SEED_LEN or len(sk_share) != SEED_LEN:
+        raise SecureAggError("share blobs carry two 32-byte shares")
+    stream, mac_key = _share_keys(
+        pair_secret, session, round_index, dealer, holder
+    )
+    pt = b_share + sk_share
+    ct = bytes(a ^ b for a, b in zip(pt, stream))
+    return ct + hmac.new(mac_key, ct, hashlib.sha256).digest()
+
+
+def decrypt_share_blob(
+    pair_secret: bytes,
+    session: bytes,
+    round_index: int,
+    dealer: int,
+    holder: int,
+    blob: bytes,
+) -> tuple[bytes, bytes]:
+    """Verify + decrypt a relayed share blob -> (b_share, sk_share)."""
+    import hmac
+
+    if len(blob) != SHARE_BLOB_LEN:
+        raise SecureAggError(f"share blob has length {len(blob)}")
+    stream, mac_key = _share_keys(
+        pair_secret, session, round_index, dealer, holder
+    )
+    ct, tag = blob[: 2 * SEED_LEN], blob[2 * SEED_LEN :]
+    if not hmac.compare_digest(tag, hmac.new(mac_key, ct, hashlib.sha256).digest()):
+        raise SecureAggError(
+            f"share blob from dealer {dealer} failed its authenticity "
+            "check — possible relay tampering"
+        )
+    pt = bytes(a ^ b for a, b in zip(ct, stream))
+    return pt[:SEED_LEN], pt[SEED_LEN:]
+
+
+def _unmask_tag(auth_key: bytes, kind: bytes, session: bytes,
+                round_index: int, body: bytes) -> bytes:
+    import hmac
+
+    return hmac.new(
+        auth_key,
+        _DOMAIN + kind + session + struct.pack("<Q", round_index) + body,
+        hashlib.sha256,
+    ).digest()
+
+
+def build_unmask_request(
+    alive: Sequence[int], dead: Sequence[int], *, session: bytes,
+    round_index: int, auth_key: bytes | None = None,
+) -> bytes:
+    """Server -> survivor: 'reveal b-shares for these alive (contributing)
+    dealers and key-seed shares for these dead ones'."""
+    a = sorted(set(int(x) for x in alive))
+    d = sorted(set(int(x) for x in dead))
+    body = (
+        struct.pack("<I", len(a)) + b"".join(struct.pack("<q", i) for i in a)
+        + struct.pack("<I", len(d)) + b"".join(struct.pack("<q", i) for i in d)
+    )
+    msg = UNMASK_MAGIC + body
+    if auth_key is not None:
+        msg += _unmask_tag(auth_key, b"-uq", session, round_index, body)
+    return msg
+
+
+def parse_unmask_request(
+    frame: bytes, *, session: bytes, round_index: int,
+    auth_key: bytes | None = None,
+) -> tuple[list[int], list[int]]:
+    """Validate + parse -> (alive ids, dead ids). Refuses overlap — an id
+    claimed both alive and dead is exactly the both-kinds harvest the
+    either/or rule exists to stop."""
+    import hmac
+
+    if not frame.startswith(UNMASK_MAGIC):
+        raise SecureAggError("not an unmask request")
+    body_end = len(frame) - (_TAG_LEN if auth_key is not None else 0)
+    body = frame[len(UNMASK_MAGIC) : body_end]
+    if auth_key is not None and not hmac.compare_digest(
+        frame[body_end:],
+        _unmask_tag(auth_key, b"-uq", session, round_index, body),
+    ):
+        raise SecureAggError("unmask request failed its authenticity check")
+    if len(body) < 8:
+        raise SecureAggError("truncated unmask request")
+    (na,) = struct.unpack("<I", body[:4])
+    off = 4 + 8 * na
+    if len(body) < off + 4:
+        raise SecureAggError("malformed unmask request body")
+    alive = list(struct.unpack(f"<{na}q", body[4:off]))
+    (nd,) = struct.unpack("<I", body[off : off + 4])
+    if len(body) != off + 4 + 8 * nd:
+        raise SecureAggError("malformed unmask request body")
+    dead = list(struct.unpack(f"<{nd}q", body[off + 4 :]))
+    if len(set(alive)) != na or len(set(dead)) != nd:
+        raise SecureAggError("duplicate ids in unmask request")
+    both = set(alive) & set(dead)
+    if both:
+        raise SecureAggError(
+            f"unmask request claims clients {sorted(both)} both alive and "
+            "dead — refusing (both-kinds share harvest)"
+        )
+    if not alive:
+        raise SecureAggError("unmask request with no alive clients")
+    return alive, dead
+
+
+def build_unmask_response(
+    b_shares: Mapping[int, bytes],
+    sk_shares: Mapping[int, bytes],
+    *,
+    session: bytes,
+    round_index: int,
+    client_id: int,
+    auth_key: bytes | None = None,
+) -> bytes:
+    """Survivor -> server: this holder's shares, kind-tagged (0 = b-share
+    of an alive dealer, 1 = key-seed share of a dead dealer)."""
+    entries = []
+    for d in sorted(b_shares):
+        entries.append(struct.pack("<qB", int(d), 0) + b_shares[d])
+    for d in sorted(sk_shares):
+        entries.append(struct.pack("<qB", int(d), 1) + sk_shares[d])
+    body = struct.pack("<I", len(entries)) + b"".join(entries)
+    msg = UNMASK_RESP_MAGIC + body
+    if auth_key is not None:
+        msg += _unmask_tag(
+            auth_key, b"-ua" + struct.pack("<q", int(client_id)),
+            session, round_index, body,
+        )
+    return msg
+
+
+def parse_unmask_response(
+    frame: bytes, *, session: bytes, round_index: int, client_id: int,
+    expect_alive: Sequence[int], expect_dead: Sequence[int],
+    auth_key: bytes | None = None,
+) -> tuple[dict[int, bytes], dict[int, bytes]]:
+    """Validate + parse -> ({alive dealer: b-share}, {dead dealer:
+    sk-share}); the covered sets must match the request exactly."""
+    import hmac
+
+    if not frame.startswith(UNMASK_RESP_MAGIC):
+        raise SecureAggError("not an unmask response")
+    body_end = len(frame) - (_TAG_LEN if auth_key is not None else 0)
+    body = frame[len(UNMASK_RESP_MAGIC) : body_end]
+    if auth_key is not None and not hmac.compare_digest(
+        frame[body_end:],
+        _unmask_tag(
+            auth_key, b"-ua" + struct.pack("<q", int(client_id)),
+            session, round_index, body,
+        ),
+    ):
+        raise SecureAggError(
+            f"unmask response from client {client_id} failed its "
+            "authenticity check"
+        )
+    if len(body) < 4:
+        raise SecureAggError("truncated unmask response")
+    (n,) = struct.unpack("<I", body[:4])
+    entry = 8 + 1 + SEED_LEN
+    if len(body) != 4 + n * entry:
+        raise SecureAggError("malformed unmask response body")
+    b_shares: dict[int, bytes] = {}
+    sk_shares: dict[int, bytes] = {}
+    for off in range(4, len(body), entry):
+        d, kind = struct.unpack("<qB", body[off : off + 9])
+        y = body[off + 9 : off + entry]
+        if kind == 0:
+            if d in b_shares:
+                raise SecureAggError(f"duplicate b-share for dealer {d}")
+            b_shares[d] = y
+        elif kind == 1:
+            if d in sk_shares:
+                raise SecureAggError(f"duplicate sk-share for dealer {d}")
+            sk_shares[d] = y
+        else:
+            raise SecureAggError(f"unknown share kind {kind}")
+    if sorted(b_shares) != sorted(set(int(x) for x in expect_alive)):
+        raise SecureAggError(
+            f"unmask response b-shares cover {sorted(b_shares)}, expected "
+            f"{sorted(expect_alive)}"
+        )
+    if sorted(sk_shares) != sorted(set(int(x) for x in expect_dead)):
+        raise SecureAggError(
+            f"unmask response sk-shares cover {sorted(sk_shares)}, "
+            f"expected {sorted(expect_dead)}"
+        )
+    return b_shares, sk_shares
+
+
+def b_seed_commitment(
+    b_seed: bytes, session: bytes, round_index: int, dealer: int
+) -> bytes:
+    """Public commitment to a dealer's self-mask seed, carried in its
+    SHARES frame: the server verifies the Shamir reconstruction against
+    it, so corrupted/inconsistent shares fail loudly instead of silently
+    skewing the aggregate. (The key seed needs no extra commitment — its
+    reconstruction is verified against the dealer's registered DH public
+    key.)"""
+    return hashlib.sha256(
+        _DOMAIN + b"-bcommit" + b_seed + session
+        + struct.pack("<Qq", round_index, int(dealer))
+    ).digest()
+
+
+def build_shares_frame(
+    dealer: int,
+    commit: bytes,
+    blobs: Mapping[int, bytes],
+    *,
+    threshold: int,
+    session: bytes,
+    round_index: int,
+    auth_key: bytes | None = None,
+) -> bytes:
+    """Dealer -> server: encrypted (b-share, key-seed-share) blobs for
+    every other participant, the b-seed commitment, and the Shamir
+    threshold the shares were dealt at (the server validates it against
+    its own effective threshold — a mismatch could never reconstruct)."""
+    if not 2 <= int(threshold) <= 254:
+        raise SecureAggError(f"threshold {threshold} out of range [2, 254]")
+    body = struct.pack("<qB", int(dealer), int(threshold)) + commit + struct.pack(
+        "<I", len(blobs)
+    )
+    for holder in sorted(blobs):
+        blob = blobs[holder]
+        if len(blob) != SHARE_BLOB_LEN:
+            raise SecureAggError(f"share blob for holder {holder} malformed")
+        body += struct.pack("<q", int(holder)) + blob
+    msg = SHARES_MAGIC + body
+    if auth_key is not None:
+        msg += _unmask_tag(auth_key, b"-sh", session, round_index, body)
+    return msg
+
+
+def parse_shares_frame(
+    frame: bytes,
+    *,
+    session: bytes,
+    round_index: int,
+    auth_key: bytes | None = None,
+) -> tuple[int, int, bytes, dict[int, bytes]]:
+    """-> (dealer id, threshold, b-seed commitment, {holder: blob}).
+    ``auth_key`` is the DEALER's identity key when per-client keys are
+    provisioned (the caller looks it up from the claimed dealer id before
+    verifying)."""
+    import hmac
+
+    if not frame.startswith(SHARES_MAGIC):
+        raise SecureAggError("not a shares frame")
+    body_end = len(frame) - (_TAG_LEN if auth_key is not None else 0)
+    body = frame[len(SHARES_MAGIC) : body_end]
+    if auth_key is not None and not hmac.compare_digest(
+        frame[body_end:],
+        _unmask_tag(auth_key, b"-sh", session, round_index, body),
+    ):
+        raise SecureAggError("shares frame failed its authenticity check")
+    if len(body) < 9 + 32 + 4:
+        raise SecureAggError("truncated shares frame")
+    dealer, threshold = struct.unpack("<qB", body[:9])
+    commit = body[9:41]
+    (n,) = struct.unpack("<I", body[41:45])
+    entry = 8 + SHARE_BLOB_LEN
+    if len(body) != 45 + n * entry:
+        raise SecureAggError("malformed shares frame body")
+    blobs: dict[int, bytes] = {}
+    for off in range(45, len(body), entry):
+        (holder,) = struct.unpack("<q", body[off : off + 8])
+        if holder in blobs:
+            raise SecureAggError(f"duplicate holder {holder} in shares frame")
+        blobs[holder] = body[off + 8 : off + entry]
+    return dealer, threshold, commit, blobs
+
+
+def build_shareset_frame(
+    share_set: Sequence[int],
+    entries: Mapping[int, bytes],
+    *,
+    session: bytes,
+    round_index: int,
+    auth_key: bytes | None = None,
+) -> bytes:
+    """Server -> holder: the round's share-complete participant set U2
+    (the set everyone masks over) plus this holder's encrypted share
+    blobs from every other dealer in U2."""
+    u2 = sorted(set(int(x) for x in share_set))
+    body = struct.pack("<I", len(u2)) + b"".join(
+        struct.pack("<q", i) for i in u2
+    ) + struct.pack("<I", len(entries))
+    for dealer in sorted(entries):
+        body += struct.pack("<q", int(dealer)) + entries[dealer]
+    msg = SHARESET_MAGIC + body
+    if auth_key is not None:
+        msg += _unmask_tag(auth_key, b"-ss", session, round_index, body)
+    return msg
+
+
+def parse_shareset_frame(
+    frame: bytes,
+    *,
+    session: bytes,
+    round_index: int,
+    auth_key: bytes | None = None,
+) -> tuple[list[int], dict[int, bytes]]:
+    """-> (U2 ids, {dealer: blob for this holder})."""
+    import hmac
+
+    if not frame.startswith(SHARESET_MAGIC):
+        raise SecureAggError("not a shareset frame")
+    body_end = len(frame) - (_TAG_LEN if auth_key is not None else 0)
+    body = frame[len(SHARESET_MAGIC) : body_end]
+    if auth_key is not None and not hmac.compare_digest(
+        frame[body_end:],
+        _unmask_tag(auth_key, b"-ss", session, round_index, body),
+    ):
+        raise SecureAggError("shareset frame failed its authenticity check")
+    if len(body) < 4:
+        raise SecureAggError("truncated shareset frame")
+    (nu,) = struct.unpack("<I", body[:4])
+    off = 4 + 8 * nu
+    if len(body) < off + 4:
+        raise SecureAggError("malformed shareset frame body")
+    u2 = list(struct.unpack(f"<{nu}q", body[4:off]))
+    if len(set(u2)) != nu:
+        raise SecureAggError("duplicate ids in shareset U2")
+    (m,) = struct.unpack("<I", body[off : off + 4])
+    entry = 8 + SHARE_BLOB_LEN
+    if len(body) != off + 4 + m * entry:
+        raise SecureAggError("malformed shareset frame body")
+    entries: dict[int, bytes] = {}
+    for e in range(off + 4, len(body), entry):
+        (dealer,) = struct.unpack("<q", body[e : e + 8])
+        if dealer in entries:
+            raise SecureAggError(f"duplicate dealer {dealer} in shareset")
+        entries[dealer] = body[e + 8 : e + entry]
+    return u2, entries

@@ -88,6 +88,13 @@ class _Round:
     pubkeys: dict[int, bytes] = field(default_factory=dict)
     key_set: list | None = None  # sorted ids the keys frame covered
     keys_ready: threading.Event = field(default_factory=threading.Event)
+    # Double-masking (secure_protocol="double"): each dealer's encrypted
+    # share blobs ({holder: blob}) + its b-seed commitment; U2 (share_set)
+    # is the share-complete subset everyone masks over.
+    share_blobs: dict[int, dict] = field(default_factory=dict)
+    share_commits: dict[int, bytes] = field(default_factory=dict)
+    share_set: list | None = None
+    shares_ready: threading.Event = field(default_factory=threading.Event)
     # Central DP: each upload's declared round-base crc; the round only
     # aggregates when all are identical (a common anchor is what makes
     # the clipped-delta mean well-defined).
@@ -130,6 +137,8 @@ class AggregationServer:
         dp_clip: float = 0.0,
         dp_noise_multiplier: float = 0.0,
         client_keys: dict[int, bytes] | None = None,
+        secure_protocol: str = "double",
+        secure_threshold: int | None = None,
     ):
         if client_keys is not None and auth_key is None:
             raise ValueError(
@@ -154,6 +163,19 @@ class AggregationServer:
                 "secure aggregation needs min_clients >= 2: a lone "
                 "survivor's 'sum' is its raw update"
             )
+        if secure_protocol not in ("reveal", "double"):
+            raise ValueError(
+                f"secure_protocol {secure_protocol!r} must be reveal|double"
+            )
+        if secure_agg and secure_protocol == "double" and num_clients > 254:
+            raise ValueError(
+                "double-masking Shamir x-coordinates support <= 254 clients"
+            )
+        if secure_threshold is not None and secure_threshold < 2:
+            raise ValueError(
+                "secure_threshold < 2 would let the server reconstruct "
+                "secrets from a single holder"
+            )
         if compression.startswith("topk"):
             raise ValueError(
                 "topk is an upload-side (sparse round-delta) compression; "
@@ -166,6 +188,15 @@ class AggregationServer:
         self.compression = compression
         self.auth_key = auth_key
         self.secure_agg = secure_agg
+        # "double" (default): full Bonawitz double-masking — self-mask +
+        # Shamir-shared seeds, unmask round every round; closes the
+        # false-death unmask and survives dropouts during unmasking.
+        # "reveal": the cheaper reveal-round variant (no share
+        # distribution; a dropout during its reveal fails the round).
+        self.secure_protocol = secure_protocol
+        # Shamir threshold; None = strict majority of the round's U2 (the
+        # default that makes the either/or share-reveal rule binding).
+        self.secure_threshold = secure_threshold
         self.fp_bits = fp_bits
         # Central DP (dp_clip > 0): uploads must be clipped round deltas
         # (the client flag --dp; the advert carries clip+noise); the
@@ -263,15 +294,24 @@ class AggregationServer:
                     ),
                 )
             if self.secure_agg:
-                # Advertise (round, session) so every participant keys its
-                # mask streams identically — and freshly — for this round.
+                # Advertise (round, session, protocol) so every participant
+                # keys its mask streams identically — and freshly — for
+                # this round, and speaks the same recovery protocol. The
+                # client REFUSES a protocol differing from its own config
+                # (a malicious advert can't downgrade double -> reveal).
                 import struct as _struct
 
+                proto = (
+                    secure.PROTO_DOUBLE
+                    if self.secure_protocol == "double"
+                    else secure.PROTO_REVEAL
+                )
                 framing.send_frame(
                     conn,
                     wire.ROUND_MAGIC
                     + _struct.pack("<Q", rnd.round_no)
-                    + self._session,
+                    + self._session
+                    + bytes([proto]),
                 )
                 # DH relay: collect this client's ephemeral public key,
                 # wait for the full fleet's, then hand everyone the whole
@@ -415,6 +455,11 @@ class AggregationServer:
                     conn.close()
                     return
                 framing.send_frame(conn, wire.KEYS_MAGIC + entries)
+                if self.secure_protocol == "double":
+                    if not self._shares_exchange(
+                        conn, rnd, hello_id, key_set, deadline
+                    ):
+                        return
             payload = framing.recv_frame(conn)
             flat, meta = wire.decode(payload, auth_key=self.auth_key)
             if self.auth_key is not None and (
@@ -497,15 +542,20 @@ class AggregationServer:
                         f"fp_bits={self.fp_bits}: de-quantization would be wrong"
                     )
                 with rnd.lock:
-                    n_keyed = len(rnd.key_set or [])
-                if int(meta.get("participants", -1)) != n_keyed:
+                    mask_set = (
+                        rnd.share_set
+                        if self.secure_protocol == "double"
+                        else rnd.key_set
+                    )
+                    n_mask = len(mask_set or [])
+                if int(meta.get("participants", -1)) != n_mask:
                     # A client masking against a different participant set
                     # would carry uncancelled pair masks — the sum would
                     # silently de-quantize to ring noise.
                     raise wire.WireError(
                         f"secure upload masked for "
                         f"{meta.get('participants')} participants, server "
-                        f"distributed keys to {n_keyed}"
+                        f"distributed the round's mask set to {n_mask}"
                     )
                 if int(meta.get("round", -1)) != rnd.round_no:
                     raise wire.WireError(
@@ -571,6 +621,315 @@ class AggregationServer:
             log.info(f"[SERVER] upload failed: {e}")
             conn.close()
 
+    def _client_wire_key(self, cid: int) -> bytes | None:
+        """The key server<->client control frames (reveal/unmask/shares)
+        ride for ``cid``: its per-client identity key when provisioned,
+        the group key otherwise (comm/secure.py threat model)."""
+        if self.client_keys is not None:
+            return self.client_keys[cid]
+        return self.auth_key
+
+    def _shares_exchange(
+        self,
+        conn: socket.socket,
+        rnd: _Round,
+        hello_id: int,
+        key_set: list,
+        deadline: float,
+    ) -> bool:
+        """Double-masking share distribution for one connection: collect
+        this dealer's encrypted share blobs, wait (grace-bounded) for the
+        keyed fleet's, close U2, relay this holder's shareset. Returns
+        False when the connection was dropped (late/conflicting dealer or
+        a holder outside U2)."""
+        frame = framing.recv_frame(conn)
+        dealer, dealt_t, commit, blobs = secure.parse_shares_frame(
+            frame,
+            session=self._session,
+            round_index=rnd.round_no,
+            auth_key=(
+                self._client_wire_key(hello_id)
+                if self.auth_key is not None
+                else None
+            ),
+        )
+        if dealer != hello_id:
+            raise wire.WireError(
+                f"shares frame claims dealer {dealer} on client "
+                f"{hello_id}'s connection"
+            )
+        # Both ends derive t from U1 (key_set) — majority by default, or
+        # the operator's explicit threshold set identically on both. A
+        # mismatched degree could never reconstruct, so fail it now.
+        want_t = (
+            self.secure_threshold
+            if self.secure_threshold is not None
+            else secure.majority_threshold(len(key_set))
+        )
+        if dealt_t != want_t:
+            raise wire.WireError(
+                f"client {hello_id} dealt shares at threshold {dealt_t}, "
+                f"server expects {want_t} (set secure_threshold "
+                "identically on both ends)"
+            )
+        # U2 must stay >= t: fewer dealers than the Shamir threshold could
+        # never unmask, so closing such a round would doom it AFTER all
+        # the masking/upload work — refuse at the quorum close instead.
+        share_floor = max(2, self.min_clients, want_t)
+        want = set(key_set) - {hello_id}
+        if set(blobs) != want:
+            raise wire.WireError(
+                f"shares frame covers holders {sorted(blobs)}, expected "
+                f"every other keyed participant {sorted(want)}"
+            )
+        with rnd.lock:
+            if rnd.closed:
+                conn.close()
+                return False
+            prev = rnd.share_blobs.get(hello_id)
+            if prev is not None and (
+                prev != blobs or rnd.share_commits.get(hello_id) != commit
+            ):
+                # Like a conflicting DH hello: first deal wins — different
+                # shares for the same dealer could never reconstruct.
+                log.info(
+                    f"[SERVER] conflicting shares from client {hello_id}; "
+                    "dropping connection"
+                )
+                conn.close()
+                return False
+            if prev is None and rnd.shares_ready.is_set():
+                log.info(
+                    f"[SERVER] late shares from client {hello_id} after "
+                    "shareset distribution; dropping connection"
+                )
+                conn.close()
+                return False
+            rnd.share_blobs[hello_id] = blobs
+            rnd.share_commits[hello_id] = commit
+            if set(key_set).issubset(rnd.share_blobs):
+                rnd.share_set = sorted(rnd.share_blobs)
+                rnd.shares_ready.set()
+        # Wait for the fleet's shares — after the grace window, close U2
+        # at the quorum that dealt (dropout-after-keys-before-shares
+        # recovery: nobody masked against the missing yet, so the round
+        # simply proceeds over the dealers).
+        grace_end = time.monotonic() + self.key_grace
+        while not rnd.shares_ready.is_set():
+            now = time.monotonic()
+            if now >= deadline:
+                raise wire.WireError(
+                    "round deadline passed waiting for the remaining "
+                    "participants' secret shares"
+                )
+            wait_until = grace_end if now < grace_end else deadline
+            if rnd.shares_ready.wait(timeout=max(0.0, wait_until - now)):
+                break
+            with rnd.lock:
+                if (
+                    not rnd.shares_ready.is_set()
+                    and time.monotonic() >= grace_end
+                    and len(rnd.share_blobs) >= share_floor
+                ):
+                    rnd.share_set = sorted(rnd.share_blobs)
+                    rnd.shares_ready.set()
+                    log.info(
+                        f"[SERVER] share grace expired; closing U2 at "
+                        f"quorum {rnd.share_set}"
+                    )
+                    break
+        with rnd.lock:
+            u2 = list(rnd.share_set or [])
+            entries = {
+                d: rnd.share_blobs[d][hello_id] for d in u2 if d != hello_id
+            }
+        if hello_id not in u2:
+            log.info(
+                f"[SERVER] client {hello_id} missed the share set {u2}; "
+                "dropping connection"
+            )
+            conn.close()
+            return False
+        framing.send_frame(
+            conn,
+            secure.build_shareset_frame(
+                u2,
+                entries,
+                session=self._session,
+                round_index=rnd.round_no,
+                auth_key=(
+                    self._client_wire_key(hello_id)
+                    if self.auth_key is not None
+                    else None
+                ),
+            ),
+        )
+        return True
+
+    def _aggregate_double(
+        self,
+        rnd: _Round,
+        models: dict[int, dict],
+        conns: dict[int, socket.socket],
+    ) -> dict:
+        """Double-masking round completion: one unmask round (EVERY round
+        — self-masks never cancel on their own), Shamir reconstruction of
+        contributors' self-mask seeds and dead participants' key seeds,
+        then residue subtraction and de-quantization over contributors.
+
+        Tolerates further dropouts during unmasking: any ``t`` responders
+        suffice (t = secure_threshold, default majority of U2)."""
+        from . import shamir
+
+        with rnd.lock:
+            u2 = list(rnd.share_set or [])
+            u1 = list(rnd.key_set or [])
+            commits = dict(rnd.share_commits)
+            pubs = {
+                cid: rnd.pubkeys[cid][: secure.DH_PUB_LEN]
+                for cid in rnd.pubkeys
+            }
+        alive = sorted(models)
+        extra = [i for i in alive if i not in u2]
+        if extra:
+            raise RuntimeError(
+                f"secure uploads from clients {extra} outside the share "
+                f"set {u2}"
+            )
+        dead = [i for i in u2 if i not in alive]
+        # t derives from U1 — the set the shares were DEALT over (their
+        # polynomial degree is fixed there); U2 only selects who masked.
+        t = (
+            self.secure_threshold
+            if self.secure_threshold is not None
+            else secure.majority_threshold(len(u1))
+        )
+        if len(alive) < t:
+            # Unmask needs t responders and only contributors hold open
+            # connections — fail with the real cause before burning an
+            # unmask round that cannot succeed.
+            raise RuntimeError(
+                f"only {len(alive)} secure uploads survived, below the "
+                f"Shamir threshold {t} — the self-masks cannot be "
+                "reconstructed (dropouts exceeded the double-masking "
+                "tolerance)"
+            )
+        budget = min(self.timeout, 30.0)
+        responses: dict[int, tuple] = {}
+        errs: dict[int, Exception] = {}
+
+        def _ask(cid: int) -> None:
+            conn = conns[cid]
+            try:
+                conn.settimeout(budget)
+                framing.send_frame(
+                    conn,
+                    secure.build_unmask_request(
+                        alive,
+                        dead,
+                        session=self._session,
+                        round_index=rnd.round_no,
+                        auth_key=(
+                            self._client_wire_key(cid)
+                            if self.auth_key is not None
+                            else None
+                        ),
+                    ),
+                )
+                responses[cid] = secure.parse_unmask_response(
+                    framing.recv_frame(conn),
+                    session=self._session,
+                    round_index=rnd.round_no,
+                    client_id=cid,
+                    expect_alive=alive,
+                    expect_dead=dead,
+                    auth_key=(
+                        self._client_wire_key(cid)
+                        if self.auth_key is not None
+                        else None
+                    ),
+                )
+                conn.settimeout(self.timeout)
+            except (
+                OSError,
+                ConnectionError,
+                wire.WireError,
+                secure.SecureAggError,
+            ) as e:
+                errs[cid] = e
+
+        threads = [
+            threading.Thread(target=_ask, args=(cid,), daemon=True)
+            for cid in alive
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=budget + 5.0)
+        if len(responses) < t:
+            raise RuntimeError(
+                f"unmask round got {len(responses)} responses "
+                f"(clients {sorted(responses)}), need the Shamir "
+                f"threshold {t}; failures: "
+                f"{ {c: str(e) for c, e in errs.items()} }"
+            )
+        # Reconstruct contributors' self-mask seeds, verified against the
+        # dealt commitments (corrupted shares fail loudly, not silently).
+        b_seeds: dict[int, bytes] = {}
+        for d in alive:
+            shares = {
+                secure.share_x(h): responses[h][0][d] for h in responses
+            }
+            seed = shamir.combine(shares)
+            if (
+                secure.b_seed_commitment(
+                    seed, self._session, rnd.round_no, d
+                )
+                != commits[d]
+            ):
+                raise RuntimeError(
+                    f"reconstructed self-mask seed for client {d} fails "
+                    "its commitment — inconsistent shares"
+                )
+            b_seeds[d] = seed
+        # Reconstruct dead participants' key seeds, verified against their
+        # registered DH public keys; regenerate the uncancelled pair masks.
+        revealed: dict[int, dict[int, bytes]] = {}
+        for d in dead:
+            shares = {
+                secure.share_x(h): responses[h][1][d] for h in responses
+            }
+            sk_seed = shamir.combine(shares)
+            priv, pub = secure.dh_keypair(entropy=sk_seed)
+            if pub != pubs.get(d):
+                raise RuntimeError(
+                    f"reconstructed key seed for dead client {d} does not "
+                    "match its registered public key — inconsistent shares"
+                )
+            for s in alive:
+                revealed.setdefault(s, {})[d] = secure.dh_pair_secret(
+                    priv, pubs[s]
+                )
+        summed = secure.sum_masked([models[i] for i in alive])
+        self_res = secure.self_mask_sum(
+            summed, b_seeds, session=self._session, round_index=rnd.round_no
+        )
+        out = {k: summed[k] - self_res[k] for k in summed}
+        if revealed:
+            pair_res = secure.residual_mask_sum(
+                summed,
+                revealed,
+                session=self._session,
+                round_index=rnd.round_no,
+            )
+            out = {k: out[k] - pair_res[k] for k in out}
+        log.info(
+            f"[SERVER] double-mask unmasked {len(alive)} uploads with "
+            f"{len(responses)}/{len(alive)} responders (threshold {t})"
+            + (f", {len(dead)} dropout(s) recovered" if dead else "")
+        )
+        return secure.dequantize_sum(out, len(alive), self.fp_bits)
+
     def serve_round(
         self, *, deadline: float | None = None, round_index: int | None = None
     ) -> dict | None:
@@ -631,7 +990,13 @@ class AggregationServer:
                         "every client must start the round from the same "
                         "adopted aggregate / shared init"
                     )
-            if self.secure_agg:
+            if self.secure_agg and self.secure_protocol == "double":
+                agg = self._aggregate_double(rnd, models, conns)
+                log.info(
+                    f"[SERVER] secure-aggregated {len(ids)} masked models "
+                    "(double-masking; server never saw raw weights)"
+                )
+            elif self.secure_agg:
                 key_set = list(rnd.key_set or [])
                 extra = [i for i in ids if i not in key_set]
                 if extra:
@@ -654,14 +1019,11 @@ class AggregationServer:
                     )
                     # Reveal frames are tagged under each survivor's OWN
                     # identity key when per-client keys are provisioned
-                    # (group key otherwise): an in-group adversary holding
-                    # only the group key can then neither forge a
-                    # REVEAL_REQ naming a victim that actually uploaded nor
-                    # spoof a survivor's response (secure.py threat model).
-                    def _reveal_key(cid: int) -> bytes | None:
-                        if self.client_keys is not None:
-                            return self.client_keys[cid]
-                        return self.auth_key
+                    # (group key otherwise, _client_wire_key): an in-group
+                    # adversary holding only the group key can then
+                    # neither forge a REVEAL_REQ naming a victim that
+                    # actually uploaded nor spoof a survivor's response
+                    # (secure.py threat model).
                     # Parallel per-survivor exchange with a bounded budget
                     # (same rationale as the reply fan-out below): a
                     # stalled survivor must neither block the others'
@@ -682,7 +1044,7 @@ class AggregationServer:
                                     dead,
                                     session=self._session,
                                     round_index=rnd.round_no,
-                                    auth_key=_reveal_key(cid),
+                                    auth_key=self._client_wire_key(cid),
                                 ),
                             )
                             revealed[cid] = secure.parse_reveal_response(
@@ -691,7 +1053,7 @@ class AggregationServer:
                                 round_index=rnd.round_no,
                                 client_id=cid,
                                 expect_dead=dead,
-                                auth_key=_reveal_key(cid),
+                                auth_key=self._client_wire_key(cid),
                             )
                             conn.settimeout(self.timeout)
                         except (

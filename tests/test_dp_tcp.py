@@ -116,7 +116,8 @@ def test_dp_noise_is_calibrated(rng):
     noise = flatten_params(results[0])["w"]
     sigma = mult * clip / 1
     assert abs(float(noise.std()) - sigma) < 0.1 * sigma
-    assert abs(float(noise.mean())) < 3 * sigma / np.sqrt(noise.size)
+    # 4-sigma bound: the 3-sigma version false-failed ~0.3% of runs.
+    assert abs(float(noise.mean())) < 4 * sigma / np.sqrt(noise.size)
 
 
 def test_dp_base_mismatch_fails_the_round(rng):

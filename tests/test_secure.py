@@ -209,7 +209,11 @@ def test_client_refuses_replayed_round(rng):
             conn, _ = srv.accept()
             conn.settimeout(10)
             try:
-                send_frame(conn, ROUND_MAGIC + struct.pack("<Q", 3) + session)
+                send_frame(
+                    conn,
+                    ROUND_MAGIC + struct.pack("<Q", 3) + session
+                    + bytes([0]),  # PROTO_REVEAL
+                )
                 hello = recv_frame(conn)  # client's DH pubkey
                 assert hello.startswith(PUBKEY_MAGIC)
                 pub0 = hello[len(PUBKEY_MAGIC) + 8 :]
@@ -230,7 +234,7 @@ def test_client_refuses_replayed_round(rng):
     t.start()
     client = FederatedClient(
         "127.0.0.1", port, client_id=0, timeout=10,
-        secure_agg=True, num_clients=2,
+        secure_agg=True, num_clients=2, secure_protocol="reveal",
     )
     params = _params(rng)
     client.exchange(params, max_retries=1)  # first use of round 3: fine
@@ -494,7 +498,7 @@ def _keyed_then_dead_client(port, cid, *, died, auth_key=None, tag_key=None):
         adv = framing.recv_frame(sock)  # round advert
         n_magic = len(wire.ROUND_MAGIC)
         round_no = struct.unpack("<Q", adv[n_magic : n_magic + 8])[0]
-        session = bytes(adv[n_magic + 8 :])
+        session = bytes(adv[n_magic + 8 : n_magic + 8 + 16])
         _, pub = dh_keypair()
         hello = wire.PUBKEY_MAGIC + struct.pack("<q", cid) + pub
         if auth_key is not None:
@@ -526,7 +530,7 @@ def test_secure_round_survives_dropout_after_keys(rng, auth):
     died = threading.Event()
     with AggregationServer(
         port=0, num_clients=C, timeout=20, secure_agg=True, min_clients=2,
-        auth_key=auth_key,
+        auth_key=auth_key, secure_protocol="reveal",
     ) as server:
         st = threading.Thread(
             target=lambda: results.__setitem__(
@@ -550,6 +554,7 @@ def test_secure_round_survives_dropout_after_keys(rng, auth):
                 secure_agg=True,
                 num_clients=C,
                 auth_key=auth_key,
+                secure_protocol="reveal",
             ).exchange(params[cid])
 
         ts = [threading.Thread(target=_go, args=(c,)) for c in range(2)]
@@ -664,7 +669,7 @@ def test_per_client_identity_keys_round_and_impersonation(rng):
                 adv = framing.recv_frame(sock)  # round advert
                 n = len(wire.ROUND_MAGIC)
                 round_no = struct.unpack("<Q", adv[n : n + 8])[0]
-                session = bytes(adv[n + 8 :])
+                session = bytes(adv[n + 8 : n + 8 + 16])
                 _, pub = dh_keypair(entropy=b"attacker")
                 # Best available forgery: claim id 0, tag with key 1.
                 hello = (
@@ -834,7 +839,11 @@ def test_retry_after_wire_error_reuses_keypair_and_completes(rng):
             conn, _ = srv.accept()
             conn.settimeout(10)
             try:
-                send_frame(conn, ROUND_MAGIC + struct.pack("<Q", 5) + session)
+                send_frame(
+                    conn,
+                    ROUND_MAGIC + struct.pack("<Q", 5) + session
+                    + bytes([0]),  # PROTO_REVEAL
+                )
                 hello = recv_frame(conn)
                 assert hello.startswith(PUBKEY_MAGIC)
                 pubs.append(hello[len(PUBKEY_MAGIC) + 8 :])
@@ -856,7 +865,7 @@ def test_retry_after_wire_error_reuses_keypair_and_completes(rng):
     t.start()
     client = FederatedClient(
         "127.0.0.1", port, client_id=0, timeout=10,
-        secure_agg=True, num_clients=2,
+        secure_agg=True, num_clients=2, secure_protocol="reveal",
     )
     out = client.exchange(_params(rng), max_retries=3)
     assert "w" in flatten_params(out)
@@ -918,7 +927,9 @@ def test_keys_frame_below_default_floor_fails_closed(rng):
                 conn.settimeout(10)
                 try:
                     send_frame(
-                        conn, ROUND_MAGIC + struct.pack("<Q", 1) + session
+                        conn,
+                        ROUND_MAGIC + struct.pack("<Q", 1) + session
+                        + bytes([1]),  # PROTO_DOUBLE
                     )
                     hello = recv_frame(conn)
                     pub0 = hello[len(PUBKEY_MAGIC) + 8 :]
@@ -1003,7 +1014,7 @@ def test_secure_dropout_reveal_with_per_client_keys(rng):
     died = threading.Event()
     with AggregationServer(
         port=0, num_clients=C, timeout=20, secure_agg=True, min_clients=2,
-        auth_key=group, client_keys=ckeys,
+        auth_key=group, client_keys=ckeys, secure_protocol="reveal",
     ) as server:
         st = threading.Thread(
             target=lambda: results.__setitem__(
@@ -1028,6 +1039,7 @@ def test_secure_dropout_reveal_with_per_client_keys(rng):
                 num_clients=C,
                 auth_key=group,
                 client_key=ckeys[cid],
+                secure_protocol="reveal",
             ).exchange(params[cid])
 
         ts = [threading.Thread(target=_go, args=(c,)) for c in range(2)]
@@ -1044,3 +1056,239 @@ def test_secure_dropout_reveal_with_per_client_keys(rng):
         np.testing.assert_allclose(
             arr, expected[key], atol=2.0 / (1 << DEFAULT_FP_BITS)
         )
+
+
+def _double_scripted_client(
+    port, cid, *, die_after, died, params=None, results=None
+):
+    """Speak the double-masking protocol up to ``die_after`` ("shares":
+    dealt but never uploaded; "upload": uploaded but vanished before the
+    unmask round) then drop the connection — the two dropout windows the
+    Shamir construction recovers from."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        framing,
+        shamir,
+        wire,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        secure as sec,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.client import (
+        connect_with_retry,
+    )
+    import os as os_mod
+
+    sock = connect_with_retry("127.0.0.1", port, timeout=10)
+    try:
+        sock.settimeout(10)
+        adv = framing.recv_frame(sock)
+        nm = len(wire.ROUND_MAGIC)
+        round_no = struct.unpack("<Q", adv[nm : nm + 8])[0]
+        session = bytes(adv[nm + 8 : nm + 8 + 16])
+        assert adv[-1] == sec.PROTO_DOUBLE
+        sk_seed = os_mod.urandom(sec.SEED_LEN)
+        priv, pub = dh_keypair(entropy=sk_seed)
+        framing.send_frame(
+            sock, wire.PUBKEY_MAGIC + struct.pack("<q", cid) + pub
+        )
+        keys = framing.recv_frame(sock)
+        entry = 8 + sec.DH_PUB_LEN
+        pubs = {}
+        for off in range(len(wire.KEYS_MAGIC), len(keys), entry):
+            (kcid,) = struct.unpack("<q", keys[off : off + 8])
+            pubs[kcid] = keys[off + 8 : off + entry]
+        participants = sorted(pubs)
+        pair_secrets = {
+            p: dh_pair_secret(priv, pubs[p]) for p in participants if p != cid
+        }
+        t = sec.majority_threshold(len(participants))
+        b_seed = os_mod.urandom(sec.SEED_LEN)
+        xs = [sec.share_x(p) for p in participants]
+        shares_b = shamir.split(b_seed, xs, t)
+        shares_sk = shamir.split(sk_seed, xs, t)
+        blobs = {
+            p: sec.encrypt_share_blob(
+                pair_secrets[p], session, round_no, cid, p,
+                shares_b[sec.share_x(p)], shares_sk[sec.share_x(p)],
+            )
+            for p in participants
+            if p != cid
+        }
+        framing.send_frame(
+            sock,
+            sec.build_shares_frame(
+                cid,
+                sec.b_seed_commitment(b_seed, session, round_no, cid),
+                blobs,
+                threshold=t,
+                session=session,
+                round_index=round_no,
+            ),
+        )
+        shareset = framing.recv_frame(sock)
+        if die_after == "shares":
+            return
+        u2, _ = sec.parse_shareset_frame(
+            shareset, session=session, round_index=round_no
+        )
+        upload = sec.masked_upload(
+            flatten_params(params),
+            pair_secrets=pair_secrets,
+            round_index=round_no,
+            client_id=cid,
+            participants=sorted(u2),
+            session=session,
+        )
+        sec.apply_self_stream(
+            upload, b_seed, session, round_no, cid, add=True
+        )
+        framing.send_frame(
+            sock,
+            wire.encode(
+                upload,
+                meta={
+                    "client_id": cid,
+                    "n_samples": 1,
+                    "secure": True,
+                    "fp_bits": sec.DEFAULT_FP_BITS,
+                    "round": round_no,
+                    "participants": len(u2),
+                },
+            ),
+        )
+        # die before answering the unmask request
+    finally:
+        sock.close()
+        died.set()
+
+
+def _run_double_round(C, dead_cid, die_after, rng):
+    """One double-mask round with client ``dead_cid`` scripted to die at
+    ``die_after``; returns (params, results dict)."""
+    params = [_params(rng) for _ in range(C)]
+    results = {}
+    died = threading.Event()
+    with AggregationServer(
+        port=0, num_clients=C, timeout=20, secure_agg=True, min_clients=2,
+    ) as server:
+        st = threading.Thread(
+            target=lambda: results.__setitem__(
+                "agg", server.serve_round(deadline=10)
+            )
+        )
+        st.start()
+        dead = threading.Thread(
+            target=_double_scripted_client,
+            args=(server.port, dead_cid),
+            kwargs={
+                "die_after": die_after,
+                "died": died,
+                "params": params[dead_cid],
+            },
+        )
+        dead.start()
+
+        def _go(cid):
+            results[cid] = FederatedClient(
+                "127.0.0.1",
+                server.port,
+                client_id=cid,
+                timeout=20,
+                secure_agg=True,
+                num_clients=C,
+                min_participants=2,
+            ).exchange(params[cid])
+
+        ts = [
+            threading.Thread(target=_go, args=(c,))
+            for c in range(C)
+            if c != dead_cid
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        st.join(timeout=30)
+        dead.join(timeout=10)
+    assert died.is_set() and "agg" in results, sorted(results)
+    return params, results
+
+
+def test_double_mask_dropout_after_shares(rng):
+    """Double-masking dropout window 1: client 2 deals its shares then
+    never uploads. Survivors' responses reconstruct the dead client's DH
+    key seed (verified against its registered public key), its pair-mask
+    residue comes off the ring sum, and the round completes with the
+    survivors' exact mean."""
+    C = 3
+    params, results = _run_double_round(C, 2, "shares", rng)
+    expected = aggregate_flat([flatten_params(p) for p in params[:2]])
+    for key, arr in flatten_params(results[0]).items():
+        np.testing.assert_allclose(
+            arr, expected[key], atol=2.0 / (1 << DEFAULT_FP_BITS)
+        )
+
+
+def test_double_mask_dropout_during_unmask(rng):
+    """VERDICT r4 #3 done-criterion: a client drops DURING the unmask
+    (reveal) phase — client 2 uploads, then vanishes before answering the
+    unmask request — and the round still completes, INCLUDING the dead
+    client's contribution: the remaining holders meet the Shamir
+    threshold for its self-mask seed. The reveal-round variant failed
+    this outright (old comm/secure.py threat model)."""
+    C = 3
+    params, results = _run_double_round(C, 2, "upload", rng)
+    expected = aggregate_flat([flatten_params(p) for p in params])
+    for key, arr in flatten_params(results[0]).items():
+        np.testing.assert_allclose(
+            arr, expected[key], atol=2.0 / (1 << DEFAULT_FP_BITS)
+        )
+
+
+def test_unmask_request_overlap_and_partition_refused():
+    """The either/or rule's teeth: a request naming one id both alive and
+    dead (the both-kinds share harvest) is refused at parse, and an
+    honest client also refuses a partition that does not cover U2
+    exactly or claims the client itself did not contribute."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.secure import (
+        build_unmask_request,
+        parse_unmask_request,
+    )
+
+    kw = dict(session=b"s" * 16, round_index=1)
+    with pytest.raises(SecureAggError, match="both alive and dead"):
+        parse_unmask_request(build_unmask_request([0, 1], [1], **kw), **kw)
+    client = FederatedClient(
+        "h", 1, client_id=0, secure_agg=True, num_clients=3,
+    )
+    share_st = {"u2": [0, 1, 2], "holder_shares": {}, "own_b_share": b"x" * 32}
+    with pytest.raises(SecureAggError, match="did not contribute"):
+        client._answer_unmask(
+            None, build_unmask_request([1, 2], [], **kw), share_st,
+            b"s" * 16, 1,
+        )
+    with pytest.raises(SecureAggError, match="partition"):
+        client._answer_unmask(
+            None, build_unmask_request([0, 1], [], **kw), share_st,
+            b"s" * 16, 1,
+        )
+
+
+def test_shamir_roundtrip_and_threshold():
+    """Any t of n shares reconstruct; fewer yield garbage."""
+    import itertools
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        shamir,
+    )
+
+    secret = bytes(range(32))
+    shares = shamir.split(secret, [1, 2, 3, 4, 5], 3)
+    for combo in itertools.combinations([1, 2, 3, 4, 5], 3):
+        assert shamir.combine({x: shares[x] for x in combo}) == secret
+    assert shamir.combine(shares) == secret  # all five: same polynomial
+    assert shamir.combine({1: shares[1], 2: shares[2]}) != secret
+    with pytest.raises(shamir.ShamirError):
+        shamir.split(secret, [1, 1, 2], 2)  # duplicate x
+    with pytest.raises(shamir.ShamirError):
+        shamir.split(secret, [0, 1], 2)  # x=0 would leak the secret
