@@ -66,24 +66,40 @@ def cmd_serve(args) -> int:
 
     dp_clip = float(getattr(args, "dp_clip", 0.0) or 0.0)
     dp_noise = float(getattr(args, "dp_noise_multiplier", 0.0) or 0.0)
+    _dp_q_arg = getattr(args, "dp_participation", None)
+    # No `or 1.0` coercion: an explicit 0 must reach the server's
+    # validation and be rejected, not silently become full participation.
+    dp_q = 1.0 if _dp_q_arg is None else float(_dp_q_arg)
     rounds = args.rounds or 1
     if dp_clip > 0.0 and dp_noise > 0.0:
         # Same dual-adjacency accountant banner as the mesh tier
-        # (cli/federated.py): every client participates in every TCP
-        # round, so q = 1 and the bound is the plain Gaussian-mechanism
-        # RDP composition — exact, no sampling caveat.
+        # (cli/federated.py). With --dp-participation q < 1 the server
+        # runs the Poisson cohort sampler — exactly the sampler the
+        # subsampled-Gaussian accountant assumes, so the reported epsilon
+        # is exact WITH privacy amplification; at q = 1 the bound is the
+        # plain Gaussian-mechanism RDP composition, also exact.
         from ..parallel.dp import dp_epsilon_both
 
-        eps_zeroed, eps_replace = dp_epsilon_both(rounds, dp_noise, 1e-5)
+        eps_zeroed, eps_replace = dp_epsilon_both(
+            rounds, dp_noise, 1e-5, sampling_rate=dp_q
+        )
+        sampling_note = (
+            "full participation, accountant exact"
+            if dp_q >= 1.0
+            else (
+                f"Poisson cohort sampling q={dp_q:.3g} (accountant "
+                "exact; sampled sets are kept out of replies — "
+                "amplification assumes a hidden cohort)"
+            )
+        )
         log.info(
             f"[DP] client-level guarantee for {rounds} round(s): "
             f"({eps_zeroed:.3g}, 1e-05)-DP under zeroed-contribution "
             f"adjacency; ({eps_replace:.3g}, 1e-05)-DP under replace-one "
-            f"adjacency (clip {dp_clip}, noise x{dp_noise}; full "
-            "participation, accountant exact). Noise caveat: float32 "
-            "Gaussian draws (OS-entropy Philox) — not hardened against "
-            "the Mironov floating-point precision attack (no discrete "
-            "Gaussian)"
+            f"adjacency (clip {dp_clip}, noise x{dp_noise}; "
+            f"{sampling_note}). Noise caveat: float32 Gaussian draws "
+            "(OS-entropy Philox) — not hardened against the Mironov "
+            "floating-point precision attack (no discrete Gaussian)"
         )
     elif dp_clip > 0.0:
         log.warning(
@@ -105,6 +121,7 @@ def cmd_serve(args) -> int:
         client_keys=_server_client_keys(),
         secure_protocol=getattr(args, "secure_protocol", "double"),
         secure_threshold=getattr(args, "secure_threshold", None),
+        dp_participation=dp_q,
     ) as server:
         log.info(f"[SERVER] listening on {args.host}:{server.port}")
         server.serve(rounds=rounds)

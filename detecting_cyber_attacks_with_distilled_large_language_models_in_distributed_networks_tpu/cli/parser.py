@@ -340,6 +340,16 @@ def build_parser() -> argparse.ArgumentParser:
         "multiplier * clip / n_clients; the accountant banner reports "
         "the (epsilon, delta) guarantee for the served rounds",
     )
+    p.add_argument(
+        "--dp-participation",
+        type=float,
+        default=1.0,
+        help="Poisson cohort sampling rate q: each round samples every "
+        "registered client independently with probability q; non-sampled "
+        "clients sit the round out (they still receive the reply). "
+        "q < 1 buys privacy amplification — the banner's subsampled "
+        "accountant is exact for this sampler",
+    )
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(

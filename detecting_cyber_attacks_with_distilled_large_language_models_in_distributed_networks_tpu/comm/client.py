@@ -312,14 +312,28 @@ class FederatedClient:
                         raise wire.WireError("bad auth challenge from server")
                     nonce_hex = chal[len(wire.NONCE_MAGIC) :].hex()
                     attempt_meta.update(role="client", nonce=nonce_hex)
+                sitting_out = False
+                share_st = None
                 if self.dp:
-                    # DP advert: the clip bound + noise multiplier this
-                    # server enforces. Fail fast if the server isn't in DP
-                    # mode (its first frame would be something else).
+                    # DP handshake: identify ourselves (the server's
+                    # Poisson cohort sampler needs the id before any model
+                    # bytes move), then read the advert — clip bound,
+                    # noise multiplier, sampling rate, and whether THIS
+                    # client is in the round's cohort. Fail fast if the
+                    # server isn't in DP mode (its next frame would be
+                    # something else).
                     import struct as _struct
 
                     sock.settimeout(min(self.timeout, 30.0))
                     try:
+                        # send_frame blocks on the ACK, so a non-DP server
+                        # (which never reads the hello as a hello) fails
+                        # here or at the advert recv — both non-retryable.
+                        framing.send_frame(
+                            sock,
+                            wire.DPID_MAGIC
+                            + _struct.pack("<q", self.client_id),
+                        )
                         adv = framing.recv_frame(sock)
                     except socket.timeout:
                         # ModeError, not WireError: retries would stall
@@ -328,34 +342,83 @@ class FederatedClient:
                             "server sent no DP advert — is it running "
                             "with --dp-clip?"
                         ) from None
+                    except ConnectionError:
+                        # Ambiguous: a non-DP server drops the id hello
+                        # (it reads as a bad upload), but a transient RST
+                        # against a genuine DP server looks identical —
+                        # stay RETRYABLE and leave a hint for the
+                        # repeating case.
+                        log.info(
+                            f"[CLIENT {self.client_id}] connection dropped "
+                            "during the DP handshake — if this repeats, "
+                            "the server may not be running with --dp-clip"
+                        )
+                        raise
                     finally:
                         sock.settimeout(self.timeout)
                     n_magic = len(wire.DP_MAGIC)
-                    if len(adv) != n_magic + 16 or not adv.startswith(
+                    if len(adv) != n_magic + 25 or not adv.startswith(
                         wire.DP_MAGIC
                     ):
                         raise wire.ModeError("bad DP advert from server")
-                    dp_clip, dp_noise = _struct.unpack(
-                        "<dd", adv[n_magic:]
+                    dp_clip, dp_noise, dp_q = _struct.unpack(
+                        "<ddd", adv[n_magic : n_magic + 24]
                     )
                     if not dp_clip > 0.0:
                         raise wire.WireError(
                             f"DP advert carries clip={dp_clip}"
                         )
-                    # Client-side clipping (the server re-clips in plain
-                    # mode; under secure-agg it cannot, so this is the
-                    # honest-client clip the guarantee assumes).
-                    clipped, norm, scale = wire.clip_flat(dp_delta, dp_clip)
-                    log.info(
-                        f"[CLIENT {self.client_id}] DP round: update norm "
-                        f"{norm:.4g}, clip {dp_clip} (scale {scale:.3g}), "
-                        f"noise x{dp_noise}"
-                    )
-                    if self.secure_agg:
-                        flat = clipped  # quantize+mask the clipped delta
+                    if not 0.0 < dp_q <= 1.0:
+                        raise wire.WireError(
+                            f"DP advert carries sampling rate q={dp_q}"
+                        )
+                    if adv[-1] == 0:
+                        if dp_q >= 1.0:
+                            raise wire.WireError(
+                                "server claims this client is not sampled "
+                                "under full participation (q=1)"
+                            )
+                        # Sitting the round out: no upload — but wait for
+                        # the round's reply so our base tracks the fleet's.
+                        if self.auth_key is not None:
+                            # Prove key knowledge before the server
+                            # registers us for the reply (anti-hijack).
+                            import hmac as _hmac
+
+                            framing.send_frame(
+                                sock,
+                                wire.DPSKIP_MAGIC
+                                + _hmac.new(
+                                    self.auth_key,
+                                    wire.DPSKIP_DOMAIN
+                                    + bytes.fromhex(nonce_hex)
+                                    + _struct.pack("<q", self.client_id),
+                                    "sha256",
+                                ).digest(),
+                            )
+                        log.info(
+                            f"[CLIENT {self.client_id}] sitting out this "
+                            f"round (Poisson cohort sampling q={dp_q}); "
+                            "waiting for the round reply"
+                        )
+                        sitting_out = True
                     else:
-                        upload = clipped
-                if self.secure_agg:
+                        # Client-side clipping (the server re-clips in
+                        # plain mode; under secure-agg it cannot, so this
+                        # is the honest-client clip the guarantee assumes).
+                        clipped, norm, scale = wire.clip_flat(
+                            dp_delta, dp_clip
+                        )
+                        log.info(
+                            f"[CLIENT {self.client_id}] DP round: update "
+                            f"norm {norm:.4g}, clip {dp_clip} (scale "
+                            f"{scale:.3g}), noise x{dp_noise}"
+                        )
+                        if self.secure_agg:
+                            flat = clipped  # quantize+mask the clipped delta
+                        else:
+                            upload = clipped
+                if self.secure_agg and not sitting_out:
                     import struct as _struct
 
                     # A secure server adverts immediately after accept; if
@@ -488,35 +551,40 @@ class FederatedClient:
                     )
                 attempt_compression = self.compression
                 delta_flat = sent_flat = None
-                if self._topk_frac is not None:
-                    upload, attempt_compression, delta_flat, sent_flat = (
-                        self._prepare_topk_upload(params, attempt, attempt_meta)
+                if not sitting_out:
+                    if self._topk_frac is not None:
+                        upload, attempt_compression, delta_flat, sent_flat = (
+                            self._prepare_topk_upload(
+                                params, attempt, attempt_meta
+                            )
+                        )
+                    if (
+                        self.auth_key is not None
+                        or self.secure_agg
+                        or self._topk_frac is not None
+                        or self.dp
+                    ):
+                        # Fresh encode per attempt: the nonce and/or round
+                        # (and with them the masks), or the sparse-vs-dense
+                        # choice, change between connections.
+                        msg = wire.encode(
+                            upload,
+                            meta=attempt_meta,
+                            compression=attempt_compression,
+                            auth_key=self.auth_key,
+                        )
+                    log.info(
+                        f"[CLIENT {self.client_id}] uploading "
+                        f"{len(msg) / 1e6:.1f} MB "
+                        f"(attempt {attempt}/{max_retries})"
                     )
-                if (
-                    self.auth_key is not None
-                    or self.secure_agg
-                    or self._topk_frac is not None
-                    or self.dp
-                ):
-                    # Fresh encode per attempt: the nonce and/or round (and
-                    # with them the masks), or the sparse-vs-dense choice,
-                    # change between connections.
-                    msg = wire.encode(
-                        upload,
-                        meta=attempt_meta,
-                        compression=attempt_compression,
-                        auth_key=self.auth_key,
-                    )
-                log.info(
-                    f"[CLIENT {self.client_id}] uploading {len(msg) / 1e6:.1f} MB "
-                    f"(attempt {attempt}/{max_retries})"
-                )
-                sparse_in_flight = delta_flat is not None
-                framing.send_frame(sock, msg)
+                    sparse_in_flight = delta_flat is not None
+                    framing.send_frame(sock, msg)
                 reply = framing.recv_frame(sock)
                 if (
                     self.secure_agg
                     and self.secure_protocol == "double"
+                    and share_st is not None
                     and bytes(reply[:4]) == secure.UNMASK_MAGIC
                 ):
                     # Unmask round (every double-mask round): respond with
@@ -593,6 +661,14 @@ class FederatedClient:
                     f"({len(reply) / 1e6:.1f} MB, clients {agg_meta.get('round_clients')})"
                 )
                 if self.dp:
+                    if agg_meta.get("dp_reply") == "noop":
+                        # Empty Poisson cohort: a no-op round — nothing
+                        # was aggregated or released; keep the base.
+                        log.info(
+                            f"[CLIENT {self.client_id}] no-op round "
+                            "(empty sampled cohort); keeping the round base"
+                        )
+                        return wire.unflatten_params(dp_base_flat)
                     # The DP reply is the noised mean DELTA (the server
                     # never held absolute weights); apply it to the round
                     # base so callers still receive an absolute aggregate.

@@ -99,6 +99,11 @@ class _Round:
     # aggregates when all are identical (a common anchor is what makes
     # the clipped-delta mean well-defined).
     dp_crcs: dict[int, int] = field(default_factory=dict)
+    # Poisson cohort sampling (dp_participation < 1): the round's sampled
+    # id set, drawn once per round from OS entropy; non-sampled clients
+    # register here to receive the round's reply without contributing.
+    cohort: set | None = None
+    skip_conns: dict[int, socket.socket] = field(default_factory=dict)
     lock: threading.Lock = field(default_factory=threading.Lock)
     complete: threading.Event = field(default_factory=threading.Event)
     # Set (under lock) when serve_round snapshots the round; a handler that
@@ -139,6 +144,7 @@ class AggregationServer:
         client_keys: dict[int, bytes] | None = None,
         secure_protocol: str = "double",
         secure_threshold: int | None = None,
+        dp_participation: float = 1.0,
     ):
         if client_keys is not None and auth_key is None:
             raise ValueError(
@@ -176,6 +182,16 @@ class AggregationServer:
                 "secure_threshold < 2 would let the server reconstruct "
                 "secrets from a single holder"
             )
+        if not 0.0 < dp_participation <= 1.0:
+            raise ValueError(
+                f"dp_participation={dp_participation} must be in (0, 1]"
+            )
+        if dp_participation < 1.0 and dp_clip <= 0.0:
+            raise ValueError(
+                "dp_participation < 1 is the DP cohort sampler; it needs "
+                "dp_clip > 0 (the sampling exists for the accountant's "
+                "privacy amplification)"
+            )
         if compression.startswith("topk"):
             raise ValueError(
                 "topk is an upload-side (sparse round-delta) compression; "
@@ -206,6 +222,12 @@ class AggregationServer:
         # requiring every upload's dp_base_crc to be identical.
         self.dp_clip = float(dp_clip)
         self.dp_noise_multiplier = float(dp_noise_multiplier)
+        # Poisson cohort sampling rate: each registered client is drawn
+        # independently with probability q every round — the sampler the
+        # subsampled-Gaussian accountant assumes, so the TCP tier's
+        # epsilon is exact under q < 1 (privacy amplification), mirroring
+        # the mesh tier's participation_mode="poisson".
+        self.dp_participation = float(dp_participation)
         # Noise generator: Philox (counter-based, 128-bit crypto-derived
         # keying) keyed from OS entropy, never seeded deterministically —
         # the draw sequence is not predictable from any run artifact.
@@ -283,16 +305,77 @@ class AggregationServer:
                 framing.send_frame(
                     conn, wire.NONCE_MAGIC + bytes.fromhex(nonce_hex)
                 )
+            dpid = None
             if self.dp_clip > 0.0:
                 import struct as _dstruct
 
+                # DP handshake: the client identifies itself first so the
+                # round's Poisson cohort decision can be made (and told)
+                # before any model bytes move.
+                idf = framing.recv_frame(conn)
+                if len(idf) != len(wire.DPID_MAGIC) + 8 or (
+                    not idf.startswith(wire.DPID_MAGIC)
+                ):
+                    raise wire.WireError("bad DP id hello")
+                dpid = _dstruct.unpack("<q", idf[len(wire.DPID_MAGIC) :])[0]
+                if not 0 <= dpid < self.num_clients:
+                    raise wire.WireError(f"DP id hello from unknown client {dpid}")
+                with rnd.lock:
+                    sampled = rnd.cohort is None or dpid in rnd.cohort
                 framing.send_frame(
                     conn,
                     wire.DP_MAGIC
                     + _dstruct.pack(
-                        "<dd", self.dp_clip, self.dp_noise_multiplier
-                    ),
+                        "<ddd",
+                        self.dp_clip,
+                        self.dp_noise_multiplier,
+                        self.dp_participation,
+                    )
+                    + bytes([1 if sampled else 0]),
                 )
+                if not sampled:
+                    # Sitting out: no upload, but the client still gets
+                    # the round's reply (its base must track the fleet's).
+                    if self.auth_key is not None:
+                        # The contributor path authenticates via its
+                        # HMAC'd upload; a sitting-out client must prove
+                        # key knowledge too, or anyone could claim a
+                        # non-sampled id, evict the real registration,
+                        # and collect the aggregate.
+                        import hmac as _hmac
+
+                        ack = framing.recv_frame(conn)
+                        want = wire.DPSKIP_MAGIC + _hmac.new(
+                            self.auth_key,
+                            wire.DPSKIP_DOMAIN
+                            + bytes.fromhex(nonce_hex)
+                            + _dstruct.pack("<q", dpid),
+                            "sha256",
+                        ).digest()
+                        if not _hmac.compare_digest(ack, want):
+                            raise wire.WireError(
+                                f"sit-out ack for client {dpid} failed "
+                                "its authenticity check"
+                            )
+                    with rnd.lock:
+                        if rnd.closed:
+                            conn.close()
+                            return
+                        old = rnd.skip_conns.pop(dpid, None)
+                        if old is not None and old is not conn:
+                            old.close()
+                        rnd.skip_conns[dpid] = conn
+                        if nonce_hex is not None:
+                            rnd.nonces[dpid] = nonce_hex
+                        done = self._round_done(rnd)
+                    log.info(
+                        f"[SERVER] client {dpid} sits out round "
+                        f"{rnd.round_no} (cohort sampling "
+                        f"q={self.dp_participation})"
+                    )
+                    if done:
+                        rnd.complete.set()
+                    return
             if self.secure_agg:
                 # Advertise (round, session, protocol) so every participant
                 # keys its mask streams identically — and freshly — for
@@ -471,6 +554,15 @@ class AggregationServer:
                 )
             flat = wire.flatten_params(flat)
             client_id = int(meta.get("client_id", -1))
+            # Cohort enforcement needs no separate membership check here:
+            # a non-sampled dpid already returned on the sit-out path
+            # (its upload frame is never read as a model), and this id
+            # binding stops a sampled connection smuggling another id.
+            if dpid is not None and client_id != dpid:
+                raise wire.WireError(
+                    f"upload claims client {client_id} but the DP id "
+                    f"hello said {dpid}"
+                )
             is_delta = bool(meta.get("delta", False))
             if is_delta:
                 if self.secure_agg:
@@ -588,14 +680,7 @@ class AggregationServer:
                 rnd.conns[client_id] = conn
                 if nonce_hex is not None:
                     rnd.nonces[client_id] = nonce_hex
-                done = len(rnd.models) >= rnd.expected or (
-                    # Secure subset round (dropout before keys): complete
-                    # as soon as every KEYED participant uploaded — the
-                    # unkeyed never will.
-                    self.secure_agg
-                    and rnd.key_set is not None
-                    and set(rnd.key_set).issubset(rnd.models)
-                )
+                done = self._round_done(rnd)
             log.info(
                 f"[SERVER] received model from client {client_id} "
                 f"({len(rnd.models)}/{rnd.expected})"
@@ -620,6 +705,26 @@ class AggregationServer:
         ) as e:
             log.info(f"[SERVER] upload failed: {e}")
             conn.close()
+
+    def _round_done(self, rnd: _Round) -> bool:
+        """Round completion test (caller holds ``rnd.lock``): every
+        expected upload arrived — the full fleet, the secure keyed subset,
+        or the sampled cohort — AND, under cohort sampling, every
+        non-sampled client has connected to collect the round's reply
+        (their bases must track the fleet's)."""
+        uploads_done = len(rnd.models) >= rnd.expected or (
+            # Secure subset round (dropout before keys): complete as soon
+            # as every KEYED participant uploaded — the unkeyed never will.
+            self.secure_agg
+            and rnd.key_set is not None
+            and set(rnd.key_set).issubset(rnd.models)
+        )
+        if rnd.cohort is None:
+            return uploads_done
+        skips_done = (
+            len(rnd.skip_conns) >= self.num_clients - len(rnd.cohort)
+        )
+        return uploads_done and skips_done
 
     def _client_wire_key(self, cid: int) -> bytes | None:
         """The key server<->client control frames (reveal/unmask/shares)
@@ -943,9 +1048,48 @@ class AggregationServer:
             round_no=self._round_counter if round_index is None else round_index,
         )
         self._round_counter = rnd.round_no + 1
+        if self.dp_clip > 0.0 and self.dp_participation < 1.0:
+            # Per-round Poisson cohort from OS entropy: each registered
+            # client independently with probability q — exactly the
+            # sampler the subsampled-Gaussian accountant assumes. An
+            # empty draw is a legitimate sample: the round becomes a
+            # clean no-op (no release, no privacy spent beyond the
+            # accountant's bound, which already covers this branch).
+            rnd.cohort = {
+                i
+                for i in range(self.num_clients)
+                if self._dp_rng.random() < self.dp_participation
+            }
+            rnd.expected = len(rnd.cohort)
+            log.info(
+                f"[SERVER] round {rnd.round_no} Poisson cohort "
+                f"(q={self.dp_participation}): {sorted(rnd.cohort)}"
+            )
         deadline = time.monotonic() + (self.timeout if deadline is None else deadline)
         threads: list[threading.Thread] = []
+        # Sitting-out liveness bound: once every cohort upload has landed,
+        # missing non-sampled clients get a short grace to connect for
+        # their reply, not the whole round deadline (one crashed skip
+        # client must not stall every sampled round).
+        uploads_done_at = None
+        skip_grace = min(self.key_grace, 10.0)
         while not rnd.complete.is_set() and time.monotonic() < deadline:
+            if rnd.cohort is not None:
+                with rnd.lock:
+                    up_done = len(rnd.models) >= rnd.expected
+                    all_done = self._round_done(rnd)
+                if up_done and not all_done:
+                    if uploads_done_at is None:
+                        uploads_done_at = time.monotonic()
+                    elif time.monotonic() - uploads_done_at > skip_grace:
+                        log.info(
+                            "[SERVER] cohort uploads complete; proceeding "
+                            "without the missing sitting-out client(s) "
+                            f"after {skip_grace:.0f}s grace"
+                        )
+                        break
+                else:
+                    uploads_done_at = None
             self._sock.settimeout(max(0.05, min(1.0, deadline - time.monotonic())))
             try:
                 conn, addr = self._sock.accept()
@@ -967,14 +1111,55 @@ class AggregationServer:
             models = dict(rnd.models)
             deltas = dict(rnd.deltas)
             conns = dict(rnd.conns)
+            skip_conns = dict(rnd.skip_conns)
             n_samples = dict(rnd.n_samples)
             nonces = dict(rnd.nonces)
             dp_crcs = dict(rnd.dp_crcs)
+        # Failure cleanup must cover every registered connection,
+        # contributors and sitting-out clients alike.
+        all_conns = {**skip_conns, **conns}
         try:
-            if len(models) < self.min_clients:
+            if rnd.cohort is not None and len(rnd.cohort) == 0:
+                # Empty Poisson cohort: a clean no-op round. No model is
+                # aggregated and nothing is released; connected clients
+                # get a "noop" reply telling them to keep their base.
+                log.info(
+                    f"[SERVER] round {rnd.round_no}: empty Poisson "
+                    "cohort — no-op round, replying noop to "
+                    f"{len(skip_conns)} client(s)"
+                )
+                self._reply_all(
+                    {
+                        cid: self._encode_reply(
+                            {},
+                            {
+                                "round_clients": [],
+                                "agg_round": rnd.round_no,
+                                "dp_reply": "noop",
+                            },
+                            nonces.get(cid),
+                        )
+                        for cid in skip_conns
+                    },
+                    skip_conns,
+                )
+                return None
+            # Quorum: a sampled round can't demand more uploads than the
+            # cohort it drew (the draw is data-independent; gating on it
+            # would only hurt liveness, not privacy).
+            quorum = self.min_clients
+            if rnd.cohort is not None:
+                quorum = min(quorum, len(rnd.cohort))
+            if len(models) < quorum:
                 raise RuntimeError(
                     f"only {len(models)}/{self.num_clients} clients arrived "
-                    f"(min_clients={self.min_clients})"
+                    f"(min_clients={self.min_clients}"
+                    + (
+                        f", cohort {sorted(rnd.cohort)}"
+                        if rnd.cohort is not None
+                        else ""
+                    )
+                    + ")"
                 )
             ids = sorted(models)
             dp_mode = self.dp_clip > 0.0
@@ -1150,10 +1335,16 @@ class AggregationServer:
                     f"std {sigma:.3g}/coordinate"
                 )
                 reply_meta = {
-                    "round_clients": ids,
                     "agg_round": rnd.round_no,
                     "dp_reply": "delta",
                 }
+                if rnd.cohort is None:
+                    # Under cohort sampling the sampled set stays OUT of
+                    # the replies: privacy amplification by subsampling
+                    # assumes the adversary cannot condition on who was
+                    # sampled. With full participation the "cohort" is
+                    # public knowledge anyway.
+                    reply_meta["round_clients"] = ids
             else:
                 # The new base for next round's sparse deltas, advertised
                 # in every reply. Secure mode tracks it too (harmless), but
@@ -1175,38 +1366,52 @@ class AggregationServer:
                 }
                 if rnd.wants_delta and not self.secure_agg:
                     reply_meta["agg_crc"] = wire.flat_crc32(agg)
+            # Sitting-out clients (cohort sampling) receive the identical
+            # reply: the aggregate is the round's public output and their
+            # bases must track the fleet's.
+            reply_targets = ids + sorted(skip_conns)
             if self.auth_key is None:
                 # One shared reply blob, referenced by every client.
                 shared = wire.encode(
                     agg, meta=reply_meta, compression=self.compression
                 )
-                replies = {cid: shared for cid in ids}
+                replies = {cid: shared for cid in reply_targets}
             else:
                 # Auth mode: each reply echoes that client's challenge nonce
                 # with role=server, so it can't be replayed or reflected.
                 # (Per-client encode costs one extra payload memcpy each.)
                 replies = {
-                    cid: wire.encode(
-                        agg,
-                        meta={
-                            **reply_meta,
-                            "role": "server",
-                            "nonce": nonces.get(cid),
-                        },
-                        compression=self.compression,
-                        auth_key=self.auth_key,
-                    )
-                    for cid in ids
+                    cid: self._encode_reply(agg, reply_meta, nonces.get(cid))
+                    for cid in reply_targets
                 }
         except BaseException:
             # A failed round must not leave clients blocked in recv_frame
             # until their timeouts — drop every connection so they fail fast.
-            for c in conns.values():
+            for c in all_conns.values():
                 c.close()
             raise
-        # Replies go out on parallel threads: send_frame blocks on the
-        # client's ACK, so a sequential loop would let one dead client stall
-        # every healthy one behind it for a full socket timeout.
+        self._reply_all(replies, all_conns)
+        return agg
+
+    def _encode_reply(self, agg: dict, meta: dict, nonce: str | None) -> bytes:
+        """One reply blob, auth-aware (echoes the client's nonce with
+        role=server in auth mode)."""
+        if self.auth_key is None:
+            return wire.encode(agg, meta=meta, compression=self.compression)
+        return wire.encode(
+            agg,
+            meta={**meta, "role": "server", "nonce": nonce},
+            compression=self.compression,
+            auth_key=self.auth_key,
+        )
+
+    def _reply_all(
+        self, replies: dict[int, bytes], conns_map: dict[int, socket.socket]
+    ) -> None:
+        """Parallel reply fan-out: send_frame blocks on the client's ACK,
+        so a sequential loop would let one dead client stall every healthy
+        one behind it for a full socket timeout."""
+
         def _reply(cid: int, conn: socket.socket) -> None:
             try:
                 framing.send_frame(conn, replies[cid])
@@ -1216,14 +1421,15 @@ class AggregationServer:
                 conn.close()
 
         reply_threads = [
-            threading.Thread(target=_reply, args=(cid, conns[cid]), daemon=True)
-            for cid in ids
+            threading.Thread(
+                target=_reply, args=(cid, conns_map[cid]), daemon=True
+            )
+            for cid in replies
         ]
         for t in reply_threads:
             t.start()
         for t in reply_threads:
             t.join(timeout=self.timeout)
-        return agg
 
     def serve(self, rounds: int = 1) -> None:
         """Multi-round loop: one failed round (quorum missed, DP base

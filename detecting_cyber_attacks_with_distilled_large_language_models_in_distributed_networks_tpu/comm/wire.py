@@ -69,11 +69,25 @@ SESSION_LEN = 16
 #: arrived, replies KEYS_MAGIC + num_clients x (u64 id + pubkey [+ tag]).
 PUBKEY_MAGIC = b"DHPK"
 KEYS_MAGIC = b"DHKS"
-#: Central-DP advert sent by a DP server on connect (after the nonce, if
-#: any; before the secure round advert): DP_MAGIC + f64 clip + f64 noise
-#: multiplier. DP uploads are CLIPPED ROUND DELTAS and the DP reply is the
-#: noised mean delta (the server never holds absolute weights).
+#: Central-DP handshake (after the nonce, if any; before the secure round
+#: advert). The client first identifies itself — DPID_MAGIC + i64
+#: client_id — so the server can apply per-round Poisson cohort sampling;
+#: the server answers DP_MAGIC + f64 clip + f64 noise multiplier + f64
+#: sampling rate q + u8 sampled flag. A sampled client proceeds with its
+#: clipped-round-delta upload; a non-sampled one sits the round out but
+#: still receives the round's reply (its base must track the fleet's).
+#: The DP reply is the noised mean delta over the round's contributors —
+#: the server never holds absolute weights — or a "noop" marker for an
+#: empty cohort.
 DP_MAGIC = b"DPAD"
+DPID_MAGIC = b"DPID"
+#: Auth-mode sit-out acknowledgment: a non-sampled client proves key
+#: knowledge — DPSKIP_MAGIC + HMAC(auth_key, domain + nonce + id) —
+#: before the server registers it for the round's reply (without this an
+#: unauthenticated connection could claim a sitting-out id, evict the
+#: real client's registration, and collect the aggregate).
+DPSKIP_MAGIC = b"DPSK"
+DPSKIP_DOMAIN = b"fedtpu-dp-skip-v1"
 _ALLOWED_DTYPES = {
     "float32", "float64", "float16", "bfloat16",
     "int8", "int16", "int32", "int64",
