@@ -67,9 +67,13 @@ def cmd_serve(args) -> int:
     dp_clip = float(getattr(args, "dp_clip", 0.0) or 0.0)
     dp_noise = float(getattr(args, "dp_noise_multiplier", 0.0) or 0.0)
     _dp_q_arg = getattr(args, "dp_participation", None)
-    # No `or 1.0` coercion: an explicit 0 must reach the server's
-    # validation and be rejected, not silently become full participation.
+    # No `or 1.0` coercion: an explicit 0 must be rejected, not silently
+    # become full participation. Validate before the banner math — the
+    # accountant would otherwise crash first with an internal-parameter
+    # traceback.
     dp_q = 1.0 if _dp_q_arg is None else float(_dp_q_arg)
+    if not 0.0 < dp_q <= 1.0:
+        raise SystemExit(f"--dp-participation {dp_q} must be in (0, 1]")
     rounds = args.rounds or 1
     if dp_clip > 0.0 and dp_noise > 0.0:
         # Same dual-adjacency accountant banner as the mesh tier
@@ -92,6 +96,17 @@ def cmd_serve(args) -> int:
                 "amplification assumes a hidden cohort)"
             )
         )
+        secure_note = ""
+        if bool(getattr(args, "secure_agg", False)):
+            # Masked uploads are uniform ring elements: the server CANNOT
+            # re-clip them, so the sensitivity bound (and with it the
+            # epsilon above) holds only if every client applies its own
+            # clip — standard for secure-agg DP, but it must be said.
+            secure_note = (
+                ". Secure-agg caveat: clipping is HONEST-CLIENT-ONLY "
+                "(masked uploads cannot be re-clipped server-side); one "
+                "dishonest client can widen the mechanism's sensitivity"
+            )
         log.info(
             f"[DP] client-level guarantee for {rounds} round(s): "
             f"({eps_zeroed:.3g}, 1e-05)-DP under zeroed-contribution "
@@ -100,6 +115,7 @@ def cmd_serve(args) -> int:
             f"{sampling_note}). Noise caveat: float32 Gaussian draws "
             "(OS-entropy Philox) — not hardened against the Mironov "
             "floating-point precision attack (no discrete Gaussian)"
+            f"{secure_note}"
         )
     elif dp_clip > 0.0:
         log.warning(

@@ -312,6 +312,50 @@ class FederatedClient:
                         raise wire.WireError("bad auth challenge from server")
                     nonce_hex = chal[len(wire.NONCE_MAGIC) :].hex()
                     attempt_meta.update(role="client", nonce=nonce_hex)
+                if not self.secure_agg and not self.dp and attempt > 1:
+                    # Mode diagnosis after a failed first attempt: a
+                    # secure/DP/auth server speaks FIRST (round advert /
+                    # DP advert / nonce challenge), which a plain client
+                    # never reads — its upload then dies as a malformed
+                    # hello and naive retries burn the whole budget the
+                    # same way. One short peek turns that loop into a
+                    # clean, non-retryable refusal naming the fix.
+                    sock.settimeout(0.3)
+                    try:
+                        stray = framing.recv_frame(sock)
+                    except (OSError, ConnectionError):
+                        stray = None
+                    finally:
+                        sock.settimeout(self.timeout)
+                    if stray is not None:
+                        if bytes(stray[:4]) == wire.ROUND_MAGIC:
+                            raise secure.SecureAggError(
+                                "server is running --secure-agg; run this "
+                                "client with --secure-agg"
+                                + (
+                                    " (and drop topk: sparse deltas "
+                                    "cannot be masked — masked uploads "
+                                    "are uniform ring elements with no "
+                                    "sparsity)"
+                                    if self._topk_frac is not None
+                                    else ""
+                                )
+                            )
+                        if bytes(stray[:4]) == wire.DP_MAGIC:
+                            raise wire.ModeError(
+                                "server is running --dp-clip; run this "
+                                "client with --dp"
+                            )
+                        if bytes(stray[:4]) == wire.NONCE_MAGIC:
+                            raise wire.ModeError(
+                                "server requires authentication; set "
+                                "FEDTPU_SECRET for this client"
+                            )
+                        raise wire.ModeError(
+                            "server opened with an unexpected frame "
+                            f"({bytes(stray[:4])!r}) — client/server "
+                            "mode mismatch"
+                        )
                 sitting_out = False
                 share_st = None
                 if self.dp:
@@ -326,14 +370,6 @@ class FederatedClient:
 
                     sock.settimeout(min(self.timeout, 30.0))
                     try:
-                        # send_frame blocks on the ACK, so a non-DP server
-                        # (which never reads the hello as a hello) fails
-                        # here or at the advert recv — both non-retryable.
-                        framing.send_frame(
-                            sock,
-                            wire.DPID_MAGIC
-                            + _struct.pack("<q", self.client_id),
-                        )
                         adv = framing.recv_frame(sock)
                     except socket.timeout:
                         # ModeError, not WireError: retries would stall
@@ -342,27 +378,15 @@ class FederatedClient:
                             "server sent no DP advert — is it running "
                             "with --dp-clip?"
                         ) from None
-                    except ConnectionError:
-                        # Ambiguous: a non-DP server drops the id hello
-                        # (it reads as a bad upload), but a transient RST
-                        # against a genuine DP server looks identical —
-                        # stay RETRYABLE and leave a hint for the
-                        # repeating case.
-                        log.info(
-                            f"[CLIENT {self.client_id}] connection dropped "
-                            "during the DP handshake — if this repeats, "
-                            "the server may not be running with --dp-clip"
-                        )
-                        raise
                     finally:
                         sock.settimeout(self.timeout)
                     n_magic = len(wire.DP_MAGIC)
-                    if len(adv) != n_magic + 25 or not adv.startswith(
+                    if len(adv) != n_magic + 24 or not adv.startswith(
                         wire.DP_MAGIC
                     ):
                         raise wire.ModeError("bad DP advert from server")
                     dp_clip, dp_noise, dp_q = _struct.unpack(
-                        "<ddd", adv[n_magic : n_magic + 24]
+                        "<ddd", adv[n_magic:]
                     )
                     if not dp_clip > 0.0:
                         raise wire.WireError(
@@ -372,7 +396,18 @@ class FederatedClient:
                         raise wire.WireError(
                             f"DP advert carries sampling rate q={dp_q}"
                         )
-                    if adv[-1] == 0:
+                    # Identify ourselves; the server answers the round's
+                    # cohort verdict for this id.
+                    framing.send_frame(
+                        sock,
+                        wire.DPID_MAGIC + _struct.pack("<q", self.client_id),
+                    )
+                    verdict = framing.recv_frame(sock)
+                    if len(verdict) != len(wire.DPCOHORT_MAGIC) + 1 or (
+                        not verdict.startswith(wire.DPCOHORT_MAGIC)
+                    ):
+                        raise wire.WireError("bad DP cohort verdict")
+                    if verdict[-1] == 0:
                         if dp_q >= 1.0:
                             raise wire.WireError(
                                 "server claims this client is not sampled "
@@ -644,16 +679,20 @@ class FederatedClient:
                         "aggregated reply failed the freshness check "
                         "(stale nonce or wrong role) — possible replay"
                     )
-                if self.secure_agg:
+                if self.secure_agg and this_call is not None:
                     # Round complete: drop this round's (and any older)
                     # per-round keypair/share state — _used_rounds already
                     # forbids re-entering them, and seeds for finished
                     # rounds must not linger in memory round after round.
+                    # Guarded on this_call: a sitting-out sampled round
+                    # never ran the secure handshake, so (session,
+                    # round_no) are unbound there.
+                    done_session, done_round = this_call
                     for store in (self._round_keys, self._round_shares):
                         for k in [
                             k
                             for k in store
-                            if k[0] == session and k[1] <= round_no
+                            if k[0] == done_session and k[1] <= done_round
                         ]:
                             del store[k]
                 log.info(

@@ -309,9 +309,21 @@ class AggregationServer:
             if self.dp_clip > 0.0:
                 import struct as _dstruct
 
-                # DP handshake: the client identifies itself first so the
-                # round's Poisson cohort decision can be made (and told)
-                # before any model bytes move.
+                # DP handshake, server-first: the mode advert lets a
+                # mis-configured plain client diagnose the mismatch; the
+                # client then identifies itself so the round's Poisson
+                # cohort verdict can be made (and told) before any model
+                # bytes move.
+                framing.send_frame(
+                    conn,
+                    wire.DP_MAGIC
+                    + _dstruct.pack(
+                        "<ddd",
+                        self.dp_clip,
+                        self.dp_noise_multiplier,
+                        self.dp_participation,
+                    ),
+                )
                 idf = framing.recv_frame(conn)
                 if len(idf) != len(wire.DPID_MAGIC) + 8 or (
                     not idf.startswith(wire.DPID_MAGIC)
@@ -324,14 +336,7 @@ class AggregationServer:
                     sampled = rnd.cohort is None or dpid in rnd.cohort
                 framing.send_frame(
                     conn,
-                    wire.DP_MAGIC
-                    + _dstruct.pack(
-                        "<ddd",
-                        self.dp_clip,
-                        self.dp_noise_multiplier,
-                        self.dp_participation,
-                    )
-                    + bytes([1 if sampled else 0]),
+                    wire.DPCOHORT_MAGIC + bytes([1 if sampled else 0]),
                 )
                 if not sampled:
                     # Sitting out: no upload, but the client still gets
@@ -1087,6 +1092,9 @@ class AggregationServer:
                             "without the missing sitting-out client(s) "
                             f"after {skip_grace:.0f}s grace"
                         )
+                        # Set the event too: the post-loop complete.wait
+                        # must not re-stall for the full round deadline.
+                        rnd.complete.set()
                         break
                 else:
                     uploads_done_at = None

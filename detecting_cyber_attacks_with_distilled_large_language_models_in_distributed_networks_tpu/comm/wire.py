@@ -70,17 +70,19 @@ SESSION_LEN = 16
 PUBKEY_MAGIC = b"DHPK"
 KEYS_MAGIC = b"DHKS"
 #: Central-DP handshake (after the nonce, if any; before the secure round
-#: advert). The client first identifies itself — DPID_MAGIC + i64
-#: client_id — so the server can apply per-round Poisson cohort sampling;
-#: the server answers DP_MAGIC + f64 clip + f64 noise multiplier + f64
-#: sampling rate q + u8 sampled flag. A sampled client proceeds with its
-#: clipped-round-delta upload; a non-sampled one sits the round out but
-#: still receives the round's reply (its base must track the fleet's).
-#: The DP reply is the noised mean delta over the round's contributors —
-#: the server never holds absolute weights — or a "noop" marker for an
-#: empty cohort.
+#: advert). The server speaks FIRST — DP_MAGIC + f64 clip + f64 noise
+#: multiplier + f64 sampling rate q — so a mis-configured plain client
+#: can diagnose the mode mismatch; the client identifies itself
+#: (DPID_MAGIC + i64 client_id) and the server answers the per-round
+#: Poisson cohort verdict (DPCOHORT_MAGIC + u8 sampled). A sampled
+#: client proceeds with its clipped-round-delta upload; a non-sampled
+#: one sits the round out but still receives the round's reply (its base
+#: must track the fleet's). The DP reply is the noised mean delta over
+#: the round's contributors — the server never holds absolute weights —
+#: or a "noop" marker for an empty cohort.
 DP_MAGIC = b"DPAD"
 DPID_MAGIC = b"DPID"
+DPCOHORT_MAGIC = b"DPCO"
 #: Auth-mode sit-out acknowledgment: a non-sampled client proves key
 #: knowledge — DPSKIP_MAGIC + HMAC(auth_key, domain + nonce + id) —
 #: before the server registers it for the round's reply (without this an

@@ -166,13 +166,16 @@ def test_server_enforces_the_clip(rng):
         sock = connect_with_retry("127.0.0.1", server.port, timeout=10)
         try:
             sock.settimeout(10)
+            adv = framing.recv_frame(sock)
+            assert bytes(adv[:4]) == wire.DP_MAGIC
+            clip, _, q = struct.unpack("<ddd", adv[4:])
+            assert clip == 1.0 and q == 1.0
             framing.send_frame(
                 sock, wire.DPID_MAGIC + struct.pack("<q", 0)
             )
-            adv = framing.recv_frame(sock)
-            assert bytes(adv[:4]) == wire.DP_MAGIC
-            clip, _, q = struct.unpack("<ddd", adv[4:28])
-            assert clip == 1.0 and q == 1.0 and adv[-1] == 1
+            verdict = framing.recv_frame(sock)
+            assert bytes(verdict[:4]) == wire.DPCOHORT_MAGIC
+            assert verdict[-1] == 1
             framing.send_frame(
                 sock,
                 wire.encode(
@@ -399,9 +402,10 @@ def test_upload_from_non_sampled_client_rejected(rng):
         sock = connect_with_retry("127.0.0.1", server.port, timeout=10)
         try:
             sock.settimeout(10)
+            framing.recv_frame(sock)  # mode advert
             framing.send_frame(sock, wire.DPID_MAGIC + struct.pack("<q", 0))
-            adv = framing.recv_frame(sock)
-            assert adv[-1] == 0  # told to sit out
+            verdict = framing.recv_frame(sock)
+            assert verdict[-1] == 0  # told to sit out
             # Upload anyway (claiming id 0): the server never reads it as
             # a model — the frame's ACK never comes and the connection is
             # dropped at round close, so the rogue upload cannot land.
@@ -468,3 +472,59 @@ def test_dp_participation_banner_exact():
     eps_full = dp_epsilon(1, 1.0, 1e-5)
     assert eps_q < eps_full
     assert f"({eps_q:.3g}, 1e-05)-DP under zeroed-contribution" in banner[0]
+
+
+def test_secure_dp_banner_states_honest_clipping():
+    """VERDICT r4 weak #3: the secure+DP serve banner must say the
+    guarantee is honest-client-only (masked uploads cannot be re-clipped
+    server-side)."""
+    import logging
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
+        main,
+    )
+
+    msgs: list[str] = []
+
+    class _Cap(logging.Handler):
+        def emit(self, record):
+            msgs.append(record.getMessage())
+
+    logger = logging.getLogger("fedtpu")
+    h = _Cap()
+    logger.addHandler(h)
+    try:
+        rc = main(
+            [
+                "serve", "--port", "0", "--num-clients", "2",
+                "--secure-agg", "--dp-clip", "0.5",
+                "--dp-noise-multiplier", "1.0",
+                "--rounds", "1", "--timeout", "0.3",
+            ]
+        )
+    finally:
+        logger.removeHandler(h)
+    assert rc == 0
+    banner = [m for m in msgs if "[DP]" in m]
+    assert banner, msgs
+    assert "HONEST-CLIENT-ONLY" in banner[0]
+    assert "cannot be re-clipped server-side" in banner[0]
+
+
+def test_plain_client_diagnoses_dp_server(rng):
+    """A plain client against a --dp-clip server gets a clean ModeError
+    naming the fix after one failed probe attempt (the server speaks
+    first, so the retry peek can see the DP advert) — not a burned
+    retry budget."""
+    with AggregationServer(
+        port=0, num_clients=2, timeout=10, dp_clip=1.0
+    ) as server:
+        st = threading.Thread(
+            target=lambda: server.serve_round(deadline=12), daemon=True
+        )
+        st.start()
+        plain = FederatedClient(
+            "127.0.0.1", server.port, client_id=0, timeout=10
+        )
+        with pytest.raises(wire.ModeError, match="--dp"):
+            plain.exchange({"w": np.zeros(2, np.float32)}, max_retries=5)

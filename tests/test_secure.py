@@ -1292,3 +1292,30 @@ def test_shamir_roundtrip_and_threshold():
         shamir.split(secret, [1, 1, 2], 2)  # duplicate x
     with pytest.raises(shamir.ShamirError):
         shamir.split(secret, [0, 1], 2)  # x=0 would leak the secret
+
+
+def test_topk_client_refused_cleanly_by_secure_server(rng):
+    """Contract pin (VERDICT r4 weak #5): sparse-delta (topk) uploads do
+    not compose with secure aggregation — masked uploads are uniform
+    ring elements with no sparsity. A topk client pointed at a secure
+    server gets a clean, NON-RETRYABLE refusal naming the fix (one
+    failed probe attempt, then the mode diagnosis — not a burned retry
+    budget), and the plain client gets the same diagnosis."""
+    with AggregationServer(
+        port=0, num_clients=2, timeout=20, secure_agg=True
+    ) as server:
+        st = threading.Thread(
+            target=lambda: server.serve_round(deadline=15), daemon=True
+        )
+        st.start()
+        topk = FederatedClient(
+            "127.0.0.1", server.port, client_id=0, timeout=10,
+            compression="topk:0.05",
+        )
+        with pytest.raises(SecureAggError, match="drop topk"):
+            topk.exchange(_params(rng), max_retries=5)
+        plain = FederatedClient(
+            "127.0.0.1", server.port, client_id=1, timeout=10
+        )
+        with pytest.raises(SecureAggError, match="--secure-agg"):
+            plain.exchange(_params(rng), max_retries=5)
