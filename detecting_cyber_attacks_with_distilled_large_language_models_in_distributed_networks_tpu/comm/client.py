@@ -320,7 +320,10 @@ class FederatedClient:
                     # hello and naive retries burn the whole budget the
                     # same way. One short peek turns that loop into a
                     # clean, non-retryable refusal naming the fix.
-                    sock.settimeout(0.3)
+                    # Window scaled off the configured timeout: a 0.3 s
+                    # constant would miss the advert on a slow link and
+                    # silently fall back to burning the retry budget.
+                    sock.settimeout(min(self.timeout, 2.0))
                     try:
                         stray = framing.recv_frame(sock)
                     except (OSError, ConnectionError):

@@ -1098,8 +1098,13 @@ class AggregationServer:
                         break
                 else:
                     uploads_done_at = None
-            self._sock.settimeout(max(0.05, min(1.0, deadline - time.monotonic())))
             try:
+                # settimeout inside the guard: close() mid-round (a test
+                # or operator shutdown) invalidates the fd and must end
+                # the loop, not crash the round thread.
+                self._sock.settimeout(
+                    max(0.05, min(1.0, deadline - time.monotonic()))
+                )
                 conn, addr = self._sock.accept()
             except socket.timeout:
                 continue
