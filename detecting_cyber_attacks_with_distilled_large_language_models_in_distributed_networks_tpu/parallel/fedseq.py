@@ -39,7 +39,7 @@ count.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -282,6 +282,56 @@ def make_fedseq_train_step(
     return step
 
 
+def make_fedseq_packed_loss(
+    model,
+    mesh: Mesh,
+    *,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+    dropout: bool = False,
+    prox_mu: float = 0.0,
+) -> Callable:
+    """ONE client's sequence-parallel loss with NO client axis and NO
+    vmap — the client-packing fast path's inner program (see
+    train/fedsteps.py build_packed_step for the measured rationale; the
+    3-axis variant additionally drops the inner unit vmap that the
+    stacked program carries even at one local client). Signature:
+    ``(params, [anchor,] ids [B,L], mask [B,L], labels [B][, key]) ->
+    scalar mean loss`` (``(objective, task)`` under FedProx)."""
+
+    def local_loss(p_l, *rest):
+        if prox_mu > 0.0:
+            anchor_l, rest = rest[0], rest[1:]
+        ids_l, mask_l, labels_l, *key_l = rest
+        if dropout:
+            logits = model.apply(
+                {"params": p_l}, ids_l, mask_l, False,
+                rngs={"dropout": key_l[0]},
+            )
+        else:
+            logits = model.apply({"params": p_l}, ids_l, mask_l, True)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels_l
+        ).mean()
+        task = jax.lax.pmean(loss, data_axis)
+        if prox_mu == 0.0:
+            return task
+        return task + 0.5 * prox_mu * prox_sq(p_l, anchor_l), task
+
+    in_specs = [P()]
+    if prox_mu > 0.0:
+        in_specs.append(P())
+    in_specs += [P(data_axis, seq_axis), P(data_axis, seq_axis), P(data_axis)]
+    if dropout:
+        in_specs.append(P())
+    return jax.shard_map(
+        local_loss,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=P() if prox_mu == 0.0 else (P(), P()),
+    )
+
+
 class FedSeqSteps(NamedTuple):
     """FedState-compatible jitted programs for the 3-axis composition —
     the same call signatures as train/fedsteps.py's FedSteps train/eval
@@ -290,6 +340,9 @@ class FedSeqSteps(NamedTuple):
     train_step: Callable  # (FedState, batch) -> (FedState, [C] losses)
     build_ragged_step: Callable  # () -> (FedState, batch) -> (FedState, ([C], [C]))
     eval_step: Callable  # (params, batch, valid) -> (BinaryCounts [C], probs [C,B])
+    # () -> per-client packed step (client-packing fast path; see
+    # train/fedsteps.py build_packed_step)
+    build_packed_step: Callable = None
 
 
 def build_fedseq_steps(cfg, model, optimizer, mesh: Mesh) -> FedSeqSteps:
@@ -491,10 +544,55 @@ def build_fedseq_steps(cfg, model, optimizer, mesh: Mesh) -> FedSeqSteps:
             batch["labels"], valid,
         )
 
+    build_packed_step = lru_cache(maxsize=1)(
+        lambda: _build_fedseq_packed_step(
+            model, optimizer, mesh, dropout=dropout, mu=mu, wsteps=wsteps
+        )
+    )
+
     return FedSeqSteps(
         train_step=train_step,
         build_ragged_step=build_ragged_step,
         eval_step=eval_step,
+        build_packed_step=build_packed_step,
+    )
+
+
+def _build_fedseq_packed_step(
+    model, optimizer, mesh: Mesh, *, dropout: bool, mu: float, wsteps: int
+) -> Callable:
+    """Jitted per-client packed fedseq step:
+    ``(cstate, batch[, anchor]) -> (cstate, task)`` with
+    ``cstate = (params, opt_state, step, rng)``; donated buffers. Same
+    math as the stacked 3-axis step for one client — pinned by
+    tests/test_fedseq.py::test_packed_fedseq_matches_stacked."""
+    loss = make_fedseq_packed_loss(model, mesh, dropout=dropout, prox_mu=mu)
+
+    def body(cstate, batch, anchor):
+        params, opt_state, step, rng = cstate
+        keys = (jax.random.fold_in(rng, step),) if dropout else ()
+
+        def total(p):
+            args = (p,) if mu == 0.0 else (p, anchor)
+            out = loss(
+                *args, batch["input_ids"], batch["attention_mask"],
+                batch["labels"], *keys,
+            )
+            obj, task = out if mu > 0.0 else (out, out)
+            return obj, task
+
+        (_, task), grads = jax.value_and_grad(total, has_aux=True)(params)
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        updates = apply_warmup(updates, step, wsteps)
+        return (
+            (optax.apply_updates(params, updates), new_opt, step + 1, rng),
+            task,
+        )
+
+    if mu > 0.0:
+        return jax.jit(body, donate_argnums=(0,))
+    return jax.jit(
+        lambda cstate, batch: body(cstate, batch, None), donate_argnums=(0,)
     )
 
 

@@ -106,6 +106,12 @@ class FedSeqTrainer(FederatedTrainer):
         self.eval_step = steps.eval_step
         self._build_ragged_step = steps.build_ragged_step
         self._ragged_train_step = None
+        # Client-packing fast path, 3-axis variant: per-client ring-path
+        # step with no client axis and no inner vmap (parallel/fedseq.py
+        # make_fedseq_packed_loss) — shadows the dense packed builder the
+        # super() call installed.
+        self._build_packed_step = steps.build_packed_step
+        self._packed_step = None
 
     def _feed(self, batch: dict[str, Any]) -> dict[str, Any]:
         """[C, B, L] token arrays shard over (clients, data, seq); [C, B]

@@ -391,3 +391,73 @@ def test_fedseq_eval_counts_match_two_axis_trainer(eight_devices):
         for k in ("Accuracy", "Precision", "Recall", "F1-Score"):
             np.testing.assert_allclose(a[k], b[k], atol=1e-4, err_msg=k)
         np.testing.assert_allclose(a["Loss"], b["Loss"], atol=1e-3)
+
+
+def test_packed_fedseq_matches_stacked(tok_fixture_probe=None):
+    """3-axis variant of the packing parity: FedSeqTrainer on a
+    single-device 1x1x1 mesh takes the packed per-client ring-path step;
+    the same config on a 2-device mesh runs the stacked shard_map
+    program. One epoch from one init must agree."""
+    import jax
+    import numpy as np
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+        default_tokenizer,
+        make_all_client_splits,
+        make_synthetic_flows,
+        stack_clients,
+        tokenize_client,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        FedConfig,
+        MeshConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.parallel.fedseq import (
+        make_seq_mesh,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.seqfed import (
+        FedSeqTrainer,
+    )
+
+    L = 32
+    tok = default_tokenizer()
+    df = make_synthetic_flows(480, seed=5)
+    dcfg = DataConfig(data_fraction=0.9, max_len=L)
+    splits = make_all_client_splits(df, 2, dcfg)
+    clients = [tokenize_client(s, tok, max_len=L) for s in splits]
+    stacked_train = stack_clients([c.train for c in clients])
+    cfg = ExperimentConfig(
+        model=ModelConfig.tiny(
+            vocab_size=len(tok), max_len=L, max_position_embeddings=L,
+            dim=32, n_layers=2, n_heads=2, hidden_dim=64,
+        ),
+        data=DataConfig(data_fraction=0.9, max_len=L, batch_size=8),
+        train=TrainConfig(learning_rate=1e-3, epochs_per_round=1, seed=0),
+        fed=FedConfig(num_clients=2),
+        mesh=MeshConfig(clients=1, data=1, seq=1),
+    )
+    devs = jax.devices()
+    packed = FedSeqTrainer(
+        cfg, pad_id=tok.pad_id,
+        mesh=make_seq_mesh(1, 1, 1, devices=devs[:1]),
+    )
+    assert packed._packed_eligible()
+    import dataclasses
+
+    cfg2 = dataclasses.replace(
+        cfg, mesh=MeshConfig(clients=2, data=1, seq=1)
+    )
+    stacked = FedSeqTrainer(
+        cfg2, pad_id=tok.pad_id,
+        mesh=make_seq_mesh(2, 1, 1, devices=devs[:2]),
+    )
+    assert not stacked._packed_eligible()
+    sp, lp = packed.fit_local(packed.init_state(), stacked_train, epochs=1)
+    sv, lv = stacked.fit_local(stacked.init_state(), stacked_train, epochs=1)
+    np.testing.assert_allclose(lp, lv, atol=1e-4)
+    for a, b in zip(jax.tree.leaves(sp.params), jax.tree.leaves(sv.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
