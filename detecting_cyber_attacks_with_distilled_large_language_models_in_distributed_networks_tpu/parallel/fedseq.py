@@ -562,38 +562,25 @@ def _build_fedseq_packed_step(
     model, optimizer, mesh: Mesh, *, dropout: bool, mu: float, wsteps: int
 ) -> Callable:
     """Jitted per-client packed fedseq step:
-    ``(cstate, batch[, anchor]) -> (cstate, task)`` with
-    ``cstate = (params, opt_state, step, rng)``; donated buffers. Same
-    math as the stacked 3-axis step for one client — pinned by
+    ``(cstate, batch[, anchor]) -> (cstate, task)`` — the shared packed
+    builder (train/fedsteps.py make_packed_step: same rng fold, Adam,
+    warmup, donation as the dense path) over the 3-axis packed loss.
+    Same math as the stacked 3-axis step for one client — pinned by
     tests/test_fedseq.py::test_packed_fedseq_matches_stacked."""
+    from ..train.fedsteps import make_packed_step
+
     loss = make_fedseq_packed_loss(model, mesh, dropout=dropout, prox_mu=mu)
 
-    def body(cstate, batch, anchor):
-        params, opt_state, step, rng = cstate
-        keys = (jax.random.fold_in(rng, step),) if dropout else ()
-
-        def total(p):
-            args = (p,) if mu == 0.0 else (p, anchor)
-            out = loss(
-                *args, batch["input_ids"], batch["attention_mask"],
-                batch["labels"], *keys,
-            )
-            obj, task = out if mu > 0.0 else (out, out)
-            return obj, task
-
-        (_, task), grads = jax.value_and_grad(total, has_aux=True)(params)
-        updates, new_opt = optimizer.update(grads, opt_state, params)
-        updates = apply_warmup(updates, step, wsteps)
-        return (
-            (optax.apply_updates(params, updates), new_opt, step + 1, rng),
-            task,
+    def objective(p, batch, step_rng, anchor):
+        keys = (step_rng,) if dropout else ()
+        args = (p,) if mu == 0.0 else (p, anchor)
+        out = loss(
+            *args, batch["input_ids"], batch["attention_mask"],
+            batch["labels"], *keys,
         )
+        return out if mu > 0.0 else (out, out)
 
-    if mu > 0.0:
-        return jax.jit(body, donate_argnums=(0,))
-    return jax.jit(
-        lambda cstate, batch: body(cstate, batch, None), donate_argnums=(0,)
-    )
+    return make_packed_step(objective, optimizer, wsteps, mu)
 
 
 def init_fedseq_state(

@@ -386,18 +386,57 @@ class FederatedTrainer:
             self._slice_client_fn = fn
         return fn
 
+    @property
+    def _unstack_fn(self):
+        """Jitted, memoized stacked->per-client splitter that DONATES the
+        stacked params/opt buffers: the packed fit must not pin the
+        stacked originals alongside the per-client copies for its whole
+        duration (Python references in caller frames keep the FedState
+        alive; donation frees the buffers regardless — the same contract
+        the vmapped train step already imposes on its input state)."""
+        fn = getattr(self, "_unstack_fn_cache", None)
+        if fn is None:
+            C = self.C
+
+            def unstack(params, opt_state):
+                return (
+                    [jax.tree.map(lambda x: x[c], params) for c in range(C)],
+                    [
+                        jax.tree.map(lambda x: x[c], opt_state)
+                        for c in range(C)
+                    ],
+                )
+
+            fn = jax.jit(unstack, donate_argnums=(0, 1))
+            self._unstack_fn_cache = fn
+        return fn
+
+    @property
+    def _restack_fn(self):
+        """Jitted, memoized per-client->stacked assembler (a fresh jit
+        per fit would re-trace the full params+opt stacking program every
+        round)."""
+        fn = getattr(self, "_restack_fn_cache", None)
+        if fn is None:
+            fn = jax.jit(
+                lambda *ts: jax.tree.map(lambda *xs: jnp.stack(xs), *ts),
+                out_shardings=self.sh.client,
+            )
+            self._restack_fn_cache = fn
+        return fn
+
     def _unstack_cstates(self, state: FedState) -> list:
         """FedState -> per-client ``(params, opt_state, step, rng)``
-        tuples for the packed step. Every leaf is this client's OWN fresh
-        buffer — the packed step donates its cstate, so a buffer shared
-        across clients (state.step) would be dead by client 1's first
-        dispatch. Shared by the fit loop and bench.py's product-step
-        timer."""
-        slice_c = self._slice_client
+        tuples for the packed step. CONSUMES the stacked params/opt
+        buffers (donation). Every leaf is this client's OWN fresh buffer
+        — the packed step donates its cstate, so a buffer shared across
+        clients (state.step) would be dead by client 1's first dispatch.
+        Shared by the fit loop and bench.py's product-step timer."""
+        pcs, ocs = self._unstack_fn(state.params, state.opt_state)
         return [
             (
-                slice_c(state.params, c),
-                slice_c(state.opt_state, c),
+                pcs[c],
+                ocs[c],
                 jnp.copy(state.step),
                 jnp.copy(state.rngs[c]),
             )
@@ -439,17 +478,13 @@ class FederatedTrainer:
         step_fn = self._packed_step
         C = self.C
         mu = self.cfg.fed.prox_mu
-        cstates = self._unstack_cstates(state)
         slice_c = self._slice_client
-        # FedProx anchors: fresh round-start slices (never donated).
+        # FedProx anchors: fresh round-start slices, taken BEFORE the
+        # unstack below donates (consumes) the stacked params.
         anchors = (
             [slice_c(state.params, c) for c in range(C)] if mu > 0.0 else None
         )
-        # Drop the stacked params/opt references for the duration of the
-        # fit: every client's slices are fresh buffers, and keeping the
-        # stacked originals pinned would double peak HBM vs the donating
-        # vmapped path (restack rebuilds them at the end).
-        state = state._replace(params=None, opt_state=None)
+        cstates = self._unstack_cstates(state)
         out = []
         telemetry = self._step_telemetry()
         for epoch in range(epoch_offset, epoch_offset + E):
@@ -484,10 +519,7 @@ class FederatedTrainer:
                     f"Client {c} Epoch [{epoch - epoch_offset + 1}/{E}], "
                     f"Average Loss: {out[-1][c]:.4f}"
                 )
-        restack = jax.jit(
-            lambda *ts: jax.tree.map(lambda *xs: jnp.stack(xs), *ts),
-            out_shardings=self.sh.client,
-        )
+        restack = self._restack_fn
         state = state._replace(
             params=restack(*[cs[0] for cs in cstates]),
             opt_state=restack(*[cs[1] for cs in cstates]),

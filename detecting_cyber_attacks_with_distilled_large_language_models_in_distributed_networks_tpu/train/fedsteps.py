@@ -35,6 +35,40 @@ from ..utils.logging import get_logger
 log = get_logger()
 
 
+def make_packed_step(objective, optimizer, wsteps: int, mu: float) -> Callable:
+    """The SINGLE per-client packed step builder (shared by the dense and
+    3-axis fedseq paths — their update math must never diverge).
+
+    ``objective(params, batch, step_rng, anchor) -> (objective, task)``
+    supplies the loss; everything else — the per-step rng fold off the
+    lockstep counter, Adam, warmup, donation — is identical to one lane
+    of the stacked vmapped step. Signature of the returned program:
+    ``(cstate, batch[, anchor]) -> (cstate, task_loss)`` with
+    ``cstate = (params, opt_state, step, rng)`` (one client's buffers,
+    donated)."""
+
+    def body(cstate, batch, anchor):
+        params, opt_state, step, rng = cstate
+        step_rng = jax.random.fold_in(rng, step)
+        (_, task), grads = jax.value_and_grad(
+            lambda p: objective(p, batch, step_rng, anchor),
+            has_aux=True,
+        )(params)
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        updates = apply_warmup(updates, step, wsteps)
+        return (
+            (optax.apply_updates(params, updates), new_opt, step + 1, rng),
+            task,
+        )
+
+    if mu > 0.0:
+        return jax.jit(body, donate_argnums=(0,))
+    return jax.jit(
+        lambda cstate, batch: body(cstate, batch, None),
+        donate_argnums=(0,),
+    )
+
+
 class FedState(NamedTuple):
     """Stacked per-client training state; every leaf's axis 0 is clients."""
 
@@ -196,37 +230,8 @@ def build_federated_steps(cfg, model, optimizer, sh) -> FedSteps:
         under threefry dropout keys (pinned by
         test_federated.py::test_packed_fit_matches_vmapped) — the default
         rbg impl generates layout-dependent bitstreams, so there the two
-        paths draw different, equally distributed dropout masks.
-
-        Signature: ``(cstate, batch[, anchor]) -> (cstate, task_loss)``
-        with ``cstate = (params, opt_state, step, rng)`` (one client's
-        slices; buffers donated)."""
-
-        def body(cstate, batch, anchor):
-            params, opt_state, step, rng = cstate
-            step_rng = jax.random.fold_in(rng, step)
-            (_, task), grads = jax.value_and_grad(
-                lambda p: local_loss(p, batch, step_rng, anchor),
-                has_aux=True,
-            )(params)
-            updates, new_opt = optimizer.update(grads, opt_state, params)
-            updates = apply_warmup(updates, step, wsteps)
-            return (
-                (
-                    optax.apply_updates(params, updates),
-                    new_opt,
-                    step + 1,
-                    rng,
-                ),
-                task,
-            )
-
-        if mu > 0.0:
-            return jax.jit(body, donate_argnums=(0,))
-        return jax.jit(
-            lambda cstate, batch: body(cstate, batch, None),
-            donate_argnums=(0,),
-        )
+        paths draw different, equally distributed dropout masks."""
+        return make_packed_step(local_loss, optimizer, wsteps, mu)
 
     @lru_cache(maxsize=1)
     def build_ragged_step():

@@ -446,6 +446,7 @@ def test_flash_dropout_deterministic_and_unbiased(rng):
     np.testing.assert_allclose(np.asarray(out_det), np.asarray(base), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_flash_dropout_gradients_check(rng):
     """The Pallas backward regenerates the identical dropout mask from the
     (seed, position) hash: reverse-mode grads must match finite differences

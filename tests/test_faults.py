@@ -121,6 +121,7 @@ def test_fault_below_quorum_fails_the_round(eight_devices):
         trainer.aggregate(state, client_mask=np.array([1.0, 0.0, 0.0, 1.0]))
 
 
+@pytest.mark.slow
 def test_recovery_round_after_fault(eight_devices):
     """A client dropped in round 0 rejoins in round 1 (it received the
     round-0 aggregate like everyone else — SPMD replicas move in lockstep),

@@ -276,6 +276,7 @@ def test_fedseq_trainer_dense_ragged_eval(eight_devices):
     np.testing.assert_allclose(leaf[0], leaf[1], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_fedseq_fedprox_matches_dense_trainer_and_bounds_drift(eight_devices):
     """Round-4 done-criterion: FedProx runs under --seq-parallel. The
     3-axis prox trajectory matches the dense 2-axis trainer's (reported
@@ -393,14 +394,12 @@ def test_fedseq_eval_counts_match_two_axis_trainer(eight_devices):
         np.testing.assert_allclose(a["Loss"], b["Loss"], atol=1e-3)
 
 
-def test_packed_fedseq_matches_stacked(tok_fixture_probe=None):
+@pytest.mark.slow
+def test_packed_fedseq_matches_stacked():
     """3-axis variant of the packing parity: FedSeqTrainer on a
     single-device 1x1x1 mesh takes the packed per-client ring-path step;
     the same config on a 2-device mesh runs the stacked shard_map
     program. One epoch from one init must agree."""
-    import jax
-    import numpy as np
-
     from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
         default_tokenizer,
         make_all_client_splits,
@@ -425,7 +424,7 @@ def test_packed_fedseq_matches_stacked(tok_fixture_probe=None):
 
     L = 32
     tok = default_tokenizer()
-    df = make_synthetic_flows(480, seed=5)
+    df = make_synthetic_flows(240, seed=5)
     dcfg = DataConfig(data_fraction=0.9, max_len=L)
     splits = make_all_client_splits(df, 2, dcfg)
     clients = [tokenize_client(s, tok, max_len=L) for s in splits]

@@ -72,6 +72,7 @@ def test_load_hf_dir_missing_weights(tmp_path):
         load_hf_dir(str(tmp_path))
 
 
+@pytest.mark.slow
 def test_cli_local_from_hf_dir(hf_dir, tmp_path, monkeypatch):
     """End-to-end: fedtpu local --hf-dir trains from the pretrained encoder
     and writes the reference artifact set."""
@@ -232,6 +233,7 @@ def test_pth_migration_loads_reference_artifact(hf_dir, tmp_path):
         main(["predict", "--csv", csv, "--pth", pth, "--output", out])
 
 
+@pytest.mark.slow
 def test_distill_from_reference_pth(hf_dir, tmp_path):
     """Distill a migrated reference model (--pth teacher) into a shallower
     student (--student-layers): the full migration-then-compress flow."""

@@ -70,6 +70,7 @@ def test_dropout_train_vs_eval(tiny_params):
     assert np.abs(np.asarray(t1) - np.asarray(t2)).max() > 1e-6
 
 
+@pytest.mark.slow
 def test_tanh_gelu_matches_exact_within_bf16_rounding():
     """The default fast path (gelu='tanh') must be indistinguishable from
     HF's erf GELU at bf16 activation width — the basis for keeping it the
@@ -187,6 +188,7 @@ def test_remat_matches(tiny_params):
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_fused_qkv_matches_unfused():
     """fused_qkv computes identical logits from the identical parameter
     tree (the fusion is apply-time only; params/checkpoints/HF layout are

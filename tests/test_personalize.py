@@ -170,6 +170,7 @@ def test_trainable_head_engine_scope():
         TrainConfig(trainable="encoder")
 
 
+@pytest.mark.slow
 def test_cli_personalize_writes_third_metrics_csv(tmp_path, eight_devices):
     from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
         main,

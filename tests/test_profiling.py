@@ -100,6 +100,7 @@ def test_mfu_math():
     )
 
 
+@pytest.mark.slow
 def test_trace_noop_and_real(tmp_path):
     with trace(None):
         pass  # no-op path needs no profiler at all

@@ -188,6 +188,7 @@ def test_ragged_spmd_matches_manual_per_client_runs(eight_devices):
         )
 
 
+@pytest.mark.slow
 def test_zero_row_client_is_gated_not_fatal(eight_devices):
     """A client with an empty split (extreme Dirichlet skew) idles behind
     masks: its params stay at init through local training, and the auto

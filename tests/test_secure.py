@@ -1173,7 +1173,9 @@ def _run_double_round(C, dead_cid, die_after, rng):
     ) as server:
         st = threading.Thread(
             target=lambda: results.__setitem__(
-                "agg", server.serve_round(deadline=10)
+                # The dead-after-shares variant waits the full deadline
+                # for the missing upload — keep it short.
+                "agg", server.serve_round(deadline=6)
             )
         )
         st.start()
