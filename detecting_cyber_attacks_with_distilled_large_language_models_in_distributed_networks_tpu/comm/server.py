@@ -226,7 +226,14 @@ class AggregationServer:
         # independently with probability q every round — the sampler the
         # subsampled-Gaussian accountant assumes, so the TCP tier's
         # epsilon is exact under q < 1 (privacy amplification), mirroring
-        # the mesh tier's participation_mode="poisson".
+        # the mesh tier's participation_mode="poisson". Known limit
+        # (inherent to the delta-only DP design, not the sampler): a
+        # client that misses a round's REPLY entirely — skipped client
+        # crashing past the skip grace, or any client losing the reply —
+        # has a stale base from then on; its next upload fails the
+        # round's base-crc agreement and it cannot resync without a
+        # restart from the shared init, because a DP server never holds
+        # absolute weights to re-seed it from.
         self.dp_participation = float(dp_participation)
         # Noise generator: Philox (counter-based, 128-bit crypto-derived
         # keying) keyed from OS entropy, never seeded deterministically —
