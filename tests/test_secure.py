@@ -1162,40 +1162,46 @@ def _double_scripted_client(
         died.set()
 
 
-def _run_double_round(C, dead_cid, die_after, rng):
-    """One double-mask round with client ``dead_cid`` scripted to die at
-    ``die_after``; returns (params, results dict)."""
+def _run_double_round(C, dead, rng):
+    """One double-mask round with the ``dead`` clients — a list of
+    ``(cid, die_after)`` — scripted to drop at their phase; the rest are
+    real FederatedClients. Returns (params, results dict)."""
     params = [_params(rng) for _ in range(C)]
     results = {}
-    died = threading.Event()
+    dead_ids = {cid for cid, _ in dead}
+    events = {cid: threading.Event() for cid in dead_ids}
     with AggregationServer(
-        port=0, num_clients=C, timeout=20, secure_agg=True, min_clients=2,
+        port=0, num_clients=C, timeout=25, secure_agg=True, min_clients=2,
     ) as server:
         st = threading.Thread(
             target=lambda: results.__setitem__(
-                # The dead-after-shares variant waits the full deadline
-                # for the missing upload — keep it short.
+                # A dead-before-upload client makes the server wait the
+                # full upload deadline before recovery — keep it short.
                 "agg", server.serve_round(deadline=6)
             )
         )
         st.start()
-        dead = threading.Thread(
-            target=_double_scripted_client,
-            args=(server.port, dead_cid),
-            kwargs={
-                "die_after": die_after,
-                "died": died,
-                "params": params[dead_cid],
-            },
-        )
-        dead.start()
+        scripted = [
+            threading.Thread(
+                target=_double_scripted_client,
+                args=(server.port, cid),
+                kwargs={
+                    "die_after": die_after,
+                    "died": events[cid],
+                    "params": params[cid],
+                },
+            )
+            for cid, die_after in dead
+        ]
+        for t in scripted:
+            t.start()
 
         def _go(cid):
             results[cid] = FederatedClient(
                 "127.0.0.1",
                 server.port,
                 client_id=cid,
-                timeout=20,
+                timeout=25,
                 secure_agg=True,
                 num_clients=C,
                 min_participants=2,
@@ -1204,15 +1210,18 @@ def _run_double_round(C, dead_cid, die_after, rng):
         ts = [
             threading.Thread(target=_go, args=(c,))
             for c in range(C)
-            if c != dead_cid
+            if c not in dead_ids
         ]
         for t in ts:
             t.start()
         for t in ts:
-            t.join(timeout=30)
-        st.join(timeout=30)
-        dead.join(timeout=10)
-    assert died.is_set() and "agg" in results, sorted(results)
+            t.join(timeout=40)
+        st.join(timeout=40)
+        for t in scripted:
+            t.join(timeout=10)
+    assert all(e.is_set() for e in events.values()) and "agg" in results, (
+        sorted(results)
+    )
     return params, results
 
 
@@ -1223,7 +1232,7 @@ def test_double_mask_dropout_after_shares(rng):
     residue comes off the ring sum, and the round completes with the
     survivors' exact mean."""
     C = 3
-    params, results = _run_double_round(C, 2, "shares", rng)
+    params, results = _run_double_round(C, [(2, "shares")], rng)
     expected = aggregate_flat([flatten_params(p) for p in params[:2]])
     for key, arr in flatten_params(results[0]).items():
         np.testing.assert_allclose(
@@ -1239,7 +1248,7 @@ def test_double_mask_dropout_during_unmask(rng):
     threshold for its self-mask seed. The reveal-round variant failed
     this outright (old comm/secure.py threat model)."""
     C = 3
-    params, results = _run_double_round(C, 2, "upload", rng)
+    params, results = _run_double_round(C, [(2, "upload")], rng)
     expected = aggregate_flat([flatten_params(p) for p in params])
     for key, arr in flatten_params(results[0]).items():
         np.testing.assert_allclose(
@@ -1321,3 +1330,24 @@ def test_topk_client_refused_cleanly_by_secure_server(rng):
         )
         with pytest.raises(SecureAggError, match="--secure-agg"):
             plain.exchange(_params(rng), max_retries=5)
+
+
+@pytest.mark.slow
+def test_double_mask_combined_dropouts_at_threshold(rng):
+    """Both recovery mechanisms in ONE round at the exact Shamir
+    threshold: C=5 (t = majority = 3); client 4 deals shares then never
+    uploads (pair-mask recovery), client 3 uploads then dies before the
+    unmask round (self-mask recovery from the remaining holders), and
+    exactly t=3 survivors answer. The round completes with the mean over
+    the FOUR contributors — including the one that died during
+    unmasking."""
+    C = 5
+    params, results = _run_double_round(
+        C, [(4, "shares"), (3, "upload")], rng
+    )
+    # Contributors: 0, 1, 2 AND the unmask-phase casualty 3.
+    expected = aggregate_flat([flatten_params(p) for p in params[:4]])
+    for key, arr in flatten_params(results[0]).items():
+        np.testing.assert_allclose(
+            arr, expected[key], atol=2.0 / (1 << DEFAULT_FP_BITS)
+        )
