@@ -12,6 +12,10 @@ covers every deployment shape, parameterized by client id / count:
   predict     batch inference: flow CSV -> per-row P(attack) CSV, from a
               local/federated checkpoint or a fine-tuned --hf-dir (the
               deployment step the reference never ships)
+  infer-serve online inference: TCP scoring service with dynamic
+              micro-batching (bucketed warm jit paths), bounded-queue
+              admission control, and hot reload of new federated
+              checkpoints between batches (serving/)
   distill     teacher -> student knowledge distillation (the recipe behind
               the reference's pre-distilled encoder)
   serve       TCP aggregation server (demo-parity mode, reference server.py)
@@ -36,6 +40,7 @@ from .distill import cmd_distill
 from .federated import cmd_federated
 from .local import cmd_local
 from .predict import cmd_export_hf, cmd_predict
+from .serving import cmd_infer_serve
 
 
 def _wire_compression(spec: str) -> str:
@@ -442,6 +447,68 @@ def build_parser() -> argparse.ArgumentParser:
         help="P(attack) decision threshold (default 0.5)",
     )
     p.set_defaults(fn=cmd_predict)
+
+    p = sub.add_parser(
+        "infer-serve",
+        help="online inference: dynamic-batching TCP scoring service with "
+        "hot checkpoint reload",
+        epilog="Requests are one frame each (serving/protocol.py): "
+        '{"id": N, "text": "..."} or {"id": N, "features": {...}} with an '
+        "optional per-request deadline_ms; replies carry P(attack) plus "
+        "telemetry (model round, batch size, queue wait). A full queue or "
+        "a blown deadline gets an explicit reject frame, never a hang.",
+    )
+    _add_common(p)  # model/tokenizer/dataset resolution flags
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=12380)
+    p.add_argument(
+        "--checkpoint-dir",
+        help="serve (and hot-reload) from this local/federated training "
+        "checkpoint; new rounds are picked up between batches",
+    )
+    p.add_argument(
+        "--buckets",
+        default="1,8,32,128",
+        help="micro-batch bucket shapes; XLA compiles one program per "
+        "(bucket, seq) at startup and every request hits a warm path "
+        "(default 1,8,32,128)",
+    )
+    p.add_argument(
+        "--max-wait-ms",
+        type=float,
+        default=5.0,
+        help="batch gather window: how long the scorer coalesces after "
+        "the first queued request (latency floor a lone request pays; "
+        "default 5)",
+    )
+    p.add_argument(
+        "--max-queue",
+        type=int,
+        default=1024,
+        help="admission-control queue bound; a submit beyond it is "
+        "rejected immediately with a 503-style frame (default 1024)",
+    )
+    p.add_argument(
+        "--default-deadline-ms",
+        type=float,
+        default=None,
+        help="deadline applied to requests that name none (default: wait "
+        "forever); expired requests get an explicit reject frame",
+    )
+    p.add_argument(
+        "--reload-poll",
+        type=float,
+        default=2.0,
+        help="seconds between checkpoint-directory polls on the scorer's "
+        "idle tick (default 2)",
+    )
+    p.add_argument(
+        "--threshold",
+        type=float,
+        default=0.5,
+        help="P(attack) decision threshold in replies (default 0.5)",
+    )
+    p.set_defaults(fn=cmd_infer_serve)
 
     p = sub.add_parser("distill", help="teacher -> student knowledge distillation")
     _add_common(p)

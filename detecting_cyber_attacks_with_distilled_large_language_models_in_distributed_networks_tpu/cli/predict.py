@@ -15,10 +15,12 @@ from .common import _resolve_with_pretrained
 log = get_logger()
 
 
-def _restore_predict_params(cfg, tok, trainer, *, ckpt_dir=None):
+def _restore_predict_params(cfg, tok, trainer, *, ckpt_dir=None, step=None):
     """Trained weights for inference from a checkpoint directory
     (``cfg.checkpoint_dir`` unless ``ckpt_dir`` overrides — distill's
-    teacher restore points elsewhere).
+    teacher restore points elsewhere; ``step`` pins a specific saved step
+    — serving's hot reload needs params and round metadata read from ONE
+    snapshot, not whatever became latest between two reads).
 
     Understands both checkpoint flavors: a ``local``/``client`` TrainState
     (restored against this trainer's template, or the checkpoint's own
@@ -35,7 +37,8 @@ def _restore_predict_params(cfg, tok, trainer, *, ckpt_dir=None):
         # mistyped location (it would later masquerade as a real run dir).
         raise SystemExit(f"checkpoint dir {ckpt_dir} does not exist")
     with Checkpointer(ckpt_dir) as ckpt:
-        step = ckpt.latest_step()
+        if step is None:
+            step = ckpt.latest_step()
         if step is None:
             raise SystemExit(f"no checkpoint found in {ckpt_dir}")
         meta = ckpt.restore_meta(step=step)

@@ -1077,6 +1077,31 @@ class FederatedClient:
                 pair_secrets[dealer], session, round_no,
                 dealer, self.client_id, blob,
             )
+        # Pin U2 and the decrypted holder shares across retries of one
+        # round, exactly as ``participants`` is pinned above: the
+        # share-complete set is fixed once relayed. A retried connection
+        # relaying a DIFFERENT set (or different dealer shares) is the
+        # server steering this client between mask partitions to
+        # difference its uploads — fail closed, no retry (SecureAggError
+        # propagates past the retry loop).
+        if "u2" in st:
+            if st["u2"] != u2_sorted:
+                raise secure.SecureAggError(
+                    "share-complete set changed across retries of one "
+                    f"round (pinned {st['u2']}, relayed {u2_sorted}) — "
+                    "refusing the substituted shareset"
+                )
+            if st["holder_shares"] != holder_shares:
+                changed = sorted(
+                    d
+                    for d in holder_shares
+                    if st["holder_shares"].get(d) != holder_shares[d]
+                )
+                raise secure.SecureAggError(
+                    f"dealers {changed} re-dealt different shares on a "
+                    "retry of one round (U2 unchanged) — refusing the "
+                    "substituted shareset"
+                )
         st["u2"] = u2_sorted
         st["holder_shares"] = holder_shares
         return st
@@ -1106,6 +1131,26 @@ class FederatedClient:
                 f"dead={sorted(dead)} does not cover this round's "
                 f"participant set {sorted(u2set)} exactly"
             )
+        # Pin the FIRST answered (alive, dead) partition for this
+        # (session, round): answering a second, different partition would
+        # hand the server both kinds of shares for the ids it moved
+        # between the sets (answer alive -> b-shares, drop the
+        # connection, retry claiming dead -> key-seed shares), re-opening
+        # exactly the false-death attack the either/or rule closes.
+        # SecureAggError is non-retryable (the exchange retry loop only
+        # catches connection/wire errors), so one conflicting request
+        # ends the round for this client.
+        partition = (tuple(sorted(alive)), tuple(sorted(dead)))
+        pinned = share_st.get("unmask_partition")
+        if pinned is not None and pinned != partition:
+            raise secure.SecureAggError(
+                "unmask request partition changed across retries of one "
+                f"round (answered alive={list(pinned[0])}/"
+                f"dead={list(pinned[1])}, now asked alive={sorted(alive)}/"
+                f"dead={sorted(dead)}) — refusing the replayed unmask "
+                "(answer-then-drop share harvest)"
+            )
+        share_st["unmask_partition"] = partition
         holder = share_st["holder_shares"]
         b_shares = {
             d: (

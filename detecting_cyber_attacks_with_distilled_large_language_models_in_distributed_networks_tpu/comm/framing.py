@@ -44,29 +44,53 @@ def recv_exact(sock: socket.socket, n: int) -> bytearray:
     return buf
 
 
-def send_frame(sock: socket.socket, payload: bytes) -> None:
-    """Send one CRC'd frame in 1 MB chunks; wait for the receiver's ACK."""
+def send_frame(
+    sock: socket.socket, payload: bytes, *, await_ack: bool = True
+) -> None:
+    """Send one CRC'd frame in 1 MB chunks; wait for the receiver's ACK.
+
+    ``await_ack=False`` sends fire-and-forget (the scoring service's
+    request/reply exchange — serving/protocol.py — where the reply itself
+    is the acknowledgment and a blocking ACK read per small frame would
+    serialize the batching hot path on the slowest client)."""
     crc = native.crc32(payload)
     sock.sendall(FRAME_MAGIC + struct.pack("<QI", len(payload), crc))
     view = memoryview(payload)
     for start in range(0, len(view), SEND_CHUNK):
         sock.sendall(view[start : start + SEND_CHUNK])
+    if not await_ack:
+        return
     ack = recv_exact(sock, len(ACK))
     if ack != ACK:
         raise WireError(f"bad ACK {ack!r}")
 
 
-def recv_frame(sock: socket.socket) -> bytearray:
-    """Receive one frame, verify its CRC, ACK it, return the payload."""
+def recv_frame(
+    sock: socket.socket,
+    *,
+    send_ack: bool = True,
+    max_frame: int = MAX_FRAME,
+) -> bytearray:
+    """Receive one frame, verify its CRC, ACK it, return the payload.
+
+    ``send_ack=False`` matches a peer's ``await_ack=False`` send (both
+    directions of the scoring protocol): no ACK bytes ever ride the
+    socket, so a reply frame written by another thread can never
+    interleave with an ACK write from this one. ``max_frame`` lets a
+    receiver expecting small frames (one scoring request, not a 250 MB
+    model) bound the pre-validated allocation."""
     header = recv_exact(sock, len(FRAME_MAGIC) + 12)
     if header[:4] != FRAME_MAGIC:
         raise WireError(f"bad frame magic {bytes(header[:4])!r}")
     length, crc = struct.unpack("<QI", header[4:])
-    if length > MAX_FRAME:
-        raise WireError(f"frame length {length} exceeds {MAX_FRAME}")
+    if length > min(max_frame, MAX_FRAME):
+        raise WireError(
+            f"frame length {length} exceeds {min(max_frame, MAX_FRAME)}"
+        )
     payload = recv_exact(sock, length)
     got = native.crc32(payload)
     if got != crc:
         raise WireError(f"frame CRC mismatch (got {got:#010x}, want {crc:#010x})")
-    sock.sendall(ACK)
+    if send_ack:
+        sock.sendall(ACK)
     return payload

@@ -1047,6 +1047,26 @@ class AggregationServer:
         )
         return secure.dequantize_sum(out, len(alive), self.fp_bits)
 
+    def _round_quorum(self, cohort: set[int] | None) -> int:
+        """Upload quorum for one round.
+
+        A sampled round can't demand more uploads than the cohort it drew
+        (the draw is data-independent; gating on it would only hurt
+        liveness, not privacy) — but the cohort clamp must never lower
+        the secure-agg floor below 2: a 1-member cohort's "sum" IS that
+        client's raw update, so aggregating it defeats the masking
+        outright. Clients enforce their own min_participants floor; the
+        server must not construct the degenerate round either:
+        ``quorum = max(2, min(min_clients, |cohort|))`` under secure
+        aggregation (the constructor already pins min_clients >= 2
+        there, so only the cohort clamp can drive the value below 2)."""
+        quorum = self.min_clients
+        if cohort is not None:
+            quorum = min(quorum, len(cohort))
+        if self.secure_agg:
+            quorum = max(2, quorum)
+        return quorum
+
     def serve_round(
         self, *, deadline: float | None = None, round_index: int | None = None
     ) -> dict | None:
@@ -1164,12 +1184,7 @@ class AggregationServer:
                     skip_conns,
                 )
                 return None
-            # Quorum: a sampled round can't demand more uploads than the
-            # cohort it drew (the draw is data-independent; gating on it
-            # would only hurt liveness, not privacy).
-            quorum = self.min_clients
-            if rnd.cohort is not None:
-                quorum = min(quorum, len(rnd.cohort))
+            quorum = self._round_quorum(rnd.cohort)
             if len(models) < quorum:
                 raise RuntimeError(
                     f"only {len(models)}/{self.num_clients} clients arrived "

@@ -90,6 +90,16 @@ DPCOHORT_MAGIC = b"DPCO"
 #: real client's registration, and collect the aggregate).
 DPSKIP_MAGIC = b"DPSK"
 DPSKIP_DOMAIN = b"fedtpu-dp-skip-v1"
+#: Online scoring frames (serving/protocol.py), riding the same length-
+#: framed transport in fire-and-forget mode (framing.send_frame
+#: await_ack=False): SCORE_REQ carries one flow record (text or raw
+#: features) + a per-request deadline; SCORE_REP answers with P(attack)
+#: plus the serving telemetry (model round, batch size, queue wait);
+#: SCORE_REJ is the explicit 503-style admission-control refusal — a
+#: shed request is TOLD it was shed instead of hanging to its deadline.
+SCORE_REQ_MAGIC = b"SCRQ"
+SCORE_REP_MAGIC = b"SCRP"
+SCORE_REJ_MAGIC = b"SCRJ"
 _ALLOWED_DTYPES = {
     "float32", "float64", "float16", "bfloat16",
     "int8", "int16", "int32", "int64",

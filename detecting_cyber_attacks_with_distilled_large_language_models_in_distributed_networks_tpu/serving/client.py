@@ -1,0 +1,167 @@
+"""Scoring-service SDK + load generator (shared by tests and bench.py).
+
+One :class:`ScoringClient` = one TCP connection with synchronous
+request/reply (``score()``); concurrency comes from many clients — which
+is exactly what makes the server's micro-batcher earn its keep: N
+concurrent connections coalesce into one padded bucket dispatch.
+:func:`run_load` spins that shape up (a thread per connection, a shared
+work queue) and reports client-observed throughput and latency
+percentiles — the numbers bench.py publishes.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+from ..comm import framing
+from ..comm.wire import WireError
+from . import protocol
+
+
+class ScoreRejected(Exception):
+    """Explicit server-side refusal (admission control / deadline)."""
+
+    def __init__(self, code: int, reason: str, req_id: int):
+        super().__init__(f"request {req_id} rejected ({code}): {reason}")
+        self.code = int(code)
+        self.reason = reason
+        self.req_id = int(req_id)
+
+
+class ScoringClient:
+    """Blocking scoring connection. Not thread-safe; one per thread."""
+
+    def __init__(
+        self, host: str, port: int, *, timeout: float = 30.0
+    ):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.settimeout(timeout)
+        self._next_id = 0
+
+    def score(
+        self,
+        *,
+        text: str | None = None,
+        features: Mapping[str, Any] | None = None,
+        deadline_ms: float | None = None,
+    ) -> dict:
+        """Score one flow; returns the reply dict (prob, prediction,
+        round, batch_size, bucket, queue_ms). Raises :class:`ScoreRejected`
+        on an explicit reject frame."""
+        self._next_id += 1
+        req_id = self._next_id
+        framing.send_frame(
+            self.sock,
+            protocol.build_request(
+                req_id, text=text, features=features, deadline_ms=deadline_ms
+            ),
+            await_ack=False,
+        )
+        reply = bytes(framing.recv_frame(self.sock, send_ack=False))
+        if protocol.is_reject(reply):
+            body = protocol.parse_reject(reply)
+            raise ScoreRejected(body["code"], body["reason"], body["id"])
+        body = protocol.parse_reply(reply)
+        if body["id"] != req_id:
+            raise WireError(
+                f"reply for request {body['id']} arrived while awaiting "
+                f"{req_id} (synchronous client; server must answer in order)"
+            )
+        return body
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "ScoringClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def run_load(
+    host: str,
+    port: int,
+    texts: Sequence[str],
+    *,
+    concurrency: int = 4,
+    requests: int | None = None,
+    deadline_ms: float | None = None,
+    timeout: float = 60.0,
+) -> dict:
+    """Closed-loop load generator: ``concurrency`` connections, each
+    scoring the next text round-robin until ``requests`` total (default:
+    one pass over ``texts``) have been answered. Returns client-observed
+    stats: flows/s, p50/p95/p99 ms, reject count, per-reply batch sizes
+    (the coalescing evidence tests assert on)."""
+    total = len(texts) if requests is None else int(requests)
+    idx = iter(range(total))
+    idx_lock = threading.Lock()
+    latencies: list[float] = []
+    batch_sizes: list[int] = []
+    rejects = [0]
+    errors: list[Exception] = []
+    out_lock = threading.Lock()
+
+    def worker() -> None:
+        try:
+            with ScoringClient(host, port, timeout=timeout) as cli:
+                while True:
+                    with idx_lock:
+                        i = next(idx, None)
+                    if i is None:
+                        return
+                    t0 = time.monotonic()
+                    try:
+                        reply = cli.score(
+                            text=texts[i % len(texts)],
+                            deadline_ms=deadline_ms,
+                        )
+                    except ScoreRejected:
+                        with out_lock:
+                            rejects[0] += 1
+                        continue
+                    dt = time.monotonic() - t0
+                    with out_lock:
+                        latencies.append(dt)
+                        batch_sizes.append(int(reply["batch_size"]))
+        except Exception as e:  # surface worker crashes to the caller
+            with out_lock:
+                errors.append(e)
+
+    threads = [
+        threading.Thread(target=worker, daemon=True)
+        for _ in range(max(1, concurrency))
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout + 30.0)
+    wall = max(time.monotonic() - t0, 1e-9)
+    if errors:
+        raise errors[0]
+    lat = np.asarray(latencies, np.float64) * 1e3
+    pct = (
+        {f"p{p}_ms": float(np.percentile(lat, p)) for p in (50, 95, 99)}
+        if lat.size
+        else {"p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0}
+    )
+    return {
+        "scored": len(latencies),
+        "rejected": rejects[0],
+        "wall_s": wall,
+        "flows_per_sec": len(latencies) / wall,
+        "mean_batch": float(np.mean(batch_sizes)) if batch_sizes else 0.0,
+        "max_batch": max(batch_sizes, default=0),
+        "batch_sizes": batch_sizes,
+        **pct,
+    }

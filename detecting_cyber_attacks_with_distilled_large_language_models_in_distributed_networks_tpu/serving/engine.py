@@ -1,0 +1,156 @@
+"""Bucketed jit cache: score variable-size batches through fixed shapes.
+
+A scoring service sees every batch size from 1 (a lone probe) to the
+coalescing cap. Jitting on the raw size would compile a fresh XLA
+program per novel size — a multi-second stall mid-traffic, per size.
+Instead batches are padded up to a small ladder of bucket shapes
+(default 1/8/32/128) so the service runs at most ``len(buckets)``
+compilations for its whole lifetime, all of them optionally paid at
+startup (``warmup()``), and every request thereafter hits a warm path.
+
+The probability math is exactly the eval path's (train/engine.py
+``eval_counts``): ``softmax(model.apply(...))[:, 1]`` with deterministic
+apply, and pad rows built the way ``pad_split_to_batch`` builds them —
+which is what makes served probabilities bit-for-bit equal to ``fedtpu
+predict``'s (pinned in tests/test_serving.py).
+
+Compile counting: the Python body of a jitted function runs once per
+traced shape — so the counter increment inside ``_probs`` IS a compile
+hook, not a call counter. ``compile_counts`` maps (batch, seq) to trace
+count; the e2e test storms mixed sizes and asserts every value == 1.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from ..config import ModelConfig
+from ..models.distilbert import DDoSClassifier
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+
+class ScoreEngine:
+    """Pad-to-bucket scoring over one jitted program per (bucket, seq).
+
+    Thread contract: ``score`` is called by the single scorer thread;
+    ``swap`` may be called from the watcher/scorer; the params reference
+    is swapped atomically under a lock (scoring holds whichever params it
+    read at dispatch — a reload never tears a batch)."""
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        params: Any,
+        *,
+        pad_id: int = 0,
+        buckets: tuple[int, ...] = DEFAULT_BUCKETS,
+        round_id: int = 0,
+    ):
+        import jax
+
+        if not buckets or any(b < 1 for b in buckets):
+            raise ValueError(f"buckets {buckets} must be positive")
+        self.model_cfg = model_cfg
+        self.pad_id = int(pad_id)
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.seq_len = int(model_cfg.max_len)
+        self.compile_counts: dict[tuple[int, int], int] = {}
+        self._lock = threading.Lock()
+        self._params = jax.device_put(params)
+        self._round_id = int(round_id)
+        model = DDoSClassifier(model_cfg)
+
+        def _probs(p, input_ids, attention_mask):
+            # Trace-time hook: this Python body runs exactly once per
+            # (batch, seq) shape — each execution of the compiled program
+            # skips it. The dict update is the compile counter.
+            shape = (input_ids.shape[0], input_ids.shape[1])
+            self.compile_counts[shape] = self.compile_counts.get(shape, 0) + 1
+            logits = model.apply(
+                {"params": p}, input_ids, attention_mask, True
+            )
+            return jax.nn.softmax(logits, axis=-1)[:, 1]
+
+        self._probs = jax.jit(_probs)
+
+    # ------------------------------------------------------------ versioning
+    @property
+    def round_id(self) -> int:
+        return self._round_id
+
+    def swap(self, params: Any, *, round_id: int) -> None:
+        """Adopt a new checkpoint's params (same architecture — shapes are
+        unchanged, so the compiled programs are reused as-is; a changed
+        architecture needs a new engine, serving/reload.py handles that
+        distinction)."""
+        import jax
+
+        new = jax.device_put(params)
+        with self._lock:
+            self._params = new
+            self._round_id = int(round_id)
+
+    def snapshot(self) -> tuple[Any, int]:
+        with self._lock:
+            return self._params, self._round_id
+
+    # --------------------------------------------------------------- scoring
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket that fits ``n`` (callers cap n at max bucket)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"batch of {n} exceeds the largest bucket {self.buckets[-1]}"
+        )
+
+    def warmup(self) -> None:
+        """Pay every bucket's compilation before traffic arrives."""
+        for b in self.buckets:
+            self.score(
+                np.full((b, self.seq_len), self.pad_id, np.int32),
+                np.zeros((b, self.seq_len), np.int32),
+            )
+        log.info(
+            f"[SERVE] warmed {len(self.buckets)} bucket programs "
+            f"(batch in {self.buckets}, seq {self.seq_len})"
+        )
+
+    def score(
+        self, input_ids: np.ndarray, attention_mask: np.ndarray
+    ) -> tuple[np.ndarray, int, int]:
+        """Score ``[n, seq]`` rows -> (float32 probs [n], bucket, round).
+
+        Pads up to the bucket with PAD rows exactly as
+        ``pad_split_to_batch`` does for eval (pad_id ids, zero mask) and
+        slices the pad rows back off — per-row results are independent of
+        sibling rows, so the padded program returns the same bits the
+        eval pipeline computes."""
+        n = int(input_ids.shape[0])
+        bucket = self.bucket_for(n)
+        if input_ids.shape[1] != self.seq_len:
+            raise ValueError(
+                f"rows have seq {input_ids.shape[1]}, engine expects "
+                f"{self.seq_len}"
+            )
+        if n < bucket:
+            pad_ids = np.full(
+                (bucket - n, self.seq_len), self.pad_id, np.int32
+            )
+            pad_mask = np.zeros((bucket - n, self.seq_len), np.int32)
+            input_ids = np.concatenate([input_ids, pad_ids])
+            attention_mask = np.concatenate([attention_mask, pad_mask])
+        params, round_id = self.snapshot()
+        probs = self._probs(
+            params,
+            np.ascontiguousarray(input_ids, np.int32),
+            np.ascontiguousarray(attention_mask, np.int32),
+        )
+        return np.asarray(probs)[:n], bucket, round_id

@@ -1,0 +1,160 @@
+"""Scoring request/reply/reject codecs over the length-framed wire.
+
+One frame = 4-byte magic (comm/wire.py ``SCORE_*``) + a UTF-8 JSON body.
+JSON, not the tensor manifest: a scoring exchange moves one flow record
+and a handful of floats, so the non-executable-payload argument that
+shaped comm/wire.py holds trivially — ``json.loads`` cannot encode code —
+and the frames stay greppable on the wire.
+
+Float exactness: ``prob`` crosses as a JSON double. float32 -> float64 is
+exact and Python's repr round-trips doubles exactly, so a reply compares
+bit-for-bit against the float32 probability ``fedtpu predict`` computes
+(``float(np.float32(p)) == reply["prob"]``) — pinned by the e2e test.
+
+Frames ride :func:`comm.framing.send_frame` with ``await_ack=False`` in
+BOTH directions (see that module): the reply is the acknowledgment, and
+keeping ACK bytes off the socket means the scorer thread's reply writes
+can never interleave with the reader thread's ACKs.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Mapping
+
+from ..comm.wire import (
+    SCORE_REJ_MAGIC,
+    SCORE_REP_MAGIC,
+    SCORE_REQ_MAGIC,
+    WireError,
+)
+
+#: Reject codes (HTTP-flavored for operator familiarity): the service is
+#: over capacity (queue full at admission) or the request sat past its
+#: deadline before a scorer slot opened.
+REJECT_OVERLOADED = 503
+REJECT_DEADLINE = 504
+
+
+def _build(magic: bytes, body: Mapping[str, Any]) -> bytes:
+    return magic + json.dumps(body, separators=(",", ":")).encode()
+
+
+def _parse(frame: bytes, magic: bytes, kind: str) -> dict:
+    frame = bytes(frame)
+    if frame[:4] != magic:
+        raise WireError(
+            f"not a scoring {kind} frame (magic {frame[:4]!r})"
+        )
+    try:
+        body = json.loads(frame[4:].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireError(f"malformed scoring {kind} body: {e}") from None
+    if not isinstance(body, dict):
+        raise WireError(f"scoring {kind} body must be a JSON object")
+    return body
+
+
+# ----------------------------------------------------------------- request
+def build_request(
+    req_id: int,
+    *,
+    text: str | None = None,
+    features: Mapping[str, Any] | None = None,
+    deadline_ms: float | None = None,
+) -> bytes:
+    """One flow record to score: either the rendered template ``text`` or
+    the raw ``features`` mapping (rendered server-side through the active
+    dataset's template — the same bytes ``predict`` would feed). Exactly
+    one of the two. ``deadline_ms`` is this request's latency budget;
+    past it the server answers with an explicit reject, never a hang."""
+    if (text is None) == (features is None):
+        raise ValueError("pass exactly one of text= or features=")
+    body: dict[str, Any] = {"id": int(req_id)}
+    if text is not None:
+        body["text"] = str(text)
+    else:
+        body["features"] = dict(features)
+    if deadline_ms is not None:
+        body["deadline_ms"] = float(deadline_ms)
+    return _build(SCORE_REQ_MAGIC, body)
+
+
+def parse_request(frame: bytes) -> dict:
+    """Validate types as well as presence: every field here is attacker-
+    controlled network input, and a wrong-typed value must surface as a
+    WireError (clean connection drop) — never as a TypeError escaping a
+    reader thread."""
+    body = _parse(frame, SCORE_REQ_MAGIC, "request")
+    if not isinstance(body.get("id"), int) or isinstance(body["id"], bool):
+        raise WireError("scoring request id must be an integer")
+    if ("text" in body) == ("features" in body):
+        raise WireError(
+            "scoring request must carry exactly one of text/features"
+        )
+    if "text" in body and not isinstance(body["text"], str):
+        raise WireError("scoring request text must be a string")
+    if "features" in body and not isinstance(body["features"], dict):
+        raise WireError("scoring request features must be an object")
+    if "deadline_ms" in body and (
+        not isinstance(body["deadline_ms"], (int, float))
+        or isinstance(body["deadline_ms"], bool)
+    ):
+        raise WireError("scoring request deadline_ms must be a number")
+    return body
+
+
+# ------------------------------------------------------------------- reply
+def build_reply(
+    req_id: int,
+    *,
+    prob: float,
+    threshold: float,
+    round_id: int,
+    batch_size: int,
+    bucket: int,
+    queue_ms: float,
+) -> bytes:
+    """P(attack) + the per-request telemetry that makes the service
+    observable from the client side alone: which model round answered,
+    how large the coalesced batch was, and how long the request queued."""
+    return _build(
+        SCORE_REP_MAGIC,
+        {
+            "id": int(req_id),
+            "prob": float(prob),
+            "prediction": int(float(prob) >= threshold),
+            "round": int(round_id),
+            "batch_size": int(batch_size),
+            "bucket": int(bucket),
+            "queue_ms": round(float(queue_ms), 3),
+        },
+    )
+
+
+def parse_reply(frame: bytes) -> dict:
+    body = _parse(frame, SCORE_REP_MAGIC, "reply")
+    for key in ("id", "prob", "prediction", "round", "batch_size"):
+        if key not in body:
+            raise WireError(f"scoring reply missing {key!r}")
+    return body
+
+
+# ------------------------------------------------------------------ reject
+def build_reject(req_id: int, *, code: int, reason: str) -> bytes:
+    return _build(
+        SCORE_REJ_MAGIC,
+        {"id": int(req_id), "code": int(code), "reason": str(reason)},
+    )
+
+
+def parse_reject(frame: bytes) -> dict:
+    body = _parse(frame, SCORE_REJ_MAGIC, "reject")
+    for key in ("id", "code", "reason"):
+        if key not in body:
+            raise WireError(f"scoring reject missing {key!r}")
+    return body
+
+
+def is_reject(frame: bytes) -> bool:
+    return bytes(frame[:4]) == SCORE_REJ_MAGIC

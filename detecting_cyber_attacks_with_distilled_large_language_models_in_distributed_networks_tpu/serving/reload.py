@@ -1,0 +1,186 @@
+"""Hot checkpoint reload: the detector improves every FL round, live.
+
+The federated loop writes a checkpoint per round (train/checkpoint.py);
+without this module the scoring service would serve round N's weights
+until an operator restarted it — exactly the train/deploy gap the
+reference never closed. The watcher polls the checkpoint directory
+BETWEEN batches (the scorer's idle tick calls ``poll()``; no watcher
+thread races the scorer) and, on a new step, restores through the same
+``_restore_predict_params`` path ``fedtpu predict`` uses — federated
+FedState and local TrainState checkpoints both, with the same
+vocab/architecture validation — then swaps the engine's params
+atomically. In-flight batches finish on the old weights; the next batch
+serves the new round, and every reply names the round that scored it.
+
+Cheap new-step detection: orbax finalizes a step by renaming its tmp dir
+(``<step>.orbax-checkpoint-tmp-*``) to the bare ``<step>`` — so a
+pure-digit directory entry is a completed step, and the poll is one
+``os.scandir`` with no CheckpointManager construction on the idle path.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable
+
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+#: restore_fn contract: step (None = whatever is latest; surfaces the
+#: clean "no checkpoint found" error on an empty directory) ->
+#: (model_cfg, params, round_id).
+RestoreFn = Callable[[int | None], tuple[Any, Any, int]]
+
+
+def latest_finalized_step(ckpt_dir: str) -> int | None:
+    """Largest completed orbax step in ``ckpt_dir`` (None when empty /
+    missing). Pure-digit entries only — tmp dirs carry a suffix."""
+    try:
+        entries = os.scandir(ckpt_dir)
+    except OSError:
+        return None
+    steps = [
+        int(e.name)
+        for e in entries
+        if e.name.isdigit() and e.is_dir(follow_symlinks=False)
+    ]
+    return max(steps, default=None)
+
+
+def checkpoint_restorer(cfg, tok) -> RestoreFn:
+    """Bind the predict-path restore to (config, tokenizer): returns a
+    ``RestoreFn`` that restores the latest finalized checkpoint and reads
+    its round id from the SAME step's metadata — the round number for
+    federated checkpoints, the step id for local ones. One snapshot for
+    params and round id: reading "latest" twice around a params restore
+    would let a round finalized in between label old weights with the new
+    round id (replies must name the round that actually scored them)."""
+    from ..cli.predict import _restore_predict_params
+    from ..train.checkpoint import Checkpointer
+    from ..train.engine import Trainer
+
+    def restore(step: int | None) -> tuple[Any, Any, int]:
+        with Checkpointer(cfg.checkpoint_dir) as ckpt:
+            actual = ckpt.latest_step()
+            pin = actual if actual is not None else step
+            meta = ckpt.restore_meta(step=pin) if pin is not None else {}
+        trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
+        # Pinned to the step whose metadata was just read; if orbax GC
+        # removes it mid-restore this raises and the watcher retries. A
+        # still-None pin (empty directory) passes through so the predict
+        # path raises its clean "no checkpoint found" — not a confusing
+        # architecture-mismatch report against a step that never existed.
+        model_cfg, params = _restore_predict_params(
+            cfg, tok, trainer, ckpt_dir=cfg.checkpoint_dir, step=pin
+        )
+        return model_cfg, params, int(meta.get("round", pin))
+
+    return restore
+
+
+class CheckpointWatcher:
+    """Poll-on-idle reload driver (single-threaded with the scorer).
+
+    ``poll(engine)`` rate-limits itself to ``poll_interval_s``, detects a
+    new finalized step, restores, and either swaps the params in place
+    (same architecture) or reports the new config so the server can
+    rebuild the engine. A failed restore (e.g. the checkpoint vanished
+    under GC mid-restore) logs and leaves the serving params untouched —
+    reload is an optimization; the service must never die for it. A
+    transiently failing step is retried on later polls (up to
+    ``max_retries``) before being written off: the FINAL federated
+    round's checkpoint has no newer step coming after it, so marking it
+    seen on the first blip would strand the service on stale weights
+    forever while reload looked healthy."""
+
+    def __init__(
+        self,
+        ckpt_dir: str,
+        restore_fn: RestoreFn,
+        *,
+        poll_interval_s: float = 2.0,
+        max_retries: int = 5,
+    ):
+        self.ckpt_dir = ckpt_dir
+        self.restore_fn = restore_fn
+        self.poll_interval_s = float(poll_interval_s)
+        self.max_retries = int(max_retries)
+        self._last_poll = 0.0
+        self._seen_step: int | None = None
+        self._fail_step: int | None = None
+        self._fail_count = 0
+        self._primed = False
+        self.reload_count = 0
+
+    @property
+    def primed(self) -> bool:
+        return self._primed
+
+    def prime(self, step: int | None = None) -> None:
+        """Record the step already serving (skip a spurious first reload).
+
+        Callers that restored a specific step should pass it: priming by
+        directory scan instead would mark any step finalized between the
+        restore and this call as already-seen — stale weights served
+        until the NEXT round lands (or forever, if training finished)."""
+        self._seen_step = (
+            latest_finalized_step(self.ckpt_dir) if step is None else step
+        )
+        self._primed = True
+
+    def poll(self, engine) -> bool:
+        """One idle-tick check; True when a new checkpoint was adopted."""
+        now = time.monotonic()
+        if now - self._last_poll < self.poll_interval_s:
+            return False
+        self._last_poll = now
+        step = latest_finalized_step(self.ckpt_dir)
+        if step is None or (
+            self._seen_step is not None and step <= self._seen_step
+        ):
+            return False
+        try:
+            model_cfg, params, round_id = self.restore_fn(step)
+        except (Exception, SystemExit) as e:
+            # SystemExit included: the predict-path restore raises it for
+            # operator-facing CLI errors (missing/mismatched checkpoint),
+            # and an uncaught SystemExit would silently end the scorer
+            # thread — the service must outlive a bad reload.
+            if self._fail_step != step:
+                self._fail_step, self._fail_count = step, 0
+            self._fail_count += 1
+            if self._fail_count >= self.max_retries:
+                # Persistent failure (corrupt/incompatible step): stop
+                # burning every poll on it; a NEWER step still reloads.
+                self._seen_step = step
+            log.warning(
+                f"[SERVE] checkpoint reload from {self.ckpt_dir} (step "
+                f"{step}) failed ({type(e).__name__}: {e}); keeping the "
+                f"serving weights (attempt {self._fail_count}/"
+                f"{self.max_retries}"
+                + (
+                    ", giving this step up"
+                    if self._fail_count >= self.max_retries
+                    else ", will retry"
+                )
+                + ")"
+            )
+            return False
+        self._fail_step, self._fail_count = None, 0
+        self._seen_step = step
+        if model_cfg != engine.model_cfg:
+            log.warning(
+                f"[SERVE] checkpoint at step {step} declares a different "
+                "architecture than the serving engine; skipping hot reload "
+                "(restart the service to change model shapes)"
+            )
+            return False
+        engine.swap(params, round_id=round_id)
+        self.reload_count += 1
+        log.info(
+            f"[SERVE] hot-reloaded checkpoint step {step} "
+            f"(model round {round_id})"
+        )
+        return True

@@ -1,0 +1,472 @@
+"""The scoring service: accept loop, scorer thread, telemetry.
+
+Thread layout (fixed, small, lock-light):
+
+* one **accept** thread hands each connection to a reader thread;
+* one **reader** thread per connection parses + tokenizes requests (the
+  WordPiece work rides the connection thread, in parallel across
+  clients, keeping the scorer hot path pure) and submits them to the
+  micro-batcher — a full queue is answered with the explicit reject
+  frame right there;
+* one **scorer** thread owns the JAX dispatch: coalesce, drop expired
+  requests with deadline rejects, score the rest through the bucketed
+  engine, write replies. Its idle tick polls the checkpoint watcher, so
+  reloads never race a batch.
+
+Per-connection writes (replies, rejects) go through a bounded outbound
+queue drained by a per-connection **writer** thread — the scorer thread
+never touches a socket, so a non-reading client (full TCP buffers,
+blocking sendall) stalls only its own writer, never the service; when a
+connection's outbound queue fills, that connection is dropped. No ACK
+bytes ride the scoring sockets (framing ``await_ack=False`` both
+directions), so reader and writer writes cannot interleave.
+
+Telemetry: every reply carries (model round, batch size, queue wait);
+the server accumulates latency percentiles (p50/p95/p99), throughput,
+and reject counts — surfaced via ``stats()``, appended per-batch to the
+metrics-JSONL channel when configured, and summarized on close.
+"""
+
+from __future__ import annotations
+
+import collections
+import socket
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from ..comm import framing
+from ..comm.wire import WireError
+from ..data.textualize import render_row
+from ..utils.logging import get_logger
+from . import protocol
+from .batcher import MicroBatcher, ScoreRequest
+
+log = get_logger()
+
+#: A scoring request is one flow record — bound the frame allocation far
+#: below the transport's model-sized MAX_FRAME.
+MAX_REQUEST_FRAME = 1 << 20  # 1 MB
+
+
+class _ConnWriter:
+    """Per-connection outbound lane: a bounded queue + one writer thread.
+
+    The scorer thread calls :meth:`send` (non-blocking put); only this
+    writer ever does the blocking ``sendall``, so one non-reading client
+    can never head-of-line-block scoring for everyone else. A full queue
+    means the peer has stopped draining replies — the connection is
+    closed (its un-read replies were lost to it anyway)."""
+
+    def __init__(self, conn: socket.socket, *, maxsize: int = 256):
+        import queue
+
+        self._conn = conn
+        self._q: "queue.Queue[bytes | None]" = queue.Queue(maxsize=maxsize)
+        self._dead = threading.Event()
+        self._t = threading.Thread(target=self._drain, daemon=True)
+        self._t.start()
+
+    def send(self, frame: bytes) -> None:
+        import queue
+
+        if self._dead.is_set():
+            return
+        try:
+            self._q.put_nowait(frame)
+        except queue.Full:
+            self.kill()
+
+    def _drain(self) -> None:
+        while True:
+            frame = self._q.get()
+            if frame is None or self._dead.is_set():
+                return
+            try:
+                framing.send_frame(self._conn, frame, await_ack=False)
+            except OSError:
+                self.kill()
+                return
+
+    def kill(self) -> None:
+        """Tear the connection down (peer gone or not draining)."""
+        self._dead.set()
+        try:
+            self._conn.close()  # also unblocks the reader thread
+        except OSError:
+            pass
+        try:
+            self._q.put_nowait(None)
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        """Stop the writer after the queue drains (normal teardown)."""
+        try:
+            self._q.put(None, timeout=1.0)
+        except Exception:
+            self._dead.set()
+        self._t.join(timeout=5.0)
+
+
+class ScoringServer:
+    """TCP scoring service over a :class:`~.engine.ScoreEngine`.
+
+    ``spec`` (a data.datasets.DatasetSpec) renders ``features`` requests
+    through the active dataset's template — the same bytes ``predict``
+    feeds; ``text`` requests skip rendering. ``default_deadline_s``
+    applies to requests that name no budget (None = wait forever).
+    """
+
+    def __init__(
+        self,
+        engine,
+        tokenizer,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        spec=None,
+        threshold: float = 0.5,
+        batcher: MicroBatcher | None = None,
+        watcher=None,
+        default_deadline_s: float | None = None,
+        idle_tick_s: float = 0.05,
+        metrics_jsonl: str | None = None,
+        warmup: bool = True,
+        latency_window: int = 100_000,
+    ):
+        self.engine = engine
+        self.tok = tokenizer
+        self.spec = spec
+        self.threshold = float(threshold)
+        self.batcher = batcher or MicroBatcher(max_batch=engine.buckets[-1])
+        if self.batcher.max_batch > engine.buckets[-1]:
+            raise ValueError(
+                f"batcher.max_batch={self.batcher.max_batch} exceeds the "
+                f"largest engine bucket {engine.buckets[-1]}"
+            )
+        self.watcher = watcher
+        self.default_deadline_s = default_deadline_s
+        self.idle_tick_s = float(idle_tick_s)
+        self.metrics_jsonl = metrics_jsonl
+        self._warmup = warmup
+        self._closed = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._conns: set[socket.socket] = set()
+        self._conn_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._scored = 0
+        self._batches = 0
+        self._rejects = {
+            "deadline": 0, "overloaded": 0, "bad_request": 0, "error": 0,
+        }
+        self._batch_hist: collections.Counter[int] = collections.Counter()
+        self._latencies: collections.deque[float] = collections.deque(
+            maxlen=latency_window
+        )
+        self._t_start = time.monotonic()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]
+
+    # ---------------------------------------------------------------- control
+    def start(self) -> "ScoringServer":
+        # Prime BEFORE the (multi-second) warmup, and only when the
+        # caller didn't already prime with the step it restored: a
+        # checkpoint finalized during warmup must count as new, not be
+        # silently marked seen-but-never-loaded.
+        if self.watcher is not None and not self.watcher.primed:
+            self.watcher.prime()
+        if self._warmup:
+            self.engine.warmup()
+        self._sock.listen(64)
+        for target, name in (
+            (self._accept_loop, "accept"),
+            (self._score_loop, "scorer"),
+        ):
+            t = threading.Thread(
+                target=target, name=f"fedtpu-serve-{name}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        log.info(
+            f"[SERVE] scoring service on port {self.port} (buckets "
+            f"{self.engine.buckets}, seq {self.engine.seq_len}, window "
+            f"{self.batcher.gather_window_s * 1e3:.1f} ms, queue cap "
+            f"{self.batcher.max_queue})"
+        )
+        return self
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+        s = self.stats()
+        log.info(
+            f"[SERVE] served {s['scored']} flows in {s['uptime_s']:.1f}s "
+            f"({s['flows_per_sec']:.1f} flows/s), p50 {s['p50_ms']:.2f} ms "
+            f"p95 {s['p95_ms']:.2f} ms p99 {s['p99_ms']:.2f} ms, rejects "
+            f"{s['rejects']}"
+        )
+        if self.metrics_jsonl:
+            from ..reporting import append_metrics_jsonl
+
+            append_metrics_jsonl(
+                self.metrics_jsonl, {"phase": "serve_summary", **_flat(s)}
+            )
+
+    def __enter__(self) -> "ScoringServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ----------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        with self._stats_lock:
+            lat = np.asarray(self._latencies, np.float64) * 1e3
+            scored = self._scored
+            batches = self._batches
+            rejects = dict(self._rejects)
+            hist = dict(sorted(self._batch_hist.items()))
+        uptime = max(time.monotonic() - self._t_start, 1e-9)
+        pct = (
+            {
+                f"p{p}_ms": float(np.percentile(lat, p))
+                for p in (50, 95, 99)
+            }
+            if lat.size
+            else {"p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0}
+        )
+        return {
+            "scored": scored,
+            "batches": batches,
+            "mean_batch": scored / batches if batches else 0.0,
+            "batch_size_hist": hist,
+            "rejects": rejects,
+            "reloads": getattr(self.watcher, "reload_count", 0),
+            "round": self.engine.round_id,
+            "uptime_s": uptime,
+            "flows_per_sec": scored / uptime,
+            **pct,
+        }
+
+    # ----------------------------------------------------------- accept path
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            with self._conn_lock:
+                self._conns.add(conn)
+            t = threading.Thread(
+                target=self._reader_loop, args=(conn,), daemon=True
+            )
+            t.start()
+
+    def _reader_loop(self, conn: socket.socket) -> None:
+        writer = _ConnWriter(conn)
+        seq_len = self.engine.seq_len
+        try:
+            while not self._closed.is_set():
+                try:
+                    frame = framing.recv_frame(
+                        conn, send_ack=False, max_frame=MAX_REQUEST_FRAME
+                    )
+                except (ConnectionError, OSError):
+                    return
+                except WireError as e:
+                    # Oversized/corrupt frame: the stream is desynced —
+                    # drop the connection (cleanly; no thread excepthook
+                    # noise), the client sees EOF and reconnects.
+                    log.warning(f"[SERVE] dropping connection: {e}")
+                    return
+                try:
+                    body = protocol.parse_request(bytes(frame))
+                except WireError as e:
+                    log.warning(f"[SERVE] dropping connection: {e}")
+                    return
+                req_id = body["id"]  # parse_request pinned the type
+                reject = self._make_reject(writer, req_id)
+                if "features" in body:
+                    if self.spec is None:
+                        self._count_reject("bad_request")
+                        reject(
+                            400,
+                            "this server accepts text requests only "
+                            "(no dataset spec configured)",
+                        )
+                        continue
+                    try:
+                        text = render_row(body["features"], self.spec.template)
+                    except KeyError as e:
+                        self._count_reject("bad_request")
+                        reject(400, f"features missing template column {e}")
+                        continue
+                else:
+                    text = body["text"]
+                # batch_encode, not encode: it takes the native WordPiece
+                # fast path when built, and is byte-identical to what the
+                # predict pipeline feeds (bit-parity depends on it).
+                enc = self.tok.batch_encode([text], max_len=seq_len)
+                row_ids = enc["input_ids"][0]
+                row_mask = enc["attention_mask"][0]
+                deadline_ms = body.get("deadline_ms")
+                deadline_s = (
+                    float(deadline_ms) / 1e3
+                    if deadline_ms is not None
+                    else self.default_deadline_s
+                )
+                req = ScoreRequest(
+                    req_id=req_id,
+                    input_ids=row_ids,
+                    attention_mask=row_mask,
+                    reply=self._make_reply(writer, req_id),
+                    reject=reject,
+                    deadline_s=deadline_s,
+                )
+                if not self.batcher.submit(req):
+                    self._count_reject("overloaded")
+                    reject(
+                        protocol.REJECT_OVERLOADED,
+                        f"queue full ({self.batcher.max_queue} pending)",
+                    )
+        finally:
+            with self._conn_lock:
+                self._conns.discard(conn)
+            writer.close()
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _make_reply(self, writer: _ConnWriter, req_id: int):
+        def _reply(*, prob, round_id, batch_size, bucket, queue_ms):
+            writer.send(
+                protocol.build_reply(
+                    req_id,
+                    prob=prob,
+                    threshold=self.threshold,
+                    round_id=round_id,
+                    batch_size=batch_size,
+                    bucket=bucket,
+                    queue_ms=queue_ms,
+                )
+            )
+
+        return _reply
+
+    def _make_reject(self, writer: _ConnWriter, req_id: int):
+        def _reject(code: int, reason: str) -> None:
+            writer.send(
+                protocol.build_reject(req_id, code=code, reason=reason)
+            )
+
+        return _reject
+
+    # ------------------------------------------------------------ score path
+    def _count_reject(self, kind: str) -> None:
+        with self._stats_lock:
+            self._rejects[kind] += 1
+
+    def _score_loop(self) -> None:
+        while not self._closed.is_set():
+            if self.watcher is not None:
+                self.watcher.poll(self.engine)
+            batch = self.batcher.next_batch(timeout=self.idle_tick_s)
+            if not batch:
+                continue
+            now = time.monotonic()
+            live: list[ScoreRequest] = []
+            for r in batch:
+                if r.expired(now):
+                    self._count_reject("deadline")
+                    r.reject(
+                        protocol.REJECT_DEADLINE,
+                        f"deadline of {r.deadline_s * 1e3:.1f} ms exceeded "
+                        f"after {(now - r.t_enqueue) * 1e3:.1f} ms in queue",
+                    )
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            try:
+                probs, bucket, round_id = self.engine.score(
+                    np.stack([r.input_ids for r in live]),
+                    np.stack([r.attention_mask for r in live]),
+                )
+            except Exception as e:
+                # A failed dispatch must not hang the batch's clients
+                # (they'd block to their socket timeouts) or kill the
+                # scorer thread (the whole service): reject and move on.
+                log.warning(
+                    f"[SERVE] scoring dispatch failed "
+                    f"({type(e).__name__}: {e}); rejecting {len(live)} "
+                    "request(s)"
+                )
+                for r in live:
+                    # Counted per request: the most alarming reject class
+                    # must show in stats()/JSONL, not just client-side.
+                    self._count_reject("error")
+                    r.reject(500, f"scoring failed: {type(e).__name__}")
+                continue
+            done = time.monotonic()
+            n = len(live)
+            for r, p in zip(live, probs):
+                r.reply(
+                    prob=float(p),
+                    round_id=round_id,
+                    batch_size=n,
+                    bucket=bucket,
+                    queue_ms=(now - r.t_enqueue) * 1e3,
+                )
+            with self._stats_lock:
+                self._scored += n
+                self._batches += 1
+                self._batch_hist[n] += 1
+                self._latencies.extend(done - r.t_enqueue for r in live)
+            if self.metrics_jsonl:
+                from ..reporting import append_metrics_jsonl
+
+                append_metrics_jsonl(
+                    self.metrics_jsonl,
+                    {
+                        "phase": "serve_batch",
+                        "batch_size": n,
+                        "bucket": bucket,
+                        "round": round_id,
+                        "score_ms": round((done - now) * 1e3, 3),
+                        "queue_ms_max": round(
+                            max((now - r.t_enqueue) for r in live) * 1e3, 3
+                        ),
+                    },
+                )
+
+
+def _flat(stats: dict) -> dict:
+    """Flatten stats() for the scalar-only JSONL writer."""
+    out = {}
+    for k, v in stats.items():
+        if isinstance(v, dict):
+            for kk, vv in v.items():
+                out[f"{k}_{kk}"] = vv
+        else:
+            out[k] = v
+    return out

@@ -63,6 +63,12 @@ def _abstract(template: Any) -> Any:
     def _leaf(x):
         if _is_prng_key(x):
             x = jax.random.key_data(x)
+        elif isinstance(x, jax.ShapeDtypeStruct) and jnp.issubdtype(
+            x.dtype, jax.dtypes.prng_key
+        ):
+            # Abstract (eval_shape) templates carry typed-key leaves too;
+            # checkpoints store the raw key data, so describe that shape.
+            x = jax.eval_shape(jax.random.key_data, x)
         sharding = getattr(x, "sharding", None)
         return jax.ShapeDtypeStruct(
             np.shape(x), np.asarray(x).dtype if not hasattr(x, "dtype") else x.dtype,
@@ -134,6 +140,23 @@ class Checkpointer:
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
         abstract = _abstract(template)
+        if not hasattr(ocp, "PLACEHOLDER"):
+            # Older orbax without placeholder skipping (e.g. 0.7.x):
+            # restore the full abstract tree and keep only params. This
+            # pays the optimizer-moment materialization the placeholder
+            # path avoids — correct everywhere, memory-lean only on new
+            # orbax — instead of failing the whole predict/serve restore.
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    **{STATE_ITEM: ocp.args.StandardRestore(abstract)}
+                ),
+            )[STATE_ITEM]
+            return (
+                restored["params"]
+                if isinstance(restored, Mapping)
+                else restored.params
+            )
         masked = abstract._replace(
             **{
                 f: jax.tree.map(lambda _: ocp.PLACEHOLDER, getattr(abstract, f))

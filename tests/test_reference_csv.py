@@ -1,0 +1,160 @@
+"""Reference-CSV text-template parity, pinned in the suite.
+
+VERDICT round 5 verified by hand that ``load_flow_csv`` +
+``texts_from_dataframe`` reproduce the reference's ``features_to_text``
+(client1.py:68-81) byte-for-byte on the real bundled ``CICIDS2017.csv``
+rows — but no test pinned it. This fixture embeds ten rows in the real
+file's shape (the full 79-column header with its space-prefix quirks,
+``Infinity``/empty cells exercising the ±inf->NaN->column-mean
+imputation, reference client1.py:86-88) and asserts the rendered
+template output against literal expected strings, self-contained — no
+runtime dependency on the reference mount."""
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.cicids import (
+    load_flow_csv,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.textualize import (
+    FLOW_TEXT_COLUMNS,
+    flow_to_text,
+    labels_from_dataframe,
+    texts_from_dataframe,
+)
+
+#: The real CICIDS2017 export's 79-column header line, verbatim quirks
+#: included (leading spaces on most names, the duplicate-derived
+#: ``Fwd Header Length.1``).
+_HEADER = (
+    " Destination Port, Flow Duration, Total Fwd Packets, Total Backward"
+    " Packets,Total Length of Fwd Packets, Total Length of Bwd Packets,"
+    " Fwd Packet Length Max, Fwd Packet Length Min, Fwd Packet Length Mean,"
+    " Fwd Packet Length Std,Bwd Packet Length Max, Bwd Packet Length Min,"
+    " Bwd Packet Length Mean, Bwd Packet Length Std,Flow Bytes/s, Flow"
+    " Packets/s, Flow IAT Mean, Flow IAT Std, Flow IAT Max, Flow IAT Min,"
+    "Fwd IAT Total, Fwd IAT Mean, Fwd IAT Std, Fwd IAT Max, Fwd IAT Min,"
+    "Bwd IAT Total, Bwd IAT Mean, Bwd IAT Std, Bwd IAT Max, Bwd IAT Min,"
+    "Fwd PSH Flags, Bwd PSH Flags, Fwd URG Flags, Bwd URG Flags, Fwd"
+    " Header Length, Bwd Header Length,Fwd Packets/s, Bwd Packets/s, Min"
+    " Packet Length, Max Packet Length, Packet Length Mean, Packet Length"
+    " Std, Packet Length Variance,FIN Flag Count, SYN Flag Count, RST"
+    " Flag Count, PSH Flag Count, ACK Flag Count, URG Flag Count, CWE"
+    " Flag Count, ECE Flag Count, Down/Up Ratio, Average Packet Size, Avg"
+    " Fwd Segment Size, Avg Bwd Segment Size, Fwd Header Length.1,Fwd Avg"
+    " Bytes/Bulk, Fwd Avg Packets/Bulk, Fwd Avg Bulk Rate, Bwd Avg"
+    " Bytes/Bulk, Bwd Avg Packets/Bulk,Bwd Avg Bulk Rate,Subflow Fwd"
+    " Packets, Subflow Fwd Bytes, Subflow Bwd Packets, Subflow Bwd Bytes,"
+    "Init_Win_bytes_forward, Init_Win_bytes_backward, act_data_pkt_fwd,"
+    " min_seg_size_forward,Active Mean, Active Std, Active Max, Active"
+    " Min,Idle Mean, Idle Std, Idle Max, Idle Min, Label"
+)
+
+#: Ten rows' template-column values (plus Label), real-file value shapes:
+#: integer counts, 4-decimal rates, ``Infinity`` (row 6) and an empty
+#: cell (row 7) for the imputation path.
+_ROWS = [
+    (54865, 3, 2, 0, 12, 0, 6, 6, "4000000.0", "666666.6667", "BENIGN"),
+    (55054, 109, 1, 1, 6, 6, 6, 6, "110091.7431", "18348.62385", "BENIGN"),
+    (55055, 52, 1, 1, 6, 6, 6, 6, "230769.2308", "38461.53846", "BENIGN"),
+    (46236, 34, 1, 1, 6, 6, 6, 6, "352941.1765", "58823.52941", "BENIGN"),
+    (54863, 3, 2, 0, 12, 0, 6, 6, "4000000.0", "666666.6667", "BENIGN"),
+    (80, 10265, 6, 4, 352, 196, 176, 0, "Infinity", "974.1841208", "DDoS"),
+    (80, 1022, 3, 4, 26, 11607, 20, 0, "11382.58317", "", "DDoS"),
+    (443, 117573, 46, 62, 1988, 127536, 580, 0, "1101.476326", "918.5782628", "BENIGN"),
+    (53, 128, 2, 2, 70, 342, 35, 35, "3218750.0", "31250.0", "BENIGN"),
+    (8080, 5, 2, 0, 0, 0, 0, 0, "0.0", "400000.0", "BENIGN"),
+]
+
+#: Expected rendered sentences, pinned as literals (NOT recomputed from
+#: the template — that would be circular). Rows 6/7 carry the imputed
+#: column means: mean of the nine finite Flow Bytes/s values
+#: (11925036.209896 / 9 = 1325004.0233217778) and of the nine present
+#: Flow Packets/s values (1882119.26753236 / 9 = 209123.30972262222).
+_EXPECTED = [
+    "Destination port is 54865. Flow duration is 3 microseconds. Total forward packets are 2. Total backward packets are 0. Total length of forward packets is 12 bytes. Total length of backward packets is 0 bytes. Maximum forward packet length is 6. Minimum forward packet length is 6. Flow bytes per second is 4000000.0. Flow packets per second is 666666.6667.",
+    "Destination port is 55054. Flow duration is 109 microseconds. Total forward packets are 1. Total backward packets are 1. Total length of forward packets is 6 bytes. Total length of backward packets is 6 bytes. Maximum forward packet length is 6. Minimum forward packet length is 6. Flow bytes per second is 110091.7431. Flow packets per second is 18348.62385.",
+    "Destination port is 55055. Flow duration is 52 microseconds. Total forward packets are 1. Total backward packets are 1. Total length of forward packets is 6 bytes. Total length of backward packets is 6 bytes. Maximum forward packet length is 6. Minimum forward packet length is 6. Flow bytes per second is 230769.2308. Flow packets per second is 38461.53846.",
+    "Destination port is 46236. Flow duration is 34 microseconds. Total forward packets are 1. Total backward packets are 1. Total length of forward packets is 6 bytes. Total length of backward packets is 6 bytes. Maximum forward packet length is 6. Minimum forward packet length is 6. Flow bytes per second is 352941.1765. Flow packets per second is 58823.52941.",
+    "Destination port is 54863. Flow duration is 3 microseconds. Total forward packets are 2. Total backward packets are 0. Total length of forward packets is 12 bytes. Total length of backward packets is 0 bytes. Maximum forward packet length is 6. Minimum forward packet length is 6. Flow bytes per second is 4000000.0. Flow packets per second is 666666.6667.",
+    "Destination port is 80. Flow duration is 10265 microseconds. Total forward packets are 6. Total backward packets are 4. Total length of forward packets is 352 bytes. Total length of backward packets is 196 bytes. Maximum forward packet length is 176. Minimum forward packet length is 0. Flow bytes per second is 1325004.0233217778. Flow packets per second is 974.1841208.",
+    "Destination port is 80. Flow duration is 1022 microseconds. Total forward packets are 3. Total backward packets are 4. Total length of forward packets is 26 bytes. Total length of backward packets is 11607 bytes. Maximum forward packet length is 20. Minimum forward packet length is 0. Flow bytes per second is 11382.58317. Flow packets per second is 209123.30972262222.",
+    "Destination port is 443. Flow duration is 117573 microseconds. Total forward packets are 46. Total backward packets are 62. Total length of forward packets is 1988 bytes. Total length of backward packets is 127536 bytes. Maximum forward packet length is 580. Minimum forward packet length is 0. Flow bytes per second is 1101.476326. Flow packets per second is 918.5782628.",
+    "Destination port is 53. Flow duration is 128 microseconds. Total forward packets are 2. Total backward packets are 2. Total length of forward packets is 70 bytes. Total length of backward packets is 342 bytes. Maximum forward packet length is 35. Minimum forward packet length is 35. Flow bytes per second is 3218750.0. Flow packets per second is 31250.0.",
+    "Destination port is 8080. Flow duration is 5 microseconds. Total forward packets are 2. Total backward packets are 0. Total length of forward packets is 0 bytes. Total length of backward packets is 0 bytes. Maximum forward packet length is 0. Minimum forward packet length is 0. Flow bytes per second is 0.0. Flow packets per second is 400000.0.",
+]
+
+
+def _fixture_csv_path(tmp_path):
+    cols = [c.strip() for c in _HEADER.split(",")]
+    tmpl = list(FLOW_TEXT_COLUMNS)
+    lines = [_HEADER]
+    for row in _ROWS:
+        vals = dict(zip(tmpl + ["Label"], row))
+        lines.append(",".join(str(vals.get(c, 0)) for c in cols))
+    path = tmp_path / "cicids_fixture.csv"
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def test_features_to_text_byte_parity_on_reference_shaped_rows(tmp_path):
+    """Load -> impute -> render must reproduce the pinned byte-exact
+    sentences (the reference's features_to_text semantics, including the
+    imputed means flowing into the rendered text), and the per-row
+    renderer (the serving features path) must agree with the vectorized
+    one."""
+    df = load_flow_csv(_fixture_csv_path(tmp_path))
+    assert len(df.columns) == 79  # whole real header survived the strip
+    texts = texts_from_dataframe(df)
+    assert texts == _EXPECTED
+    # Imputation really fired: no non-finite values remain in the
+    # rendered numeric columns.
+    for col in FLOW_TEXT_COLUMNS:
+        assert np.isfinite(df[col].to_numpy(np.float64)).all(), col
+    # flow_to_text (per-row, the serving/feature-request path) is
+    # byte-identical to the vectorized renderer.
+    for row, want in zip(df.to_dict("records"), _EXPECTED):
+        assert flow_to_text(row) == want
+    # Reference label map: 'DDoS' -> 1 else 0 (client1.py:91).
+    assert labels_from_dataframe(df).tolist() == [0] * 5 + [1, 1] + [0] * 3
+
+
+@pytest.mark.slow
+def test_reference_shaped_csv_trains_on_degenerate_single_class(tmp_path):
+    """The reference's bundled stub is all-BENIGN; the pipeline must
+    survive that degenerate single-class case end to end: load ->
+    render -> tokenize -> a train step + eval with finite outputs."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        ModelConfig,
+        TrainConfig,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+        default_tokenizer,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.pipeline import (
+        TokenizedSplit,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.engine import (
+        Trainer,
+    )
+
+    df = load_flow_csv(_fixture_csv_path(tmp_path))
+    benign = df[df["Label"] == "BENIGN"]  # the stub's shape: one class
+    texts = texts_from_dataframe(benign)
+    labels = labels_from_dataframe(benign)
+    assert (labels == 0).all()
+    tok = default_tokenizer()
+    model_cfg = ModelConfig.tiny(vocab_size=len(tok.vocab))
+    enc = tok.batch_encode(texts, max_len=model_cfg.max_len)
+    split = TokenizedSplit(
+        enc["input_ids"], enc["attention_mask"], labels.astype(np.int32)
+    )
+    trainer = Trainer(
+        model_cfg, TrainConfig(), pad_id=tok.pad_id, drop_remainder=False
+    )
+    state = trainer.init_state(seed=0)
+    state, losses = trainer.fit(state, split, batch_size=4, epochs=1)
+    assert losses and np.isfinite(losses[0])
+    metrics = trainer.evaluate(state.params, split, batch_size=4)
+    assert np.isfinite(metrics["Loss"])
+    assert len(metrics["probs"]) == len(benign)

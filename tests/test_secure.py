@@ -1332,6 +1332,195 @@ def test_topk_client_refused_cleanly_by_secure_server(rng):
             plain.exchange(_params(rng), max_retries=5)
 
 
+def _served_answer_unmask(client, request, share_st, session, round_no):
+    """Run one _answer_unmask over a socketpair with a scripted server
+    side (recv the response, send a dummy final reply) — the transport
+    legs a successful answer needs."""
+    import socket as socket_mod
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        framing,
+    )
+
+    a, b = socket_mod.socketpair()
+    captured = {}
+
+    def server_side():
+        captured["response"] = bytes(framing.recv_frame(b))
+        framing.send_frame(b, b"final-reply")
+
+    t = threading.Thread(target=server_side, daemon=True)
+    t.start()
+    try:
+        reply = client._answer_unmask(a, request, share_st, session, round_no)
+    finally:
+        t.join(timeout=10)
+        a.close()
+        b.close()
+    return reply, captured.get("response")
+
+
+def test_unmask_partition_pinned_across_retries():
+    """Advisor-high comm/client.py: the answer-then-drop replay. A
+    malicious server gets one (alive, dead) partition answered, drops the
+    connection, and on the retry relays a DIFFERENT partition moving a
+    victim from alive to dead — harvesting both its b-share and its
+    key-seed share would unmask the victim's upload. The first answered
+    partition is pinned per (session, round); the conflicting request
+    must die with a non-retryable SecureAggError, while an identical
+    re-request (an honest retry) still answers."""
+    import os as os_mod
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.secure import (
+        build_unmask_request,
+    )
+
+    session, round_no = b"s" * 16, 1
+    kw = dict(session=session, round_index=round_no)
+    client = FederatedClient(
+        "h", 1, client_id=0, secure_agg=True, num_clients=3,
+        min_participants=2,
+    )
+    share_st = {
+        "u2": [0, 1, 2],
+        "own_b_share": os_mod.urandom(32),
+        "holder_shares": {
+            1: (os_mod.urandom(32), os_mod.urandom(32)),
+            2: (os_mod.urandom(32), os_mod.urandom(32)),
+        },
+    }
+    first = build_unmask_request([0, 1, 2], [], **kw)
+    reply, response = _served_answer_unmask(
+        client, first, share_st, session, round_no
+    )
+    assert reply == b"final-reply" and response is not None
+    assert share_st["unmask_partition"] == ((0, 1, 2), ())
+    # Honest retry (identical partition): still answered.
+    reply2, _ = _served_answer_unmask(
+        client, first, share_st, session, round_no
+    )
+    assert reply2 == b"final-reply"
+    # Malicious retry: client 2 moved alive -> dead. No socket I/O may
+    # happen (the sk-share must never leave this process) — sock=None
+    # proves the refusal fires before any send.
+    moved = build_unmask_request([0, 1], [2], **kw)
+    with pytest.raises(SecureAggError, match="partition changed"):
+        client._answer_unmask(None, moved, share_st, session, round_no)
+    # The pin survives the refused attempt unchanged.
+    assert share_st["unmask_partition"] == ((0, 1, 2), ())
+
+
+def test_shareset_u2_pinned_across_retries():
+    """Advisor-medium comm/client.py: U2/holder shares are pinned across
+    retries of one round like ``participants``. A retried connection
+    whose relay presents a smaller (but floor-passing) share-complete
+    set — the server steering the client between mask partitions to
+    difference its uploads — must fail closed with SecureAggError."""
+    import os as os_mod
+    import socket as socket_mod
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        framing,
+        shamir,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        secure as sec,
+    )
+
+    session, round_no = b"u" * 16, 2
+    C = 3
+    pairs, secrets = _fleet_keys(C)
+    client = FederatedClient(
+        "h", 1, client_id=0, secure_agg=True, num_clients=C,
+        min_participants=2,
+    )
+    participants = [0, 1, 2]
+    t = sec.majority_threshold(C)  # 2
+    sk_seed = os_mod.urandom(sec.SEED_LEN)
+
+    def dealer_entries(u2):
+        """The relayed shareset entries: every OTHER dealer in u2 deals
+        holder 0 a share blob (the test plays the dealers)."""
+        entries = {}
+        xs = [sec.share_x(p) for p in participants]
+        for d in u2:
+            if d == 0:
+                continue
+            shares_b = shamir.split(os_mod.urandom(sec.SEED_LEN), xs, t)
+            shares_sk = shamir.split(os_mod.urandom(sec.SEED_LEN), xs, t)
+            entries[d] = sec.encrypt_share_blob(
+                secrets[d][0], session, round_no, d, 0,
+                shares_b[sec.share_x(0)], shares_sk[sec.share_x(0)],
+            )
+        return entries
+
+    def run_attempt(u2, entries):
+        a, b = socket_mod.socketpair()
+        errors = []
+
+        def relay():
+            try:
+                framing.recv_frame(b)  # the client's shares frame
+                framing.send_frame(
+                    b,
+                    sec.build_shareset_frame(
+                        u2, entries, session=session, round_index=round_no
+                    ),
+                )
+            except Exception as e:  # surfaced via the client-side raise
+                errors.append(e)
+
+        th = threading.Thread(target=relay, daemon=True)
+        th.start()
+        try:
+            return client._double_share_exchange(
+                a, participants, secrets[0], sk_seed, session, round_no
+            )
+        finally:
+            th.join(timeout=10)
+            a.close()
+            b.close()
+
+    st = run_attempt([0, 1, 2], dealer_entries([0, 1, 2]))
+    assert st["u2"] == [0, 1, 2] and sorted(st["holder_shares"]) == [1, 2]
+    pinned_shares = dict(st["holder_shares"])
+    # Retry relays U2 = {0, 1}: len 2 passes the min_participants floor
+    # AND the Shamir threshold — only the pin stops the partition switch.
+    with pytest.raises(SecureAggError, match="share-complete set changed"):
+        run_attempt([0, 1], dealer_entries([0, 1]))
+    # Same U2 but re-dealt (different) shares is the same attack — and
+    # the refusal must say WHICH dealers changed, not print two
+    # identical U2 sets as "changed".
+    with pytest.raises(SecureAggError, match="re-dealt different shares"):
+        run_attempt([0, 1, 2], dealer_entries([0, 1, 2]))
+    # The pinned state survives the refused retries unchanged.
+    assert st["u2"] == [0, 1, 2] and st["holder_shares"] == pinned_shares
+
+
+def test_secure_quorum_floor_survives_one_member_cohort():
+    """Advisor-low comm/server.py: the Poisson-cohort clamp must not drag
+    the secure-agg quorum below 2 — a 1-member cohort's "sum" is that
+    client's raw update. quorum = max(2, min(min_clients, |cohort|))
+    when secure aggregation is on; plain rounds keep the liveness
+    clamp."""
+    with AggregationServer(
+        port=0, num_clients=3, min_clients=2, secure_agg=True, timeout=5
+    ) as server:
+        assert server._round_quorum(None) == 2
+        assert server._round_quorum({0, 1, 2}) == 2
+        assert server._round_quorum({1}) == 2  # the degenerate cohort
+        assert server._round_quorum(set()) == 2  # (empty cohorts no-op earlier)
+    with AggregationServer(
+        port=0, num_clients=3, min_clients=1, timeout=5
+    ) as server:  # no secure-agg: the cohort clamp is pure liveness
+        assert server._round_quorum({1}) == 1
+        assert server._round_quorum(None) == 1
+    # Constructor guard unchanged: an explicit sub-2 floor under secure
+    # aggregation is refused outright.
+    with pytest.raises(ValueError, match="min_clients >= 2"):
+        AggregationServer(port=0, num_clients=2, min_clients=1, secure_agg=True)
+
+
 @pytest.mark.slow
 def test_double_mask_combined_dropouts_at_threshold(rng):
     """Both recovery mechanisms in ONE round at the exact Shamir

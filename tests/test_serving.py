@@ -1,0 +1,452 @@
+"""Online scoring service (serving/): protocol, batcher, bucketed engine,
+and the end-to-end acceptance flow — concurrent clients coalescing into
+one bucket dispatch, bit-for-bit parity with the predict pipeline,
+explicit deadline rejects, hot checkpoint reload mid-traffic, and
+exactly one XLA compilation per (bucket, seq) shape."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+    default_tokenizer,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.datasets import (
+    get_dataset,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.pipeline import (
+    TokenizedSplit,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.serving import (
+    CheckpointWatcher,
+    MicroBatcher,
+    ScoreEngine,
+    ScoreRejected,
+    ScoreRequest,
+    ScoringClient,
+    ScoringServer,
+    protocol,
+    run_load,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.engine import (
+    Trainer,
+)
+
+TEXTS = [
+    f"Destination port is {p}. Flow duration is {d} microseconds. "
+    f"Total forward packets are {n}."
+    for p, d, n in [
+        (80, 100, 3),
+        (443, 2500, 9),
+        (8080, 7, 1),
+        (53, 120000, 44),
+        (22, 31, 2),
+        (3389, 9999, 17),
+    ]
+]
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    tok = default_tokenizer()
+    model_cfg = ModelConfig.tiny(vocab_size=len(tok.vocab))
+    trainer = Trainer(model_cfg, TrainConfig(), pad_id=tok.pad_id)
+    params = trainer.init_state(seed=0).params
+    return tok, model_cfg, trainer, params
+
+
+def _expected_probs(tok, trainer, params, texts, batch_size=16):
+    """The predict pipeline's probabilities (cli/predict.py feed shape)."""
+    enc = tok.batch_encode(texts, max_len=trainer.model_cfg.max_len)
+    split = TokenizedSplit(
+        enc["input_ids"],
+        enc["attention_mask"],
+        np.zeros(len(texts), np.int32),
+    )
+    return trainer.evaluate(params, split, batch_size=batch_size)["probs"]
+
+
+# ----------------------------------------------------------------- protocol
+def test_protocol_roundtrip_and_validation():
+    req = protocol.parse_request(
+        protocol.build_request(7, text="hello", deadline_ms=12.5)
+    )
+    assert req == {"id": 7, "text": "hello", "deadline_ms": 12.5}
+    rep = protocol.parse_reply(
+        protocol.build_reply(
+            7,
+            prob=0.25,
+            threshold=0.5,
+            round_id=3,
+            batch_size=4,
+            bucket=8,
+            queue_ms=1.5,
+        )
+    )
+    assert rep["prob"] == 0.25 and rep["prediction"] == 0 and rep["round"] == 3
+    rej = protocol.parse_reject(
+        protocol.build_reject(9, code=503, reason="queue full")
+    )
+    assert rej["code"] == 503 and protocol.is_reject(
+        protocol.build_reject(9, code=503, reason="x")
+    )
+    with pytest.raises(ValueError):
+        protocol.build_request(1)  # neither text nor features
+    with pytest.raises(ValueError):
+        protocol.build_request(1, text="a", features={"b": 1})
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.wire import (
+        WireError,
+    )
+
+    with pytest.raises(WireError):
+        protocol.parse_request(b"XXXX{}")
+    with pytest.raises(WireError):
+        protocol.parse_request(protocol.build_reply(
+            1, prob=0.1, threshold=0.5, round_id=0, batch_size=1,
+            bucket=1, queue_ms=0.0,
+        ))
+    # Wrong-TYPED fields are network input too: each must fail as a
+    # WireError (clean connection drop), never a TypeError in a reader.
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.wire import (
+        SCORE_REQ_MAGIC,
+    )
+
+    for bad in (
+        b'{"id": null, "text": "x"}',
+        b'{"id": true, "text": "x"}',
+        b'{"id": 1, "text": 5}',
+        b'{"id": 1, "features": [1, 2]}',
+        b'{"id": 1, "text": "x", "deadline_ms": "abc"}',
+        b'[1, 2, 3]',
+    ):
+        with pytest.raises(WireError):
+            protocol.parse_request(SCORE_REQ_MAGIC + bad)
+
+
+def test_protocol_prob_crosses_bit_exact():
+    """float32 -> JSON double -> parse is lossless (the wire leg of the
+    bit-for-bit predict-parity guarantee)."""
+    for bits in (0.1, 1 / 3, 0.9999999, 1e-30):
+        p32 = np.float32(bits)
+        body = protocol.parse_reply(
+            protocol.build_reply(
+                1, prob=float(p32), threshold=0.5, round_id=0,
+                batch_size=1, bucket=1, queue_ms=0.0,
+            )
+        )
+        assert body["prob"] == float(p32)
+
+
+# ------------------------------------------------------------------ batcher
+def _req(i, deadline_s=None):
+    return ScoreRequest(
+        req_id=i,
+        input_ids=np.zeros(4, np.int32),
+        attention_mask=np.zeros(4, np.int32),
+        reply=lambda **kw: None,
+        reject=lambda code, reason: None,
+        deadline_s=deadline_s,
+    )
+
+
+def test_batcher_coalesces_within_window():
+    b = MicroBatcher(max_batch=8, max_queue=16, gather_window_s=0.2)
+    for i in range(3):
+        assert b.submit(_req(i))
+    batch = b.next_batch(timeout=1.0)
+    assert [r.req_id for r in batch] == [0, 1, 2]
+    assert b.next_batch(timeout=0.01) == []
+
+
+def test_batcher_caps_at_max_batch_and_bounds_queue():
+    b = MicroBatcher(max_batch=2, max_queue=4, gather_window_s=0.05)
+    admitted = [b.submit(_req(i)) for i in range(6)]
+    assert admitted == [True] * 4 + [False] * 2  # bounded admission
+    assert len(b.next_batch(timeout=0.5)) == 2  # capped at max_batch
+    assert len(b.next_batch(timeout=0.5)) == 2
+    with pytest.raises(ValueError):
+        MicroBatcher(max_batch=8, max_queue=4)
+
+
+def test_request_expiry():
+    r = _req(0, deadline_s=0.0)
+    assert r.expired()
+    assert not _req(1).expired()  # no deadline = never expires
+    r2 = _req(2, deadline_s=30.0)
+    assert not r2.expired()
+
+
+# ------------------------------------------------------------------- engine
+def test_engine_bucketing_and_single_compile_per_shape(tiny_setup):
+    tok, model_cfg, trainer, params = tiny_setup
+    eng = ScoreEngine(
+        model_cfg, params, pad_id=tok.pad_id, buckets=(1, 4, 8), round_id=1
+    )
+    L = model_cfg.max_len
+    enc = tok.batch_encode(TEXTS, max_len=L)
+    # Mixed-size storm: sizes map onto buckets 1/4/4/8, repeated — only
+    # the first hit of each bucket may trace.
+    for n in (1, 3, 4, 6, 1, 2, 5, 6, 3, 1):
+        probs, bucket, rid = eng.score(
+            enc["input_ids"][:n], enc["attention_mask"][:n]
+        )
+        assert probs.shape == (n,) and rid == 1
+        assert bucket == min(b for b in (1, 4, 8) if b >= n)
+    assert eng.compile_counts == {(1, L): 1, (4, L): 1, (8, L): 1}
+    with pytest.raises(ValueError):
+        eng.score(enc["input_ids"][:9] if len(TEXTS) >= 9 else
+                  np.zeros((9, L), np.int32), np.zeros((9, L), np.int32))
+
+
+def test_engine_probs_match_predict_pipeline_bitwise(tiny_setup):
+    tok, model_cfg, trainer, params = tiny_setup
+    eng = ScoreEngine(model_cfg, params, pad_id=tok.pad_id, buckets=(1, 4, 8))
+    enc = tok.batch_encode(TEXTS[:3], max_len=model_cfg.max_len)
+    got, _, _ = eng.score(enc["input_ids"], enc["attention_mask"])
+    want = _expected_probs(tok, trainer, params, TEXTS[:3])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_engine_swap_changes_round_and_weights(tiny_setup):
+    tok, model_cfg, trainer, params = tiny_setup
+    eng = ScoreEngine(model_cfg, params, pad_id=tok.pad_id, buckets=(4,))
+    enc = tok.batch_encode(TEXTS[:2], max_len=model_cfg.max_len)
+    before, _, rid0 = eng.score(enc["input_ids"], enc["attention_mask"])
+    new_params = trainer.init_state(seed=1).params
+    eng.swap(new_params, round_id=rid0 + 1)
+    after, _, rid1 = eng.score(enc["input_ids"], enc["attention_mask"])
+    assert rid1 == rid0 + 1
+    assert not np.array_equal(before, after)
+    # Same shapes: the swap must not retrace.
+    assert all(v == 1 for v in eng.compile_counts.values())
+
+
+# ---------------------------------------------------------------------- e2e
+def test_scoring_service_end_to_end(tiny_setup, tmp_path):
+    """The acceptance flow in one service lifetime: three concurrent
+    clients coalesce into a shared bucket batch (telemetry batch_size >
+    1) with probabilities bit-for-bit equal to the predict pipeline's; an
+    over-deadline request gets the explicit reject frame (not a hang); a
+    checkpoint written mid-test is hot-reloaded and served with the new
+    round id; and a mixed-size request storm leaves exactly one XLA
+    compilation per (bucket, seq) shape."""
+    import jax
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli.predict import (
+        _restore_predict_params,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.serving.reload import (
+        checkpoint_restorer,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.checkpoint import (
+        Checkpointer,
+    )
+
+    tok, model_cfg, trainer, _ = tiny_setup
+    cfg = ExperimentConfig(
+        model=model_cfg,
+        data=DataConfig(max_len=model_cfg.max_len),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    state1 = trainer.init_state(seed=3)
+    state2 = trainer.init_state(seed=4)
+    meta = {"kind": "local", "config": cfg.to_dict()}
+    with Checkpointer(cfg.checkpoint_dir) as ckpt:
+        ckpt.save(1, state1, meta={**meta, "round": 1})
+        ckpt.wait()
+
+    # Serve FROM the checkpoint through the predict-path restore.
+    restored_cfg, restored_params = _restore_predict_params(
+        cfg, tok, trainer
+    )
+    assert restored_cfg == model_cfg
+    buckets = (1, 4, 8)
+    eng = ScoreEngine(
+        model_cfg, restored_params, pad_id=tok.pad_id, buckets=buckets,
+        round_id=1,
+    )
+    watcher = CheckpointWatcher(
+        cfg.checkpoint_dir, checkpoint_restorer(cfg, tok),
+        poll_interval_s=0.0,
+    )
+    server = ScoringServer(
+        eng,
+        tok,
+        spec=get_dataset("cicids2017"),
+        batcher=MicroBatcher(max_batch=8, max_queue=64, gather_window_s=0.25),
+        watcher=watcher,
+        idle_tick_s=0.02,
+        metrics_jsonl=str(tmp_path / "metrics.jsonl"),
+    )
+    expected1 = _expected_probs(tok, trainer, state1.params, TEXTS[:3])
+    with server:
+        # --- 3 concurrent clients -> one coalesced bucket batch --------
+        barrier = threading.Barrier(3)
+        replies = {}
+
+        def go(i):
+            with ScoringClient("127.0.0.1", server.port, timeout=30) as c:
+                barrier.wait()
+                replies[i] = c.score(text=TEXTS[i])
+
+        threads = [threading.Thread(target=go, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert sorted(replies) == [0, 1, 2]
+        # Bit-for-bit parity with the predict pipeline (float32 -> JSON
+        # double is exact; see serving/protocol.py).
+        for i in range(3):
+            assert replies[i]["prob"] == float(expected1[i]), (i, replies[i])
+            assert replies[i]["round"] == 1
+        # Coalescing evidence: the three requests shared a batch.
+        assert max(r["batch_size"] for r in replies.values()) > 1
+        assert all(r["bucket"] == 4 for r in replies.values())
+
+        # --- over-deadline request -> explicit reject, not a hang ------
+        with ScoringClient("127.0.0.1", server.port, timeout=30) as c:
+            with pytest.raises(ScoreRejected) as exc:
+                c.score(text=TEXTS[0], deadline_ms=0.0)
+            assert exc.value.code == protocol.REJECT_DEADLINE
+        assert server.stats()["rejects"]["deadline"] == 1
+
+        # --- checkpoint written mid-test -> hot reload, new round id ---
+        with Checkpointer(cfg.checkpoint_dir) as ckpt:
+            ckpt.save(2, state2, meta={**meta, "round": 2})
+            ckpt.wait()
+        expected2 = _expected_probs(tok, trainer, state2.params, TEXTS[:3])
+        deadline = time.monotonic() + 30.0
+        reply = None
+        with ScoringClient("127.0.0.1", server.port, timeout=30) as c:
+            while time.monotonic() < deadline:
+                reply = c.score(text=TEXTS[0])
+                if reply["round"] == 2:
+                    break
+                time.sleep(0.05)
+        assert reply is not None and reply["round"] == 2, reply
+        assert reply["prob"] == float(expected2[0])
+        assert watcher.reload_count == 1
+
+        # --- mixed-size storm: still one compile per (bucket, seq) -----
+        stats = run_load(
+            "127.0.0.1", server.port, TEXTS, concurrency=5, requests=25,
+        )
+        assert stats["scored"] == 25 and stats["rejected"] == 0
+        assert stats["p50_ms"] > 0.0 and stats["p99_ms"] >= stats["p50_ms"]
+        L = model_cfg.max_len
+        assert eng.compile_counts == {(b, L): 1 for b in buckets}
+        final = server.stats()
+        assert final["scored"] >= 29 and final["round"] == 2
+    # The metrics-JSONL channel carried per-batch records + the summary.
+    import json
+
+    records = [
+        json.loads(line)
+        for line in (tmp_path / "metrics.jsonl").read_text().splitlines()
+    ]
+    phases = {r["phase"] for r in records}
+    assert {"serve_batch", "serve_summary"} <= phases
+    assert any(
+        r["phase"] == "serve_batch" and r["batch_size"] > 1 for r in records
+    )
+    jax.clear_caches()
+
+
+def test_overload_is_rejected_not_queued(tiny_setup):
+    """Admission control: with the queue bound at 1 and the scorer wedged
+    (a poison request whose reply callback blocks it), excess requests
+    get the 503-style reject frame immediately instead of queueing into
+    unbounded latency."""
+    tok, model_cfg, trainer, params = tiny_setup
+    eng = ScoreEngine(model_cfg, params, pad_id=tok.pad_id, buckets=(1,))
+    server = ScoringServer(
+        eng,
+        tok,
+        batcher=MicroBatcher(max_batch=1, max_queue=1, gather_window_s=0.0),
+        idle_tick_s=0.01,
+        warmup=True,
+    )
+    L = model_cfg.max_len
+    wedge = threading.Event()
+    with server:
+        # Wedge the single scorer thread: it dequeues this request,
+        # scores it, and blocks inside its reply callback.
+        server.batcher.submit(
+            ScoreRequest(
+                req_id=0,
+                input_ids=np.zeros(L, np.int32),
+                attention_mask=np.zeros(L, np.int32),
+                reply=lambda **kw: wedge.wait(timeout=20),
+                reject=lambda code, reason: None,
+            )
+        )
+        deadline = time.monotonic() + 10.0
+        while server.batcher.qsize() > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)  # scorer has taken the poison request
+        outcomes = {}
+
+        def go(i):
+            try:
+                with ScoringClient(
+                    "127.0.0.1", server.port, timeout=30
+                ) as c:
+                    outcomes[i] = c.score(text=TEXTS[i % len(TEXTS)])
+            except ScoreRejected as e:
+                outcomes[i] = e
+
+        threads = [
+            threading.Thread(target=go, args=(i,)) for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        # All six submissions resolve at ADMISSION (5 shed, 1 queued)
+        # while the scorer is still wedged; only then release it so the
+        # queued request can be served and its client thread can join.
+        deadline = time.monotonic() + 15.0
+        while (
+            server.stats()["rejects"]["overloaded"] < 5
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        wedge.set()
+        for t in threads:
+            t.join(timeout=30)
+        rejected = [
+            o for o in outcomes.values() if isinstance(o, ScoreRejected)
+        ]
+        assert len(rejected) == 5, outcomes  # 1 queue slot, 5 shed
+        assert all(
+            r.code == protocol.REJECT_OVERLOADED for r in rejected
+        )
+        assert server.stats()["rejects"]["overloaded"] == 5
+
+
+def test_infer_serve_parser_wiring():
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli.parser import (
+        build_parser,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli.serving import (
+        _parse_buckets,
+        cmd_infer_serve,
+    )
+
+    args = build_parser().parse_args(
+        ["infer-serve", "--checkpoint-dir", "/tmp/x", "--buckets", "1,16",
+         "--max-wait-ms", "2", "--default-deadline-ms", "250"]
+    )
+    assert args.fn is cmd_infer_serve
+    assert _parse_buckets(args.buckets) == (1, 16)
+    assert args.default_deadline_ms == 250.0
+    with pytest.raises(SystemExit):
+        _parse_buckets("fast,slow")
+    with pytest.raises(SystemExit):
+        _parse_buckets("0,8")
