@@ -154,16 +154,31 @@ def cmd_client(args) -> int:
     on the next launch, client1.py:375-377,388,403 — its only multi-round
     mechanism), upgraded to full Orbax state. ``--rounds R`` runs the
     re-launch loop in-process instead (the server must be serving at least
-    as many rounds)."""
+    as many rounds).
+
+    ``--data-parallel N`` / ``--seq-parallel M`` train the LOCAL phase over
+    this host's own device mesh (train/client_mesh.py): batch rows shard
+    over N chips (threefry-identical trajectory to the single-device
+    client), sequences ring over M. The wire exchange is untouched —
+    params gather to host as one replica, the aggregate scatters back onto
+    the mesh — so --secure-agg and --dp compose unchanged."""
     from ..comm import FederatedClient, SecureAggError
-    from ..train.engine import Trainer
+    from ..train.client_mesh import make_client_trainer
 
     tok, cfg, pretrained = _resolve_with_pretrained(args)
     client_data = _load_clients(args, cfg, tok, cfg.fed.num_clients)[args.client_id]
-    trainer = Trainer(
-        cfg.model, cfg.train, pad_id=tok.pad_id,
-        drop_remainder=cfg.data.drop_remainder,
-    )
+    try:
+        trainer = make_client_trainer(cfg, pad_id=tok.pad_id)
+    except ValueError as e:
+        # Operator error (axes vs local devices / batch / max_len), not a
+        # traceback: --data-parallel 4 on a 2-chip host etc.
+        raise SystemExit(str(e)) from None
+    if cfg.mesh.data > 1 or cfg.mesh.seq > 1:
+        log.info(
+            f"[CLIENT {args.client_id}] local mesh: data={cfg.mesh.data}"
+            + (f" x seq={cfg.mesh.seq}" if cfg.mesh.seq > 1 else "")
+            + f" over {cfg.mesh.data * cfg.mesh.seq} local device(s)"
+        )
     state = trainer.init_state(params=pretrained)
     ckpt = None
     if cfg.checkpoint_dir:
@@ -192,8 +207,6 @@ def cmd_client(args) -> int:
         secure_protocol=getattr(args, "secure_protocol", "double"),
         secure_threshold=getattr(args, "secure_threshold", None),
     )
-    import jax.numpy as jnp
-
     rounds = max(1, getattr(args, "rounds", None) or 1)
     local = agg_metrics = None
     E = cfg.train.epochs_per_round
@@ -208,11 +221,15 @@ def cmd_client(args) -> int:
         # Central DP: the round base is what THIS round's training starts
         # from — the shared init in round 1 (every client must launch from
         # the same weights; the server enforces crc equality), the adopted
-        # aggregate afterwards. np.array(copy=True), NOT np.asarray: the
-        # jitted train step donates its input buffers, and a zero-copy
-        # view would silently alias the POST-training params (zero delta).
+        # aggregate afterwards. host_params gathers the trainer's wire
+        # form (one replica of a meshed state); np.array(copy=True), NOT
+        # the gathered view: the jitted train step donates its input
+        # buffers, and a zero-copy view would silently alias the
+        # POST-training params (zero delta).
         round_base = (
-            jax.tree.map(lambda x: np.array(x, copy=True), state.params)
+            jax.tree.map(
+                lambda x: np.array(x, copy=True), trainer.host_params(state)
+            )
             if fed.dp
             else None
         )
@@ -221,7 +238,7 @@ def cmd_client(args) -> int:
                 state, client_data.train, batch_size=cfg.data.batch_size,
                 epoch_offset=r * E, tag=f"[CLIENT {args.client_id}] ",
             )
-        local = trainer.evaluate(state.params, client_data.test)
+        local = trainer.evaluate_state(state, client_data.test)
         if ckpt is not None:
             # Post-train save — the reference's client1.py:388.
             save_seq += 1
@@ -234,7 +251,7 @@ def cmd_client(args) -> int:
                     "config": cfg.to_dict(),
                 },
             )
-        host_params = jax.tree.map(np.asarray, state.params)
+        host_params = trainer.host_params(state)
         try:
             with phase("federated exchange", tag="COMM"):
                 aggregated = fed.exchange(
@@ -264,10 +281,10 @@ def cmd_client(args) -> int:
                     )
             # Continue the next round FROM the aggregate with a fresh Adam
             # (every reference re-launch constructs a new optimizer,
-            # client1.py:380) but a continuing step counter (LR warmup).
-            trained_steps = int(state.step)
-            state = trainer.init_state(params=aggregated)
-            state = state._replace(step=jnp.asarray(trained_steps, jnp.int32))
+            # client1.py:380) but a continuing step counter (LR warmup);
+            # a meshed trainer scatters the aggregate onto its device mesh
+            # here, with no intermediate full-replica state.
+            state = trainer.adopt_aggregate(state, aggregated)
             if ckpt is not None:
                 # Post-aggregate save — the reference's client1.py:403.
                 save_seq += 1

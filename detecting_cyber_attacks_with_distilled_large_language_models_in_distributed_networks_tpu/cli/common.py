@@ -40,6 +40,22 @@ def _preset_model(preset: str, vocab_size: int) -> ModelConfig:
     )
 
 
+def _resolve_mesh(args, cfg: ExperimentConfig, n: int) -> MeshConfig:
+    """Mesh axes from flags: ``is None`` checks (an explicit 0 must reach
+    MeshConfig's own validation, not silently fall back to the config
+    default), and validation errors surface as operator messages."""
+    dp = getattr(args, "data_parallel", None)
+    sp = getattr(args, "seq_parallel", None)
+    try:
+        return MeshConfig(
+            clients=n,
+            data=cfg.mesh.data if dp is None else dp,
+            seq=cfg.mesh.seq if sp is None else sp,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+
+
 def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentConfig:
     """defaults <- --config file <- flags."""
     if getattr(args, "config", None):
@@ -174,11 +190,7 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
                     or cfg.fed.personalize_scope
                 ),
             ),
-            mesh=MeshConfig(
-                clients=n,
-                data=getattr(args, "data_parallel", None) or cfg.mesh.data,
-                seq=getattr(args, "seq_parallel", None) or cfg.mesh.seq,
-            ),
+            mesh=_resolve_mesh(args, cfg, n),
         )
     if getattr(args, "output_dir", None):
         cfg = dataclasses.replace(cfg, output_dir=args.output_dir)

@@ -368,6 +368,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=12345)
     p.add_argument("--client-id", type=int, required=True)
     p.add_argument("--num-clients", type=int, default=None)  # None: config wins
+    p.add_argument(
+        "--data-parallel",
+        type=int,
+        help="shard the local training batch over this many of THIS "
+        "host's devices (params replicated, gradient psum on-mesh); the "
+        "trajectory stays threefry-identical to the single-device client "
+        "and the wire exchange is unchanged",
+    )
+    p.add_argument(
+        "--seq-parallel",
+        type=int,
+        help="sequence-parallel shards for the local phase (ring "
+        "attention over a local 'seq' mesh axis via a C=1 fedseq trainer; "
+        "model.max_len must divide by it)",
+    )
     p.add_argument("--timeout", type=float, default=300.0)
     p.add_argument(
         "--compression",

@@ -25,6 +25,18 @@ from . import framing, secure, wire
 log = get_logger()
 
 
+def _host_params(tree: Any) -> Any:
+    """Materialize every leaf of a nested param dict on host exactly once.
+
+    The meshed TCP client (cli/comm.py ``--data-parallel``) hands exchange
+    device-backed replicated arrays; ``np.asarray`` here is the one
+    device->host gather, so the retry loop's per-attempt flatten/encode
+    passes never re-cross the device boundary."""
+    if isinstance(tree, Mapping):
+        return {k: _host_params(v) for k, v in tree.items()}
+    return np.asarray(tree)
+
+
 def connect_with_retry(
     host: str,
     port: int,
@@ -242,6 +254,9 @@ class FederatedClient:
         params would carry the undelivered drift in its params AND in the
         residual, over-correcting those coordinates roughly 2x per round.
         """
+        params = _host_params(params)
+        if round_base is not None:
+            round_base = _host_params(round_base)
         base_meta = {
             "client_id": self.client_id,
             "n_samples": int(n_samples),

@@ -1099,6 +1099,7 @@ class AggregationServer:
             )
         deadline = time.monotonic() + (self.timeout if deadline is None else deadline)
         threads: list[threading.Thread] = []
+        listener_closed = False
         # Sitting-out liveness bound: once every cohort upload has landed,
         # missing non-sampled clients get a short grace to connect for
         # their reply, not the whole round deadline (one crashed skip
@@ -1136,15 +1137,28 @@ class AggregationServer:
             except socket.timeout:
                 continue
             except OSError:
-                break  # closed
+                # Only a real close() (the _stop event) takes the prompt
+                # shutdown path below; any other accept() OSError (e.g.
+                # EMFILE) keeps the original deadline-bounded wait so an
+                # in-flight final upload can still complete the round.
+                listener_closed = self._stop.is_set()
+                break
             t = threading.Thread(
                 target=self._handle_upload, args=(conn, rnd, deadline), daemon=True
             )
             t.start()
             threads.append(t)
-        rnd.complete.wait(timeout=max(0.0, deadline - time.monotonic()))
-        for t in threads:
-            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        if listener_closed:
+            # No new connection can ever arrive: waiting out the full round
+            # deadline would just stall shutdown (and leak the round thread
+            # past the caller's join window). In-flight handlers may still
+            # legitimately complete the round — give them a short bound.
+            for t in threads:
+                t.join(timeout=1.0)
+        else:
+            rnd.complete.wait(timeout=max(0.0, deadline - time.monotonic()))
+            for t in threads:
+                t.join(timeout=max(0.1, deadline - time.monotonic()))
 
         with rnd.lock:
             rnd.closed = True

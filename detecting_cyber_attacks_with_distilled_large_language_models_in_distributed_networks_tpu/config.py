@@ -451,10 +451,18 @@ class MeshConfig:
     data: int = 1
     # Sequence-parallel axis (ring attention): >1 adds a third ``seq`` mesh
     # axis and routes `federated` through FedSeqTrainer (--seq-parallel N).
+    # On the TCP tier, `client --data-parallel/--seq-parallel` reuses the
+    # data/seq axes as that host's LOCAL mesh (train/client_mesh.py); the
+    # clients axis is the wire there, not a mesh dimension.
     seq: int = 1
     axis_names: tuple[str, str] = ("clients", "data")
 
     def __post_init__(self) -> None:
+        if self.clients < 1 or self.data < 1:
+            raise ValueError(
+                f"mesh axes must be >= 1 (clients={self.clients}, "
+                f"data={self.data})"
+            )
         if self.seq < 1:
             raise ValueError(f"mesh.seq={self.seq} must be >= 1")
 

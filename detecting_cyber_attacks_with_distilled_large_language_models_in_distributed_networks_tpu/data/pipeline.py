@@ -91,6 +91,23 @@ def num_batches(n: int, batch_size: int, drop_remainder: bool = True) -> int:
     return n // batch_size if drop_remainder else -(-n // batch_size)
 
 
+def shard_rows(batch: dict, sharding, replicated) -> dict:
+    """Place a host batch onto a device mesh with rows sharded over the
+    sharding's leading mesh axis (every value's axis 0 is the batch row).
+
+    A batch whose row count does not divide the axis — the final short
+    batch under ``drop_remainder=False`` — is placed REPLICATED instead:
+    the math is identical (each device computes the full small batch), so
+    the trajectory matches the single-device engine exactly, at the cost
+    of redundant FLOPs on one batch per epoch."""
+    import jax
+
+    rows = len(next(iter(batch.values())))
+    n_shards = sharding.mesh.shape[sharding.spec[0]]
+    target = sharding if rows % n_shards == 0 else replicated
+    return {k: jax.device_put(v, target) for k, v in batch.items()}
+
+
 def pad_split_to_batch(
     split: TokenizedSplit, batch_size: int, pad_id: int = 0
 ) -> tuple[TokenizedSplit, np.ndarray]:

@@ -1,4 +1,4 @@
-from .mesh import FedShardings, make_mesh  # noqa: F401
+from .mesh import FedShardings, make_host_mesh, make_mesh  # noqa: F401
 from .fedavg import fedavg, make_fedavg_step  # noqa: F401
 from .multihost import (  # noqa: F401
     global_array_from_replicated,

@@ -50,6 +50,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..train.engine import apply_warmup, prox_sq
 from .fedavg import stack_params
+from .mesh import shard_map
 
 
 def make_seq_mesh(
@@ -131,7 +132,7 @@ def make_fedseq_loss(
     ]
     if dropout:
         in_specs.append(P(clients_axis))
-    return jax.shard_map(
+    return shard_map(
         local_losses,
         mesh=mesh,
         in_specs=tuple(in_specs),
@@ -208,7 +209,7 @@ def make_fedseq_masked_loss(
     ]
     if dropout:
         in_specs.append(P(clients_axis))
-    return jax.shard_map(
+    return shard_map(
         local_losses,
         mesh=mesh,
         in_specs=tuple(in_specs),
@@ -324,7 +325,7 @@ def make_fedseq_packed_loss(
     in_specs += [P(data_axis, seq_axis), P(data_axis, seq_axis), P(data_axis)]
     if dropout:
         in_specs.append(P())
-    return jax.shard_map(
+    return shard_map(
         local_loss,
         mesh=mesh,
         in_specs=tuple(in_specs),
@@ -521,7 +522,7 @@ def build_fedseq_steps(cfg, model, optimizer, mesh: Mesh) -> FedSeqSteps:
         counts = jax.vmap(counts_one)(ce, logits, labels_l, valid_l)
         return counts, probs
 
-    eval_inner = jax.shard_map(
+    eval_inner = shard_map(
         local_eval,
         mesh=mesh,
         in_specs=(

@@ -25,6 +25,24 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# ``jax.shard_map`` became public API only in newer JAX; older versions
+# (e.g. 0.4.x) ship it as jax.experimental.shard_map. One compat binding
+# here so every shard_map call site (fedseq, ring attention, tests) runs
+# on both.
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pragma: no cover - exercised on jax<0.5 environments
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, **kw):
+        # check_rep=False: the experimental version's replication checker
+        # has the known scan-carry mismatch bug (jax#21945-adjacent) that
+        # the ring attention scan trips; newer JAX tracks varying axes
+        # properly (see ring_attention.py's vma/pcast handling) and keeps
+        # the check on.
+        kw.setdefault("check_rep", False)
+        return _experimental_shard_map(f, **kw)
+
 
 def make_mesh(
     clients: int = 1,
@@ -51,6 +69,30 @@ def make_mesh(
         )
     grid = np.array(devs[:need]).reshape(dims)
     return Mesh(grid, axis_names)
+
+
+def make_host_mesh(
+    data: int = 1, *, seq: int | None = None, devices: list | None = None
+) -> Mesh:
+    """A single-host ``data`` (optionally ``data x seq``) mesh over this
+    process's LOCAL devices — the separate-process TCP client's view of its
+    own chips (cli/comm.py ``client --data-parallel N [--seq-parallel M]``).
+
+    Unlike :func:`make_mesh` (global devices, ``clients`` leading axis),
+    there is no federation axis here: federation happens over the wire, and
+    every local chip serves one client's batch (and sequence) shards."""
+    if data < 1 or (seq is not None and seq < 1):
+        raise ValueError(f"host mesh axes must be >= 1 (data={data}, seq={seq})")
+    devs = list(jax.local_devices() if devices is None else devices)
+    dims = (data,) if seq is None else (data, seq)
+    need = data * (seq or 1)
+    if len(devs) < need:
+        raise ValueError(
+            f"host mesh {'x'.join(map(str, dims))} needs {need} local "
+            f"devices, have {len(devs)}"
+        )
+    grid = np.array(devs[:need]).reshape(dims)
+    return Mesh(grid, ("data",) if seq is None else ("data", "seq"))
 
 
 def fit_clients_axis(num_clients: int, data: int, n_devices: int) -> int:

@@ -157,10 +157,14 @@ def ring_attention(
     # over every mesh axis q varies over (the ring axis alone inside a pure
     # seq shard_map; clients/data too inside the 3-axis fedseq composition);
     # mark them varying up front so the scan carry types match.
-    want_vma = tuple(getattr(jax.typeof(q), "vma", ()))
+    # (jax.typeof and the vma/pcast machinery exist only on newer JAX;
+    # older versions' shard_map has no varying-axis avals, so want_vma is
+    # empty there and _vary is the identity.)
+    _typeof = getattr(jax, "typeof", lambda _x: None)
+    want_vma = tuple(getattr(_typeof(q), "vma", ()) or ())
 
     def _vary(x):
-        have = getattr(jax.typeof(x), "vma", ())
+        have = getattr(_typeof(x), "vma", ()) or ()
         missing = tuple(a for a in want_vma if a not in have)
         if not missing:
             return x
@@ -273,8 +277,10 @@ def _sharded_ring_fn(
         + ((bias_spec,) if has_bias else ())
         + ((P(),) if has_rng else ())
     )
+    from .mesh import shard_map
+
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             call, mesh=mesh, in_specs=in_specs, out_specs=seq_spec
         )
     )

@@ -5,6 +5,11 @@ from .engine import (  # noqa: F401
     make_optimizer,
     make_train_step,
 )
+from .client_mesh import (  # noqa: F401
+    FedSeqClientTrainer,
+    MeshTrainer,
+    make_client_trainer,
+)
 from .distill import (  # noqa: F401
     DistillTrainer,
     distillation_loss,

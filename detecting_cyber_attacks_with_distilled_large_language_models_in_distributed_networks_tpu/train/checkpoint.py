@@ -97,9 +97,24 @@ class Checkpointer:
 
     # ------------------------------------------------------------------ save
     def save(self, step: int, state: Any, *, meta: Mapping[str, Any] | None = None) -> None:
-        args = {STATE_ITEM: ocp.args.StandardSave(_unwrap_keys(state))}
-        if meta is not None:
-            args[META_ITEM] = ocp.args.JsonSave(dict(meta))
+        unwrapped = _unwrap_keys(state)
+        args = {STATE_ITEM: ocp.args.StandardSave(unwrapped)}
+        # The saved leaf-shape manifest (internal "_leaf_shapes" key) rides
+        # the JSON meta so ANY later manager instance can check template
+        # compatibility before restoring — orbax's own array metadata is
+        # only readable by the manager that saved (handler registry), and
+        # some orbax versions restore into mismatched template shapes
+        # silently (see saved_compatible).
+        # Tree-leaves order, NOT sorted: a multiset compare would miss two
+        # tables swapping sizes (vocab 128/pos 140 -> vocab 140/pos 128 has
+        # the identical shape multiset); leaves order is deterministic for
+        # a given structure, so the positional compare is exact.
+        manifest = [
+            [int(d) for d in np.shape(x)] for x in jax.tree.leaves(unwrapped)
+        ]
+        args[META_ITEM] = ocp.args.JsonSave(
+            {**(dict(meta) if meta is not None else {}), "_leaf_shapes": manifest}
+        )
         self._mgr.save(step, args=ocp.args.Composite(**args))
 
     def wait(self) -> None:
@@ -121,6 +136,30 @@ class Checkpointer:
             ),
         )[STATE_ITEM]
         return _rewrap_keys(restored, template)
+
+    def saved_compatible(self, template: Any, *, step: int | None = None) -> bool:
+        """Pre-restore compatibility gate: does the checkpoint's saved
+        per-leaf shape list (the "_leaf_shapes" manifest save() records,
+        in tree-leaves order) match the template's? Some orbax versions
+        (0.7.x) silently restore a checkpoint into DIFFERENT template
+        shapes instead of raising — e.g. a vocab-100 embedding into a
+        vocab-140 array — which would mistrain far from the restore site.
+        Checkpoints predating the manifest -> True (the restore call
+        itself then decides)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return False
+        try:
+            recorded = self._restore_meta_raw(step=step).get("_leaf_shapes")
+        except Exception:
+            recorded = None
+        if recorded is None:
+            return True
+        saved = [tuple(int(d) for d in s) for s in recorded]
+        want = [
+            tuple(x.shape) for x in jax.tree.leaves(_abstract(template))
+        ]
+        return saved == want
 
     def restore_params(self, template: Any, *, step: int | None = None) -> Any:
         """Restore ONLY the ``params`` field of a saved TrainState/FedState.
@@ -173,6 +212,16 @@ class Checkpointer:
         return restored.params
 
     def restore_meta(self, *, step: int | None = None) -> dict:
+        """The caller-supplied meta blob; internal bookkeeping keys
+        (underscore-prefixed, e.g. the "_leaf_shapes" manifest) are
+        stripped — they are save()'s implementation detail."""
+        return {
+            k: v
+            for k, v in self._restore_meta_raw(step=step).items()
+            if not str(k).startswith("_")
+        }
+
+    def _restore_meta_raw(self, *, step: int | None = None) -> dict:
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
@@ -193,6 +242,23 @@ class Checkpointer:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def _shapes_match(restored: Any, template: Any) -> bool:
+    """True when two state pytrees agree on structure and per-leaf shapes
+    — the compatibility contract a warm start needs (dtype differences are
+    tolerated: orbax already restores into the template's dtypes when the
+    shapes agree)."""
+    try:
+        r_leaves, r_def = jax.tree.flatten(restored)
+        t_leaves, t_def = jax.tree.flatten(template)
+    except Exception:
+        return False
+    if r_def != t_def:
+        return False
+    return all(
+        np.shape(r) == np.shape(t) for r, t in zip(r_leaves, t_leaves)
+    )
 
 
 def maybe_warm_start(directory: str, template: Any) -> tuple[Any | None, int | None]:
@@ -225,14 +291,36 @@ def maybe_warm_start(directory: str, template: Any) -> tuple[Any | None, int | N
         if step_agreed < 0:
             return None, None
         step = step_agreed
-        try:
-            restored: Any | None = ckpt.restore(template, step=step)
-        except Exception as e:  # orbax raises backend-specific error types
+        if not ckpt.saved_compatible(template, step=step):
             from ..utils.logging import get_logger
 
             get_logger().warning(
-                f"checkpoint at {directory} (step {step}) failed to restore "
-                f"({type(e).__name__}: {e}); starting fresh"
+                f"checkpoint at {directory} (step {step}) was saved under a "
+                "different model shape; starting fresh"
+            )
+            restored: Any | None = None
+        else:
+            try:
+                restored = ckpt.restore(template, step=step)
+            except Exception as e:  # orbax raises backend-specific errors
+                from ..utils.logging import get_logger
+
+                get_logger().warning(
+                    f"checkpoint at {directory} (step {step}) failed to "
+                    f"restore ({type(e).__name__}: {e}); starting fresh"
+                )
+                restored = None
+        if restored is not None and not _shapes_match(restored, template):
+            # Some orbax versions restore with the CHECKPOINT's shapes
+            # instead of raising when the template disagrees (e.g. the
+            # default vocab grew between runs); adopting those arrays
+            # would crash — or silently mistrain — far from here. Same
+            # degrade-to-fresh semantics as a restore error.
+            from ..utils.logging import get_logger
+
+            get_logger().warning(
+                f"checkpoint at {directory} (step {step}) has incompatible "
+                "tree/leaf shapes for this config; starting fresh"
             )
             restored = None
         # The outcome must be agreed too: if any process failed to restore,

@@ -1,0 +1,294 @@
+"""Meshed local training for the separate-process TCP client.
+
+The reference's real deployment shape is independent client processes
+talking TCP to an aggregation server (reference client1.py:276-336); until
+this module, our client on that tier trained its local phase on ONE device
+no matter how many chips its host had. ``fedtpu client --data-parallel N
+[--seq-parallel M]`` drives the local phase over the host's own device
+mesh instead, reusing the existing meshed machinery:
+
+* ``--data-parallel N`` alone -> :class:`MeshTrainer`: the single-client
+  engine's OWN jitted programs (train/engine.py), dispatched with batch
+  rows sharded over a per-host ``data`` mesh axis and params replicated —
+  XLA inserts the gradient psum. Same math, same PRNG streams, same
+  shuffles: the trajectory is threefry-identical to the single-device
+  client (params agree to float32 reduction-order ulps — the per-shard
+  partial sums round differently than one sequential reduction — which is
+  below every metric's resolution).
+* ``--seq-parallel M`` (with or without data shards) ->
+  :class:`FedSeqClientTrainer`: a C=1 FedSeqTrainer over a local
+  ``1 x data x seq`` mesh — ring attention over the sequence axis, the
+  long-context composition (parallel/fedseq.py) behind the single-client
+  surface the TCP round loop drives.
+
+Both trainers keep the wire tier untouched: params gather to host as one
+replica readback for the upload, and a received aggregate is scattered
+straight onto the mesh by ``init_state`` (``adopt_aggregate``) — no
+intermediate full-replica state on the host beyond the wire buffer
+itself. Secure aggregation and central DP therefore compose unchanged:
+masking and noising operate on the host-gathered flat vector exactly as
+for the single-device client.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config import ExperimentConfig, ModelConfig, TrainConfig
+from ..data.pipeline import TokenizedSplit, shard_rows, stack_clients
+from ..parallel.mesh import make_host_mesh
+from ..utils.logging import get_logger
+from .engine import Trainer, TrainState
+
+log = get_logger()
+
+
+class MeshTrainer(Trainer):
+    """The single-client engine over a per-host ``data`` mesh axis.
+
+    Reuses the engine's cached jitted programs verbatim; only placement
+    changes — batch rows shard over ``data``, state replicates. A batch
+    whose row count doesn't divide the axis (the final short batch under
+    ``drop_remainder=False``) is placed replicated, keeping the math (and
+    so the trajectory) identical to the single-device engine.
+    """
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        train_cfg: TrainConfig,
+        *,
+        mesh,
+        pad_id: int = 0,
+        drop_remainder: bool = True,
+    ):
+        super().__init__(
+            model_cfg, train_cfg, pad_id=pad_id, drop_remainder=drop_remainder
+        )
+        self.mesh = mesh
+        self.batch_sharding = NamedSharding(mesh, P("data"))
+        self.replicated = NamedSharding(mesh, P())
+        base_train, base_eval = self.train_step, self.eval_step
+
+        def train_step(state, batch):
+            return base_train(
+                state, shard_rows(batch, self.batch_sharding, self.replicated)
+            )
+
+        def eval_step(params, batch, valid):
+            placed = shard_rows(
+                {**batch, "valid": valid},
+                self.batch_sharding,
+                self.replicated,
+            )
+            return base_eval(
+                params=jax.device_put(params, self.replicated),
+                batch={k: v for k, v in placed.items() if k != "valid"},
+                valid=placed["valid"],
+            )
+
+        self.train_step = train_step
+        self.eval_step = eval_step
+
+    def init_state(
+        self, seed: int | None = None, params: Any | None = None
+    ) -> TrainState:
+        """Build the engine state, then scatter it onto the mesh
+        (replicated) — also the aggregate-adoption path, so a received
+        round reply lands on every local device in one placement."""
+        state = super().init_state(seed=seed, params=params)
+        return jax.device_put(state, self.replicated)
+
+    def evaluate(self, params: Any, split, **kw: Any) -> dict:
+        """Place host params on the mesh ONCE before the batch sweep (the
+        per-batch wrapper's device_put is then a no-op short-circuit —
+        without this, a host aggregate would re-cross the device boundary
+        on every eval batch)."""
+        return super().evaluate(
+            jax.device_put(params, self.replicated), split, **kw
+        )
+
+
+class FedSeqClientTrainer:
+    """C=1 FedSeqTrainer behind the TCP client's single-client surface.
+
+    The sequence-parallel composition (ring attention over a ``seq`` mesh
+    axis, optional batch shards over ``data``) already exists as the
+    3-axis federated trainer; a fleet of one reuses it wholesale. The
+    trajectory is the fedseq one (hash-keyed dropout, federated batch
+    permutations) — shard-count-invariant on its own terms, but distinct
+    from the single-device engine's; use plain ``--data-parallel`` when
+    byte-level parity with the single-device client matters.
+    """
+
+    def __init__(self, cfg: ExperimentConfig, *, pad_id: int = 0):
+        from ..parallel.fedseq import make_seq_mesh
+        from .seqfed import FedSeqTrainer
+
+        self.cfg = dataclasses.replace(
+            cfg,
+            fed=dataclasses.replace(cfg.fed, num_clients=1),
+            mesh=dataclasses.replace(cfg.mesh, clients=1),
+        )
+        mesh = make_seq_mesh(
+            1, cfg.mesh.data, cfg.mesh.seq, devices=jax.local_devices()
+        )
+        self.inner = FedSeqTrainer(self.cfg, pad_id=pad_id, mesh=mesh)
+        self.mesh = mesh
+        self.pad_id = pad_id
+        # Single-entry caches keyed on split identity: the TCP round loop
+        # feeds the SAME split objects every round, and re-stacking the
+        # full train set (or re-padding the eval set, twice per round)
+        # is pure wasted host memory traffic (prepare_eval's own contract
+        # is pad once, reuse across rounds).
+        self._train_cache: tuple[Any, Any] | None = None
+        self._eval_cache: tuple[Any, int | None, Any] | None = None
+
+    def init_state(self, seed: int | None = None, params: Any | None = None):
+        return self.inner.init_state(seed=seed, params=params)
+
+    def fit(
+        self,
+        state,
+        split: TokenizedSplit,
+        *,
+        batch_size: int = 16,
+        epochs: int | None = None,
+        epoch_offset: int = 0,
+        tag: str = "",
+    ):
+        """E local epochs over the dense [1, N, ...] stack; returns the
+        engine-shaped per-epoch loss list. ``tag`` (the TCP round loop's
+        ``[CLIENT n]`` prefix) rides the inner trainer's step telemetry so
+        mixed-fleet logs stay attributable."""
+        if tag:
+            self.inner.telemetry_prefix = tag
+        if self._train_cache is None or self._train_cache[0] is not split:
+            self._train_cache = (split, stack_clients([split]))
+        stacked = self._train_cache[1]
+        state, losses = self.inner.fit_local(
+            state,
+            stacked,
+            batch_size=batch_size,
+            epochs=epochs,
+            epoch_offset=epoch_offset,
+        )
+        return state, [float(e[0]) for e in losses]
+
+    def evaluate(
+        self,
+        params: Any,
+        split: TokenizedSplit,
+        *,
+        batch_size: int | None = None,
+        collect_probs: bool = True,
+    ) -> dict:
+        """Five reference metrics for UNSTACKED params (e.g. a received
+        aggregate): stack to [1, ...], run the 3-axis eval sweep."""
+        from ..parallel.fedavg import stack_params
+
+        stacked = jax.device_put(
+            stack_params(jax.tree.map(np.asarray, params), 1),
+            self.inner.sh.client,
+        )
+        return self._evaluate_stacked(
+            stacked, split, batch_size=batch_size, collect_probs=collect_probs
+        )
+
+    def evaluate_state(
+        self, state, split: TokenizedSplit, *, collect_probs: bool = True
+    ) -> dict:
+        """Metrics straight from the (already stacked) live state."""
+        return self._evaluate_stacked(
+            state.params, split, collect_probs=collect_probs
+        )
+
+    def _evaluate_stacked(
+        self,
+        stacked_params,
+        split: TokenizedSplit,
+        *,
+        batch_size: int | None = None,
+        collect_probs: bool = True,
+    ) -> dict:
+        # Normalize the default BEFORE keying the cache: the round loop's
+        # local eval (evaluate_state, batch_size=None) and aggregated eval
+        # (evaluate) must share one prepared entry, and both default to
+        # the config's eval batch size.
+        if batch_size is None:
+            batch_size = self.inner.cfg.data.eval_batch_size
+        cache = self._eval_cache
+        if cache is None or cache[0] is not split or cache[1] != batch_size:
+            cache = self._eval_cache = (
+                split,
+                batch_size,
+                self.inner.prepare_eval([split], batch_size=batch_size),
+            )
+        return self.inner.evaluate_clients(
+            stacked_params, prepared=cache[2], collect_probs=collect_probs
+        )[0]
+
+    def host_params(self, state) -> Any:
+        """One replica of the single client's params, unstacked, on host —
+        the wire-upload form."""
+        return jax.tree.map(lambda x: np.asarray(x)[0], state.params)
+
+    def adopt_aggregate(self, state, aggregated: Any):
+        """Fresh Adam from the received aggregate, continuing step counter
+        — the shared adoption semantics (engine.py); init_state scatters
+        the aggregate onto the 3-axis mesh."""
+        from .engine import adopt_aggregate_with_fresh_opt
+
+        return adopt_aggregate_with_fresh_opt(self, state, aggregated)
+
+
+def make_client_trainer(
+    cfg: ExperimentConfig, *, pad_id: int = 0
+) -> Trainer | FedSeqClientTrainer:
+    """The TCP client's local-phase trainer for the resolved mesh config:
+    plain engine (1x1), data-parallel meshed engine (Nx1), or the C=1
+    sequence-parallel composition (NxM, M > 1)."""
+    data, seq = cfg.mesh.data, cfg.mesh.seq
+    if data > 1 and cfg.data.batch_size % data:
+        # Both branches: fail at construction with an operator-readable
+        # message, not mid-round with an XLA sharding traceback.
+        raise ValueError(
+            f"batch_size={cfg.data.batch_size} must divide over "
+            f"--data-parallel {data} (row shards)"
+        )
+    if seq > 1:
+        # (FedSeqTrainer's own __init__ validates max_len % seq and the
+        # local device count, also as ValueError.)
+        return FedSeqClientTrainer(cfg, pad_id=pad_id)
+    if data > 1:
+        if cfg.train.prng_impl != "threefry2x32":
+            # rbg/unsafe_rbg bits are NOT guaranteed identical across
+            # shardings of one computation (JAX PRNG docs), so dropout
+            # masks — and with them the trajectory — can diverge from the
+            # single-device client. Training is still correct; only the
+            # strict single-device parity needs threefry.
+            log.warning(
+                f"[CLIENT-MESH] prng_impl={cfg.train.prng_impl!r}: dropout "
+                "masks are not shard-invariant under this impl, so the "
+                "--data-parallel trajectory may diverge from the "
+                "single-device client's; set train.prng_impl="
+                "'threefry2x32' for threefry-identical parity"
+            )
+        return MeshTrainer(
+            cfg.model,
+            cfg.train,
+            mesh=make_host_mesh(data),
+            pad_id=pad_id,
+            drop_remainder=cfg.data.drop_remainder,
+        )
+    return Trainer(
+        cfg.model,
+        cfg.train,
+        pad_id=pad_id,
+        drop_remainder=cfg.data.drop_remainder,
+    )

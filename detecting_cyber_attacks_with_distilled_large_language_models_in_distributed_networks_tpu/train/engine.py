@@ -262,6 +262,17 @@ def _engine_steps(model_cfg: ModelConfig, train_cfg: TrainConfig):
     return _cached_engine_steps(model_cfg, key_cfg)
 
 
+def adopt_aggregate_with_fresh_opt(trainer: Any, state: Any, aggregated: Any) -> Any:
+    """The aggregate-adoption semantics every TCP-client trainer shares:
+    fresh optimizer from the received aggregate (``trainer.init_state``
+    owns placement — engine, meshed, or C=1 fedseq), continuing step
+    counter. One implementation so the plain, data-parallel, and
+    seq-parallel clients can never drift apart here."""
+    trained_steps = int(state.step)
+    state = trainer.init_state(params=aggregated)
+    return state._replace(step=jnp.asarray(trained_steps, jnp.int32))
+
+
 class Trainer:
     """Single-client engine: fit for E epochs, evaluate with full metrics."""
 
@@ -292,6 +303,31 @@ class Trainer:
             step=jnp.zeros((), jnp.int32),
             rng=jax.random.fold_in(rng, 1),
         )
+
+    def evaluate_state(
+        self, state: TrainState, split: TokenizedSplit, **kw: Any
+    ) -> dict:
+        """Metrics from the live training state — the uniform entry the
+        TCP client uses so meshed trainers (whose state params are stacked
+        or sharded) evaluate without a host round-trip."""
+        return self.evaluate(state.params, split, **kw)
+
+    def host_params(self, state: TrainState) -> Any:
+        """Gather the state's params to host numpy — the wire-upload form
+        the TCP client feeds FederatedClient.exchange. The single-device
+        engine's gather is a plain readback; meshed subclasses override
+        none of this (replicated params read back one replica)."""
+        return jax.tree.map(np.asarray, state.params)
+
+    def adopt_aggregate(self, state: TrainState, aggregated: Any) -> TrainState:
+        """Continue the next round FROM a received aggregate with a fresh
+        Adam (every reference re-launch constructs a new optimizer,
+        client1.py:380) but a continuing step counter (LR warmup). The
+        single shared implementation for the plain and meshed TCP clients
+        — ``init_state`` places the aggregate, so a meshed subclass
+        scatters it straight onto its device mesh with no intermediate
+        full-replica state."""
+        return adopt_aggregate_with_fresh_opt(self, state, aggregated)
 
     def epoch_batches(
         self, split: TokenizedSplit, epoch: int, batch_size: int

@@ -628,11 +628,15 @@ class FederatedTrainer:
 
     def _step_telemetry(self):
         """Shared per-step logging closure (engine.make_step_telemetry)
-        with the fleet-mean loss label."""
+        with the fleet-mean loss label. ``telemetry_prefix`` overrides the
+        default tag — the C=1 TCP client adapter sets its ``[CLIENT n]``
+        prefix there so mixed-fleet step logs stay attributable."""
         from ..train.engine import make_step_telemetry
 
         return make_step_telemetry(
-            self.cfg.train.log_every, prefix="[FED] ", label="mean loss"
+            self.cfg.train.log_every,
+            prefix=getattr(self, "telemetry_prefix", "[FED] "),
+            label="mean loss",
         )
 
     @staticmethod

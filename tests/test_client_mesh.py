@@ -1,0 +1,360 @@
+"""Multi-chip TCP client (cli/comm.py --data-parallel/--seq-parallel):
+the separate-process tier's local phase over the host's own device mesh.
+
+The identity contract (ISSUE 2): with ``--data-parallel N`` the client
+runs the single-client engine's OWN jitted programs, batch rows sharded
+over N devices — same threefry PRNG streams, same shuffles, same math.
+Params agree with the single-device client to float32 reduction-order
+ulps (per-shard partial sums round differently than one sequential
+reduction), which is below metric resolution: final metrics are equal,
+and the wire/masking machinery operates on the host-gathered vector
+unchanged (byte-identical round-1 DP bases; the server's dp_base_crc
+equality check binds a meshed and a single-device client in one round).
+"""
+
+import csv
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
+    main,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+    AggregationServer,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+    default_tokenizer,
+    make_synthetic,
+    make_all_client_splits,
+    tokenize_client,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.parallel.mesh import (
+    make_host_mesh,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.client_mesh import (
+    FedSeqClientTrainer,
+    MeshTrainer,
+    make_client_trainer,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.engine import (
+    Trainer,
+)
+
+L = 32
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return default_tokenizer()
+
+
+def _cfg(tok, *, data=1, seq=1, prng="threefry2x32"):
+    model = ModelConfig.tiny(
+        vocab_size=len(tok.vocab), max_len=L, max_position_embeddings=2 * L
+    )
+    return ExperimentConfig(
+        model=model,
+        data=DataConfig(max_len=L, batch_size=8, data_fraction=0.3),
+        train=TrainConfig(
+            prng_impl=prng,
+            epochs_per_round=1,
+            learning_rate=1e-3,
+            log_every=0,
+        ),
+        fed=FedConfig(num_clients=1),
+        mesh=MeshConfig(clients=1, data=data, seq=seq),
+    )
+
+
+@pytest.fixture(scope="module")
+def client_data(tok):
+    cfg = _cfg(tok)
+    df = make_synthetic("cicids2017", 400, seed=42)
+    splits = make_all_client_splits(df, 1, cfg.data)
+    return tokenize_client(splits[0], tok, max_len=L)
+
+
+def test_make_client_trainer_dispatch(tok, eight_devices):
+    assert isinstance(make_client_trainer(_cfg(tok)), Trainer)
+    t = make_client_trainer(_cfg(tok, data=2))
+    assert isinstance(t, MeshTrainer)
+    assert t.mesh.shape["data"] == 2
+    t = make_client_trainer(_cfg(tok, data=2, seq=2))
+    assert isinstance(t, FedSeqClientTrainer)
+    assert dict(t.mesh.shape) == {"clients": 1, "data": 2, "seq": 2}
+    with pytest.raises(ValueError, match="batch_size"):
+        make_client_trainer(_cfg(tok, data=3))  # 8 % 3 != 0
+    with pytest.raises(ValueError, match="devices"):
+        MeshTrainer(
+            _cfg(tok).model,
+            _cfg(tok).train,
+            mesh=make_host_mesh(99),
+        )
+
+
+def test_mesh_trainer_matches_single_device_trajectory(
+    tok, client_data, eight_devices
+):
+    """The headline identity: MeshTrainer over 2 data shards vs the plain
+    engine — same threefry trajectory, equal final metrics, params within
+    reduction-order ulps. (N=4 behaves identically — covered by the slow
+    lane's seq/TCP variants; one shard count keeps this anchor cheap.)"""
+    cfg = _cfg(tok)
+    plain = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
+    s0, _ = plain.fit(plain.init_state(), client_data.train, batch_size=8)
+    m0 = plain.evaluate_state(s0, client_data.test)
+    h0 = plain.host_params(s0)
+    for n in (2,):
+        meshed = MeshTrainer(
+            cfg.model, cfg.train, mesh=make_host_mesh(n), pad_id=tok.pad_id
+        )
+        sn, _ = meshed.fit(
+            meshed.init_state(), client_data.train, batch_size=8
+        )
+        mn = meshed.evaluate_state(sn, client_data.test)
+        for k in ("Accuracy", "Precision", "Recall", "F1-Score"):
+            assert m0[k] == mn[k], (n, k, m0[k], mn[k])
+        np.testing.assert_allclose(m0["Loss"], mn["Loss"], rtol=1e-5)
+        np.testing.assert_array_equal(
+            m0["confusion_matrix"], mn["confusion_matrix"]
+        )
+        hn = meshed.host_params(sn)
+        for a, b in zip(jax.tree.leaves(h0), jax.tree.leaves(hn)):
+            np.testing.assert_allclose(a, b, atol=2e-6, rtol=1e-5)
+
+
+def test_mesh_trainer_gather_scatter_is_byte_exact(tok, eight_devices):
+    """The wire boundary: init -> gather and adopt-aggregate -> gather are
+    byte-exact round trips, so the masking/noising machinery sees the
+    identical flat vector a single-device client would produce (round-1
+    DP bases byte-identical; the server's dp_base_crc equality check
+    across a mixed single-device/meshed fleet can hold)."""
+    cfg = _cfg(tok)
+    plain = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
+    meshed = MeshTrainer(
+        cfg.model, cfg.train, mesh=make_host_mesh(2), pad_id=tok.pad_id
+    )
+    p0 = plain.host_params(plain.init_state())
+    pm = meshed.host_params(meshed.init_state())
+    for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(pm)):
+        np.testing.assert_array_equal(a, b)
+    # Scatter an "aggregate" onto the mesh and gather it back: byte-exact.
+    rng = np.random.default_rng(7)
+    agg = jax.tree.map(
+        lambda x: (x + rng.normal(0, 0.01, x.shape)).astype(x.dtype), p0
+    )
+    state = meshed.adopt_aggregate(meshed.init_state(), agg)
+    back = meshed.host_params(state)
+    for a, b in zip(jax.tree.leaves(agg), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(a, b)
+    assert int(state.step) == 0
+
+
+def _write_cfg(tmp_path, cfg, name):
+    path = str(tmp_path / name)
+    with open(path, "w") as f:
+        json.dump(cfg.to_dict(), f)
+    return path
+
+
+def _read_metrics_csv(path):
+    with open(path) as f:
+        return dict(next(iter(csv.DictReader(f))))
+
+
+def _run_client(argv, results, key):
+    try:
+        results[key] = main(argv)
+    except BaseException as e:  # surfaced by the asserting main thread
+        results[key] = e
+
+
+def test_client_data_parallel_tcp_round_matches_single_device(
+    tok, tmp_path, eight_devices
+):
+    """The acceptance run: live server + `client --data-parallel 2` vs the
+    single-device client on identical config/data — final local AND
+    aggregated metrics threefry-identical (same CSV values; Loss to float
+    repr resolution)."""
+    cfg = _cfg(tok)
+    cfg_path = _write_cfg(tmp_path, cfg, "cfg.json")
+    outs = {}
+    for name, extra in (("single", []), ("dp2", ["--data-parallel", "2"])):
+        out = str(tmp_path / name)
+        outs[name] = out
+        with AggregationServer(port=0, num_clients=1, timeout=60) as server:
+            errs: list = []
+
+            def _serve():
+                try:
+                    server.serve(rounds=1)
+                except Exception as e:
+                    errs.append(e)
+
+            t = threading.Thread(target=_serve, daemon=True)
+            t.start()
+            rc = main(
+                [
+                    "client", "--client-id", "0", "--host", "127.0.0.1",
+                    "--port", str(server.port), "--config", cfg_path,
+                    "--synthetic", "400", "--output-dir", out,
+                    "--timeout", "60", *extra,
+                ]
+            )
+            t.join(timeout=60)
+        assert rc == 0 and not errs, (rc, errs)
+    for phase in ("local", "aggregated"):
+        a = _read_metrics_csv(
+            os.path.join(outs["single"], f"client0_{phase}_metrics.csv")
+        )
+        b = _read_metrics_csv(
+            os.path.join(outs["dp2"], f"client0_{phase}_metrics.csv")
+        )
+        assert set(a) == set(b)
+        for k in a:
+            if k == "Loss":
+                np.testing.assert_allclose(
+                    float(a[k]), float(b[k]), rtol=1e-5, err_msg=(phase, k)
+                )
+            else:
+                assert a[k] == b[k], (phase, k, a[k], b[k])
+
+
+def test_client_data_parallel_composes_with_secure_agg_and_dp(
+    tok, tmp_path, eight_devices, monkeypatch
+):
+    """--secure-agg + --dp with a MIXED fleet: client 0 single-device,
+    client 1 --data-parallel 2, one live secure DP round. The server's
+    dp_base_crc equality check REJECTS a round whose clients upload
+    different round bases, so completion proves the meshed client's
+    host-gathered base is byte-identical to the single-device client's;
+    masking and noising ride the identical machinery (comm/client.py is
+    untouched by the mesh — one host gather feeds it)."""
+    monkeypatch.delenv("FEDTPU_SECRET", raising=False)
+    monkeypatch.delenv("FEDTPU_CLIENT_SECRET", raising=False)
+    cfg = _cfg(tok)
+    cfg = ExperimentConfig(
+        model=cfg.model,
+        data=cfg.data,
+        train=cfg.train,
+        fed=FedConfig(num_clients=2),
+        mesh=MeshConfig(clients=2, data=1),
+    )
+    cfg_path = _write_cfg(tmp_path, cfg, "cfg2.json")
+    out = str(tmp_path / "compose")
+    with AggregationServer(
+        port=0,
+        num_clients=2,
+        timeout=90,
+        secure_agg=True,
+        dp_clip=1.0,
+        dp_noise_multiplier=0.05,
+    ) as server:
+        errs: list = []
+
+        def _serve():
+            try:
+                server.serve(rounds=1)
+            except Exception as e:
+                errs.append(e)
+
+        t = threading.Thread(target=_serve, daemon=True)
+        t.start()
+        results: dict = {}
+        base = [
+            "--host", "127.0.0.1", "--port", str(server.port),
+            "--config", cfg_path, "--synthetic", "400",
+            "--output-dir", out, "--timeout", "90",
+            "--secure-agg", "--dp",
+        ]
+        c1 = threading.Thread(
+            target=_run_client,
+            args=(
+                ["client", "--client-id", "1", "--data-parallel", "2", *base],
+                results,
+                "dp2",
+            ),
+            daemon=True,
+        )
+        c1.start()
+        results["single"] = main(["client", "--client-id", "0", *base])
+        c1.join(timeout=120)
+        t.join(timeout=60)
+    assert results["single"] == 0 and results["dp2"] == 0, results
+    assert not errs, errs
+    # Aggregated artifacts from BOTH clients prove the masked+noised round
+    # completed through the mixed fleet.
+    for c in (0, 1):
+        assert os.path.exists(
+            os.path.join(out, f"client{c}_aggregated_metrics.csv")
+        )
+
+
+@pytest.mark.slow
+def test_client_seq_parallel_tcp_round(tok, tmp_path, eight_devices):
+    """`client --data-parallel 2 --seq-parallel 2`: the C=1 fedseq
+    composition (ring attention) behind the TCP round loop — live server,
+    full artifact set, sane metrics."""
+    cfg = _cfg(tok, data=2, seq=2)
+    cfg_path = _write_cfg(tmp_path, cfg, "cfg_seq.json")
+    out = str(tmp_path / "seq")
+    with AggregationServer(port=0, num_clients=1, timeout=90) as server:
+        errs: list = []
+
+        def _serve():
+            try:
+                server.serve(rounds=1)
+            except Exception as e:
+                errs.append(e)
+
+        t = threading.Thread(target=_serve, daemon=True)
+        t.start()
+        rc = main(
+            [
+                "client", "--client-id", "0", "--host", "127.0.0.1",
+                "--port", str(server.port), "--config", cfg_path,
+                "--synthetic", "400", "--output-dir", out,
+                "--timeout", "90", "--data-parallel", "2",
+                "--seq-parallel", "2",
+            ]
+        )
+        t.join(timeout=60)
+    assert rc == 0 and not errs, (rc, errs)
+    for phase in ("local", "aggregated"):
+        m = _read_metrics_csv(
+            os.path.join(out, f"client0_{phase}_metrics.csv")
+        )
+        assert 0.0 <= float(m["Accuracy"]) <= 100.0
+
+
+def test_seq_client_trainer_roundtrip(tok, client_data, eight_devices):
+    """In-process fedseq client adapter: fit advances, evaluate accepts
+    both the live state and an unstacked host aggregate, gather/adopt are
+    byte-exact round trips (fast-lane anchor for the slow TCP e2e)."""
+    trainer = make_client_trainer(_cfg(tok, data=2, seq=2), pad_id=tok.pad_id)
+    state = trainer.init_state()
+    state, losses = trainer.fit(state, client_data.train, batch_size=8)
+    assert len(losses) == 1 and np.isfinite(losses[0])
+    m_state = trainer.evaluate_state(state, client_data.test)
+    host = trainer.host_params(state)
+    m_host = trainer.evaluate(host, client_data.test)
+    assert m_state["Accuracy"] == m_host["Accuracy"]
+    adopted = trainer.adopt_aggregate(state, host)
+    back = trainer.host_params(adopted)
+    for a, b in zip(jax.tree.leaves(host), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(a, b)
+    assert int(adopted.step) == int(state.step)
