@@ -1,0 +1,206 @@
+"""fedtpu controller / registry — the control plane's operator surface.
+
+``controller`` turns the hand-run three-script round (reference
+server.py + client1.py + client2.py, re-launched by a human per round)
+into an unattended campaign: it owns the TCP aggregation endpoint,
+serves round after round, evaluates every aggregate on a held-out
+validation pool, registers it as an immutable candidate, and moves the
+registry's serving pointer only through the eval gate. ``registry`` is
+the manual override: list artifacts, promote one by hand, roll the
+pointer back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+from ..utils.logging import get_logger, phase
+from .comm import _auth_key, _server_client_keys
+from .common import (
+    _load_client_splits,
+    _load_clients,
+    _resolve_with_pretrained,
+)
+
+log = get_logger()
+
+
+def _gate_val_split(args, cfg, tok, num_clients):
+    """Every client's VALIDATION rows, tokenized and pooled, as the
+    held-out gate split — val, never test: the gate is model selection,
+    and reusing test data to pick what serves would leak the final
+    numbers. Only the val rows are tokenized (the controller never
+    trains, so paying a full-corpus tokenization pass at every daemon
+    start would be pure waste); the --stream reader has no split-level
+    entry point, so that path tokenizes everything as before."""
+    if getattr(args, "stream", False):
+        vals = [
+            c.val
+            for c in _load_clients(args, cfg, tok, num_clients)
+            if len(c.val)
+        ]
+    else:
+        from ..data.pipeline import tokenize_split
+        from ..utils.logging import phase as _phase
+
+        splits = _load_client_splits(args, cfg, num_clients)
+        with _phase("tokenize validation pools", tag="DATA"):
+            vals = [
+                tokenize_split(s.val, tok, cfg.model.max_len)
+                for s in splits
+                if len(s.val)
+            ]
+    if not vals:
+        raise SystemExit(
+            "no validation rows for the eval gate (val_fraction too small "
+            "for this corpus?)"
+        )
+    from ..data.pipeline import TokenizedSplit
+
+    return TokenizedSplit(
+        np.concatenate([v.input_ids for v in vals]),
+        np.concatenate([v.attention_mask for v in vals]),
+        np.concatenate([v.labels for v in vals]),
+    )
+
+
+def cmd_controller(args) -> int:
+    from ..comm import AggregationServer
+    from ..control import Controller, DriftMonitor
+    from ..registry import ModelRegistry
+    from ..train.engine import Trainer
+
+    tok, cfg, _pretrained = _resolve_with_pretrained(args, load_weights=False)
+    C = cfg.fed.num_clients
+    ctl = cfg.control
+    ctl_kw = {}
+    for flag, field_name in (
+        ("gate_metric", "gate_metric"),
+        ("gate_min_delta", "gate_min_delta"),
+        ("interval", "min_interval_s"),
+        ("max_interval", "max_interval_s"),
+        ("drift_threshold", "drift_threshold"),
+        ("drift_min_scores", "drift_min_scores"),
+        ("drift_method", "drift_method"),
+        ("round_deadline", "round_deadline_s"),
+    ):
+        v = getattr(args, flag, None)
+        if v is not None:
+            ctl_kw[field_name] = v
+    try:
+        ctl = dataclasses.replace(ctl, **ctl_kw) if ctl_kw else ctl
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+
+    # The gate's held-out data: the pooled per-client VAL split.
+    with phase("loading the eval-gate validation pool", tag="DATA"):
+        val = _gate_val_split(args, cfg, tok, C)
+    trainer = Trainer(cfg.model, cfg.train, pad_id=tok.pad_id)
+    log.info(
+        f"[CONTROLLER] eval gate: {len(val.input_ids)} pooled validation "
+        f"rows, metric {ctl.gate_metric} (min delta {ctl.gate_min_delta})"
+    )
+
+    def eval_fn(params):
+        return trainer.evaluate(
+            params, val, batch_size=cfg.data.eval_batch_size
+        )
+
+    registry = ModelRegistry(args.registry_dir)
+    state_path = args.state_jsonl or os.path.join(
+        args.registry_dir, "controller_state.jsonl"
+    )
+    drift = None
+    if getattr(args, "drift_jsonl", None):
+        drift = DriftMonitor(
+            args.drift_jsonl,
+            threshold=ctl.drift_threshold,
+            min_scores=ctl.drift_min_scores,
+            method=ctl.drift_method,
+        )
+        log.info(
+            f"[CONTROLLER] drift-triggered rounds: tailing "
+            f"{args.drift_jsonl} ({ctl.drift_method} >= "
+            f"{ctl.drift_threshold} over >= {ctl.drift_min_scores} scores"
+            + (
+                f"; clock fallback every {ctl.max_interval_s:.0f}s"
+                if ctl.max_interval_s is not None
+                else "; no clock fallback"
+            )
+            + ")"
+        )
+    with AggregationServer(
+        host=args.host,
+        port=args.port,
+        num_clients=C,
+        min_clients=args.min_clients,
+        timeout=args.timeout,
+        auth_key=_auth_key(),
+        secure_agg=bool(getattr(args, "secure_agg", False)),
+        client_keys=_server_client_keys(),
+    ) as server:
+        controller = Controller(
+            server,
+            registry,
+            eval_fn,
+            control=ctl,
+            state_path=state_path,
+            drift_monitor=drift,
+            model_config=cfg.model,
+        )
+        max_rounds = args.rounds if args.rounds and args.rounds > 0 else None
+        log.info(
+            f"[CONTROLLER] round endpoint {args.host}:{server.port} "
+            f"({C} clients, quorum {server.min_clients}); campaign: "
+            + (f"{max_rounds} round(s)" if max_rounds else "until stopped")
+        )
+        try:
+            controller.run(max_rounds=max_rounds)
+        except KeyboardInterrupt:
+            log.info("[CONTROLLER] interrupted; campaign state saved")
+    s = controller.summary()
+    log.info(f"[CONTROLLER] campaign summary: {s}")
+    return 0
+
+
+def cmd_registry(args) -> int:
+    from ..registry import ModelRegistry, RegistryError
+
+    registry = ModelRegistry(args.registry_dir)
+    try:
+        if args.action == "list":
+            serving = registry.serving_info()
+            serving_id = serving["artifact"] if serving else None
+            rows = registry.list()
+            if not rows:
+                print(f"(registry {args.registry_dir} is empty)")
+                return 0
+            for m in rows:
+                metrics = m.get("metrics") or {}
+                headline = ", ".join(
+                    f"{k}={v:.4f}"
+                    for k, v in sorted(metrics.items())
+                    if isinstance(v, float)
+                )
+                marker = " <- serving" if m["id"] == serving_id else ""
+                print(
+                    f"{m['id']}  round={m.get('round')}  "
+                    f"state={m.get('state')}  {headline}{marker}"
+                )
+            return 0
+        if args.action == "promote":
+            if not args.artifact:
+                raise SystemExit("registry promote needs --artifact <id>")
+            m = registry.promote(args.artifact, to=args.to)
+            print(f"{m['id']} -> {m['state']}")
+            return 0
+        if args.action == "rollback":
+            m = registry.rollback()
+            print(f"serving pointer -> {m['id']} (round {m.get('round')})")
+            return 0
+    except RegistryError as e:
+        raise SystemExit(str(e)) from None
+    raise SystemExit(f"unknown registry action {args.action!r}")

@@ -263,6 +263,31 @@ def cmd_federated(args) -> int:
                         "config": cfg.to_dict(),
                     },
                 )
+            if getattr(args, "registry_dir", None) and jax.process_index() == 0:
+                # Registry-aware checkpointing: every finished round also
+                # becomes an immutable CANDIDATE artifact with its
+                # fleet-mean validation metrics (model-selection data —
+                # never test), so `fedtpu registry promote` / the control
+                # plane can gate what serves without touching raw orbax
+                # steps. Replica 0 is the global model (FedAvg replicates
+                # its output across the clients axis).
+                from ..registry import ModelRegistry
+
+                params0 = jax.tree.map(
+                    lambda x: np.asarray(x)[0], trainer._host(state.params)
+                )
+                fleet_val = {
+                    k: float(np.mean([m[k] for m in aggregated_val]))
+                    for k in ("Accuracy", "Loss", "Precision", "Recall", "F1-Score")
+                    if all(k in m for m in aggregated_val)
+                }
+                ModelRegistry(args.registry_dir).add(
+                    params0,
+                    round_index=r + 1,
+                    metrics=fleet_val,
+                    model_config=cfg.model,
+                    extra={"tier": "mesh", "clients": C},
+                )
             if r + 1 < cfg.fed.rounds and cfg.fed.reset_optimizer_each_round:
                 state = trainer.reset_optimizer(state)
     if ckpt is not None:

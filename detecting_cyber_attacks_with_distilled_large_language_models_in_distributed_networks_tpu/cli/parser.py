@@ -21,6 +21,13 @@ covers every deployment shape, parameterized by client id / count:
   serve       TCP aggregation server (demo-parity mode, reference server.py)
   client      TCP client: train locally, exchange with a serve process,
               re-evaluate the aggregate (reference client1.py end-to-end)
+  controller  control plane: unattended continuous federated rounds with
+              an eval-gated model registry — round -> held-out eval ->
+              candidate artifact -> promote (or reject on regression) ->
+              the serving tier follows the promoted pointer; rounds fire
+              on serving-score drift instead of a fixed clock (control/)
+  registry    inspect/operate the model registry: list artifacts, promote
+              one by hand, roll the serving pointer back (registry/)
   export-config   print the full default config as JSON (there is no config
                   file in the reference to copy from)
 
@@ -36,6 +43,7 @@ from typing import Sequence
 
 from .comm import cmd_client, cmd_serve
 from .common import resolve_config
+from .control import cmd_controller, cmd_registry
 from .distill import cmd_distill
 from .federated import cmd_federated
 from .local import cmd_local
@@ -274,6 +282,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--checkpoint-dir")
     p.add_argument(
+        "--registry-dir",
+        help="also publish every round's aggregate to this model registry "
+        "as an immutable CANDIDATE artifact (fleet-mean validation "
+        "metrics attached) — promotion stays with `fedtpu registry "
+        "promote` / the controller's eval gate",
+    )
+    p.add_argument(
         "--coordinator",
         help="multi-host: coordinator HOST:PORT (every process passes the "
         "same address; also via JAX_COORDINATOR_ADDRESS)",
@@ -482,6 +497,21 @@ def build_parser() -> argparse.ArgumentParser:
         "checkpoint; new rounds are picked up between batches",
     )
     p.add_argument(
+        "--registry-dir",
+        help="serve from the model registry's PROMOTED artifact instead "
+        "of a raw checkpoint dir: the process follows the atomically-"
+        "swapped serving pointer (fedtpu controller / registry promote), "
+        "so unevaluated or gate-rejected rounds can never reach traffic "
+        "and a rollback takes effect within one poll",
+    )
+    p.add_argument(
+        "--auth",
+        action="store_true",
+        help="require the FL tier's HMAC challenge-response on every "
+        "scoring connection (shared secret from FEDTPU_SECRET; the SDK "
+        "passes auth_key). Default: open port, like the reference",
+    )
+    p.add_argument(
         "--buckets",
         default="1,8,32,128",
         help="micro-batch bucket shapes; XLA compiles one program per "
@@ -524,6 +554,124 @@ def build_parser() -> argparse.ArgumentParser:
         help="P(attack) decision threshold in replies (default 0.5)",
     )
     p.set_defaults(fn=cmd_infer_serve)
+
+    p = sub.add_parser(
+        "controller",
+        help="control plane: continuous eval-gated federated rounds "
+        "(round -> gate -> promote -> serve -> drift-monitor loop)",
+        epilog="Set FEDTPU_SECRET to authenticate the round endpoint "
+        "(same contract as `serve`). Central DP is not supported here: a "
+        "DP server never holds the absolute params an artifact needs.",
+    )
+    _add_common(p)  # dataset/model flags resolve the held-out gate split
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=12345)
+    p.add_argument("--num-clients", type=int, default=None)
+    p.add_argument("--min-clients", type=int, default=None)
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument(
+        "--rounds",
+        type=int,
+        default=0,
+        help="stop after this many controller cycles (0 = run until "
+        "interrupted — the daemon shape)",
+    )
+    p.add_argument(
+        "--registry-dir",
+        required=True,
+        help="model registry root: every finished round writes an "
+        "immutable candidate artifact here; the serving pointer is the "
+        "file infer-serve --registry-dir follows",
+    )
+    p.add_argument(
+        "--state-jsonl",
+        default=None,
+        help="controller-state JSONL (default: "
+        "<registry-dir>/controller_state.jsonl); a restarted controller "
+        "replays it and resumes the campaign mid-way",
+    )
+    p.add_argument(
+        "--secure-agg",
+        action="store_true",
+        help="accept pairwise-masked uploads (comm/secure.py); the gate "
+        "evaluates the recovered mean as usual",
+    )
+    p.add_argument(
+        "--gate-metric",
+        default=None,
+        help="held-out metric the promotion gate compares (default "
+        "Accuracy; higher is better)",
+    )
+    p.add_argument(
+        "--gate-min-delta",
+        type=float,
+        default=None,
+        help="tolerated regression: candidate must score >= incumbent - "
+        "delta (default 0 = never promote a worse model)",
+    )
+    p.add_argument(
+        "--interval",
+        type=float,
+        default=None,
+        help="minimum seconds between round starts (fixed cadence when no "
+        "--drift-jsonl is given; default 0 = back-to-back)",
+    )
+    p.add_argument(
+        "--max-interval",
+        type=float,
+        default=None,
+        help="with --drift-jsonl: force a round after this many seconds "
+        "even when no drift fired (default: none — purely drift-driven)",
+    )
+    p.add_argument(
+        "--drift-jsonl",
+        help="serving metrics-JSONL to tail (infer-serve --metrics-jsonl "
+        "X): rounds trigger when the live score distribution shifts off "
+        "the promoted artifact's eval histogram",
+    )
+    p.add_argument(
+        "--drift-threshold",
+        type=float,
+        default=None,
+        help="drift distance that triggers a round (default 0.25 — the "
+        "classic PSI 'significant shift' bound)",
+    )
+    p.add_argument(
+        "--drift-min-scores",
+        type=int,
+        default=None,
+        help="minimum live scores before a drift verdict (default 256)",
+    )
+    p.add_argument(
+        "--drift-method",
+        choices=["psi", "ks"],
+        default=None,
+        help="distribution distance: psi (default) or ks",
+    )
+    p.add_argument(
+        "--round-deadline",
+        type=float,
+        default=None,
+        help="per-round straggler deadline in seconds handed to the round "
+        "engine (default: the server --timeout)",
+    )
+    p.set_defaults(fn=cmd_controller)
+
+    p = sub.add_parser(
+        "registry",
+        help="model registry operations: list | promote | rollback",
+    )
+    p.add_argument("action", choices=["list", "promote", "rollback"])
+    p.add_argument("--registry-dir", required=True)
+    p.add_argument("--artifact", help="artifact id (promote)")
+    p.add_argument(
+        "--to",
+        choices=["candidate", "shadow", "serving"],
+        default=None,
+        help="promotion target state (default: one rung up the "
+        "candidate -> shadow -> serving ladder)",
+    )
+    p.set_defaults(fn=cmd_registry)
 
     p = sub.add_parser("distill", help="teacher -> student knowledge distillation")
     _add_common(p)

@@ -36,6 +36,7 @@ def cmd_infer_serve(args) -> int:
     from ..serving import (
         CheckpointWatcher,
         MicroBatcher,
+        RegistryWatcher,
         ScoreEngine,
         ScoringServer,
     )
@@ -50,14 +51,68 @@ def cmd_infer_serve(args) -> int:
             f"--max-queue {args.max_queue} is smaller than the largest "
             f"bucket {buckets[-1]}: the queue could never fill one batch"
         )
-    if not cfg.checkpoint_dir and pretrained is None:
+    auth_key = None
+    if getattr(args, "auth", False):
+        from .comm import _auth_key
+
+        auth_key = _auth_key()
+        if auth_key is None:
+            raise SystemExit(
+                "--auth needs the shared secret in the FEDTPU_SECRET env "
+                "var (same value on server and every scoring client)"
+            )
+    registry_dir = getattr(args, "registry_dir", None)
+    if registry_dir and cfg.checkpoint_dir:
         raise SystemExit(
-            "infer-serve needs trained weights: pass --checkpoint-dir (a "
-            "local or federated training checkpoint; also enables hot "
-            "reload) or --hf-dir (a fine-tuned classifier checkpoint)"
+            "--registry-dir and --checkpoint-dir are two different reload "
+            "sources (eval-gated pointer vs raw latest step); pass one"
+        )
+    if not registry_dir and not cfg.checkpoint_dir and pretrained is None:
+        raise SystemExit(
+            "infer-serve needs trained weights: pass --registry-dir (serve "
+            "the control plane's PROMOTED artifact, hot-swapped on "
+            "promotion), --checkpoint-dir (a local or federated training "
+            "checkpoint; hot reload of the latest step) or --hf-dir (a "
+            "fine-tuned classifier checkpoint)"
         )
     watcher = None
-    if cfg.checkpoint_dir:
+    if registry_dir:
+        from ..registry import ModelRegistry
+
+        # Pointer-following deployment: the initial load AND every swap
+        # come from the registry's serving pointer — this process can only
+        # ever score with an artifact the eval gate promoted.
+        registry = ModelRegistry(registry_dir)
+        info = registry.serving_info()
+        if info is None:
+            raise SystemExit(
+                f"registry {registry_dir} has no serving artifact yet — "
+                "run `fedtpu controller` (or `fedtpu registry promote`) "
+                "to promote one first"
+            )
+        manifest = registry.manifest(info["artifact"])
+        model_cfg = cfg.model
+        if manifest.get("model_config"):
+            from ..config import ModelConfig
+
+            model_cfg = ModelConfig(**manifest["model_config"])
+        if model_cfg.vocab_size != len(tok.vocab):
+            raise SystemExit(
+                f"serving artifact's model vocab ({model_cfg.vocab_size}) "
+                f"!= tokenizer vocab ({len(tok.vocab)}); pass the matching "
+                "--hf-dir / vocab"
+            )
+        params = registry.load_params(info["artifact"])
+        round_id = int(manifest.get("round", 0))
+        watcher = RegistryWatcher(
+            registry, poll_interval_s=args.reload_poll
+        )
+        watcher.prime(info["artifact"])
+        log.info(
+            f"[SERVE] serving promoted artifact {info['artifact']} "
+            f"(round {round_id}) from registry {registry_dir}"
+        )
+    elif cfg.checkpoint_dir:
         from ..serving.reload import latest_finalized_step
 
         # One restore path for the initial load AND every hot reload —
@@ -102,12 +157,22 @@ def cmd_infer_serve(args) -> int:
             else None
         ),
         metrics_jsonl=getattr(args, "metrics_jsonl", None),
+        auth_key=auth_key,
+        # The drift contract: serving-score histograms and the promoted
+        # artifact's eval reference must bin identically (ControlConfig).
+        score_bins=cfg.control.score_bins,
+    )
+    reload_src = (
+        "registry pointer"
+        if registry_dir
+        else ("checkpoint dir" if cfg.checkpoint_dir else "off")
     )
     with server:
         log.info(
             f"[SERVE] scoring {cfg.data.dataset} flows on "
             f"{args.host}:{server.port} (model round {engine.round_id}; "
-            f"hot reload {'on' if watcher else 'off — no --checkpoint-dir'})"
+            f"hot reload: {reload_src}; auth "
+            f"{'on' if auth_key else 'off — open port'})"
         )
         try:
             while True:

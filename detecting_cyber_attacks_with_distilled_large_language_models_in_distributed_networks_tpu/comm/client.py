@@ -729,10 +729,65 @@ class FederatedClient:
                     # The DP reply is the noised mean DELTA (the server
                     # never held absolute weights); apply it to the round
                     # base so callers still receive an absolute aggregate.
+                    if agg_meta.get("dp_reply") == "resync":
+                        # The server noticed our base was stale (a missed
+                        # reply): the payload is the SEQUENCE of retained
+                        # post-noise round deltas under keys "0", "1", ...
+                        # Replay each round's fp32 addition in order — the
+                        # same arithmetic every current client performed —
+                        # so the resynced base matches the fleet's
+                        # BIT-EXACTLY (a pre-summed delta would land ulps
+                        # away, fp32 addition being non-associative, and
+                        # fail the next round's crc agreement).
+                        try:
+                            n_rounds = int(agg_meta["dp_resync_rounds"])
+                        except (KeyError, TypeError, ValueError):
+                            raise wire.WireError(
+                                "resync reply missing dp_resync_rounds"
+                            ) from None
+                        cur = dp_base_flat
+                        for i in range(n_rounds):
+                            if str(i) not in agg:
+                                raise wire.WireError(
+                                    f"resync reply missing round delta {i}"
+                                )
+                            step = wire.flatten_params(agg[str(i)])
+                            if not wire.shapes_compatible(step, cur):
+                                raise wire.WireError(
+                                    f"resync delta {i} shapes do not "
+                                    "match the base"
+                                )
+                            cur = {
+                                k: cur[k] + np.asarray(step[k], np.float32)
+                                for k in cur
+                            }
+                        log.info(
+                            f"[CLIENT {self.client_id}] stale round base "
+                            f"resynced: replayed {n_rounds} retained "
+                            "round delta(s)"
+                        )
+                        return wire.unflatten_params(cur)
                     if agg_meta.get("dp_reply") != "delta":
                         raise wire.WireError(
                             "DP reply missing dp_reply=delta marker"
                         )
+                    reply_base_crc = agg_meta.get("dp_base_crc")
+                    if reply_base_crc is not None and int(
+                        reply_base_crc
+                    ) != int(base_meta["dp_base_crc"]):
+                        # The round's delta applies to a base we do not
+                        # hold (we are stale — e.g. a missed reply
+                        # followed by sitting a sampled round out).
+                        # Applying it would compound onto the wrong base
+                        # and void the server's resync window; keep our
+                        # base and resync on the next contributing round.
+                        log.info(
+                            f"[CLIENT {self.client_id}] round delta "
+                            "targets a different base than ours (stale "
+                            "base); keeping the base — the next "
+                            "contributing round resyncs it"
+                        )
+                        return wire.unflatten_params(dp_base_flat)
                     agg_flat = wire.flatten_params(agg)
                     if not wire.shapes_compatible(agg_flat, dp_base_flat):
                         raise wire.WireError(

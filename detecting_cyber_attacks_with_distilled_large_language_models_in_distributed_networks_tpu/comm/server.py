@@ -145,6 +145,7 @@ class AggregationServer:
         secure_protocol: str = "double",
         secure_threshold: int | None = None,
         dp_participation: float = 1.0,
+        dp_resync_rounds: int = 8,
     ):
         if client_keys is not None and auth_key is None:
             raise ValueError(
@@ -226,15 +227,31 @@ class AggregationServer:
         # independently with probability q every round — the sampler the
         # subsampled-Gaussian accountant assumes, so the TCP tier's
         # epsilon is exact under q < 1 (privacy amplification), mirroring
-        # the mesh tier's participation_mode="poisson". Known limit
-        # (inherent to the delta-only DP design, not the sampler): a
-        # client that misses a round's REPLY entirely — skipped client
-        # crashing past the skip grace, or any client losing the reply —
-        # has a stale base from then on; its next upload fails the
-        # round's base-crc agreement and it cannot resync without a
-        # restart from the shared init, because a DP server never holds
-        # absolute weights to re-seed it from.
+        # the mesh tier's participation_mode="poisson".
         self.dp_participation = float(dp_participation)
+        # Stranded-client resync (plain DP only): the server retains the
+        # last ``dp_resync_rounds`` released round deltas together with
+        # the base crc their round's uploads agreed on. A client that
+        # missed a reply declares a base crc matching one of those
+        # retained rounds; instead of failing the whole round, its (stale)
+        # upload is excluded from the mean and it is answered with the
+        # catch-up SEQUENCE of retained deltas (every one from its base
+        # forward, including this round's), which it replays in round
+        # order — the same fp32 additions every current client performed,
+        # so the resynced base matches the fleet's bit-exactly and the
+        # next round's crc agreement holds. Privacy cost: zero — each
+        # retained delta is a post-noise DP OUTPUT, and re-releasing
+        # released values is post-processing. Memory cost:
+        # dp_resync_rounds model-sized fp32 trees. Not available under
+        # secure-agg DP (a masked upload cannot be excluded from the sum
+        # — the masks only cancel over the full set), under lossy reply
+        # compression (the fleet's bases are the DECODED deltas, which
+        # the fp32 retention cannot reproduce), or across server
+        # restarts (history is in-memory);
+        # a client staler than the window still fails the round's crc
+        # agreement exactly as before.
+        self.dp_resync_rounds = int(dp_resync_rounds)
+        self._dp_history: list[tuple[int, dict]] = []
         # Noise generator: Philox (counter-based, 128-bit crypto-derived
         # keying) keyed from OS entropy, never seeded deterministically —
         # the draw sequence is not predictable from any run artifact.
@@ -1047,6 +1064,52 @@ class AggregationServer:
         )
         return secure.dequantize_sum(out, len(alive), self.fp_bits)
 
+    def _heal_stale_clients(
+        self,
+        rnd: _Round,
+        stale_resync: dict[int, int],
+        conns: dict[int, socket.socket],
+        nonces: dict[int, str],
+    ) -> None:
+        """Serve catch-up sequences of RETAINED deltas to stale clients of
+        a round that is about to FAIL (quorum miss after their exclusion):
+        no new delta exists, but the retained rounds alone land them on
+        the fleet's current base so the retried round can succeed. Send
+        failures are logged and ignored — the round is failing anyway."""
+        for cid, j in stale_resync.items():
+            conn = conns.get(cid)
+            entries = [d for _, d in self._dp_history[j:]]
+            if conn is None or not entries:
+                continue
+            if not all(
+                wire.shapes_compatible(d, entries[0]) for d in entries
+            ):
+                continue
+            try:
+                conn.settimeout(min(self.timeout, 30.0))
+                framing.send_frame(
+                    conn,
+                    self._encode_reply(
+                        {
+                            str(i): wire.unflatten_params(d)
+                            for i, d in enumerate(entries)
+                        },
+                        {
+                            "agg_round": rnd.round_no,
+                            "dp_reply": "resync",
+                            "dp_resync_rounds": len(entries),
+                        },
+                        nonces.get(cid),
+                    ),
+                )
+                log.info(
+                    f"[SERVER] client {cid} healed with a catch-up "
+                    f"sequence of {len(entries)} retained round delta(s) "
+                    "(round itself failed quorum)"
+                )
+            except (OSError, ConnectionError, wire.WireError) as e:
+                log.info(f"[SERVER] catch-up to client {cid} failed: {e}")
+
     def _round_quorum(self, cohort: set[int] | None) -> int:
         """Upload quorum for one round.
 
@@ -1212,18 +1275,88 @@ class AggregationServer:
                 )
             ids = sorted(models)
             dp_mode = self.dp_clip > 0.0
+            stale_resync: dict[int, int] = {}  # client id -> history index
+            resync_payloads: dict[int, tuple[dict, int]] = {}
             if dp_mode:
-                crc_set = {dp_crcs[i] for i in ids}
-                if len(crc_set) != 1:
-                    # A stale client (missed a round / different init)
-                    # would shift the mean by an unbounded base gap.
+                if not self.secure_agg and self.compression == "none":
+                    # Resyncable stale clients: base crc matches a retained
+                    # round (latest entry wins on the impossible collision).
+                    # Lossless replies only: under bf16/int8 the bases the
+                    # fleet adopted are the DECODED (lossy) deltas, which
+                    # the fp32 retention cannot reproduce bit-exactly — a
+                    # "resynced" base would miss the crc agreement anyway.
+                    hist_index = {
+                        crc: j for j, (crc, _) in enumerate(self._dp_history)
+                    }
+                    stale_resync = {
+                        i: hist_index[dp_crcs[i]]
+                        for i in ids
+                        if dp_crcs[i] in hist_index
+                    }
+                current = [i for i in ids if i not in stale_resync]
+                if not current and stale_resync:
+                    group_crcs = {dp_crcs[i] for i in stale_resync}
+                    if len(group_crcs) == 1:
+                        # EVERY upload agrees on a RETAINED base: the
+                        # previously released delta(s) past it were never
+                        # adopted by anyone (fleet-wide reply loss), so
+                        # the consensus IS the fleet base. Proceed
+                        # normally from it — exactly what the pre-resync
+                        # server did — instead of misclassifying the
+                        # whole fleet as stale; the orphaned history
+                        # entries are shadowed by this round's re-release
+                        # (hist_index keeps the latest entry per crc).
+                        log.info(
+                            "[SERVER] all uploads share a retained base "
+                            "crc (fleet-wide missed reply); treating the "
+                            "consensus as current"
+                        )
+                        current = sorted(stale_resync)
+                        stale_resync = {}
+                crc_set = {dp_crcs[i] for i in current}
+                if not current or len(crc_set) != 1:
+                    # A stale client outside the resync window (or a
+                    # different init) would shift the mean by an unbounded
+                    # base gap.
                     raise RuntimeError(
                         "DP round base mismatch: clients disagree on the "
                         f"round base (crcs per client: "
                         f"{ {i: f'{dp_crcs[i]:#010x}' for i in ids} }) — "
                         "every client must start the round from the same "
-                        "adopted aggregate / shared init"
+                        "adopted aggregate / shared init (stale clients "
+                        f"resync only within the last {self.dp_resync_rounds} "
+                        "retained round(s) of this server process)"
                     )
+                if stale_resync:
+                    if len(current) < quorum:
+                        # The round cannot proceed — but the stale clients
+                        # must STILL be healed now, with the retained
+                        # rounds alone (this round produced no delta).
+                        # Under the default quorum (min_clients ==
+                        # num_clients) this is the ONLY path that ever
+                        # engages: excluding the stale upload always drops
+                        # the round below quorum, so without healing here
+                        # the fleet would wedge forever — the exact
+                        # deadlock the resync exists to close. Healed
+                        # clients rejoin current next round, which then
+                        # meets quorum.
+                        self._heal_stale_clients(
+                            rnd, stale_resync, all_conns, nonces
+                        )
+                        raise RuntimeError(
+                            f"only {len(current)} current-base clients "
+                            f"uploaded (stale: {sorted(stale_resync)}, "
+                            "served catch-up sequences), below the quorum "
+                            f"of {quorum} — retrying clients complete the "
+                            "next round from the common base"
+                        )
+                    log.info(
+                        f"[SERVER] clients {sorted(stale_resync)} declared "
+                        "stale round bases; excluding their uploads and "
+                        "serving composed catch-up deltas "
+                        f"(contributors: {current})"
+                    )
+                    ids = current
             if self.secure_agg and self.secure_protocol == "double":
                 agg = self._aggregate_double(rnd, models, conns)
                 log.info(
@@ -1386,6 +1519,14 @@ class AggregationServer:
                 reply_meta = {
                     "agg_round": rnd.round_no,
                     "dp_reply": "delta",
+                    # The base this delta applies to. A receiver whose own
+                    # base differs (a STALE client sitting a sampled round
+                    # out) must NOT apply it — compounding a foreign delta
+                    # onto a stale base would create a base the retained
+                    # history never saw, making the client permanently
+                    # unresyncable. It keeps its base instead and resyncs
+                    # on its next contributing round.
+                    "dp_base_crc": next(iter(crc_set)),
                 }
                 if rnd.cohort is None:
                     # Under cohort sampling the sampled set stays OUT of
@@ -1394,6 +1535,60 @@ class AggregationServer:
                     # sampled. With full participation the "cohort" is
                     # public knowledge anyway.
                     reply_meta["round_clients"] = ids
+                if not self.secure_agg and self.compression == "none":
+                    # Retain this round's released delta for the resync
+                    # window (post-noise: a DP output, so retaining and
+                    # re-releasing compositions of it is free
+                    # post-processing), keyed by the base crc the round's
+                    # current uploads agreed on. An EXACTLY-ZERO delta
+                    # (noiseless round, all clients at their base) is NOT
+                    # retained: the new base equals the old one, so the
+                    # retained crc would collide with every current
+                    # client's next declaration and misclassify the whole
+                    # fleet as stale — and a zero delta contributes
+                    # nothing to any composition anyway.
+                    if any(np.any(np.asarray(v)) for v in agg.values()):
+                        self._dp_history.append(
+                            (
+                                next(iter(crc_set)),
+                                {
+                                    k: np.asarray(v, np.float32)
+                                    for k, v in agg.items()
+                                },
+                            )
+                        )
+                    for cid, j in stale_resync.items():
+                        # Catch-up: every retained delta from the client's
+                        # base forward — the tail INCLUDES the entry just
+                        # appended. Shipped as the SEQUENCE (keys "0","1",
+                        # ...), never pre-summed: the client replays each
+                        # round's fp32 addition in order, which is the
+                        # only arithmetic that reproduces the fleet's base
+                        # BIT-EXACTLY (fp32 addition is not associative —
+                        # a server-side sum would land ulps away and fail
+                        # the next round's crc agreement for everyone).
+                        entries = [d for _, d in self._dp_history[j:]]
+                        if not all(
+                            wire.shapes_compatible(d, agg) for d in entries
+                        ):
+                            log.info(
+                                f"[SERVER] client {cid} cannot resync: "
+                                "retained deltas changed shape mid-window"
+                            )
+                            continue
+                        resync_payloads[cid] = (
+                            {
+                                str(i): wire.unflatten_params(d)
+                                for i, d in enumerate(entries)
+                            },
+                            len(entries),
+                        )
+                    # Trim AFTER composing: stale_resync indices address
+                    # the pre-trim list (append only extends the tail).
+                    if len(self._dp_history) > self.dp_resync_rounds:
+                        del self._dp_history[
+                            : len(self._dp_history) - self.dp_resync_rounds
+                        ]
             else:
                 # The new base for next round's sparse deltas, advertised
                 # in every reply. Secure mode tracks it too (harmless), but
@@ -1433,6 +1628,33 @@ class AggregationServer:
                     cid: self._encode_reply(agg, reply_meta, nonces.get(cid))
                     for cid in reply_targets
                 }
+            # Stale-but-resyncable DP clients: the reply is the catch-up
+            # SEQUENCE of retained round deltas (applied in order to
+            # their base) — their excluded uploads already cost them the
+            # round's contribution; this puts them back on the fleet's
+            # exact base for the next one.
+            for cid, (sequence, n_rounds) in resync_payloads.items():
+                replies[cid] = self._encode_reply(
+                    sequence,
+                    {
+                        **reply_meta,
+                        "dp_reply": "resync",
+                        "dp_resync_rounds": n_rounds,
+                    },
+                    nonces.get(cid),
+                )
+                log.info(
+                    f"[SERVER] client {cid} resynced with a catch-up "
+                    f"sequence of {n_rounds} retained round delta(s)"
+                )
+            for cid in stale_resync:
+                if cid not in resync_payloads:
+                    # Unresyncable after all (shape drift mid-window):
+                    # close now so the client fails fast instead of
+                    # blocking on a reply that will never come.
+                    c = all_conns.get(cid)
+                    if c is not None:
+                        c.close()
         except BaseException:
             # A failed round must not leave clients blocked in recv_frame
             # until their timeouts — drop every connection so they fail fast.

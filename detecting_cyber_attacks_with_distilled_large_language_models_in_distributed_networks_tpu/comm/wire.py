@@ -100,6 +100,16 @@ DPSKIP_DOMAIN = b"fedtpu-dp-skip-v1"
 SCORE_REQ_MAGIC = b"SCRQ"
 SCORE_REP_MAGIC = b"SCRP"
 SCORE_REJ_MAGIC = b"SCRJ"
+#: Scoring-port authentication (serving/protocol.py): with ``--auth`` the
+#: scoring server reuses the FL tier's challenge-response — it opens every
+#: connection with the NONCE_MAGIC challenge above, and the client must
+#: answer SCORE_AUTH_MAGIC + HMAC-SHA256(key, domain + nonce) before any
+#: request is read. Connection-level (one proof per connection, not per
+#: request): the scoring hot path stays HMAC-free, and a captured proof is
+#: useless on any other connection (fresh nonce). Without a key the port
+#: is the reference-style open protocol, as before.
+SCORE_AUTH_MAGIC = b"SCAU"
+SCORE_AUTH_DOMAIN = b"fedtpu-score-auth-v1"
 _ALLOWED_DTYPES = {
     "float32", "float64", "float16", "bfloat16",
     "int8", "int16", "int32", "int64",

@@ -439,6 +439,73 @@ class DistillConfig:
 
 
 @dataclass(frozen=True)
+class ControlConfig:
+    """Control-plane loop (control/ + registry/): the knobs of the
+    unattended train -> gate -> promote -> serve -> monitor cycle.
+
+    The reference has no loop at all — a round happens when a human
+    re-runs three scripts, and nothing gates what the serving tier loads.
+    """
+
+    # Eval gate: a candidate must score >= incumbent[metric] - min_delta
+    # on the held-out split or it is rejected (the serving pointer stays
+    # on the incumbent — automatic rollback-by-refusal).
+    gate_metric: str = "Accuracy"
+    gate_min_delta: float = 0.0
+    # Round cadence. min_interval_s throttles back-to-back rounds;
+    # max_interval_s forces a round even when no drift fired (None = no
+    # clock at all — purely drift-triggered once a monitor is attached).
+    min_interval_s: float = 0.0
+    max_interval_s: float | None = None
+    # Drift monitor (control/drift.py): score-distribution shift of live
+    # serving traffic vs the promoted artifact's eval reference
+    # histogram. PSI > 0.25 is the classic "significant shift" bound.
+    drift_method: str = "psi"  # psi | ks
+    drift_threshold: float = 0.25
+    drift_min_scores: int = 256
+    # Histogram resolution for both the eval reference and the serving
+    # tier's score export; both sides must agree.
+    score_bins: int = 10
+    # Per-round deadline handed to the TCP round engine (None = the
+    # server's own timeout).
+    round_deadline_s: float | None = None
+
+    def __post_init__(self) -> None:
+        if self.drift_method not in ("psi", "ks"):
+            raise ValueError(
+                f"drift_method={self.drift_method!r} must be 'psi' or 'ks'"
+            )
+        if self.drift_threshold <= 0.0:
+            raise ValueError(
+                f"drift_threshold={self.drift_threshold} must be > 0"
+            )
+        if self.drift_min_scores < 1:
+            raise ValueError(
+                f"drift_min_scores={self.drift_min_scores} must be >= 1"
+            )
+        if not 2 <= self.score_bins <= 64:
+            # Upper bound matches the metrics-JSONL short-list cap
+            # (reporting.append_metrics_jsonl keeps lists <= 64 entries):
+            # a larger histogram would be silently dropped from every
+            # serve_batch record and starve the drift monitor.
+            raise ValueError(
+                f"score_bins={self.score_bins} must be in [2, 64]"
+            )
+        if self.min_interval_s < 0.0:
+            raise ValueError(
+                f"min_interval_s={self.min_interval_s} must be >= 0"
+            )
+        if (
+            self.max_interval_s is not None
+            and self.max_interval_s < self.min_interval_s
+        ):
+            raise ValueError(
+                f"max_interval_s={self.max_interval_s} below "
+                f"min_interval_s={self.min_interval_s}"
+            )
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout.
 
@@ -475,6 +542,7 @@ class ExperimentConfig:
     fed: FedConfig = field(default_factory=FedConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     distill: DistillConfig = field(default_factory=DistillConfig)
+    control: ControlConfig = field(default_factory=ControlConfig)
     output_dir: str = "outputs"
     checkpoint_dir: str | None = None
 
@@ -516,6 +584,7 @@ class ExperimentConfig:
             "fed": FedConfig,
             "mesh": MeshConfig,
             "distill": DistillConfig,
+            "control": ControlConfig,
         }
         scalars = ("output_dir", "checkpoint_dir")
         unknown_top = set(d) - set(sections) - set(scalars)

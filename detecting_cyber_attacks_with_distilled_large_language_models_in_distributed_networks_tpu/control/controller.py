@@ -1,0 +1,401 @@
+"""The ``fedtpu controller`` daemon: continuous eval-gated federated rounds.
+
+One controller cycle::
+
+    trigger (drift verdict | max-interval clock | bootstrap)
+      -> serve one TCP round through the EXISTING round engine
+         (comm/server.py AggregationServer.serve_round — clients connect
+         exactly as they always did; the straggler deadline / quorum /
+         retry machinery is reused, not reimplemented)
+      -> evaluate the aggregate on the held-out split (eval_fn)
+      -> register an immutable candidate artifact (registry/)
+      -> eval gate (train/fedeval.eval_gate) vs the serving incumbent
+           pass  -> promote candidate -> shadow -> serving
+                    (atomic pointer swap; the scoring tier follows it)
+           fail  -> reject; the pointer NEVER moves — automatic
+                    rollback-by-refusal on regression
+      -> feed the promoted artifact's eval histogram to the drift
+         monitor as the new reference
+
+Every cycle appends one structured record to the controller-state JSONL;
+a restarted controller replays that file to resume mid-campaign (round
+counter, promotion/rejection tallies) instead of starting a colliding
+round 0. The registry's serving pointer survives restarts by
+construction, so the drift reference re-anchors from the registry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+from ..comm import wire
+from ..config import ControlConfig
+from ..registry import ModelRegistry, RegistryError
+from ..train.fedeval import eval_gate, reference_histogram
+from ..utils.logging import get_logger
+from .drift import DriftMonitor
+
+log = get_logger()
+
+#: eval_fn contract: nested params dict -> metrics mapping. Must carry the
+#: gate metric; a "probs" array (np.ndarray) makes the candidate's eval
+#: reference histogram available to the drift monitor.
+EvalFn = Callable[[Any], Mapping[str, Any]]
+
+
+@dataclass
+class ControllerStats:
+    rounds_attempted: int = 0
+    rounds_completed: int = 0
+    rounds_failed: int = 0
+    promotions: int = 0
+    gate_rejections: int = 0
+    drift_triggers: int = 0
+    #: round-engine wall seconds (inside serve_round) vs full cycle wall:
+    #: the orchestration overhead the bench record reports.
+    round_wall_s: float = 0.0
+    cycle_wall_s: float = 0.0
+    promotion_latency_s: list = field(default_factory=list)
+
+
+class Controller:
+    """Drive ``server`` round after round, gate every candidate, and keep
+    the registry's serving pointer on the best evaluated artifact.
+
+    ``server`` is an already-bound :class:`~..comm.AggregationServer`
+    (plain or secure-agg; central DP is refused — a DP server only ever
+    holds noised mean DELTAS, never the absolute params an artifact
+    needs). ``eval_fn`` maps a nested params dict to held-out metrics.
+    """
+
+    def __init__(
+        self,
+        server,
+        registry: ModelRegistry,
+        eval_fn: EvalFn,
+        *,
+        control: ControlConfig | None = None,
+        state_path: str | None = None,
+        drift_monitor: DriftMonitor | None = None,
+        model_config: Any | None = None,
+        drift_poll_s: float = 1.0,
+    ):
+        if getattr(server, "dp_clip", 0.0) > 0.0:
+            raise ValueError(
+                "the controller cannot gate a central-DP server: it never "
+                "holds absolute params to register or evaluate (run the DP "
+                "tier with its own cadence, or gate on the mesh tier)"
+            )
+        self.server = server
+        self.registry = registry
+        self.eval_fn = eval_fn
+        self.control = control or ControlConfig()
+        self.state_path = state_path
+        self.drift = drift_monitor
+        self.model_config = model_config
+        self.drift_poll_s = float(drift_poll_s)
+        self.stats = ControllerStats()
+        self._next_round = 0
+        self._last_round_start: float | None = None
+        if state_path:
+            self._resume(state_path)
+        if self.drift is not None:
+            self._seed_drift_reference()
+
+    # ----------------------------------------------------------------- state
+    def _resume(self, path: str) -> None:
+        """Replay the controller-state JSONL: round counter + tallies. A
+        half-written trailing line (crash mid-append) is skipped."""
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            r = rec.get("round")
+            if isinstance(r, int):
+                self._next_round = max(self._next_round, r + 1)
+            ev = rec.get("event")
+            # Every cycle writes exactly one of these five records, so
+            # the attempted/completed tallies replay exactly (a restarted
+            # campaign's summary must stay internally consistent —
+            # promotions can never exceed completed rounds).
+            if ev in (
+                "promoted",
+                "gate_rejected",
+                "promote_noop",
+                "round_noop",
+                "round_failed",
+                "cycle_error",
+            ):
+                self.stats.rounds_attempted += 1
+            if ev in (
+                "promoted", "gate_rejected", "promote_noop", "cycle_error",
+            ):
+                self.stats.rounds_completed += 1
+            if ev == "promoted":
+                self.stats.promotions += 1
+            elif ev == "gate_rejected":
+                self.stats.gate_rejections += 1
+            elif ev == "round_failed":
+                self.stats.rounds_failed += 1
+            elif ev == "drift_trigger":
+                self.stats.drift_triggers += 1
+        if self._next_round or self.stats.promotions:
+            log.info(
+                f"[CONTROLLER] resumed campaign from {path}: next round "
+                f"{self._next_round} ({self.stats.promotions} promotion(s), "
+                f"{self.stats.gate_rejections} gate rejection(s) so far)"
+            )
+
+    def _record(self, event: str, **fields: Any) -> None:
+        if not self.state_path:
+            return
+        os.makedirs(os.path.dirname(self.state_path) or ".", exist_ok=True)
+        with open(self.state_path, "a") as f:
+            f.write(json.dumps({"ts": time.time(), "event": event, **fields}) + "\n")
+
+    def _seed_drift_reference(self) -> None:
+        """Re-anchor the drift reference from whatever is serving (resume
+        path: the registry outlives the controller process)."""
+        try:
+            m = self.registry.serving_manifest()
+        except RegistryError:
+            return
+        if m and m.get("eval_hist"):
+            self.drift.set_reference(m["eval_hist"])
+            log.info(
+                f"[CONTROLLER] drift reference = serving artifact "
+                f"{m['id']}'s eval histogram"
+            )
+
+    # --------------------------------------------------------------- trigger
+    def _wait_for_trigger(self, stop: threading.Event) -> str | None:
+        """Block until the next round should run; returns the trigger name
+        (``bootstrap`` | ``drift`` | ``interval``) or None when stopped."""
+        c = self.control
+        # Back-to-back throttle applies to every trigger source.
+        if self._last_round_start is not None and c.min_interval_s > 0.0:
+            wake = self._last_round_start + c.min_interval_s
+            while time.monotonic() < wake:
+                if stop.wait(min(0.2, wake - time.monotonic())):
+                    return None
+        if self.registry.serving_info() is None:
+            return "bootstrap"  # nothing serving: a round is needed regardless
+        if self.drift is None:
+            return "interval"  # fixed cadence (min_interval is the clock)
+        if not self.drift.has_reference:
+            # Serving artifact without an eval histogram (e.g. published
+            # by `federated --registry-dir` and hand-promoted): drift can
+            # NEVER fire against nothing — waiting on it would idle the
+            # campaign forever. Run a round on the clock instead; its
+            # promotion re-anchors the reference and drift takes over.
+            log.warning(
+                "[CONTROLLER] no drift reference (serving artifact "
+                "carries no eval histogram); triggering a round on the "
+                "clock so the campaign can re-anchor"
+            )
+            return "interval"
+        start = time.monotonic()
+        while True:
+            verdict = self.drift.poll()
+            if verdict is not None:
+                self.stats.drift_triggers += 1
+                self._record("drift_trigger", **verdict)
+                return "drift"
+            if (
+                c.max_interval_s is not None
+                and time.monotonic() - start >= c.max_interval_s
+            ):
+                return "interval"
+            if stop.wait(self.drift_poll_s):
+                return None
+
+    # ----------------------------------------------------------------- cycle
+    def run_cycle(self, trigger: str = "interval") -> dict:
+        """One round -> gate -> promote/reject cycle. Returns the cycle's
+        state record (also appended to the state JSONL)."""
+        c = self.control
+        r = self._next_round
+        self._next_round += 1
+        self._last_round_start = time.monotonic()
+        self.stats.rounds_attempted += 1
+        log.info(f"[CONTROLLER] round {r} starting (trigger: {trigger})")
+        try:
+            t0 = time.monotonic()
+            agg = self.server.serve_round(
+                deadline=c.round_deadline_s, round_index=r
+            )
+            round_wall = time.monotonic() - t0
+        except (RuntimeError, OSError, ConnectionError, ValueError) as e:
+            # Quorum miss / straggler deadline (RuntimeError), a malformed
+            # upload surviving to aggregation (WireError/SecureAggError,
+            # both ValueErrors), or a socket error: the campaign continues
+            # — one failed round must not kill the daemon (the single most
+            # important behavioral difference from the reference server).
+            self.stats.rounds_failed += 1
+            rec = {"round": r, "trigger": trigger, "error": str(e)}
+            self._record("round_failed", **rec)
+            log.info(f"[CONTROLLER] round {r} failed: {e}")
+            return {"event": "round_failed", **rec}
+        self.stats.round_wall_s += round_wall
+        if agg is None:
+            rec = {"round": r, "trigger": trigger}
+            self._record("round_noop", **rec)
+            return {"event": "round_noop", **rec}
+        self.stats.rounds_completed += 1
+        t_end = time.monotonic()
+        try:
+            return self._gate_and_promote(
+                r, trigger, agg, t_end=t_end, round_wall=round_wall
+            )
+        except Exception as e:
+            # Eval of a foreign-architecture aggregate, a full disk under
+            # the registry write, any other post-round surprise: the ROUND
+            # engine is healthy, so the campaign continues — same
+            # one-bad-cycle-must-not-kill-the-daemon contract as above.
+            rec = {"round": r, "trigger": trigger, "error": f"{type(e).__name__}: {e}"}
+            self._record("cycle_error", **rec)
+            log.info(
+                f"[CONTROLLER] round {r} completed but its gate/promote "
+                f"cycle failed ({type(e).__name__}: {e}); serving pointer "
+                "unchanged"
+            )
+            return {"event": "cycle_error", **rec}
+
+    def _gate_and_promote(
+        self, r: int, trigger: str, agg: dict, *, t_end: float, round_wall: float
+    ) -> dict:
+        c = self.control
+        nested = wire.unflatten_params(agg)
+        metrics = dict(self.eval_fn(nested))
+        probs = metrics.pop("probs", None)
+        metrics.pop("labels", None)
+        eval_hist = (
+            reference_histogram(probs, bins=c.score_bins)
+            if probs is not None
+            else None
+        )
+        incumbent = self.registry.serving_manifest()
+        aid = self.registry.add(
+            agg,
+            round_index=r,
+            metrics=metrics,
+            eval_hist=eval_hist,
+            model_config=self.model_config,
+            parent=incumbent["id"] if incumbent else None,
+        )
+        if incumbent is not None and aid == incumbent["id"]:
+            # Content-addressed dedup: this round's aggregate is
+            # bit-identical to what already serves. Short-circuit BEFORE
+            # any state transition — promote(to='shadow') would demote
+            # the serving artifact's manifest just to fail the final swap.
+            rec = {"round": r, "trigger": trigger, "artifact": aid}
+            self._record("promote_noop", **rec)
+            log.info(
+                f"[CONTROLLER] round {r}: aggregate identical to the "
+                f"serving artifact {aid}; nothing to promote"
+            )
+            return {"event": "promote_noop", **rec}
+        ok, reason = eval_gate(
+            metrics,
+            incumbent["metrics"] if incumbent else None,
+            metric=c.gate_metric,
+            min_delta=c.gate_min_delta,
+        )
+        rec: dict[str, Any] = {
+            "round": r,
+            "trigger": trigger,
+            "artifact": aid,
+            "gate": c.gate_metric,
+            "reason": reason,
+            "round_wall_s": round(round_wall, 3),
+        }
+        if c.gate_metric in metrics:
+            try:
+                rec["metric_value"] = float(metrics[c.gate_metric])
+            except (TypeError, ValueError):
+                pass
+        if not ok:
+            # Regression: reject; the serving pointer stays on the
+            # incumbent (the rollback IS the refusal to move it).
+            self.stats.gate_rejections += 1
+            self.registry.reject(aid, reason=reason)
+            rec["incumbent"] = incumbent["id"] if incumbent else None
+            self._record("gate_rejected", **rec)
+            log.info(
+                f"[CONTROLLER] round {r}: candidate {aid} REJECTED "
+                f"({reason}); serving pointer unchanged"
+                + (f" ({rec['incumbent']})" if rec["incumbent"] else "")
+            )
+            return {"event": "gate_rejected", **rec}
+        try:
+            self.registry.promote(aid, to="shadow")
+            self.registry.promote(aid, to="serving")
+        except RegistryError as e:
+            # Content-addressed dedup corner: a round whose aggregate is
+            # bit-identical to the serving artifact has nothing to swap.
+            rec["note"] = str(e)
+            self._record("promote_noop", **rec)
+            return {"event": "promote_noop", **rec}
+        latency = time.monotonic() - t_end
+        self.stats.promotions += 1
+        self.stats.promotion_latency_s.append(latency)
+        rec["promotion_latency_s"] = round(latency, 4)
+        if self.drift is not None and eval_hist is not None:
+            self.drift.set_reference(eval_hist)
+        self._record("promoted", **rec)
+        log.info(
+            f"[CONTROLLER] round {r}: promoted {aid} to serving "
+            f"({reason}; pointer swap {latency * 1e3:.0f} ms after round end)"
+        )
+        return {"event": "promoted", **rec}
+
+    # ------------------------------------------------------------------- run
+    def run(
+        self,
+        *,
+        max_rounds: int | None = None,
+        stop: threading.Event | None = None,
+    ) -> ControllerStats:
+        """The daemon loop: trigger-wait, cycle, repeat. ``max_rounds``
+        bounds COMPLETED+failed cycles (None = until ``stop`` is set)."""
+        stop = stop or threading.Event()
+        cycles = 0
+        while not stop.is_set():
+            if max_rounds is not None and cycles >= max_rounds:
+                break
+            trigger = self._wait_for_trigger(stop)
+            if trigger is None:
+                break
+            t0 = time.monotonic()
+            self.run_cycle(trigger)
+            self.stats.cycle_wall_s += time.monotonic() - t0
+            cycles += 1
+        log.info(
+            f"[CONTROLLER] campaign halted: "
+            f"{self.stats.rounds_completed} round(s) completed, "
+            f"{self.stats.promotions} promoted, "
+            f"{self.stats.gate_rejections} gate-rejected, "
+            f"{self.stats.drift_triggers} drift-triggered"
+        )
+        return self.stats
+
+    def summary(self) -> dict:
+        s = asdict(self.stats)
+        lat = s.pop("promotion_latency_s")
+        s["promotion_latency_ms_mean"] = (
+            round(float(np.mean(lat)) * 1e3, 3) if lat else None
+        )
+        return s

@@ -1,0 +1,245 @@
+"""Score-distribution drift: PSI/KS over serving-score histograms.
+
+The detector's output distribution is the cheapest drift signal a
+deployment already has: every scored flow produces one P(attack), the
+serving tier bins them (serving/server.py exports a per-batch
+``score_hist`` on the metrics-JSONL channel), and the promoted
+artifact's manifest carries the histogram of the SAME model's scores on
+the held-out eval split (train/fedeval.reference_histogram). When live
+traffic stops looking like the validation traffic — new attack family,
+topology change, seasonal shift — the two histograms diverge long before
+anyone labels a flow.
+
+Two standard distances over the binned distributions:
+
+* **PSI** (population stability index): ``sum((o - e) * ln(o / e))``
+  over bin fractions, the industry-standard monitoring score; > 0.25 is
+  the classic "significant shift, retrain" bound.
+* **KS**: max absolute CDF gap — bounded [0, 1], less sensitive to
+  tail bins than PSI's log ratio.
+
+:class:`DriftMonitor` tails the serving metrics-JSONL incrementally
+(byte-offset resume, partial trailing lines left for the next poll) and
+fires a verdict once enough scores accumulated AND the distance crosses
+the threshold. Firing resets the observation window — one burst of
+drifted traffic triggers one round, not one round per poll.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+_EPS = 1e-4  # empty-bin smoothing: PSI's log ratio must never see a zero
+
+
+def _fractions(counts: Any) -> np.ndarray:
+    c = np.asarray(counts, np.float64).ravel()
+    if c.ndim != 1 or c.size < 2 or (c < 0).any():
+        raise ValueError(f"histogram counts must be a 1-D >=2-bin non-negative array, got {c!r}")
+    total = c.sum()
+    if total <= 0:
+        raise ValueError("histogram has no mass")
+    return c / total
+
+
+def psi(expected: Any, observed: Any) -> float:
+    """Population stability index between two count histograms (same
+    binning). 0 = identical; > 0.25 = significant shift (classic bound)."""
+    e = np.clip(_fractions(expected), _EPS, None)
+    o = np.clip(_fractions(observed), _EPS, None)
+    if e.shape != o.shape:
+        raise ValueError(f"bin count mismatch: {e.shape} vs {o.shape}")
+    # Renormalize after clipping so both still sum to 1.
+    e, o = e / e.sum(), o / o.sum()
+    return float(np.sum((o - e) * np.log(o / e)))
+
+
+def ks_distance(expected: Any, observed: Any) -> float:
+    """Max absolute CDF gap between two count histograms (same binning)."""
+    e = _fractions(expected)
+    o = _fractions(observed)
+    if e.shape != o.shape:
+        raise ValueError(f"bin count mismatch: {e.shape} vs {o.shape}")
+    return float(np.max(np.abs(np.cumsum(o) - np.cumsum(e))))
+
+
+class DriftMonitor:
+    """Accumulate live serving-score histograms; fire on distribution
+    shift vs the promoted artifact's eval reference.
+
+    Sources compose: :meth:`observe` ingests a histogram directly (tests,
+    in-process wiring) and :meth:`poll` tails a serving metrics-JSONL
+    file for ``serve_batch`` records carrying ``score_hist`` (the
+    cross-process wiring — ``fedtpu infer-serve --metrics-jsonl X`` plus
+    ``fedtpu controller --drift-jsonl X``). Either way :meth:`check`
+    decides; a fired verdict resets the window.
+
+    The reference histogram is per-PROMOTION state: the controller calls
+    :meth:`set_reference` with each newly promoted artifact's eval
+    histogram, which also resets the window (scores produced by the old
+    model must not count against the new reference).
+    """
+
+    def __init__(
+        self,
+        jsonl_path: str | None = None,
+        *,
+        reference: Any | None = None,
+        threshold: float = 0.25,
+        min_scores: int = 256,
+        method: str = "psi",
+        window_scores: int | None = None,
+    ):
+        if method not in ("psi", "ks"):
+            raise ValueError(f"method={method!r} must be 'psi' or 'ks'")
+        if threshold <= 0.0:
+            raise ValueError(f"threshold={threshold} must be > 0")
+        self.jsonl_path = jsonl_path
+        self.threshold = float(threshold)
+        self.min_scores = int(min_scores)
+        self.method = method
+        # Observation-window cap (exponential decay): once the window
+        # holds this many scores, each new ingestion halves the existing
+        # counts — an UNBOUNDED window would let a week of stable traffic
+        # dilute a fresh shift so far below threshold that the trigger
+        # fires days late (recent traffic must stay a constant fraction
+        # of the window). Default: 64x the verdict floor.
+        self.window_scores = (
+            64 * self.min_scores if window_scores is None else int(window_scores)
+        )
+        if self.window_scores < self.min_scores:
+            raise ValueError(
+                f"window_scores={self.window_scores} below "
+                f"min_scores={self.min_scores}"
+            )
+        self._ref: np.ndarray | None = None
+        self._obs: np.ndarray | None = None
+        self._offset = 0  # resume point into the JSONL tail
+        if reference is not None:
+            self.set_reference(reference)
+
+    # ------------------------------------------------------------ ingestion
+    def set_reference(self, counts: Any) -> None:
+        """Adopt a newly promoted artifact's eval histogram; resets the
+        observation window (old-model scores must not fire against it)
+        AND fast-forwards the JSONL tail to end-of-file — records already
+        on disk were scored by the OLD model (during the training round,
+        or a whole backlog on controller restart) and counting them
+        against the new reference would fire a spurious round right after
+        every promotion."""
+        self._ref = np.asarray(counts, np.int64).ravel()
+        _fractions(self._ref)  # validate now, not at check time
+        self.reset_window()
+        if self.jsonl_path is not None:
+            try:
+                self._offset = os.path.getsize(self.jsonl_path)
+            except OSError:
+                self._offset = 0
+
+    def reset_window(self) -> None:
+        self._obs = None
+
+    @property
+    def has_reference(self) -> bool:
+        return self._ref is not None
+
+    @property
+    def observed_scores(self) -> int:
+        return 0 if self._obs is None else int(self._obs.sum())
+
+    def observe(self, counts: Any) -> None:
+        c = np.asarray(counts, np.int64).ravel()
+        if (c < 0).any():
+            # Validate on INGESTION, not at check(): a malformed record in
+            # the tailed JSONL must be skipped by _ingest_jsonl's guard,
+            # never poison the window and crash the controller daemon at
+            # verdict time.
+            raise ValueError(f"negative histogram counts {c.tolist()}")
+        if self._ref is not None and c.shape != self._ref.shape:
+            raise ValueError(
+                f"observed histogram has {c.size} bins, reference has "
+                f"{self._ref.size} — serving and eval must bin identically"
+            )
+        if self._obs is None:
+            self._obs = c
+        else:
+            if self._obs.sum() >= self.window_scores:
+                self._obs //= 2  # decay old traffic; recency must matter
+            self._obs = self._obs + c
+
+    def poll(self) -> dict | None:
+        """Tail the JSONL for new ``serve_batch`` score histograms, then
+        :meth:`check`. Returns the fired verdict dict or None."""
+        if self.jsonl_path is not None:
+            self._ingest_jsonl()
+        return self.check()
+
+    def _ingest_jsonl(self) -> None:
+        try:
+            size = os.path.getsize(self.jsonl_path)
+        except OSError:
+            return
+        if size < self._offset:
+            self._offset = 0  # file truncated/rotated: start over
+        if size == self._offset:
+            return
+        with open(self.jsonl_path, "rb") as f:
+            f.seek(self._offset)
+            chunk = f.read(size - self._offset)
+        # Only complete lines; a partially-flushed record waits for the
+        # next poll (the writer appends whole lines, so the split is safe).
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return
+        self._offset += end + 1
+        for line in chunk[: end + 1].splitlines():
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("phase") != "serve_batch":
+                continue
+            hist = rec.get("score_hist")
+            if isinstance(hist, list) and hist:
+                try:
+                    self.observe(hist)
+                except ValueError as e:
+                    log.warning(f"[DRIFT] skipping malformed score_hist: {e}")
+
+    # -------------------------------------------------------------- verdict
+    def distance(self) -> tuple[float | None, int]:
+        """(current distance or None when undecidable, scores observed)."""
+        n = self.observed_scores
+        if self._ref is None or self._obs is None or n == 0:
+            return None, n
+        fn = psi if self.method == "psi" else ks_distance
+        return fn(self._ref, self._obs), n
+
+    def check(self) -> dict | None:
+        """Fire when >= min_scores accumulated and distance >= threshold.
+        A fired verdict resets the window."""
+        d, n = self.distance()
+        if d is None or n < self.min_scores:
+            return None
+        if d < self.threshold:
+            return None
+        verdict = {
+            "drift": round(d, 6),
+            "method": self.method,
+            "threshold": self.threshold,
+            "scores": n,
+        }
+        log.info(
+            f"[DRIFT] {self.method}={d:.4f} >= {self.threshold} over {n} "
+            "live scores — triggering a training round"
+        )
+        self.reset_window()
+        return verdict

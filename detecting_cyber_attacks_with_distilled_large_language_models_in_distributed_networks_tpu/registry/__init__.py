@@ -1,0 +1,29 @@
+"""Model registry: immutable round artifacts + eval-gated promotion.
+
+The reference's deployment contract is a bare ``.pth`` path: whatever
+file sits there IS the model, with no record of which round produced it,
+how it evaluated, or what served before it — and the serving tier (PR 1)
+inherited that shape by hot-reloading whatever checkpoint appears on
+disk. This package is the control-plane half of closing that gap:
+
+* every finished federated round can be written as an **immutable,
+  content-addressed artifact** (flat params + a manifest carrying round
+  lineage, held-out eval metrics, and the eval score histogram drift
+  detection references);
+* artifacts move through explicit **promotion states** —
+  ``candidate -> shadow -> serving`` — with regression states
+  (``rejected``/``retired``) for gate failures and demotions;
+* the **serving pointer** is one atomically-swapped JSON file
+  (``serving.json``), which ``serving/reload.RegistryWatcher`` follows
+  instead of a raw checkpoint directory — the scoring tier can only ever
+  serve a PROMOTED artifact, never a half-written or unevaluated one;
+* ``rollback()`` swaps the pointer back to the previous serving
+  artifact in one atomic step.
+
+The promotion decisions themselves (the eval gate, drift triggers) live
+in :mod:`..control`; this package is the storage + state machine.
+"""
+
+from .store import ModelRegistry, RegistryError
+
+__all__ = ["ModelRegistry", "RegistryError"]

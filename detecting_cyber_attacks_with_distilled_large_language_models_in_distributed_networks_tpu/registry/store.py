@@ -1,0 +1,350 @@
+"""Content-addressed artifact store with an atomic serving pointer.
+
+Layout under the registry root::
+
+    artifacts/<id>/params.npz     flat fp32 params ('/'-joined keys)
+    artifacts/<id>/manifest.json  round lineage, state, eval metrics,
+                                  eval score histogram, model config
+    serving.json                  the serving pointer (atomic os.replace)
+    events.jsonl                  append-only audit trail
+
+The artifact id is a truncated SHA-256 over the sorted tensor manifest
+(key, dtype, shape, bytes), so identical params dedup to one artifact
+and an id can never name two different models. Artifact directories are
+staged under a tmp name and ``os.rename``d into place, manifests are
+rewritten via tmp + ``os.replace``, and the pointer is one small JSON
+file swapped with ``os.replace`` — every read a concurrent serving
+process can make sees either the old state or the new one, never a torn
+write (pinned by tests/test_registry.py's concurrent-reader test).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Any, Mapping
+
+import numpy as np
+
+from ..comm import wire
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+#: Promotion ladder (promote() advances one rung; serving swaps the
+#: pointer). ``rejected`` is the eval gate's terminal verdict; ``retired``
+#: is what a serving artifact becomes when another one replaces it.
+STATES = ("candidate", "shadow", "serving", "rejected", "retired")
+_LADDER = ("candidate", "shadow", "serving")
+
+_POINTER = "serving.json"
+_EVENTS = "events.jsonl"
+_ID_HEX = 16  # 64 bits of sha256 — collision-safe for any real fleet
+
+
+class RegistryError(ValueError):
+    """Unknown artifact, illegal state transition, or a corrupt store."""
+
+
+def _atomic_write_json(path: str, obj: Mapping[str, Any]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _flatten(params: Any) -> dict[str, np.ndarray]:
+    """Nested-or-flat params -> flat fp32 dict (the registry's one storage
+    dtype; non-fp32 leaves — e.g. a bf16-trained tree — are upcast, which
+    is exact for every dtype the engine trains in). An already-flat dict
+    (every value a leaf — what serve_round returns, '/'-joined keys) is
+    taken as-is; anything nested goes through wire.flatten_params."""
+    if isinstance(params, Mapping) and params and all(
+        not isinstance(v, Mapping) for v in params.values()
+    ):
+        flat: Mapping[str, Any] = {str(k): v for k, v in params.items()}
+    else:
+        flat = wire.flatten_params(params)
+    return {k: np.asarray(v, np.float32) for k, v in flat.items()}
+
+
+def artifact_id(params: Any) -> str:
+    """Content address: SHA-256 over the sorted (key, dtype, shape, bytes)
+    manifest, truncated to 64 bits of hex."""
+    flat = _flatten(params)
+    h = hashlib.sha256()
+    for key in sorted(flat):
+        arr = np.ascontiguousarray(flat[key])
+        h.update(key.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()[:_ID_HEX]
+
+
+class ModelRegistry:
+    """Artifact store + promotion state machine + serving pointer."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._artifacts = os.path.join(self.root, "artifacts")
+        os.makedirs(self._artifacts, exist_ok=True)
+
+    # ---------------------------------------------------------------- events
+    def _event(self, kind: str, **fields: Any) -> None:
+        rec = {"ts": time.time(), "event": kind, **fields}
+        with open(os.path.join(self.root, _EVENTS), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    # --------------------------------------------------------------- writing
+    def add(
+        self,
+        params: Any,
+        *,
+        round_index: int,
+        metrics: Mapping[str, float] | None = None,
+        eval_hist: Any | None = None,
+        model_config: Any | None = None,
+        parent: str | None = None,
+        extra: Mapping[str, Any] | None = None,
+    ) -> str:
+        """Register one finished round's params as an immutable candidate.
+
+        Returns the artifact id. Re-adding identical params is a no-op
+        returning the existing id (content addressing); the artifact is
+        staged under a tmp directory and renamed into place, so a reader
+        can never see a partially-written artifact.
+
+        ``eval_hist``: the held-out eval score-distribution histogram
+        (train/fedeval.reference_histogram) the drift monitor compares
+        live serving scores against once this artifact is promoted.
+        ``model_config``: a ModelConfig (or its asdict) recorded so the
+        serving tier refuses to hot-swap an architecture mismatch.
+        """
+        flat = _flatten(params)
+        aid = artifact_id(flat)
+        final = os.path.join(self._artifacts, aid)
+        if os.path.isdir(final):
+            log.info(f"[REGISTRY] artifact {aid} already registered (dedup)")
+            return aid
+        if model_config is not None and dataclasses.is_dataclass(model_config):
+            model_config = dataclasses.asdict(model_config)
+        manifest = {
+            "id": aid,
+            "state": "candidate",
+            "round": int(round_index),
+            "created_unix": time.time(),
+            "parent": parent,
+            "metrics": _scalar_metrics(metrics),
+            "eval_hist": (
+                None
+                if eval_hist is None
+                else [int(c) for c in np.asarray(eval_hist).ravel()]
+            ),
+            "model_config": model_config,
+            "n_tensors": len(flat),
+            "n_params": int(sum(v.size for v in flat.values())),
+        }
+        if extra:
+            manifest["extra"] = dict(extra)
+        tmp = os.path.join(self._artifacts, f".tmp-{aid}-{os.getpid()}")
+        os.makedirs(tmp, exist_ok=True)
+        try:
+            with open(os.path.join(tmp, "params.npz"), "wb") as f:
+                np.savez(f, **flat)
+            _atomic_write_json(os.path.join(tmp, "manifest.json"), manifest)
+            os.rename(tmp, final)
+        except OSError:
+            # A racing add() of the same content may have won the rename;
+            # that is success (identical bytes by construction).
+            if not os.path.isdir(final):
+                raise
+        finally:
+            if os.path.isdir(tmp):
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+        self._event("added", artifact=aid, round=int(round_index))
+        log.info(
+            f"[REGISTRY] registered candidate {aid} (round {round_index}, "
+            f"{manifest['n_params']:,} params)"
+        )
+        return aid
+
+    # --------------------------------------------------------------- reading
+    def _manifest_path(self, aid: str) -> str:
+        return os.path.join(self._artifacts, aid, "manifest.json")
+
+    def manifest(self, aid: str) -> dict:
+        try:
+            with open(self._manifest_path(aid)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise RegistryError(f"unknown or corrupt artifact {aid!r}: {e}") from None
+
+    def load_params(self, aid: str) -> dict:
+        """Artifact params as the nested dict the engines consume."""
+        path = os.path.join(self._artifacts, aid, "params.npz")
+        try:
+            with np.load(path) as z:
+                flat = {k: np.asarray(z[k]) for k in z.files}
+        except OSError as e:
+            raise RegistryError(f"artifact {aid!r} has no params: {e}") from None
+        return wire.unflatten_params(flat)
+
+    def list(self) -> list[dict]:
+        """Every artifact's manifest, oldest first."""
+        out = []
+        try:
+            entries = sorted(os.listdir(self._artifacts))
+        except OSError:
+            return out
+        for name in entries:
+            if name.startswith("."):
+                continue
+            try:
+                out.append(self.manifest(name))
+            except RegistryError:
+                continue
+        out.sort(key=lambda m: m.get("created_unix", 0.0))
+        return out
+
+    # --------------------------------------------------------------- pointer
+    def serving_info(self) -> dict | None:
+        """The serving pointer's content (None before any promotion).
+        One atomic file read — safe against a concurrent promote()."""
+        try:
+            with open(os.path.join(self.root, _POINTER)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, json.JSONDecodeError) as e:
+            raise RegistryError(f"corrupt serving pointer: {e}") from None
+
+    def serving_manifest(self) -> dict | None:
+        info = self.serving_info()
+        return None if info is None else self.manifest(info["artifact"])
+
+    # ----------------------------------------------------- state transitions
+    def _set_state(self, aid: str, state: str) -> dict:
+        if state not in STATES:
+            raise RegistryError(f"unknown state {state!r}")
+        m = self.manifest(aid)
+        m["state"] = state
+        m[f"{state}_unix"] = time.time()
+        _atomic_write_json(self._manifest_path(aid), m)
+        return m
+
+    def promote(self, aid: str, *, to: str | None = None) -> dict:
+        """Advance ``aid`` one rung up the ladder (or straight ``to`` a
+        named rung). Reaching ``serving`` swaps the pointer atomically and
+        retires the previous serving artifact. Returns the new manifest."""
+        m = self.manifest(aid)
+        cur = m.get("state", "candidate")
+        if cur in ("rejected", "retired") and to is None:
+            raise RegistryError(
+                f"artifact {aid} is {cur}; promote it explicitly with "
+                "to='candidate' first if that is really intended"
+            )
+        if to is None:
+            if cur not in _LADDER:
+                to = "candidate"
+            elif cur == "serving":
+                raise RegistryError(f"artifact {aid} is already serving")
+            else:
+                to = _LADDER[_LADDER.index(cur) + 1]
+        if to not in STATES:
+            raise RegistryError(f"unknown state {to!r}")
+        if to != "serving":
+            m = self._set_state(aid, to)
+            self._event("promoted", artifact=aid, state=to)
+            log.info(f"[REGISTRY] {aid}: {cur} -> {to}")
+            return m
+        prev = self.serving_info()
+        prev_id = prev["artifact"] if prev else None
+        if prev_id == aid:
+            raise RegistryError(f"artifact {aid} is already serving")
+        m = self._set_state(aid, "serving")
+        pointer = {
+            "artifact": aid,
+            "round": m.get("round"),
+            "promoted_at_unix": time.time(),
+            # Rollback chain, most recent first (the pointer itself is the
+            # single source of truth for "what served before").
+            "history": ([prev_id] + list(prev.get("history", []))) if prev else [],
+        }
+        _atomic_write_json(os.path.join(self.root, _POINTER), pointer)
+        if prev_id is not None:
+            try:
+                self._set_state(prev_id, "retired")
+            except RegistryError:
+                pass  # previous artifact deleted out-of-band; pointer moved anyway
+        self._event("serving", artifact=aid, previous=prev_id)
+        log.info(
+            f"[REGISTRY] serving pointer -> {aid} (round {m.get('round')})"
+            + (f", retired {prev_id}" if prev_id else "")
+        )
+        return m
+
+    def reject(self, aid: str, *, reason: str = "") -> dict:
+        """The eval gate's verdict: mark a candidate rejected (it stays on
+        disk as lineage; it can never reach the pointer without an
+        explicit operator re-promote)."""
+        m = self._set_state(aid, "rejected")
+        self._event("rejected", artifact=aid, reason=reason)
+        log.info(f"[REGISTRY] rejected {aid}" + (f": {reason}" if reason else ""))
+        return m
+
+    def rollback(self) -> dict:
+        """Swap the pointer back to the previous serving artifact (one
+        atomic step). The demoted artifact is marked retired."""
+        cur = self.serving_info()
+        if cur is None:
+            raise RegistryError("nothing is serving; no rollback target")
+        history = list(cur.get("history", []))
+        if not history:
+            raise RegistryError(
+                f"serving artifact {cur['artifact']} has no predecessor"
+            )
+        target, rest = history[0], history[1:]
+        m = self.manifest(target)  # must still exist before we demote anyone
+        self._set_state(target, "serving")
+        pointer = {
+            "artifact": target,
+            "round": m.get("round"),
+            "promoted_at_unix": time.time(),
+            "history": rest,
+            "rolled_back_from": cur["artifact"],
+        }
+        _atomic_write_json(os.path.join(self.root, _POINTER), pointer)
+        try:
+            self._set_state(cur["artifact"], "retired")
+        except RegistryError:
+            pass
+        self._event("rollback", artifact=target, previous=cur["artifact"])
+        log.info(
+            f"[REGISTRY] rollback: serving pointer {cur['artifact']} -> {target}"
+        )
+        return m
+
+
+def _scalar_metrics(metrics: Mapping[str, Any] | None) -> dict:
+    """Keep only scalar metrics, and only FINITE numeric ones: arrays
+    (probs/labels) stay out of the manifest — the histogram is their
+    registry representation — and a NaN metric is DROPPED, not stored as
+    a null sentinel (a missing key reads as 'never measured' everywhere;
+    a null would make a later gate comparison fail-open confusingly)."""
+    out: dict[str, Any] = {}
+    for k, v in (metrics or {}).items():
+        if isinstance(v, (bool, str)):
+            out[k] = v
+        elif isinstance(v, (int, float, np.generic)):
+            f = float(v)
+            if np.isfinite(f):
+                out[k] = f
+    return out

@@ -65,17 +65,35 @@ def append_metrics_jsonl(path: str, record: Mapping[str, object]) -> None:
     (SURVEY.md §5); a JSONL stream is the machine-readable upgrade — one
     self-describing record per (round, client, phase), greppable and
     loadable into pandas (``pd.read_json(path, lines=True)``). Non-scalar
-    metric entries (probs/labels arrays) are dropped, not serialized.
+    metric entries (probs/labels arrays) are dropped, not serialized —
+    EXCEPT short scalar lists (<= 64 entries, e.g. the serving tier's
+    binned ``score_hist`` the drift monitor consumes), which are small by
+    construction and stay machine-readable.
     """
     import json
     import time
 
+    def _short_scalar_list(v: object) -> list | None:
+        if not isinstance(v, (list, tuple)) or len(v) > 64:
+            return None
+        out = []
+        for x in v:
+            if isinstance(x, np.generic):
+                x = x.item()
+            if isinstance(x, bool) or not isinstance(x, (int, float)):
+                return None
+            out.append(x)
+        return out
+
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    clean = {
-        k: (v.item() if isinstance(v, np.generic) else v)
-        for k, v in record.items()
-        if isinstance(v, (str, int, float, bool, np.generic)) or v is None
-    }
+    clean = {}
+    for k, v in record.items():
+        if isinstance(v, (str, int, float, bool, np.generic)) or v is None:
+            clean[k] = v.item() if isinstance(v, np.generic) else v
+        else:
+            lst = _short_scalar_list(v)
+            if lst is not None:
+                clean[k] = lst
     clean.setdefault("ts", time.time())
     with open(path, "a") as f:
         f.write(json.dumps(clean) + "\n")

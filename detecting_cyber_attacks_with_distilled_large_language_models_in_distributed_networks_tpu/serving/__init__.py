@@ -40,11 +40,12 @@ from .protocol import (
     parse_reply,
     parse_request,
 )
-from .reload import CheckpointWatcher
+from .reload import CheckpointWatcher, RegistryWatcher
 from .server import ScoringServer
 
 __all__ = [
     "CheckpointWatcher",
+    "RegistryWatcher",
     "MicroBatcher",
     "ScoreEngine",
     "ScoreRejected",

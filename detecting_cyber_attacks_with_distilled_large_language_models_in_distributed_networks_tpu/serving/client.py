@@ -19,7 +19,7 @@ from typing import Any, Mapping, Sequence
 import numpy as np
 
 from ..comm import framing
-from ..comm.wire import WireError
+from ..comm.wire import NONCE_LEN, NONCE_MAGIC, WireError
 from . import protocol
 
 
@@ -34,14 +34,48 @@ class ScoreRejected(Exception):
 
 
 class ScoringClient:
-    """Blocking scoring connection. Not thread-safe; one per thread."""
+    """Blocking scoring connection. Not thread-safe; one per thread.
+
+    ``auth_key``: the scoring port's shared secret (server ``--auth``):
+    the constructor answers the server's per-connection nonce challenge
+    before the first request. Against a server that requires auth, a
+    keyless client fails with a clear WireError on its first score()
+    (the challenge frame arrives where the reply was expected)."""
 
     def __init__(
-        self, host: str, port: int, *, timeout: float = 30.0
+        self,
+        host: str,
+        port: int,
+        *,
+        timeout: float = 30.0,
+        auth_key: bytes | None = None,
     ):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.settimeout(timeout)
         self._next_id = 0
+        if auth_key is not None:
+            try:
+                chal = bytes(framing.recv_frame(self.sock, send_ack=False))
+            except (OSError, ConnectionError) as e:
+                self.close()
+                raise WireError(
+                    "server sent no auth challenge — is it running with "
+                    f"--auth? ({e})"
+                ) from None
+            if len(chal) != len(NONCE_MAGIC) + NONCE_LEN or not chal.startswith(
+                NONCE_MAGIC
+            ):
+                self.close()
+                raise WireError(
+                    f"bad auth challenge from server (magic {chal[:4]!r})"
+                )
+            framing.send_frame(
+                self.sock,
+                protocol.build_auth_response(
+                    auth_key, chal[len(NONCE_MAGIC) :]
+                ),
+                await_ack=False,
+            )
 
     def score(
         self,
@@ -63,6 +97,14 @@ class ScoringClient:
             await_ack=False,
         )
         reply = bytes(framing.recv_frame(self.sock, send_ack=False))
+        if reply[:4] == NONCE_MAGIC:
+            # The server's auth challenge landed where the reply was
+            # expected: this client connected without a key to an
+            # --auth server. Name the fix instead of a generic magic error.
+            raise WireError(
+                "server requires authentication — construct the client "
+                "with auth_key (server runs with --auth)"
+            )
         if protocol.is_reject(reply):
             body = protocol.parse_reject(reply)
             raise ScoreRejected(body["code"], body["reason"], body["id"])
@@ -96,6 +138,7 @@ def run_load(
     requests: int | None = None,
     deadline_ms: float | None = None,
     timeout: float = 60.0,
+    auth_key: bytes | None = None,
 ) -> dict:
     """Closed-loop load generator: ``concurrency`` connections, each
     scoring the next text round-robin until ``requests`` total (default:
@@ -113,7 +156,9 @@ def run_load(
 
     def worker() -> None:
         try:
-            with ScoringClient(host, port, timeout=timeout) as cli:
+            with ScoringClient(
+                host, port, timeout=timeout, auth_key=auth_key
+            ) as cli:
                 while True:
                     with idx_lock:
                         i = next(idx, None)

@@ -19,10 +19,13 @@ can never interleave with the reader thread's ACKs.
 
 from __future__ import annotations
 
+import hmac
 import json
 from typing import Any, Mapping
 
 from ..comm.wire import (
+    SCORE_AUTH_DOMAIN,
+    SCORE_AUTH_MAGIC,
     SCORE_REJ_MAGIC,
     SCORE_REP_MAGIC,
     SCORE_REQ_MAGIC,
@@ -158,3 +161,20 @@ def parse_reject(frame: bytes) -> dict:
 
 def is_reject(frame: bytes) -> bool:
     return bytes(frame[:4]) == SCORE_REJ_MAGIC
+
+
+# -------------------------------------------------------------------- auth
+def build_auth_response(auth_key: bytes, nonce: bytes) -> bytes:
+    """The client's proof for the server's per-connection nonce challenge
+    (the FL tier's challenge-response reused on the scoring port):
+    ``SCORE_AUTH_MAGIC + HMAC-SHA256(key, domain + nonce)``. Domain
+    separation keeps the proof from doubling as any FL-tier tag."""
+    return SCORE_AUTH_MAGIC + hmac.new(
+        auth_key, SCORE_AUTH_DOMAIN + bytes(nonce), "sha256"
+    ).digest()
+
+
+def check_auth_response(frame: bytes, auth_key: bytes, nonce: bytes) -> bool:
+    """Constant-time verification of a client's auth proof."""
+    want = build_auth_response(auth_key, nonce)
+    return hmac.compare_digest(bytes(frame), want)

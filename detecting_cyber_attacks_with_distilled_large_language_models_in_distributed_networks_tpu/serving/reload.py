@@ -184,3 +184,119 @@ class CheckpointWatcher:
             f"(model round {round_id})"
         )
         return True
+
+
+class RegistryWatcher:
+    """Pointer-following reload: serve ONLY what the control plane promoted.
+
+    The checkpoint watcher above trusts the training tier completely —
+    whatever step lands in the directory gets served. With a model
+    registry (registry/) in the loop, that trust moves to the eval gate:
+    this watcher follows the registry's atomically-swapped serving
+    pointer, so an unevaluated or gate-rejected candidate can never reach
+    traffic, and a ``registry rollback`` takes effect within one poll
+    interval with no serving restart.
+
+    Same duck type as :class:`CheckpointWatcher` (``poll(engine)`` /
+    ``prime()`` / ``primed`` / ``reload_count``), so the scoring server
+    drives either without knowing which deployment shape it is in."""
+
+    def __init__(self, registry, *, poll_interval_s: float = 2.0):
+        self.registry = registry
+        self.poll_interval_s = float(poll_interval_s)
+        self._last_poll = 0.0
+        self._seen: str | None = None
+        # Incompatible artifacts are NOT marked seen (a rollback to a
+        # compatible one must still be adopted), so dedup their warning
+        # here — a 2 s poll would otherwise log the same line ~43k
+        # times/day until an operator intervened.
+        self._warned: str | None = None
+        self._primed = False
+        self.reload_count = 0
+
+    @property
+    def primed(self) -> bool:
+        return self._primed
+
+    def prime(self, artifact: str | None = None) -> None:
+        """Record the artifact already serving (the one the caller just
+        loaded); None primes from the current pointer."""
+        if artifact is None:
+            info = self.registry.serving_info()
+            artifact = info["artifact"] if info else None
+        self._seen = artifact
+        self._primed = True
+
+    def poll(self, engine) -> bool:
+        """One idle-tick check; True when a newly promoted (or rolled-
+        back-to) artifact was adopted. Any registry error leaves the
+        serving params untouched — reload is an optimization; the
+        service must never die for it."""
+        now = time.monotonic()
+        if now - self._last_poll < self.poll_interval_s:
+            return False
+        self._last_poll = now
+        try:
+            info = self.registry.serving_info()
+        except Exception as e:
+            log.warning(f"[SERVE] registry pointer read failed: {e}")
+            return False
+        if info is None or info.get("artifact") == self._seen:
+            return False
+        aid = info["artifact"]
+        try:
+            manifest = self.registry.manifest(aid)
+            mc = manifest.get("model_config")
+            if mc is not None:
+                import dataclasses as _dc
+
+                if mc != _dc.asdict(engine.model_cfg):
+                    # Do NOT mark seen: the operator may roll back to a
+                    # compatible artifact, which must still be adopted.
+                    if self._warned != aid:
+                        self._warned = aid
+                        log.warning(
+                            f"[SERVE] serving artifact {aid} declares a "
+                            "different architecture than the engine; "
+                            "skipping hot swap (restart the service to "
+                            "change shapes)"
+                        )
+                    return False
+            params = self.registry.load_params(aid)
+            # Checkpoint/restore's compatibility predicate, reused: same
+            # pytree structure and per-leaf shapes, dtype-tolerant.
+            from ..train.checkpoint import _shapes_match
+
+            if mc is None and not _shapes_match(
+                engine.snapshot()[0], params
+            ):
+                # No recorded architecture to compare (older artifact):
+                # the param tree itself is the claim — a mismatched tree
+                # would swap in fine and then fail EVERY batch until an
+                # operator rolls back.
+                if self._warned != aid:
+                    self._warned = aid
+                    log.warning(
+                        f"[SERVE] serving artifact {aid} has a different "
+                        "param tree than the engine (no model_config "
+                        "recorded); skipping hot swap"
+                    )
+                return False
+            # Adoption inside the guard too: device_put in swap() can
+            # fail transiently (e.g. an OOM while two model copies
+            # coexist) and the scorer thread must outlive it.
+            engine.swap(params, round_id=int(manifest.get("round", 0)))
+        except Exception as e:
+            log.warning(
+                f"[SERVE] reload of serving artifact {aid} failed "
+                f"({type(e).__name__}: {e}); keeping the serving weights"
+            )
+            return False
+        self._seen = aid
+        self._warned = None
+        self.reload_count += 1
+        log.info(
+            f"[SERVE] hot-swapped to promoted artifact {aid} "
+            f"(round {manifest.get('round')})"
+        )
+        return True

@@ -136,6 +136,8 @@ class ScoringServer:
         metrics_jsonl: str | None = None,
         warmup: bool = True,
         latency_window: int = 100_000,
+        auth_key: bytes | None = None,
+        score_bins: int = 10,
     ):
         self.engine = engine
         self.tok = tokenizer
@@ -161,7 +163,19 @@ class ScoringServer:
         self._batches = 0
         self._rejects = {
             "deadline": 0, "overloaded": 0, "bad_request": 0, "error": 0,
+            "auth": 0,
         }
+        # Scoring-port auth (the FL tier's HMAC challenge-response reused
+        # here): with a key, every connection must answer the nonce
+        # challenge before its first request is read. None = the
+        # reference-style open port, exactly as before.
+        self.auth_key = auth_key
+        # Score-distribution export for the drift monitor
+        # (control/drift.py): per-batch probability histograms over fixed
+        # [0, 1] bins — the SAME binning train/fedeval.reference_histogram
+        # uses for the promoted artifact's eval fingerprint.
+        self._hist_edges = np.linspace(0.0, 1.0, int(score_bins) + 1)
+        self._score_hist = np.zeros(int(score_bins), np.int64)
         self._batch_hist: collections.Counter[int] = collections.Counter()
         self._latencies: collections.deque[float] = collections.deque(
             maxlen=latency_window
@@ -245,6 +259,7 @@ class ScoringServer:
             batches = self._batches
             rejects = dict(self._rejects)
             hist = dict(sorted(self._batch_hist.items()))
+            score_hist = self._score_hist.tolist()
         uptime = max(time.monotonic() - self._t_start, 1e-9)
         pct = (
             {
@@ -259,6 +274,7 @@ class ScoringServer:
             "batches": batches,
             "mean_batch": scored / batches if batches else 0.0,
             "batch_size_hist": hist,
+            "score_hist": score_hist,
             "rejects": rejects,
             "reloads": getattr(self.watcher, "reload_count", 0),
             "round": self.engine.round_id,
@@ -282,6 +298,14 @@ class ScoringServer:
             t.start()
 
     def _reader_loop(self, conn: socket.socket) -> None:
+        if self.auth_key is not None and not self._auth_handshake(conn):
+            with self._conn_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
         writer = _ConnWriter(conn)
         seq_len = self.engine.seq_len
         try:
@@ -356,6 +380,39 @@ class ScoringServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _auth_handshake(self, conn: socket.socket) -> bool:
+        """Challenge-response before the first request is read (the FL
+        tier's per-connection nonce, comm/server.py): send NONCE_MAGIC +
+        fresh nonce, require SCORE_AUTH_MAGIC + HMAC(key, domain + nonce).
+        The handshake runs before the writer thread exists, so these are
+        the connection's only writes — no interleaving to worry about.
+        A short handshake deadline bounds how long an unauthenticated
+        connection can hold a reader thread."""
+        import os as _os
+
+        from ..comm.wire import NONCE_LEN, NONCE_MAGIC
+
+        nonce = _os.urandom(NONCE_LEN)
+        try:
+            conn.settimeout(10.0)
+            framing.send_frame(conn, NONCE_MAGIC + nonce, await_ack=False)
+            proof = framing.recv_frame(
+                conn, send_ack=False, max_frame=MAX_REQUEST_FRAME
+            )
+            conn.settimeout(None)
+        except (OSError, ConnectionError, WireError) as e:
+            self._count_reject("auth")
+            log.warning(f"[SERVE] auth handshake failed: {e}")
+            return False
+        if not protocol.check_auth_response(proof, self.auth_key, nonce):
+            self._count_reject("auth")
+            log.warning(
+                "[SERVE] dropping connection: bad or missing auth proof "
+                "(client must score with the matching key)"
+            )
+            return False
+        return True
 
     def _make_reply(self, writer: _ConnWriter, req_id: int):
         def _reply(*, prob, round_id, batch_size, bucket, queue_ms):
@@ -437,10 +494,18 @@ class ScoringServer:
                     bucket=bucket,
                     queue_ms=(now - r.t_enqueue) * 1e3,
                 )
+            # The batch's score-distribution histogram: the drift signal
+            # (control/drift.py) — binned counts, never raw scores, so the
+            # JSONL stays small under any traffic volume.
+            batch_hist, _ = np.histogram(
+                np.clip(np.asarray(probs[:n], np.float64), 0.0, 1.0),
+                bins=self._hist_edges,
+            )
             with self._stats_lock:
                 self._scored += n
                 self._batches += 1
                 self._batch_hist[n] += 1
+                self._score_hist += batch_hist
                 self._latencies.extend(done - r.t_enqueue for r in live)
             if self.metrics_jsonl:
                 from ..reporting import append_metrics_jsonl
@@ -456,6 +521,7 @@ class ScoringServer:
                         "queue_ms_max": round(
                             max((now - r.t_enqueue) for r in live) * 1e3, 3
                         ),
+                        "score_hist": batch_hist.tolist(),
                     },
                 )
 

@@ -1,15 +1,21 @@
-"""Federated evaluation plumbing: eval-split stacking and the stacked
-metrics loop.
+"""Federated evaluation plumbing: eval-split stacking, the stacked
+metrics loop, and the control plane's eval-gate hooks.
 
 The reference evaluates each client separately with a host-side sklearn
 pass (client1.py:118-150); here all C clients evaluate in one jitted
 vmapped sweep over a padded ``[C, M, ...]`` stack, with on-device
 BinaryCounts accumulation and one host sync per evaluation.
+
+:func:`eval_gate` and :func:`reference_histogram` are the train-side
+hooks the controller (control/controller.py) gates promotion on: the
+gate compares a candidate's held-out metrics against the incumbent's,
+and the histogram is the score-distribution fingerprint the drift
+monitor later compares live serving traffic against.
 """
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Sequence
+from typing import Any, Mapping, NamedTuple, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -133,3 +139,58 @@ def evaluate_stacked(
             m["labels"] = labels_g[c][mask_c]
         out.append(m)
     return out
+
+
+# ----------------------------------------------------- control-plane hooks
+def reference_histogram(probs: Any, *, bins: int = 10) -> np.ndarray:
+    """Score-distribution fingerprint of a held-out evaluation: integer
+    counts of P(attack) over ``bins`` equal buckets spanning [0, 1].
+
+    Recorded in the registry manifest at artifact creation; once the
+    artifact is promoted, the drift monitor (control/drift.py) compares
+    live serving-score histograms (the serving tier exports the SAME
+    binning, serving/server.py) against this reference — a shift says the
+    traffic no longer looks like what the model was validated on."""
+    p = np.clip(np.asarray(probs, np.float64).ravel(), 0.0, 1.0)
+    counts, _ = np.histogram(p, bins=int(bins), range=(0.0, 1.0))
+    return counts.astype(np.int64)
+
+
+def eval_gate(
+    candidate: Mapping[str, Any],
+    incumbent: Mapping[str, Any] | None,
+    *,
+    metric: str = "Accuracy",
+    min_delta: float = 0.0,
+) -> tuple[bool, str]:
+    """The promotion gate: may ``candidate`` replace ``incumbent``?
+
+    Returns ``(ok, reason)``. A candidate whose gate metric is missing or
+    non-finite NEVER passes — a corrupted aggregate (NaN params) shows up
+    exactly there, and "can't evaluate" must fail closed, not promote.
+    With no incumbent (bootstrap) any finite candidate passes. Otherwise
+    the candidate must score at least ``incumbent[metric] - min_delta``
+    (metrics here are higher-is-better, the reference's five-metric
+    schema minus Loss — gate on Loss is not supported)."""
+    try:
+        cand = float(candidate[metric])
+    except (KeyError, TypeError, ValueError):
+        return False, f"candidate has no finite {metric!r}"
+    if not np.isfinite(cand):
+        return False, f"candidate {metric}={cand} is not finite"
+    if incumbent is None:
+        return True, f"bootstrap: no incumbent ({metric} {cand:.4f})"
+    try:
+        inc = float(incumbent[metric])
+    except (KeyError, TypeError, ValueError):
+        # An incumbent with no recorded metric cannot anchor a comparison;
+        # treat it like bootstrap rather than blocking every promotion.
+        return True, f"incumbent has no {metric!r}; promoting {cand:.4f}"
+    if not np.isfinite(inc):
+        return True, f"incumbent {metric} not finite; promoting {cand:.4f}"
+    if cand >= inc - float(min_delta):
+        return True, f"{metric} {cand:.4f} >= incumbent {inc:.4f} - {min_delta}"
+    return (
+        False,
+        f"{metric} {cand:.4f} < incumbent {inc:.4f} - {min_delta} (regression)",
+    )

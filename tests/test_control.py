@@ -1,0 +1,518 @@
+"""Control plane (control/ + registry/ + serving pointer): drift math,
+the drift monitor's JSONL tail, and the end-to-end unattended loop —
+consecutive live TCP rounds with no human re-run, the eval gate blocking
+a corrupted candidate (pointer unchanged), the serving tier scoring via
+the promoted artifact only, and a drift verdict triggering a round."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+    AggregationServer,
+    FederatedClient,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+    ControlConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.control import (
+    Controller,
+    DriftMonitor,
+    ks_distance,
+    psi,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.registry import (
+    ModelRegistry,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.fedeval import (
+    eval_gate,
+    reference_histogram,
+)
+
+# ---------------------------------------------------------------- drift math
+def test_psi_and_ks_distances():
+    ref = [100, 50, 10, 5, 5, 5, 5, 10, 50, 100]
+    assert psi(ref, ref) == pytest.approx(0.0, abs=1e-9)
+    assert ks_distance(ref, ref) == pytest.approx(0.0, abs=1e-12)
+    # Scale invariance: 3x the traffic, same distribution.
+    assert psi(ref, [3 * c for c in ref]) == pytest.approx(0.0, abs=1e-9)
+    shifted = [0, 0, 0, 0, 170, 170, 0, 0, 0, 0]
+    assert psi(ref, shifted) > 0.25
+    assert ks_distance(ref, shifted) > 0.25
+    with pytest.raises(ValueError):
+        psi(ref, [1, 2, 3])  # bin count mismatch
+    with pytest.raises(ValueError):
+        psi([0] * 10, ref)  # reference with no mass
+
+
+def test_reference_histogram_binning():
+    h = reference_histogram([0.05, 0.95, 0.96, 1.0, 0.0], bins=10)
+    assert h.tolist() == [2, 0, 0, 0, 0, 0, 0, 0, 0, 3]
+    assert h.sum() == 5
+
+
+def test_eval_gate_verdicts():
+    ok, _ = eval_gate({"Accuracy": 0.9}, None)
+    assert ok  # bootstrap
+    ok, _ = eval_gate({"Accuracy": 0.9}, {"Accuracy": 0.8})
+    assert ok
+    ok, reason = eval_gate({"Accuracy": 0.7}, {"Accuracy": 0.8})
+    assert not ok and "regression" in reason
+    ok, _ = eval_gate(
+        {"Accuracy": 0.75}, {"Accuracy": 0.8}, min_delta=0.1
+    )
+    assert ok  # inside the tolerated delta
+    # Corruption fails CLOSED: NaN or missing metric never promotes.
+    ok, _ = eval_gate({"Accuracy": float("nan")}, None)
+    assert not ok
+    ok, _ = eval_gate({}, {"Accuracy": 0.5})
+    assert not ok
+
+
+# ------------------------------------------------------------- drift monitor
+def test_drift_monitor_fires_on_shift_and_stays_quiet_on_iid():
+    ref = [400, 200, 50, 20, 10, 10, 20, 50, 200, 400]
+    dm = DriftMonitor(reference=ref, threshold=0.25, min_scores=200)
+    # IID traffic (the reference distribution itself, rescaled): quiet.
+    dm.observe([40, 20, 5, 2, 1, 1, 2, 5, 20, 40])
+    assert dm.check() is None
+    dm.observe([200, 100, 25, 10, 5, 5, 10, 25, 100, 200])
+    assert dm.check() is None
+    # Injected shift: mass collapses to the middle bins.
+    dm.reset_window()
+    dm.observe([0, 0, 0, 150, 150, 150, 150, 0, 0, 0])
+    verdict = dm.check()
+    assert verdict is not None
+    assert verdict["drift"] >= 0.25 and verdict["scores"] == 600
+    assert dm.observed_scores == 0  # fired verdict resets the window
+
+
+def test_drift_monitor_needs_min_scores():
+    dm = DriftMonitor(reference=[10, 10], threshold=0.1, min_scores=100)
+    dm.observe([99, 0])
+    assert dm.check() is None  # massively shifted but too few scores
+    dm.observe([99, 0])
+    assert dm.check() is not None
+
+
+def test_drift_monitor_tails_serving_jsonl(tmp_path):
+    """The cross-process wiring: infer-serve appends serve_batch records
+    with score_hist; the monitor ingests incrementally and tolerates a
+    partially-flushed trailing line."""
+    path = str(tmp_path / "metrics.jsonl")
+    ref = [500, 0, 0, 0, 0, 0, 0, 0, 0, 500]
+    dm = DriftMonitor(path, reference=ref, threshold=0.25, min_scores=64)
+    assert dm.poll() is None  # file doesn't exist yet
+
+    def rec(hist):
+        return json.dumps({"phase": "serve_batch", "score_hist": hist})
+
+    with open(path, "w") as f:
+        f.write(rec([16, 0, 0, 0, 0, 0, 0, 0, 0, 16]) + "\n")
+        f.write(json.dumps({"phase": "serve_summary"}) + "\n")  # ignored
+    assert dm.poll() is None and dm.observed_scores == 32
+    with open(path, "a") as f:
+        f.write(rec([0, 0, 0, 0, 32, 32, 0, 0, 0, 0]) + "\n")
+        f.write('{"phase": "serve_batch", "score_hi')  # torn tail
+    assert dm.poll() is not None  # 96 >= 64 scores, shifted
+    assert dm.observed_scores == 0
+    with open(path, "a") as f:  # complete the torn line
+        f.write('st": [16, 0, 0, 0, 0, 0, 0, 0, 0, 16]}\n')
+    assert dm.poll() is None  # ingested, but below min_scores again
+    assert dm.observed_scores == 32
+
+
+# -------------------------------------------------------------- live helpers
+def _mean_eval(params):
+    """Synthetic held-out eval: 'accuracy' tracks the mean weight (the
+    fleet's uploads push it up each round), with probs for the reference
+    histogram. A NaN aggregate yields a NaN metric — exactly what a real
+    eval of corrupted params produces."""
+    w = params["w"]
+    mean = float(np.asarray(w, np.float64).mean())
+    acc = mean if np.isfinite(mean) else float("nan")
+    rng = np.random.default_rng(7)
+    return {"Accuracy": acc, "probs": rng.uniform(0, 1, 128)}
+
+
+# ------------------------------------------------------------- e2e: rounds
+def test_controller_runs_consecutive_live_rounds_unattended(tmp_path):
+    """Two consecutive live TCP rounds with no human re-run: the
+    controller owns the cadence, every round lands as an artifact, the
+    improving candidate promotes each time, and the state JSONL replays
+    into a resumed controller."""
+    registry = ModelRegistry(str(tmp_path / "reg"))
+    state = str(tmp_path / "state.jsonl")
+    errors = []
+    with AggregationServer(port=0, num_clients=2, timeout=30) as server:
+        controller = Controller(
+            server,
+            registry,
+            _mean_eval,
+            control=ControlConfig(round_deadline_s=20.0),
+            state_path=state,
+        )
+
+        def uploads(r, cid, cur):
+            base = np.zeros(16, np.float32) if cur is None else cur["w"]
+            return {"w": base + np.float32(0.1 * (r + 1))}
+
+        def loop(cid):
+            try:
+                fc = FederatedClient(
+                    "127.0.0.1", server.port, client_id=cid, timeout=30
+                )
+                cur = None
+                for r in range(2):
+                    cur = fc.exchange(uploads(r, cid, cur))
+            except Exception as e:
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=loop, args=(c,), daemon=True)
+            for c in range(2)
+        ]
+        for t in threads:
+            t.start()
+        stats = controller.run(max_rounds=2)
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, errors
+    assert stats.rounds_completed == 2
+    assert stats.promotions == 2 and stats.gate_rejections == 0
+    arts = registry.list()
+    assert len(arts) == 2
+    serving = registry.serving_manifest()
+    assert serving["round"] == 1  # the second (better) round serves
+    assert serving["eval_hist"] is not None
+    events = [json.loads(ln) for ln in open(state)]
+    assert [e["event"] for e in events if e["event"] == "promoted"] == [
+        "promoted",
+        "promoted",
+    ]
+    # A restarted controller resumes mid-campaign: round counter continues.
+    with AggregationServer(port=0, num_clients=2, timeout=5) as server2:
+        resumed = Controller(
+            server2, registry, _mean_eval, state_path=state
+        )
+    assert resumed._next_round == 2
+    assert resumed.stats.promotions == 2
+
+
+def test_eval_gate_blocks_corrupted_candidate_live(tmp_path):
+    """Round 1 promotes; round 2's fleet uploads a NaN-corrupted model.
+    The gate must reject it: serving pointer unchanged, candidate marked
+    rejected, the refusal logged in the controller state (the automatic
+    rollback-on-regression contract)."""
+    registry = ModelRegistry(str(tmp_path / "reg"))
+    state = str(tmp_path / "state.jsonl")
+    errors = []
+    with AggregationServer(port=0, num_clients=2, timeout=30) as server:
+        controller = Controller(
+            server,
+            registry,
+            _mean_eval,
+            control=ControlConfig(round_deadline_s=20.0),
+            state_path=state,
+        )
+
+        def loop(cid):
+            try:
+                fc = FederatedClient(
+                    "127.0.0.1", server.port, client_id=cid, timeout=30
+                )
+                good = {"w": np.full(16, 0.5, np.float32)}
+                fc.exchange(good)
+                corrupt = {"w": np.full(16, np.nan, np.float32)}
+                fc.exchange(corrupt)
+            except Exception as e:
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=loop, args=(c,), daemon=True)
+            for c in range(2)
+        ]
+        for t in threads:
+            t.start()
+        stats = controller.run(max_rounds=2)
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, errors
+    assert stats.rounds_completed == 2
+    assert stats.promotions == 1 and stats.gate_rejections == 1
+    serving = registry.serving_info()
+    good_id = serving["artifact"]
+    manifests = {m["id"]: m for m in registry.list()}
+    assert manifests[good_id]["round"] == 0  # pointer never moved
+    rejected = [m for m in manifests.values() if m["state"] == "rejected"]
+    assert len(rejected) == 1 and rejected[0]["round"] == 1
+    events = [json.loads(ln) for ln in open(state)]
+    rej = [e for e in events if e["event"] == "gate_rejected"]
+    assert len(rej) == 1
+    assert rej[0]["incumbent"] == good_id
+    assert "not finite" in rej[0]["reason"]
+
+
+def test_drift_verdict_triggers_the_next_round(tmp_path):
+    """Purely drift-driven cadence (no clock): after the bootstrap round
+    promotes, the controller idles until a shifted score distribution is
+    injected into the monitor — then exactly one more round runs, tagged
+    with the drift trigger."""
+    registry = ModelRegistry(str(tmp_path / "reg"))
+    state = str(tmp_path / "state.jsonl")
+    dm = DriftMonitor(threshold=0.25, min_scores=64)
+    errors = []
+    with AggregationServer(port=0, num_clients=2, timeout=30) as server:
+        controller = Controller(
+            server,
+            registry,
+            _mean_eval,
+            control=ControlConfig(round_deadline_s=20.0),
+            state_path=state,
+            drift_monitor=dm,
+            drift_poll_s=0.05,
+        )
+
+        def loop(cid):
+            try:
+                fc = FederatedClient(
+                    "127.0.0.1", server.port, client_id=cid, timeout=30
+                )
+                out = fc.exchange({"w": np.full(16, 0.5, np.float32)})
+                out = fc.exchange(
+                    {"w": out["w"] + np.float32(0.1)}
+                )
+            except Exception as e:
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=loop, args=(c,), daemon=True)
+            for c in range(2)
+        ]
+        for t in threads:
+            t.start()
+        run_t = threading.Thread(
+            target=lambda: controller.run(max_rounds=2), daemon=True
+        )
+        run_t.start()
+        # Wait for the bootstrap promotion (it installs the drift
+        # reference), then inject live-traffic shift.
+        deadline = time.monotonic() + 20
+        while registry.serving_info() is None:
+            assert time.monotonic() < deadline, "bootstrap round never promoted"
+            time.sleep(0.05)
+        time.sleep(0.3)  # let the controller enter its drift wait
+        assert controller.stats.rounds_completed == 1
+        shifted = np.zeros(10, np.int64)
+        shifted[4:6] = 64
+        dm.observe(shifted)
+        run_t.join(timeout=30)
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, errors
+    assert controller.stats.rounds_completed == 2
+    assert controller.stats.drift_triggers == 1
+    events = [json.loads(ln) for ln in open(state)]
+    assert any(e["event"] == "drift_trigger" for e in events)
+    second = [
+        e for e in events if e["event"] == "promoted" and e["round"] == 1
+    ]
+    assert second and second[0]["trigger"] == "drift"
+
+
+# ------------------------------------------- serving follows the pointer
+def test_serving_tier_scores_via_promoted_artifact_only(tmp_path):
+    """A live scoring process over a RegistryWatcher: an unpromoted
+    candidate never reaches traffic; promotion hot-swaps within one poll;
+    rollback swaps back — all with no serving restart."""
+    import dataclasses
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+        default_tokenizer,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.serving import (
+        RegistryWatcher,
+        ScoreEngine,
+        ScoringClient,
+        ScoringServer,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.engine import (
+        Trainer,
+    )
+
+    tok = default_tokenizer()
+    model_cfg = ModelConfig.tiny(vocab_size=len(tok.vocab))
+    trainer = Trainer(model_cfg, TrainConfig(), pad_id=tok.pad_id)
+    params_a = trainer.init_state(seed=0).params
+    params_b = trainer.init_state(seed=1).params
+
+    registry = ModelRegistry(str(tmp_path / "reg"))
+    mc = dataclasses.asdict(model_cfg)
+    a = registry.add(params_a, round_index=0, model_config=mc)
+    registry.promote(a, to="serving")
+
+    engine = ScoreEngine(
+        model_cfg,
+        registry.load_params(a),
+        pad_id=tok.pad_id,
+        buckets=(1, 4),
+        round_id=0,
+    )
+    watcher = RegistryWatcher(registry, poll_interval_s=0.05)
+    watcher.prime(a)
+    text = "Destination port is 80. Flow duration is 100 microseconds."
+    with ScoringServer(
+        engine, tok, batcher=None, watcher=watcher, idle_tick_s=0.01
+    ) as server:
+        with ScoringClient("127.0.0.1", server.port) as cli:
+            r1 = cli.score(text=text)
+            assert r1["round"] == 0
+            # A CANDIDATE lands in the registry: must NOT be served.
+            b = registry.add(params_b, round_index=1, model_config=mc)
+            time.sleep(0.3)
+            r2 = cli.score(text=text)
+            assert r2["round"] == 0 and r2["prob"] == r1["prob"]
+            assert watcher.reload_count == 0
+            # Promotion: the pointer swap reaches traffic within a poll.
+            registry.promote(b, to="serving")
+            deadline = time.monotonic() + 10
+            while watcher.reload_count == 0:
+                assert time.monotonic() < deadline, "promotion never served"
+                time.sleep(0.05)
+            r3 = cli.score(text=text)
+            assert r3["round"] == 1 and r3["prob"] != r1["prob"]
+            # Rollback: one atomic swap back, again with no restart.
+            registry.rollback()
+            deadline = time.monotonic() + 10
+            while watcher.reload_count == 1:
+                assert time.monotonic() < deadline, "rollback never served"
+                time.sleep(0.05)
+            r4 = cli.score(text=text)
+            assert r4["round"] == 0 and r4["prob"] == r1["prob"]
+
+
+def test_drift_monitor_survives_malformed_jsonl_counts(tmp_path):
+    """A corrupt record (negative counts) in the tailed JSONL must be
+    skipped at ingestion — never accumulate and crash the controller's
+    poll loop at verdict time."""
+    path = str(tmp_path / "metrics.jsonl")
+    dm = DriftMonitor(
+        path, reference=[10, 10], threshold=0.1, min_scores=8
+    )
+    with open(path, "w") as f:
+        f.write(
+            json.dumps(
+                {"phase": "serve_batch", "score_hist": [-1, 300]}
+            )
+            + "\n"
+        )
+        f.write(
+            json.dumps({"phase": "serve_batch", "score_hist": [8, 0]})
+            + "\n"
+        )
+    verdict = dm.poll()  # must not raise; only the clean record counts
+    assert dm.observed_scores == 0 if verdict else True
+    assert verdict is not None and verdict["scores"] == 8
+
+
+def test_round_engine_errors_do_not_kill_the_campaign(tmp_path):
+    """A WireError escaping serve_round (malformed upload surviving to
+    aggregation) is a failed ROUND, not a dead daemon — same contract the
+    serve CLI loop has always had."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.wire import (
+        WireError,
+    )
+
+    class FlakyServer:
+        dp_clip = 0.0
+
+        def __init__(self):
+            self.calls = 0
+
+        def serve_round(self, *, deadline=None, round_index=None):
+            self.calls += 1
+            if self.calls == 1:
+                raise WireError("model 1 key set differs from model 0")
+            return {"w": np.full(8, 0.5, np.float32)}
+
+    registry = ModelRegistry(str(tmp_path / "reg"))
+    ctl = Controller(
+        FlakyServer(),
+        registry,
+        _mean_eval,
+        state_path=str(tmp_path / "state.jsonl"),
+    )
+    stats = ctl.run(max_rounds=2)
+    assert stats.rounds_failed == 1 and stats.rounds_completed == 1
+    assert registry.serving_info() is not None
+
+
+def test_drift_wait_without_reference_falls_back_to_the_clock(tmp_path):
+    """A serving artifact with no eval histogram (mesh-tier publish +
+    hand promote) must not idle a drift-driven campaign forever: the
+    controller runs a clock round, whose promotion re-anchors drift."""
+    registry = ModelRegistry(str(tmp_path / "reg"))
+    a = registry.add({"w": np.zeros(8, np.float32)}, round_index=0)
+    registry.promote(a, to="serving")
+    assert registry.serving_manifest()["eval_hist"] is None
+
+    class OneShotServer:
+        dp_clip = 0.0
+
+        def serve_round(self, *, deadline=None, round_index=None):
+            return {"w": np.full(8, 0.5, np.float32)}
+
+    dm = DriftMonitor(threshold=0.25, min_scores=8)
+    ctl = Controller(
+        OneShotServer(),
+        registry,
+        _mean_eval,
+        state_path=str(tmp_path / "state.jsonl"),
+        drift_monitor=dm,
+        drift_poll_s=0.05,
+    )
+    assert not dm.has_reference
+    stats = ctl.run(max_rounds=1)  # would hang forever without the fallback
+    assert stats.rounds_completed == 1
+    assert dm.has_reference  # the promoted round re-anchored the monitor
+
+
+def test_eval_or_registry_errors_do_not_kill_the_campaign(tmp_path):
+    """A post-round failure (eval of a foreign-architecture aggregate, a
+    failed registry write) is one bad CYCLE, not a dead daemon: the
+    pointer stays put and the next cycle proceeds."""
+    calls = [0]
+
+    def flaky_eval(params):
+        calls[0] += 1
+        if calls[0] == 1:
+            raise TypeError("foreign architecture: missing encoder scope")
+        return _mean_eval(params)
+
+    class Srv:
+        dp_clip = 0.0
+
+        def __init__(self):
+            self.n = 0
+
+        def serve_round(self, *, deadline=None, round_index=None):
+            self.n += 1
+            return {"w": np.full(8, float(self.n), np.float32)}
+
+    registry = ModelRegistry(str(tmp_path / "reg"))
+    state = str(tmp_path / "state.jsonl")
+    ctl = Controller(Srv(), registry, flaky_eval, state_path=state)
+    stats = ctl.run(max_rounds=2)
+    assert stats.rounds_completed == 2 and stats.promotions == 1
+    assert registry.serving_info() is not None
+    events = [json.loads(ln) for ln in open(state)]
+    assert [e["event"] for e in events] == ["cycle_error", "promoted"]
+    # Resume replay counts the errored cycle consistently.
+    resumed = Controller(Srv(), registry, flaky_eval, state_path=state)
+    assert resumed.stats.rounds_attempted == 2
+    assert resumed.stats.rounds_completed == 2

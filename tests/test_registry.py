@@ -1,0 +1,170 @@
+"""Model registry (registry/): content addressing, the candidate ->
+shadow -> serving state machine, atomic pointer swap under a concurrent
+reader, and rollback."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.registry import (
+    ModelRegistry,
+    RegistryError,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.registry.store import (
+    artifact_id,
+)
+
+
+def _params(seed, shape=(8, 4)):
+    rng = np.random.default_rng(seed)
+    return {
+        "encoder": {"w": rng.normal(size=shape).astype(np.float32)},
+        "head": {"b": rng.normal(size=shape[1]).astype(np.float32)},
+    }
+
+
+@pytest.fixture()
+def registry(tmp_path):
+    return ModelRegistry(str(tmp_path / "registry"))
+
+
+# ------------------------------------------------------------- addressing
+def test_content_addressing_dedups_and_roundtrips(registry):
+    p = _params(0)
+    a = registry.add(p, round_index=1, metrics={"Accuracy": 0.9})
+    assert registry.add(p, round_index=99) == a  # identical bytes dedup
+    assert a == artifact_id(p)
+    assert artifact_id(_params(1)) != a  # different params, different id
+    back = registry.load_params(a)
+    np.testing.assert_array_equal(back["encoder"]["w"], p["encoder"]["w"])
+    np.testing.assert_array_equal(back["head"]["b"], p["head"]["b"])
+    m = registry.manifest(a)
+    assert m["state"] == "candidate"
+    assert m["round"] == 1
+    assert m["metrics"]["Accuracy"] == pytest.approx(0.9)
+
+
+def test_flat_and_nested_params_share_an_address(registry):
+    """serve_round hands the controller FLAT '/'-joined params; the same
+    model registered nested must address (and load) identically."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.wire import (
+        flatten_params,
+    )
+
+    nested = _params(3)
+    flat = flatten_params(nested)
+    assert artifact_id(nested) == artifact_id(flat)
+    a = registry.add(flat, round_index=0)
+    back = registry.load_params(a)
+    np.testing.assert_array_equal(
+        back["encoder"]["w"], nested["encoder"]["w"]
+    )
+
+
+# ----------------------------------------------------------- state machine
+def test_promotion_ladder_and_pointer(registry):
+    a1 = registry.add(_params(0), round_index=0, metrics={"Accuracy": 0.8})
+    assert registry.serving_info() is None
+    registry.promote(a1)  # candidate -> shadow
+    assert registry.manifest(a1)["state"] == "shadow"
+    assert registry.serving_info() is None  # shadow never serves
+    registry.promote(a1)  # shadow -> serving (pointer swap)
+    info = registry.serving_info()
+    assert info["artifact"] == a1 and info["history"] == []
+    with pytest.raises(RegistryError):
+        registry.promote(a1)  # already serving
+
+    a2 = registry.add(_params(1), round_index=1, metrics={"Accuracy": 0.9})
+    registry.promote(a2, to="serving")
+    assert registry.serving_info()["artifact"] == a2
+    assert registry.serving_info()["history"] == [a1]
+    assert registry.manifest(a1)["state"] == "retired"
+    assert registry.serving_manifest()["id"] == a2
+
+
+def test_rejected_candidate_never_reaches_the_pointer(registry):
+    a1 = registry.add(_params(0), round_index=0)
+    registry.promote(a1, to="serving")
+    a2 = registry.add(_params(1), round_index=1)
+    registry.reject(a2, reason="gate regression")
+    assert registry.manifest(a2)["state"] == "rejected"
+    assert registry.serving_info()["artifact"] == a1
+    with pytest.raises(RegistryError):
+        registry.promote(a2)  # rejected artifacts need an explicit revival
+
+
+def test_rollback_swaps_back_and_chains(registry):
+    ids = [
+        registry.add(_params(i), round_index=i) for i in range(3)
+    ]
+    for a in ids:
+        registry.promote(a, to="serving")
+    assert registry.serving_info()["artifact"] == ids[2]
+    m = registry.rollback()
+    assert m["id"] == ids[1]
+    assert registry.serving_info()["artifact"] == ids[1]
+    assert registry.manifest(ids[2])["state"] == "retired"
+    m = registry.rollback()  # chain continues to the first artifact
+    assert m["id"] == ids[0]
+    with pytest.raises(RegistryError):
+        registry.rollback()  # no predecessor left
+
+
+def test_rollback_without_serving_fails(registry):
+    with pytest.raises(RegistryError):
+        registry.rollback()
+
+
+# ------------------------------------------------------------- concurrency
+def test_pointer_swap_is_atomic_under_a_concurrent_reader(registry):
+    """A scoring process reads the pointer between batches; promotions
+    must never expose a torn/partial read — every read is either the old
+    pointer or the new one, always naming a loadable artifact."""
+    ids = [registry.add(_params(i), round_index=i) for i in range(6)]
+    registry.promote(ids[0], to="serving")
+    stop = threading.Event()
+    bad: list = []
+    reads = [0]
+
+    def reader():
+        while not stop.is_set():
+            try:
+                info = registry.serving_info()
+                if info is None or info["artifact"] not in ids:
+                    bad.append(info)
+                    return
+                # The named artifact must be fully readable at all times.
+                registry.manifest(info["artifact"])
+                reads[0] += 1
+            except Exception as e:  # torn read = failure
+                bad.append(e)
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    for a in ids[1:]:
+        registry.promote(a, to="serving")
+    for _ in range(3):
+        registry.rollback()
+    stop.set()
+    t.join(timeout=10)
+    assert not bad, bad
+    assert reads[0] > 0
+
+
+# ------------------------------------------------------------------ events
+def test_events_jsonl_records_the_lifecycle(registry):
+    a1 = registry.add(_params(0), round_index=0)
+    registry.promote(a1, to="serving")
+    a2 = registry.add(_params(1), round_index=1)
+    registry.reject(a2, reason="worse")
+    events = [
+        json.loads(line)
+        for line in open(os.path.join(registry.root, "events.jsonl"))
+    ]
+    kinds = [e["event"] for e in events]
+    assert kinds == ["added", "serving", "added", "rejected"]
+    assert events[3]["reason"] == "worse"

@@ -450,3 +450,107 @@ def test_infer_serve_parser_wiring():
         _parse_buckets("fast,slow")
     with pytest.raises(SystemExit):
         _parse_buckets("0,8")
+
+
+# ----------------------------------------------------------------- auth
+def test_scoring_port_auth_challenge_response(tiny_setup):
+    """The FL tier's HMAC + per-connection nonce challenge reused on the
+    scoring port (--auth): the right key scores, a wrong key is dropped
+    before any request is read, and a keyless client gets an error that
+    names the fix instead of a hang."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.wire import (
+        WireError,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.serving import (
+        ScoreEngine,
+        ScoringClient,
+        ScoringServer,
+        run_load,
+    )
+
+    tok, model_cfg, trainer, params = tiny_setup
+    key = b"scoring-secret"
+    engine = ScoreEngine(model_cfg, params, pad_id=tok.pad_id, buckets=(1, 4))
+    with ScoringServer(
+        engine, tok, idle_tick_s=0.01, auth_key=key
+    ) as server:
+        # Right key: the handshake is invisible to the scoring flow.
+        with ScoringClient(
+            "127.0.0.1", server.port, auth_key=key
+        ) as cli:
+            reply = cli.score(text=TEXTS[0])
+            assert 0.0 <= reply["prob"] <= 1.0
+        # No key: the challenge frame arrives where the reply was
+        # expected — a clear refusal, not a stall.
+        with ScoringClient("127.0.0.1", server.port) as bare:
+            with pytest.raises(WireError, match="auth"):
+                bare.score(text=TEXTS[0])
+        # Wrong key: the server drops the connection after the bad proof.
+        with pytest.raises((ConnectionError, OSError, WireError)):
+            with ScoringClient(
+                "127.0.0.1", server.port, auth_key=b"wrong", timeout=5
+            ) as thief:
+                thief.score(text=TEXTS[0])
+        # The load generator authenticates too (SDK passthrough).
+        stats = run_load(
+            "127.0.0.1",
+            server.port,
+            TEXTS,
+            concurrency=2,
+            auth_key=key,
+        )
+        assert stats["scored"] == len(TEXTS)
+        assert server.stats()["rejects"]["auth"] >= 2
+
+
+def test_scoring_auth_client_against_open_server_fails_fast(tiny_setup):
+    """An auth-configured client dialing an OPEN server must fail with a
+    clear message (no challenge ever comes), bounded by its timeout."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.wire import (
+        WireError,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.serving import (
+        ScoreEngine,
+        ScoringClient,
+        ScoringServer,
+    )
+
+    tok, model_cfg, trainer, params = tiny_setup
+    engine = ScoreEngine(model_cfg, params, pad_id=tok.pad_id, buckets=(1,))
+    with ScoringServer(engine, tok, idle_tick_s=0.01) as server:
+        with pytest.raises(WireError, match="no auth challenge"):
+            ScoringClient(
+                "127.0.0.1", server.port, auth_key=b"k", timeout=2
+            )
+
+
+def test_serve_batch_jsonl_carries_score_histogram(tiny_setup, tmp_path):
+    """The drift monitor's input: every serve_batch record carries the
+    batch's binned score histogram, and the cumulative histogram rides
+    stats() — counts must equal flows scored."""
+    import json as _json
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.serving import (
+        ScoreEngine,
+        ScoringClient,
+        ScoringServer,
+    )
+
+    tok, model_cfg, trainer, params = tiny_setup
+    path = str(tmp_path / "metrics.jsonl")
+    engine = ScoreEngine(model_cfg, params, pad_id=tok.pad_id, buckets=(1, 4))
+    with ScoringServer(
+        engine, tok, idle_tick_s=0.01, metrics_jsonl=path
+    ) as server:
+        with ScoringClient("127.0.0.1", server.port) as cli:
+            for t in TEXTS:
+                cli.score(text=t)
+        s = server.stats()
+    assert sum(s["score_hist"]) == len(TEXTS)
+    assert len(s["score_hist"]) == 10
+    records = [_json.loads(ln) for ln in open(path)]
+    batch_hists = [
+        r["score_hist"] for r in records if r.get("phase") == "serve_batch"
+    ]
+    assert batch_hists and all(len(h) == 10 for h in batch_hists)
+    assert sum(sum(h) for h in batch_hists) == len(TEXTS)
