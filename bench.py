@@ -54,8 +54,14 @@ online-serving throughput/latency record — and the dense headline LAST;
 tail parsers keep reading the same headline metric, and the headline now
 carries ``fed2_mfu``/``fedseq_mfu`` as machine-parsed fields with a
 ``BENCH_MFU_FLOOR`` (default 0.50) regression gate that exits 3 when a
-federated product step breaks it. BENCH_SECONDARY=0 restores the
-single-line output; every other mode prints exactly one line.
+federated product step breaks it. The headline also carries the fedseq
+MFU-residual decomposition (``fedseq_residual_*``: hash-dropout vs
+ring-merge vs degenerate-ring shares of the fed2-vs-fedseq step gap,
+measured by no-dropout and merge micro A/Bs; BENCH_FEDSEQ_DECOMP=0
+skips) and the round engine's measured ``comm_phase_{wait,agg,reply}_s``
+breakdown from the controller fleet — ASSERTED present (exit 3 when the
+phase accounting breaks). BENCH_SECONDARY=0 restores the single-line
+output; every other mode prints exactly one line.
 """
 
 from __future__ import annotations
@@ -477,6 +483,7 @@ def bench_fed2() -> dict:
         "vs_baseline": round(sps / REFERENCE_TRAIN_SAMPLES_PER_SEC, 2),
         "device": jax.devices()[0].device_kind,
         "tflops_per_sec": round(flops / dt / 1e12, 2),
+        "step_seconds": round(dt, 6),
         "path": path,
     }
     if util is not None:
@@ -529,10 +536,163 @@ def bench_fedseq() -> dict:
         "vs_baseline": round(sps / REFERENCE_TRAIN_SAMPLES_PER_SEC, 2),
         "device": jax.devices()[0].device_kind,
         "tflops_per_sec": round(flops / dt / 1e12, 2),
+        "step_seconds": round(dt, 6),
         "path": path,
     }
     if util is not None:
         record["mfu"] = round(util, 4)
+    _emit(record)
+    return record
+
+
+def bench_fedseq_residual(
+    rec_fed2: dict | None, rec_fedseq: dict | None
+) -> dict | None:
+    """Fedseq MFU residual decomposition (ROADMAP: "fedseq 56.0% vs fed2
+    58.54% — the 2.5-point residual has no decomposition"). Measured A/Bs
+    isolate where each fedseq step's extra time goes:
+
+    * **hash-dropout**: rerun BOTH product steps with every dropout rate
+      zeroed; the dropout cost difference ((fedseq - fedseq_nd) -
+      (fed2 - fed2_nd)) is what the ring path's global-coordinate hash
+      masks cost over the dense path's PRNG masks.
+    * **ring-merge arithmetic**: micro A/B at the model's attention shape
+      — blockwise_attention_local(n_chunks=1) (the online-softmax merge
+      formulation with NO ring schedule) vs the XLA dot path — scaled by
+      layers and clients.
+    * **degenerate-ring overhead**: the remainder of the no-dropout gap —
+      shard_map/1-hop-schedule cost that is neither merge math nor
+      dropout.
+
+    The parts are emitted as machine-parsed fields on this record AND as
+    ``fedseq_residual_*`` companions on the headline record, so the
+    driver pins the residual (and any fix) per round."""
+    if not rec_fed2 or not rec_fedseq:
+        return None
+    if "step_seconds" not in rec_fed2 or "step_seconds" not in rec_fedseq:
+        return None
+    import jax.numpy as jnp
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        ExperimentConfig,
+        FedConfig,
+        MeshConfig,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.ops.attention import (
+        dot_product_attention,
+        make_attention_bias,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.parallel.ring_attention import (
+        blockwise_attention_local,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.federated import (
+        FederatedTrainer,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.seqfed import (
+        FedSeqTrainer,
+    )
+
+    n_clients = int(os.environ.get("BENCH_CLIENTS", "2"))
+    batch_size = int(os.environ.get("BENCH_BATCH", "64"))
+    steps = int(os.environ.get("BENCH_DECOMP_STEPS", "20"))
+    warmup = max(1, int(os.environ.get("BENCH_WARMUP", "5")))
+    fs_dt = float(rec_fedseq["step_seconds"])
+    f2_dt = float(rec_fed2["step_seconds"])
+    gap_s = fs_dt - f2_dt
+
+    def _nd(cfg: ExperimentConfig) -> ExperimentConfig:
+        return ExperimentConfig(
+            fed=cfg.fed,
+            mesh=cfg.mesh,
+            model=cfg.model.replace(
+                dropout=0.0, attention_dropout=0.0, head_dropout=0.0
+            ),
+        )
+
+    cfg2 = ExperimentConfig(
+        fed=FedConfig(num_clients=n_clients), mesh=MeshConfig(clients=1, data=1)
+    )
+    cfg3 = ExperimentConfig(
+        fed=FedConfig(num_clients=n_clients),
+        mesh=MeshConfig(clients=1, data=1, seq=1),
+    )
+    f2_nd_dt, _ = _time_product_step(
+        FederatedTrainer(_nd(cfg2)), cfg2.model, n_clients, batch_size,
+        steps, warmup,
+    )
+    tr3 = FedSeqTrainer(_nd(cfg3))
+    fs_nd_dt, _ = _time_product_step(
+        tr3, tr3.cfg.model, n_clients, batch_size, steps, warmup,
+    )
+    ring_total_s = fs_nd_dt - f2_nd_dt
+    hash_dropout_s = (fs_dt - fs_nd_dt) - (f2_dt - f2_nd_dt)
+
+    # Ring-merge micro A/B at the per-client attention shape: the
+    # blockwise (online-softmax) formulation at n_chunks=1 runs the merge
+    # arithmetic with zero ring schedule — its delta over the XLA dot
+    # path, scaled by layers x clients, estimates the merge share of the
+    # no-dropout gap; the rest is degenerate-ring/shard_map overhead.
+    model = cfg2.model
+    B, H, L, D = batch_size, model.n_heads, model.max_len, model.head_dim
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jax.device_put(rng.normal(size=(B, H, L, D)).astype(np.float32)).astype(
+            jnp.bfloat16
+        )
+        for _ in range(3)
+    )
+    bias = make_attention_bias(jax.device_put(np.ones((B, L), np.int32)))
+
+    def _grad_time(fn):
+        g = jax.jit(
+            jax.grad(lambda qkv: fn(*qkv, bias).astype(jnp.float32).sum())
+        )
+        out = g((q, k, v))
+        _sync(out)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = g((q, k, v))
+        _sync(out)
+        return (time.perf_counter() - t0) / steps
+
+    merge_attn_s = _grad_time(
+        lambda q, k, v, b: blockwise_attention_local(q, k, v, b, n_chunks=1)
+    )
+    dot_attn_s = _grad_time(dot_product_attention)
+    # The micro estimate is extrapolated (layers x clients, separate jit)
+    # and can exceed a small/noisy gap; clamp BEFORE emitting so the
+    # machine-parsed parts always satisfy
+    # hash_dropout + ring_merge + degenerate_ring == gap exactly (a
+    # negative degenerate_ring then honestly reads as measurement noise,
+    # never as inconsistent bookkeeping).
+    ring_merge_s = min(
+        max(merge_attn_s - dot_attn_s, 0.0) * model.n_layers * n_clients,
+        max(gap_s, 0.0),
+    )
+    degenerate_ring_s = gap_s - hash_dropout_s - ring_merge_s
+    record = {
+        "metric": f"fedseq_mfu_residual_c{n_clients}_bs{batch_size}",
+        "value": round(gap_s * 1e3, 3),
+        "unit": "ms/step",
+        # Higher is better: fedseq step time as a fraction of fed2's
+        # (1.0 = residual fully closed).
+        "vs_baseline": round(f2_dt / fs_dt, 4) if fs_dt > 0 else None,
+        "baseline_note": "fed2 product step time over fedseq's "
+        "(no-dropout A/B + merge micro-A/B decomposition attached)",
+        "fed2_step_ms": round(f2_dt * 1e3, 3),
+        "fedseq_step_ms": round(fs_dt * 1e3, 3),
+        "fed2_nodrop_step_ms": round(f2_nd_dt * 1e3, 3),
+        "fedseq_nodrop_step_ms": round(fs_nd_dt * 1e3, 3),
+        "hash_dropout_ms": round(hash_dropout_s * 1e3, 3),
+        "ring_total_ms": round(ring_total_s * 1e3, 3),
+        "ring_merge_ms": round(ring_merge_s * 1e3, 3),
+        "degenerate_ring_ms": round(degenerate_ring_s * 1e3, 3),
+        "device": jax.devices()[0].device_kind,
+    }
+    if rec_fed2.get("mfu") is not None and rec_fedseq.get("mfu") is not None:
+        record["mfu_gap_points"] = round(
+            (rec_fed2["mfu"] - rec_fedseq["mfu"]) * 100, 2
+        )
     _emit(record)
     return record
 
@@ -663,7 +823,7 @@ def bench_controller() -> dict | None:
 
     errors: list[Exception] = []
     try:
-        stats, wall = _run_controller_fleet(
+        stats, wall, comm_phases = _run_controller_fleet(
             registry, base, rounds, n_clients, eval_fn, errors
         )
     finally:
@@ -697,6 +857,13 @@ def bench_controller() -> dict | None:
         "gate_rejections": stats.gate_rejections,
         "rounds": stats.rounds_completed,
         "param_mb": param_mb,
+        # The round engine's measured comm/compute breakdown (obs layer:
+        # AggregationServer.phase_seconds) — wait (accept + straggler +
+        # upload wire), agg (aggregation compute), reply (fan-out) —
+        # machine-parsed so the driver tracks where round wall goes.
+        "comm_phase_wait_s": round(comm_phases.get("wait", 0.0), 4),
+        "comm_phase_agg_s": round(comm_phases.get("agg", 0.0), 4),
+        "comm_phase_reply_s": round(comm_phases.get("reply", 0.0), 4),
         "device": jax.devices()[0].device_kind,
     }
     _emit(record)
@@ -705,7 +872,7 @@ def bench_controller() -> dict | None:
 
 def _run_controller_fleet(registry, base, rounds, n_clients, eval_fn, errors):
     """One controller campaign over an in-process TCP fleet; returns
-    (ControllerStats, wall seconds)."""
+    (ControllerStats, wall seconds, round-engine phase seconds)."""
     import threading
 
     from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
@@ -755,7 +922,8 @@ def _run_controller_fleet(registry, base, rounds, n_clients, eval_fn, errors):
         wall = time.perf_counter() - t0
         for t in threads:
             t.join(timeout=30)
-    return stats, wall
+        comm_phases = dict(server.phase_seconds)
+    return stats, wall, comm_phases
 
 
 def _measure_local_steps(trainer, model_cfg, batch_size, steps, warmup) -> float:
@@ -1044,12 +1212,16 @@ def main() -> None:
             # parsers keep reading the same metric, and it carries the
             # federated MFUs as machine-parsed fields. BENCH_SECONDARY=0
             # restores the single-line behavior.
-            rec_fed2 = rec_fedseq = rec_ctrl = None
+            rec_fed2 = rec_fedseq = rec_ctrl = rec_resid = None
             if os.environ.get("BENCH_SECONDARY", "1").lower() not in (
                 "", "0", "false",
             ):
                 rec_fed2 = bench_fed2()
                 rec_fedseq = bench_fedseq()
+                if os.environ.get(
+                    "BENCH_FEDSEQ_DECOMP", "1"
+                ).lower() not in ("", "0", "false"):
+                    rec_resid = bench_fedseq_residual(rec_fed2, rec_fedseq)
                 bench_client_dp()
                 bench_serving()
                 rec_ctrl = bench_controller()
@@ -1057,6 +1229,19 @@ def main() -> None:
             for key, rec in (("fed2", rec_fed2), ("fedseq", rec_fedseq)):
                 if rec is not None and rec.get("mfu") is not None:
                     extra[f"{key}_mfu"] = rec["mfu"]
+            if rec_resid is not None:
+                # The fedseq residual decomposition as headline fields:
+                # the driver pins the 2.5-point fed2-vs-fedseq gap (and
+                # any closure) per round, machine-parsed.
+                extra["fedseq_residual_gap_ms"] = rec_resid["value"]
+                for part in (
+                    "hash_dropout_ms", "ring_merge_ms", "degenerate_ring_ms",
+                ):
+                    extra[f"fedseq_residual_{part}"] = rec_resid[part]
+                if "mfu_gap_points" in rec_resid:
+                    extra["fedseq_residual_mfu_points"] = rec_resid[
+                        "mfu_gap_points"
+                    ]
             if rec_ctrl is not None and rec_ctrl.get("metric") != "bench_error":
                 # Control-plane companions on the headline record: the
                 # driver's tail parser reads rounds/hour and the gate's
@@ -1065,6 +1250,36 @@ def main() -> None:
                 extra["controller_gate_rejections"] = rec_ctrl[
                     "gate_rejections"
                 ]
+                # comm_phase_* headline fields (obs round-phase
+                # accounting): ASSERTED present — a refactor that drops
+                # the round engine's phase accounting must fail the bench
+                # loudly, not silently stop tracking the breakdown.
+                missing = [
+                    k
+                    for k in (
+                        "comm_phase_wait_s",
+                        "comm_phase_agg_s",
+                        "comm_phase_reply_s",
+                    )
+                    if k not in rec_ctrl
+                ]
+                if missing:
+                    _emit(
+                        {
+                            "metric": "bench_error",
+                            "error": "comm_phase_fields_missing",
+                            "detail": f"controller record lacks {missing} "
+                            "(AggregationServer.phase_seconds accounting "
+                            "broken?)",
+                        }
+                    )
+                    raise SystemExit(3)
+                for k in (
+                    "comm_phase_wait_s",
+                    "comm_phase_agg_s",
+                    "comm_phase_reply_s",
+                ):
+                    extra[k] = rec_ctrl[k]
             broken = _check_mfu_floor(
                 {"fed2": rec_fed2, "fedseq": rec_fedseq}
             )

@@ -8,7 +8,12 @@ import os
 import numpy as np
 
 from ..utils.logging import get_logger, phase
-from .common import _load_clients, _resolve_with_pretrained, _write_reports
+from .common import (
+    _load_clients,
+    _obs_setup,
+    _resolve_with_pretrained,
+    _write_reports,
+)
 
 log = get_logger()
 
@@ -122,6 +127,9 @@ def cmd_serve(args) -> int:
             "[DP] --dp-clip without --dp-noise-multiplier clips uploads "
             "but adds NO noise: no (epsilon, delta) guarantee"
         )
+    tracer, _metrics = _obs_setup(
+        args, proc="server", metrics_host=args.host
+    )
     with AggregationServer(
         host=args.host,
         port=args.port,
@@ -138,6 +146,7 @@ def cmd_serve(args) -> int:
         secure_protocol=getattr(args, "secure_protocol", "double"),
         secure_threshold=getattr(args, "secure_threshold", None),
         dp_participation=dp_q,
+        tracer=tracer,
     ) as server:
         log.info(f"[SERVER] listening on {args.host}:{server.port}")
         server.serve(rounds=rounds)
@@ -195,6 +204,9 @@ def cmd_client(args) -> int:
 
     import jax
 
+    client_tracer, _metrics = _obs_setup(
+        args, proc=f"client-{args.client_id}", cfg=cfg, install_global=False
+    )
     fed = FederatedClient(
         args.host, args.port, client_id=args.client_id,
         timeout=args.timeout, compression=args.compression,
@@ -206,6 +218,7 @@ def cmd_client(args) -> int:
         min_participants=getattr(args, "min_participants", None),
         secure_protocol=getattr(args, "secure_protocol", "double"),
         secure_threshold=getattr(args, "secure_threshold", None),
+        tracer=client_tracer,
     )
     rounds = max(1, getattr(args, "rounds", None) or 1)
     local = agg_metrics = None
@@ -233,11 +246,22 @@ def cmd_client(args) -> int:
             if fed.dp
             else None
         )
-        with phase(f"client {args.client_id} round {r + 1}/{rounds} training", tag="TRAIN"):
+        import time as _time
+
+        t_local = _time.time()
+        with phase(
+            f"client {args.client_id} round {r + 1}/{rounds} training",
+            tag="TRAIN",
+        ) as tinfo:
             state, _ = trainer.fit(
                 state, client_data.train, batch_size=cfg.data.batch_size,
                 epoch_offset=r * E, tag=f"[CLIENT {args.client_id}] ",
             )
+        # Buffered until the exchange reveals the round's trace id —
+        # the span then lands with the server's (trace, round) identity.
+        fed.note_local_phase(
+            t_local, tinfo["seconds"], client=args.client_id
+        )
         local = trainer.evaluate_state(state, client_data.test)
         if ckpt is not None:
             # Post-train save — the reference's client1.py:388.

@@ -25,6 +25,62 @@ from ..utils.logging import get_logger, phase
 log = get_logger()
 
 
+# ------------------------------------------------------------- observability
+def _obs_setup(
+    args,
+    *,
+    proc: str,
+    cfg: ExperimentConfig | None = None,
+    install_global: bool = True,
+    metrics_host: str = "127.0.0.1",
+):
+    """One call per CLI process: build this process's span Tracer (from
+    --trace-jsonl, falling back to the config's obs.trace_jsonl), install
+    it as the global tracer (the mesh-tier trainers' fallback hook), and
+    start the /metrics endpoint when --metrics-port (or obs.metrics_port)
+    asks for one. Returns ``(tracer | None, metrics_server | None)``.
+
+    ``install_global=False`` (the TCP client): the round loop measures
+    its own client-local phase through FederatedClient.note_local_phase,
+    so the inner trainer's fallback hook must stay disarmed — a
+    --seq-parallel client's embedded fedseq trainer would otherwise emit
+    a SECOND client-local span per round and double the timeline's
+    compute attribution."""
+    from ..obs import Tracer, maybe_start_metrics_server, set_global_tracer
+    from ..obs.trace import set_run_id
+
+    obs_cfg = cfg.obs if cfg is not None else None
+    if obs_cfg is not None and obs_cfg.run_id:
+        # Pin BEFORE the first span/metrics record: every stream this
+        # process writes then carries the configured run identity.
+        set_run_id(obs_cfg.run_id)
+    trace_path = getattr(args, "trace_jsonl", None) or (
+        obs_cfg.trace_jsonl if obs_cfg else None
+    )
+    tracer = None
+    if trace_path:
+        tracer = Tracer(trace_path, proc=proc)
+        log.info(f"[OBS] {proc}: appending spans to {trace_path}")
+    # Unconditional: an invocation WITHOUT tracing must clear any tracer
+    # a previous in-process invocation installed (tests drive several CLI
+    # commands per process; a stale global tracer would keep appending to
+    # a dead path).
+    set_global_tracer(tracer if install_global else None)
+    port = getattr(args, "metrics_port", None) or (
+        obs_cfg.metrics_port if obs_cfg else 0
+    )
+    # The endpoint is unauthenticated: bind no wider than the tier
+    # itself (server commands pass their own --host; everything else
+    # stays loopback).
+    server = maybe_start_metrics_server(port, host=metrics_host)
+    if server is not None:
+        log.info(
+            f"[OBS] {proc}: Prometheus /metrics on "
+            f"{metrics_host}:{server.port}"
+        )
+    return tracer, server
+
+
 # ------------------------------------------------------------------ config
 def _preset_model(preset: str, vocab_size: int) -> ModelConfig:
     if preset == "tiny":

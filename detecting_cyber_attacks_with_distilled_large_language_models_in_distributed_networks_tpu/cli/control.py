@@ -22,6 +22,7 @@ from .comm import _auth_key, _server_client_keys
 from .common import (
     _load_client_splits,
     _load_clients,
+    _obs_setup,
     _resolve_with_pretrained,
 )
 
@@ -109,7 +110,10 @@ def cmd_controller(args) -> int:
             params, val, batch_size=cfg.data.eval_batch_size
         )
 
-    registry = ModelRegistry(args.registry_dir)
+    tracer, _metrics = _obs_setup(
+        args, proc="controller", cfg=cfg, metrics_host=args.host
+    )
+    registry = ModelRegistry(args.registry_dir, tracer=tracer)
     state_path = args.state_jsonl or os.path.join(
         args.registry_dir, "controller_state.jsonl"
     )
@@ -141,6 +145,7 @@ def cmd_controller(args) -> int:
         auth_key=_auth_key(),
         secure_agg=bool(getattr(args, "secure_agg", False)),
         client_keys=_server_client_keys(),
+        tracer=tracer,
     ) as server:
         controller = Controller(
             server,
@@ -150,6 +155,7 @@ def cmd_controller(args) -> int:
             state_path=state_path,
             drift_monitor=drift,
             model_config=cfg.model,
+            tracer=tracer,
         )
         max_rounds = args.rounds if args.rounds and args.rounds > 0 else None
         log.info(

@@ -10,6 +10,7 @@ import numpy as np
 from ..utils.logging import get_logger, phase
 from .common import (
     _load_client_splits,
+    _obs_setup,
     _resolve_with_pretrained,
     _write_reports,
 )
@@ -147,6 +148,10 @@ def cmd_federated(args) -> int:
         trainer = FedSeqTrainer(cfg, pad_id=tok.pad_id, mesh=mesh)
     else:
         trainer = FederatedTrainer(cfg, pad_id=tok.pad_id, mesh=mesh)
+    # Obs spans for the mesh tier: per-round client-local / agg phase
+    # timers land on this process's events-JSONL (no wire here — the
+    # round boundary is a collective, so one proc covers the fleet).
+    trainer.tracer, _metrics = _obs_setup(args, proc="fed", cfg=cfg)
 
     ckpt = None
     start_round = 0

@@ -1,0 +1,65 @@
+"""fedtpu obs — merge per-process span JSONLs into round timelines.
+
+The read side of the obs/ subsystem: every tier (server, clients,
+controller, registry, infer-serve) appends spans to its own events-JSONL
+(``--trace-jsonl``); this command merges them on the shared
+(trace, round) identity and answers "where did round N's wall-clock go".
+
+    fedtpu obs timeline --trace-dir runs/obs
+    fedtpu obs timeline --trace server.jsonl --trace client0.jsonl --json
+    fedtpu obs export --trace-dir runs/obs --out trace.json
+        # load trace.json in chrome://tracing or ui.perfetto.dev
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from ..obs import (
+    export_chrome_trace,
+    load_spans,
+    round_summaries,
+    timeline_table,
+)
+
+
+def cmd_obs(args) -> int:
+    paths = list(getattr(args, "trace", None) or [])
+    trace_dir = getattr(args, "trace_dir", None)
+    if not paths and not trace_dir:
+        raise SystemExit(
+            "fedtpu obs needs span inputs: --trace-dir DIR (merges every "
+            "*.jsonl) and/or --trace FILE (repeatable)"
+        )
+    spans = load_spans(paths, trace_dir=trace_dir)
+    if not spans:
+        raise SystemExit(
+            "no obs spans found (are these files written by --trace-jsonl "
+            "/ obs.trace.Tracer? metrics-JSONL streams are a different "
+            "schema)"
+        )
+    if args.action == "export":
+        out = getattr(args, "out", None)
+        if not out:
+            raise SystemExit("obs export needs --out <chrome_trace.json>")
+        path = export_chrome_trace(spans, out)
+        print(
+            f"wrote {path} ({len(spans)} spans; load in chrome://tracing "
+            "or ui.perfetto.dev)"
+        )
+        return 0
+    if args.action == "timeline":
+        round_filter = getattr(args, "round", None)
+        if getattr(args, "json", False):
+            rounds = round_summaries(spans)
+            if round_filter is not None:
+                rounds = [r for r in rounds if r["round"] == round_filter]
+            json.dump(rounds, sys.stdout, indent=2)
+            sys.stdout.write("\n")
+        else:
+            sys.stdout.write(
+                timeline_table(spans, round_filter=round_filter)
+            )
+        return 0
+    raise SystemExit(f"unknown obs action {args.action!r}")

@@ -47,6 +47,7 @@ from .control import cmd_controller, cmd_registry
 from .distill import cmd_distill
 from .federated import cmd_federated
 from .local import cmd_local
+from .obs import cmd_obs
 from .predict import cmd_export_hf, cmd_predict
 from .serving import cmd_infer_serve
 
@@ -174,6 +175,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         help="append one structured JSON record per (round, client, phase) "
         "here — machine-readable observability the reference's prints/CSVs "
         "lack (pd.read_json(..., lines=True))",
+    )
+    p.add_argument(
+        "--trace-jsonl",
+        help="append obs spans (round/client-local/wire/agg/... with the "
+        "round's shared trace id) to this events-JSONL; give every "
+        "process its own file and merge with `fedtpu obs timeline "
+        "--trace-dir DIR`",
     )
 
 
@@ -370,6 +378,20 @@ def build_parser() -> argparse.ArgumentParser:
         "q < 1 buys privacy amplification — the banner's subsampled "
         "accountant is exact for this sampler",
     )
+    p.add_argument(
+        "--trace-jsonl",
+        help="append obs spans (round/agg/wire-reply with each round's "
+        "trace id, also stamped into every reply meta) to this "
+        "events-JSONL; merge with `fedtpu obs timeline --trace-dir`",
+    )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=0,
+        help="expose live counters/gauges (rounds, uploads, wire bytes, "
+        "per-phase seconds) at http://HOST:PORT/metrics in Prometheus "
+        "text format (0 = off, the default)",
+    )
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
@@ -553,6 +575,14 @@ def build_parser() -> argparse.ArgumentParser:
         default=0.5,
         help="P(attack) decision threshold in replies (default 0.5)",
     )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=0,
+        help="expose live gauges/counters (queue depth, rejects by kind, "
+        "scored total, queue-wait histogram) at http://HOST:PORT/metrics "
+        "in Prometheus text format (0 = off, the default)",
+    )
     p.set_defaults(fn=cmd_infer_serve)
 
     p = sub.add_parser(
@@ -655,7 +685,48 @@ def build_parser() -> argparse.ArgumentParser:
         help="per-round straggler deadline in seconds handed to the round "
         "engine (default: the server --timeout)",
     )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=0,
+        help="expose live counters (rounds, promotions, gate rejections, "
+        "round-phase seconds) at http://HOST:PORT/metrics in Prometheus "
+        "text format (0 = off, the default)",
+    )
     p.set_defaults(fn=cmd_controller)
+
+    p = sub.add_parser(
+        "obs",
+        help="observability: merge per-process span JSONLs into a "
+        "per-round timeline table or a Chrome trace-event export",
+        epilog="Every tier writes spans with --trace-jsonl; the server "
+        "stamps one trace id per round into its replies, so the merged "
+        "files agree on (trace, round). `timeline` attributes each "
+        "round's wall-clock to per-client compute / straggler wait / "
+        "wire / agg; `export` writes chrome://tracing JSON.",
+    )
+    p.add_argument("action", choices=["timeline", "export"])
+    p.add_argument(
+        "--trace-dir",
+        help="directory of span JSONLs (every *.jsonl is merged)",
+    )
+    p.add_argument(
+        "--trace",
+        action="append",
+        metavar="FILE",
+        help="individual span JSONL (repeatable; composes with "
+        "--trace-dir)",
+    )
+    p.add_argument(
+        "--round", type=int, default=None, help="only this round (timeline)"
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="timeline as machine-readable JSON instead of the table",
+    )
+    p.add_argument("--out", help="output path (export)")
+    p.set_defaults(fn=cmd_obs)
 
     p = sub.add_parser(
         "registry",

@@ -13,7 +13,7 @@ from __future__ import annotations
 import time
 
 from ..utils.logging import get_logger
-from .common import _resolve_with_pretrained
+from .common import _obs_setup, _resolve_with_pretrained
 
 log = get_logger()
 
@@ -142,6 +142,9 @@ def cmd_infer_serve(args) -> int:
         max_queue=args.max_queue,
         gather_window_s=args.max_wait_ms / 1e3,
     )
+    tracer, _metrics = _obs_setup(
+        args, proc="serve", cfg=cfg, metrics_host=args.host
+    )
     server = ScoringServer(
         engine,
         tok,
@@ -161,6 +164,7 @@ def cmd_infer_serve(args) -> int:
         # The drift contract: serving-score histograms and the promoted
         # artifact's eval reference must bin identically (ControlConfig).
         score_bins=cfg.control.score_bins,
+        tracer=tracer,
     )
     reload_src = (
         "registry pointer"

@@ -83,6 +83,7 @@ class FederatedClient:
         min_participants: int | None = None,
         secure_protocol: str = "double",
         secure_threshold: int | None = None,
+        tracer=None,
     ):
         if client_key is not None and auth_key is None:
             raise ValueError(
@@ -213,6 +214,16 @@ class FederatedClient:
         self._gave_up_delta = False
         self._dense_rounds_since_giveup = 0
         self._probe_this_round = False
+        # Observability (obs/trace.py): the server mints one trace id per
+        # round and stamps it into the reply meta; this client's spans
+        # (wire-upload/wire-reply plus any caller-noted client-local
+        # phase) are written only once the reply reveals that identity,
+        # so both sides of the wire share (trace, round). A server
+        # without tracing simply omits the field — spans then carry no
+        # trace id but the exchange is unchanged (old-peer interop).
+        self.tracer = tracer
+        self.last_trace: tuple[str | None, int | None] = (None, None)
+        self._pending_spans: list[tuple[str, float, float, dict]] = []
         if secure_agg and auth_key is None:
             log.warning(
                 f"[CLIENT {client_id}] --secure-agg without an auth key "
@@ -632,7 +643,21 @@ class FederatedClient:
                         f"(attempt {attempt}/{max_retries})"
                     )
                     sparse_in_flight = delta_flat is not None
+                    t_up_unix = time.time()
+                    t_up0 = time.monotonic()
                     framing.send_frame(sock, msg)
+                    upload_timing = (
+                        t_up_unix, time.monotonic() - t_up0, len(msg),
+                    )
+                else:
+                    upload_timing = None
+                # The reply window spans from here to the final reply
+                # frame (through any unmask/reveal sub-rounds): from the
+                # client's clock it covers straggler wait + server agg +
+                # the reply transfer — the obs timeline subtracts the
+                # server's measured agg/reply spans to isolate the wait.
+                t_rep_unix = time.time()
+                t_rep0 = time.monotonic()
                 reply = framing.recv_frame(sock)
                 if (
                     self.secure_agg
@@ -688,6 +713,9 @@ class FederatedClient:
                         ),
                     )
                     reply = framing.recv_frame(sock)
+                reply_timing = (
+                    t_rep_unix, time.monotonic() - t_rep0, len(reply),
+                )
                 agg, agg_meta = wire.decode(reply, auth_key=self.auth_key)
                 if self.auth_key is not None and (
                     agg_meta.get("role") != "server"
@@ -697,6 +725,7 @@ class FederatedClient:
                         "aggregated reply failed the freshness check "
                         "(stale nonce or wrong role) — possible replay"
                     )
+                self._flush_spans(agg_meta, upload_timing, reply_timing)
                 if self.secure_agg and this_call is not None:
                     # Round complete: drop this round's (and any older)
                     # per-round keypair/share state — _used_rounds already
@@ -823,6 +852,64 @@ class FederatedClient:
         raise ConnectionError(
             f"client {self.client_id}: round failed after {max_retries} attempts: {last}"
         )
+
+    # ------------------------------------------------------ observability
+    def note_local_phase(
+        self, t_start: float, dur_s: float, **attrs
+    ) -> None:
+        """Buffer a ``client-local`` span measured by the caller (the CLI
+        round loop times local training BEFORE the exchange). It is
+        written on the next successful exchange, once the reply meta
+        reveals the round's trace id — the identity a client cannot know
+        while it is still training."""
+        self._pending_spans.append(
+            ("client-local", float(t_start), float(dur_s), dict(attrs))
+        )
+
+    def _flush_spans(
+        self,
+        agg_meta: Mapping[str, Any],
+        upload: tuple[float, float, int] | None,
+        reply: tuple[float, float, int] | None,
+    ) -> None:
+        """Adopt the reply's (trace, round) identity and write this
+        round's spans: buffered client-local phases first (they happened
+        first), then wire-upload and wire-reply."""
+        trace = agg_meta.get("trace")
+        rnd = agg_meta.get("agg_round")
+        try:
+            rnd = int(rnd) if rnd is not None else None
+        except (TypeError, ValueError):
+            rnd = None
+        self.last_trace = (trace if isinstance(trace, str) else None, rnd)
+        trace = self.last_trace[0]
+        if self.tracer is None:
+            self._pending_spans.clear()
+            return
+        for name, t_start, dur_s, attrs in self._pending_spans:
+            self.tracer.record(
+                name, t_start=t_start, dur_s=dur_s, trace=trace,
+                round=rnd, **attrs,
+            )
+        self._pending_spans.clear()
+        if upload is not None:
+            self.tracer.record(
+                "wire-upload",
+                t_start=upload[0],
+                dur_s=upload[1],
+                trace=trace,
+                round=rnd,
+                bytes=upload[2],
+            )
+        if reply is not None:
+            self.tracer.record(
+                "wire-reply",
+                t_start=reply[0],
+                dur_s=reply[1],
+                trace=trace,
+                round=rnd,
+                bytes=reply[2],
+            )
 
     # ------------------------------------------------- sparse round deltas
     def _prepare_topk_upload(

@@ -32,6 +32,8 @@ from typing import Any
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
+from ..obs.trace import new_trace_id
 from ..utils.logging import get_logger
 from . import framing, secure, wire
 
@@ -74,6 +76,9 @@ class _Round:
 
     expected: int
     round_no: int = 0
+    #: Round-scoped trace id (obs/trace.py), minted by serve_round and
+    #: stamped into every reply's meta so clients adopt the same identity.
+    trace: str = ""
     models: dict[int, dict] = field(default_factory=dict)  # client_id -> flat params
     # Sparse-delta uploads (topk clients): flat params holds the DENSIFIED
     # round delta; the absolute model is base + delta at aggregation time.
@@ -146,6 +151,7 @@ class AggregationServer:
         secure_threshold: int | None = None,
         dp_participation: float = 1.0,
         dp_resync_rounds: int = 8,
+        tracer=None,
     ):
         if client_keys is not None and auth_key is None:
             raise ValueError(
@@ -297,6 +303,46 @@ class AggregationServer:
         self._sock.settimeout(timeout)
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
+        # Observability (obs/): optional span tracer + always-on cheap
+        # phase accounting. phase_seconds accumulates where each round's
+        # wall went — wait (accept + straggler + upload wire), agg
+        # (aggregation compute), reply (fan-out) — the measured comm/
+        # compute breakdown bench.py's comm_phase_* headline fields and
+        # the /metrics endpoint report. last_trace is the most recent
+        # round's (trace id, round index) for callers (the controller)
+        # that stamp their own follow-on spans with the round's identity.
+        self.tracer = tracer
+        self.phase_seconds = {"wait": 0.0, "agg": 0.0, "reply": 0.0}
+        self.last_trace: tuple[str, int] | None = None
+        m = obs_metrics.default_registry()
+        self._m_rounds = m.counter(
+            "fedtpu_server_rounds_total",
+            help="aggregation rounds started",
+        )
+        self._m_round_failures = m.counter(
+            "fedtpu_server_round_failures_total",
+            help="rounds that raised (quorum miss, deadline, bad uploads)",
+        )
+        self._m_uploads = m.counter(
+            "fedtpu_server_uploads_total",
+            help="client model uploads accepted into a round",
+        )
+        self._m_bytes_in = m.counter(
+            "fedtpu_server_wire_bytes_received_total",
+            help="model upload payload bytes received",
+        )
+        self._m_bytes_out = m.counter(
+            "fedtpu_server_wire_bytes_sent_total",
+            help="aggregate reply payload bytes sent",
+        )
+        self._m_phase = {
+            p: m.counter(
+                "fedtpu_server_round_phase_seconds_total",
+                help="round wall-clock by phase (wait|agg|reply)",
+                labels={"phase": p},
+            )
+            for p in ("wait", "agg", "reply")
+        }
 
     # ------------------------------------------------------------- lifecycle
     def close(self) -> None:
@@ -573,6 +619,7 @@ class AggregationServer:
                     ):
                         return
             payload = framing.recv_frame(conn)
+            self._m_bytes_in.inc(float(len(payload)))
             flat, meta = wire.decode(payload, auth_key=self.auth_key)
             if self.auth_key is not None and (
                 meta.get("role") != "client" or meta.get("nonce") != nonce_hex
@@ -710,6 +757,7 @@ class AggregationServer:
                 if nonce_hex is not None:
                     rnd.nonces[client_id] = nonce_hex
                 done = self._round_done(rnd)
+            self._m_uploads.inc()
             log.info(
                 f"[SERVER] received model from client {client_id} "
                 f"({len(rnd.models)}/{rnd.expected})"
@@ -1096,6 +1144,7 @@ class AggregationServer:
                         },
                         {
                             "agg_round": rnd.round_no,
+                            "trace": rnd.trace,
                             "dp_reply": "resync",
                             "dp_resync_rounds": len(entries),
                         },
@@ -1143,6 +1192,16 @@ class AggregationServer:
             round_no=self._round_counter if round_index is None else round_index,
         )
         self._round_counter = rnd.round_no + 1
+        # Round trace identity (obs/): minted here, stamped into every
+        # reply's meta — clients adopt it for their own spans, so the
+        # obs timeline can correlate both sides of the wire. Old clients
+        # simply ignore the extra meta key (free-form JSON).
+        rnd.trace = new_trace_id()
+        self.last_trace = (rnd.trace, rnd.round_no)
+        self._m_rounds.inc()
+        t_round_unix = time.time()
+        t_round0 = time.monotonic()
+        wait_s = 0.0
         if self.dp_clip > 0.0 and self.dp_participation < 1.0:
             # Per-round Poisson cohort from OS entropy: each registered
             # client independently with probability q — exactly the
@@ -1223,6 +1282,11 @@ class AggregationServer:
             for t in threads:
                 t.join(timeout=max(0.1, deadline - time.monotonic()))
 
+        # Everything up to here — accept loop, straggler wait, upload
+        # reads — is the round's "wait" phase; aggregation compute and
+        # the reply fan-out are timed separately below.
+        wait_s = time.monotonic() - t_round0
+
         with rnd.lock:
             rnd.closed = True
             models = dict(rnd.models)
@@ -1235,6 +1299,8 @@ class AggregationServer:
         # Failure cleanup must cover every registered connection,
         # contributors and sitting-out clients alike.
         all_conns = {**skip_conns, **conns}
+        t_agg_unix = time.time()
+        t_agg0 = time.monotonic()
         try:
             if rnd.cohort is not None and len(rnd.cohort) == 0:
                 # Empty Poisson cohort: a clean no-op round. No model is
@@ -1253,12 +1319,17 @@ class AggregationServer:
                                 "round_clients": [],
                                 "agg_round": rnd.round_no,
                                 "dp_reply": "noop",
+                                "trace": rnd.trace,
                             },
                             nonces.get(cid),
                         )
                         for cid in skip_conns
                     },
                     skip_conns,
+                )
+                self._finish_round(
+                    rnd, t_round_unix, t_round0, wait_s,
+                    time.monotonic() - t_agg0, 0.0,
                 )
                 return None
             quorum = self._round_quorum(rnd.cohort)
@@ -1518,6 +1589,7 @@ class AggregationServer:
                 )
                 reply_meta = {
                     "agg_round": rnd.round_no,
+                    "trace": rnd.trace,
                     "dp_reply": "delta",
                     # The base this delta applies to. A receiver whose own
                     # base differs (a STALE client sitting a sampled round
@@ -1607,6 +1679,7 @@ class AggregationServer:
                 reply_meta = {
                     "round_clients": ids,
                     "agg_round": rnd.round_no,
+                    "trace": rnd.trace,
                 }
                 if rnd.wants_delta and not self.secure_agg:
                     reply_meta["agg_crc"] = wire.flat_crc32(agg)
@@ -1660,9 +1733,68 @@ class AggregationServer:
             # until their timeouts — drop every connection so they fail fast.
             for c in all_conns.values():
                 c.close()
+            self._finish_round(
+                rnd, t_round_unix, t_round0, wait_s,
+                time.monotonic() - t_agg0, 0.0, failed=True,
+            )
             raise
+        agg_s = time.monotonic() - t_agg0
+        if self.tracer is not None:
+            self.tracer.record(
+                "agg",
+                t_start=t_agg_unix,
+                dur_s=agg_s,
+                trace=rnd.trace,
+                round=rnd.round_no,
+                clients=len(models),
+            )
+        t_rep_unix = time.time()
+        t_rep0 = time.monotonic()
         self._reply_all(replies, all_conns)
+        reply_s = time.monotonic() - t_rep0
+        self._m_bytes_out.inc(float(sum(len(b) for b in replies.values())))
+        if self.tracer is not None:
+            self.tracer.record(
+                "wire-reply",
+                t_start=t_rep_unix,
+                dur_s=reply_s,
+                trace=rnd.trace,
+                round=rnd.round_no,
+                replies=len(replies),
+            )
+        self._finish_round(
+            rnd, t_round_unix, t_round0, wait_s, agg_s, reply_s
+        )
         return agg
+
+    def _finish_round(
+        self,
+        rnd: _Round,
+        t_unix: float,
+        t0: float,
+        wait_s: float,
+        agg_s: float,
+        reply_s: float,
+        *,
+        failed: bool = False,
+    ) -> None:
+        """Close a round's observability: accumulate the wait/agg/reply
+        phase seconds (process totals AND /metrics counters) and emit the
+        round span."""
+        for name, dur in (("wait", wait_s), ("agg", agg_s), ("reply", reply_s)):
+            self.phase_seconds[name] += dur
+            self._m_phase[name].inc(max(dur, 0.0))
+        if failed:
+            self._m_round_failures.inc()
+        if self.tracer is not None:
+            self.tracer.record(
+                "round",
+                t_start=t_unix,
+                dur_s=time.monotonic() - t0,
+                trace=rnd.trace,
+                round=rnd.round_no,
+                failed=True if failed else None,
+            )
 
     def _encode_reply(self, agg: dict, meta: dict, nonce: str | None) -> bytes:
         """One reply blob, auth-aware (echoes the client's nonce with

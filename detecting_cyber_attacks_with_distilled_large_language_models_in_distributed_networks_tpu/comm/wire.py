@@ -12,6 +12,13 @@ nbytes}`` plus a payload CRC-32 and a free-form JSON ``meta`` (client id,
 round, sample count). Tensor keys are '/'-joined paths through the nested
 params dict, so decode rebuilds the pytree with no embedded type tags.
 
+One optional meta field is a cross-cutting contract rather than a caller
+convention: ``meta["trace"]`` (obs/trace.py TRACE_META_KEY) carries the
+server-minted round trace id in every aggregate reply, giving both ends
+of a round the shared span identity the ``fedtpu obs`` timeline merges
+on. It is plain meta — peers that omit or ignore it interop unchanged,
+so tracing deploys one process at a time.
+
 Optional ``compression="bf16"`` packs float32 tensors to bfloat16 via the
 native fedwire library (comm/native.py) — a 2x cut that matches TPU compute
 precision, instead of the reference's ~11 s/round byte-level gzip.

@@ -506,6 +506,35 @@ class ControlConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Observability (obs/): cross-tier round tracing + /metrics.
+
+    The reference's observability is timestamped prints and one-row CSVs
+    (SURVEY.md §5). These knobs configure the structured upgrade; the
+    matching CLI flags (``--trace-jsonl``, ``--metrics-port``) override
+    per process.
+    """
+
+    #: Span events-JSONL path for THIS process (obs.trace.Tracer). None
+    #: (default) = tracing off. Give every process its own file; `fedtpu
+    #: obs timeline --trace-dir` merges a directory of them.
+    trace_jsonl: str | None = None
+    #: Prometheus text endpoint port (stdlib HTTP, GET /metrics). 0
+    #: (default) = off — the endpoint binds nothing unless asked.
+    metrics_port: int = 0
+    #: Run identity stamped on every span and metrics record. None =
+    #: FEDTPU_RUN_ID env var, else a fresh per-process id.
+    run_id: str | None = None
+
+    def __post_init__(self) -> None:
+        if not 0 <= self.metrics_port <= 65535:
+            raise ValueError(
+                f"metrics_port={self.metrics_port} must be a port in "
+                "[0, 65535] (0 = off)"
+            )
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout.
 
@@ -543,6 +572,7 @@ class ExperimentConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     distill: DistillConfig = field(default_factory=DistillConfig)
     control: ControlConfig = field(default_factory=ControlConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
     output_dir: str = "outputs"
     checkpoint_dir: str | None = None
 
@@ -585,6 +615,7 @@ class ExperimentConfig:
             "mesh": MeshConfig,
             "distill": DistillConfig,
             "control": ControlConfig,
+            "obs": ObsConfig,
         }
         scalars = ("output_dir", "checkpoint_dir")
         unknown_top = set(d) - set(sections) - set(scalars)

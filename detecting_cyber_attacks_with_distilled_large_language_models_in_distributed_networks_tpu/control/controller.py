@@ -37,6 +37,7 @@ import numpy as np
 
 from ..comm import wire
 from ..config import ControlConfig
+from ..obs import metrics as obs_metrics
 from ..registry import ModelRegistry, RegistryError
 from ..train.fedeval import eval_gate, reference_histogram
 from ..utils.logging import get_logger
@@ -86,6 +87,7 @@ class Controller:
         drift_monitor: DriftMonitor | None = None,
         model_config: Any | None = None,
         drift_poll_s: float = 1.0,
+        tracer=None,
     ):
         if getattr(server, "dp_clip", 0.0) > 0.0:
             raise ValueError(
@@ -102,6 +104,28 @@ class Controller:
         self.model_config = model_config
         self.drift_poll_s = float(drift_poll_s)
         self.stats = ControllerStats()
+        # Observability (obs/): spans stamped with the round engine's
+        # (trace, round) — server.last_trace after each serve_round — so
+        # the obs timeline shows eval-gate/promote time next to the
+        # round's compute/wait/wire phases; counters feed /metrics.
+        self.tracer = tracer
+        m = obs_metrics.default_registry()
+        self._m_rounds = m.counter(
+            "fedtpu_controller_rounds_total",
+            help="controller cycles attempted",
+        )
+        self._m_promotions = m.counter(
+            "fedtpu_controller_promotions_total",
+            help="candidates promoted to serving",
+        )
+        self._m_gate_rejections = m.counter(
+            "fedtpu_controller_gate_rejections_total",
+            help="candidates rejected by the eval gate",
+        )
+        self._m_drift_triggers = m.counter(
+            "fedtpu_controller_drift_triggers_total",
+            help="rounds triggered by the drift monitor",
+        )
         self._next_round = 0
         self._last_round_start: float | None = None
         if state_path:
@@ -212,6 +236,7 @@ class Controller:
             verdict = self.drift.poll()
             if verdict is not None:
                 self.stats.drift_triggers += 1
+                self._m_drift_triggers.inc()
                 self._record("drift_trigger", **verdict)
                 return "drift"
             if (
@@ -231,6 +256,7 @@ class Controller:
         self._next_round += 1
         self._last_round_start = time.monotonic()
         self.stats.rounds_attempted += 1
+        self._m_rounds.inc()
         log.info(f"[CONTROLLER] round {r} starting (trigger: {trigger})")
         try:
             t0 = time.monotonic()
@@ -278,7 +304,12 @@ class Controller:
         self, r: int, trigger: str, agg: dict, *, t_end: float, round_wall: float
     ) -> dict:
         c = self.control
+        # The round engine's (trace, round) identity for this cycle's
+        # follow-on spans (server.last_trace is set by serve_round).
+        trace, _ = getattr(self.server, "last_trace", None) or (None, None)
         nested = wire.unflatten_params(agg)
+        t_gate_unix = time.time()
+        t_gate0 = time.monotonic()
         metrics = dict(self.eval_fn(nested))
         probs = metrics.pop("probs", None)
         metrics.pop("labels", None)
@@ -314,6 +345,16 @@ class Controller:
             metric=c.gate_metric,
             min_delta=c.gate_min_delta,
         )
+        if self.tracer is not None:
+            self.tracer.record(
+                "eval-gate",
+                t_start=t_gate_unix,
+                dur_s=time.monotonic() - t_gate0,
+                trace=trace,
+                round=r,
+                artifact=aid,
+                passed=bool(ok),
+            )
         rec: dict[str, Any] = {
             "round": r,
             "trigger": trigger,
@@ -331,6 +372,7 @@ class Controller:
             # Regression: reject; the serving pointer stays on the
             # incumbent (the rollback IS the refusal to move it).
             self.stats.gate_rejections += 1
+            self._m_gate_rejections.inc()
             self.registry.reject(aid, reason=reason)
             rec["incumbent"] = incumbent["id"] if incumbent else None
             self._record("gate_rejected", **rec)
@@ -340,6 +382,8 @@ class Controller:
                 + (f" ({rec['incumbent']})" if rec["incumbent"] else "")
             )
             return {"event": "gate_rejected", **rec}
+        t_pro_unix = time.time()
+        t_pro0 = time.monotonic()
         try:
             self.registry.promote(aid, to="shadow")
             self.registry.promote(aid, to="serving")
@@ -349,8 +393,18 @@ class Controller:
             rec["note"] = str(e)
             self._record("promote_noop", **rec)
             return {"event": "promote_noop", **rec}
+        if self.tracer is not None:
+            self.tracer.record(
+                "promote",
+                t_start=t_pro_unix,
+                dur_s=time.monotonic() - t_pro0,
+                trace=trace,
+                round=r,
+                artifact=aid,
+            )
         latency = time.monotonic() - t_end
         self.stats.promotions += 1
+        self._m_promotions.inc()
         self.stats.promotion_latency_s.append(latency)
         rec["promotion_latency_s"] = round(latency, 4)
         if self.drift is not None and eval_hist is not None:

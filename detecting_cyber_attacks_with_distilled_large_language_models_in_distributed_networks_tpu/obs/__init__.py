@@ -1,0 +1,42 @@
+"""Cross-tier observability: round tracing, metrics registry, timelines.
+
+Three pieces (see each module's docstring):
+
+* :mod:`.trace` — round-scoped trace contexts with span ids propagated
+  across the TCP wire protocols via an optional meta field; every
+  process appends spans to a unified events-JSONL.
+* :mod:`.metrics` — in-process counters/gauges/histograms exposed over a
+  stdlib-HTTP ``/metrics`` endpoint in Prometheus text format.
+* :mod:`.timeline` — the ``fedtpu obs`` merge/analysis layer: per-round
+  timeline tables and Chrome trace-event export.
+"""
+
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    MetricsServer,
+    default_registry,
+    maybe_start_metrics_server,
+)
+from .timeline import (  # noqa: F401
+    chrome_trace,
+    export_chrome_trace,
+    group_rounds,
+    load_spans,
+    round_breakdown,
+    round_summaries,
+    timeline_table,
+)
+from .trace import (  # noqa: F401
+    SCHEMA,
+    SPAN_NAMES,
+    TRACE_META_KEY,
+    Tracer,
+    get_global_tracer,
+    get_run_id,
+    maybe_span,
+    new_trace_id,
+    set_global_tracer,
+)

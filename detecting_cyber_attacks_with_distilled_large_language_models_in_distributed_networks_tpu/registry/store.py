@@ -88,11 +88,17 @@ def artifact_id(params: Any) -> str:
 
 
 class ModelRegistry:
-    """Artifact store + promotion state machine + serving pointer."""
+    """Artifact store + promotion state machine + serving pointer.
 
-    def __init__(self, root: str):
+    ``tracer`` (obs/trace.py): promotion events double as ``promote``
+    spans — each state transition / pointer swap lands on the unified
+    events-JSONL with its measured duration, so the obs timeline shows
+    registry time next to round compute and the eval gate."""
+
+    def __init__(self, root: str, *, tracer=None):
         self.root = os.path.abspath(root)
         self._artifacts = os.path.join(self.root, "artifacts")
+        self.tracer = tracer
         os.makedirs(self._artifacts, exist_ok=True)
 
     # ---------------------------------------------------------------- events
@@ -100,6 +106,20 @@ class ModelRegistry:
         rec = {"ts": time.time(), "event": kind, **fields}
         with open(os.path.join(self.root, _EVENTS), "a") as f:
             f.write(json.dumps(rec) + "\n")
+
+    def _promote_span(
+        self, t_unix: float, t0: float, aid: str, state: str, round_index
+    ) -> None:
+        if self.tracer is None:
+            return
+        self.tracer.record(
+            "promote",
+            t_start=t_unix,
+            dur_s=time.monotonic() - t0,
+            round=round_index if isinstance(round_index, int) else None,
+            artifact=aid,
+            state=state,
+        )
 
     # --------------------------------------------------------------- writing
     def add(
@@ -244,6 +264,8 @@ class ModelRegistry:
         """Advance ``aid`` one rung up the ladder (or straight ``to`` a
         named rung). Reaching ``serving`` swaps the pointer atomically and
         retires the previous serving artifact. Returns the new manifest."""
+        t_unix = time.time()
+        t0 = time.monotonic()
         m = self.manifest(aid)
         cur = m.get("state", "candidate")
         if cur in ("rejected", "retired") and to is None:
@@ -264,6 +286,7 @@ class ModelRegistry:
             m = self._set_state(aid, to)
             self._event("promoted", artifact=aid, state=to)
             log.info(f"[REGISTRY] {aid}: {cur} -> {to}")
+            self._promote_span(t_unix, t0, aid, to, m.get("round"))
             return m
         prev = self.serving_info()
         prev_id = prev["artifact"] if prev else None
@@ -289,6 +312,7 @@ class ModelRegistry:
             f"[REGISTRY] serving pointer -> {aid} (round {m.get('round')})"
             + (f", retired {prev_id}" if prev_id else "")
         )
+        self._promote_span(t_unix, t0, aid, "serving", m.get("round"))
         return m
 
     def reject(self, aid: str, *, reason: str = "") -> dict:
@@ -303,6 +327,8 @@ class ModelRegistry:
     def rollback(self) -> dict:
         """Swap the pointer back to the previous serving artifact (one
         atomic step). The demoted artifact is marked retired."""
+        t_unix = time.time()
+        t0 = time.monotonic()
         cur = self.serving_info()
         if cur is None:
             raise RegistryError("nothing is serving; no rollback target")
@@ -330,6 +356,7 @@ class ModelRegistry:
         log.info(
             f"[REGISTRY] rollback: serving pointer {cur['artifact']} -> {target}"
         )
+        self._promote_span(t_unix, t0, target, "rollback", m.get("round"))
         return m
 
 

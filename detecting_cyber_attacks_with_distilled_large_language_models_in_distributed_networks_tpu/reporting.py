@@ -58,6 +58,11 @@ def load_metrics(filename: str) -> dict[str, float]:
     return {k: float(v) for k, v in row.items()}
 
 
+#: metrics-JSONL schema tag: lets `fedtpu obs` and the drift monitor
+#: merge streams (and reject foreign/obs-span lines) without guessing.
+METRICS_SCHEMA = "fedtpu-metrics-v1"
+
+
 def append_metrics_jsonl(path: str, record: Mapping[str, object]) -> None:
     """Append one structured metrics record as a JSON line.
 
@@ -69,9 +74,18 @@ def append_metrics_jsonl(path: str, record: Mapping[str, object]) -> None:
     EXCEPT short scalar lists (<= 64 entries, e.g. the serving tier's
     binned ``score_hist`` the drift monitor consumes), which are small by
     construction and stay machine-readable.
+
+    Concurrency contract: the whole line goes down in ONE ``os.write`` on
+    an ``O_APPEND`` descriptor (obs.trace.append_jsonl_line). The server
+    and serving tiers append from several threads; Python's buffered
+    ``open(path, "a").write`` can flush a long line in pieces, and two
+    writers' partial flushes interleave into unparseable garbage.
+    Every record also carries ``schema`` + ``run_id`` so downstream
+    mergers can group one run's streams.
     """
     import json
-    import time
+
+    from .obs.trace import append_jsonl_line, get_run_id
 
     def _short_scalar_list(v: object) -> list | None:
         if not isinstance(v, (list, tuple)) or len(v) > 64:
@@ -85,7 +99,6 @@ def append_metrics_jsonl(path: str, record: Mapping[str, object]) -> None:
             out.append(x)
         return out
 
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     clean = {}
     for k, v in record.items():
         if isinstance(v, (str, int, float, bool, np.generic)) or v is None:
@@ -94,9 +107,12 @@ def append_metrics_jsonl(path: str, record: Mapping[str, object]) -> None:
             lst = _short_scalar_list(v)
             if lst is not None:
                 clean[k] = lst
+    import time
+
     clean.setdefault("ts", time.time())
-    with open(path, "a") as f:
-        f.write(json.dumps(clean) + "\n")
+    clean.setdefault("schema", METRICS_SCHEMA)
+    clean.setdefault("run_id", get_run_id())
+    append_jsonl_line(path, json.dumps(clean))
 
 
 # ------------------------------------------------------------- curve math

@@ -41,6 +41,9 @@ class ScoreRequest:
     reply: Callable[..., None]
     reject: Callable[[int, str], None]
     deadline_s: float | None = None  # relative budget from t_enqueue
+    #: Optional obs trace id (obs/trace.py) the request carried; echoed
+    #: in the reply so a caller can correlate its spans with the batch's.
+    trace: str | None = None
     t_enqueue: float = field(default_factory=time.monotonic)
 
     def expired(self, now: float | None = None) -> bool:

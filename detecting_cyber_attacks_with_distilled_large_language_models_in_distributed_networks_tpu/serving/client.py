@@ -83,16 +83,22 @@ class ScoringClient:
         text: str | None = None,
         features: Mapping[str, Any] | None = None,
         deadline_ms: float | None = None,
+        trace: str | None = None,
     ) -> dict:
         """Score one flow; returns the reply dict (prob, prediction,
-        round, batch_size, bucket, queue_ms). Raises :class:`ScoreRejected`
-        on an explicit reject frame."""
+        round, batch_size, bucket, queue_ms — plus ``trace`` echoed when
+        the request carried one). Raises :class:`ScoreRejected` on an
+        explicit reject frame."""
         self._next_id += 1
         req_id = self._next_id
         framing.send_frame(
             self.sock,
             protocol.build_request(
-                req_id, text=text, features=features, deadline_ms=deadline_ms
+                req_id,
+                text=text,
+                features=features,
+                deadline_ms=deadline_ms,
+                trace=trace,
             ),
             await_ack=False,
         )

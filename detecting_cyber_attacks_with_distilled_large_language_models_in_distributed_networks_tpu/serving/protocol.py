@@ -65,12 +65,16 @@ def build_request(
     text: str | None = None,
     features: Mapping[str, Any] | None = None,
     deadline_ms: float | None = None,
+    trace: str | None = None,
 ) -> bytes:
     """One flow record to score: either the rendered template ``text`` or
     the raw ``features`` mapping (rendered server-side through the active
     dataset's template — the same bytes ``predict`` would feed). Exactly
     one of the two. ``deadline_ms`` is this request's latency budget;
-    past it the server answers with an explicit reject, never a hang."""
+    past it the server answers with an explicit reject, never a hang.
+    ``trace`` is the optional obs trace id (obs/trace.py) echoed in the
+    reply — a caller's distributed-tracing hook; old peers that omit it
+    (or servers that ignore it) interop unchanged."""
     if (text is None) == (features is None):
         raise ValueError("pass exactly one of text= or features=")
     body: dict[str, Any] = {"id": int(req_id)}
@@ -80,6 +84,8 @@ def build_request(
         body["features"] = dict(features)
     if deadline_ms is not None:
         body["deadline_ms"] = float(deadline_ms)
+    if trace is not None:
+        body["trace"] = str(trace)
     return _build(SCORE_REQ_MAGIC, body)
 
 
@@ -104,6 +110,8 @@ def parse_request(frame: bytes) -> dict:
         or isinstance(body["deadline_ms"], bool)
     ):
         raise WireError("scoring request deadline_ms must be a number")
+    if "trace" in body and not isinstance(body["trace"], str):
+        raise WireError("scoring request trace must be a string")
     return body
 
 
@@ -117,22 +125,24 @@ def build_reply(
     batch_size: int,
     bucket: int,
     queue_ms: float,
+    trace: str | None = None,
 ) -> bytes:
     """P(attack) + the per-request telemetry that makes the service
     observable from the client side alone: which model round answered,
-    how large the coalesced batch was, and how long the request queued."""
-    return _build(
-        SCORE_REP_MAGIC,
-        {
-            "id": int(req_id),
-            "prob": float(prob),
-            "prediction": int(float(prob) >= threshold),
-            "round": int(round_id),
-            "batch_size": int(batch_size),
-            "bucket": int(bucket),
-            "queue_ms": round(float(queue_ms), 3),
-        },
-    )
+    how large the coalesced batch was, and how long the request queued.
+    ``trace`` echoes the request's obs trace id when it carried one."""
+    body = {
+        "id": int(req_id),
+        "prob": float(prob),
+        "prediction": int(float(prob) >= threshold),
+        "round": int(round_id),
+        "batch_size": int(batch_size),
+        "bucket": int(bucket),
+        "queue_ms": round(float(queue_ms), 3),
+    }
+    if trace is not None:
+        body["trace"] = str(trace)
+    return _build(SCORE_REP_MAGIC, body)
 
 
 def parse_reply(frame: bytes) -> dict:

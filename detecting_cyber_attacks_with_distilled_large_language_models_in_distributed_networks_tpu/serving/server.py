@@ -40,6 +40,7 @@ import numpy as np
 from ..comm import framing
 from ..comm.wire import WireError
 from ..data.textualize import render_row
+from ..obs import metrics as obs_metrics
 from ..utils.logging import get_logger
 from . import protocol
 from .batcher import MicroBatcher, ScoreRequest
@@ -138,6 +139,7 @@ class ScoringServer:
         latency_window: int = 100_000,
         auth_key: bytes | None = None,
         score_bins: int = 10,
+        tracer=None,
     ):
         self.engine = engine
         self.tok = tokenizer
@@ -179,6 +181,40 @@ class ScoringServer:
         self._batch_hist: collections.Counter[int] = collections.Counter()
         self._latencies: collections.deque[float] = collections.deque(
             maxlen=latency_window
+        )
+        # Observability (obs/): optional serve-batch span tracer + the
+        # process gauge registry the /metrics endpoint renders. Queue
+        # depth and reject counts existed internally but never reached
+        # the exported surfaces; both land in stats(), the per-batch
+        # JSONL record, and the gauge registry now.
+        self.tracer = tracer
+        m = obs_metrics.default_registry()
+        self._g_queue = m.gauge(
+            "fedtpu_serve_queue_depth",
+            help="scoring requests waiting in the micro-batcher",
+        )
+        self._g_round = m.gauge(
+            "fedtpu_serve_model_round",
+            help="model round currently serving",
+        )
+        self._m_scored = m.counter(
+            "fedtpu_serve_scored_total", help="flows scored"
+        )
+        self._m_batches = m.counter(
+            "fedtpu_serve_batches_total", help="coalesced score dispatches"
+        )
+        self._m_rejects = {
+            kind: m.counter(
+                "fedtpu_serve_rejects_total",
+                help="explicit reject frames by kind",
+                labels={"kind": kind},
+            )
+            for kind in self._rejects
+        }
+        self._h_queue_ms = m.histogram(
+            "fedtpu_serve_queue_wait_seconds",
+            help="request queue wait before dispatch",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5, 1.0, 5.0),
         )
         self._t_start = time.monotonic()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -276,6 +312,8 @@ class ScoringServer:
             "batch_size_hist": hist,
             "score_hist": score_hist,
             "rejects": rejects,
+            "rejects_total": sum(rejects.values()),
+            "queue_depth": self.batcher.qsize(),
             "reloads": getattr(self.watcher, "reload_count", 0),
             "round": self.engine.round_id,
             "uptime_s": uptime,
@@ -328,6 +366,7 @@ class ScoringServer:
                     log.warning(f"[SERVE] dropping connection: {e}")
                     return
                 req_id = body["id"]  # parse_request pinned the type
+                req_trace = body.get("trace")
                 reject = self._make_reject(writer, req_id)
                 if "features" in body:
                     if self.spec is None:
@@ -362,9 +401,10 @@ class ScoringServer:
                     req_id=req_id,
                     input_ids=row_ids,
                     attention_mask=row_mask,
-                    reply=self._make_reply(writer, req_id),
+                    reply=self._make_reply(writer, req_id, req_trace),
                     reject=reject,
                     deadline_s=deadline_s,
+                    trace=req_trace,
                 )
                 if not self.batcher.submit(req):
                     self._count_reject("overloaded")
@@ -414,7 +454,9 @@ class ScoringServer:
             return False
         return True
 
-    def _make_reply(self, writer: _ConnWriter, req_id: int):
+    def _make_reply(
+        self, writer: _ConnWriter, req_id: int, trace: str | None = None
+    ):
         def _reply(*, prob, round_id, batch_size, bucket, queue_ms):
             writer.send(
                 protocol.build_reply(
@@ -425,6 +467,7 @@ class ScoringServer:
                     batch_size=batch_size,
                     bucket=bucket,
                     queue_ms=queue_ms,
+                    trace=trace,
                 )
             )
 
@@ -442,6 +485,7 @@ class ScoringServer:
     def _count_reject(self, kind: str) -> None:
         with self._stats_lock:
             self._rejects[kind] += 1
+        self._m_rejects[kind].inc()
 
     def _score_loop(self) -> None:
         while not self._closed.is_set():
@@ -501,12 +545,37 @@ class ScoringServer:
                 np.clip(np.asarray(probs[:n], np.float64), 0.0, 1.0),
                 bins=self._hist_edges,
             )
+            queue_depth = self.batcher.qsize()
             with self._stats_lock:
                 self._scored += n
                 self._batches += 1
                 self._batch_hist[n] += 1
                 self._score_hist += batch_hist
                 self._latencies.extend(done - r.t_enqueue for r in live)
+                rejects_total = sum(self._rejects.values())
+            self._m_scored.inc(n)
+            self._m_batches.inc()
+            self._g_queue.set(queue_depth)
+            self._g_round.set(round_id)
+            for r in live:
+                self._h_queue_ms.observe(now - r.t_enqueue)
+            if self.tracer is not None:
+                # One serve-batch span per coalesced dispatch; trace from
+                # the first traced request in the batch (a batch may mix
+                # traces — the per-request echo in each reply keeps the
+                # exact mapping).
+                trace = next(
+                    (r.trace for r in live if r.trace is not None), None
+                )
+                self.tracer.record(
+                    "serve-batch",
+                    t_start=time.time() - (done - now),
+                    dur_s=done - now,
+                    trace=trace,
+                    batch_size=n,
+                    bucket=bucket,
+                    round=round_id,
+                )
             if self.metrics_jsonl:
                 from ..reporting import append_metrics_jsonl
 
@@ -521,6 +590,8 @@ class ScoringServer:
                         "queue_ms_max": round(
                             max((now - r.t_enqueue) for r in live) * 1e3, 3
                         ),
+                        "queue_depth": queue_depth,
+                        "rejects_total": rejects_total,
                         "score_hist": batch_hist.tolist(),
                     },
                 )

@@ -23,6 +23,7 @@ fresh-Adam-per-run semantics (FedConfig.reset_optimizer_each_round).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Sequence
 
@@ -108,6 +109,11 @@ class FederatedTrainer:
         self.sh = FedShardings(self.mesh)
         self.model = DDoSClassifier(cfg.model)
         self.optimizer = make_optimizer(cfg.train)
+        # Observability (obs/trace.py): set by the CLI (or any caller) to
+        # emit per-round client-local/agg phase spans; None by default —
+        # the global tracer (set_global_tracer) is the fallback so
+        # embedded constructions need no plumbing.
+        self.tracer = None
         self._build_steps()
 
     # ---------------------------------------------------------- jitted steps
@@ -308,7 +314,38 @@ class FederatedTrainer:
         A :class:`StackedClients` input takes the ragged path: every
         client's full split trains each epoch (row-masked batches, gated
         updates); a plain :class:`TokenizedSplit` takes the dense path
-        (all clients share one row count)."""
+        (all clients share one row count).
+
+        Instrumented at THIS entry (not in run()): both round-loop owners
+        — run() and the CLI's own loop — emit one ``client-local`` obs
+        span per call, with the round derived from ``epoch_offset`` (the
+        loops pass ``r * epochs_per_round``)."""
+        t_unix = time.time()
+        t0 = time.monotonic()
+        out = self._fit_local_impl(
+            state,
+            stacked_train,
+            batch_size=batch_size,
+            epochs=epochs,
+            epoch_offset=epoch_offset,
+        )
+        self._trace_phase(
+            "client-local",
+            t_unix,
+            time.monotonic() - t0,
+            epoch_offset // max(self.cfg.train.epochs_per_round, 1),
+        )
+        return out
+
+    def _fit_local_impl(
+        self,
+        state: FedState,
+        stacked_train: TokenizedSplit | StackedClients,
+        *,
+        batch_size: int | None = None,
+        epochs: int | None = None,
+        epoch_offset: int = 0,
+    ) -> tuple[FedState, np.ndarray]:
         if isinstance(stacked_train, StackedClients):
             return self._fit_local_ragged(
                 state,
@@ -645,6 +682,30 @@ class FederatedTrainer:
 
         return allgather_hosts(value)
 
+    # ------------------------------------------------------- observability
+    def _trace_attrs(self) -> dict:
+        """Span attributes identifying this trainer's product path (the
+        3-axis fedseq subclass overrides with its seq layout)."""
+        return {"path": "fed2", "clients": self.C}
+
+    def _obs_tracer(self):
+        from ..obs.trace import get_global_tracer
+
+        return self.tracer if self.tracer is not None else get_global_tracer()
+
+    def _trace_phase(
+        self, name: str, t_start: float, dur_s: float, round_index: int
+    ) -> None:
+        tracer = self._obs_tracer()
+        if tracer is not None:
+            tracer.record(
+                name,
+                t_start=t_start,
+                dur_s=dur_s,
+                round=round_index,
+                **self._trace_attrs(),
+            )
+
     def evaluate_clients(
         self,
         stacked_params: Any,
@@ -752,7 +813,9 @@ class FederatedTrainer:
                 "this branch)"
             )
             return state
-        return self.aggregate(
+        t_unix = time.time()
+        t0 = time.monotonic()
+        state = self.aggregate(
             state,
             weights=weights,
             client_mask=mask,
@@ -760,6 +823,8 @@ class FederatedTrainer:
             round_index=round_index,
             enforce_min_fraction=not poisson,
         )
+        self._trace_phase("agg", t_unix, time.monotonic() - t0, round_index)
+        return state
 
     def round_anchor(self, state: FedState) -> Any | None:
         """Round-start params snapshot for DP and/or FedOpt aggregation —

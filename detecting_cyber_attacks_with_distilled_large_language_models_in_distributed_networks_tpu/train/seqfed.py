@@ -144,3 +144,16 @@ class FedSeqTrainer(FederatedTrainer):
                 f"batch_size={B} must divide over the data axis ({d})"
             )
         return super().fit_local(state, stacked_train, **kw)
+
+    def _trace_attrs(self) -> dict:
+        """Obs span attributes: the 3-axis product path's layout — seq
+        shard count and ring chunk size — so a merged timeline can
+        attribute fedseq rounds to their ring configuration (the
+        fedseq-MFU-residual instrument rides the same identity in
+        bench.py's decomposition fields)."""
+        return {
+            "path": "fedseq",
+            "clients": self.C,
+            "seq": self.cfg.mesh.seq,
+            "ring_chunk": self.cfg.model.max_len // self.cfg.mesh.seq,
+        }

@@ -113,15 +113,29 @@ def test_federated_flow_writes_artifacts_and_checkpoints(tmp_path, eight_devices
     out = tmp_path / "out"
     ckpt = tmp_path / "ckpt"
     jsonl = tmp_path / "metrics.jsonl"
+    spans_jsonl = tmp_path / "spans.jsonl"
     rc = main(
         [
             "federated", "--synthetic", "600", "--num-clients", "2",
             "--rounds", "1", "--epochs", "1",
             "--output-dir", str(out), "--checkpoint-dir", str(ckpt),
             "--metrics-jsonl", str(jsonl),
+            "--trace-jsonl", str(spans_jsonl),
         ]
     )
     assert rc == 0
+    # Mesh-tier obs spans: the round's client-local/agg phase timers
+    # landed on the events-JSONL with the fed2 path identity.
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs import (
+        load_spans,
+    )
+
+    spans = load_spans([str(spans_jsonl)])
+    assert {(s["span"], s.get("round")) for s in spans} >= {
+        ("client-local", 0),
+        ("agg", 0),
+    }
+    assert all(s["proc"] == "fed" and s["path"] == "fed2" for s in spans)
     # Per-round JSONL reports val AND test at both phases, like the
     # reference (client1.py:383-385,398-400).
     import json

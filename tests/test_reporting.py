@@ -150,3 +150,50 @@ def test_append_metrics_jsonl(tmp_path):
     assert "probs" not in lines[0]
     assert all("ts" in rec for rec in lines)
     assert lines[1]["phase"] == "aggregated"
+    # Stream-merge identity (obs satellite): every record self-describes
+    # its schema and run, so `fedtpu obs` / the drift monitor can merge
+    # several processes' streams without guessing.
+    assert all(rec["schema"] == reporting.METRICS_SCHEMA for rec in lines)
+    assert all(rec["run_id"] for rec in lines)
+    assert lines[0]["run_id"] == lines[1]["run_id"]
+
+
+def test_append_metrics_jsonl_concurrent_writers(tmp_path):
+    """Two+ threads appending concurrently must never interleave partial
+    lines (the server's reply threads and the serving tier's scorer share
+    one stream): every line parses, none are lost. Pinned by the single
+    atomic O_APPEND os.write the writer now uses — Python's buffered
+    'a'-mode writes flush long lines in pieces."""
+    import json
+    import threading
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.reporting import (
+        append_metrics_jsonl,
+    )
+
+    path = str(tmp_path / "concurrent.jsonl")
+    n_threads, per_thread = 4, 200
+    # Long-ish records: well past typical libc buffer flush granularity,
+    # so a non-atomic writer WOULD interleave.
+    filler = {f"k{i}": float(i) * 1.5 for i in range(40)}
+
+    def writer(tid: int) -> None:
+        for i in range(per_thread):
+            append_metrics_jsonl(
+                path, {"phase": "stress", "thread": tid, "i": i, **filler}
+            )
+
+    threads = [
+        threading.Thread(target=writer, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lines = open(path).read().splitlines()
+    assert len(lines) == n_threads * per_thread
+    seen = set()
+    for line in lines:
+        rec = json.loads(line)  # every line parses — no interleaving
+        seen.add((rec["thread"], rec["i"]))
+    assert len(seen) == n_threads * per_thread  # and none were lost
