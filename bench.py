@@ -785,56 +785,88 @@ def bench_serving() -> None:
 
 
 def bench_controller() -> dict | None:
-    """Control-plane cadence on a dryrun fleet (ISSUE 3): the unattended
-    round -> eval-gate -> promote loop (control/Controller over the real
-    TCP round engine with real in-process clients) measured end to end.
+    """Control-plane cadence on a dryrun fleet (ISSUE 3), now as a round-
+    pipelining A/B (ISSUE 5): the unattended round -> eval-gate -> promote
+    loop (control/Controller over the real TCP round engine with real
+    in-process clients) measured end to end, TWICE — the barrier arm
+    (stream_chunk_bytes=0: single-frame uploads, aggregation exposed after
+    the last upload) vs the streaming arm (chunk-streamed uploads folded
+    into the running mean as chunks arrive, comm/stream_agg.py).
 
-    The record's value is rounds/hour; ``promotion_latency_ms`` is the
-    round-end -> serving-pointer-swap gap (eval + artifact write + atomic
-    swap — what a scoring process waits before the new round serves), and
-    ``gate_rejections`` is machine-parsed so a driver can assert the gate
-    stayed quiet on a healthy run. vs_baseline is the fraction of cycle
-    wall spent inside the round engine itself (1.0 = zero orchestration
-    overhead); the reference has no unattended loop to compare against —
-    its cadence is a human re-running three scripts."""
+    The record's value is the STREAMING arm's rounds/hour (the production
+    shape); ``promotion_latency_ms`` is the round-end -> serving-pointer-
+    swap gap, ``gate_rejections`` is machine-parsed so a driver can assert
+    the gate stayed quiet. Pipelining headline fields (asserted present by
+    the train-mode headline, exit 3): ``comm_overlap_frac`` — bytes-
+    weighted fraction of aggregation input folded while the wire phase was
+    still active — and ``server_peak_agg_bytes`` — the aggregation-state
+    peak, O(model + in-flight leaves) under streaming vs O(clients x
+    model) at the barrier. ``barrier_comm_phase_wait_s`` is the A/B's
+    other arm on the same run."""
     import tempfile
-
-    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.registry import (
-        ModelRegistry,
-    )
 
     rounds = int(os.environ.get("BENCH_CTRL_ROUNDS", "5"))
     n_clients = int(os.environ.get("BENCH_CTRL_CLIENTS", "2"))
     # Model-sized payloads dominate the round wall; default ~4 MB keeps
     # the record cheap while exercising real encode/decode + registry IO.
+    # Split over leaves (a real state dict's shape): per-LEAF folds are
+    # what overlap with the slower clients' remaining wire transfer.
     param_mb = float(os.environ.get("BENCH_CTRL_PARAM_MB", "4"))
-    n_elems = max(1, int(param_mb * 1e6 / 4))
+    n_leaves = 32
+    leaf_elems = max(1, int(param_mb * 1e6 / 4 / n_leaves))
     rng = np.random.default_rng(0)
-    base = {"w": rng.normal(size=n_elems).astype(np.float32)}
-    root = tempfile.mkdtemp(prefix="bench-registry-")
-    registry = ModelRegistry(root)
-    evals = [0]
+    base = {
+        f"w{i:02d}": rng.normal(size=leaf_elems).astype(np.float32)
+        for i in range(n_leaves)
+    }
+    # Chunks sized well under one leaf so each upload streams in many
+    # frames and the server's running fold has in-flight wire to overlap.
+    chunk = max(64 << 10, int(param_mb * (1 << 20)) // 16)
 
-    def eval_fn(params):
-        # Monotonically improving synthetic metric: every round promotes,
-        # so the record measures the FULL promote path each cycle.
-        evals[0] += 1
-        return {"Accuracy": min(0.5 + 0.01 * evals[0], 0.99)}
-
-    errors: list[Exception] = []
-    try:
-        stats, wall, comm_phases = _run_controller_fleet(
-            registry, base, rounds, n_clients, eval_fn, errors
+    def run_arm(stream_chunk_bytes: int):
+        from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.registry import (
+            ModelRegistry,
         )
-    finally:
-        import shutil
 
-        shutil.rmtree(root, ignore_errors=True)  # ~rounds x param_mb of /tmp
-    if errors or stats.rounds_completed == 0:
+        root = tempfile.mkdtemp(prefix="bench-registry-")
+        evals = [0]
+
+        def eval_fn(params):
+            # Monotonically improving synthetic metric: every round
+            # promotes, so the record measures the FULL promote path.
+            evals[0] += 1
+            return {"Accuracy": min(0.5 + 0.01 * evals[0], 0.99)}
+
+        errors: list[Exception] = []
+        try:
+            out = _run_controller_fleet(
+                ModelRegistry(root), base, rounds, n_clients, eval_fn,
+                errors, stream_chunk_bytes=stream_chunk_bytes,
+            )
+        finally:
+            import shutil
+
+            shutil.rmtree(root, ignore_errors=True)  # rounds x param_mb
+        return out + (errors,)
+
+    # Barrier arm first (stream off), then the streaming arm the record
+    # headlines — same base, same rounds, same loopback host.
+    b_stats, b_wall, b_phases, _b_stream, b_errors = run_arm(0)
+    stats, wall, comm_phases, stream_info, errors = run_arm(chunk)
+    if (
+        errors
+        or b_errors
+        or stats.rounds_completed == 0
+        # A zero-round barrier arm would publish ~0 barrier_* fields and
+        # turn the A/B headline into an arbitrary speedup — fail loudly,
+        # same as the streaming arm.
+        or b_stats.rounds_completed == 0
+    ):
+        first = (errors or b_errors)[0] if (errors or b_errors) else None
         record = {
             "metric": "bench_error",
             "error": "controller_round_failed",
-            "detail": str(errors[0])[:300] if errors else "no round completed",
+            "detail": str(first)[:300] if first else "no round completed",
         }
         _emit(record)
         return record
@@ -864,15 +896,35 @@ def bench_controller() -> dict | None:
         "comm_phase_wait_s": round(comm_phases.get("wait", 0.0), 4),
         "comm_phase_agg_s": round(comm_phases.get("agg", 0.0), 4),
         "comm_phase_reply_s": round(comm_phases.get("reply", 0.0), 4),
+        # Round pipelining (ISSUE 5): overlapped-vs-exposed fold
+        # attribution + aggregation-state peak from the streaming arm,
+        # and the barrier arm's wait/agg on the same run as the A/B.
+        "comm_overlap_frac": round(stream_info["overlap_frac"], 4),
+        "server_peak_agg_bytes": int(stream_info["peak_agg_bytes"]),
+        # The LAST (fully streamed) round's aggregation-state peak —
+        # O(model + in-flight leaves); the cross-round max above still
+        # carries the dense first round's O(clients x model).
+        "server_round_peak_agg_bytes": int(
+            stream_info["last_round_peak_bytes"]
+        ),
+        "stream_uploads": int(stream_info["stream_uploads"]),
+        "stream_chunk_bytes": chunk,
+        "barrier_comm_phase_wait_s": round(b_phases.get("wait", 0.0), 4),
+        "barrier_comm_phase_agg_s": round(b_phases.get("agg", 0.0), 4),
+        "barrier_wall_s": round(b_wall, 3),
         "device": jax.devices()[0].device_kind,
     }
     _emit(record)
     return record
 
 
-def _run_controller_fleet(registry, base, rounds, n_clients, eval_fn, errors):
+def _run_controller_fleet(
+    registry, base, rounds, n_clients, eval_fn, errors,
+    *, stream_chunk_bytes: int = 0,
+):
     """One controller campaign over an in-process TCP fleet; returns
-    (ControllerStats, wall seconds, round-engine phase seconds)."""
+    (ControllerStats, wall seconds, round-engine phase seconds, streaming
+    fold stats — overlap_frac/peak_agg_bytes/stream_uploads)."""
     import threading
 
     from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
@@ -887,7 +939,8 @@ def _run_controller_fleet(registry, base, rounds, n_clients, eval_fn, errors):
     )
 
     with AggregationServer(
-        port=0, num_clients=n_clients, timeout=120
+        port=0, num_clients=n_clients, timeout=120,
+        stream_chunk_bytes=stream_chunk_bytes,
     ) as server:
         controller = Controller(
             server,
@@ -923,7 +976,15 @@ def _run_controller_fleet(registry, base, rounds, n_clients, eval_fn, errors):
         for t in threads:
             t.join(timeout=30)
         comm_phases = dict(server.phase_seconds)
-    return stats, wall, comm_phases
+        stream_info = {
+            "overlap_frac": server.comm_overlap_frac(),
+            "peak_agg_bytes": server.stream_totals["peak_agg_bytes"],
+            "last_round_peak_bytes": server.stream_totals[
+                "last_round_peak_bytes"
+            ],
+            "stream_uploads": server.stream_totals["stream_uploads"],
+        }
+    return stats, wall, comm_phases, stream_info
 
 
 def _measure_local_steps(trainer, model_cfg, batch_size, steps, warmup) -> float:
@@ -1250,9 +1311,10 @@ def main() -> None:
                 extra["controller_gate_rejections"] = rec_ctrl[
                     "gate_rejections"
                 ]
-                # comm_phase_* headline fields (obs round-phase
-                # accounting): ASSERTED present — a refactor that drops
-                # the round engine's phase accounting must fail the bench
+                # comm_phase_* / round-pipelining headline fields (obs
+                # round-phase accounting + streaming chunk aggregation):
+                # ASSERTED present — a refactor that drops the round
+                # engine's phase or fold accounting must fail the bench
                 # loudly, not silently stop tracking the breakdown.
                 missing = [
                     k
@@ -1260,6 +1322,8 @@ def main() -> None:
                         "comm_phase_wait_s",
                         "comm_phase_agg_s",
                         "comm_phase_reply_s",
+                        "comm_overlap_frac",
+                        "server_peak_agg_bytes",
                     )
                     if k not in rec_ctrl
                 ]
@@ -1269,8 +1333,8 @@ def main() -> None:
                             "metric": "bench_error",
                             "error": "comm_phase_fields_missing",
                             "detail": f"controller record lacks {missing} "
-                            "(AggregationServer.phase_seconds accounting "
-                            "broken?)",
+                            "(AggregationServer.phase_seconds / "
+                            "stream_totals accounting broken?)",
                         }
                     )
                     raise SystemExit(3)
@@ -1278,8 +1342,12 @@ def main() -> None:
                     "comm_phase_wait_s",
                     "comm_phase_agg_s",
                     "comm_phase_reply_s",
+                    "comm_overlap_frac",
+                    "server_peak_agg_bytes",
+                    "barrier_comm_phase_wait_s",
                 ):
-                    extra[k] = rec_ctrl[k]
+                    if k in rec_ctrl:
+                        extra[k] = rec_ctrl[k]
             broken = _check_mfu_floor(
                 {"fed2": rec_fed2, "fedseq": rec_fedseq}
             )

@@ -130,6 +130,11 @@ def cmd_serve(args) -> int:
     tracer, _metrics = _obs_setup(
         args, proc="server", metrics_host=args.host
     )
+    from ..comm import wire as _wire
+
+    stream_chunk_bytes = _wire.stream_chunk_bytes_from_mb(
+        getattr(args, "stream_chunk_mb", None)
+    )
     with AggregationServer(
         host=args.host,
         port=args.port,
@@ -147,6 +152,7 @@ def cmd_serve(args) -> int:
         secure_threshold=getattr(args, "secure_threshold", None),
         dp_participation=dp_q,
         tracer=tracer,
+        stream_chunk_bytes=stream_chunk_bytes,
     ) as server:
         log.info(f"[SERVER] listening on {args.host}:{server.port}")
         server.serve(rounds=rounds)
@@ -219,6 +225,7 @@ def cmd_client(args) -> int:
         secure_protocol=getattr(args, "secure_protocol", "double"),
         secure_threshold=getattr(args, "secure_threshold", None),
         tracer=client_tracer,
+        stream=bool(getattr(args, "stream_upload", True)),
     )
     rounds = max(1, getattr(args, "rounds", None) or 1)
     local = agg_metrics = None
@@ -276,12 +283,35 @@ def cmd_client(args) -> int:
                 },
             )
         host_params = trainer.host_params(state)
+        # Hide reply latency behind next-round input-pipeline work: the
+        # next round's first batch gathers (permutation + row copies) run
+        # on a background thread WHILE the exchange below blocks on the
+        # aggregate reply. Same iterator, same seed — the batch sequence
+        # is identical prefetched or not (pinned by tests).
+        prefetch = (
+            trainer.prefetch_epoch(
+                client_data.train, (r + 1) * E, cfg.data.batch_size
+            )
+            if r + 1 < rounds
+            else None
+        )
         try:
             with phase("federated exchange", tag="COMM"):
                 aggregated = fed.exchange(
                     host_params,
                     n_samples=len(client_data.train),
                     round_base=round_base,
+                )
+            if prefetch is not None and prefetch.ready():
+                # The input-pipeline seconds that ran under the reply
+                # wait — buffered like client-local, stamped with the
+                # round's (trace, round) identity on the NEXT exchange.
+                fed.note_phase(
+                    "batch-prefetch",
+                    prefetch.t_unix,
+                    prefetch.busy_s,
+                    client=args.client_id,
+                    batches=prefetch.n_prefetched,
                 )
             with phase("aggregated evaluation", tag="EVAL"):
                 agg_metrics = trainer.evaluate(aggregated, client_data.test)

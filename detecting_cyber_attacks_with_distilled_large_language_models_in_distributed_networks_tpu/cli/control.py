@@ -87,6 +87,7 @@ def cmd_controller(args) -> int:
         ("drift_min_scores", "drift_min_scores"),
         ("drift_method", "drift_method"),
         ("round_deadline", "round_deadline_s"),
+        ("max_artifacts", "max_artifacts"),
     ):
         v = getattr(args, flag, None)
         if v is not None:
@@ -136,6 +137,9 @@ def cmd_controller(args) -> int:
             )
             + ")"
         )
+    from ..comm import wire as _wire
+
+    stream_mb = getattr(args, "stream_chunk_mb", None)
     with AggregationServer(
         host=args.host,
         port=args.port,
@@ -146,6 +150,7 @@ def cmd_controller(args) -> int:
         secure_agg=bool(getattr(args, "secure_agg", False)),
         client_keys=_server_client_keys(),
         tracer=tracer,
+        stream_chunk_bytes=_wire.stream_chunk_bytes_from_mb(stream_mb),
     ) as server:
         controller = Controller(
             server,
@@ -206,6 +211,18 @@ def cmd_registry(args) -> int:
         if args.action == "rollback":
             m = registry.rollback()
             print(f"serving pointer -> {m['id']} (round {m.get('round')})")
+            return 0
+        if args.action == "gc":
+            if args.max_artifacts is None:
+                raise SystemExit("registry gc needs --max-artifacts N")
+            removed = registry.gc(max_artifacts=args.max_artifacts)
+            if removed:
+                for aid in removed:
+                    print(f"pruned {aid}")
+            print(
+                f"{len(removed)} artifact(s) pruned, "
+                f"{len(registry.list())} kept"
+            )
             return 0
     except RegistryError as e:
         raise SystemExit(str(e)) from None

@@ -392,6 +392,18 @@ def build_parser() -> argparse.ArgumentParser:
         "per-phase seconds) at http://HOST:PORT/metrics in Prometheus "
         "text format (0 = off, the default)",
     )
+    p.add_argument(
+        "--stream-chunk-mb",
+        type=float,
+        default=None,
+        help="advertise chunk-streamed uploads at this chunk size (MB): "
+        "capable clients pipeline their uploads leaf-by-leaf and the "
+        "server folds each chunk into the running mean as it arrives — "
+        "bit-exact with the barrier mean, lower round latency and O(model)"
+        " peak memory instead of O(clients x model). 0 disables the "
+        "advert AND eager folding (the stop-the-world barrier shape); "
+        "default 4. Old clients interop either way (plain meta field)",
+    )
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
@@ -480,6 +492,16 @@ def build_parser() -> argparse.ArgumentParser:
         default=1,
         help="train/exchange rounds in one process (server must serve >= "
         "this many); the reference achieves this by re-launching",
+    )
+    p.add_argument(
+        "--no-stream-upload",
+        dest="stream_upload",
+        action="store_false",
+        default=True,
+        help="never chunk-stream uploads, even when the server "
+        "advertises support (--stream-chunk-mb): every upload stays one "
+        "dense frame — the old-peer wire shape, useful for interop "
+        "testing and as the pipelining A/B arm",
     )
     p.set_defaults(fn=cmd_client)
 
@@ -582,6 +604,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="expose live gauges/counters (queue depth, rejects by kind, "
         "scored total, queue-wait histogram) at http://HOST:PORT/metrics "
         "in Prometheus text format (0 = off, the default)",
+    )
+    p.add_argument(
+        "--trace-sample",
+        type=float,
+        default=None,
+        help="serve-batch span sampling rate in (0, 1]: with --trace-jsonl"
+        " on a high-rate scorer, emit one span per ~1/RATE coalesced "
+        "batches (deterministic batch-counter stride, not RNG; each span "
+        "carries sampled_batches so the timeline can re-scale). Default "
+        "1.0 = every batch, the pre-sampling behavior",
     )
     p.set_defaults(fn=cmd_infer_serve)
 
@@ -693,6 +725,22 @@ def build_parser() -> argparse.ArgumentParser:
         "round-phase seconds) at http://HOST:PORT/metrics in Prometheus "
         "text format (0 = off, the default)",
     )
+    p.add_argument(
+        "--stream-chunk-mb",
+        type=float,
+        default=None,
+        help="chunk-streamed upload advert for the embedded round engine "
+        "(see `serve --stream-chunk-mb`); 0 = barrier shape",
+    )
+    p.add_argument(
+        "--max-artifacts",
+        type=int,
+        default=None,
+        help="registry GC after every promotion/rejection: prune oldest "
+        "retired/rejected artifacts beyond this count (the serving "
+        "artifact and its rollback chain are never pruned); default: "
+        "keep everything",
+    )
     p.set_defaults(fn=cmd_controller)
 
     p = sub.add_parser(
@@ -730,9 +778,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser(
         "registry",
-        help="model registry operations: list | promote | rollback",
+        help="model registry operations: list | promote | rollback | gc",
     )
-    p.add_argument("action", choices=["list", "promote", "rollback"])
+    p.add_argument("action", choices=["list", "promote", "rollback", "gc"])
     p.add_argument("--registry-dir", required=True)
     p.add_argument("--artifact", help="artifact id (promote)")
     p.add_argument(
@@ -741,6 +789,15 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="promotion target state (default: one rung up the "
         "candidate -> shadow -> serving ladder)",
+    )
+    p.add_argument(
+        "--max-artifacts",
+        type=int,
+        default=None,
+        help="gc: prune oldest retired/rejected artifacts until at most "
+        "this many remain on disk; the serving artifact, its rollback "
+        "chain, and live candidate/shadow artifacts are NEVER pruned "
+        "(required for the gc action)",
     )
     p.set_defaults(fn=cmd_registry)
 

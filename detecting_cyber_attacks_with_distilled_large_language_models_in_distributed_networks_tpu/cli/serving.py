@@ -165,6 +165,13 @@ def cmd_infer_serve(args) -> int:
         # artifact's eval reference must bin identically (ControlConfig).
         score_bins=cfg.control.score_bins,
         tracer=tracer,
+        # serve-batch span sampling for high-rate streams: --trace-sample
+        # overrides the config's obs.trace_sample (both default 1.0).
+        trace_sample=(
+            args.trace_sample
+            if getattr(args, "trace_sample", None) is not None
+            else cfg.obs.trace_sample
+        ),
     )
     reload_src = (
         "registry pointer"

@@ -84,6 +84,7 @@ class FederatedClient:
         secure_protocol: str = "double",
         secure_threshold: int | None = None,
         tracer=None,
+        stream: bool = True,
     ):
         if client_key is not None and auth_key is None:
             raise ValueError(
@@ -224,6 +225,18 @@ class FederatedClient:
         self.tracer = tracer
         self.last_trace: tuple[str | None, int | None] = (None, None)
         self._pending_spans: list[tuple[str, float, float, dict]] = []
+        # Streamed-upload capability (wire.py "Streamed uploads"): the
+        # server advertises its preferred chunk size in every reply's
+        # meta; once seen, later uploads go leaf-by-leaf in bounded
+        # chunks on a background wire thread (pack k+1 while k sends).
+        # Pre-stream servers never advertise, so the first round — and
+        # every exchange against an old peer — stays single-frame.
+        # Masked (secure-agg) and sparse (topk) uploads stay single-frame
+        # too: the former's unmask protocol needs the full contributor
+        # set resolved server-side before any aggregate exists, the
+        # latter's encoded size is data-dependent (no plannable header).
+        self.stream = bool(stream)
+        self._server_stream: int | None = None
         if secure_agg and auth_key is None:
             log.warning(
                 f"[CLIENT {client_id}] --secure-agg without an auth key "
@@ -265,7 +278,19 @@ class FederatedClient:
         params would carry the undelivered drift in its params AND in the
         residual, over-correcting those coordinates roughly 2x per round.
         """
-        params = _host_params(params)
+        can_stream = (
+            self.stream and not self.secure_agg and self._topk_frac is None
+        )
+        # Lazy host gather (plain streamed path only): leave device-backed
+        # leaves on device so the stream packer's per-leaf np.asarray
+        # overlaps the mesh-tier host gather with the first chunk sends.
+        # DP needs the full host tree up front (the delta/clip math runs
+        # over it), and a fallback/dense attempt gathers on demand.
+        stream_flat: dict | None = None
+        if can_stream and self._server_stream and not self.dp:
+            stream_flat = wire.flatten_lazy(params)
+        else:
+            params = _host_params(params)
         if round_base is not None:
             round_base = _host_params(round_base)
         base_meta = {
@@ -317,6 +342,7 @@ class FederatedClient:
             and not self.secure_agg
             and self._topk_frac is None
             and not self.dp
+            and not (can_stream and self._server_stream)
             else None
         )
         last: Exception | None = None
@@ -622,33 +648,72 @@ class FederatedClient:
                                 params, attempt, attempt_meta
                             )
                         )
-                    if (
-                        self.auth_key is not None
-                        or self.secure_agg
-                        or self._topk_frac is not None
-                        or self.dp
-                    ):
-                        # Fresh encode per attempt: the nonce and/or round
-                        # (and with them the masks), or the sparse-vs-dense
-                        # choice, change between connections.
-                        msg = wire.encode(
-                            upload,
-                            meta=attempt_meta,
-                            compression=attempt_compression,
-                            auth_key=self.auth_key,
+                    # Streamed upload: first attempt only — a retry may be
+                    # recovering from a server that stopped speaking the
+                    # stream protocol (restart, downgrade), and the dense
+                    # single frame is always correct.
+                    use_stream = (
+                        can_stream
+                        and self._server_stream is not None
+                        and attempt == 1
+                    )
+                    if use_stream:
+                        up_flat = (
+                            stream_flat
+                            if stream_flat is not None
+                            else wire.flatten_lazy(upload)
                         )
-                    log.info(
-                        f"[CLIENT {self.client_id}] uploading "
-                        f"{len(msg) / 1e6:.1f} MB "
-                        f"(attempt {attempt}/{max_retries})"
-                    )
-                    sparse_in_flight = delta_flat is not None
-                    t_up_unix = time.time()
-                    t_up0 = time.monotonic()
-                    framing.send_frame(sock, msg)
-                    upload_timing = (
-                        t_up_unix, time.monotonic() - t_up0, len(msg),
-                    )
+                        t_up_unix = time.time()
+                        t_up0 = time.monotonic()
+                        sent, chunks, overlap_s = self._stream_upload(
+                            sock, up_flat, attempt_meta,
+                            attempt_compression, nonce_hex,
+                        )
+                        upload_timing = (
+                            t_up_unix, time.monotonic() - t_up0, sent,
+                            {"chunks": chunks,
+                             "overlap_s": round(overlap_s, 6)},
+                        )
+                    else:
+                        if stream_flat is not None:
+                            # Dense fallback from the lazy path: gather
+                            # whatever the packer hasn't already cached,
+                            # writing the host arrays back so further
+                            # retries reuse them instead of re-gathering
+                            # the whole model off-device each attempt.
+                            for k, v in stream_flat.items():
+                                stream_flat[k] = np.asarray(v)
+                            upload = dict(stream_flat)
+                        if (
+                            self.auth_key is not None
+                            or self.secure_agg
+                            or self._topk_frac is not None
+                            or self.dp
+                            or msg is None
+                        ):
+                            # Fresh encode per attempt: the nonce and/or
+                            # round (and with them the masks), or the
+                            # sparse-vs-dense choice, change between
+                            # connections.
+                            msg = wire.encode(
+                                upload,
+                                meta=attempt_meta,
+                                compression=attempt_compression,
+                                auth_key=self.auth_key,
+                            )
+                        log.info(
+                            f"[CLIENT {self.client_id}] uploading "
+                            f"{len(msg) / 1e6:.1f} MB "
+                            f"(attempt {attempt}/{max_retries})"
+                        )
+                        sparse_in_flight = delta_flat is not None
+                        t_up_unix = time.time()
+                        t_up0 = time.monotonic()
+                        framing.send_frame(sock, msg)
+                        upload_timing = (
+                            t_up_unix, time.monotonic() - t_up0, len(msg),
+                            None,
+                        )
                 else:
                     upload_timing = None
                 # The reply window spans from here to the final reply
@@ -725,6 +790,22 @@ class FederatedClient:
                         "aggregated reply failed the freshness check "
                         "(stale nonce or wrong role) — possible replay"
                     )
+                # Capability negotiation (one reply behind, like the
+                # sparse tier's agg_crc): adopt/refresh the server's
+                # streamed-upload advert. A reply without the field —
+                # old server, or one restarted with streaming off —
+                # drops us back to single-frame uploads.
+                try:
+                    adv_stream = int(agg_meta.get(wire.STREAM_META_KEY, 0))
+                except (TypeError, ValueError):
+                    adv_stream = 0
+                self._server_stream = (
+                    adv_stream
+                    if 0
+                    < adv_stream
+                    <= framing.MAX_FRAME - wire.STREAM_CHUNK_OVERHEAD
+                    else None
+                )
                 self._flush_spans(agg_meta, upload_timing, reply_timing)
                 if self.secure_agg and this_call is not None:
                     # Round complete: drop this round's (and any older)
@@ -853,6 +934,97 @@ class FederatedClient:
             f"client {self.client_id}: round failed after {max_retries} attempts: {last}"
         )
 
+    # ------------------------------------------------- streamed uploads
+    def _stream_upload(
+        self,
+        sock: socket.socket,
+        flat: dict,
+        meta: dict,
+        compression: str,
+        nonce_hex: str | None,
+    ) -> tuple[int, int, float]:
+        """Ship one upload as header + chunk frames + trailer (wire.py
+        "Streamed uploads"): leaves are gathered/encoded one at a time on
+        THIS thread while a background wire thread (framing.
+        PipelinedSender) sends the chunks already packed — the compute/
+        comms overlap the single-frame path cannot have. Returns
+        ``(bytes sent, chunk count, overlap seconds)`` where overlap is
+        the pack+send time hidden by running the two concurrently.
+
+        Leaves materialized here are cached back into ``flat`` so a
+        dense fallback attempt never re-crosses the device boundary."""
+        tensors, payload_nbytes = wire.plan_stream(flat, compression)
+        chunk_bytes = int(self._server_stream or wire.DEFAULT_STREAM_CHUNK)
+        nonce = bytes.fromhex(nonce_hex) if nonce_hex else b""
+        header = wire.encode_stream_header(
+            tensors,
+            meta=meta,
+            chunk_bytes=chunk_bytes,
+            payload_nbytes=payload_nbytes,
+            auth_key=self.auth_key,
+        )
+        log.info(
+            f"[CLIENT {self.client_id}] streaming "
+            f"{payload_nbytes / 1e6:.1f} MB upload in "
+            f"{-(-payload_nbytes // chunk_bytes)} chunk(s) of "
+            f"<= {chunk_bytes / 1e6:.1f} MB"
+        )
+        t0 = time.monotonic()
+        # ACKed header: a peer that stopped speaking the stream protocol
+        # fails here, before any model bytes move.
+        framing.send_frame(sock, header)
+        sender = framing.PipelinedSender(sock)
+        pack_s = 0.0
+        seq = 0
+        sent = len(header)
+        buf = bytearray()
+        try:
+            def _flush(final: bool = False) -> None:
+                nonlocal buf, seq, sent
+                while len(buf) >= chunk_bytes or (final and buf):
+                    chunk = bytes(buf[:chunk_bytes])
+                    del buf[:chunk_bytes]
+                    frame = wire.encode_stream_chunk(
+                        seq, chunk, auth_key=self.auth_key, nonce=nonce
+                    )
+                    sender.send(frame)
+                    sent += len(frame)
+                    seq += 1
+
+            for t in tensors:
+                key = t["key"]
+                tp0 = time.monotonic()
+                leaf = flat[key]
+                if not isinstance(leaf, wire.PreEncoded) and not isinstance(
+                    leaf, np.ndarray
+                ):
+                    # The one host gather for a device-backed leaf;
+                    # cached so retries reuse it.
+                    leaf = flat[key] = np.asarray(leaf)
+                data = wire.encode_stream_leaf(leaf, t["enc"])
+                pack_s += time.monotonic() - tp0
+                buf += data
+                _flush()
+            _flush(final=True)
+            # ACKed trailer: the upload-complete handshake (the dense
+            # path's per-frame ACK, paid once per upload instead).
+            sender.send(
+                wire.encode_stream_end(
+                    seq, auth_key=self.auth_key, nonce=nonce
+                ),
+                await_ack=True,
+            )
+            send_s = sender.close()
+        except BaseException:
+            try:
+                sender.close()
+            except (OSError, wire.WireError, ConnectionError):
+                pass
+            raise
+        wall = max(time.monotonic() - t0, 1e-9)
+        overlap_s = max(0.0, pack_s + send_s - wall)
+        return sent, seq, overlap_s
+
     # ------------------------------------------------------ observability
     def note_local_phase(
         self, t_start: float, dur_s: float, **attrs
@@ -862,14 +1034,22 @@ class FederatedClient:
         written on the next successful exchange, once the reply meta
         reveals the round's trace id — the identity a client cannot know
         while it is still training."""
+        self.note_phase("client-local", t_start, dur_s, **attrs)
+
+    def note_phase(
+        self, name: str, t_start: float, dur_s: float, **attrs
+    ) -> None:
+        """Buffer an arbitrary caller-measured span (``client-local``,
+        ``batch-prefetch``, ...) for the next successful exchange, which
+        stamps it with the round's (trace, round) identity."""
         self._pending_spans.append(
-            ("client-local", float(t_start), float(dur_s), dict(attrs))
+            (str(name), float(t_start), float(dur_s), dict(attrs))
         )
 
     def _flush_spans(
         self,
         agg_meta: Mapping[str, Any],
-        upload: tuple[float, float, int] | None,
+        upload: tuple[float, float, int, dict | None] | None,
         reply: tuple[float, float, int] | None,
     ) -> None:
         """Adopt the reply's (trace, round) identity and write this
@@ -900,6 +1080,7 @@ class FederatedClient:
                 trace=trace,
                 round=rnd,
                 bytes=upload[2],
+                **(upload[3] or {}),
             )
         if reply is not None:
             self.tracer.record(

@@ -17,8 +17,11 @@ The receiver replies ``b"FTPK"`` after a verified read (the reference's
 
 from __future__ import annotations
 
+import queue
 import socket
 import struct
+import threading
+import time
 
 from . import native
 from .wire import WireError
@@ -94,3 +97,58 @@ def recv_frame(
     if send_ack:
         sock.sendall(ACK)
     return payload
+
+
+class PipelinedSender:
+    """Background frame writer: the streamed upload's wire half.
+
+    The producer enqueues frame payloads; a dedicated thread drains the
+    (bounded) queue through :func:`send_frame`, so packing chunk k+1 —
+    the host gather + encode work — overlaps chunk k's socket write
+    instead of alternating with it. The queue depth bounds how far the
+    packer can run ahead (memory: ``depth`` chunks), and the first send
+    error is re-raised to the producer on its next ``send`` or on
+    ``close`` — a dead socket stops the pipeline within one chunk, not
+    after packing the whole model.
+    """
+
+    def __init__(self, sock: socket.socket, *, depth: int = 4):
+        self._sock = sock
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._err: BaseException | None = None
+        self._send_s = 0.0  # seconds spent inside send_frame (wire time)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            payload, await_ack = item
+            if self._err is not None:
+                continue  # drain so the producer never blocks on put()
+            t0 = time.monotonic()
+            try:
+                send_frame(self._sock, payload, await_ack=await_ack)
+            except (OSError, WireError, ConnectionError) as e:
+                self._err = e
+            finally:
+                self._send_s += time.monotonic() - t0
+
+    def send(self, payload: bytes, *, await_ack: bool = False) -> None:
+        """Enqueue one frame (blocks when ``depth`` frames are pending);
+        raises the wire thread's first error, if any."""
+        if self._err is not None:
+            raise self._err
+        self._q.put((payload, await_ack))
+
+    def close(self) -> float:
+        """Flush the queue, join the thread, re-raise any send error.
+        Returns the wire thread's cumulative send seconds (the overlap
+        accounting the upload span reports)."""
+        self._q.put(None)
+        self._thread.join()
+        if self._err is not None:
+            raise self._err
+        return self._send_s

@@ -36,6 +36,7 @@ from ..obs import metrics as obs_metrics
 from ..obs.trace import new_trace_id
 from ..utils.logging import get_logger
 from . import framing, secure, wire
+from .stream_agg import StreamAgg
 
 log = get_logger()
 
@@ -120,6 +121,11 @@ class _Round:
     # ``agg_crc`` stamp, a full fp32 pass + tobytes() copy over the whole
     # model that deployments with no topk client shouldn't pay every round.
     wants_delta: bool = False
+    # Streaming chunk aggregation (comm/stream_agg.py): every plain/DP
+    # upload — streamed or single-frame — registers here; leaves fold
+    # into the running mean as they complete. None in secure-agg mode
+    # (masked sums keep the barrier path).
+    stream: Any = None
 
 
 class AggregationServer:
@@ -152,6 +158,7 @@ class AggregationServer:
         dp_participation: float = 1.0,
         dp_resync_rounds: int = 8,
         tracer=None,
+        stream_chunk_bytes: int = wire.DEFAULT_STREAM_CHUNK,
     ):
         if client_keys is not None and auth_key is None:
             raise ValueError(
@@ -311,10 +318,51 @@ class AggregationServer:
         # the /metrics endpoint report. last_trace is the most recent
         # round's (trace id, round index) for callers (the controller)
         # that stamp their own follow-on spans with the round's identity.
+        # Streamed uploads + streaming chunk aggregation (PR 5): the
+        # preferred chunk size advertised in every reply's meta (wire.py
+        # STREAM_META_KEY — plain meta, old clients interop unchanged).
+        # 0 disables BOTH the advert and eager folding: every round then
+        # runs the stop-the-world barrier shape (the bench's A/B arm).
+        # Secure-agg rounds never advertise: a masked upload's unmask
+        # protocol needs the full contributor set resolved before any
+        # aggregate exists, so those stay single-frame by design.
+        stream_chunk_cap = framing.MAX_FRAME - wire.STREAM_CHUNK_OVERHEAD
+        if not 0 <= int(stream_chunk_bytes) <= stream_chunk_cap:
+            # The cap leaves room for the STRC envelope (magic + seq +
+            # auth tag): a full chunk must still encode into a frame the
+            # transport accepts, or every streamed attempt would fail
+            # and silently pay a dense retry.
+            raise ValueError(
+                f"stream_chunk_bytes={stream_chunk_bytes} must be in "
+                f"[0, {stream_chunk_cap}] (0 = streaming off)"
+            )
+        self.stream_chunk_bytes = int(stream_chunk_bytes)
+        # Cross-round streaming totals: bytes folded during the wait
+        # phase (overlapped with the wire) vs after it, and the peak
+        # aggregation-state footprint — the comm_overlap_frac /
+        # server_peak_agg_bytes bench headline fields.
+        self.stream_totals = {
+            "early_bytes": 0,
+            "late_bytes": 0,
+            "early_s": 0.0,
+            "late_s": 0.0,
+            "peak_agg_bytes": 0,
+            "last_round_peak_bytes": 0,
+            "stream_uploads": 0,
+        }
         self.tracer = tracer
         self.phase_seconds = {"wait": 0.0, "agg": 0.0, "reply": 0.0}
         self.last_trace: tuple[str, int] | None = None
         m = obs_metrics.default_registry()
+        self._m_stream_uploads = m.counter(
+            "fedtpu_server_stream_uploads_total",
+            help="chunk-streamed client uploads accepted into a round",
+        )
+        self._g_peak_agg = m.gauge(
+            "fedtpu_server_peak_agg_bytes",
+            help="peak aggregation-state bytes of the last round "
+            "(accumulator + pending leaves)",
+        )
         self._m_rounds = m.counter(
             "fedtpu_server_rounds_total",
             help="aggregation rounds started",
@@ -620,25 +668,23 @@ class AggregationServer:
                         return
             payload = framing.recv_frame(conn)
             self._m_bytes_in.inc(float(len(payload)))
-            flat, meta = wire.decode(payload, auth_key=self.auth_key)
-            if self.auth_key is not None and (
-                meta.get("role") != "client" or meta.get("nonce") != nonce_hex
-            ):
-                raise wire.WireError(
-                    "authenticated upload failed the freshness check "
-                    "(stale nonce or wrong role) — possible replay"
+            if bytes(payload[:4]) == wire.STREAM_MAGIC:
+                # Streamed upload (wire.py "Streamed uploads"): header
+                # now, leaves folded into the running mean as chunks
+                # arrive. Only plain/DP rounds aggregate incrementally.
+                self._handle_stream_upload(
+                    conn, payload, rnd, nonce_hex=nonce_hex, dpid=dpid
                 )
-            flat = wire.flatten_params(flat)
-            client_id = int(meta.get("client_id", -1))
+                return
+            flat, meta = wire.decode(payload, auth_key=self.auth_key)
             # Cohort enforcement needs no separate membership check here:
             # a non-sampled dpid already returned on the sit-out path
             # (its upload frame is never read as a model), and this id
             # binding stops a sampled connection smuggling another id.
-            if dpid is not None and client_id != dpid:
-                raise wire.WireError(
-                    f"upload claims client {client_id} but the DP id "
-                    f"hello said {dpid}"
-                )
+            client_id = self._validate_upload_identity(
+                meta, nonce_hex=nonce_hex, dpid=dpid
+            )
+            flat = wire.flatten_params(flat)
             is_delta = bool(meta.get("delta", False))
             if is_delta:
                 if self.secure_agg:
@@ -670,25 +716,8 @@ class AggregationServer:
                     f"secure_agg={self.secure_agg}, upload "
                     f"secure={meta.get('secure', False)}"
                 )
-            dp_mode = self.dp_clip > 0.0
-            if bool(meta.get("dp", False)) != dp_mode:
-                raise wire.WireError(
-                    f"central-DP mode mismatch: server dp={dp_mode}, "
-                    f"upload dp={meta.get('dp', False)} — run the client "
-                    f"with --dp iff the server has --dp-clip"
-                )
-            dp_crc = None
+            dp_mode, dp_crc = self._validate_dp_meta(meta, is_delta=is_delta)
             if dp_mode:
-                if is_delta:
-                    raise wire.WireError(
-                        "sparse-delta upload in central-DP mode"
-                    )
-                try:
-                    dp_crc = int(meta["dp_base_crc"])
-                except (KeyError, TypeError, ValueError):
-                    raise wire.WireError(
-                        "DP upload missing its dp_base_crc"
-                    ) from None
                 if not self.secure_agg:
                     # ENFORCED clipping (not just trusted): a client that
                     # skipped its clip cannot widen the mechanism's
@@ -741,21 +770,94 @@ class AggregationServer:
                     )
                     conn.close()
                     return
-                if client_id in rnd.models:
-                    log.info(f"[SERVER] duplicate upload from client {client_id}; replacing")
+                dup_folded = False
+                if client_id in rnd.models or (
+                    # A still-in-flight STREAM from this client (intent
+                    # registered, trailer not yet processed) is a
+                    # duplicate too: a dense retry must not stack a
+                    # second intent/leaf set on top of it (the stalled
+                    # handler's cleanup would then poison the round or
+                    # strip the retry's state out from under it).
+                    rnd.stream is not None
+                    and client_id in rnd.stream.intents
+                ):
+                    # Replace the first upload — unless aggregation folds
+                    # already consumed it (streaming path): then the
+                    # folded original STANDS, and only the connection is
+                    # adopted so the (usually retrying, dead-socketed)
+                    # client still gets the round's reply.
+                    dup_folded = rnd.stream is not None and (
+                        not rnd.stream.drop_client(client_id, poison=False)
+                    )
+                    log.info(
+                        f"[SERVER] duplicate upload from client "
+                        f"{client_id}; "
+                        + (
+                            "keeping the already-aggregated original"
+                            if dup_folded
+                            else "replacing"
+                        )
+                    )
                     old = rnd.conns.pop(client_id, None)
-                    if old is not None:
+                    if old is not None and old is not conn:
                         old.close()
-                rnd.models[client_id] = flat
-                rnd.deltas[client_id] = is_delta
-                if dp_crc is not None:
-                    rnd.dp_crcs[client_id] = dp_crc
+                    if dup_folded and client_id not in rnd.models:
+                        # The folded original is an in-flight stream that
+                        # never reached its trailer (its socket is dead);
+                        # mark the client complete from its intent so the
+                        # round doesn't barrier on a connection that will
+                        # never finish.
+                        it = rnd.stream.intents.get(client_id, {})
+                        rnd.models[client_id] = {}
+                        rnd.deltas[client_id] = bool(it.get("delta", False))
+                        if it.get("dp_crc") is not None:
+                            rnd.dp_crcs[client_id] = it["dp_crc"]
+                        rnd.n_samples[client_id] = float(
+                            it.get("n_samples", 1.0)
+                        )
+                        if set(flat) == set(it.get("keys", ())) and bool(
+                            is_delta
+                        ) == bool(it.get("delta", False)):
+                            # Folds consumed the original's early leaves
+                            # and its socket will never deliver the rest;
+                            # the retry re-sends the same upload, so its
+                            # (validated, re-clipped) leaves complete the
+                            # remaining folds to the exact barrier mean.
+                            # A diverging retry (key set / mode mismatch)
+                            # skips this and fails the ROUND at finalize,
+                            # never the server.
+                            rnd.stream.add_dense(client_id, flat)
+                if not dup_folded:
+                    # In a plain/DP round the StreamAgg owns the upload's
+                    # arrays (registered below) and frees each leaf as it
+                    # folds — keep only the completion sentinel here, so
+                    # dense clients reach the O(model + in-flight) peak
+                    # too. The secure path has no StreamAgg and
+                    # aggregates from rnd.models directly.
+                    rnd.models[client_id] = (
+                        {} if rnd.stream is not None else flat
+                    )
+                    rnd.deltas[client_id] = is_delta
+                    if dp_crc is not None:
+                        rnd.dp_crcs[client_id] = dp_crc
+                    rnd.n_samples[client_id] = float(meta.get("n_samples", 1.0))
                 if is_delta or bool(meta.get("wants_delta", False)):
                     rnd.wants_delta = True
-                rnd.n_samples[client_id] = float(meta.get("n_samples", 1.0))
                 rnd.conns[client_id] = conn
                 if nonce_hex is not None:
                     rnd.nonces[client_id] = nonce_hex
+                if rnd.stream is not None and not dup_folded:
+                    # Single-frame uploads join the same incremental fold
+                    # as streamed ones (mixed fleets fold in one pass).
+                    rnd.stream.register(
+                        client_id,
+                        keys=tuple(flat),
+                        n_samples=float(meta.get("n_samples", 1.0)),
+                        delta=is_delta,
+                        dp_crc=dp_crc,
+                    )
+                    rnd.stream.add_dense(client_id, flat)
+                    self._try_freeze_stream(rnd)
                 done = self._round_done(rnd)
             self._m_uploads.inc()
             log.info(
@@ -802,6 +904,399 @@ class AggregationServer:
             len(rnd.skip_conns) >= self.num_clients - len(rnd.cohort)
         )
         return uploads_done and skips_done
+
+    def _try_freeze_stream(self, rnd: _Round) -> None:
+        """Freeze the round's fold set once every expected client's
+        upload intent has arrived (caller holds ``rnd.lock``). Mirrors
+        the close-time contributor logic — DP staleness partition
+        included — over the SAME inputs, so the frozen set always equals
+        the set ``serve_round`` later aggregates over (``_dp_history`` is
+        only mutated in the agg phase, after the wait ends). A DP fleet
+        whose current-base clients disagree on their crc is left
+        unfrozen: nothing folds, and the close-time path raises the
+        usual base-mismatch error."""
+        st = rnd.stream
+        if (
+            st is None
+            or not st.eager
+            or st.fold_ids is not None
+            or st.poisoned
+        ):
+            return
+        have = set(st.intents)
+        if rnd.cohort is not None:
+            if not set(rnd.cohort).issubset(have):
+                return
+            ids_all = sorted(rnd.cohort)
+        else:
+            if len(have) < rnd.expected:
+                return
+            ids_all = sorted(have)
+        if self.dp_clip > 0.0:
+            crcs = {c: st.intents[c].get("dp_crc") for c in ids_all}
+            hist = {crc for crc, _ in self._dp_history}
+            stale = [c for c in ids_all if crcs[c] in hist]
+            current = [c for c in ids_all if c not in stale]
+            if not current and stale and len({crcs[c] for c in stale}) == 1:
+                # Fleet-wide missed reply: the consensus IS the base
+                # (same rule as the close-time resync logic).
+                current, stale = stale, []
+            if not current or len({crcs[c] for c in current}) != 1:
+                return
+            ids = current
+        else:
+            ids = ids_all
+        # Same weight rule as serve_round's close-time aggregation —
+        # n_samples weights whenever the server is weighted, DP or not.
+        weights = (
+            [st.intents[c]["n_samples"] for c in ids]
+            if self.weighted
+            else None
+        )
+        st.freeze(ids, weights)
+
+    def _validate_upload_identity(
+        self, meta, *, nonce_hex: str | None, dpid: int | None
+    ) -> int:
+        """Freshness + identity binding every upload shape shares. The
+        single-frame and streamed wire paths MUST apply identical
+        security checks, so both call this one helper — a check added to
+        only one path would open a validation gap between the two
+        shapes. Returns the bound client id."""
+        if self.auth_key is not None and (
+            meta.get("role") != "client" or meta.get("nonce") != nonce_hex
+        ):
+            raise wire.WireError(
+                "authenticated upload failed the freshness check "
+                "(stale nonce or wrong role) — possible replay"
+            )
+        client_id = int(meta.get("client_id", -1))
+        if dpid is not None and client_id != dpid:
+            raise wire.WireError(
+                f"upload claims client {client_id} but the DP id "
+                f"hello said {dpid}"
+            )
+        return client_id
+
+    def _validate_dp_meta(self, meta, *, is_delta: bool) -> tuple[bool, int | None]:
+        """Central-DP mode agreement + base-crc parse, shared by both
+        upload shapes (see _validate_upload_identity). Returns
+        ``(dp_mode, dp_crc)``."""
+        dp_mode = self.dp_clip > 0.0
+        if bool(meta.get("dp", False)) != dp_mode:
+            raise wire.WireError(
+                f"central-DP mode mismatch: server dp={dp_mode}, "
+                f"upload dp={meta.get('dp', False)} — run the client "
+                f"with --dp iff the server has --dp-clip"
+            )
+        dp_crc = None
+        if dp_mode:
+            if is_delta:
+                raise wire.WireError(
+                    "sparse-delta upload in central-DP mode"
+                )
+            try:
+                dp_crc = int(meta["dp_base_crc"])
+            except (KeyError, TypeError, ValueError):
+                raise wire.WireError(
+                    "DP upload missing its dp_base_crc"
+                ) from None
+        return dp_mode, dp_crc
+
+    def _handle_stream_upload(
+        self,
+        conn: socket.socket,
+        header,
+        rnd: _Round,
+        *,
+        nonce_hex: str | None,
+        dpid: int | None,
+    ) -> None:
+        """Receive one chunk-streamed upload: validate the header's meta
+        exactly as a single-frame upload's, register the intent, then
+        decode leaves as their bytes complete and hand each to the
+        round's StreamAgg — which folds it into the running mean the
+        moment every cohort member's copy arrived. The trailer frame is
+        the upload-complete handshake; only then does the client count
+        toward the round quorum."""
+        st = rnd.stream
+        if st is None:
+            raise wire.WireError(
+                "streamed upload refused: this round aggregates masked "
+                "uploads (secure-agg), which are single-frame by design"
+            )
+        tensors, meta, chunk_bytes, payload_nbytes = wire.decode_stream_header(
+            header, auth_key=self.auth_key, max_payload=framing.MAX_FRAME
+        )
+        client_id = self._validate_upload_identity(
+            meta, nonce_hex=nonce_hex, dpid=dpid
+        )
+        if bool(meta.get("delta", False)):
+            raise wire.WireError(
+                "sparse-delta uploads are single-frame (topk payload "
+                "sizes are data-dependent; nothing to stream)"
+            )
+        if bool(meta.get("secure", False)):
+            raise wire.WireError(
+                "secure-aggregation mode mismatch: server "
+                "secure_agg=False, upload secure=True"
+            )
+        dp_mode, dp_crc = self._validate_dp_meta(meta, is_delta=False)
+        n_samples = float(meta.get("n_samples", 1.0))
+        # Duplicate stream after folds consumed the first upload: a
+        # COMPLETED original stands and this stream is DRAINED (protocol
+        # kept intact, bytes discarded) so the retrying client still gets
+        # the round's reply on its fresh connection. A half-folded
+        # IN-FLIGHT original (socket died before its trailer) is instead
+        # ADOPTED: the retry re-sends the same upload, so its leaves
+        # complete the remaining folds — the streamed twin of the
+        # dense-retry heal below; a diverging plan is drained (the fold
+        # cannot reach a correct mean from it; the round fails at close,
+        # never the server).
+        discard = False
+        adopt = False
+        with rnd.lock:
+            if rnd.closed:
+                conn.close()
+                return
+            if client_id in rnd.models or client_id in st.intents:
+                folded = not st.drop_client(client_id, poison=False)
+                if folded and client_id not in rnd.models:
+                    it = st.intents[client_id]
+                    adopt = (
+                        tuple(t["key"] for t in tensors) == tuple(it["keys"])
+                    )
+                    if adopt:
+                        # The frozen fold weights came from the original
+                        # intent; complete the round's bookkeeping with
+                        # the SAME values, not the retry's meta.
+                        n_samples = float(it["n_samples"])
+                        dp_crc = it["dp_crc"]
+                discard = folded and not adopt
+                log.info(
+                    f"[SERVER] duplicate upload from client {client_id}; "
+                    + (
+                        "draining it and keeping the already-aggregated "
+                        "original"
+                        if discard
+                        else (
+                            "adopting it to complete the half-folded "
+                            "original"
+                            if adopt
+                            else "replacing"
+                        )
+                    )
+                )
+                old = rnd.conns.pop(client_id, None)
+                if old is not None and old is not conn:
+                    old.close()
+                if not (discard or adopt):
+                    rnd.models.pop(client_id, None)
+            if not (discard or adopt):
+                st.register(
+                    client_id,
+                    keys=tuple(t["key"] for t in tensors),
+                    n_samples=n_samples,
+                    delta=False,
+                    dp_crc=dp_crc,
+                )
+            # Register the connection now: a failed round's cleanup must
+            # close a mid-stream client too, not leave it blocked.
+            rnd.conns[client_id] = conn
+            self._try_freeze_stream(rnd)
+        nonce = bytes.fromhex(nonce_hex) if nonce_hex else b""
+        # Lossy-encoded DP uploads (bf16/int8): the decode can inflate an
+        # honestly-clipped norm past the tolerance, and the dense path's
+        # answer — silently re-clip — needs the WHOLE upload before any
+        # leaf folds (a post-fold re-clip fails the round closed). Hold
+        # those leaves and join the fold at trailer time, after the same
+        # clip_flat the dense path applies; raw streams fold eagerly
+        # (lossless decode — the client-side clip stands).
+        dp_hold: dict[str, np.ndarray] | None = (
+            {}
+            if dp_mode and any(t["enc"] != "raw" for t in tensors)
+            else None
+        )
+        ti = 0
+        leaf_buf = bytearray()
+        received = 0
+        seq = 0
+        sumsq = 0.0  # running clip-enforcement norm (header key order =
+        # sorted keys = flat_l2_norm's accumulation order, bit-identical)
+
+        def _consume(data) -> None:
+            nonlocal ti, leaf_buf, sumsq
+            off = 0
+            while True:
+                while ti < len(tensors) and len(leaf_buf) == int(
+                    tensors[ti]["nbytes"]
+                ):
+                    t = tensors[ti]
+                    if not discard:
+                        arr = wire.decode_tensor_entry(t, bytes(leaf_buf))
+                        if dp_hold is not None:
+                            dp_hold[t["key"]] = arr
+                        else:
+                            if dp_mode:
+                                sumsq += float(
+                                    np.sum(np.asarray(arr, np.float64) ** 2)
+                                )
+                            st.add_leaf(client_id, t["key"], arr)
+                    leaf_buf = bytearray()
+                    ti += 1
+                if off >= len(data):
+                    return
+                if ti >= len(tensors):
+                    raise wire.WireError(
+                        "stream carries bytes past its last tensor"
+                    )
+                take = min(
+                    int(tensors[ti]["nbytes"]) - len(leaf_buf),
+                    len(data) - off,
+                )
+                leaf_buf += data[off : off + take]
+                off += take
+
+        try:
+            _consume(b"")  # zero-size leading leaves / empty payloads
+            while received < payload_nbytes:
+                frame = framing.recv_frame(conn, send_ack=False)
+                self._m_bytes_in.inc(float(len(frame)))
+                data = wire.decode_stream_chunk(
+                    frame,
+                    expect_seq=seq,
+                    auth_key=self.auth_key,
+                    nonce=nonce,
+                )
+                if not data:
+                    # A well-formed sender never chunks to zero bytes
+                    # (payload_nbytes == 0 skips this loop entirely);
+                    # accepting them would let a peer pin this handler
+                    # in a no-progress receive loop forever.
+                    raise wire.WireError(f"empty stream chunk (seq {seq})")
+                seq += 1
+                if received + len(data) > payload_nbytes:
+                    raise wire.WireError(
+                        "stream overruns its declared payload size"
+                    )
+                received += len(data)
+                _consume(data)
+            if ti != len(tensors) or leaf_buf:
+                raise wire.WireError("stream ended mid-tensor")
+            wire.decode_stream_end(
+                framing.recv_frame(conn),
+                expect_chunks=seq,
+                auth_key=self.auth_key,
+                nonce=nonce,
+            )
+            if not discard and dp_hold is not None:
+                # The dense path's exact enforcement (same functions,
+                # same accumulation order): re-clip the decoded upload,
+                # then join the fold in one piece — add_dense marks the
+                # client complete.
+                norm = wire.flat_l2_norm(dp_hold)
+                if norm > self.dp_clip * (1.0 + 1e-5):
+                    dp_hold, _, _ = wire.clip_flat(dp_hold, self.dp_clip)
+                    log.info(
+                        f"[SERVER] re-clipped client {client_id}'s "
+                        f"streamed lossy-encoded delta "
+                        f"({norm:.4g} -> {self.dp_clip})"
+                    )
+                st.add_dense(client_id, dp_hold)
+            elif not discard:
+                st.mark_complete(client_id)
+            if dp_mode and not discard and dp_hold is None:
+                # ENFORCED clipping, streamed flavor: the full-upload norm
+                # is only known now. While none of this client's leaves
+                # have folded, the re-clip is applied bit-identically to
+                # the barrier path (wire.clip_flat); once folds consumed
+                # unscaled leaves the round fails closed instead — a
+                # cheater cannot widen the mechanism's sensitivity either
+                # way, and honest clients (which clip client-side) never
+                # trigger this.
+                norm = float(np.sqrt(sumsq))
+                if norm > self.dp_clip * (1.0 + 1e-5):
+                    scale = min(1.0, self.dp_clip / max(norm, 1e-12))
+                    if not st.scale_client(client_id, scale):
+                        raise wire.WireError(
+                            f"client {client_id} exceeded its DP clip "
+                            f"({norm:.4g} > {self.dp_clip}) after folds "
+                            "already consumed its leaves — round fails "
+                            "closed"
+                        )
+                    log.info(
+                        f"[SERVER] re-clipped client {client_id}'s "
+                        f"streamed delta ({norm:.4g} -> {self.dp_clip})"
+                    )
+        except BaseException:
+            # Mid-stream death: forget the client's unfolded leaves; if
+            # folds already consumed any, the StreamAgg is poisoned and
+            # the round fails with that reason at close. Skip the drop
+            # when a retry already took over this client's slot (the
+            # round's registered connection is no longer ours) — the
+            # client's state now belongs to that retry, and dropping it
+            # here would poison a round the retry just saved.
+            if not discard:
+                with rnd.lock:
+                    if rnd.conns.get(client_id) is conn:
+                        st.drop_client(client_id)
+            raise
+        with rnd.lock:
+            if rnd.closed:
+                log.info(
+                    f"[SERVER] late upload from client {client_id} after "
+                    "round close; dropping connection"
+                )
+                conn.close()
+                return
+            if rnd.conns.get(client_id) is not conn:
+                # A retry superseded this stream mid-read (duplicate
+                # handling adopted a newer connection and owns the
+                # client's round state now); finishing here would stamp
+                # stale completion info over the retry's.
+                log.info(
+                    f"[SERVER] stream from client {client_id} superseded "
+                    "by a retry; dropping connection"
+                )
+                conn.close()
+                return
+            if not discard:
+                # Sentinel entry: the StreamAgg holds (or already folded)
+                # the actual tensors; rnd.models only tracks WHO completed.
+                rnd.models[client_id] = {}
+                rnd.deltas[client_id] = False
+                if dp_crc is not None:
+                    rnd.dp_crcs[client_id] = dp_crc
+                rnd.n_samples[client_id] = n_samples
+            if bool(meta.get("wants_delta", False)):
+                rnd.wants_delta = True
+            rnd.conns[client_id] = conn
+            if nonce_hex is not None:
+                rnd.nonces[client_id] = nonce_hex
+            if not discard:
+                # Under rnd.lock: per-client handler threads are the only
+                # concurrent writers of this counter (every other
+                # stream_totals mutation is on the serve_round thread).
+                # Drained duplicates contributed nothing — the counters
+                # (and /metrics' "accepted into a round" totals) only
+                # count uploads that did.
+                self.stream_totals["stream_uploads"] += 1
+            done = self._round_done(rnd)
+        if discard:
+            log.info(
+                f"[SERVER] drained duplicate stream from client "
+                f"{client_id} ({payload_nbytes / 1e6:.1f} MB discarded)"
+            )
+        else:
+            self._m_uploads.inc()
+            self._m_stream_uploads.inc()
+            log.info(
+                f"[SERVER] received streamed model from client {client_id} "
+                f"({payload_nbytes / 1e6:.1f} MB in {seq} chunk(s); "
+                f"{len(rnd.models)}/{rnd.expected})"
+            )
+        if done:
+            rnd.complete.set()
 
     def _client_wire_key(self, cid: int) -> bytes | None:
         """The key server<->client control frames (reveal/unmask/shares)
@@ -1219,6 +1714,22 @@ class AggregationServer:
                 f"[SERVER] round {rnd.round_no} Poisson cohort "
                 f"(q={self.dp_participation}): {sorted(rnd.cohort)}"
             )
+        if not self.secure_agg:
+            # Incremental fold state for every plain/DP upload, streamed
+            # or single-frame. eager=False (streaming disabled) holds all
+            # uploads and folds only at close — the exact barrier shape.
+            # Quorum deployments (min_clients < num_clients) also fold at
+            # close: an eager fold commits to the full contributor set,
+            # so one mid-stream death after folds began would fail a
+            # round that the barrier shape completes over the survivors
+            # — eager folding must not silently change those failure
+            # semantics. Full-participation rounds (the default) lose
+            # nothing: a death fails them under either shape.
+            rnd.stream = StreamAgg(
+                eager=self.stream_chunk_bytes > 0
+                and self.min_clients >= self.num_clients,
+                base=self._last_agg,
+            )
         deadline = time.monotonic() + (self.timeout if deadline is None else deadline)
         threads: list[threading.Thread] = []
         listener_closed = False
@@ -1284,8 +1795,13 @@ class AggregationServer:
 
         # Everything up to here — accept loop, straggler wait, upload
         # reads — is the round's "wait" phase; aggregation compute and
-        # the reply fan-out are timed separately below.
+        # the reply fan-out are timed separately below. Leaf folds that
+        # already ran (handler threads, overlapped with the wire) were
+        # hidden inside it — that overlap is what the wire-overlap span
+        # and comm_overlap_frac report.
         wait_s = time.monotonic() - t_round0
+        if rnd.stream is not None:
+            rnd.stream.mark_wait_end()
 
         with rnd.lock:
             rnd.closed = True
@@ -1311,17 +1827,18 @@ class AggregationServer:
                     "cohort — no-op round, replying noop to "
                     f"{len(skip_conns)} client(s)"
                 )
+                noop_meta = {
+                    "round_clients": [],
+                    "agg_round": rnd.round_no,
+                    "dp_reply": "noop",
+                    "trace": rnd.trace,
+                }
+                if self.stream_chunk_bytes > 0 and not self.secure_agg:
+                    noop_meta[wire.STREAM_META_KEY] = self.stream_chunk_bytes
                 self._reply_all(
                     {
                         cid: self._encode_reply(
-                            {},
-                            {
-                                "round_clients": [],
-                                "agg_round": rnd.round_no,
-                                "dp_reply": "noop",
-                                "trace": rnd.trace,
-                            },
-                            nonces.get(cid),
+                            {}, noop_meta, nonces.get(cid)
                         )
                         for cid in skip_conns
                     },
@@ -1543,23 +2060,37 @@ class AggregationServer:
                 )
             else:
                 weights = [n_samples[i] for i in ids] if self.weighted else None
-                # Sparse-delta uploads become absolute models against the
-                # last aggregate (validated against it at upload time), so
-                # dense and sparse clients mix freely in one round.
-                absolute = [
-                    {
-                        k: self._last_agg[k] + np.asarray(v, np.float32)
-                        for k, v in models[i].items()
-                    }
-                    if deltas.get(i)
-                    else models[i]
-                    for i in ids
-                ]
-                agg = aggregate_flat(absolute, weights)
+                # Incremental fold (comm/stream_agg.py): leaves already
+                # folded during the wait phase — overlapped with the wire
+                # — are reused; whatever remains folds here. Sparse-delta
+                # uploads become absolute models against the last
+                # aggregate at fold time (validated at upload time), so
+                # dense, sparse, and streamed clients mix freely in one
+                # round. The result is BIT-EXACT with the barrier
+                # aggregate_flat (same fp32 ops, same ascending-id order
+                # per leaf — pinned by the parity tests).
+                try:
+                    agg = rnd.stream.finalize(ids, weights)
+                except wire.WireError as e:
+                    # Incomplete fold input (a superseded stream whose
+                    # retry diverged, a key-set mismatch): serve()'s
+                    # contract is that this fails the ROUND, not the
+                    # server — WireError is a ValueError and would
+                    # otherwise escape serve()'s RuntimeError guard.
+                    raise RuntimeError(
+                        f"streamed aggregation failed: {e}"
+                    ) from e
                 n_sparse = sum(bool(deltas.get(i)) for i in ids)
+                s_stats = rnd.stream.stats()
                 log.info(
                     f"[SERVER] aggregated {len(ids)} models (clients {ids}"
                     + (f", {n_sparse} sparse-delta" if n_sparse else "")
+                    + (
+                        f"; {s_stats['overlap_frac']:.0%} of fold input "
+                        "consumed during the wire phase"
+                        if s_stats["early_bytes"]
+                        else ""
+                    )
                     + ")"
                 )
             if dp_mode:
@@ -1683,6 +2214,11 @@ class AggregationServer:
                 }
                 if rnd.wants_delta and not self.secure_agg:
                     reply_meta["agg_crc"] = wire.flat_crc32(agg)
+            if self.stream_chunk_bytes > 0 and not self.secure_agg:
+                # Streamed-upload capability advert (same pattern as the
+                # trace field): capable clients chunk-stream their NEXT
+                # upload; old peers ignore the extra meta key.
+                reply_meta[wire.STREAM_META_KEY] = self.stream_chunk_bytes
             # Sitting-out clients (cohort sampling) receive the identical
             # reply: the aggregate is the round's public output and their
             # bases must track the fleet's.
@@ -1779,13 +2315,45 @@ class AggregationServer:
         failed: bool = False,
     ) -> None:
         """Close a round's observability: accumulate the wait/agg/reply
-        phase seconds (process totals AND /metrics counters) and emit the
-        round span."""
+        phase seconds (process totals AND /metrics counters), fold the
+        round's streaming stats into the cross-round totals (plus the
+        ``wire-overlap`` span when any fold overlapped the wire), and
+        emit the round span."""
         for name, dur in (("wait", wait_s), ("agg", agg_s), ("reply", reply_s)):
             self.phase_seconds[name] += dur
             self._m_phase[name].inc(max(dur, 0.0))
         if failed:
             self._m_round_failures.inc()
+        if rnd.stream is not None:
+            s = rnd.stream.stats()
+            tot = self.stream_totals
+            tot["early_bytes"] += s["early_bytes"]
+            tot["late_bytes"] += s["late_bytes"]
+            tot["early_s"] += s["early_s"]
+            tot["late_s"] += s["late_s"]
+            tot["peak_agg_bytes"] = max(
+                tot["peak_agg_bytes"], s["peak_bytes"]
+            )
+            # Last ROUND's peak separately: a mixed campaign's first
+            # (dense, pre-advert) round peaks at O(clients x model) and
+            # would mask the streamed rounds' O(model + in-flight) in
+            # the cross-round max.
+            tot["last_round_peak_bytes"] = s["peak_bytes"]
+            self._g_peak_agg.set(float(s["peak_bytes"]))
+            if self.tracer is not None and s["early_s"] > 0.0:
+                # Overlapped-vs-exposed wire attribution: how much fold
+                # work ran DURING the wait phase (hidden behind other
+                # clients' transfers) — the obs timeline's overlap row.
+                self.tracer.record(
+                    "wire-overlap",
+                    t_start=s["first_fold_unix"] or t_unix,
+                    dur_s=s["early_s"],
+                    trace=rnd.trace,
+                    round=rnd.round_no,
+                    folded_bytes=s["early_bytes"],
+                    overlap_frac=round(s["overlap_frac"], 4),
+                    peak_agg_bytes=s["peak_bytes"],
+                )
         if self.tracer is not None:
             self.tracer.record(
                 "round",
@@ -1833,6 +2401,16 @@ class AggregationServer:
             t.start()
         for t in reply_threads:
             t.join(timeout=self.timeout)
+
+    def comm_overlap_frac(self) -> float:
+        """Bytes-weighted fraction of this server's aggregation input
+        folded while the round's wire phase was still active (0.0 on a
+        pure barrier run) — the bench's ``comm_overlap_frac`` headline."""
+        tot = (
+            self.stream_totals["early_bytes"]
+            + self.stream_totals["late_bytes"]
+        )
+        return self.stream_totals["early_bytes"] / tot if tot else 0.0
 
     def serve(self, rounds: int = 1) -> None:
         """Multi-round loop: one failed round (quorum missed, DP base

@@ -28,6 +28,29 @@ quantization, each leading-axis row carrying its own fp32 scale
 per-weight error is half a quantization step (~0.4% of the row's max) —
 lossier than bf16; an opt-in bandwidth/fidelity trade for slow links.
 
+**Streamed uploads** (PR 5): a capability-negotiated alternative to the
+single ``FTPW`` frame for model-sized uploads. The server advertises
+``meta["stream"] = <chunk bytes>`` in its aggregate replies (plain meta,
+exactly like the ``trace`` field — old peers ignore it and keep sending
+single frames); a capable client then ships its NEXT upload as::
+
+    STRH frame   stream header: magic + u32 version + u32 header_len +
+                 header JSON (the same tensor table/meta as FTPW, plus
+                 chunk_bytes) [+ 32-byte HMAC tag in auth mode]
+    STRC frames  sequential payload chunks: magic + u64 seq + bytes
+                 [+ tag]; sent fire-and-forget (framing await_ack=False)
+                 so chunk k+1 is packed while k is on the wire
+    STRT frame   trailer: magic + u64 chunk count [+ tag]; ACKed — the
+                 upload-complete handshake
+
+Tensor extents in a stream header must be CONTIGUOUS (offset 0, each
+tensor abutting the previous): the receiver decodes leaf-by-leaf as
+chunk bytes arrive and never materializes the whole payload. Integrity
+is per-frame (framing CRC); in auth mode every frame carries its own
+HMAC tag bound to the connection nonce and chunk sequence number, so the
+receiver can fold a chunk into its running aggregate the moment it
+arrives without trusting unauthenticated bytes.
+
 ``compression="topk"`` / ``"topk:<frac>"`` keeps only the largest-magnitude
 ``frac`` of each fp32 tensor's entries (default 1%): per-tensor payload is
 ``u32 k | int32 indices[k] | fp32 values[k]`` — 8 bytes per kept entry, so
@@ -117,6 +140,35 @@ SCORE_REJ_MAGIC = b"SCRJ"
 #: is the reference-style open protocol, as before.
 SCORE_AUTH_MAGIC = b"SCAU"
 SCORE_AUTH_DOMAIN = b"fedtpu-score-auth-v1"
+#: Streamed-upload frames (module docstring "Streamed uploads"): header,
+#: sequential payload chunk, trailer. The capability rides reply meta
+#: under STREAM_META_KEY as the server's preferred chunk byte count.
+STREAM_MAGIC = b"STRH"
+STREAM_CHUNK_MAGIC = b"STRC"
+STREAM_END_MAGIC = b"STRT"
+STREAM_META_KEY = "stream"
+DEFAULT_STREAM_CHUNK = 4 << 20  # 4 MiB: bounds receiver buffering
+#: Worst-case STRC frame bytes beyond the chunk data itself (magic + u64
+#: seq + auth tag). A configured/advertised chunk size must leave this
+#: headroom under framing.MAX_FRAME, or the largest chunk would encode
+#: into a frame the transport refuses to send.
+STREAM_CHUNK_OVERHEAD = len(STREAM_CHUNK_MAGIC) + 8 + AUTH_TAG_LEN
+
+
+def stream_chunk_bytes_from_mb(mb) -> int:
+    """CLI ``--stream-chunk-mb`` value -> advertised chunk bytes
+    (``None`` = the default advert). Shared by serve and controller so
+    the two entrypoints can never diverge on the conversion rule."""
+    if mb is None:
+        return DEFAULT_STREAM_CHUNK
+    return int(float(mb) * (1 << 20))
+_STREAM_HDR_DOMAIN = b"fedtpu-stream-hdr-v1"
+_STREAM_CHK_DOMAIN = b"fedtpu-stream-chk-v1"
+_STREAM_END_DOMAIN = b"fedtpu-stream-end-v1"
+#: Leaf encodings a stream may carry: the fixed-size ones whose encoded
+#: byte count is computable from (dtype, shape) alone, so the header can
+#: be built before any leaf is gathered off-device.
+_STREAM_ENCS = ("raw", "bf16", "int8")
 _ALLOWED_DTYPES = {
     "float32", "float64", "float16", "bfloat16",
     "int8", "int16", "int32", "int64",
@@ -317,8 +369,13 @@ def flat_crc32(flat: Mapping[str, Any]) -> int:
 
 
 # ------------------------------------------------------- pytree <-> flat
-def flatten_params(tree: Any, *, sep: str = "/") -> dict[str, np.ndarray]:
-    """Nested dict of arrays -> sorted flat ``{'a/b/c': ndarray}``."""
+def flatten_params(
+    tree: Any, *, sep: str = "/", leaf_fn=np.asarray
+) -> dict[str, np.ndarray]:
+    """Nested dict of arrays -> sorted flat ``{'a/b/c': ndarray}``.
+    ``leaf_fn`` is the leaf conversion — the ONE recursive walk (key
+    validation included) serves both the eager wire path and
+    :func:`flatten_lazy`'s deferred-gather variant."""
     out: dict[str, np.ndarray] = {}
 
     def _walk(node, prefix):
@@ -328,7 +385,7 @@ def flatten_params(tree: Any, *, sep: str = "/") -> dict[str, np.ndarray]:
                     raise WireError(f"param key {key!r} contains separator {sep!r}")
                 _walk(node[key], f"{prefix}{sep}{key}" if prefix else str(key))
         else:
-            out[prefix] = np.asarray(node)
+            out[prefix] = leaf_fn(node)
 
     _walk(tree, "")
     return dict(sorted(out.items()))
@@ -431,6 +488,26 @@ def encode(
     return msg
 
 
+def decode_tensor_entry(t: Mapping[str, Any], raw) -> np.ndarray:
+    """One tensor-table entry's payload bytes -> ndarray. The shared
+    per-leaf decoder of the single-frame path (:func:`decode`) and the
+    streamed path (leaves decode as their bytes complete) — one
+    implementation so the two can never disagree on decoded values."""
+    dtype = t["dtype"]
+    if dtype not in _ALLOWED_DTYPES:
+        raise WireError(f"tensor {t.get('key')!r} has unsupported dtype {dtype}")
+    if t["enc"] == "bf16":
+        packed = np.frombuffer(raw, np.uint16)
+        return native.unpack_bf16(packed, shape=tuple(t["shape"]))
+    if t["enc"] == "int8":
+        return dequantize_int8(raw, tuple(t["shape"]))
+    if t["enc"] == "topk":
+        return densify_topk(raw, tuple(t["shape"]))
+    if t["enc"] == "raw":
+        return np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(t["shape"])
+    raise WireError(f"unknown tensor encoding {t['enc']!r}")
+
+
 # ----------------------------------------------------------------- decode
 def decode(
     data: bytes | memoryview, *, auth_key: bytes | None = None
@@ -515,18 +592,7 @@ def decode(
                 # payload's tail and alias another tensor's bytes.
                 raise WireError(f"tensor {key!r} has out-of-bounds extent")
             raw = payload[offset : offset + nbytes]
-            if t["enc"] == "bf16":
-                packed = np.frombuffer(raw, np.uint16)
-                arr = native.unpack_bf16(packed, shape=tuple(t["shape"]))
-            elif t["enc"] == "int8":
-                arr = dequantize_int8(raw, tuple(t["shape"]))
-            elif t["enc"] == "topk":
-                arr = densify_topk(raw, tuple(t["shape"]))
-            elif t["enc"] == "raw":
-                arr = np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(t["shape"])
-            else:
-                raise WireError(f"unknown tensor encoding {t['enc']!r}")
-            flat[key] = arr
+            flat[key] = decode_tensor_entry(t, raw)
         return unflatten_params(flat), dict(header.get("meta", {}))
     except WireError:
         raise
@@ -536,3 +602,266 @@ def decode(
         # reachable from attacker-controlled headers and must surface as
         # WireError, not kill a server thread.
         raise WireError(f"malformed tensor table: {e}") from None
+
+
+# ------------------------------------------------------- streamed uploads
+def flatten_lazy(tree: Any, *, sep: str = "/") -> dict[str, Any]:
+    """Like :func:`flatten_params` but WITHOUT ``np.asarray`` on leaves:
+    device-backed arrays (a meshed TCP client's replicated params) stay
+    on device, so the streamed upload's packer can gather leaf k+1 to
+    host while chunk k is already on the wire. Leaves only need
+    ``.shape``/``.dtype`` for the plan; an already-flat dict passes
+    through (sorted)."""
+    def _leaf(node):
+        # Shape/dtype metadata is all the plan needs; anything without it
+        # (a python scalar) is converted now — it is tiny by definition.
+        if isinstance(node, PreEncoded) or (
+            hasattr(node, "dtype") and hasattr(node, "shape")
+        ):
+            return node
+        return np.asarray(node)
+
+    if isinstance(tree, Mapping) and tree and all(
+        not isinstance(v, Mapping) for v in tree.values()
+    ):
+        return dict(sorted((str(k), _leaf(v)) for k, v in tree.items()))
+    return flatten_params(tree, sep=sep, leaf_fn=_leaf)
+
+
+def _leaf_plan(key: str, leaf: Any, compression: str) -> dict:
+    """One tensor-table entry (enc + exact encoded byte count) computed
+    from metadata alone — no host gather, no encode."""
+    if isinstance(leaf, PreEncoded):
+        return {
+            "key": key,
+            "dtype": leaf.dtype,
+            "shape": list(leaf.shape),
+            "enc": leaf.enc,
+            "nbytes": len(leaf.buf),
+        }
+    dtype = str(np.dtype(leaf.dtype))
+    if dtype not in _ALLOWED_DTYPES:
+        raise WireError(f"tensor {key!r} has unsupported dtype {dtype}")
+    shape = tuple(int(s) for s in leaf.shape)
+    size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    if compression == "bf16" and dtype == "float32":
+        enc, nbytes = "bf16", 2 * size
+    elif compression == "int8" and dtype == "float32":
+        rows = shape[0] if len(shape) >= 2 else 1
+        enc, nbytes = "int8", 4 * rows + size
+    else:
+        enc, nbytes = "raw", size * np.dtype(dtype).itemsize
+    return {"key": key, "dtype": dtype, "shape": list(shape), "enc": enc,
+            "nbytes": nbytes}
+
+
+def plan_stream(
+    flat: Mapping[str, Any], compression: str = "none"
+) -> tuple[list[dict], int]:
+    """Flat (possibly lazy) param dict -> (contiguous tensor table,
+    payload_nbytes). ``topk`` is not plannable (its encoded size depends
+    on the values) — sparse-delta clients keep the single-frame path."""
+    comp, _ = parse_compression(compression)
+    if comp == "topk":
+        raise WireError("topk uploads cannot be streamed (size is data-dependent)")
+    tensors: list[dict] = []
+    offset = 0
+    for key, leaf in flat.items():
+        t = _leaf_plan(key, leaf, comp)
+        t["offset"] = offset
+        offset += int(t["nbytes"])
+        tensors.append(t)
+    return tensors, offset
+
+
+def encode_stream_leaf(leaf: Any, enc: str) -> bytes:
+    """Materialize one planned leaf's payload bytes (the single host
+    gather for a device-backed leaf happens here, at pack time)."""
+    if isinstance(leaf, PreEncoded):
+        return leaf.buf
+    arr = np.asarray(leaf)
+    if enc == "bf16":
+        return np.ascontiguousarray(native.pack_bf16(arr)).tobytes()
+    if enc == "int8":
+        return quantize_int8(arr)
+    if enc == "raw":
+        return np.ascontiguousarray(arr).tobytes()
+    raise WireError(f"unknown stream leaf encoding {enc!r}")
+
+
+def _stream_tag(domain: bytes, auth_key: bytes, nonce: bytes, body: bytes) -> bytes:
+    return hmac_mod.new(auth_key, domain + nonce + body, hashlib.sha256).digest()
+
+
+def encode_stream_header(
+    tensors: list[dict],
+    *,
+    meta: Mapping[str, Any] | None = None,
+    chunk_bytes: int,
+    payload_nbytes: int,
+    auth_key: bytes | None = None,
+) -> bytes:
+    """Build the STRH frame payload. In auth mode the tag covers the full
+    prefix (magic + version + header JSON); replay protection comes from
+    the connection nonce the meta already carries (same contract as the
+    single-frame upload's freshness check)."""
+    header = {
+        "tensors": tensors,
+        "payload_nbytes": int(payload_nbytes),
+        "chunk_bytes": int(chunk_bytes),
+        "meta": dict(meta or {}),
+    }
+    if auth_key is not None:
+        header["auth"] = _AUTH_SCHEME
+    hbytes = json.dumps(header, separators=(",", ":")).encode()
+    msg = STREAM_MAGIC + struct.pack("<II", VERSION, len(hbytes)) + hbytes
+    if auth_key is not None:
+        msg += _stream_tag(_STREAM_HDR_DOMAIN, auth_key, b"", msg)
+    return msg
+
+
+def decode_stream_header(
+    data, *, auth_key: bytes | None = None, max_payload: int = 8 << 30
+) -> tuple[list[dict], dict, int, int]:
+    """STRH frame -> (tensor table, meta, chunk_bytes, payload_nbytes).
+
+    Validates everything the single-frame decoder validates — dtype
+    allowlist, stream-safe encodings, extent bounds — plus the streamed
+    path's extra invariant: tensor extents must be contiguous (offset 0,
+    each abutting the previous, total == payload_nbytes), which is what
+    lets the receiver decode leaves in one sequential pass."""
+    view = memoryview(data)
+    if len(view) < 12 or bytes(view[:4]) != STREAM_MAGIC:
+        raise WireError("bad magic: not a stream header")
+    version, hlen = struct.unpack("<II", view[4:12])
+    if version != VERSION:
+        raise WireError(f"stream version {version} unsupported (expected {VERSION})")
+    if len(view) < 12 + hlen:
+        raise WireError("truncated stream header")
+    body_end = 12 + hlen
+    try:
+        header = json.loads(bytes(view[12:body_end]).decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireError(f"malformed stream header: {e}") from None
+    auth = header.get("auth")
+    if auth not in (None, _AUTH_SCHEME):
+        raise WireError(f"unknown auth scheme {auth!r}")
+    if auth_key is not None:
+        if auth != _AUTH_SCHEME:
+            raise WireError(
+                f"unauthenticated stream rejected (this side requires {_AUTH_SCHEME})"
+            )
+        if len(view) != body_end + AUTH_TAG_LEN:
+            raise WireError("stream header missing its auth tag")
+        want = _stream_tag(
+            _STREAM_HDR_DOMAIN, auth_key, b"", bytes(view[:body_end])
+        )
+        if not hmac_mod.compare_digest(bytes(view[body_end:]), want):
+            raise WireError("stream header HMAC verification failed")
+    try:
+        tensors = list(header["tensors"])
+        payload_nbytes = int(header["payload_nbytes"])
+        chunk_bytes = int(header["chunk_bytes"])
+        if not 0 < chunk_bytes <= max_payload:
+            raise WireError(f"stream chunk_bytes {chunk_bytes} out of range")
+        if not 0 <= payload_nbytes <= max_payload:
+            raise WireError(f"stream payload {payload_nbytes} out of range")
+        offset = 0
+        for t in tensors:
+            if t.get("enc") not in _STREAM_ENCS:
+                raise WireError(
+                    f"tensor {t.get('key')!r} has non-streamable encoding "
+                    f"{t.get('enc')!r}"
+                )
+            if t["dtype"] not in _ALLOWED_DTYPES:
+                raise WireError(
+                    f"tensor {t.get('key')!r} has unsupported dtype {t['dtype']}"
+                )
+            if int(t["offset"]) != offset or int(t["nbytes"]) < 0:
+                raise WireError(
+                    f"tensor {t.get('key')!r} breaks the stream's contiguous "
+                    "extent invariant"
+                )
+            offset += int(t["nbytes"])
+        if offset != payload_nbytes:
+            raise WireError(
+                f"tensor extents sum to {offset}, header claims "
+                f"{payload_nbytes} payload bytes"
+            )
+        keys = [t["key"] for t in tensors]
+        if len(set(keys)) != len(keys):
+            raise WireError("duplicate tensor key in stream header")
+        return tensors, dict(header.get("meta", {})), chunk_bytes, payload_nbytes
+    except WireError:
+        raise
+    except (KeyError, ValueError, TypeError, OverflowError, AttributeError) as e:
+        raise WireError(f"malformed stream tensor table: {e}") from None
+
+
+def encode_stream_chunk(
+    seq: int, data: bytes, *, auth_key: bytes | None = None, nonce: bytes = b""
+) -> bytes:
+    body = STREAM_CHUNK_MAGIC + struct.pack("<Q", seq) + data
+    if auth_key is not None:
+        body += _stream_tag(_STREAM_CHK_DOMAIN, auth_key, nonce, body)
+    return body
+
+
+def decode_stream_chunk(
+    frame,
+    *,
+    expect_seq: int,
+    auth_key: bytes | None = None,
+    nonce: bytes = b"",
+):
+    """STRC frame -> chunk bytes (memoryview). Verifying the per-chunk
+    tag BEFORE returning is what lets the server fold the chunk into its
+    running aggregate immediately: every folded byte was authenticated,
+    so a key-less attacker can't poison a round mid-stream."""
+    view = memoryview(frame)
+    n_magic = len(STREAM_CHUNK_MAGIC)
+    tag_len = AUTH_TAG_LEN if auth_key is not None else 0
+    if len(view) < n_magic + 8 + tag_len or bytes(view[:n_magic]) != STREAM_CHUNK_MAGIC:
+        raise WireError("bad stream chunk frame")
+    (seq,) = struct.unpack("<Q", view[n_magic : n_magic + 8])
+    if seq != expect_seq:
+        raise WireError(f"stream chunk out of order (got {seq}, want {expect_seq})")
+    body_end = len(view) - tag_len
+    if auth_key is not None:
+        want = _stream_tag(
+            _STREAM_CHK_DOMAIN, auth_key, nonce, bytes(view[:body_end])
+        )
+        if not hmac_mod.compare_digest(bytes(view[body_end:]), want):
+            raise WireError(f"stream chunk {seq} HMAC verification failed")
+    return view[n_magic + 8 : body_end]
+
+
+def encode_stream_end(
+    n_chunks: int, *, auth_key: bytes | None = None, nonce: bytes = b""
+) -> bytes:
+    body = STREAM_END_MAGIC + struct.pack("<Q", n_chunks)
+    if auth_key is not None:
+        body += _stream_tag(_STREAM_END_DOMAIN, auth_key, nonce, body)
+    return body
+
+
+def decode_stream_end(
+    frame, *, expect_chunks: int, auth_key: bytes | None = None, nonce: bytes = b""
+) -> None:
+    view = memoryview(frame)
+    n_magic = len(STREAM_END_MAGIC)
+    tag_len = AUTH_TAG_LEN if auth_key is not None else 0
+    if len(view) != n_magic + 8 + tag_len or bytes(view[:n_magic]) != STREAM_END_MAGIC:
+        raise WireError("bad stream trailer frame")
+    (n,) = struct.unpack("<Q", view[n_magic : n_magic + 8])
+    if n != expect_chunks:
+        raise WireError(
+            f"stream trailer claims {n} chunks, received {expect_chunks}"
+        )
+    if auth_key is not None:
+        body_end = len(view) - tag_len
+        want = _stream_tag(
+            _STREAM_END_DOMAIN, auth_key, nonce, bytes(view[:body_end])
+        )
+        if not hmac_mod.compare_digest(bytes(view[body_end:]), want):
+            raise WireError("stream trailer HMAC verification failed")

@@ -469,8 +469,18 @@ class ControlConfig:
     # Per-round deadline handed to the TCP round engine (None = the
     # server's own timeout).
     round_deadline_s: float | None = None
+    # Registry GC budget: after every promotion/rejection the controller
+    # prunes oldest RETIRED/REJECTED artifacts beyond this count (the
+    # serving artifact and its rollback chain are never pruned —
+    # registry/store.py gc()). None (default) keeps everything.
+    max_artifacts: int | None = None
 
     def __post_init__(self) -> None:
+        if self.max_artifacts is not None and self.max_artifacts < 1:
+            raise ValueError(
+                f"max_artifacts={self.max_artifacts} must be >= 1 "
+                "(or None to keep everything)"
+            )
         if self.drift_method not in ("psi", "ks"):
             raise ValueError(
                 f"drift_method={self.drift_method!r} must be 'psi' or 'ks'"
@@ -525,12 +535,24 @@ class ObsConfig:
     #: Run identity stamped on every span and metrics record. None =
     #: FEDTPU_RUN_ID env var, else a fresh per-process id.
     run_id: str | None = None
+    #: Span sampling rate for HIGH-RATE span streams (today: the serving
+    #: tier's per-coalesced-batch ``serve-batch`` spans): emit one span
+    #: per ~1/rate batches via a deterministic batch-counter stride (no
+    #: RNG — reruns sample identically), each carrying
+    #: ``sampled_batches`` so consumers can re-scale. 1.0 = every batch.
+    #: Round-scoped spans (round/agg/wire-*) are never sampled — they
+    #: are one-per-round by construction.
+    trace_sample: float = 1.0
 
     def __post_init__(self) -> None:
         if not 0 <= self.metrics_port <= 65535:
             raise ValueError(
                 f"metrics_port={self.metrics_port} must be a port in "
                 "[0, 65535] (0 = off)"
+            )
+        if not 0.0 < self.trace_sample <= 1.0:
+            raise ValueError(
+                f"trace_sample={self.trace_sample} must be in (0, 1]"
             )
 
 

@@ -300,6 +300,19 @@ class Controller:
             )
             return {"event": "cycle_error", **rec}
 
+    def _maybe_gc(self) -> None:
+        """Registry GC after a promotion/rejection moved the state
+        machine (ControlConfig.max_artifacts): prune oldest retired/
+        rejected artifacts beyond the budget. A GC failure is logged,
+        never fatal — disk hygiene must not fail a healthy round."""
+        budget = self.control.max_artifacts
+        if budget is None:
+            return
+        try:
+            self.registry.gc(max_artifacts=budget)
+        except (OSError, RegistryError) as e:
+            log.info(f"[CONTROLLER] registry gc failed (non-fatal): {e}")
+
     def _gate_and_promote(
         self, r: int, trigger: str, agg: dict, *, t_end: float, round_wall: float
     ) -> dict:
@@ -374,6 +387,7 @@ class Controller:
             self.stats.gate_rejections += 1
             self._m_gate_rejections.inc()
             self.registry.reject(aid, reason=reason)
+            self._maybe_gc()
             rec["incumbent"] = incumbent["id"] if incumbent else None
             self._record("gate_rejected", **rec)
             log.info(
@@ -409,6 +423,7 @@ class Controller:
         rec["promotion_latency_s"] = round(latency, 4)
         if self.drift is not None and eval_hist is not None:
             self.drift.set_reference(eval_hist)
+        self._maybe_gc()
         self._record("promoted", **rec)
         log.info(
             f"[CONTROLLER] round {r}: promoted {aid} to serving "

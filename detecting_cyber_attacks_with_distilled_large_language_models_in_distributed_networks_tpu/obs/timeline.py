@@ -101,6 +101,13 @@ def round_breakdown(spans: list[dict]) -> dict:
     )
     agg_s = agg["dur_s"] if agg else 0.0
     reply_s = srv_reply["dur_s"] if srv_reply else 0.0
+    # Streaming chunk aggregation (comm/stream_agg.py): fold work that
+    # ran DURING the wire phase — hidden inside clients' wait, so it
+    # joins no per-client sum; reported as the round's overlapped-vs-
+    # exposed wire attribution instead. The exposed aggregation time is
+    # the ``agg`` span as before.
+    overlap = _one(spans, "wire-overlap", proc=srv_proc) if srv_proc else None
+    overlap_s = overlap["dur_s"] if overlap else 0.0
     round_span = _one(spans, "round")
     client_procs = sorted(
         {
@@ -140,6 +147,11 @@ def round_breakdown(spans: list[dict]) -> dict:
         "round_wall_s": round_span["dur_s"] if round_span else None,
         "agg_s": agg_s,
         "reply_s": reply_s,
+        "overlap_s": overlap_s,
+        "overlap_frac": overlap.get("overlap_frac") if overlap else None,
+        "peak_agg_bytes": (
+            overlap.get("peak_agg_bytes") if overlap else None
+        ),
         "clients": clients,
         "slowest_span": (
             {
@@ -188,10 +200,22 @@ def timeline_table(
                     f"{row['reply_s']:>8.3f}s {row['attributed_s']:>8.3f}s "
                     f"{row['measured_s']:>8.3f}s"
                 )
+        if b["overlap_s"] > 0.0:
+            # Overlapped vs exposed wire/aggregation time: fold seconds
+            # hidden inside the wire phase, next to the exposed agg.
+            frac = b["overlap_frac"]
+            peak = b["peak_agg_bytes"]
+            out.append(
+                f"  wire-overlap   {b['overlap_s']:>8.3f}s folded during "
+                "the wire phase"
+                + (f" ({frac:.0%} of fold input)" if frac is not None else "")
+                + (f", peak agg {peak / 1e6:.1f} MB" if peak else "")
+            )
         extra = [
             s
             for s in groups[key]
-            if s["span"] in ("eval-gate", "promote", "serve-batch")
+            if s["span"]
+            in ("eval-gate", "promote", "serve-batch", "batch-prefetch")
         ]
         for s in extra:
             out.append(

@@ -22,12 +22,20 @@ Span vocabulary (names are the contract the timeline tool groups by)::
 
     round         one aggregation round, server side (contains agg/reply)
     client-local  a client's local training phase
-    wire-upload   a client's model upload send
-    agg           the server's aggregation compute
+    wire-upload   a client's model upload send (streamed uploads carry
+                  ``chunks`` + ``overlap_s``: pack/send seconds hidden by
+                  running the two concurrently)
+    wire-overlap  server-side: aggregation folds that ran DURING the wire
+                  phase (streaming chunk aggregation) — overlapped wire
+                  time, with ``overlap_frac`` and ``peak_agg_bytes``
+    agg           the server's EXPOSED aggregation compute
     wire-reply    the reply transfer (server: fan-out; client: recv)
+    batch-prefetch  a client's next-round input-pipeline work that ran
+                  under the reply wait (train/batches.EpochPrefetcher)
     eval-gate     the controller's held-out eval + gate decision
     promote       a registry state transition / pointer swap
     serve-batch   one coalesced scoring dispatch on the serving tier
+                  (``sampled_batches`` when span sampling is on)
 
 Timestamps are wall-clock unix seconds (``ts``) with a separately
 measured monotonic duration (``dur_s``): cross-process correlation needs
@@ -53,8 +61,10 @@ SPAN_NAMES = (
     "round",
     "client-local",
     "wire-upload",
+    "wire-overlap",
     "agg",
     "wire-reply",
+    "batch-prefetch",
     "eval-gate",
     "promote",
     "serve-batch",

@@ -360,6 +360,76 @@ class ModelRegistry:
         return m
 
 
+    # ------------------------------------------------------------------- gc
+    def gc(self, *, max_artifacts: int) -> list[str]:
+        """Prune oldest RETIRED/REJECTED artifacts until at most
+        ``max_artifacts`` remain on disk (an unattended controller's
+        registry otherwise grows by one model-sized artifact per round,
+        forever). Returns the pruned ids, oldest first.
+
+        Never pruned, regardless of the budget:
+
+        * the serving artifact and every id on the pointer's rollback
+          ``history`` chain — ``registry rollback`` must always have its
+          targets;
+        * live ladder states (``candidate``/``shadow``): they are still
+          in flight toward the pointer, not garbage.
+
+        When the protected set alone exceeds ``max_artifacts`` nothing
+        beyond the eligible artifacts is touched — gc refuses to break
+        the rollback chain rather than honoring the number."""
+        if max_artifacts < 1:
+            raise RegistryError(
+                f"max_artifacts={max_artifacts} must be >= 1"
+            )
+        protected: set[str] = set()
+        info = self.serving_info()
+        if info is not None:
+            protected.add(info["artifact"])
+            protected.update(
+                h for h in info.get("history", []) if h is not None
+            )
+        manifests = self.list()  # oldest first
+        excess = len(manifests) - int(max_artifacts)
+        removed: list[str] = []
+        if excess <= 0:
+            return removed
+        import shutil
+
+        for m in manifests:
+            if excess <= 0:
+                break
+            aid = m["id"]
+            if aid in protected:
+                continue
+            if m.get("state") not in ("retired", "rejected"):
+                continue
+            path = os.path.join(self._artifacts, aid)
+            shutil.rmtree(path, ignore_errors=True)
+            if os.path.exists(path):
+                # A failed deletion (permissions, held-open file) must
+                # not be recorded as pruned — the events trail would
+                # permanently misreport and every later gc would
+                # "re-prune" it while the registry exceeds its budget.
+                log.warning(
+                    f"[REGISTRY] gc could not remove artifact {aid} "
+                    f"({path}); it remains on disk and still counts "
+                    "toward the budget"
+                )
+                continue
+            removed.append(aid)
+            excess -= 1
+        if removed:
+            self._event(
+                "gc", removed=removed, max_artifacts=int(max_artifacts)
+            )
+            log.info(
+                f"[REGISTRY] gc pruned {len(removed)} retired/rejected "
+                f"artifact(s) (budget {max_artifacts}): {removed}"
+            )
+        return removed
+
+
 def _scalar_metrics(metrics: Mapping[str, Any] | None) -> dict:
     """Keep only scalar metrics, and only FINITE numeric ones: arrays
     (probs/labels) stay out of the manifest — the histogram is their

@@ -140,7 +140,19 @@ class ScoringServer:
         auth_key: bytes | None = None,
         score_bins: int = 10,
         tracer=None,
+        trace_sample: float = 1.0,
     ):
+        if not 0.0 < float(trace_sample) <= 1.0:
+            raise ValueError(
+                f"trace_sample={trace_sample} must be in (0, 1]"
+            )
+        # serve-batch span sampling (ObsConfig.trace_sample / the
+        # --trace-sample flag): one span per ``stride`` coalesced batches
+        # via the batch COUNTER — deterministic (reruns sample the same
+        # batches, no RNG in the hot path), and the events-JSONL stops
+        # growing one line per batch on a high-rate scorer. Each emitted
+        # span carries ``sampled_batches`` so consumers re-scale.
+        self._trace_stride = max(1, round(1.0 / float(trace_sample)))
         self.engine = engine
         self.tok = tokenizer
         self.spec = spec
@@ -559,11 +571,17 @@ class ScoringServer:
             self._g_round.set(round_id)
             for r in live:
                 self._h_queue_ms.observe(now - r.t_enqueue)
-            if self.tracer is not None:
-                # One serve-batch span per coalesced dispatch; trace from
-                # the first traced request in the batch (a batch may mix
-                # traces — the per-request echo in each reply keeps the
-                # exact mapping).
+            if self.tracer is not None and (
+                # Counter-stride sampling: batch 1, 1+stride, 1+2*stride,
+                # ... (self._batches was already incremented above, so
+                # the FIRST batch always emits — a short-lived scorer
+                # still leaves a span).
+                (self._batches - 1) % self._trace_stride == 0
+            ):
+                # One serve-batch span per SAMPLED coalesced dispatch;
+                # trace from the first traced request in the batch (a
+                # batch may mix traces — the per-request echo in each
+                # reply keeps the exact mapping).
                 trace = next(
                     (r.trace for r in live if r.trace is not None), None
                 )
@@ -575,6 +593,13 @@ class ScoringServer:
                     batch_size=n,
                     bucket=bucket,
                     round=round_id,
+                    # 1 span stands for this many batches (1 = unsampled,
+                    # field omitted to keep the common case compact).
+                    sampled_batches=(
+                        self._trace_stride
+                        if self._trace_stride > 1
+                        else None
+                    ),
                 )
             if self.metrics_jsonl:
                 from ..reporting import append_metrics_jsonl

@@ -9,11 +9,122 @@ and identical no matter how clients are laid out over hosts.
 
 from __future__ import annotations
 
-from typing import Iterator
+import threading
+from typing import Any, Callable, Iterator
 
 import numpy as np
 
 from ..data.pipeline import StackedClients
+
+
+class EpochPrefetcher:
+    """Background materialization of an epoch's first K batches.
+
+    The TCP client round loop is serial: train -> upload -> WAIT for the
+    aggregate reply -> train again. The wait is dead time; this object
+    spends it on the NEXT round's input pipeline instead — the per-epoch
+    permutation plus the first K batches' row gathers run on a background
+    thread, so when training resumes its first steps dispatch without
+    touching the input pipeline. Determinism is untouched: the factory
+    builds the exact iterator the epoch loop would have built (same seed,
+    same epoch key), this object merely evaluates its head early.
+
+    ``batches()`` joins the thread and yields the prefetched head, then
+    drains the live iterator — byte-identical to iterating the factory's
+    iterator directly (pinned by tests)."""
+
+    def __init__(
+        self,
+        factory: Callable[[], Iterator[Any]],
+        *,
+        k: int = 2,
+    ):
+        self._buf: list[Any] = []
+        self._it: Iterator[Any] | None = None
+        self._err: BaseException | None = None
+        self._k = max(0, int(k))
+        self._factory = factory
+        # Span accounting (the TCP client's ``batch-prefetch`` obs span):
+        # when the background work started (unix) and how long it ran —
+        # the input-pipeline time hidden behind the reply wait.
+        self.t_unix = 0.0
+        self.busy_s = 0.0
+        self.n_prefetched = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        import time
+
+        self.t_unix = time.time()
+        t0 = time.monotonic()
+        try:
+            it = self._factory()
+            for _ in range(self._k):
+                try:
+                    self._buf.append(next(it))
+                except StopIteration:
+                    it = iter(())
+                    break
+            self._it = it
+            self.n_prefetched = len(self._buf)
+        except BaseException as e:  # surface on consume, not on a daemon
+            self._err = e
+        finally:
+            self.busy_s = time.monotonic() - t0
+
+    def ready(self) -> bool:
+        return not self._thread.is_alive()
+
+    def batches(self) -> Iterator[Any]:
+        self._thread.join()
+        if self._err is not None:
+            raise self._err
+        yield from self._buf
+        if self._it is not None:
+            yield from self._it
+
+
+class PrefetchSlot:
+    """One-slot arm/consume pairing of an :class:`EpochPrefetcher` with
+    the identity key of the epoch it was built for — the single
+    implementation of the keying/drop semantics every trainer's round
+    loop shares (engine.Trainer and FederatedTrainer hold one each).
+
+    ``arm`` starts the background prefetch and remembers its key;
+    ``consume`` is one-shot either way: a mismatched key (different
+    split / epoch / batch size) means the armed buffer will never be
+    consumed — drop it rather than pin its batches until the next arm,
+    and let the caller fall back to its live iterator."""
+
+    def __init__(self) -> None:
+        self._armed: tuple[tuple, EpochPrefetcher] | None = None
+
+    @property
+    def armed(self) -> bool:
+        return self._armed is not None
+
+    def arm(
+        self,
+        key: tuple,
+        factory: Callable[[], Iterator[Any]],
+        *,
+        k: int = 2,
+    ) -> EpochPrefetcher:
+        pf = EpochPrefetcher(factory, k=k)
+        self._armed = (tuple(key), pf)
+        return pf
+
+    def consume(self, key: tuple) -> Iterator[Any] | None:
+        """The armed prefetcher's ``batches()`` when ``key`` matches the
+        armed epoch, else None (caller builds its live iterator)."""
+        if self._armed is None:
+            return None
+        armed_key, pf = self._armed
+        self._armed = None
+        if armed_key == tuple(key):
+            return pf.batches()
+        return None
 
 
 def federated_batches(

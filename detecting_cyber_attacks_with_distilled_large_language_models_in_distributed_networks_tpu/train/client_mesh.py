@@ -233,6 +233,19 @@ class FedSeqClientTrainer:
             stacked_params, prepared=cache[2], collect_probs=collect_probs
         )[0]
 
+    def prefetch_epoch(
+        self, split: TokenizedSplit, epoch: int, batch_size: int, *, k: int = 2
+    ):
+        """Arm the inner fedseq trainer's epoch prefetch for the stacked
+        form of ``split`` (the same cached stack ``fit`` trains on), so
+        the TCP round loop can hide reply latency behind the next round's
+        first batch gathers — mirroring engine.Trainer.prefetch_epoch."""
+        if self._train_cache is None or self._train_cache[0] is not split:
+            self._train_cache = (split, stack_clients([split]))
+        return self.inner.prefetch_epoch(
+            self._train_cache[1], epoch, batch_size, k=k
+        )
+
     def host_params(self, state) -> Any:
         """One replica of the single client's params, unstacked, on host —
         the wire-upload form."""

@@ -24,6 +24,7 @@ from ..config import ModelConfig, TrainConfig
 from ..data.pipeline import TokenizedSplit, batch_iterator, pad_split_to_batch
 from ..models.distilbert import DDoSClassifier, init_params
 from ..ops.metrics import BinaryCounts, binary_counts, finalize_metrics
+from .batches import PrefetchSlot
 from ..utils.logging import get_logger
 
 log = get_logger()
@@ -288,6 +289,12 @@ class Trainer:
         self.train_cfg = train_cfg
         self.pad_id = pad_id
         self.drop_remainder = drop_remainder
+        # One-slot epoch prefetch (train/batches.PrefetchSlot): the
+        # TCP round loop arms it before the federated exchange so the
+        # next epoch's first batches materialize while the client waits
+        # on the aggregate reply. Keyed on (split id, epoch, batch_size)
+        # so a mismatched consume falls back to the live iterator.
+        self._prefetch = PrefetchSlot()
         self.model, self.optimizer, self.train_step, self.eval_step = (
             _engine_steps(model_cfg, train_cfg)
         )
@@ -332,17 +339,47 @@ class Trainer:
     def epoch_batches(
         self, split: TokenizedSplit, epoch: int, batch_size: int
     ) -> Iterator[dict]:
-        # drop_remainder=False (DataConfig.drop_remainder): the final short
-        # batch trains at its own shape (one extra XLA compilation) — the
-        # reference DataLoader's drop_last=False semantics (client1.py:370),
-        # exact per-batch mean loss included. The default drops it for a
-        # single compiled shape.
+        # A matching armed prefetch (prefetch_epoch) serves this epoch's
+        # head from the background-materialized buffer; the tail — and
+        # any mismatched key — is the live iterator below, so the batch
+        # sequence is identical either way.
+        it = self._prefetch.consume((id(split), int(epoch), int(batch_size)))
+        if it is not None:
+            return it
+        return self._epoch_iterator(split, epoch, batch_size)
+
+    def _epoch_iterator(self, split, epoch: int, batch_size: int):
+        """The epoch's shuffled iterator — the SINGLE derivation of its
+        permutation seed, shared by the live path and the armed prefetch
+        so a prefetched head can never train on different batches.
+
+        drop_remainder=False (DataConfig.drop_remainder): the final short
+        batch trains at its own shape (one extra XLA compilation) — the
+        reference DataLoader's drop_last=False semantics (client1.py:370),
+        exact per-batch mean loss included. The default drops it for a
+        single compiled shape."""
         return batch_iterator(
             split,
             batch_size,
             shuffle=True,
             seed=self.train_cfg.seed * 100_003 + epoch,
             drop_remainder=self.drop_remainder,
+        )
+
+    def prefetch_epoch(
+        self, split: TokenizedSplit, epoch: int, batch_size: int, *, k: int = 2
+    ):
+        """Arm the one-slot prefetch for ``epoch``: its permutation and
+        first ``k`` batch gathers run on a background thread NOW (the TCP
+        client calls this right before blocking on the round exchange, so
+        reply latency is hidden behind next-round input-pipeline work).
+        The next matching ``epoch_batches`` consumes it; determinism is
+        unchanged (same iterator, evaluated early). Returns the
+        EpochPrefetcher so the caller can report its measured span."""
+        return self._prefetch.arm(
+            (id(split), int(epoch), int(batch_size)),
+            lambda: self._epoch_iterator(split, epoch, batch_size),
+            k=k,
         )
 
     def fit(

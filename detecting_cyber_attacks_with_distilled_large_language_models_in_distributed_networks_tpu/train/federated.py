@@ -41,7 +41,11 @@ from ..utils.logging import get_logger, phase
 
 # Re-exports: batch iterators, eval plumbing, and jitted-step builders
 # split out of this file; importing them from here keeps the historical API.
-from .batches import federated_batches, federated_batches_ragged  # noqa: F401
+from .batches import (  # noqa: F401
+    PrefetchSlot,
+    federated_batches,
+    federated_batches_ragged,
+)
 from .fedeval import (  # noqa: F401
     PreparedEval,
     evaluate_stacked,
@@ -114,6 +118,11 @@ class FederatedTrainer:
         # the global tracer (set_global_tracer) is the fallback so
         # embedded constructions need no plumbing.
         self.tracer = None
+        # One-slot epoch prefetch (train/batches.PrefetchSlot), armed
+        # by prefetch_epoch while the round's wire exchange is in flight;
+        # _epoch_batches consumes a matching key, so the batch sequence
+        # is identical prefetched or not.
+        self._prefetch = PrefetchSlot()
         self._build_steps()
 
     # ---------------------------------------------------------- jitted steps
@@ -299,6 +308,50 @@ class FederatedTrainer:
         return ptrainer.fit_local(state, stacked_train, epochs=epochs)
 
     # ---------------------------------------------------------------- phases
+    def _epoch_batches(self, stacked_train, bs: int, epoch: int):
+        """One epoch's ``[C, B, ...]`` iterator, served from an armed
+        matching prefetch when available (same permutation keying, so
+        the sequence is identical either way)."""
+        it = self._prefetch.consume((id(stacked_train), int(epoch), bs))
+        if it is not None:
+            return it
+        return self._epoch_iterator(stacked_train, bs, epoch)
+
+    def _epoch_iterator(self, stacked_train, bs: int, epoch: int):
+        """The epoch's lockstep iterator — the SINGLE derivation of its
+        permutation keying, shared by the live path and the armed
+        prefetch so a prefetched head can never train on different
+        batches."""
+        return federated_batches(
+            stacked_train,
+            bs,
+            seed=self.cfg.train.seed,
+            epoch=epoch,
+            client_offset=self.client_offset,
+        )
+
+    def prefetch_epoch(
+        self, stacked_train, epoch: int, batch_size: int | None = None,
+        *, k: int = 2,
+    ):
+        """Arm the one-slot background prefetch for ``epoch``'s first
+        ``k`` lockstep batches (permutation + row gathers) — called by
+        round loops right before blocking on a wire exchange, so reply
+        latency hides input-pipeline work. Dense stacks only; a ragged
+        (StackedClients) input is ignored (its iterator is built per
+        epoch inside the ragged path). Returns the EpochPrefetcher (or
+        None when ignored) so the caller can report its measured span."""
+        from ..data.pipeline import StackedClients as _SC
+
+        if isinstance(stacked_train, _SC):
+            return None
+        bs = self.cfg.data.batch_size if batch_size is None else int(batch_size)
+        return self._prefetch.arm(
+            (id(stacked_train), int(epoch), bs),
+            lambda: self._epoch_iterator(stacked_train, bs, epoch),
+            k=k,
+        )
+
     def fit_local(
         self,
         state: FedState,
@@ -391,13 +444,7 @@ class FederatedTrainer:
         telemetry = self._step_telemetry()
         for epoch in range(epoch_offset, epoch_offset + E):
             losses = []
-            batches = federated_batches(
-                stacked_train,
-                bs,
-                seed=self.cfg.train.seed,
-                epoch=epoch,
-                client_offset=self.client_offset,
-            )
+            batches = self._epoch_batches(stacked_train, bs, epoch)
             for _, batch in zip(range(n_batches), batches):
                 state, loss = step(state, self._feed(batch))
                 losses.append(loss)
@@ -536,13 +583,7 @@ class FederatedTrainer:
         telemetry = self._step_telemetry()
         for epoch in range(epoch_offset, epoch_offset + E):
             losses = []
-            batches = federated_batches(
-                stacked_train,
-                bs,
-                seed=self.cfg.train.seed,
-                epoch=epoch,
-                client_offset=self.client_offset,
-            )
+            batches = self._epoch_batches(stacked_train, bs, epoch)
             for _, batch in zip(range(n_batches), batches):
                 per = []
                 for c in range(C):

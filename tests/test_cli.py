@@ -271,3 +271,65 @@ def test_flash_remat_local_run_end_to_end(tmp_path):
     )
     assert rc == 0
     assert (tmp_path / "out" / "client0_local_metrics.csv").exists()
+
+
+def test_parser_round_pipelining_flags():
+    """ISSUE 5 flags parse and land where the commands read them."""
+    ap = build_parser()
+    a = ap.parse_args(["serve", "--stream-chunk-mb", "0.25"])
+    assert a.stream_chunk_mb == 0.25
+    assert ap.parse_args(["serve"]).stream_chunk_mb is None  # default advert
+    a = ap.parse_args(["client", "--client-id", "0", "--no-stream-upload"])
+    assert a.stream_upload is False
+    assert ap.parse_args(["client", "--client-id", "0"]).stream_upload
+    a = ap.parse_args(
+        ["controller", "--registry-dir", "r", "--stream-chunk-mb", "2",
+         "--max-artifacts", "8"]
+    )
+    assert a.stream_chunk_mb == 2.0 and a.max_artifacts == 8
+    a = ap.parse_args(["infer-serve", "--trace-sample", "0.1"])
+    assert a.trace_sample == 0.1
+    a = ap.parse_args(
+        ["registry", "gc", "--registry-dir", "r", "--max-artifacts", "5"]
+    )
+    assert a.action == "gc" and a.max_artifacts == 5
+
+
+def test_registry_gc_cli_end_to_end(tmp_path, capsys):
+    """`fedtpu registry gc --max-artifacts N` prunes retired artifacts
+    through the real command path."""
+    import numpy as np
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.registry import (
+        ModelRegistry,
+    )
+
+    root = str(tmp_path / "reg")
+    reg = ModelRegistry(root)
+    for i in range(4):
+        aid = reg.add(
+            {"w": np.full(4, float(i), np.float32)}, round_index=i
+        )
+        reg.promote(aid, to="serving")
+    # Shrink the chain so old retirees become prunable.
+    info = reg.serving_info()
+    assert len(info["history"]) == 3
+    rc = main(
+        ["registry", "gc", "--registry-dir", root, "--max-artifacts", "4"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "0 artifact(s) pruned" in out  # whole chain protected
+    # Break protection by rolling the pointer forward past the budget.
+    for i in range(4, 7):
+        aid = reg.add(
+            {"w": np.full(4, float(i), np.float32)}, round_index=i
+        )
+        reg.promote(aid, to="serving")
+    rc = main(
+        ["registry", "gc", "--registry-dir", root, "--max-artifacts", "2"]
+    )
+    assert rc == 0
+    assert "pruned" in capsys.readouterr().out
+    with pytest.raises(SystemExit, match="max-artifacts"):
+        main(["registry", "gc", "--registry-dir", root])

@@ -380,3 +380,75 @@ def test_group_rounds_and_foreign_lines(tmp_path):
     groups = group_rounds(spans)
     assert set(groups) == {("aa", 1), ("bb", 2)}
     assert chrome_trace(spans)["traceEvents"]
+
+
+# ------------------------------------------- round pipelining attribution
+def test_wire_overlap_span_and_timeline_row(tmp_path):
+    """ISSUE 5: a streamed round's server emits a wire-overlap span —
+    fold work that ran DURING the wire phase, with overlap_frac and
+    peak_agg_bytes — the timeline surfaces it next to the exposed agg,
+    and the client's wire-upload span carries its chunk/overlap attrs."""
+    trace_dir = tmp_path / "stream-spans"
+    trace_dir.mkdir()
+    server = AggregationServer(
+        port=0, num_clients=2, timeout=30, stream_chunk_bytes=8192,
+        tracer=Tracer(str(trace_dir / "server.jsonl"), proc="server"),
+    )
+    out = {}
+
+    def run_server():
+        out["r0"] = server.serve_round()
+        out["r1"] = server.serve_round()
+
+    def run_client(cid):
+        fc = FederatedClient(
+            "127.0.0.1", server.port, client_id=cid, timeout=30,
+            tracer=Tracer(
+                str(trace_dir / f"client{cid}.jsonl"), proc=f"client-{cid}"
+            ),
+        )
+        p = {"w": np.full(40_000, cid + 1.0, np.float32)}
+        agg = fc.exchange(p, n_samples=1)
+        # Buffered like the real round loop's reply-wait prefetch span.
+        fc.note_phase("batch-prefetch", time.time(), 0.01, client=cid)
+        fc.exchange({k: v + 1.0 for k, v in agg.items()}, n_samples=1)
+
+    st = threading.Thread(target=run_server)
+    cts = [
+        threading.Thread(target=run_client, args=(c,)) for c in range(2)
+    ]
+    st.start()
+    for t in cts:
+        t.start()
+    for t in cts:
+        t.join(timeout=60)
+    st.join(timeout=60)
+    server.close()
+    assert server.stream_totals["stream_uploads"] == 2
+
+    spans = load_spans(trace_dir=str(trace_dir))
+    overlaps = [s for s in spans if s["span"] == "wire-overlap"]
+    assert len(overlaps) == 1  # only the streamed round overlapped
+    ov = overlaps[0]
+    assert ov["round"] == 1 and ov["proc"] == "server"
+    assert ov["folded_bytes"] > 0 and 0.0 < ov["overlap_frac"] <= 1.0
+    assert ov["peak_agg_bytes"] > 0
+    # The streamed wire-upload spans carry the pipelining attrs.
+    ups = [
+        s for s in spans
+        if s["span"] == "wire-upload" and s.get("round") == 1
+    ]
+    assert len(ups) == 2
+    assert all(u["chunks"] > 1 and u["overlap_s"] >= 0.0 for u in ups)
+    # batch-prefetch spans adopted the round identity on the next flush.
+    pf = [s for s in spans if s["span"] == "batch-prefetch"]
+    assert len(pf) == 2 and all(s.get("round") == 1 for s in pf)
+
+    summaries = round_summaries(spans)
+    by_round = {b["round"]: b for b in summaries}
+    assert by_round[1]["overlap_s"] > 0.0
+    assert by_round[1]["overlap_frac"] == ov["overlap_frac"]
+    assert by_round[0]["overlap_s"] == 0.0
+    table = timeline_table(spans)
+    assert "wire-overlap" in table and "folded during the wire phase" in table
+    assert "batch-prefetch" in table

@@ -168,3 +168,112 @@ def test_events_jsonl_records_the_lifecycle(registry):
     kinds = [e["event"] for e in events]
     assert kinds == ["added", "serving", "added", "rejected"]
     assert events[3]["reason"] == "worse"
+
+
+# ---------------------------------------------------------------------- gc
+def test_gc_prunes_retired_rejected_never_the_rollback_chain(registry):
+    """max_artifacts pruning (ISSUE 5 satellite): oldest retired/rejected
+    artifacts go first; the serving artifact, every id on the rollback
+    history, and live candidates/shadows are untouchable — gc refuses to
+    break `registry rollback` rather than honor the number."""
+    ids = [registry.add(_params(i), round_index=i) for i in range(6)]
+    # ids[0..3] serve in turn: 0..2 end up retired ON the rollback chain.
+    for a in ids[:4]:
+        registry.promote(a, to="serving")
+    registry.reject(ids[4], reason="worse")  # prunable
+    # ids[5] stays a live candidate — never prunable.
+    # Roll back once: ids[3] retired but NOT on the history any more?
+    # No — rolled_back_from is not in history; it IS prunable.
+    registry.rollback()  # serving -> ids[2], ids[3] retired off-chain
+    info = registry.serving_info()
+    assert info["artifact"] == ids[2]
+    chain = set(info.get("history", []))
+    assert chain == {ids[0], ids[1]}
+
+    removed = registry.gc(max_artifacts=4)
+    # Eligible: ids[3] (retired, off-chain) and ids[4] (rejected) —
+    # exactly the two needed to land on the budget, oldest first.
+    assert removed == [ids[3], ids[4]]
+    kept = {m["id"] for m in registry.list()}
+    assert kept == {ids[0], ids[1], ids[2], ids[5]}
+    # A budget below the protected set prunes nothing: the serving
+    # artifact + chain are untouchable, the candidate is a live state.
+    assert registry.gc(max_artifacts=1) == []
+    assert {m["id"] for m in registry.list()} == kept
+    # The whole rollback chain still works after gc.
+    registry.rollback()
+    assert registry.serving_info()["artifact"] == ids[1]
+    registry.rollback()
+    assert registry.serving_info()["artifact"] == ids[0]
+    # The event trail records the prune.
+    events = [
+        json.loads(line)
+        for line in open(os.path.join(registry.root, "events.jsonl"))
+    ]
+    gc_events = [e for e in events if e["event"] == "gc"]
+    assert len(gc_events) == 1 and gc_events[0]["removed"] == removed
+    with pytest.raises(RegistryError, match="max_artifacts"):
+        registry.gc(max_artifacts=0)
+
+
+def test_gc_never_reports_a_failed_deletion_as_pruned(registry, monkeypatch):
+    """A deletion rmtree cannot complete (permissions, held-open file on
+    a non-POSIX mount) must NOT be counted as pruned: the events trail
+    would permanently misreport it as garbage-collected while the
+    artifact remains on disk and in list(). gc skips it, warns, and
+    keeps it counting toward the budget."""
+    import shutil as _shutil
+
+    registry.add(_params(0), round_index=0)  # live candidate, protected
+    victim = registry.add(_params(9), round_index=9)
+    registry.reject(victim, reason="worse")
+    real_rmtree = _shutil.rmtree
+
+    def _stuck(path, **kw):
+        if os.path.basename(path) == victim:
+            return  # deletion silently fails, dir stays on disk
+        return real_rmtree(path, **kw)
+
+    monkeypatch.setattr(_shutil, "rmtree", _stuck)
+    removed = registry.gc(max_artifacts=1)
+    assert victim not in removed
+    assert victim in {m["id"] for m in registry.list()}
+    events = [
+        json.loads(line)
+        for line in open(os.path.join(registry.root, "events.jsonl"))
+    ]
+    for e in events:
+        if e["event"] == "gc":
+            assert victim not in e["removed"]
+    # Once the obstruction clears, the same artifact prunes normally.
+    monkeypatch.setattr(_shutil, "rmtree", real_rmtree)
+    assert victim in registry.gc(max_artifacts=1)
+    assert victim not in {m["id"] for m in registry.list()}
+
+
+def test_controller_gc_budget_bounds_the_registry(tmp_path):
+    """ControlConfig.max_artifacts: the unattended loop prunes after
+    every promotion, so a long campaign's registry stays bounded while
+    the serving pointer and its rollback chain survive."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        ControlConfig,
+    )
+
+    with pytest.raises(ValueError, match="max_artifacts"):
+        ControlConfig(max_artifacts=0)
+    reg = ModelRegistry(str(tmp_path / "gc-registry"))
+    # Simulate the controller's per-round add->promote->gc cadence.
+    budget = 3
+    for i in range(7):
+        aid = reg.add(_params(100 + i), round_index=i)
+        reg.promote(aid, to="serving")
+        reg.gc(max_artifacts=budget)
+    manifests = reg.list()
+    # Serving + its (possibly long) history chain are all protected, so
+    # the registry can exceed the budget only by protected ids.
+    info = reg.serving_info()
+    protected = {info["artifact"], *info.get("history", [])}
+    unprotected = [m for m in manifests if m["id"] not in protected]
+    assert all(
+        m.get("state") not in ("retired", "rejected") for m in unprotected
+    )

@@ -554,3 +554,61 @@ def test_serve_batch_jsonl_carries_score_histogram(tiny_setup, tmp_path):
     ]
     assert batch_hists and all(len(h) == 10 for h in batch_hists)
     assert sum(sum(h) for h in batch_hists) == len(TEXTS)
+
+
+def test_serve_batch_span_sampling_is_counter_strided(tiny_setup, tmp_path):
+    """--trace-sample RATE (ISSUE 5 satellite): a high-rate scorer emits
+    one serve-batch span per ~1/RATE coalesced batches via the batch
+    COUNTER — deterministic, no RNG — and each sampled span carries
+    sampled_batches so consumers can re-scale. rate=1.0 keeps the
+    one-span-per-batch behavior, field omitted."""
+    import json as _json
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs import (
+        Tracer,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.serving import (
+        ScoreEngine,
+        ScoringClient,
+        ScoringServer,
+    )
+
+    tok, model_cfg, trainer, params = tiny_setup
+
+    def run(rate, name):
+        path = str(tmp_path / f"{name}.jsonl")
+        engine = ScoreEngine(
+            model_cfg, params, pad_id=tok.pad_id, buckets=(1,)
+        )
+        with ScoringServer(
+            engine, tok, idle_tick_s=0.01, warmup=False,
+            tracer=Tracer(path, proc="serve"), trace_sample=rate,
+        ) as server:
+            with ScoringClient("127.0.0.1", server.port) as cli:
+                # Sequential single requests over bucket (1,): exactly
+                # one coalesced batch per request, deterministically.
+                for t in TEXTS:
+                    cli.score(text=t)
+            n_batches = server.stats()["batches"]
+        spans = [
+            _json.loads(ln)
+            for ln in open(path)
+            if _json.loads(ln).get("span") == "serve-batch"
+        ]
+        return n_batches, spans
+
+    n, spans = run(1.0, "full")
+    assert n == len(TEXTS) and len(spans) == n
+    assert all("sampled_batches" not in s for s in spans)
+
+    n, spans = run(1 / 3, "sampled")
+    assert n == len(TEXTS)
+    # Batches 1, 4, ... emit: ceil(6/3) = 2 spans, stride recorded.
+    assert len(spans) == -(-n // 3)
+    assert all(s["sampled_batches"] == 3 for s in spans)
+
+    with pytest.raises(ValueError, match="trace_sample"):
+        ScoringServer(
+            ScoreEngine(model_cfg, params, pad_id=tok.pad_id, buckets=(1,)),
+            tok, trace_sample=0.0,
+        )

@@ -1,0 +1,1177 @@
+"""Pipelined federated rounds (ISSUE 5): chunk-streamed uploads
+(comm/wire.py "Streamed uploads" + framing.PipelinedSender), streaming
+server-side chunk aggregation (comm/stream_agg.py), and the client's
+reply-wait batch prefetch (train/batches.EpochPrefetcher).
+
+The load-bearing contract everywhere: the streamed/incremental result is
+BIT-EXACT with the barrier path — same fp32 ops in the same
+ascending-client-id order per leaf — so the base crc every DP/resync
+test pins is unchanged by pipelining."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+    AggregationServer,
+    FederatedClient,
+    StreamAgg,
+    StreamAggPoisoned,
+    WireError,
+    aggregate_flat,
+    flatten_params,
+    framing,
+    wire,
+)
+
+pytestmark = pytest.mark.filterwarnings("ignore::pytest.PytestUnraisableExceptionWarning")
+
+
+def _leaves(rng, n=6, shape=(64, 97), scale=1.0):
+    """Flat separator-free keys: exchange() returns these unchanged."""
+    return {
+        f"w{i:02d}": rng.normal(size=shape).astype(np.float32) * scale
+        for i in range(n)
+    }
+
+
+# --------------------------------------------------------- wire: streams
+def test_stream_plan_and_header_roundtrip(rng):
+    flat = wire.flatten_lazy(
+        {"enc": {"k": rng.normal(size=(8, 4)).astype(np.float32)},
+         "b": rng.normal(size=7).astype(np.float32),
+         "step": np.int32(3)}
+    )
+    tensors, nbytes = wire.plan_stream(flat)
+    # Contiguous extents, sorted keys — the invariant the receiver's
+    # one-pass decode depends on.
+    assert [t["key"] for t in tensors] == sorted(flat)
+    off = 0
+    for t in tensors:
+        assert t["offset"] == off
+        off += t["nbytes"]
+    assert off == nbytes
+    hdr = wire.encode_stream_header(
+        tensors, meta={"client_id": 5}, chunk_bytes=1024,
+        payload_nbytes=nbytes,
+    )
+    t2, meta, chunk, total = wire.decode_stream_header(hdr)
+    assert meta == {"client_id": 5} and chunk == 1024 and total == nbytes
+    assert [t["key"] for t in t2] == [t["key"] for t in tensors]
+    # Leaf payloads decode back to the exact arrays via the SHARED
+    # per-leaf decoder (decode_tensor_entry).
+    for t in t2:
+        raw = wire.encode_stream_leaf(flat[t["key"]], t["enc"])
+        assert len(raw) == t["nbytes"]
+        np.testing.assert_array_equal(
+            wire.decode_tensor_entry(t, raw), np.asarray(flat[t["key"]])
+        )
+
+
+def test_stream_header_rejects_non_contiguous_and_topk(rng):
+    flat = {"a": rng.normal(size=4).astype(np.float32),
+            "b": rng.normal(size=4).astype(np.float32)}
+    tensors, nbytes = wire.plan_stream(flat)
+    broken = [dict(t) for t in tensors]
+    broken[1]["offset"] += 4  # gap
+    hdr = wire.encode_stream_header(
+        broken, chunk_bytes=64, payload_nbytes=nbytes + 4
+    )
+    with pytest.raises(WireError, match="contiguous"):
+        wire.decode_stream_header(hdr)
+    with pytest.raises(WireError, match="topk"):
+        wire.plan_stream(flat, "topk")
+
+
+def test_stream_chunk_and_trailer_auth_and_ordering(rng):
+    key, nonce = b"secret", b"\x01" * 16
+    data = rng.integers(0, 256, 1000).astype(np.uint8).tobytes()
+    frame = wire.encode_stream_chunk(3, data, auth_key=key, nonce=nonce)
+    got = wire.decode_stream_chunk(
+        frame, expect_seq=3, auth_key=key, nonce=nonce
+    )
+    assert bytes(got) == data
+    with pytest.raises(WireError, match="out of order"):
+        wire.decode_stream_chunk(
+            frame, expect_seq=4, auth_key=key, nonce=nonce
+        )
+    # A bit flip (or wrong connection nonce) fails the PER-CHUNK tag —
+    # what lets the server fold authenticated bytes immediately.
+    bad = bytearray(frame)
+    bad[20] ^= 1
+    with pytest.raises(WireError, match="HMAC"):
+        wire.decode_stream_chunk(
+            bytes(bad), expect_seq=3, auth_key=key, nonce=nonce
+        )
+    with pytest.raises(WireError, match="HMAC"):
+        wire.decode_stream_chunk(
+            frame, expect_seq=3, auth_key=key, nonce=b"\x02" * 16
+        )
+    end = wire.encode_stream_end(7, auth_key=key, nonce=nonce)
+    wire.decode_stream_end(end, expect_chunks=7, auth_key=key, nonce=nonce)
+    with pytest.raises(WireError, match="trailer claims"):
+        wire.decode_stream_end(
+            end, expect_chunks=8, auth_key=key, nonce=nonce
+        )
+
+
+def test_pipelined_sender_overlaps_and_surfaces_errors(rng):
+    import socket
+
+    a, b = socket.socketpair()
+    try:
+        sender = framing.PipelinedSender(a)
+        payloads = [
+            rng.integers(0, 256, 5000).astype(np.uint8).tobytes()
+            for _ in range(4)
+        ]
+        for p in payloads:
+            sender.send(p)
+        sender.close()
+        for p in payloads:
+            assert bytes(framing.recv_frame(b, send_ack=False)) == p
+    finally:
+        a.close(), b.close()
+    # Dead socket: the wire thread's error re-raises on close (and on a
+    # later send), never hangs the producer.
+    c, d = socket.socketpair()
+    d.close()
+    sender = framing.PipelinedSender(c)
+    sender.send(b"x" * (1 << 20))
+    with pytest.raises((OSError, ConnectionError, WireError)):
+        for _ in range(50):
+            sender.send(b"x" * (1 << 20))
+        sender.close()
+    c.close()
+
+
+# ------------------------------------------------- StreamAgg unit parity
+@pytest.mark.parametrize("weighted", [False, True])
+def test_stream_agg_matches_barrier_bit_exactly(rng, weighted):
+    """Leaves arriving in scrambled order, folded eagerly, equal the
+    barrier aggregate_flat BYTE for byte — the crc contract."""
+    n_clients, keys = 3, [f"k{i}" for i in range(5)]
+    models = [
+        {k: rng.normal(size=(33, 17)).astype(np.float32) for k in keys}
+        for _ in range(n_clients)
+    ]
+    weights = [3.0, 1.0, 2.5] if weighted else None
+    st = StreamAgg()
+    for cid in range(n_clients):
+        st.register(cid, keys=tuple(sorted(keys)), n_samples=1.0)
+    st.freeze(list(range(n_clients)), weights)
+    order = [(c, k) for c in range(n_clients) for k in keys]
+    rng.shuffle(order)
+    for c, k in order:
+        st.add_leaf(c, k, models[c][k])
+    got = st.finalize(list(range(n_clients)), weights)
+    want = aggregate_flat(models, weights)
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k])
+    assert wire.flat_crc32(got) == wire.flat_crc32(want)
+    # Eager folding freed per-leaf state: peak stays well under the
+    # barrier's full N x model residency.
+    model_bytes = sum(v.nbytes for v in models[0].values())
+    assert st.peak_bytes < n_clients * model_bytes
+
+
+def test_stream_agg_non_eager_is_the_barrier(rng):
+    models = [_leaves(rng, n=3, shape=(16, 8)) for _ in range(2)]
+    st = StreamAgg(eager=False)
+    for cid, m in enumerate(models):
+        st.register(cid, keys=tuple(sorted(m)), n_samples=1.0)
+        st.add_dense(cid, m)
+    assert st.fold_ids is None  # nothing folds before finalize
+    got = st.finalize([0, 1], None)
+    want = aggregate_flat(models)
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k])
+    # Barrier residency: both full models were co-resident.
+    model_bytes = sum(v.nbytes for v in models[0].values())
+    assert st.peak_bytes >= 2 * model_bytes
+
+
+def test_stream_agg_delta_uploads_fold_against_base(rng):
+    """A dense sparse-delta upload folds as base + float32(delta) —
+    byte-identical to the barrier's absolute reconstruction."""
+    base = _leaves(rng, n=3, shape=(8, 5))
+    delta = {k: rng.normal(size=v.shape).astype(np.float32) * 0.01
+             for k, v in base.items()}
+    dense = _leaves(rng, n=3, shape=(8, 5), scale=0.5)
+    st = StreamAgg(base=base)
+    st.register(0, keys=tuple(sorted(base)), n_samples=1.0, delta=True)
+    st.register(1, keys=tuple(sorted(base)), n_samples=1.0)
+    st.add_dense(0, delta)
+    st.add_dense(1, dense)
+    got = st.finalize([0, 1], None)
+    absolute = {k: base[k] + np.asarray(delta[k], np.float32) for k in base}
+    want = aggregate_flat([absolute, dense])
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k])
+
+
+def test_stream_agg_pre_fold_death_refreezes_over_survivors(rng):
+    """A member that registered an intent but died before any fold
+    un-freezes the set; finalize over the survivors IS the barrier mean
+    (the exact pre-streaming straggler semantics)."""
+    models = [_leaves(rng, n=2, shape=(4, 3)) for _ in range(3)]
+    st = StreamAgg()
+    for cid in range(3):
+        st.register(cid, keys=tuple(sorted(models[0])), n_samples=1.0)
+    st.freeze([0, 1, 2], None)
+    assert st.fold_ids == [0, 1, 2]
+    assert st.drop_client(2)  # nothing folded yet -> clean drop
+    assert st.fold_ids is None
+    st.add_dense(0, models[0])
+    st.add_dense(1, models[1])
+    got = st.finalize([0, 1], None)
+    want = aggregate_flat(models[:2])
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k])
+
+
+def test_stream_agg_folded_death_poisons_duplicate_refused(rng):
+    models = [_leaves(rng, n=2, shape=(4, 3)) for _ in range(2)]
+    st = StreamAgg()
+    for cid in range(2):
+        st.register(cid, keys=tuple(sorted(models[0])), n_samples=1.0)
+        st.add_dense(cid, models[cid])
+    st.freeze([0, 1], None)  # folds everything immediately
+    # Duplicate (poison=False): refused, round intact.
+    assert not st.drop_client(1, poison=False)
+    assert st.poisoned is None
+    got = st.finalize([0, 1], None)
+    want = aggregate_flat(models)
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k])
+    # Death (poison=True) after folds: the round cannot reach a correct
+    # mean any more.
+    st2 = StreamAgg()
+    for cid in range(2):
+        st2.register(cid, keys=tuple(sorted(models[0])), n_samples=1.0)
+        st2.add_dense(cid, models[cid])
+    st2.freeze([0, 1], None)
+    assert not st2.drop_client(1)
+    with pytest.raises(StreamAggPoisoned):
+        st2.finalize([0, 1], None)
+
+
+# ------------------------------------------------ live loopback A/B round
+def _run_fleet(server, params_by_cid, rounds, *, stream=True, dp=False,
+               bases=None):
+    """Drive a fleet of clients through ``rounds`` exchanges against an
+    already-serving loop; returns per-client final aggregates + clients."""
+    results, clients = {}, {}
+
+    def _loop(cid):
+        fc = FederatedClient(
+            "127.0.0.1", server.port, client_id=cid, timeout=30,
+            stream=stream, dp=dp,
+        )
+        clients[cid] = fc
+        cur = params_by_cid[cid]
+        base = bases[cid] if bases else None
+        for r in range(rounds):
+            up = {k: v + np.float32(0.01 * (r + 1)) for k, v in cur.items()}
+            if dp:
+                cur = fc.exchange(up, n_samples=1, round_base=cur)
+            else:
+                cur = fc.exchange(up, n_samples=10 * (cid + 1))
+        results[cid] = cur
+
+    ts = [
+        threading.Thread(target=_loop, args=(c,))
+        for c in params_by_cid
+    ]
+    for t in ts:
+        t.start()
+    aggs = [server.serve_round() for _ in range(rounds)]
+    for t in ts:
+        t.join(timeout=60)
+    return results, aggs, clients
+
+
+def test_streamed_round_crc_parity_live_ab(rng):
+    """THE acceptance A/B: the same two-round exchange against a
+    streaming server (chunked uploads, eager folds) and a barrier server
+    (stream_chunk_bytes=0) produces BIT-IDENTICAL aggregates — crc
+    pinned — while the streaming server actually streamed and folded
+    during the wire phase."""
+    p = [_leaves(rng), _leaves(rng, scale=2.0)]
+    outs = {}
+    for arm, chunk in (("stream", 16384), ("barrier", 0)):
+        with AggregationServer(
+            port=0, num_clients=2, timeout=30, stream_chunk_bytes=chunk
+        ) as server:
+            results, aggs, clients = _run_fleet(
+                server, {0: dict(p[0]), 1: dict(p[1])}, rounds=2
+            )
+            outs[arm] = (results, aggs)
+            if arm == "stream":
+                # Round 1 negotiates (dense), round 2 streams — both
+                # clients, in many chunks, folded during the wire phase.
+                assert server.stream_totals["stream_uploads"] == 2
+                assert clients[0]._server_stream == 16384
+                assert server.comm_overlap_frac() > 0.0
+                # Streamed-round aggregation state never held both full
+                # models (the barrier's O(N x model)).
+                model_bytes = sum(v.nbytes for v in p[0].values())
+                assert (
+                    server.stream_totals["last_round_peak_bytes"]
+                    <= 2 * model_bytes
+                )
+            else:
+                assert server.stream_totals["stream_uploads"] == 0
+                assert clients[0]._server_stream is None
+    for r in range(2):
+        s, b = outs["stream"][1][r], outs["barrier"][1][r]
+        assert wire.flat_crc32(s) == wire.flat_crc32(b)
+        for k in b:
+            np.testing.assert_array_equal(s[k], b[k])
+    for cid in (0, 1):
+        for k, v in outs["barrier"][0][cid].items():
+            np.testing.assert_array_equal(outs["stream"][0][cid][k], v)
+
+
+def test_mixed_old_new_peer_interop_round(rng):
+    """An old peer (stream=False: single dense frames, ignores the
+    advert) and a streaming client mix in ONE round; the fold is the
+    exact barrier mean of both — the capability bit is per-client, never
+    fleet-wide."""
+    p0, p1 = _leaves(rng, n=4), _leaves(rng, n=4, scale=3.0)
+    results, clients = {}, {}
+    with AggregationServer(
+        port=0, num_clients=2, timeout=30, stream_chunk_bytes=8192
+    ) as server:
+        def _loop(cid, stream):
+            fc = FederatedClient(
+                "127.0.0.1", server.port, client_id=cid, timeout=30,
+                stream=stream,
+            )
+            clients[cid] = fc
+            cur = {0: p0, 1: p1}[cid]
+            for r in range(2):
+                up = {k: v + np.float32(0.01) for k, v in cur.items()}
+                cur = fc.exchange(up)
+            results[cid] = cur
+
+        ts = [
+            threading.Thread(target=_loop, args=(0, False)),
+            threading.Thread(target=_loop, args=(1, True)),
+        ]
+        for t in ts:
+            t.start()
+        aggs = [server.serve_round() for _ in range(2)]
+        for t in ts:
+            t.join(timeout=60)
+        # Only the new peer streamed in round 2 (the old peer sees the
+        # advert too but its capability bit keeps it single-frame).
+        assert server.stream_totals["stream_uploads"] == 1
+        assert clients[0].stream is False
+        assert clients[1]._server_stream == 8192
+    up1 = [{k: v + np.float32(0.01) for k, v in p.items()} for p in (p0, p1)]
+    want1 = aggregate_flat(up1)
+    up2 = [{k: v + np.float32(0.01) for k, v in want1.items()}] * 2
+    want2 = aggregate_flat(up2)
+    for k in want2:
+        np.testing.assert_array_equal(aggs[0][k], want1[k])
+        np.testing.assert_array_equal(aggs[1][k], want2[k])
+        np.testing.assert_array_equal(results[0][k], results[1][k])
+
+
+def test_streamed_dp_round_base_crc_parity(rng):
+    """Plain central-DP rounds with streamed delta uploads: the noiseless
+    two-round trajectory is BIT-IDENTICAL to the barrier server's — the
+    dp_base_crc agreement (the contract every resync test pins) is
+    untouched by pipelining."""
+    init = _leaves(rng, n=4, shape=(16, 9), scale=0.01)
+    outs = {}
+    for arm, chunk in (("stream", 8192), ("barrier", 0)):
+        with AggregationServer(
+            port=0, num_clients=2, timeout=30, dp_clip=1e6,
+            dp_noise_multiplier=0.0, stream_chunk_bytes=chunk,
+        ) as server:
+            results, aggs, clients = _run_fleet(
+                server,
+                {0: dict(init), 1: dict(init)},
+                rounds=2,
+                dp=True,
+            )
+            outs[arm] = results
+            if arm == "stream":
+                assert server.stream_totals["stream_uploads"] == 2
+    for cid in (0, 1):
+        s = flatten_params(outs["stream"][cid])
+        b = flatten_params(outs["barrier"][cid])
+        assert wire.flat_crc32(s) == wire.flat_crc32(b)
+        for k in b:
+            np.testing.assert_array_equal(s[k], b[k])
+
+
+def test_streamed_dp_server_clip_fails_closed_after_folds(rng, monkeypatch):
+    """A streamed DP upload exceeding its declared clip can only be
+    re-clipped while none of its leaves folded; with a single-client
+    round (folds run as each leaf completes, before the trailer reveals
+    the norm) the round must FAIL CLOSED — never widen the mechanism's
+    sensitivity."""
+    base = _leaves(rng, n=4, shape=(16, 9))
+    big = {k: v + rng.normal(size=v.shape).astype(np.float32) * 100.0
+           for k, v in base.items()}
+    # First a clean round so the client adopts the stream advert.
+    with AggregationServer(
+        port=0, num_clients=1, min_clients=1, timeout=20, dp_clip=1.0,
+        dp_noise_multiplier=0.0, stream_chunk_bytes=4096,
+    ) as server:
+        fc = FederatedClient(
+            "127.0.0.1", server.port, client_id=0, timeout=10, dp=True
+        )
+        results = {}
+
+        def _r1():
+            results["out"] = fc.exchange(
+                {k: v + np.float32(1e-4) for k, v in base.items()},
+                round_base=base, max_retries=1,
+            )
+
+        t = threading.Thread(target=_r1)
+        t.start()
+        agg1 = server.serve_round()
+        t.join(timeout=30)
+        assert fc._server_stream == 4096 and agg1 is not None
+        new_base = {
+            k: np.asarray(v, np.float32)
+            for k, v in flatten_params(results["out"]).items()
+        }
+        # Cheat: skip the client-side clip so the oversized delta hits
+        # the wire unclipped. (clip_flat is client-side only here — the
+        # streamed server path computes its own norm inline.)
+        monkeypatch.setattr(
+            wire, "clip_flat",
+            lambda flat, clip: (
+                {k: np.asarray(v, np.float32) for k, v in flat.items()},
+                0.0, 1.0,
+            ),
+        )
+        errors = {}
+
+        def _r2():
+            try:
+                fc.exchange(
+                    {k: new_base[k] + big[k] for k in new_base},
+                    round_base=new_base, max_retries=1,
+                )
+            except Exception as e:
+                errors["e"] = e
+
+        t2 = threading.Thread(target=_r2)
+        t2.start()
+        with pytest.raises(RuntimeError):
+            server.serve_round(deadline=4)
+        t2.join(timeout=30)
+        assert "e" in errors  # client sees the failed round, not silence
+
+
+def test_secure_agg_round_never_streams(rng):
+    """Secure aggregation keeps the single-frame barrier by design: the
+    server never adverts streaming (masked sums need the full
+    contributor set resolved first), and the round's math is unchanged."""
+    base = {"w": rng.normal(size=(6, 3)).astype(np.float32)}
+    deltas = [
+        {"w": rng.normal(size=(6, 3)).astype(np.float32) * 0.05}
+        for _ in range(2)
+    ]
+    params = [{"w": base["w"] + d["w"]} for d in deltas]
+    results, clients = {}, {}
+    with AggregationServer(
+        port=0, num_clients=2, timeout=20, secure_agg=True, dp_clip=10.0,
+        dp_noise_multiplier=0.0, stream_chunk_bytes=1 << 20,
+    ) as server:
+        def _go(i):
+            fc = FederatedClient(
+                "127.0.0.1", server.port, client_id=i, timeout=20,
+                dp=True, secure_agg=True, num_clients=2,
+            )
+            clients[i] = fc
+            results[i] = fc.exchange(
+                params[i], n_samples=1, round_base=base
+            )
+
+        ts = [threading.Thread(target=_go, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        server.serve_round()
+        for t in ts:
+            t.join(timeout=30)
+        assert server.stream_totals["stream_uploads"] == 0
+    # No advert ever reached the clients (secure replies carry none).
+    assert clients[0]._server_stream is None
+    want = base["w"] + 0.5 * (deltas[0]["w"] + deltas[1]["w"])
+    np.testing.assert_allclose(
+        flatten_params(results[0])["w"], want, atol=1e-5
+    )
+    np.testing.assert_array_equal(
+        flatten_params(results[0])["w"], flatten_params(results[1])["w"]
+    )
+
+
+def test_streamed_stale_client_resync_round(rng):
+    """The DP stranded-client resync (PR 3) under streamed uploads: a
+    stale client's streamed upload is excluded, the catch-up SEQUENCE
+    heals it, and the next full round's base-crc agreement holds —
+    folds froze over the same staleness partition serve_round used."""
+    base = _leaves(rng, n=3, shape=(6, 3), scale=0.0)
+
+    def _step(b, scale):
+        return {
+            k: b[k] + rng.normal(size=b[k].shape).astype(np.float32) * scale
+            for k in b
+        }
+
+    def _serve(server, results, deadline=20):
+        def _go():
+            try:
+                results["agg"] = server.serve_round(deadline=deadline)
+            except RuntimeError as e:
+                results["agg"], results["err"] = None, e
+
+        t = threading.Thread(target=_go)
+        t.start()
+        return t
+
+    def _run(clients, params, bases, results):
+        def _go(i):
+            results[i] = clients[i].exchange(
+                params[i], n_samples=1, round_base=bases[i]
+            )
+
+        ts = [
+            threading.Thread(target=_go, args=(i,))
+            for i in range(len(clients))
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+
+    results = {}
+    with AggregationServer(
+        port=0, num_clients=2, min_clients=1, timeout=20,
+        dp_clip=1e6, dp_noise_multiplier=0.0, stream_chunk_bytes=2048,
+    ) as server:
+        clients = [
+            FederatedClient(
+                "127.0.0.1", server.port, client_id=i, timeout=20, dp=True
+            )
+            for i in range(2)
+        ]
+        # Round 1: shared init (dense — no advert adopted yet).
+        st = _serve(server, results)
+        _run(clients, [_step(base, 0.01), _step(base, 0.02)],
+             [base, base], results)
+        st.join(timeout=30)
+        base1 = {k: np.asarray(v, np.float32)
+                 for k, v in flatten_params(results[0]).items()}
+        # Round 2: client 0 misses it; client 1 STREAMS its delta.
+        st = _serve(server, results, deadline=4)
+        out1 = clients[1].exchange(
+            _step(base1, 0.015), round_base=base1
+        )
+        st.join(timeout=30)
+        assert server.stream_totals["stream_uploads"] >= 1
+        base2 = {k: np.asarray(v, np.float32)
+                 for k, v in flatten_params(out1).items()}
+        # Round 3: client 0 rejoins STALE (streamed stale upload is
+        # excluded from the frozen fold set); both land bit-identical.
+        st = _serve(server, results)
+        _run(clients, [_step(base1, 0.01), _step(base2, 0.02)],
+             [base1, base2], results)
+        st.join(timeout=30)
+        r0, r1 = flatten_params(results[0]), flatten_params(results[1])
+        for key in r0:
+            np.testing.assert_array_equal(r0[key], r1[key])
+        # Round 4: full fleet from the resynced base — crc agreement.
+        base3 = {k: np.asarray(v, np.float32) for k, v in r0.items()}
+        st = _serve(server, results)
+        _run(clients, [_step(base3, 0.01), _step(base3, 0.02)],
+             [base3, base3], results)
+        st.join(timeout=30)
+        assert results["agg"] is not None
+        np.testing.assert_array_equal(
+            flatten_params(results[0])["w00"],
+            flatten_params(results[1])["w00"],
+        )
+
+
+def test_duplicate_upload_after_folds_keeps_the_round_alive(rng):
+    """A client re-uploading after folds consumed its first upload must
+    not poison the round: the original stands, the duplicate is refused,
+    and the round's aggregate is the barrier mean of the FIRST uploads."""
+    p = [_leaves(rng, n=3, shape=(8, 4)), _leaves(rng, n=3, shape=(8, 4))]
+    with AggregationServer(
+        port=0, num_clients=2, timeout=20, stream_chunk_bytes=1 << 20
+    ) as server:
+        results = {}
+
+        def _c(cid):
+            fc = FederatedClient(
+                "127.0.0.1", server.port, client_id=cid, timeout=20
+            )
+            results[cid] = fc.exchange(p[cid])
+
+        ts = [threading.Thread(target=_c, args=(c,)) for c in (0, 1)]
+        for t in ts:
+            t.start()
+        agg = server.serve_round()
+        for t in ts:
+            t.join(timeout=30)
+    want = aggregate_flat([flatten_params(p[0]), flatten_params(p[1])])
+    for k in want:
+        np.testing.assert_array_equal(agg[k], want[k])
+
+
+def test_dense_retry_supersedes_in_flight_stream(rng):
+    """A client whose streamed upload stalls mid-chunk retries with a
+    dense frame on a fresh connection (attempt 2 is always dense). The
+    retry must supersede the half-open stream — one intent, the retry's
+    values — and the stalled handler's death afterwards must neither
+    poison the round nor strip the retry's state."""
+    models = [_leaves(rng, n=4, shape=(32, 33)),
+              _leaves(rng, n=4, shape=(32, 33), scale=2.0)]
+    flat0 = {k: np.asarray(v) for k, v in models[0].items()}
+    with AggregationServer(
+        port=0, num_clients=2, timeout=30, stream_chunk_bytes=2048
+    ) as server:
+        aggs = []
+        srv = threading.Thread(target=lambda: aggs.append(server.serve_round()))
+        srv.start()
+        # Half-open stream from client 0 carrying GARBAGE values: header
+        # plus most chunks, never the trailer.
+        garbage = {k: v * np.float32(100.0) for k, v in flat0.items()}
+        tensors, payload_nbytes = wire.plan_stream(garbage)
+        blob = b"".join(
+            wire.encode_stream_leaf(garbage[t["key"]], t["enc"])
+            for t in tensors
+        )
+        stalled = socket.create_connection(
+            ("127.0.0.1", server.port), timeout=30
+        )
+        framing.send_frame(
+            stalled,
+            wire.encode_stream_header(
+                tensors,
+                meta={"client_id": 0, "n_samples": 1},
+                chunk_bytes=2048,
+                payload_nbytes=payload_nbytes,
+            ),
+        )
+        n_sent = (len(blob) // 2048) // 2 + 1
+        for seq in range(n_sent):
+            framing.send_frame(
+                stalled,
+                wire.encode_stream_chunk(
+                    seq, blob[seq * 2048 : (seq + 1) * 2048]
+                ),
+                await_ack=False,
+            )
+        time.sleep(0.5)  # let the handler register + consume the chunks
+        results = {}
+
+        def _c(cid, params):
+            fc = FederatedClient(
+                "127.0.0.1", server.port, client_id=cid, timeout=30,
+                stream=False,
+            )
+            results[cid] = fc.exchange(params, n_samples=1)
+
+        t0 = threading.Thread(target=_c, args=(0, dict(models[0])))
+        t0.start()  # the dense retry takes over client 0's slot
+        time.sleep(0.5)
+        stalled.close()  # stalled handler dies AFTER the takeover
+        time.sleep(0.2)
+        t1 = threading.Thread(target=_c, args=(1, dict(models[1])))
+        t1.start()
+        for t in (t0, t1, srv):
+            t.join(timeout=60)
+    assert aggs, "round failed (streamed state poisoned the retry?)"
+    want = aggregate_flat(
+        [flatten_params(models[0]), flatten_params(models[1])]
+    )
+    for k in want:
+        np.testing.assert_array_equal(aggs[0][k], want[k])
+    for k, v in want.items():
+        np.testing.assert_array_equal(results[0][k], v)
+
+
+def test_dense_retry_completes_partially_folded_stream(rng):
+    """The POST-fold flavor of the supersede: client 1's dense upload is
+    in, client 0's stream froze the fold set and its early leaves already
+    folded when the socket stalls. The dense retry re-sends the same
+    upload, so its leaves must complete the remaining folds — the round
+    finishes with the exact barrier mean instead of raising out of
+    finalize (a WireError would escape serve()'s RuntimeError guard and
+    kill every remaining round)."""
+    models = [_leaves(rng, n=4, shape=(32, 33)),
+              _leaves(rng, n=4, shape=(32, 33), scale=2.0)]
+    flat0 = {k: np.asarray(v) for k, v in models[0].items()}
+    with AggregationServer(
+        port=0, num_clients=2, timeout=30, stream_chunk_bytes=2048
+    ) as server:
+        aggs = []
+        srv = threading.Thread(target=lambda: aggs.append(server.serve_round()))
+        srv.start()
+        results = {}
+
+        def _c(cid, params):
+            fc = FederatedClient(
+                "127.0.0.1", server.port, client_id=cid, timeout=30,
+                stream=False,
+            )
+            results[cid] = fc.exchange(params, n_samples=1)
+
+        t1 = threading.Thread(target=_c, args=(1, dict(models[1])))
+        t1.start()  # complete dense upload -> client 1's leaves all pend
+        time.sleep(0.5)
+        # Client 0 streams its TRUE values but stalls halfway: with both
+        # intents in, the fold set freezes and every leaf completed so
+        # far folds immediately (client 1's copies are already present).
+        tensors, payload_nbytes = wire.plan_stream(flat0)
+        blob = b"".join(
+            wire.encode_stream_leaf(flat0[t["key"]], t["enc"])
+            for t in tensors
+        )
+        stalled = socket.create_connection(
+            ("127.0.0.1", server.port), timeout=30
+        )
+        framing.send_frame(
+            stalled,
+            wire.encode_stream_header(
+                tensors,
+                meta={"client_id": 0, "n_samples": 1},
+                chunk_bytes=2048,
+                payload_nbytes=payload_nbytes,
+            ),
+        )
+        n_sent = (len(blob) // 2048) // 2 + 1
+        for seq in range(n_sent):
+            framing.send_frame(
+                stalled,
+                wire.encode_stream_chunk(
+                    seq, blob[seq * 2048 : (seq + 1) * 2048]
+                ),
+                await_ack=False,
+            )
+        time.sleep(0.5)  # early leaves fold (client 1 complete)
+        t0 = threading.Thread(target=_c, args=(0, dict(models[0])))
+        t0.start()  # the dense retry supersedes the half-folded stream
+        time.sleep(0.5)
+        stalled.close()
+        for t in (t0, t1, srv):
+            t.join(timeout=60)
+        early = server.stream_totals["early_bytes"]
+    assert aggs, "round failed: retry did not complete the folded stream"
+    assert early > 0, "scenario never folded during the wire phase"
+    want = aggregate_flat(
+        [flatten_params(models[0]), flatten_params(models[1])]
+    )
+    for k in want:
+        np.testing.assert_array_equal(aggs[0][k], want[k])
+        np.testing.assert_array_equal(results[0][k], want[k])
+        np.testing.assert_array_equal(results[1][k], want[k])
+
+
+def test_streamed_retry_completes_partially_folded_stream(rng):
+    """Streamed twin of the dense-retry heal: a client whose streamed
+    upload half-folded before its socket died retries with ANOTHER
+    stream (a restarted client loop with the advert already cached).
+    The retry's plan matches the original intent, so its leaves must be
+    ADOPTED to complete the remaining folds — not drained into a round
+    that then stalls to deadline failure."""
+    models = [_leaves(rng, n=4, shape=(32, 33)),
+              _leaves(rng, n=4, shape=(32, 33), scale=2.0)]
+    flat0 = {k: np.asarray(v) for k, v in models[0].items()}
+    with AggregationServer(
+        port=0, num_clients=2, timeout=30, stream_chunk_bytes=2048
+    ) as server:
+        aggs = []
+        srv = threading.Thread(target=lambda: aggs.append(server.serve_round()))
+        srv.start()
+        results = {}
+
+        def _c(cid, params, stream):
+            fc = FederatedClient(
+                "127.0.0.1", server.port, client_id=cid, timeout=30,
+                stream=stream,
+            )
+            if stream:
+                fc._server_stream = 2048  # advert cached from a past round
+            results[cid] = fc.exchange(params, n_samples=1)
+
+        t1 = threading.Thread(target=_c, args=(1, dict(models[1]), False))
+        t1.start()
+        time.sleep(0.5)
+        tensors, payload_nbytes = wire.plan_stream(flat0)
+        blob = b"".join(
+            wire.encode_stream_leaf(flat0[t["key"]], t["enc"])
+            for t in tensors
+        )
+        stalled = socket.create_connection(
+            ("127.0.0.1", server.port), timeout=30
+        )
+        framing.send_frame(
+            stalled,
+            wire.encode_stream_header(
+                tensors,
+                meta={"client_id": 0, "n_samples": 1},
+                chunk_bytes=2048,
+                payload_nbytes=payload_nbytes,
+            ),
+        )
+        n_sent = (len(blob) // 2048) // 2 + 1
+        for seq in range(n_sent):
+            framing.send_frame(
+                stalled,
+                wire.encode_stream_chunk(
+                    seq, blob[seq * 2048 : (seq + 1) * 2048]
+                ),
+                await_ack=False,
+            )
+        time.sleep(0.5)  # early leaves fold (client 1 complete)
+        t0 = threading.Thread(target=_c, args=(0, dict(models[0]), True))
+        t0.start()  # the STREAMED retry must be adopted, not drained
+        time.sleep(0.5)
+        stalled.close()
+        for t in (t0, t1, srv):
+            t.join(timeout=60)
+        early = server.stream_totals["early_bytes"]
+    assert aggs, "round failed: streamed retry was drained, not adopted"
+    assert early > 0, "scenario never folded during the wire phase"
+    want = aggregate_flat(
+        [flatten_params(models[0]), flatten_params(models[1])]
+    )
+    for k in want:
+        np.testing.assert_array_equal(aggs[0][k], want[k])
+        np.testing.assert_array_equal(results[0][k], want[k])
+
+
+def test_quorum_round_survives_mid_stream_death(rng):
+    """min_clients < num_clients: streaming must not change the barrier
+    failure semantics. An eager fold commits to the full contributor
+    set, so one mid-stream death after folds began would fail a round
+    the barrier shape completes over the survivors — quorum rounds
+    therefore hold every upload and fold only at close. One client
+    dying mid-upload costs only that client."""
+    models = [_leaves(rng, n=4, shape=(32, 33)),
+              _leaves(rng, n=4, shape=(32, 33), scale=2.0)]
+    flat0 = {k: np.asarray(v) for k, v in models[0].items()}
+    with AggregationServer(
+        port=0, num_clients=2, min_clients=1, timeout=30,
+        stream_chunk_bytes=2048,
+    ) as server:
+        aggs = []
+        srv = threading.Thread(
+            target=lambda: aggs.append(server.serve_round(deadline=5))
+        )
+        srv.start()
+        results = {}
+
+        def _c(cid, params):
+            fc = FederatedClient(
+                "127.0.0.1", server.port, client_id=cid, timeout=30,
+            )
+            results[cid] = fc.exchange(params, n_samples=1)
+
+        t1 = threading.Thread(target=_c, args=(1, dict(models[1])))
+        t1.start()  # the survivor's upload completes
+        time.sleep(0.5)
+        # Client 0 streams its header plus half the chunks, then dies.
+        tensors, payload_nbytes = wire.plan_stream(flat0)
+        blob = b"".join(
+            wire.encode_stream_leaf(flat0[t["key"]], t["enc"])
+            for t in tensors
+        )
+        dying = socket.create_connection(
+            ("127.0.0.1", server.port), timeout=30
+        )
+        framing.send_frame(
+            dying,
+            wire.encode_stream_header(
+                tensors,
+                meta={"client_id": 0, "n_samples": 1},
+                chunk_bytes=2048,
+                payload_nbytes=payload_nbytes,
+            ),
+        )
+        n_sent = (len(blob) // 2048) // 2 + 1
+        for seq in range(n_sent):
+            framing.send_frame(
+                dying,
+                wire.encode_stream_chunk(
+                    seq, blob[seq * 2048 : (seq + 1) * 2048]
+                ),
+                await_ack=False,
+            )
+        time.sleep(0.5)  # intent + chunks land, nothing may fold
+        dying.close()
+        for t in (t1, srv):
+            t.join(timeout=60)
+        assert server.stream_totals["early_bytes"] == 0, (
+            "quorum round folded during the wire phase"
+        )
+    assert aggs and aggs[0] is not None, (
+        "mid-stream death failed a quorum round the barrier shape survives"
+    )
+    want = flatten_params(models[1])  # the mean over the lone survivor
+    for k in want:
+        np.testing.assert_array_equal(aggs[0][k], want[k])
+        np.testing.assert_array_equal(results[1][k], want[k])
+
+
+def test_streamed_lossy_dp_round_reclips_like_the_dense_path(rng, monkeypatch):
+    """DP + lossy (bf16) compression: the decoded norm can exceed the
+    clip even for an honestly-clipped upload, and the dense path's
+    answer is a silent server-side re-clip. The streamed path must HOLD
+    a lossy-encoded DP upload's leaves and join the fold at trailer
+    time after the exact same clip — never fail the round closed the
+    way a post-fold re-clip would. Client-side clipping is skipped (on
+    the named client threads only) so the server-side re-clip triggers
+    deterministically in both arms; the two-round trajectory must stay
+    bit-identical between them."""
+    init = _leaves(rng, n=4, shape=(16, 9), scale=0.01)
+    deltas = {
+        cid: [
+            {
+                k: rng.normal(size=v.shape).astype(np.float32) * 3.0
+                for k, v in init.items()
+            }
+            for _ in range(2)
+        ]
+        for cid in (0, 1)
+    }
+    real_clip = wire.clip_flat
+
+    def _skip_on_client_threads(flat, clip):
+        if threading.current_thread().name.startswith("noclip"):
+            return (
+                {k: np.asarray(v, np.float32) for k, v in flat.items()},
+                wire.flat_l2_norm(flat),
+                1.0,
+            )
+        return real_clip(flat, clip)
+
+    monkeypatch.setattr(wire, "clip_flat", _skip_on_client_threads)
+    outs = {}
+    for arm, chunk in (("stream", 8192), ("barrier", 0)):
+        with AggregationServer(
+            port=0, num_clients=2, timeout=30, dp_clip=1.0,
+            dp_noise_multiplier=0.0, stream_chunk_bytes=chunk,
+        ) as server:
+            results = {}
+
+            def _loop(cid):
+                fc = FederatedClient(
+                    "127.0.0.1", server.port, client_id=cid, timeout=30,
+                    dp=True, compression="bf16",
+                )
+                cur = dict(init)
+                for r in range(2):
+                    up = {k: v + deltas[cid][r][k] for k, v in cur.items()}
+                    cur = fc.exchange(up, n_samples=1, round_base=cur)
+                results[cid] = cur
+
+            ts = [
+                threading.Thread(
+                    target=_loop, args=(c,), name=f"noclip-{c}"
+                )
+                for c in (0, 1)
+            ]
+            for t in ts:
+                t.start()
+            for _ in range(2):
+                server.serve_round()
+            for t in ts:
+                t.join(timeout=60)
+            if arm == "stream":
+                # Round 1 is dense (the advert arrives with its reply);
+                # round 2 streams from both clients and exercises the
+                # held-leaves re-clip.
+                assert server.stream_totals["stream_uploads"] == 2
+        outs[arm] = results
+    for cid in (0, 1):
+        s = flatten_params(outs["stream"][cid])
+        b = flatten_params(outs["barrier"][cid])
+        assert wire.flat_crc32(s) == wire.flat_crc32(b)
+        for k in b:
+            np.testing.assert_array_equal(s[k], b[k])
+
+
+def test_empty_stream_chunk_is_refused(rng):
+    """Zero-length STRC chunks make no receive progress; an endless
+    supply would pin the handler thread in a no-progress loop. The
+    server must drop the connection on the first one — and the round
+    must still complete once the real client uploads."""
+    flat = {k: np.asarray(v) for k, v in _leaves(rng, n=2, shape=(16, 17)).items()}
+    with AggregationServer(
+        port=0, num_clients=1, timeout=30, stream_chunk_bytes=2048
+    ) as server:
+        aggs = []
+        srv = threading.Thread(target=lambda: aggs.append(server.serve_round()))
+        srv.start()
+        tensors, payload_nbytes = wire.plan_stream(flat)
+        evil = socket.create_connection(("127.0.0.1", server.port), timeout=10)
+        framing.send_frame(
+            evil,
+            wire.encode_stream_header(
+                tensors,
+                meta={"client_id": 0, "n_samples": 1},
+                chunk_bytes=2048,
+                payload_nbytes=payload_nbytes,
+            ),
+        )
+        framing.send_frame(
+            evil, wire.encode_stream_chunk(0, b""), await_ack=False
+        )
+        evil.settimeout(10)
+        assert evil.recv(1) == b"", "server kept the empty-chunk stream open"
+        evil.close()
+        fc = FederatedClient(
+            "127.0.0.1", server.port, client_id=0, timeout=30, stream=False,
+        )
+        out = fc.exchange(dict(flat), n_samples=1)
+        srv.join(timeout=60)
+    assert aggs
+    for k in flat:
+        np.testing.assert_array_equal(out[k], flat[k])
+        np.testing.assert_array_equal(aggs[0][k], flat[k])
+
+
+def test_stream_chunk_size_must_leave_frame_headroom():
+    """A chunk size so large the STRC envelope would push the frame over
+    framing.MAX_FRAME is refused up front — otherwise every streamed
+    attempt would fail at the transport and silently pay a dense retry."""
+    cap = framing.MAX_FRAME - wire.STREAM_CHUNK_OVERHEAD
+    with pytest.raises(ValueError, match="stream_chunk_bytes"):
+        AggregationServer(
+            port=0, num_clients=1, timeout=5, stream_chunk_bytes=cap + 1
+        )
+
+
+# -------------------------------------------- reply-wait batch prefetch
+def test_epoch_prefetcher_yields_identical_batches(rng):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.batches import (
+        EpochPrefetcher,
+        federated_batches,
+    )
+
+    from types import SimpleNamespace
+
+    stacked = SimpleNamespace(
+        input_ids=rng.integers(0, 100, (2, 40, 8)).astype(np.int32),
+        attention_mask=np.ones((2, 40, 8), np.int32),
+        labels=rng.integers(0, 2, (2, 40)).astype(np.int32),
+    )
+
+    def factory():
+        return federated_batches(stacked, 8, seed=7, epoch=3)
+
+    direct = list(factory())
+    pf = EpochPrefetcher(factory, k=2)
+    got = list(pf.batches())
+    assert pf.n_prefetched == 2 and pf.busy_s >= 0.0
+    assert len(got) == len(direct)
+    for a, b in zip(got, direct):
+        for key in b:
+            np.testing.assert_array_equal(a[key], b[key])
+    # k beyond the epoch: everything prefetched, sequence unchanged.
+    pf = EpochPrefetcher(factory, k=1000)
+    got = list(pf.batches())
+    assert len(got) == len(direct)
+    # A factory error surfaces on consume, never kills the daemon thread.
+    def boom():
+        raise RuntimeError("input pipeline died")
+
+    pf = EpochPrefetcher(boom, k=1)
+    with pytest.raises(RuntimeError, match="input pipeline died"):
+        list(pf.batches())
+
+
+def test_trainer_prefetch_epoch_preserves_batch_sequence(rng):
+    """engine.Trainer: an armed prefetch serves the SAME batch sequence
+    epoch_batches would build live (determinism is the contract that
+    lets the TCP client arm it blindly before every exchange)."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        ModelConfig,
+        TrainConfig,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.pipeline import (
+        TokenizedSplit,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.engine import (
+        Trainer,
+    )
+
+    split = TokenizedSplit(
+        rng.integers(0, 50, (37, 8)).astype(np.int32),
+        np.ones((37, 8), np.int32),
+        rng.integers(0, 2, 37).astype(np.int32),
+    )
+    trainer = Trainer(ModelConfig.tiny(vocab_size=64), TrainConfig())
+    live = list(trainer.epoch_batches(split, epoch=2, batch_size=8))
+    pf = trainer.prefetch_epoch(split, 2, 8)
+    assert pf is not None
+    via_prefetch = list(trainer.epoch_batches(split, epoch=2, batch_size=8))
+    assert not trainer._prefetch.armed  # consumed
+    assert len(via_prefetch) == len(live)
+    for a, b in zip(via_prefetch, live):
+        for key in b:
+            np.testing.assert_array_equal(a[key], b[key])
+    # A mismatched key (different epoch) is never consumed wrong — the
+    # live iterator serves the epoch — and the stale armed buffer is
+    # DROPPED rather than pinned until the next arm.
+    trainer.prefetch_epoch(split, 5, 8)
+    live3 = list(trainer.epoch_batches(split, epoch=3, batch_size=8))
+    assert not trainer._prefetch.armed
+    assert len(live3) == len(live)
+
+
+def test_streamed_round_with_auth(rng):
+    """Auth mode end-to-end over streams: the STRH header passes the
+    freshness check (role + connection nonce), every chunk's HMAC is
+    bound to the nonce and sequence, and the fold result matches the
+    barrier mean bit-exactly."""
+    key = b"fleet-secret"
+    p = [_leaves(rng, n=4), _leaves(rng, n=4, scale=2.0)]
+    results = {}
+    with AggregationServer(
+        port=0, num_clients=2, timeout=30, auth_key=key,
+        stream_chunk_bytes=8192,
+    ) as server:
+        def _loop(cid):
+            fc = FederatedClient(
+                "127.0.0.1", server.port, client_id=cid, timeout=30,
+                auth_key=key,
+            )
+            cur = p[cid]
+            for _ in range(2):
+                up = {k: v + np.float32(0.01) for k, v in cur.items()}
+                cur = fc.exchange(up)
+            results[cid] = cur
+
+        ts = [threading.Thread(target=_loop, args=(c,)) for c in (0, 1)]
+        for t in ts:
+            t.start()
+        aggs = [server.serve_round() for _ in range(2)]
+        for t in ts:
+            t.join(timeout=60)
+        assert server.stream_totals["stream_uploads"] == 2
+    up1 = [{k: v + np.float32(0.01) for k, v in m.items()} for m in p]
+    want1 = aggregate_flat(up1)
+    want2 = aggregate_flat(
+        [{k: v + np.float32(0.01) for k, v in want1.items()}] * 2
+    )
+    for k in want2:
+        np.testing.assert_array_equal(aggs[1][k], want2[k])
+        np.testing.assert_array_equal(results[0][k], results[1][k])
