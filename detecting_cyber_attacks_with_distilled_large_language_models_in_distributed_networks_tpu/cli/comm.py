@@ -151,6 +151,7 @@ def cmd_serve(args) -> int:
         secure_protocol=getattr(args, "secure_protocol", "double"),
         secure_threshold=getattr(args, "secure_threshold", None),
         dp_participation=dp_q,
+        dp_history_path=getattr(args, "dp_history_file", None),
         tracer=tracer,
         stream_chunk_bytes=stream_chunk_bytes,
     ) as server:
@@ -213,8 +214,37 @@ def cmd_client(args) -> int:
     client_tracer, _metrics = _obs_setup(
         args, proc=f"client-{args.client_id}", cfg=cfg, install_global=False
     )
+    # Persona mode (faults/personas.py): client-side misbehavior (lazy
+    # epochs, stale round skips) plus a deterministic in-process fault
+    # proxy for the wire-side personas — the client dials the proxy, the
+    # proxy dials the REAL server and injects the persona's seeded
+    # faults (--fault-seed). One caveat, stated where it bites: behind
+    # the proxy the dial-probe succeeds even while the server is down,
+    # so start the server first.
+    persona = proxy = None
+    server_host, server_port = args.host, args.port
+    if getattr(args, "persona", None):
+        from ..faults.personas import get_persona, start_persona_proxy
+
+        persona = get_persona(args.persona)
+        proxy = start_persona_proxy(
+            persona, args.host, args.port,
+            fault_seed=getattr(args, "fault_seed", 0) or 0,
+            client_id=args.client_id,
+        )
+        if proxy is not None:
+            server_host, server_port = proxy.host, proxy.port
+        log.info(
+            f"[CLIENT {args.client_id}] persona '{persona.name}' "
+            f"(fault seed {getattr(args, 'fault_seed', 0) or 0})"
+            + (
+                f": wire faults via proxy {proxy.host}:{proxy.port}"
+                if proxy is not None
+                else ": client-side behavior only"
+            )
+        )
     fed = FederatedClient(
-        args.host, args.port, client_id=args.client_id,
+        server_host, server_port, client_id=args.client_id,
         timeout=args.timeout, compression=args.compression,
         auth_key=_auth_key(),
         secure_agg=bool(getattr(args, "secure_agg", False)),
@@ -238,6 +268,33 @@ def cmd_client(args) -> int:
     if ckpt is not None:
         save_seq = max(save_seq, ckpt.latest_step() or 0)
     for r in range(rounds):
+        if persona is not None and persona.skips_round(r):
+            # Stale persona: offline for this round — no training, no
+            # exchange; the next exchanged round adopts the fleet's
+            # aggregate (in DP mode, through the server's resync path).
+            # WAIT the round window out before continuing: without the
+            # sleep, a fast next-round training would upload while the
+            # server is still inside the skipped round's deadline and
+            # be aggregated into the very round this persona is
+            # supposed to miss. --timeout is the client-side bound on
+            # that window (the server's deadline is its own --timeout;
+            # run both ends with matching values, the documented
+            # contract).
+            log.info(
+                f"[CLIENT {args.client_id}] persona "
+                f"'{persona.name}': sitting out round {r + 1}/{rounds}"
+                + (
+                    f" (offline for {args.timeout:.0f}s — the round "
+                    "window)"
+                    if r + 1 < rounds
+                    else ""
+                )
+            )
+            if r + 1 < rounds:
+                import time as _time
+
+                _time.sleep(args.timeout)
+            continue
         # Central DP: the round base is what THIS round's training starts
         # from — the shared init in round 1 (every client must launch from
         # the same weights; the server enforces crc equality), the adopted
@@ -262,6 +319,11 @@ def cmd_client(args) -> int:
         ) as tinfo:
             state, _ = trainer.fit(
                 state, client_data.train, batch_size=cfg.data.batch_size,
+                # Lazy persona: a fraction of the configured epochs
+                # (floored at 1) — the under-resourced client.
+                epochs=(
+                    persona.scaled(E) if persona is not None else None
+                ),
                 epoch_offset=r * E, tag=f"[CLIENT {args.client_id}] ",
             )
         # Buffered until the exchange reveals the round's trace id —
@@ -359,6 +421,8 @@ def cmd_client(args) -> int:
                 f"({e}); local-only reports"
             )
             break
+    if proxy is not None:
+        proxy.close()
     if ckpt is not None:
         ckpt.wait()
         ckpt.close()

@@ -379,6 +379,14 @@ def _load_client_splits(args, cfg: ExperimentConfig, num_clients: int):
         parse_source_arg,
     )
 
+    # Partition manifest (data/partition.py): the non-IID schemes record
+    # each client's label histogram next to the run outputs, on BOTH
+    # deployment tiers (every tier's loader funnels through here).
+    manifest_path = (
+        os.path.join(cfg.output_dir, "partition_manifest.json")
+        if cfg.data.partition != "sample" and cfg.output_dir
+        else None
+    )
     if getattr(args, "source", None):
         if getattr(args, "csv", None):
             raise SystemExit("--csv and --source are mutually exclusive")
@@ -392,7 +400,9 @@ def _load_client_splits(args, cfg: ExperimentConfig, num_clients: int):
         with phase(f"loading {len(entries)}-source mixed corpus", tag="DATA"):
             corpus = load_mixed_corpus(entries)
         with phase("partition/split", tag="DATA"):
-            return make_all_client_splits_from_corpus(corpus, num_clients, cfg.data)
+            return make_all_client_splits_from_corpus(
+                corpus, num_clients, cfg.data, manifest_path=manifest_path
+            )
     if getattr(args, "csv", None):
         with phase(f"loading {args.csv}", tag="DATA"):
             df = load_flow_csv(args.csv)
@@ -401,7 +411,9 @@ def _load_client_splits(args, cfg: ExperimentConfig, num_clients: int):
         with phase(f"generating {n} synthetic {cfg.data.dataset} flows", tag="DATA"):
             df = make_synthetic(cfg.data.dataset, n, seed=cfg.data.seed_base)
     with phase("partition/split", tag="DATA"):
-        return make_all_client_splits(df, num_clients, cfg.data)
+        return make_all_client_splits(
+            df, num_clients, cfg.data, manifest_path=manifest_path
+        )
 
 
 def _load_clients(args, cfg: ExperimentConfig, tok, num_clients: int):

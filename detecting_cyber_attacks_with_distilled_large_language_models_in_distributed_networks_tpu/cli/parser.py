@@ -28,6 +28,11 @@ covers every deployment shape, parameterized by client id / count:
               on serving-score drift instead of a fixed clock (control/)
   registry    inspect/operate the model registry: list artifacts, promote
               one by hand, roll the serving pointer back (registry/)
+  scenario    "federated in the wild": sweep a client-persona x data-
+              partition matrix of live loopback rounds with wire-level
+              fault injection (faults/), assert every quorum-satisfiable
+              round converges bit-exactly over survivors, and emit the
+              comparison grid from the obs timeline
   export-config   print the full default config as JSON (there is no config
                   file in the reference to copy from)
 
@@ -49,6 +54,7 @@ from .federated import cmd_federated
 from .local import cmd_local
 from .obs import cmd_obs
 from .predict import cmd_export_hf, cmd_predict
+from .scenario import cmd_scenario
 from .serving import cmd_infer_serve
 
 
@@ -222,12 +228,14 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="force the uniform mean (the reference's server.py:73-76)",
     )
-    p.add_argument("--partition", help="sample|disjoint|dirichlet")
+    p.add_argument(
+        "--partition", help="sample|disjoint|dirichlet|quantity"
+    )
     p.add_argument(
         "--dirichlet-alpha",
         type=float,
-        help="label-skew concentration for --partition dirichlet "
-        "(smaller = more non-IID; default 0.5)",
+        help="skew concentration for --partition dirichlet (label skew) "
+        "or quantity (size skew); smaller = more non-IID (default 0.5)",
     )
     p.add_argument(
         "--prox-mu",
@@ -404,6 +412,16 @@ def build_parser() -> argparse.ArgumentParser:
         "advert AND eager folding (the stop-the-world barrier shape); "
         "default 4. Old clients interop either way (plain meta field)",
     )
+    p.add_argument(
+        "--dp-history-file",
+        default=None,
+        help="persist the DP resync window (the retained post-noise "
+        "round deltas) to this npz file and reload it on startup, so a "
+        "server RESTART between rounds no longer re-strands stale "
+        "clients — they heal bit-exactly from the reloaded fp32 "
+        "history. Post-noise deltas are DP outputs; persisting them "
+        "costs no privacy",
+    )
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
@@ -502,6 +520,36 @@ def build_parser() -> argparse.ArgumentParser:
         "advertises support (--stream-chunk-mb): every upload stays one "
         "dense frame — the old-peer wire shape, useful for interop "
         "testing and as the pipelining A/B arm",
+    )
+    p.add_argument(
+        "--partition", help="sample|disjoint|dirichlet|quantity"
+    )
+    p.add_argument(
+        "--dirichlet-alpha",
+        type=float,
+        help="skew concentration for --partition dirichlet/quantity "
+        "(smaller = more non-IID; default 0.5). Same seeded partition "
+        "as the mesh tier: client i holds identical rows on both tiers",
+    )
+    p.add_argument(
+        "--persona",
+        choices=["honest", "lazy", "slow", "intermittent", "stale",
+                 "flaky-net"],
+        default=None,
+        help="run this client under a misbehavior persona "
+        "(faults/personas.py): lazy trains fewer epochs; slow throttles "
+        "its upload through a local fault proxy; intermittent dies "
+        "mid-upload once per exchange and retries; stale sits out every "
+        "second round; flaky-net randomly resets connections (seeded). "
+        "Wire faults run through a deterministic in-process TCP proxy "
+        "against the REAL server — start the server first",
+    )
+    p.add_argument(
+        "--fault-seed",
+        type=int,
+        default=0,
+        help="seed for the persona's deterministic wire-fault draws "
+        "(same seed = same faults, byte-for-byte)",
     )
     p.set_defaults(fn=cmd_client)
 
@@ -742,6 +790,82 @@ def build_parser() -> argparse.ArgumentParser:
         "keep everything",
     )
     p.set_defaults(fn=cmd_controller)
+
+    p = sub.add_parser(
+        "scenario",
+        help='the "federated in the wild" matrix: persona x partition '
+        "cells of live loopback rounds with wire-level fault injection",
+        epilog="Each cell runs a REAL AggregationServer + client fleet "
+        "on loopback, with the row's persona driving faults through the "
+        "deterministic TCP fault proxy (faults/). Outcomes come from "
+        "the obs timeline (contributors, drop attribution, straggler "
+        "wait); every successful round's aggregate is crc-pinned "
+        "bit-exact against the clean barrier mean over the same "
+        "survivor set. Exits 1 on any contract violation.",
+    )
+    p.add_argument(
+        "--personas",
+        default="lazy,slow,intermittent",
+        help="comma list of matrix rows (honest|lazy|slow|intermittent|"
+        "stale|flaky-net; default lazy,slow,intermittent)",
+    )
+    p.add_argument(
+        "--partitions",
+        default="iid,dirichlet",
+        help="comma list of matrix columns (iid|dirichlet|quantity; "
+        "default iid,dirichlet)",
+    )
+    p.add_argument(
+        "--dirichlet-alpha",
+        type=float,
+        default=0.1,
+        help="skew concentration for the dirichlet/quantity columns "
+        "(default 0.1 — heavily non-IID)",
+    )
+    p.add_argument("--clients", type=int, default=3)
+    p.add_argument("--rounds", type=int, default=2)
+    p.add_argument(
+        "--payload-kb",
+        type=int,
+        default=64,
+        help="synthetic per-client model payload size (default 64)",
+    )
+    p.add_argument(
+        "--deadline",
+        type=float,
+        default=8.0,
+        help="per-round straggler deadline seconds (default 8)",
+    )
+    p.add_argument("--fault-seed", type=int, default=0)
+    p.add_argument(
+        "--out-dir",
+        default="outputs/scenario",
+        help="grid.txt + scenario.jsonl + per-cell trace JSONLs land "
+        "here (default outputs/scenario)",
+    )
+    p.add_argument(
+        "--train",
+        action="store_true",
+        help="train a tiny real model per client on the partitioned "
+        "shards (adds the per-cell accuracy column; slower)",
+    )
+    p.add_argument(
+        "--no-auth-cell",
+        action="store_true",
+        help="skip the extra HMAC-authenticated cell",
+    )
+    p.add_argument(
+        "--no-stream",
+        action="store_true",
+        help="dense single-frame uploads in every cell (default: the "
+        "server advertises chunk-streamed uploads, so round 2+ streams)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="print one JSON record per cell instead of the grid",
+    )
+    p.set_defaults(fn=cmd_scenario)
 
     p = sub.add_parser(
         "obs",

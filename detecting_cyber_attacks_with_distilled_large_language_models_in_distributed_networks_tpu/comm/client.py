@@ -37,16 +37,59 @@ def _host_params(tree: Any) -> Any:
     return np.asarray(tree)
 
 
+def backoff_intervals(
+    *,
+    base: float = 1.0,
+    cap: float = 15.0,
+    factor: float = 2.0,
+    seed: int | None = None,
+):
+    """Capped exponential backoff intervals with DETERMINISTIC jitter.
+
+    The first interval is exactly ``base`` — the reference's 1 s probe
+    cadence (client1.py:298-311), kept so the common case (server comes
+    up within a second) connects exactly as fast as before. Every later
+    interval grows by ``factor`` up to ``cap``, scaled by a jitter in
+    [0.5, 1.0) drawn from ``random.Random(seed)`` — seeded, so a given
+    (client, seed) retries on a reproducible schedule (tests can pin
+    it), while different clients (different seeds) desynchronize instead
+    of stampeding a restarting server in lockstep.
+    """
+    import random
+
+    r = random.Random(seed)
+    k = 0
+    while True:
+        if k == 0:
+            yield float(base)
+        else:
+            yield min(float(cap), float(base) * float(factor) ** k) * (
+                0.5 + 0.5 * r.random()
+            )
+        k += 1
+
+
 def connect_with_retry(
     host: str,
     port: int,
     *,
     timeout: float = 300.0,
-    poll_interval: float = 1.0,  # the reference's 1 s probe cadence
+    poll_interval: float = 1.0,  # the reference's 1 s first-probe cadence
+    max_interval: float = 15.0,
+    retry_seed: int | None = None,
 ) -> socket.socket:
-    """Dial until the server is up or ``timeout`` elapses."""
+    """Dial until the server is up or ``timeout`` elapses.
+
+    Retries follow :func:`backoff_intervals` (first retry after exactly
+    ``poll_interval``, then capped exponential growth with seeded
+    jitter) instead of the reference's fixed 1 s polling — a fleet of
+    clients waiting out a long server restart stops hammering it once a
+    second each, without giving up any first-connect latency."""
     deadline = time.monotonic() + timeout
     last: Exception | None = None
+    sched = backoff_intervals(
+        base=poll_interval, cap=max_interval, seed=retry_seed
+    )
     while time.monotonic() < deadline:
         try:
             sock = socket.create_connection(
@@ -55,7 +98,9 @@ def connect_with_retry(
             return sock
         except OSError as e:
             last = e
-            time.sleep(poll_interval)
+            time.sleep(
+                min(next(sched), max(0.0, deadline - time.monotonic()))
+            )
     raise ConnectionError(f"server {host}:{port} unreachable after {timeout}s: {last}")
 
 
@@ -351,7 +396,12 @@ class FederatedClient:
             sock = None
             sparse_in_flight = False  # this attempt's delta hit the wire
             try:
-                sock = connect_with_retry(self.host, self.port, timeout=self.timeout)
+                # retry_seed=client_id: each client's dial-retry jitter is
+                # deterministic but fleet-desynchronized.
+                sock = connect_with_retry(
+                    self.host, self.port, timeout=self.timeout,
+                    retry_seed=self.client_id,
+                )
                 sock.settimeout(self.timeout)
                 nonce_hex = None
                 attempt_meta = dict(base_meta)

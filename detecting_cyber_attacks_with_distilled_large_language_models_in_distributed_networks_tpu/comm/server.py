@@ -157,6 +157,7 @@ class AggregationServer:
         secure_threshold: int | None = None,
         dp_participation: float = 1.0,
         dp_resync_rounds: int = 8,
+        dp_history_path: str | None = None,
         tracer=None,
         stream_chunk_bytes: int = wire.DEFAULT_STREAM_CHUNK,
     ):
@@ -265,6 +266,27 @@ class AggregationServer:
         # agreement exactly as before.
         self.dp_resync_rounds = int(dp_resync_rounds)
         self._dp_history: list[tuple[int, dict]] = []
+        # Resync-history persistence (ROADMAP's last resync residual):
+        # with a path set, the retained post-noise deltas are written
+        # after every round and RELOADED on construction, so a server
+        # restart between rounds no longer re-strands stale clients —
+        # they heal bit-exactly from the reloaded fp32 history (npz is
+        # lossless). Post-noise deltas are DP outputs: persisting and
+        # re-releasing them is free post-processing, same argument as
+        # the in-memory retention.
+        self.dp_history_path = dp_history_path
+        # Single background writer with a latest-snapshot slot: the
+        # window is up to dp_resync_rounds model-sized fp32 trees, and
+        # re-serializing it synchronously inside serve_round would put
+        # GB-scale disk I/O on the aggregation critical path every
+        # round. Entries are immutable once appended, so a snapshot
+        # list is safe to write off-thread; close() drains the writer
+        # so a clean shutdown always leaves the newest window on disk.
+        self._dp_persist_lock = threading.Lock()
+        self._dp_persist_pending: list | None = None
+        self._dp_persist_thread: threading.Thread | None = None
+        if dp_history_path:
+            self._load_dp_history()
         # Noise generator: Philox (counter-based, 128-bit crypto-derived
         # keying) keyed from OS entropy, never seeded deterministically —
         # the draw sequence is not predictable from any run artifact.
@@ -396,6 +418,13 @@ class AggregationServer:
     def close(self) -> None:
         self._stop.set()
         self._sock.close()
+        # Drain the history writer: a clean shutdown must leave the
+        # NEWEST resync window on disk, or a restart would re-strand
+        # exactly the clients persistence exists to heal.
+        with self._dp_persist_lock:
+            t = self._dp_persist_thread
+        if t is not None:
+            t.join(timeout=60.0)
 
     def __enter__(self) -> "AggregationServer":
         return self
@@ -1607,6 +1636,114 @@ class AggregationServer:
         )
         return secure.dequantize_sum(out, len(alive), self.fp_bits)
 
+    def _load_dp_history(self) -> None:
+        """Reload the persisted resync window (``dp_history_path``). A
+        missing file is a fresh deployment; a corrupt one is logged and
+        ignored (the server must come up — clients staler than the
+        recoverable window fail their rounds exactly as before)."""
+        import json as _json
+        import zipfile as _zipfile
+
+        try:
+            with np.load(self.dp_history_path, allow_pickle=False) as z:
+                index = _json.loads(bytes(z["__index__"].tobytes()).decode())
+                self._dp_history = [
+                    (
+                        int(entry["crc"]),
+                        {
+                            k: np.asarray(z[f"e{i}_{j}"], np.float32)
+                            for j, k in enumerate(entry["keys"])
+                        },
+                    )
+                    for i, entry in enumerate(index)
+                ]
+            log.info(
+                f"[SERVER] reloaded {len(self._dp_history)} retained DP "
+                f"round delta(s) from {self.dp_history_path}"
+            )
+        except FileNotFoundError:
+            pass
+        except (
+            OSError,
+            ValueError,
+            KeyError,
+            # A truncated write that kept the zip magic: np.load raises
+            # BadZipFile, which is neither OSError nor ValueError.
+            _zipfile.BadZipFile,
+        ) as e:
+            log.warning(
+                f"[SERVER] could not reload DP resync history from "
+                f"{self.dp_history_path} ({e}); starting with an empty "
+                "window"
+            )
+            self._dp_history = []
+
+    def _persist_dp_history(self) -> None:
+        """Queue the current window for the background writer (see the
+        constructor comment): serve_round never blocks on history I/O.
+        Coalescing is by design — only the NEWEST snapshot matters, so
+        a slow disk skips intermediate windows instead of queueing
+        them."""
+        if not self.dp_history_path:
+            return
+        snap = list(self._dp_history)
+        with self._dp_persist_lock:
+            self._dp_persist_pending = snap
+            if (
+                self._dp_persist_thread is None
+                or not self._dp_persist_thread.is_alive()
+            ):
+                self._dp_persist_thread = threading.Thread(
+                    target=self._dp_persist_loop, daemon=True
+                )
+                self._dp_persist_thread.start()
+
+    def _dp_persist_loop(self) -> None:
+        while True:
+            with self._dp_persist_lock:
+                snap = self._dp_persist_pending
+                self._dp_persist_pending = None
+                if snap is None:
+                    self._dp_persist_thread = None
+                    return
+            self._write_dp_history(snap)
+
+    def _write_dp_history(self, history: list[tuple[int, dict]]) -> None:
+        """Write one window snapshot atomically (tmp + replace).
+        Layout: a JSON index array (per entry: base crc + leaf key
+        order) plus positionally-named fp32 arrays — leaf keys can
+        contain any character without fighting npz member naming."""
+        import json as _json
+
+        index = [
+            {"crc": int(crc), "keys": list(d)} for crc, d in history
+        ]
+        arrays: dict[str, np.ndarray] = {
+            "__index__": np.frombuffer(
+                _json.dumps(index).encode(), dtype=np.uint8
+            )
+        }
+        for i, (_, d) in enumerate(history):
+            for j, k in enumerate(d):
+                arrays[f"e{i}_{j}"] = np.asarray(d[k], np.float32)
+        tmp = self.dp_history_path + ".tmp"
+        try:
+            # makedirs INSIDE the guard: an unwritable parent is the
+            # same best-effort failure as a full disk — persistence
+            # must never fail a round that already released its delta.
+            os.makedirs(
+                os.path.dirname(os.path.abspath(tmp)) or ".",
+                exist_ok=True,
+            )
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, self.dp_history_path)
+        except OSError as e:
+            log.warning(
+                f"[SERVER] could not persist DP resync history to "
+                f"{self.dp_history_path}: {e}"
+            )
+
     def _heal_stale_clients(
         self,
         rnd: _Round,
@@ -2192,6 +2329,7 @@ class AggregationServer:
                         del self._dp_history[
                             : len(self._dp_history) - self.dp_resync_rounds
                         ]
+                    self._persist_dp_history()
             else:
                 # The new base for next round's sparse deltas, advertised
                 # in every reply. Secure mode tracks it too (harmless), but
@@ -2283,6 +2421,11 @@ class AggregationServer:
                 trace=rnd.trace,
                 round=rnd.round_no,
                 clients=len(models),
+                # The round's CONTRIBUTOR set (post staleness exclusion):
+                # the obs timeline's drop attribution — who was actually
+                # aggregated vs who uploaded-but-was-excluded vs who
+                # never arrived (faults/scenario.py consumes this).
+                contributors=[int(i) for i in ids],
             )
         t_rep_unix = time.time()
         t_rep0 = time.monotonic()

@@ -158,7 +158,11 @@ class DataConfig:
     #             (overlap between clients possible, as in the reference).
     # "disjoint" — equal disjoint shards.
     # "dirichlet" — non-IID label-skew partition (BASELINE.json config 3).
+    # "quantity" — quantity skew: disjoint IID-content shards with
+    #             Dirichlet(alpha) sizes (data/partition.py).
     partition: str = "sample"
+    # Concentration for BOTH skewed schemes: dirichlet (label skew) and
+    # quantity (size skew); smaller = more skewed.
     dirichlet_alpha: float = 0.5
     vocab_path: str | None = None
     # Training batches: True (default) drops the final short batch of each
@@ -174,6 +178,14 @@ class DataConfig:
             # which would hand every sample to the last client.
             raise ValueError(
                 f"dirichlet_alpha={self.dirichlet_alpha} must be > 0"
+            )
+        if self.partition not in ("sample", "disjoint", "dirichlet", "quantity"):
+            # Fail at config time, not mid-partition: a typo'd scheme on
+            # the TCP tier would otherwise surface only after the model
+            # loaded (data/partition.py PARTITION_SCHEMES).
+            raise ValueError(
+                f"unknown partition scheme {self.partition!r} "
+                "(sample|disjoint|dirichlet|quantity)"
             )
 
     def client_seed(self, client_id: int) -> int:

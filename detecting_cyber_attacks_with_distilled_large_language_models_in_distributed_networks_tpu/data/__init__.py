@@ -25,8 +25,16 @@ from .cicids import (  # noqa: F401
     make_all_client_splits,
     make_all_client_splits_from_corpus,
     make_client_splits,
-    partition_indices,
     train_val_test_split,
+)
+from .partition import (  # noqa: F401
+    PARTITION_SCHEMES,
+    dirichlet_label_indices,
+    log_manifest,
+    partition_indices,
+    partition_manifest,
+    quantity_skew_indices,
+    save_manifest,
 )
 from .synthetic import (  # noqa: F401
     make_synthetic,

@@ -11,9 +11,10 @@ Reference semantics reproduced exactly (they determine accuracy parity):
   — reference client1.py:365-366.
 * Label map ``'DDoS' -> 1 else 0`` — reference client1.py:91.
 
-Beyond the reference: disjoint and Dirichlet non-IID partitioners
-(BASELINE.json config 3), parameterized over N clients instead of one
-copy-pasted script per client.
+Beyond the reference: disjoint, Dirichlet label-skew, and quantity-skew
+non-IID partitioners (BASELINE.json config 3; data/partition.py),
+parameterized over N clients instead of one copy-pasted script per
+client.
 """
 
 from __future__ import annotations
@@ -25,6 +26,8 @@ import pandas as pd
 
 from ..config import DataConfig
 from .datasets import Corpus, get_dataset
+from .partition import partition_indices  # noqa: F401  (re-export)
+from .partition import log_manifest, partition_manifest, save_manifest
 from .textualize import labels_from_dataframe  # noqa: F401  (re-export)
 
 
@@ -63,52 +66,6 @@ def sample_client_frame(df: pd.DataFrame, frac: float, seed: int) -> pd.DataFram
     (reference client1.py:89). Independent samples per client — overlap between
     clients is possible, exactly as in the reference."""
     return df.sample(frac=frac, random_state=seed)
-
-
-def partition_indices(
-    labels: np.ndarray,
-    num_clients: int,
-    cfg: DataConfig,
-) -> list[np.ndarray]:
-    """Row indices per client for the 'disjoint' and 'dirichlet' schemes.
-
-    ``data_fraction`` is always per-dataset (same convention across schemes):
-
-    * disjoint: one global permutation (seed_base); each client gets
-      ``frac * n`` rows, disjoint across clients (requires
-      ``frac * num_clients <= 1``).
-    * dirichlet: classic label-skew — for each class, split its rows among
-      clients by Dirichlet(alpha) proportions (non-IID knob the reference
-      never had; BASELINE.json config 3).
-    """
-    n = len(labels)
-    rng = np.random.default_rng(cfg.seed_base)
-    if cfg.partition == "disjoint":
-        # data_fraction is per-dataset (same convention as 'sample' and
-        # 'dirichlet'): each client gets frac*n rows, disjoint across clients.
-        if cfg.data_fraction * num_clients > 1.0 + 1e-9:
-            raise ValueError(
-                f"disjoint partition infeasible: data_fraction="
-                f"{cfg.data_fraction} x {num_clients} clients > 1"
-            )
-        perm = rng.permutation(n)
-        per_client = max(1, int(n * cfg.data_fraction))
-        return [
-            perm[cid * per_client : (cid + 1) * per_client]
-            for cid in range(num_clients)
-        ]
-    if cfg.partition == "dirichlet":
-        out: list[list[np.ndarray]] = [[] for _ in range(num_clients)]
-        for cls in np.unique(labels):
-            idx = np.flatnonzero(labels == cls)
-            rng.shuffle(idx)
-            idx = idx[: max(1, int(len(idx) * cfg.data_fraction * num_clients))]
-            props = rng.dirichlet([cfg.dirichlet_alpha] * num_clients)
-            cuts = (np.cumsum(props)[:-1] * len(idx)).astype(int)
-            for cid, chunk in enumerate(np.split(idx, cuts)):
-                out[cid].append(chunk)
-        return [np.concatenate(chunks) if chunks else np.array([], int) for chunks in out]
-    raise ValueError(f"unknown partition scheme {cfg.partition!r}")
 
 
 def _two_way_split(
@@ -214,15 +171,35 @@ def make_client_splits(
 
 
 def make_all_client_splits(
-    df: pd.DataFrame, num_clients: int, cfg: DataConfig
+    df: pd.DataFrame,
+    num_clients: int,
+    cfg: DataConfig,
+    *,
+    manifest_path: str | None = None,
 ) -> list[ClientSplits]:
-    """All clients in one pass (the partition is computed once)."""
+    """All clients in one pass (the partition is computed once). The
+    per-client label-histogram manifest is logged, and written as JSON
+    when ``manifest_path`` is given (data/partition.py)."""
     frames = _all_client_frames(df, num_clients, cfg)
-    return [_splits_from_frame(p, cid, cfg) for cid, p in enumerate(frames)]
+    # One label pass per frame, shared by the manifest AND the split
+    # builder (the label mapping is a full-frame pandas pass per client).
+    labels = [_spec_labels(p, cfg) for p in frames]
+    manifest = partition_manifest(labels, cfg=cfg, total_rows=len(df))
+    log_manifest(manifest)
+    if manifest_path:
+        save_manifest(manifest, manifest_path)
+    return [
+        _splits_from_arrays(_spec_texts(p, cfg), lab, cid, cfg)
+        for cid, (p, lab) in enumerate(zip(frames, labels))
+    ]
 
 
 def make_all_client_splits_from_corpus(
-    corpus: Corpus, num_clients: int, cfg: DataConfig
+    corpus: Corpus,
+    num_clients: int,
+    cfg: DataConfig,
+    *,
+    manifest_path: str | None = None,
 ) -> list[ClientSplits]:
     """Per-client splits over a schema-erased (possibly mixed-dataset) corpus.
 
@@ -242,6 +219,12 @@ def make_all_client_splits_from_corpus(
         ]
     else:
         parts = partition_indices(corpus.labels, num_clients, cfg)
+    manifest = partition_manifest(
+        [corpus.labels[idx] for idx in parts], cfg=cfg, total_rows=n
+    )
+    log_manifest(manifest)
+    if manifest_path:
+        save_manifest(manifest, manifest_path)
     return [
         _splits_from_arrays(
             [corpus.texts[i] for i in idx], corpus.labels[idx], cid, cfg

@@ -1,0 +1,27 @@
+"""Fault injection for the REAL TCP tier (chaos layer, PR 6).
+
+The reference's only failure behavior is hanging the accept loop until
+timeout when a client dies (reference server.py:69-71,124-132; SURVEY
+§5). This package makes failure a first-class, *deterministic* input:
+
+* :mod:`.proxy`    — a seeded in-process TCP fault proxy that sits
+                     between ``FederatedClient`` and
+                     ``AggregationServer`` and injects wire-level faults
+                     (delay, throttle, drop-after-N, mid-stream reset,
+                     bit flips, duplicate connects) on the real socket
+                     protocol, never on mocks.
+* :mod:`.personas` — named client behavior profiles (``lazy``, ``slow``,
+                     ``intermittent``, ``stale``, ``flaky-net``) that
+                     combine client-side behavior (fewer steps, skipped
+                     rounds) with a wire fault plan; wired into the CLI
+                     as ``client --persona NAME --fault-seed N``.
+* :mod:`.scenario` — the ``fedtpu scenario`` runner: a persona x
+                     partition matrix of live loopback rounds, outcomes
+                     collected from the PR 4 obs timeline (drop
+                     attribution, straggler wait) with every cell's
+                     aggregate crc-pinned bit-exact against a clean
+                     barrier mean over the same survivor set.
+"""
+
+from .personas import PERSONA_NAMES, Persona, get_persona  # noqa: F401
+from .proxy import CLEAN, FaultProxy, FaultSpec  # noqa: F401

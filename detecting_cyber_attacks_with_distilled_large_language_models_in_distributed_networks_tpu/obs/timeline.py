@@ -145,6 +145,12 @@ def round_breakdown(spans: list[dict]) -> dict:
         "trace": spans[0].get("trace") if spans else None,
         "round": spans[0].get("round") if spans else None,
         "round_wall_s": round_span["dur_s"] if round_span else None,
+        # The server's aggregated-contributor ids (agg span attr, PR 6):
+        # a client row present here but absent from contributors was
+        # dropped/excluded; absent entirely = never arrived. None on
+        # traces from servers that predate the attribute.
+        "contributors": agg.get("contributors") if agg else None,
+        "failed": bool(round_span.get("failed")) if round_span else False,
         "agg_s": agg_s,
         "reply_s": reply_s,
         "overlap_s": overlap_s,
@@ -185,6 +191,10 @@ def timeline_table(
         head = f"trace {trace or '-'} round {rnd if rnd is not None else '-'}"
         if b["round_wall_s"] is not None:
             head += f"  server wall {b['round_wall_s']:.3f}s"
+        if b["failed"]:
+            head += "  FAILED"
+        if b["contributors"] is not None:
+            head += f"  contributors {b['contributors']}"
         out.append(head)
         if b["clients"]:
             out.append(

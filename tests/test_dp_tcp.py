@@ -912,3 +912,110 @@ def test_stale_client_sitting_out_a_sampled_round_stays_resyncable(rng):
         np.testing.assert_array_equal(
             flatten_params(results[0])["w"], flatten_params(results[1])["w"]
         )
+
+
+def test_dp_resync_history_survives_server_restart(rng, tmp_path):
+    """ROADMAP's last resync residual, closed: the retained post-noise
+    deltas persist to disk (``dp_history_path``), so a server RESTART
+    between rounds no longer re-strands stale clients — the rejoining
+    client heals from the RELOADED history bit-exactly (npz is lossless
+    fp32; ulps-off healing would fail every later round's crc
+    agreement)."""
+    hist = str(tmp_path / "dp_history.npz")
+    base = {"w": np.zeros((6, 3), np.float32), "b": np.zeros(3, np.float32)}
+
+    def _step(b, scale):
+        return {k: b[k] + rng.normal(size=b[k].shape).astype(np.float32) * scale
+                for k in b}
+
+    def _server():
+        return AggregationServer(
+            port=0, num_clients=2, min_clients=1, timeout=20,
+            dp_clip=1e6, dp_noise_multiplier=0.0, dp_history_path=hist,
+        )
+
+    results = {}
+    with _server() as server:
+        clients = [
+            FederatedClient(
+                "127.0.0.1", server.port, client_id=i, timeout=20, dp=True
+            )
+            for i in range(2)
+        ]
+        # Round 1: both clients from the shared init.
+        st = _serve_one(server, results)
+        _run_clients(
+            clients, [_step(base, 0.01), _step(base, 0.02)],
+            [base, base], results,
+        )
+        st.join(timeout=30)
+        base1 = {k: np.asarray(v, np.float32)
+                 for k, v in flatten_params(results[0]).items()}
+        # Round 2: client 0 misses it entirely; client 1 advances alone.
+        st = _serve_one(server, results, deadline=4)
+        out1 = clients[1].exchange(_step(base1, 0.015), round_base=base1)
+        st.join(timeout=30)
+        base2 = {k: np.asarray(v, np.float32)
+                 for k, v in flatten_params(out1).items()}
+        assert not np.array_equal(base2["w"], base1["w"])
+
+    # ---- RESTART: a fresh process-equivalent server on the same path.
+    with _server() as server:
+        assert len(server._dp_history) == 2  # both rounds reloaded
+        clients = [
+            FederatedClient(
+                "127.0.0.1", server.port, client_id=i, timeout=20, dp=True
+            )
+            for i in range(2)
+        ]
+        # Round 3: client 0 rejoins STALE at base1. Pre-persistence, a
+        # restarted server had no history and failed this round with a
+        # base-crc mismatch; now the reloaded window heals it.
+        st = _serve_one(server, results)
+        _run_clients(
+            clients, [_step(base1, 0.01), _step(base2, 0.02)],
+            [base1, base2], results,
+        )
+        st.join(timeout=30)
+        r0 = flatten_params(results[0])
+        r1 = flatten_params(results[1])
+        for key in r0:
+            # Exact: the replayed catch-up must land on the fleet's fp32
+            # base bit for bit.
+            np.testing.assert_array_equal(r0[key], r1[key])
+        # Round 4: both clients from the common healed base — the crc
+        # agreement holds, proving the heal was bit-exact.
+        base3 = {k: np.asarray(v, np.float32) for k, v in r0.items()}
+        st = _serve_one(server, results)
+        _run_clients(
+            clients, [_step(base3, 0.01), _step(base3, 0.02)],
+            [base3, base3], results,
+        )
+        st.join(timeout=30)
+        assert results["agg"] is not None
+        np.testing.assert_array_equal(
+            flatten_params(results[0])["w"], flatten_params(results[1])["w"]
+        )
+
+
+def test_dp_history_corrupt_file_starts_empty(rng, tmp_path):
+    """A corrupt persisted window must not kill the server: it logs,
+    starts empty, and stale clients outside the (now empty) window fail
+    their rounds exactly as a fresh deployment would. Two corruption
+    shapes: garbage bytes (ValueError path) and a TRUNCATED npz that
+    kept the zip magic (zipfile.BadZipFile — a crash mid-write)."""
+    import io
+
+    garbage = tmp_path / "garbage.npz"
+    garbage.write_bytes(b"not an npz at all")
+    buf = io.BytesIO()
+    np.savez(buf, a=np.zeros(64, np.float32))
+    truncated = tmp_path / "truncated.npz"
+    truncated.write_bytes(buf.getvalue()[: len(buf.getvalue()) // 2])
+    for hist in (garbage, truncated):
+        with AggregationServer(
+            port=0, num_clients=2, min_clients=1, timeout=5,
+            dp_clip=1.0, dp_noise_multiplier=0.0,
+            dp_history_path=str(hist),
+        ) as server:
+            assert server._dp_history == []

@@ -4,12 +4,15 @@ The reference has no fault injection and its only failure behavior is to
 hang the accept loop until timeout when a client dies (server.py:69-71,
 124-132; SURVEY.md §5). Here failures are first-class: mesh-mode rounds
 take an injected fault mask (dropped clients are excluded from the masked
-mean), and the TCP server survives crashed/corrupt/silent clients,
-aggregating the survivors when the quorum allows.
+mean), and the TCP tier is exercised through the REUSABLE chaos harness
+(faults/proxy.py — the seeded wire-level fault proxy the `fedtpu
+scenario` runner drives) instead of hand-rolled socket poking: crashed,
+corrupting, silent, and probe-racing clients, with the server
+aggregating the survivors whenever the quorum allows.
 """
 
+import itertools
 import socket
-import struct
 import threading
 
 import numpy as np
@@ -22,11 +25,12 @@ from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed
     FederatedClient,
     flatten_params,
 )
-from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.framing import (
-    FRAME_MAGIC,
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.client import (
+    backoff_intervals,
 )
-from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.wire import (
-    encode,
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.faults import (
+    FaultProxy,
+    FaultSpec,
 )
 from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
     DataConfig,
@@ -146,6 +150,10 @@ def test_recovery_round_after_fault(eight_devices):
 
 
 # -------------------------------------------------------------- TCP mode
+#
+# All wire-level failure shapes go through the faults/ harness (the
+# deterministic proxy the scenario runner drives); the hand-rolled
+# socket poking these tests used to carry is now the harness's job.
 def _params(rng):
     return {
         "enc": {"w": rng.normal(size=(6, 4)).astype(np.float32)},
@@ -153,13 +161,14 @@ def _params(rng):
     }
 
 
-def _healthy(server, cid, params, results):
+def _healthy(server, cid, params, results, port=None, host="127.0.0.1"):
     def _run():
         try:
             results[cid] = FederatedClient(
-                "127.0.0.1", server.port, client_id=cid, timeout=10
+                host, port if port is not None else server.port,
+                client_id=cid, timeout=10,
             ).exchange(params, max_retries=1)
-        except ConnectionError as e:
+        except (ConnectionError, OSError) as e:
             results[f"err{cid}"] = e
 
     t = threading.Thread(target=_run, daemon=True)
@@ -168,76 +177,201 @@ def _healthy(server, cid, params, results):
 
 
 def test_server_survives_mid_upload_crash(rng):
-    """One client dies mid-frame; with min_clients=1 the server aggregates
-    the survivor instead of hanging (the reference hangs until timeout)."""
+    """One client dies mid-frame (proxy drop-after-N); with min_clients=1
+    the server aggregates the survivor instead of hanging (the reference
+    hangs until timeout)."""
     p0 = _params(rng)
     results = {}
     with AggregationServer(
         port=0, num_clients=2, min_clients=1, timeout=10
     ) as server:
-
-        def _crasher():
-            s = socket.create_connection(("127.0.0.1", server.port), timeout=5)
-            # Announce a 10 MB frame, send 1 KB, die.
-            s.sendall(FRAME_MAGIC + struct.pack("<QI", 10 << 20, 0))
-            s.sendall(b"\x00" * 1024)
-            s.close()
-
-        threading.Thread(target=_crasher, daemon=True).start()
-        t0 = _healthy(server, 0, p0, results)
-        agg = server.serve_round(deadline=5.0)
-        t0.join(timeout=10)
+        with FaultProxy(
+            "127.0.0.1", server.port,
+            plan=FaultSpec(drop_after_bytes=256), seed=1,
+        ) as prox:
+            t1 = _healthy(
+                server, 1, _params(rng), results, port=prox.port,
+                host=prox.host,
+            )
+            t0 = _healthy(server, 0, p0, results)
+            agg = server.serve_round(deadline=5.0)
+            t0.join(timeout=10)
+            t1.join(timeout=10)
+            assert prox.events_of("drop"), "the fault must have fired"
     assert 0 in results
+    assert "err1" in results  # the crasher's exchange failed, not hung
     for key, arr in flatten_params(results[0]).items():
         np.testing.assert_allclose(arr, flatten_params(p0)[key], rtol=1e-6)
     assert set(agg) == set(flatten_params(p0))
 
 
-def test_server_rejects_corrupt_frame_and_serves_survivor(rng):
-    """A bit-flipped payload fails the frame CRC; the survivor's round
-    completes."""
+def test_server_rejects_corrupted_stream_and_serves_survivor(rng):
+    """An in-flight bit flip (proxy) breaks the frame CRC; the corrupt
+    upload is rejected, the survivor's round completes. (The wire-level
+    payload-CRC layer beneath is unit-pinned in test_comm.py.)"""
     p0 = _params(rng)
     results = {}
     with AggregationServer(
         port=0, num_clients=2, min_clients=1, timeout=10
     ) as server:
-
-        def _corrupt():
-            msg = bytearray(encode(_params(rng), meta={"client_id": 1}))
-            msg[-3] ^= 0x01  # corrupt payload, keep header parseable
-            from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
-                native,
+        with FaultProxy(
+            "127.0.0.1", server.port,
+            plan=FaultSpec(flip_bit_after_bytes=80), seed=2,
+        ) as prox:
+            t1 = _healthy(
+                server, 1, _params(rng), results, port=prox.port,
+                host=prox.host,
             )
-            s = socket.create_connection(("127.0.0.1", server.port), timeout=5)
-            # Valid frame CRC over the corrupted bytes: the frame layer
-            # passes, the wire-level payload CRC must catch it.
-            crc = native.crc32(bytes(msg))
-            s.sendall(FRAME_MAGIC + struct.pack("<QI", len(msg), crc))
-            s.sendall(bytes(msg))
-            s.recv(4)  # frame ACK
-            s.close()
-
-        threading.Thread(target=_corrupt, daemon=True).start()
-        t0 = _healthy(server, 0, p0, results)
-        server.serve_round(deadline=5.0)
-        t0.join(timeout=10)
+            t0 = _healthy(server, 0, p0, results)
+            server.serve_round(deadline=5.0)
+            t0.join(timeout=10)
+            t1.join(timeout=10)
+            assert prox.events_of("flip")
     assert 0 in results
+    assert 1 not in results  # the corrupted upload never joined the round
 
 
 def test_silent_client_excluded_at_deadline(rng):
-    """A client that connects and never sends anything is excluded when the
-    round deadline passes; the survivor is still served."""
+    """A client that connects and never sends anything (a lurker through
+    the proxy) is excluded when the round deadline passes; the survivor
+    is still served."""
     p0 = _params(rng)
     results = {}
     with AggregationServer(
         port=0, num_clients=2, min_clients=1, timeout=10
     ) as server:
-        lurker = socket.create_connection(("127.0.0.1", server.port), timeout=5)
-        t0 = _healthy(server, 0, p0, results)
-        server.serve_round(deadline=4.0)
-        t0.join(timeout=10)
-        lurker.close()
+        with FaultProxy("127.0.0.1", server.port, seed=3) as prox:
+            lurker = socket.create_connection(
+                (prox.host, prox.port), timeout=5
+            )
+            t0 = _healthy(server, 0, p0, results)
+            server.serve_round(deadline=4.0)
+            t0.join(timeout=10)
+            lurker.close()
     assert 0 in results
+
+
+def test_duplicate_connect_probe_race_is_harmless(rng):
+    """The reference's probe-connect race (SURVEY §5: a probe connection
+    accepted by the send loop kills it) replayed through the proxy's
+    duplicate-connect fault: the abandoned extra connection must not
+    disturb the real exchange."""
+    p0, p1 = _params(rng), _params(rng)
+    results = {}
+    with AggregationServer(
+        port=0, num_clients=2, timeout=10
+    ) as server:
+        with FaultProxy(
+            "127.0.0.1", server.port,
+            plan=FaultSpec(duplicate_connect=True), seed=4,
+        ) as prox:
+            t0 = _healthy(
+                server, 0, p0, results, port=prox.port, host=prox.host
+            )
+            t1 = _healthy(server, 1, p1, results)
+            agg = server.serve_round(deadline=8.0)
+            t0.join(timeout=10)
+            t1.join(timeout=10)
+            assert prox.events_of("duplicate-connect")
+    assert 0 in results and 1 in results
+    expected = {
+        k: (flatten_params(p0)[k] + flatten_params(p1)[k]) / 2.0
+        for k in flatten_params(p0)
+    }
+    for key, arr in flatten_params(results[0]).items():
+        np.testing.assert_allclose(arr, expected[key], rtol=1e-5)
+    assert set(agg) == set(expected)
+
+
+def test_reset_mid_upload_then_retry_converges(rng):
+    """A mid-stream RST on the first dial (the intermittent persona's
+    wire shape) is healed by the client's retry inside the SAME round —
+    and the proxy's RST is prompt (the round must not wait it out)."""
+    p0, p1 = _params(rng), _params(rng)
+    results = {}
+    with AggregationServer(
+        port=0, num_clients=2, timeout=20
+    ) as server:
+        with FaultProxy(
+            "127.0.0.1", server.port,
+            plan=lambda i, rng_: (
+                FaultSpec(reset_after_bytes=64) if i == 0 else FaultSpec()
+            ),
+            seed=5,
+        ) as prox:
+
+            def _retrying():
+                results[0] = FederatedClient(
+                    prox.host, prox.port, client_id=0, timeout=15
+                ).exchange(p0, max_retries=3)
+
+            t0 = threading.Thread(target=_retrying, daemon=True)
+            t0.start()
+            t1 = _healthy(server, 1, p1, results)
+            agg = server.serve_round(deadline=15.0)
+            t0.join(timeout=20)
+            t1.join(timeout=20)
+            assert prox.events_of("reset")
+    assert 0 in results and 1 in results
+    for key in flatten_params(results[0]):
+        np.testing.assert_array_equal(
+            flatten_params(results[0])[key], flatten_params(results[1])[key]
+        )
+    assert agg is not None
+
+
+def test_throttled_upload_is_a_straggler_not_a_dropout(rng):
+    """A throttled (slow-persona) upload still lands inside the deadline:
+    the slow client contributes — late — and every client gets the same
+    mean."""
+    big = {"w": rng.normal(size=(24_000,)).astype(np.float32)}
+    p1 = {"w": rng.normal(size=(24_000,)).astype(np.float32)}
+    results = {}
+    with AggregationServer(
+        port=0, num_clients=2, timeout=20
+    ) as server:
+        with FaultProxy(
+            "127.0.0.1", server.port,
+            plan=FaultSpec(throttle_bps=96_000), seed=6,
+        ) as prox:
+            t0 = _healthy(
+                server, 0, big, results, port=prox.port, host=prox.host
+            )
+            t1 = _healthy(server, 1, p1, results)
+            agg = server.serve_round(deadline=15.0)
+            t0.join(timeout=20)
+            t1.join(timeout=20)
+            assert prox.events_of("throttle")
+    assert 0 in results and 1 in results
+    np.testing.assert_allclose(
+        flatten_params(results[0])["w"], (big["w"] + p1["w"]) / 2.0,
+        rtol=1e-5,
+    )
+    assert agg is not None
+
+
+# ------------------------------------------------- dial-retry backoff
+def test_backoff_first_probe_is_reference_compatible():
+    """The first retry interval is EXACTLY the reference's 1 s probe
+    cadence; later intervals grow toward the cap with jitter in
+    [0.5, 1.0) of the nominal value."""
+    sched = list(itertools.islice(backoff_intervals(seed=0), 8))
+    assert sched[0] == 1.0
+    for k, s in enumerate(sched[1:], start=1):
+        nominal = min(15.0, 2.0**k)
+        assert 0.5 * nominal <= s <= nominal
+    # The envelope reaches (and never exceeds) the cap.
+    assert max(sched) <= 15.0
+    assert min(itertools.islice(backoff_intervals(seed=0), 6, 8)) >= 7.5
+
+
+def test_backoff_schedule_deterministic_per_seed():
+    a = list(itertools.islice(backoff_intervals(seed=7), 10))
+    b = list(itertools.islice(backoff_intervals(seed=7), 10))
+    c = list(itertools.islice(backoff_intervals(seed=8), 10))
+    assert a == b
+    assert a != c  # different clients desynchronize
+    assert a[0] == c[0] == 1.0  # ... except the reference first probe
 
 
 def test_many_concurrent_clients_stress(rng):

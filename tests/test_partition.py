@@ -1,0 +1,149 @@
+"""data/partition.py: seeded non-IID partitioners + the manifest.
+
+The satellite contract (ISSUE 6): same seed => identical per-client
+index sets across runs AND across both deployment tiers (the mesh tier
+and the TCP tier shard through the same partition_indices), and the
+manifest's label histograms sum to the source split.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+    DataConfig,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+    make_all_client_splits,
+    partition_indices,
+    partition_manifest,
+    quantity_skew_indices,
+    save_manifest,
+)
+
+
+def _labels(n=400, seed=0):
+    return (np.random.default_rng(seed).random(n) < 0.3).astype(np.int64)
+
+
+def _dirichlet_cfg(**kw):
+    kw.setdefault("partition", "dirichlet")
+    kw.setdefault("data_fraction", 0.25)
+    kw.setdefault("dirichlet_alpha", 0.1)
+    kw.setdefault("seed_base", 11)
+    return DataConfig(**kw)
+
+
+def test_dirichlet_same_seed_identical_index_sets():
+    """Same seed => bit-identical per-client index sets on repeated runs
+    (fresh config objects, fresh rng) — the determinism the scenario
+    runner's clean-run replay and the cross-tier contract both rest on."""
+    labels = _labels()
+    a = partition_indices(labels, 4, _dirichlet_cfg())
+    b = partition_indices(labels, 4, _dirichlet_cfg())
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    # A different seed genuinely repartitions.
+    c = partition_indices(labels, 4, _dirichlet_cfg(seed_base=12))
+    assert any(
+        len(x) != len(y) or not np.array_equal(x, y) for x, y in zip(a, c)
+    )
+
+
+def test_dirichlet_identical_across_deployment_tiers():
+    """Both tiers funnel through make_all_client_splits (cli/common.py
+    _load_client_splits serves `federated` AND `client`): the per-client
+    ROW SETS it produces must equal the raw partition_indices output for
+    the same config — client i holds the same rows no matter which tier
+    trains it."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+        make_synthetic,
+    )
+
+    df = make_synthetic("cicids2017", 240, seed=3)
+    cfg = _dirichlet_cfg(data_fraction=0.25)
+    labels = (df["Label"] == "DDoS").to_numpy().astype(np.int64)
+    parts = partition_indices(labels, 4, cfg)
+    splits = make_all_client_splits(df, 4, cfg)
+    for cid, (idx, sp) in enumerate(zip(parts, splits)):
+        # The split re-shuffles rows into train/val/test, so compare the
+        # CLIENT'S total label multiset against its assigned rows.
+        got = np.sort(
+            np.concatenate(
+                [sp.train.labels, sp.val.labels, sp.test.labels]
+            )
+        )
+        np.testing.assert_array_equal(got, np.sort(labels[idx]))
+        assert sp.client_id == cid
+
+
+def test_manifest_histograms_sum_to_source_split(tmp_path):
+    """With data_fraction covering the whole dataset (frac * C = 1), the
+    dirichlet manifest's per-class histogram sums equal the source's
+    class counts exactly, and assigned_rows == total_rows (allowing the
+    per-class >=1 floor to never fire on this data)."""
+    labels = _labels(n=500, seed=1)
+    cfg = _dirichlet_cfg(data_fraction=0.25)
+    parts = partition_indices(labels, 4, cfg)
+    man = partition_manifest(
+        [labels[i] for i in parts], cfg=cfg, total_rows=len(labels)
+    )
+    assert man["assigned_rows"] == len(labels)
+    for cls in (0, 1):
+        total = sum(
+            c["label_hist"][str(cls)] for c in man["clients"]
+        )
+        assert total == int((labels == cls).sum())
+    assert sum(c["rows"] for c in man["clients"]) == len(labels)
+    # JSON round-trip (the artifact cli/common.py writes).
+    path = save_manifest(man, str(tmp_path / "m" / "manifest.json"))
+    with open(path) as f:
+        assert json.load(f) == man
+
+
+def test_quantity_skew_disjoint_and_skewed():
+    """Quantity skew: disjoint shards covering frac*n*C rows, every
+    client >= 1 row, sizes genuinely skewed at small alpha, and the
+    label MIX stays roughly representative (it is a size skew, not a
+    label skew)."""
+    n = 1000
+    rng = np.random.default_rng(0)
+    parts = quantity_skew_indices(
+        n, 5, alpha=0.3, data_fraction=0.2, rng=rng
+    )
+    sizes = [len(p) for p in parts]
+    assert sum(sizes) == n
+    assert min(sizes) >= 1
+    assert max(sizes) >= 3 * min(sizes)  # alpha=0.3 must actually skew
+    allidx = np.concatenate(parts)
+    assert len(np.unique(allidx)) == len(allidx)  # disjoint
+
+
+def test_quantity_scheme_deterministic_via_config():
+    labels = _labels(n=300)
+    cfg = DataConfig(
+        partition="quantity", data_fraction=0.25, dirichlet_alpha=0.2,
+        seed_base=5,
+    )
+    a = partition_indices(labels, 4, cfg)
+    b = partition_indices(labels, 4, cfg)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_quantity_infeasible_fractions_refused():
+    rng = np.random.default_rng(0)
+    with pytest.raises(ValueError, match="infeasible"):
+        quantity_skew_indices(
+            100, 4, alpha=1.0, data_fraction=0.5, rng=rng
+        )
+    with pytest.raises(ValueError, match="one row each"):
+        quantity_skew_indices(
+            2, 4, alpha=1.0, data_fraction=0.25, rng=rng
+        )
+
+
+def test_unknown_partition_scheme_fails_at_config_time():
+    with pytest.raises(ValueError, match="unknown partition"):
+        DataConfig(partition="bogus")

@@ -1,0 +1,165 @@
+"""faults/scenario.py: the persona x partition matrix over the live wire.
+
+Fast-lane cells (one short live campaign per persona, tiny payloads,
+tight deadlines) pinning the PR 6 robustness contract: every
+quorum-satisfiable round succeeds over survivors, the aggregate is
+crc-pinned BIT-EXACT with the clean barrier mean over the same survivor
+set, and the obs timeline attributes drops/straggler-wait correctly.
+"""
+
+import json
+
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.faults.scenario import (
+    CellSpec,
+    ScenarioConfig,
+    build_matrix,
+    comparison_grid,
+    contract_violations,
+    run_cell,
+    write_jsonl,
+)
+
+
+def _cfg(**kw):
+    kw.setdefault("num_clients", 3)
+    kw.setdefault("rounds", 1)
+    kw.setdefault("payload_kb", 24)
+    kw.setdefault("deadline_s", 6.0)
+    kw.setdefault("partitions", ("iid",))
+    return ScenarioConfig(**kw)
+
+
+def _one_persona_cell(persona, partition="iid", **cell_kw):
+    return CellSpec(
+        name=f"{persona}|{partition}",
+        personas=(persona, "honest", "honest"),
+        partition=partition,
+        **cell_kw,
+    )
+
+
+def _assert_contract(res, expect_contributors):
+    assert [r.ok for r in res.rounds] == [True] * len(res.rounds)
+    for r in res.rounds:
+        assert r.bitexact is True, (r, res.notes)
+    assert res.rounds[-1].contributors == expect_contributors
+
+
+def test_lazy_round_bitexact_survivor_mean(tmp_path):
+    cfg = _cfg(personas=("lazy",))
+    res = run_cell(_one_persona_cell("lazy"), cfg, str(tmp_path))
+    _assert_contract(res, [0, 1, 2])
+    assert res.rounds[0].dropped == []
+
+
+def test_slow_round_is_straggler_with_measured_wait(tmp_path):
+    """The throttled client still contributes; the obs timeline charges
+    the OTHER clients a straggler wait for it."""
+    cfg = _cfg(personas=("slow",), payload_kb=48)
+    res = run_cell(_one_persona_cell("slow"), cfg, str(tmp_path))
+    _assert_contract(res, [0, 1, 2])
+    assert res.rounds[0].straggler_wait_s > 0.3
+
+
+def test_intermittent_reset_retry_converges(tmp_path):
+    """Dies mid-upload on the first dial, retries, contributes — the
+    aggregate stays bit-exact with the clean mean over all three."""
+    cfg = _cfg(personas=("intermittent",), deadline_s=8.0)
+    res = run_cell(_one_persona_cell("intermittent"), cfg, str(tmp_path))
+    _assert_contract(res, [0, 1, 2])
+
+
+def test_stale_round_drop_attribution(tmp_path):
+    """The stale persona sits round 2 out: the obs timeline must
+    attribute the drop to client 0 exactly, and the round must close
+    bit-exactly over the survivors."""
+    cfg = _cfg(personas=("stale",), rounds=2, deadline_s=4.0)
+    res = run_cell(_one_persona_cell("stale"), cfg, str(tmp_path))
+    assert [r.ok for r in res.rounds] == [True, True]
+    assert res.rounds[0].contributors == [0, 1, 2]
+    assert res.rounds[1].contributors == [1, 2]
+    assert res.rounds[1].dropped == [0]
+    assert res.rounds[1].bitexact is True  # survivor mean, crc-pinned
+
+
+def test_flaky_net_round_converges(tmp_path):
+    cfg = _cfg(personas=("flaky-net",), deadline_s=8.0)
+    res = run_cell(_one_persona_cell("flaky-net"), cfg, str(tmp_path))
+    _assert_contract(res, [0, 1, 2])
+
+
+def test_auth_cell_and_streamed_round(tmp_path):
+    """Two rounds under HMAC auth with the stream advert on: round 2's
+    uploads are chunk-streamed (stream_uploads > 0) and both rounds stay
+    crc-exact — the acceptance matrix's auth + streamed cells."""
+    cfg = _cfg(personas=("lazy",), rounds=2, deadline_s=6.0)
+    res = run_cell(
+        _one_persona_cell("lazy", auth=True), cfg, str(tmp_path)
+    )
+    _assert_contract(res, [0, 1, 2])
+    assert res.stream_uploads >= 2  # the honest clients streamed round 2
+
+
+def test_dirichlet_cell_weighted_mean_differs_from_iid(tmp_path):
+    """Partition genuinely matters: the dirichlet cell's shard sizes
+    weight the mean differently from the IID cell's equal shards."""
+    cfg = _cfg(
+        personas=("lazy",), partitions=("iid", "dirichlet"),
+        dirichlet_alpha=0.1,
+    )
+    iid = run_cell(_one_persona_cell("lazy", "iid"), cfg, str(tmp_path))
+    dir_ = run_cell(
+        _one_persona_cell("lazy", "dirichlet"), cfg, str(tmp_path)
+    )
+    _assert_contract(iid, [0, 1, 2])
+    _assert_contract(dir_, [0, 1, 2])
+    sizes_iid = [c["rows"] for c in iid.manifest["clients"]]
+    sizes_dir = [c["rows"] for c in dir_.manifest["clients"]]
+    assert len(set(sizes_iid)) == 1  # IID: equal disjoint shards
+    assert len(set(sizes_dir)) > 1  # dirichlet: skewed shard sizes
+    assert iid.rounds[0].live_crc != dir_.rounds[0].live_crc
+
+
+def test_matrix_build_and_reports(tmp_path):
+    """build_matrix covers persona x partition + the auth cell; the grid
+    and JSONL emitters round-trip a result set without running rounds."""
+    cfg = _cfg(
+        personas=("lazy", "slow"), partitions=("iid", "dirichlet"),
+    )
+    cells = build_matrix(cfg)
+    assert len(cells) == 5  # 2x2 + auth
+    assert cells[-1].auth
+    assert {c.partition for c in cells} == {"iid", "dirichlet"}
+    with pytest.raises(ValueError, match="unknown partition"):
+        build_matrix(_cfg(personas=("lazy",), partitions=("weird",)))
+    # Emitters over a real (tiny) result.
+    res = run_cell(
+        _one_persona_cell("lazy"), _cfg(personas=("lazy",)), str(tmp_path)
+    )
+    grid = comparison_grid([res], _cfg(personas=("lazy",)))
+    assert "lazy" in grid and "crc" in grid
+    path = write_jsonl([res], str(tmp_path / "scenario.jsonl"))
+    rec = json.loads(open(path).read().splitlines()[0])
+    assert rec["cell"] == "lazy|iid"
+    assert rec["rounds"][0]["bitexact"] is True
+    assert rec["manifest"]["clients"][0]["rows"] > 0
+    assert contract_violations([res]) == []
+
+
+def test_contract_violation_reported_for_failed_round(tmp_path):
+    """A genuinely quorum-impossible cell (every client stale in the
+    same round) must surface as a contract violation, not silently
+    pass."""
+    cfg = _cfg(personas=("stale",), rounds=2, deadline_s=2.0)
+    spec = CellSpec(
+        name="allstale|iid",
+        personas=("stale", "stale", "stale"),
+        partition="iid",
+    )
+    res = run_cell(spec, cfg, str(tmp_path))
+    # Round 2 (index 1) has zero uploads; quorum=1 cannot be met.
+    assert res.rounds[1].ok is False
+    v = contract_violations([res])
+    assert any("round 1" in x for x in v)
