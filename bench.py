@@ -44,6 +44,15 @@ Secondary modes via BENCH_MODE:
                       rounds/hour, promotion latency (round end -> serving
                       pointer swap), and a machine-parsed gate_rejections
                       field (BENCH_CTRL_* knobs: ROUNDS, CLIENTS, PARAM_MB)
+    scenario          the `fedtpu scenario` persona x partition matrix run
+                      small: live loopback rounds with wire-level fault
+                      injection; scenario_rounds_ok_frac asserted 1.0
+    fleet             fleet-scale rounds (comm/relay.py): a live loopback
+                      depth-2 fold tree — BENCH_FLEET_CLIENTS clients
+                      (default 64) behind BENCH_FLEET_RELAYS relays behind
+                      one weighted root, streamed both ways; headline
+                      fleet_rounds_per_hour + relay_peak_agg_bytes, root
+                      aggregate crc-pinned vs the aggregate_tree replay
 
 Every record is one JSON line of the shape
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
@@ -987,6 +996,168 @@ def _run_controller_fleet(
     return stats, wall, comm_phases, stream_info
 
 
+def bench_fleet() -> dict | None:
+    """Fleet-scale rounds (ISSUE 7): a LIVE loopback depth-2 fold tree —
+    BENCH_FLEET_CLIENTS simulated clients (default 64) behind
+    BENCH_FLEET_RELAYS relays (default 8) behind one weighted root, every
+    hop chunk-streamed both ways (uploads AND replies). Headline fields
+    (asserted present by the train-mode headline, exit 3):
+    ``fleet_rounds_per_hour`` — full-fleet round cadence including the
+    relay forward hop — and ``relay_peak_agg_bytes`` — the worst relay's
+    aggregation-state peak, the O(model + in-flight) bound that replaces
+    the flat tier's O(clients x model). ``fleet_crc_exact`` pins the
+    root aggregate bit-exact against aggregate_tree's replay of the
+    captured uploads (the PR 5/6 crc contract at depth 2)."""
+    import threading as _threading
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        AggregationServer,
+        FederatedClient,
+        RelayAggregator,
+        aggregate_tree,
+        wire,
+    )
+
+    n_clients = int(os.environ.get("BENCH_FLEET_CLIENTS", "64"))
+    n_relays = int(os.environ.get("BENCH_FLEET_RELAYS", "8"))
+    rounds = int(os.environ.get("BENCH_FLEET_ROUNDS", "2"))
+    param_mb = float(os.environ.get("BENCH_FLEET_PARAM_MB", "1"))
+    per = max(1, n_clients // n_relays)
+    n_clients = per * n_relays
+    n_leaves = 16
+    leaf_elems = max(1, int(param_mb * 1e6 / 4 / n_leaves))
+    rng = np.random.default_rng(0)
+    base = {
+        f"w{i:02d}": rng.normal(size=leaf_elems).astype(np.float32)
+        for i in range(n_leaves)
+    }
+    chunk = max(64 << 10, int(param_mb * (1 << 20)) // 8)
+    groups = [list(range(r * per, (r + 1) * per)) for r in range(n_relays)]
+    uploads = [
+        {k: v + np.float32(0.001 * (cid + 1)) for k, v in base.items()}
+        for cid in range(n_clients)
+    ]
+    errors: list[Exception] = []
+    root_aggs: list[dict] = []
+    replies: dict[int, dict] = {}
+    try:
+        with AggregationServer(
+            port=0, num_clients=n_relays, weighted=True, timeout=120,
+            stream_chunk_bytes=chunk,
+        ) as root:
+            relays = [
+                RelayAggregator(
+                    "127.0.0.1", 0, parent_host="127.0.0.1",
+                    parent_port=root.port, relay_id=r, num_clients=per,
+                    timeout=120, stream_chunk_bytes=chunk,
+                )
+                for r in range(n_relays)
+            ]
+            try:
+                def root_loop():
+                    for _ in range(rounds):
+                        try:
+                            root_aggs.append(root.serve_round())
+                        except RuntimeError as e:
+                            errors.append(e)
+
+                rt = _threading.Thread(target=root_loop, daemon=True)
+                rt.start()
+                for rel in relays:
+                    _threading.Thread(
+                        target=rel.serve, args=(rounds,), daemon=True
+                    ).start()
+                clients = [
+                    FederatedClient(
+                        "127.0.0.1", relays[cid // per].port,
+                        client_id=cid, timeout=120,
+                    )
+                    for cid in range(n_clients)
+                ]
+
+                def client_loop(cid: int) -> None:
+                    try:
+                        for _ in range(rounds):
+                            replies[cid] = clients[cid].exchange(
+                                uploads[cid]
+                            )
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(e)
+
+                t0 = time.perf_counter()
+                cthreads = [
+                    _threading.Thread(
+                        target=client_loop, args=(c,), daemon=True
+                    )
+                    for c in range(n_clients)
+                ]
+                for t in cthreads:
+                    t.start()
+                for t in cthreads:
+                    t.join(timeout=240)
+                rt.join(timeout=60)
+                wall = time.perf_counter() - t0
+                relay_peak = max(
+                    rel.server.stream_totals["peak_agg_bytes"]
+                    for rel in relays
+                )
+                stream_replies = root.stream_totals[
+                    "stream_replies"
+                ] + sum(
+                    rel.server.stream_totals["stream_replies"]
+                    for rel in relays
+                )
+            finally:
+                for rel in relays:
+                    rel.close()
+            root_peak = root.stream_totals["peak_agg_bytes"]
+    except Exception as e:  # noqa: BLE001 - one parseable line, not a dump
+        errors.append(e)
+        wall = 1.0
+    if errors or len(root_aggs) < rounds or len(replies) < n_clients:
+        record = {
+            "metric": "bench_error",
+            "error": "fleet_round_failed",
+            "detail": (
+                str(errors[0])[:300]
+                if errors
+                else f"{len(root_aggs)}/{rounds} rounds, "
+                f"{len(replies)}/{n_clients} clients completed"
+            ),
+        }
+        _emit(record)
+        return record
+    want = aggregate_tree(uploads, None, groups)
+    want_crc = wire.flat_crc32(want)
+    crc_ok = wire.flat_crc32(root_aggs[-1]) == want_crc and all(
+        wire.flat_crc32(replies[c]) == want_crc for c in replies
+    )
+    record = {
+        "metric": f"fleet_rounds_per_hour_c{n_clients}_r{n_relays}",
+        "value": round(rounds / wall * 3600.0, 1),
+        "unit": "rounds/hour",
+        # Scale headroom vs the flat tier's connection ceiling: clients
+        # terminated per process at depth 2 vs flat (lower is better for
+        # the root; vs_baseline is the fan-in reduction factor).
+        "vs_baseline": round(n_clients / n_relays, 2),
+        "baseline_note": "fan-in reduction at the root vs the flat "
+        "single-server tier (which terminates every client itself)",
+        "fleet_rounds_per_hour": round(rounds / wall * 3600.0, 1),
+        "relay_peak_agg_bytes": int(relay_peak),
+        "root_peak_agg_bytes": int(root_peak),
+        "fleet_crc_exact": 1.0 if crc_ok else 0.0,
+        "fleet_clients": n_clients,
+        "fleet_relays": n_relays,
+        "tree_depth": 2,
+        "rounds": rounds,
+        "param_mb": param_mb,
+        "stream_replies": int(stream_replies),
+        "wall_s": round(wall, 3),
+    }
+    _emit(record)
+    return record
+
+
 def bench_scenario() -> dict | None:
     """Persona-matrix loopback sweep (ISSUE 6): the `fedtpu scenario`
     harness run small — a persona x partition matrix of LIVE TCP rounds
@@ -1302,6 +1473,7 @@ def _preflight() -> None:
 MODES = (
     "train", "bert", "bertlarge", "eval", "fedavg", "flash", "ring",
     "fed2", "fedseq", "serve", "clientdp", "controller", "scenario",
+    "fleet",
 )
 
 #: Federated product-step MFU floor (fed2/fedseq): the driver-captured
@@ -1359,6 +1531,7 @@ def main() -> None:
             # federated MFUs as machine-parsed fields. BENCH_SECONDARY=0
             # restores the single-line behavior.
             rec_fed2 = rec_fedseq = rec_ctrl = rec_resid = rec_scn = None
+            rec_fleet = None
             if os.environ.get("BENCH_SECONDARY", "1").lower() not in (
                 "", "0", "false",
             ):
@@ -1372,6 +1545,7 @@ def main() -> None:
                 bench_serving()
                 rec_ctrl = bench_controller()
                 rec_scn = bench_scenario()
+                rec_fleet = bench_fleet()
             extra = {}
             for key, rec in (("fed2", rec_fed2), ("fedseq", rec_fedseq)):
                 if rec is not None and rec.get("mfu") is not None:
@@ -1453,13 +1627,43 @@ def main() -> None:
                     rec_scn["scenario_rounds_ok_frac"] < 1.0
                     or rec_scn["scenario_crc_exact_frac"] < 1.0
                 )
+            fleet_broken = False
+            if rec_fleet is not None and (
+                rec_fleet.get("metric") != "bench_error"
+            ):
+                # Fleet-scale headline fields (ISSUE 7): ASSERTED present
+                # — a refactor that drops the relay tier's fold or peak
+                # accounting must fail the bench loudly (exit 3), exactly
+                # like the comm_phase_* / comm_overlap_frac contract.
+                missing = [
+                    k
+                    for k in ("fleet_rounds_per_hour", "relay_peak_agg_bytes")
+                    if k not in rec_fleet
+                ]
+                if missing:
+                    _emit(
+                        {
+                            "metric": "bench_error",
+                            "error": "fleet_fields_missing",
+                            "detail": f"fleet record lacks {missing} "
+                            "(relay stream_totals accounting broken?)",
+                        }
+                    )
+                    raise SystemExit(3)
+                for k in (
+                    "fleet_rounds_per_hour",
+                    "relay_peak_agg_bytes",
+                    "fleet_crc_exact",
+                ):
+                    extra[k] = rec_fleet[k]
+                fleet_broken = rec_fleet["fleet_crc_exact"] < 1.0
             broken = _check_mfu_floor(
                 {"fed2": rec_fed2, "fedseq": rec_fedseq}
             )
             if broken:
                 extra.update(mfu_floor=MFU_FLOOR, mfu_floor_broken=broken)
             bench_train(ModelConfig(), "distilbert", extra=extra or None)
-            if broken or scenario_broken:
+            if broken or scenario_broken or fleet_broken:
                 raise SystemExit(3)
         elif mode == "bert":
             bench_train(ModelConfig.bert_base(), "bertbase")
@@ -1492,6 +1696,12 @@ def main() -> None:
             if rec is not None and rec.get("metric") != "bench_error" and (
                 rec["scenario_rounds_ok_frac"] < 1.0
                 or rec["scenario_crc_exact_frac"] < 1.0
+            ):
+                raise SystemExit(3)
+        elif mode == "fleet":
+            rec = bench_fleet()
+            if rec is not None and rec.get("metric") != "bench_error" and (
+                rec["fleet_crc_exact"] < 1.0
             ):
                 raise SystemExit(3)
     finally:

@@ -1,5 +1,6 @@
-"""fedtpu serve / client — the TCP demo-parity mode (the reference's
-socket deployment shape, server.py + client1.py end-to-end)."""
+"""fedtpu serve / client / relay — the TCP demo-parity mode (the
+reference's socket deployment shape, server.py + client1.py end-to-end,
+plus the hierarchical fold tree's intermediate aggregator)."""
 
 from __future__ import annotations
 
@@ -160,6 +161,47 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_relay(args) -> int:
+    """``fedtpu relay`` — one intermediate aggregator of the hierarchical
+    fold tree (comm/relay.py): terminate ``--num-clients`` subtree client
+    connections, fold their (streamed or dense) uploads into a partial
+    weighted mean as chunks land, forward ONE streamed upload per round
+    to ``--parent-host:--parent-port``, and fan the root's aggregate back
+    out to the subtree. Clients point at the relay exactly as they would
+    at a root server; run the ROOT ``fedtpu serve`` with ``--weighted``
+    so subtree means recombine by their sample mass."""
+    from ..comm import wire as _wire
+    from ..comm.relay import RelayAggregator
+
+    tracer, _metrics = _obs_setup(
+        args, proc=f"relay-{args.relay_id}", metrics_host=args.host
+    )
+    stream_chunk_bytes = _wire.stream_chunk_bytes_from_mb(
+        getattr(args, "stream_chunk_mb", None)
+    )
+    with RelayAggregator(
+        args.host,
+        args.port,
+        parent_host=args.parent_host,
+        parent_port=args.parent_port,
+        relay_id=args.relay_id,
+        num_clients=args.num_clients,
+        min_clients=args.min_clients,
+        timeout=args.timeout,
+        compression=args.compression,
+        auth_key=_auth_key(),
+        stream_chunk_bytes=stream_chunk_bytes,
+        stream=bool(getattr(args, "stream_upload", True)),
+        tracer=tracer,
+    ) as relay:
+        log.info(
+            f"[RELAY {args.relay_id}] listening on {args.host}:{relay.port}"
+            f" -> parent {args.parent_host}:{args.parent_port}"
+        )
+        relay.serve(rounds=args.rounds or 1)
+    return 0
+
+
 def cmd_client(args) -> int:
     """The reference client1.py end-to-end: (warm start ->) train -> eval ->
     exchange over TCP -> load aggregate -> re-eval -> CSVs + plots; degrades
@@ -257,6 +299,12 @@ def cmd_client(args) -> int:
         tracer=client_tracer,
         stream=bool(getattr(args, "stream_upload", True)),
     )
+    sink = getattr(trainer, "reply_leaf_sink", None)
+    if sink is not None:
+        # Meshed client (train/client_mesh.py): streamed-reply leaves
+        # scatter onto the local device mesh as their chunks land, so
+        # adopt_aggregate never waits for a full host-side tree.
+        fed.reply_leaf_sink = sink
     rounds = max(1, getattr(args, "rounds", None) or 1)
     local = agg_metrics = None
     E = cfg.train.epochs_per_round

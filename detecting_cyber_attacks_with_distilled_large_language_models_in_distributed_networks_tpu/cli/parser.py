@@ -21,6 +21,12 @@ covers every deployment shape, parameterized by client id / count:
   serve       TCP aggregation server (demo-parity mode, reference server.py)
   client      TCP client: train locally, exchange with a serve process,
               re-evaluate the aggregate (reference client1.py end-to-end)
+  relay       intermediate aggregator of the hierarchical fold tree
+              (comm/relay.py): terminate a subtree of client connections,
+              fold them into a partial weighted mean as chunks land, and
+              forward one streamed upload per round to the parent — how a
+              round scales past one server process to 64-256-client
+              cohorts (run the root serve with --weighted)
   controller  control plane: unattended continuous federated rounds with
               an eval-gated model registry — round -> held-out eval ->
               candidate artifact -> promote (or reject on regression) ->
@@ -46,7 +52,7 @@ import json
 import sys
 from typing import Sequence
 
-from .comm import cmd_client, cmd_serve
+from .comm import cmd_client, cmd_relay, cmd_serve
 from .common import resolve_config
 from .control import cmd_controller, cmd_registry
 from .distill import cmd_distill
@@ -423,6 +429,78 @@ def build_parser() -> argparse.ArgumentParser:
         "costs no privacy",
     )
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "relay",
+        help="intermediate aggregator: fold a subtree of clients into a "
+        "partial weighted mean and forward one streamed upload upward "
+        "(hierarchical fold tree for 64-256-client cohorts)",
+        epilog="Clients point at the relay exactly as at a root server "
+        "(same wire protocol, same FEDTPU_SECRET auth). Run the ROOT "
+        "`fedtpu serve` with --num-clients = the relay count and "
+        "--weighted, so subtree means recombine by their sample mass. "
+        "Secure aggregation and central DP stay single-aggregator by "
+        "design — run those fleets flat.",
+    )
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument(
+        "--port", type=int, default=12346,
+        help="subtree-facing listen port (default 12346)",
+    )
+    p.add_argument(
+        "--parent-host", default="127.0.0.1",
+        help="root (or higher-tier relay) host (default 127.0.0.1)",
+    )
+    p.add_argument(
+        "--parent-port", type=int, default=12345,
+        help="root (or higher-tier relay) port (default 12345)",
+    )
+    p.add_argument(
+        "--relay-id", type=int, required=True,
+        help="this relay's client id on the PARENT tier — the fixed "
+        "subtree order at the root (ascending relay id)",
+    )
+    p.add_argument(
+        "--num-clients", type=int, default=2,
+        help="subtree size: how many clients this relay terminates",
+    )
+    p.add_argument("--min-clients", type=int, default=None)
+    p.add_argument("--rounds", type=int, default=1)
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument(
+        "--compression",
+        default="none",
+        type=_reply_compression,
+        help="wire encoding both ways at this hop: none|bf16|int8",
+    )
+    p.add_argument(
+        "--stream-chunk-mb",
+        type=float,
+        default=None,
+        help="chunk-streamed upload advert for the subtree (see `serve "
+        "--stream-chunk-mb`); 0 = barrier shape below this relay",
+    )
+    p.add_argument(
+        "--no-stream-upload",
+        dest="stream_upload",
+        action="store_false",
+        default=True,
+        help="send the upward partial as one dense frame (and skip the "
+        "streamed-reply advert to the parent)",
+    )
+    p.add_argument(
+        "--trace-jsonl",
+        help="append obs spans (round/agg/wire-reply/relay-forward) to "
+        "this events-JSONL; merge with `fedtpu obs timeline --trace-dir`",
+    )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=0,
+        help="Prometheus /metrics for this relay's round engine "
+        "(0 = off, the default)",
+    )
+    p.set_defaults(fn=cmd_relay)
 
     p = sub.add_parser(
         "client",

@@ -9,6 +9,7 @@ CRC'd chunked framing, and a native C++ byte-path (native/fedwire.cpp).
 
 from .client import FederatedClient, connect_with_retry  # noqa: F401
 from .framing import PipelinedSender, recv_frame, send_frame  # noqa: F401
+from .relay import RelayAggregator, aggregate_tree  # noqa: F401
 from .secure import SecureAggError, aggregate_masked, masked_upload  # noqa: F401
 from .server import AggregationServer, aggregate_flat  # noqa: F401
 from .stream_agg import StreamAgg, StreamAggPoisoned  # noqa: F401

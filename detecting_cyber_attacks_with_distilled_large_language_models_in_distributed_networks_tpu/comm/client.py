@@ -282,6 +282,24 @@ class FederatedClient:
         # latter's encoded size is data-dependent (no plannable header).
         self.stream = bool(stream)
         self._server_stream: int | None = None
+        # Streamed-REPLY capability (wire.py "Streamed replies"): when
+        # this client can decode STRH/STRC/STRT replies it says so in
+        # every upload's meta; a capable server then streams the
+        # aggregate back and each leaf decodes as its bytes land. Works
+        # from round 1 (the advert travels client -> server). Masked
+        # rounds stay dense both ways (single-aggregator protocol).
+        # ``reply_leaf_sink``: optional callable ``(key, ndarray) ->
+        # leaf`` applied to each PLAIN streamed-reply leaf the moment it
+        # decodes — the mesh tier's hook (train/client_mesh.py) places
+        # leaves onto device buffers while later chunks are still on the
+        # wire, so adopt_aggregate never waits for a full host-side
+        # tree. Never applied to DP/sparse replies (their deltas need
+        # host arithmetic first) or dense replies.
+        self.reply_leaf_sink = None
+        # One-line dense-fallback reasons already logged (log each once,
+        # not per round — an old peer would otherwise say it every
+        # exchange).
+        self._fallback_logged: set[str] = set()
         if secure_agg and auth_key is None:
             log.warning(
                 f"[CLIENT {client_id}] --secure-agg without an auth key "
@@ -343,6 +361,10 @@ class FederatedClient:
             "n_samples": int(n_samples),
             **dict(meta or {}),
         }
+        if self.stream and not self.secure_agg:
+            # Streamed-reply advert: plain meta, so an old server ignores
+            # it and keeps sending the dense frame (interop unchanged).
+            base_meta[wire.STREAM_REPLY_META_KEY] = 1
         dp_base_flat = dp_delta = None
         if self.dp:
             # ``round_base``: the params this round's local training
@@ -707,6 +729,8 @@ class FederatedClient:
                         and self._server_stream is not None
                         and attempt == 1
                     )
+                    if not use_stream:
+                        self._log_dense_fallback(attempt)
                     if use_stream:
                         up_flat = (
                             stream_flat
@@ -828,10 +852,23 @@ class FederatedClient:
                         ),
                     )
                     reply = framing.recv_frame(sock)
+                if bytes(reply[:4]) == wire.STREAM_MAGIC:
+                    # Chunk-streamed reply (wire.py "Streamed replies"):
+                    # the header frame already arrived; leaves decode —
+                    # and, on a meshed client, land on device — as the
+                    # remaining chunks come off the wire.
+                    agg_flat, agg_meta, reply_bytes = (
+                        self._recv_stream_reply(sock, reply, nonce_hex)
+                    )
+                    agg = wire.unflatten_params(agg_flat)
+                else:
+                    agg, agg_meta = wire.decode(
+                        reply, auth_key=self.auth_key
+                    )
+                    reply_bytes = len(reply)
                 reply_timing = (
-                    t_rep_unix, time.monotonic() - t_rep0, len(reply),
+                    t_rep_unix, time.monotonic() - t_rep0, reply_bytes,
                 )
-                agg, agg_meta = wire.decode(reply, auth_key=self.auth_key)
                 if self.auth_key is not None and (
                     agg_meta.get("role") != "server"
                     or agg_meta.get("nonce") != nonce_hex
@@ -875,7 +912,7 @@ class FederatedClient:
                             del store[k]
                 log.info(
                     f"[CLIENT {self.client_id}] received aggregated model "
-                    f"({len(reply) / 1e6:.1f} MB, clients {agg_meta.get('round_clients')})"
+                    f"({reply_bytes / 1e6:.1f} MB, clients {agg_meta.get('round_clients')})"
                 )
                 if self.dp:
                     if agg_meta.get("dp_reply") == "noop":
@@ -1074,6 +1111,134 @@ class FederatedClient:
         wall = max(time.monotonic() - t0, 1e-9)
         overlap_s = max(0.0, pack_s + send_s - wall)
         return sent, seq, overlap_s
+
+    def _log_dense_fallback(self, attempt: int) -> None:
+        """One line naming WHY this upload goes dense while the streamed
+        shape exists — the silent fallbacks (topk, secure-agg, old peer,
+        retry) were otherwise indistinguishable from streaming working.
+        Each distinct reason logs once per client lifetime; the server
+        counts them on /metrics (``stream_fallbacks_total``)."""
+        if not self.stream:
+            reason = "--no-stream-upload"
+        elif self.secure_agg:
+            reason = "secure-agg (masked uploads are single-frame by design)"
+        elif self._topk_frac is not None:
+            reason = "topk (payload size is data-dependent; nothing to plan)"
+        elif self._server_stream is None:
+            reason = "no stream advert seen yet (old peer, or round 1)"
+        else:
+            reason = (
+                f"retry attempt {attempt} (dense is always correct after "
+                "a failed streamed attempt)"
+            )
+        if reason not in self._fallback_logged:
+            self._fallback_logged.add(reason)
+            log.info(
+                f"[CLIENT {self.client_id}] upload falls back to a dense "
+                f"single frame: {reason}"
+            )
+
+    def _recv_stream_reply(
+        self, sock: socket.socket, header, nonce_hex: str | None
+    ) -> tuple[dict, dict, int]:
+        """Receive one chunk-streamed aggregate reply (wire.py "Streamed
+        replies"): decode each leaf the moment its bytes complete. In
+        auth mode every frame's tag verifies under the REPLY-direction
+        HMAC domain before any byte is trusted, so a reflected upload
+        chunk (valid under the upload domain, same nonce and seq) can
+        never pass as aggregate data. Plain (non-DP, non-sparse) replies
+        pass each decoded leaf through ``reply_leaf_sink`` when set —
+        the mesh tier's on-device placement — while later chunks are
+        still in flight. Returns ``(flat leaves, meta, bytes read)``."""
+        tensors, meta, _chunk_bytes, payload_nbytes = (
+            wire.decode_stream_header(
+                header,
+                auth_key=self.auth_key,
+                max_payload=framing.MAX_FRAME,
+                direction="down",
+            )
+        )
+        if self.auth_key is not None and (
+            meta.get("role") != "server" or meta.get("nonce") != nonce_hex
+        ):
+            # Checked BEFORE any model bytes move (the dense path checks
+            # after its one-frame decode; here the meta arrives first).
+            raise wire.WireError(
+                "streamed reply failed the freshness check (stale nonce "
+                "or wrong role) — possible replay"
+            )
+        nonce = bytes.fromhex(nonce_hex) if nonce_hex else b""
+        sink = self.reply_leaf_sink
+        if self.dp or self._topk_frac is not None or (
+            meta.get("dp_reply") is not None
+        ):
+            # DP deltas and sparse bases need host arithmetic before any
+            # placement; the sink contract is absolute aggregate leaves.
+            sink = None
+        flat: dict[str, Any] = {}
+        ti = 0
+        leaf_buf = bytearray()
+
+        def _consume(data) -> None:
+            nonlocal ti, leaf_buf
+            off = 0
+            while True:
+                while ti < len(tensors) and len(leaf_buf) == int(
+                    tensors[ti]["nbytes"]
+                ):
+                    t = tensors[ti]
+                    arr = wire.decode_tensor_entry(t, bytes(leaf_buf))
+                    flat[t["key"]] = sink(t["key"], arr) if sink else arr
+                    leaf_buf = bytearray()
+                    ti += 1
+                if off >= len(data):
+                    return
+                if ti >= len(tensors):
+                    raise wire.WireError(
+                        "reply stream carries bytes past its last tensor"
+                    )
+                take = min(
+                    int(tensors[ti]["nbytes"]) - len(leaf_buf),
+                    len(data) - off,
+                )
+                leaf_buf += data[off : off + take]
+                off += take
+
+        received = 0
+        seq = 0
+        got = len(header)
+        _consume(b"")  # zero-size leading leaves / empty payloads
+        while received < payload_nbytes:
+            frame = framing.recv_frame(sock, send_ack=False)
+            got += len(frame)
+            data = wire.decode_stream_chunk(
+                frame,
+                expect_seq=seq,
+                auth_key=self.auth_key,
+                nonce=nonce,
+                direction="down",
+            )
+            if not data:
+                raise wire.WireError(f"empty reply stream chunk (seq {seq})")
+            seq += 1
+            if received + len(data) > payload_nbytes:
+                raise wire.WireError(
+                    "reply stream overruns its declared payload size"
+                )
+            received += len(data)
+            _consume(data)
+        if ti != len(tensors) or leaf_buf:
+            raise wire.WireError("reply stream ended mid-tensor")
+        trailer = framing.recv_frame(sock)
+        got += len(trailer)
+        wire.decode_stream_end(
+            trailer,
+            expect_chunks=seq,
+            auth_key=self.auth_key,
+            nonce=nonce,
+            direction="down",
+        )
+        return flat, meta, got
 
     # ------------------------------------------------------ observability
     def note_local_phase(

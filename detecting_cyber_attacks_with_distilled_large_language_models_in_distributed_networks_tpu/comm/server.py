@@ -126,6 +126,10 @@ class _Round:
     # into the running mean as they complete. None in secure-agg mode
     # (masked sums keep the barrier path).
     stream: Any = None
+    # Clients whose upload meta advertised streamed-REPLY capability
+    # (wire.STREAM_REPLY_META_KEY): their reply fan-out goes out as
+    # STRH/STRC/STRT frames instead of one dense model-sized frame.
+    stream_replies: set = field(default_factory=set)
 
 
 class AggregationServer:
@@ -328,10 +332,27 @@ class AggregationServer:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
-        self._sock.listen(num_clients * 2)
+        # Backlog sized for fleet cohorts: 256 clients dialing one round
+        # start simultaneously overflow the old num_clients*2 backlog on
+        # small fleets' defaults (refused dials burn client retries);
+        # the kernel clamps to SOMAXCONN, so asking high is free.
+        self._sock.listen(max(128, num_clients * 2))
         self._sock.settimeout(timeout)
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
+        # Bounded upload-handler pool (one task per accepted connection).
+        # Unbounded thread-per-dial let a retry storm (or a port scan)
+        # spawn without limit; the bound must still exceed the fleet —
+        # secure-mode handlers all block concurrently on the DH/key
+        # rendezvous, and duplicate retry dials legitimately coexist with
+        # the originals — hence 2x the fleet plus slack, queueing the
+        # excess instead of spawning it.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(
+            max_workers=2 * num_clients + 8,
+            thread_name_prefix="fedtpu-upload",
+        )
         # Observability (obs/): optional span tracer + always-on cheap
         # phase accounting. phase_seconds accumulates where each round's
         # wall went — wait (accept + straggler + upload wire), agg
@@ -371,7 +392,22 @@ class AggregationServer:
             "peak_agg_bytes": 0,
             "last_round_peak_bytes": 0,
             "stream_uploads": 0,
+            # Reply-side streaming + fallback accounting (PR 7): replies
+            # shipped as chunk streams, and dense uploads accepted while
+            # the streaming advert was active (topk/secure-agg/old-peer/
+            # retry fallbacks — the client logs its one-line reason).
+            "stream_replies": 0,
+            "stream_fallbacks": 0,
         }
+        # Hierarchical fold tree hook (comm/relay.py): when set, the
+        # plain round's aggregate is handed to this callable BETWEEN
+        # aggregation and the reply fan-out — the relay forwards the
+        # subtree partial to its parent and returns the ROOT aggregate,
+        # which is what this subtree's clients then receive (and what
+        # next round's sparse-delta base tracks). Incompatible with DP
+        # (the partial would be an un-noised release) and secure-agg
+        # (the unmask protocol needs the single-aggregator shape).
+        self.reply_via = None
         self.tracer = tracer
         self.phase_seconds = {"wait": 0.0, "agg": 0.0, "reply": 0.0}
         self.last_trace: tuple[str, int] | None = None
@@ -379,6 +415,19 @@ class AggregationServer:
         self._m_stream_uploads = m.counter(
             "fedtpu_server_stream_uploads_total",
             help="chunk-streamed client uploads accepted into a round",
+        )
+        self._m_stream_replies = m.counter(
+            "fedtpu_server_stream_replies_total",
+            help="aggregate replies fanned out as chunk streams",
+        )
+        self._m_stream_fallbacks = m.counter(
+            "fedtpu_server_stream_fallbacks_total",
+            help="dense single-frame uploads accepted while streaming "
+            "was advertised (topk/secure-agg/old-peer/retry fallbacks)",
+        )
+        self._g_inflight_streams = m.gauge(
+            "fedtpu_server_stream_inflight",
+            help="chunk-streamed uploads currently mid-transfer",
         )
         self._g_peak_agg = m.gauge(
             "fedtpu_server_peak_agg_bytes",
@@ -418,6 +467,10 @@ class AggregationServer:
     def close(self) -> None:
         self._stop.set()
         self._sock.close()
+        # Queued-but-unstarted handler tasks are abandoned (their
+        # connections close with the process); running ones are daemons
+        # of the pool and drop out on their socket errors.
+        self._pool.shutdown(wait=False, cancel_futures=True)
         # Drain the history writer: a clean shutdown must leave the
         # NEWEST resync window on disk, or a restart would re-strand
         # exactly the clients persistence exists to heal.
@@ -872,6 +925,19 @@ class AggregationServer:
                     rnd.n_samples[client_id] = float(meta.get("n_samples", 1.0))
                 if is_delta or bool(meta.get("wants_delta", False)):
                     rnd.wants_delta = True
+                if bool(meta.get(wire.STREAM_REPLY_META_KEY, False)):
+                    rnd.stream_replies.add(client_id)
+                if (
+                    self.stream_chunk_bytes > 0
+                    and not self.secure_agg
+                    and not dup_folded
+                ):
+                    # Upload arrived dense while streaming was advertised:
+                    # a fallback (old peer, topk, a retry, or round 1
+                    # before the client saw the advert). The client logs
+                    # its one-line reason; this side just counts.
+                    self.stream_totals["stream_fallbacks"] += 1
+                    self._m_stream_fallbacks.inc()
                 rnd.conns[client_id] = conn
                 if nonce_hex is not None:
                     rnd.nonces[client_id] = nonce_hex
@@ -1133,6 +1199,8 @@ class AggregationServer:
             # close a mid-stream client too, not leave it blocked.
             rnd.conns[client_id] = conn
             self._try_freeze_stream(rnd)
+        self._g_inflight_streams.inc()
+        in_flight = True
         nonce = bytes.fromhex(nonce_hex) if nonce_hex else b""
         # Lossy-encoded DP uploads (bf16/int8): the decode can inflate an
         # honestly-clipped norm past the tolerance, and the dense path's
@@ -1218,6 +1286,8 @@ class AggregationServer:
                 auth_key=self.auth_key,
                 nonce=nonce,
             )
+            self._g_inflight_streams.dec()
+            in_flight = False
             if not discard and dp_hold is not None:
                 # The dense path's exact enforcement (same functions,
                 # same accumulation order): re-clip the decoded upload,
@@ -1265,6 +1335,8 @@ class AggregationServer:
             # round's registered connection is no longer ours) — the
             # client's state now belongs to that retry, and dropping it
             # here would poison a round the retry just saved.
+            if in_flight:
+                self._g_inflight_streams.dec()
             if not discard:
                 with rnd.lock:
                     if rnd.conns.get(client_id) is conn:
@@ -1299,6 +1371,8 @@ class AggregationServer:
                 rnd.n_samples[client_id] = n_samples
             if bool(meta.get("wants_delta", False)):
                 rnd.wants_delta = True
+            if bool(meta.get(wire.STREAM_REPLY_META_KEY, False)):
+                rnd.stream_replies.add(client_id)
             rnd.conns[client_id] = conn
             if nonce_hex is not None:
                 rnd.nonces[client_id] = nonce_hex
@@ -1819,6 +1893,15 @@ class AggregationServer:
 
         ``round_index`` overrides the internal monotonic round counter
         (secure clients key their mask streams off the advertised value)."""
+        if self.reply_via is not None and (
+            self.dp_clip > 0.0 or self.secure_agg
+        ):
+            raise ValueError(
+                "reply_via (the relay tier's parent-forward hook) is "
+                "incompatible with central DP (the subtree partial would "
+                "be an un-noised release) and secure aggregation (the "
+                "unmask protocol needs the single-aggregator shape)"
+            )
         rnd = _Round(
             expected=self.num_clients,
             round_no=self._round_counter if round_index is None else round_index,
@@ -1868,7 +1951,7 @@ class AggregationServer:
                 base=self._last_agg,
             )
         deadline = time.monotonic() + (self.timeout if deadline is None else deadline)
-        threads: list[threading.Thread] = []
+        futures: list = []
         listener_closed = False
         # Sitting-out liveness bound: once every cohort upload has landed,
         # missing non-sampled clients get a short grace to connect for
@@ -1913,22 +1996,29 @@ class AggregationServer:
                 # in-flight final upload can still complete the round.
                 listener_closed = self._stop.is_set()
                 break
-            t = threading.Thread(
-                target=self._handle_upload, args=(conn, rnd, deadline), daemon=True
-            )
-            t.start()
-            threads.append(t)
+            try:
+                # Bounded pool, not thread-per-dial: a 256-client cohort
+                # (or a retry storm) queues beyond 2*num_clients + 8
+                # concurrent handlers instead of spawning without limit.
+                futures.append(
+                    self._pool.submit(self._handle_upload, conn, rnd, deadline)
+                )
+            except RuntimeError:
+                # close() shut the pool between accept and submit.
+                conn.close()
+                listener_closed = True
+                break
+        import concurrent.futures as _cf
+
         if listener_closed:
             # No new connection can ever arrive: waiting out the full round
             # deadline would just stall shutdown (and leak the round thread
             # past the caller's join window). In-flight handlers may still
             # legitimately complete the round — give them a short bound.
-            for t in threads:
-                t.join(timeout=1.0)
+            _cf.wait(futures, timeout=1.0)
         else:
             rnd.complete.wait(timeout=max(0.0, deadline - time.monotonic()))
-            for t in threads:
-                t.join(timeout=max(0.1, deadline - time.monotonic()))
+            _cf.wait(futures, timeout=max(0.1, deadline - time.monotonic()))
 
         # Everything up to here — accept loop, straggler wait, upload
         # reads — is the round's "wait" phase; aggregation compute and
@@ -2230,6 +2320,26 @@ class AggregationServer:
                     )
                     + ")"
                 )
+            if self.reply_via is not None:
+                # Hierarchical fold tree (comm/relay.py): hand the
+                # subtree's partial weighted mean to the parent exchange;
+                # what comes back — the ROOT's aggregate — is what this
+                # subtree's clients receive, adopt, and (sparse tier)
+                # difference their next deltas against. A parent failure
+                # raises here and the BaseException cleanup below fails
+                # the round for the whole subtree (clients retry).
+                agg = {
+                    k: np.asarray(v, np.float32)
+                    for k, v in self.reply_via(
+                        agg,
+                        {
+                            "ids": list(ids),
+                            "n_samples": {i: n_samples[i] for i in ids},
+                            "round": rnd.round_no,
+                            "trace": rnd.trace,
+                        },
+                    ).items()
+                }
             if dp_mode:
                 # agg is the uniform mean of CLIPPED DELTAS (plain mode:
                 # aggregate_flat over re-clipped uploads; secure mode: the
@@ -2361,20 +2471,48 @@ class AggregationServer:
             # reply: the aggregate is the round's public output and their
             # bases must track the fleet's.
             reply_targets = ids + sorted(skip_conns)
-            if self.auth_key is None:
+            # Streamed replies (wire.py "Streamed replies"): contributors
+            # that advertised the capability get STRH/STRC/STRT frames.
+            # The payload chunks are built ONCE and shared across the
+            # fan-out; resync sequences and sit-out replies stay dense
+            # (rare / not advertised).
+            stream_ids: list[int] = []
+            stream_plan = None
+            if self.stream_chunk_bytes > 0 and not self.secure_agg:
+                stream_ids = [
+                    cid for cid in ids if cid in rnd.stream_replies
+                ]
+            if stream_ids:
+                stream_plan = self._plan_reply_stream(agg)
+            dense_targets = [c for c in reply_targets if c not in stream_ids]
+            if not dense_targets:
+                # All-streaming fleet: no dense blob to build — skipping
+                # the encode saves a model-sized copy + CRC pass per
+                # round in exactly the shape this PR optimizes for.
+                replies = {}
+            elif self.auth_key is None:
                 # One shared reply blob, referenced by every client.
                 shared = wire.encode(
                     agg, meta=reply_meta, compression=self.compression
                 )
-                replies = {cid: shared for cid in reply_targets}
+                replies = {cid: shared for cid in dense_targets}
             else:
                 # Auth mode: each reply echoes that client's challenge nonce
                 # with role=server, so it can't be replayed or reflected.
                 # (Per-client encode costs one extra payload memcpy each.)
                 replies = {
                     cid: self._encode_reply(agg, reply_meta, nonces.get(cid))
-                    for cid in reply_targets
+                    for cid in dense_targets
                 }
+            stream_jobs = {
+                cid: (
+                    self._encode_stream_reply_header(
+                        stream_plan, reply_meta, nonces.get(cid)
+                    ),
+                    bytes.fromhex(nonces[cid]) if cid in nonces else b"",
+                )
+                for cid in stream_ids
+            }
             # Stale-but-resyncable DP clients: the reply is the catch-up
             # SEQUENCE of retained round deltas (applied in order to
             # their base) — their excluded uploads already cost them the
@@ -2429,9 +2567,17 @@ class AggregationServer:
             )
         t_rep_unix = time.time()
         t_rep0 = time.monotonic()
-        self._reply_all(replies, all_conns)
+        self._reply_all(replies, all_conns, stream_plan, stream_jobs)
         reply_s = time.monotonic() - t_rep0
-        self._m_bytes_out.inc(float(sum(len(b) for b in replies.values())))
+        out_bytes = float(sum(len(b) for b in replies.values()))
+        if stream_jobs:
+            out_bytes += sum(
+                len(hdr) + stream_plan["payload_nbytes"]
+                for hdr, _ in stream_jobs.values()
+            )
+            self.stream_totals["stream_replies"] += len(stream_jobs)
+            self._m_stream_replies.inc(float(len(stream_jobs)))
+        self._m_bytes_out.inc(out_bytes)
         if self.tracer is not None:
             self.tracer.record(
                 "wire-reply",
@@ -2519,16 +2665,102 @@ class AggregationServer:
             auth_key=self.auth_key,
         )
 
+    def _plan_reply_stream(self, agg: dict) -> dict:
+        """Build the round's shared streamed-reply payload ONCE: the
+        tensor plan plus the chunk payload list every advertised client's
+        fan-out references. Per-client state (header meta, auth tags) is
+        layered on in :meth:`_encode_stream_reply_header` and
+        :meth:`_send_stream_reply` — a 256-client fan-out never holds
+        more than one encoded copy of the model payload."""
+        flat = wire.flatten_lazy(agg)
+        tensors, payload_nbytes = wire.plan_stream(flat, self.compression)
+        chunks: list[bytes] = []
+        buf = bytearray()
+        for t in tensors:
+            buf += wire.encode_stream_leaf(flat[t["key"]], t["enc"])
+            while len(buf) >= self.stream_chunk_bytes:
+                chunks.append(bytes(buf[: self.stream_chunk_bytes]))
+                del buf[: self.stream_chunk_bytes]
+        if buf:
+            chunks.append(bytes(buf))
+        return {
+            "tensors": tensors,
+            "chunks": chunks,
+            "payload_nbytes": payload_nbytes,
+        }
+
+    def _encode_stream_reply_header(
+        self, plan: dict, meta: dict, nonce: str | None
+    ) -> bytes:
+        """One client's STRH reply header (auth mode echoes its nonce
+        with role=server, exactly like the dense reply's meta)."""
+        if self.auth_key is not None:
+            meta = {**meta, "role": "server", "nonce": nonce}
+        return wire.encode_stream_header(
+            plan["tensors"],
+            meta=meta,
+            chunk_bytes=self.stream_chunk_bytes,
+            payload_nbytes=plan["payload_nbytes"],
+            auth_key=self.auth_key,
+            direction="down",
+        )
+
+    def _send_stream_reply(
+        self,
+        conn: socket.socket,
+        header: bytes,
+        plan: dict,
+        nonce: bytes,
+    ) -> None:
+        """Ship one streamed reply: ACKed header, fire-and-forget chunk
+        frames (the client reads them without interleaving ACK writes),
+        ACKed trailer — the mirror of the upload direction's shape. Chunk
+        envelopes (seq + per-connection tag under the reply-direction
+        domain) are built per send, so the shared payload is never
+        duplicated per client."""
+        framing.send_frame(conn, header)
+        for seq, chunk in enumerate(plan["chunks"]):
+            framing.send_frame(
+                conn,
+                wire.encode_stream_chunk(
+                    seq,
+                    chunk,
+                    auth_key=self.auth_key,
+                    nonce=nonce,
+                    direction="down",
+                ),
+                await_ack=False,
+            )
+        framing.send_frame(
+            conn,
+            wire.encode_stream_end(
+                len(plan["chunks"]),
+                auth_key=self.auth_key,
+                nonce=nonce,
+                direction="down",
+            ),
+        )
+
     def _reply_all(
-        self, replies: dict[int, bytes], conns_map: dict[int, socket.socket]
+        self,
+        replies: dict[int, bytes],
+        conns_map: dict[int, socket.socket],
+        stream_plan: dict | None = None,
+        stream_jobs: dict[int, tuple[bytes, bytes]] | None = None,
     ) -> None:
         """Parallel reply fan-out: send_frame blocks on the client's ACK,
         so a sequential loop would let one dead client stall every healthy
-        one behind it for a full socket timeout."""
+        one behind it for a full socket timeout. ``stream_jobs`` clients
+        get the chunk-streamed shape instead of their ``replies`` blob."""
+        stream_jobs = stream_jobs or {}
 
         def _reply(cid: int, conn: socket.socket) -> None:
             try:
-                framing.send_frame(conn, replies[cid])
+                if cid in stream_jobs:
+                    header, nonce = stream_jobs[cid]
+                    self._send_stream_reply(conn, header, stream_plan, nonce)
+                else:
+                    framing.send_frame(conn, replies[cid])
             except (OSError, wire.WireError, ConnectionError) as e:
                 log.info(f"[SERVER] reply to client {cid} failed: {e}")
             finally:
@@ -2538,7 +2770,7 @@ class AggregationServer:
             threading.Thread(
                 target=_reply, args=(cid, conns_map[cid]), daemon=True
             )
-            for cid in replies
+            for cid in {*replies, *stream_jobs}
         ]
         for t in reply_threads:
             t.start()

@@ -51,6 +51,18 @@ HMAC tag bound to the connection nonce and chunk sequence number, so the
 receiver can fold a chunk into its running aggregate the moment it
 arrives without trusting unauthenticated bytes.
 
+**Streamed replies** (PR 7): the same three frames carry the aggregate
+BACK to the client. A capable client advertises with
+``meta[STREAM_REPLY_META_KEY] = 1`` in its upload meta (plain meta — an
+old server ignores it and keeps sending one dense reply frame); the
+server then ships that client's reply as STRH + STRC... + STRT instead
+of one model-sized frame, and the client decodes (and can place) each
+leaf as its bytes land. Every stream frame takes a ``direction``:
+``"up"`` (upload) and ``"down"`` (reply) use DISJOINT HMAC domains, so
+an on-path attacker cannot reflect a client's own authenticated upload
+chunks back at it as the "aggregate" — the upload-domain tags verify
+under no reply-domain check.
+
 ``compression="topk"`` / ``"topk:<frac>"`` keeps only the largest-magnitude
 ``frac`` of each fp32 tensor's entries (default 1%): per-tensor payload is
 ``u32 k | int32 indices[k] | fp32 values[k]`` — 8 bytes per kept entry, so
@@ -147,6 +159,10 @@ STREAM_MAGIC = b"STRH"
 STREAM_CHUNK_MAGIC = b"STRC"
 STREAM_END_MAGIC = b"STRT"
 STREAM_META_KEY = "stream"
+#: Upload-meta advert for chunk-streamed REPLIES (module docstring
+#: "Streamed replies"): a truthy value means this client decodes
+#: STRH/STRC/STRT reply frames; old servers ignore it (plain meta).
+STREAM_REPLY_META_KEY = "stream_reply"
 DEFAULT_STREAM_CHUNK = 4 << 20  # 4 MiB: bounds receiver buffering
 #: Worst-case STRC frame bytes beyond the chunk data itself (magic + u64
 #: seq + auth tag). A configured/advertised chunk size must leave this
@@ -165,6 +181,26 @@ def stream_chunk_bytes_from_mb(mb) -> int:
 _STREAM_HDR_DOMAIN = b"fedtpu-stream-hdr-v1"
 _STREAM_CHK_DOMAIN = b"fedtpu-stream-chk-v1"
 _STREAM_END_DOMAIN = b"fedtpu-stream-end-v1"
+#: Direction-bound HMAC domains for the stream frames: "up" = client
+#: upload, "down" = server reply. Disjoint domains close the reflection
+#: hole a shared domain would open — a client's own authenticated upload
+#: chunks replayed back at it would otherwise carry valid tags for the
+#: same (nonce, seq) and decode as the "aggregate".
+_STREAM_DOMAINS = {
+    "up": (_STREAM_HDR_DOMAIN, _STREAM_CHK_DOMAIN, _STREAM_END_DOMAIN),
+    "down": (
+        b"fedtpu-stream-rhdr-v1",
+        b"fedtpu-stream-rchk-v1",
+        b"fedtpu-stream-rend-v1",
+    ),
+}
+
+
+def _stream_domains(direction: str) -> tuple[bytes, bytes, bytes]:
+    try:
+        return _STREAM_DOMAINS[direction]
+    except KeyError:
+        raise WireError(f"unknown stream direction {direction!r}") from None
 #: Leaf encodings a stream may carry: the fixed-size ones whose encoded
 #: byte count is computable from (dtype, shape) alone, so the header can
 #: be built before any leaf is gathered off-device.
@@ -700,11 +736,14 @@ def encode_stream_header(
     chunk_bytes: int,
     payload_nbytes: int,
     auth_key: bytes | None = None,
+    direction: str = "up",
 ) -> bytes:
     """Build the STRH frame payload. In auth mode the tag covers the full
-    prefix (magic + version + header JSON); replay protection comes from
-    the connection nonce the meta already carries (same contract as the
-    single-frame upload's freshness check)."""
+    prefix (magic + version + header JSON) under the direction's own
+    domain; replay protection comes from the connection nonce the meta
+    already carries (same contract as the single-frame upload's
+    freshness check)."""
+    hdr_domain, _, _ = _stream_domains(direction)
     header = {
         "tensors": tensors,
         "payload_nbytes": int(payload_nbytes),
@@ -716,12 +755,16 @@ def encode_stream_header(
     hbytes = json.dumps(header, separators=(",", ":")).encode()
     msg = STREAM_MAGIC + struct.pack("<II", VERSION, len(hbytes)) + hbytes
     if auth_key is not None:
-        msg += _stream_tag(_STREAM_HDR_DOMAIN, auth_key, b"", msg)
+        msg += _stream_tag(hdr_domain, auth_key, b"", msg)
     return msg
 
 
 def decode_stream_header(
-    data, *, auth_key: bytes | None = None, max_payload: int = 8 << 30
+    data,
+    *,
+    auth_key: bytes | None = None,
+    max_payload: int = 8 << 30,
+    direction: str = "up",
 ) -> tuple[list[dict], dict, int, int]:
     """STRH frame -> (tensor table, meta, chunk_bytes, payload_nbytes).
 
@@ -730,6 +773,7 @@ def decode_stream_header(
     path's extra invariant: tensor extents must be contiguous (offset 0,
     each abutting the previous, total == payload_nbytes), which is what
     lets the receiver decode leaves in one sequential pass."""
+    hdr_domain, _, _ = _stream_domains(direction)
     view = memoryview(data)
     if len(view) < 12 or bytes(view[:4]) != STREAM_MAGIC:
         raise WireError("bad magic: not a stream header")
@@ -754,7 +798,7 @@ def decode_stream_header(
         if len(view) != body_end + AUTH_TAG_LEN:
             raise WireError("stream header missing its auth tag")
         want = _stream_tag(
-            _STREAM_HDR_DOMAIN, auth_key, b"", bytes(view[:body_end])
+            hdr_domain, auth_key, b"", bytes(view[:body_end])
         )
         if not hmac_mod.compare_digest(bytes(view[body_end:]), want):
             raise WireError("stream header HMAC verification failed")
@@ -799,11 +843,17 @@ def decode_stream_header(
 
 
 def encode_stream_chunk(
-    seq: int, data: bytes, *, auth_key: bytes | None = None, nonce: bytes = b""
+    seq: int,
+    data: bytes,
+    *,
+    auth_key: bytes | None = None,
+    nonce: bytes = b"",
+    direction: str = "up",
 ) -> bytes:
+    _, chk_domain, _ = _stream_domains(direction)
     body = STREAM_CHUNK_MAGIC + struct.pack("<Q", seq) + data
     if auth_key is not None:
-        body += _stream_tag(_STREAM_CHK_DOMAIN, auth_key, nonce, body)
+        body += _stream_tag(chk_domain, auth_key, nonce, body)
     return body
 
 
@@ -813,11 +863,13 @@ def decode_stream_chunk(
     expect_seq: int,
     auth_key: bytes | None = None,
     nonce: bytes = b"",
+    direction: str = "up",
 ):
     """STRC frame -> chunk bytes (memoryview). Verifying the per-chunk
     tag BEFORE returning is what lets the server fold the chunk into its
     running aggregate immediately: every folded byte was authenticated,
     so a key-less attacker can't poison a round mid-stream."""
+    _, chk_domain, _ = _stream_domains(direction)
     view = memoryview(frame)
     n_magic = len(STREAM_CHUNK_MAGIC)
     tag_len = AUTH_TAG_LEN if auth_key is not None else 0
@@ -829,7 +881,7 @@ def decode_stream_chunk(
     body_end = len(view) - tag_len
     if auth_key is not None:
         want = _stream_tag(
-            _STREAM_CHK_DOMAIN, auth_key, nonce, bytes(view[:body_end])
+            chk_domain, auth_key, nonce, bytes(view[:body_end])
         )
         if not hmac_mod.compare_digest(bytes(view[body_end:]), want):
             raise WireError(f"stream chunk {seq} HMAC verification failed")
@@ -837,17 +889,28 @@ def decode_stream_chunk(
 
 
 def encode_stream_end(
-    n_chunks: int, *, auth_key: bytes | None = None, nonce: bytes = b""
+    n_chunks: int,
+    *,
+    auth_key: bytes | None = None,
+    nonce: bytes = b"",
+    direction: str = "up",
 ) -> bytes:
+    _, _, end_domain = _stream_domains(direction)
     body = STREAM_END_MAGIC + struct.pack("<Q", n_chunks)
     if auth_key is not None:
-        body += _stream_tag(_STREAM_END_DOMAIN, auth_key, nonce, body)
+        body += _stream_tag(end_domain, auth_key, nonce, body)
     return body
 
 
 def decode_stream_end(
-    frame, *, expect_chunks: int, auth_key: bytes | None = None, nonce: bytes = b""
+    frame,
+    *,
+    expect_chunks: int,
+    auth_key: bytes | None = None,
+    nonce: bytes = b"",
+    direction: str = "up",
 ) -> None:
+    _, _, end_domain = _stream_domains(direction)
     view = memoryview(frame)
     n_magic = len(STREAM_END_MAGIC)
     tag_len = AUTH_TAG_LEN if auth_key is not None else 0
@@ -861,7 +924,7 @@ def decode_stream_end(
     if auth_key is not None:
         body_end = len(view) - tag_len
         want = _stream_tag(
-            _STREAM_END_DOMAIN, auth_key, nonce, bytes(view[:body_end])
+            end_domain, auth_key, nonce, bytes(view[:body_end])
         )
         if not hmac_mod.compare_digest(bytes(view[body_end:]), want):
             raise WireError("stream trailer HMAC verification failed")

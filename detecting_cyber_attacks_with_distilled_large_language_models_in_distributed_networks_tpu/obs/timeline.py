@@ -225,7 +225,13 @@ def timeline_table(
             s
             for s in groups[key]
             if s["span"]
-            in ("eval-gate", "promote", "serve-batch", "batch-prefetch")
+            in (
+                "eval-gate",
+                "promote",
+                "serve-batch",
+                "batch-prefetch",
+                "relay-forward",
+            )
         ]
         for s in extra:
             out.append(

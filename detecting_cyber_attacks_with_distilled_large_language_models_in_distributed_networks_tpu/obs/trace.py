@@ -32,6 +32,10 @@ Span vocabulary (names are the contract the timeline tool groups by)::
     wire-reply    the reply transfer (server: fan-out; client: recv)
     batch-prefetch  a client's next-round input-pipeline work that ran
                   under the reply wait (train/batches.EpochPrefetcher)
+    relay-forward a relay's upward exchange window (comm/relay.py): the
+                  subtree partial going up + the root aggregate coming
+                  back, with ``parent_trace``/``parent_round`` linking
+                  this subtree round to the parent tier's round
     eval-gate     the controller's held-out eval + gate decision
     promote       a registry state transition / pointer swap
     serve-batch   one coalesced scoring dispatch on the serving tier
@@ -65,6 +69,7 @@ SPAN_NAMES = (
     "agg",
     "wire-reply",
     "batch-prefetch",
+    "relay-forward",
     "eval-gate",
     "promote",
     "serve-batch",

@@ -113,6 +113,18 @@ class MeshTrainer(Trainer):
             jax.device_put(params, self.replicated), split, **kw
         )
 
+    def reply_leaf_sink(self, key: str, arr: np.ndarray) -> Any:
+        """Streamed-reply leaf placement (comm/client.py
+        ``reply_leaf_sink``): scatter one decoded aggregate leaf onto the
+        local mesh (replicated) the moment its chunk bytes land, so the
+        host->device transfer of leaf k overlaps the wire transfer of
+        leaf k+1 and ``adopt_aggregate`` starts from device-backed
+        buffers instead of a full host-side tree. ``init_state``'s
+        later device_put of an already-placed leaf is a no-op, and the
+        values are bit-identical to the host-tree path (placement only,
+        no arithmetic)."""
+        return jax.device_put(arr, self.replicated)
+
 
 class FedSeqClientTrainer:
     """C=1 FedSeqTrainer behind the TCP client's single-client surface.

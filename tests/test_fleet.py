@@ -1,0 +1,568 @@
+"""Fleet-scale rounds (ISSUE 7): streamed reply fan-out + the
+hierarchical fold tree (comm/relay.py) for 64-256-client cohorts.
+
+Contracts pinned here:
+
+* Streamed replies are BYTE-IDENTICAL in value to dense replies —
+  mixed fleets (advertising and old-peer clients) in one round receive
+  the same aggregate, crc-equal to the barrier ``aggregate_flat``.
+* The depth-2 fold tree's root aggregate is crc-bit-exact against
+  :func:`aggregate_tree` — the pinned order (ascending client id within
+  a subtree, fixed subtree order at the root) replayed flat from the
+  captured uploads — and every individual fold in the tree is bit-exact
+  against ``aggregate_flat`` over its own inputs.
+* A LIVE 64-client loopback round at tree depth 2 completes under the
+  bounded handler pool and keeps both contracts.
+* Fold order is deterministic at scale: shuffled arrival orders through
+  StreamAgg (flat and depth-2) produce ONE crc.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+    AggregationServer,
+    FederatedClient,
+    RelayAggregator,
+    StreamAgg,
+    WireError,
+    aggregate_flat,
+    aggregate_tree,
+    wire,
+)
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnraisableExceptionWarning"
+)
+
+
+def _leaves(rng, n=4, shape=(32, 9), scale=1.0):
+    """Flat separator-free keys: exchange() returns these unchanged."""
+    return {
+        f"w{i:02d}": rng.normal(size=shape).astype(np.float32) * scale
+        for i in range(n)
+    }
+
+
+def _run_clients(clients, uploads, n_samples=None, results=None, errors=None):
+    """Drive one exchange per client on its own thread; collect replies."""
+    results = {} if results is None else results
+    errors = [] if errors is None else errors
+
+    def go(cid):
+        try:
+            kw = {}
+            if n_samples is not None:
+                kw["n_samples"] = n_samples[cid]
+            results[cid] = clients[cid].exchange(uploads[cid], **kw)
+        except Exception as e:  # noqa: BLE001 - surfaced via the list
+            errors.append((cid, e))
+
+    threads = [
+        threading.Thread(target=go, args=(cid,), daemon=True)
+        for cid in clients
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    return results, errors
+
+
+# ------------------------------------------------------ streamed replies
+def test_streamed_reply_mixed_fleet_bit_exact(rng):
+    """One round, one advertising client + one old-peer (dense) client:
+    both receive the SAME aggregate, crc-equal to the barrier mean, and
+    exactly one reply went out as a chunk stream."""
+    models = [_leaves(rng) for _ in range(2)]
+    with AggregationServer(
+        port=0, num_clients=2, timeout=30, stream_chunk_bytes=1 << 10
+    ) as server:
+        clients = {
+            0: FederatedClient(
+                "127.0.0.1", server.port, client_id=0, timeout=30
+            ),
+            # stream=False = the pre-PR peer: no reply advert, no
+            # streamed upload — the dense wire shape end to end.
+            1: FederatedClient(
+                "127.0.0.1", server.port, client_id=1, timeout=30,
+                stream=False,
+            ),
+        }
+        agg_thread_out = {}
+        t = threading.Thread(
+            target=lambda: agg_thread_out.setdefault(
+                "agg", server.serve_round()
+            ),
+            daemon=True,
+        )
+        t.start()
+        results, errors = _run_clients(clients, models)
+        t.join(timeout=60)
+        assert not errors, errors
+        want = aggregate_flat(models)
+        for cid in (0, 1):
+            got = results[cid]
+            assert wire.flat_crc32(got) == wire.flat_crc32(want)
+            for k in want:
+                np.testing.assert_array_equal(got[k], want[k])
+        # Exactly the advertising client's reply streamed; the old peer's
+        # dense upload counted as a fallback while streaming was on.
+        assert server.stream_totals["stream_replies"] == 1
+        assert server.stream_totals["stream_fallbacks"] >= 1
+
+
+def test_streamed_reply_auth_round(rng):
+    """HMAC round: the reply's header/chunk/trailer tags ride the
+    REPLY-direction domains and the aggregate still decodes bit-exact."""
+    key = b"fleet-secret"
+    models = [_leaves(rng) for _ in range(2)]
+    with AggregationServer(
+        port=0, num_clients=2, timeout=30, auth_key=key,
+        stream_chunk_bytes=1 << 10,
+    ) as server:
+        clients = {
+            cid: FederatedClient(
+                "127.0.0.1", server.port, client_id=cid, timeout=30,
+                auth_key=key,
+            )
+            for cid in range(2)
+        }
+        t = threading.Thread(target=server.serve_round, daemon=True)
+        t.start()
+        results, errors = _run_clients(clients, models)
+        t.join(timeout=60)
+        assert not errors, errors
+        want = aggregate_flat(models)
+        for cid in range(2):
+            assert wire.flat_crc32(results[cid]) == wire.flat_crc32(want)
+        assert server.stream_totals["stream_replies"] == 2
+
+
+def test_reply_direction_domains_reject_reflection():
+    """An upload-domain chunk tag never verifies under the reply-domain
+    check (and vice versa) — the reflection hole disjoint domains close."""
+    key, nonce = b"secret", b"\x07" * 16
+    up = wire.encode_stream_chunk(0, b"data", auth_key=key, nonce=nonce)
+    with pytest.raises(WireError, match="HMAC"):
+        wire.decode_stream_chunk(
+            up, expect_seq=0, auth_key=key, nonce=nonce, direction="down"
+        )
+    down = wire.encode_stream_chunk(
+        0, b"data", auth_key=key, nonce=nonce, direction="down"
+    )
+    with pytest.raises(WireError, match="HMAC"):
+        wire.decode_stream_chunk(
+            down, expect_seq=0, auth_key=key, nonce=nonce
+        )
+    hdr = wire.encode_stream_header(
+        [], chunk_bytes=64, payload_nbytes=0, auth_key=key
+    )
+    with pytest.raises(WireError, match="HMAC"):
+        wire.decode_stream_header(hdr, auth_key=key, direction="down")
+    end = wire.encode_stream_end(3, auth_key=key, nonce=nonce)
+    with pytest.raises(WireError, match="HMAC"):
+        wire.decode_stream_end(
+            end, expect_chunks=3, auth_key=key, nonce=nonce,
+            direction="down",
+        )
+
+
+def test_reply_leaf_sink_sees_every_leaf(rng):
+    """The streamed-reply sink runs per leaf as bytes land; its returned
+    objects ARE the aggregate the caller receives (the mesh tier returns
+    device-placed leaves here)."""
+
+    class Tagged:
+        def __init__(self, arr):
+            self.arr = arr
+
+    models = [_leaves(rng) for _ in range(2)]
+    seen: list[str] = []
+    with AggregationServer(
+        port=0, num_clients=2, timeout=30, stream_chunk_bytes=1 << 10
+    ) as server:
+        clients = {
+            cid: FederatedClient(
+                "127.0.0.1", server.port, client_id=cid, timeout=30
+            )
+            for cid in range(2)
+        }
+
+        def sink(key, arr):
+            seen.append(key)
+            return Tagged(arr)
+
+        clients[0].reply_leaf_sink = sink
+        t = threading.Thread(target=server.serve_round, daemon=True)
+        t.start()
+        results, errors = _run_clients(clients, models)
+        t.join(timeout=60)
+        assert not errors, errors
+        want = aggregate_flat(models)
+        assert sorted(seen) == sorted(want)
+        for k in want:
+            assert isinstance(results[0][k], Tagged)
+            np.testing.assert_array_equal(results[0][k].arr, want[k])
+            np.testing.assert_array_equal(results[1][k], want[k])
+
+
+def test_mesh_trainer_sink_places_on_device(rng):
+    """MeshTrainer.reply_leaf_sink returns a replicated device leaf with
+    unchanged bytes — placement only, no arithmetic."""
+    import jax
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        ModelConfig,
+        TrainConfig,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.parallel.mesh import (
+        make_host_mesh,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.client_mesh import (
+        MeshTrainer,
+    )
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    trainer = MeshTrainer(
+        ModelConfig.tiny(), TrainConfig(), mesh=make_host_mesh(2)
+    )
+    arr = rng.normal(size=(8, 4)).astype(np.float32)
+    placed = trainer.reply_leaf_sink("w", arr)
+    assert isinstance(placed, jax.Array)
+    assert placed.sharding == trainer.replicated
+    np.testing.assert_array_equal(np.asarray(placed), arr)
+
+
+# -------------------------------------------------- hierarchical fold tree
+def _run_tree(rng, n_clients, n_relays, *, n_samples=None, leaf_shape=(16, 5),
+              trace_dir=None, rounds=1, chunk=1 << 10):
+    """Stand up root + relays + clients on loopback, run ``rounds``
+    rounds, return (models, results, groups, root_aggs)."""
+    per = n_clients // n_relays
+    groups = [list(range(r * per, (r + 1) * per)) for r in range(n_relays)]
+    models = [_leaves(rng, n=3, shape=leaf_shape) for _ in range(n_clients)]
+    tracer = None
+    if trace_dir is not None:
+        from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs import (
+            Tracer,
+        )
+
+        tracer = Tracer(f"{trace_dir}/relay.jsonl", proc="relay-0")
+    root_aggs: list[dict] = []
+    with AggregationServer(
+        port=0, num_clients=n_relays, weighted=True, timeout=60,
+        stream_chunk_bytes=chunk,
+    ) as root:
+        relays = [
+            RelayAggregator(
+                "127.0.0.1", 0,
+                parent_host="127.0.0.1", parent_port=root.port,
+                relay_id=r, num_clients=per, timeout=60,
+                stream_chunk_bytes=chunk,
+                tracer=tracer if r == 0 else None,
+            )
+            for r in range(n_relays)
+        ]
+        try:
+            def root_loop():
+                for _ in range(rounds):
+                    root_aggs.append(root.serve_round())
+
+            rt = threading.Thread(target=root_loop, daemon=True)
+            rt.start()
+            relay_threads = [
+                threading.Thread(
+                    target=rel.serve, args=(rounds,), daemon=True
+                )
+                for rel in relays
+            ]
+            for t in relay_threads:
+                t.start()
+            clients = {
+                cid: FederatedClient(
+                    "127.0.0.1",
+                    relays[cid // per].port,
+                    client_id=cid,
+                    timeout=60,
+                )
+                for cid in range(n_clients)
+            }
+            all_results: dict[int, dict] = {}
+            errors: list = []
+            for _ in range(rounds):
+                results, errs = _run_clients(
+                    clients, models, n_samples=n_samples
+                )
+                errors.extend(errs)
+                all_results = results
+            rt.join(timeout=90)
+            for t in relay_threads:
+                t.join(timeout=30)
+            assert not errors, errors
+            peak = max(
+                rel.server.stream_totals["peak_agg_bytes"] for rel in relays
+            )
+            return models, all_results, groups, root_aggs, peak
+        finally:
+            for rel in relays:
+                rel.close()
+
+
+def test_relay_depth2_bit_exact_vs_tree_replay(rng, tmp_path):
+    """Live depth-2 round (2 relays x 2 clients): every client receives
+    the root aggregate, crc-bit-exact vs aggregate_tree's pinned replay;
+    each subtree fold and the root fold are each bit-exact vs
+    aggregate_flat over their own inputs; the flat all-N mean agrees to
+    reduction-order ulps. The relay-forward span lands on the obs
+    timeline vocabulary."""
+    n_samples = {0: 5, 1: 1, 2: 3, 3: 2}
+    models, results, groups, root_aggs, _peak = _run_tree(
+        rng, 4, 2, n_samples=n_samples, trace_dir=str(tmp_path)
+    )
+    weights = [float(n_samples[i]) for i in range(4)]
+    want = aggregate_tree(models, weights, groups)
+    assert len(root_aggs) == 1 and root_aggs[0] is not None
+    assert wire.flat_crc32(root_aggs[0]) == wire.flat_crc32(want)
+    for cid in range(4):
+        assert wire.flat_crc32(results[cid]) == wire.flat_crc32(want)
+    # Each tree fold individually == the barrier mean over its inputs.
+    partial0 = aggregate_flat([models[0], models[1]], weights[:2])
+    partial1 = aggregate_flat([models[2], models[3]], weights[2:])
+    root_ref = aggregate_flat(
+        [partial0, partial1], [sum(weights[:2]), sum(weights[2:])]
+    )
+    assert wire.flat_crc32(root_ref) == wire.flat_crc32(want)
+    # The flat all-N mean differs by fp32 reduction-order ulps at most.
+    flat_ref = aggregate_flat(models, weights)
+    for k in want:
+        np.testing.assert_allclose(
+            want[k], flat_ref[k], rtol=1e-5, atol=1e-6
+        )
+    # relay-forward span: the tree tier's line on the obs timeline.
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs.timeline import (
+        load_spans,
+    )
+
+    spans = load_spans(trace_dir=str(tmp_path))
+    fwd = [s for s in spans if s["span"] == "relay-forward"]
+    assert fwd and fwd[0]["subtree_clients"] == 2
+    assert fwd[0]["parent_round"] is not None
+
+
+def test_relay_sparse_delta_base_tracks_root(rng):
+    """A topk client behind a relay: round 2's sparse delta validates
+    against the ROOT aggregate the client adopted (the relay's _last_agg
+    is the forwarded result, not the subtree partial)."""
+    n_clients, n_relays, rounds = 4, 2, 2
+    per = n_clients // n_relays
+    models = [_leaves(rng, n=3, shape=(16, 5)) for _ in range(n_clients)]
+    with AggregationServer(
+        port=0, num_clients=n_relays, weighted=True, timeout=60,
+        stream_chunk_bytes=1 << 10,
+    ) as root:
+        relays = [
+            RelayAggregator(
+                "127.0.0.1", 0, parent_host="127.0.0.1",
+                parent_port=root.port, relay_id=r, num_clients=per,
+                timeout=60, stream_chunk_bytes=1 << 10,
+            )
+            for r in range(n_relays)
+        ]
+        try:
+            rt = threading.Thread(
+                target=lambda: [root.serve_round() for _ in range(rounds)],
+                daemon=True,
+            )
+            rt.start()
+            for rel in relays:
+                threading.Thread(
+                    target=rel.serve, args=(rounds,), daemon=True
+                ).start()
+            clients = {
+                cid: FederatedClient(
+                    "127.0.0.1", relays[cid // per].port, client_id=cid,
+                    timeout=60,
+                    compression="topk:0.5" if cid == 0 else "none",
+                )
+                for cid in range(n_clients)
+            }
+            last = {}
+            for _ in range(rounds):
+                uploads = {
+                    cid: {
+                        k: v + np.float32(0.01)
+                        for k, v in (last.get(cid) or models[cid]).items()
+                    }
+                    for cid in clients
+                }
+                last, errors = _run_clients(clients, uploads)
+                assert not errors, errors
+            # Round 2 went sparse against the adopted ROOT base — the
+            # client only adopts a base whose crc matches the relay's
+            # agg_crc stamp, so reaching here proves base agreement.
+            assert clients[0]._base is not None
+            rt.join(timeout=60)
+        finally:
+            for rel in relays:
+                rel.close()
+
+
+def test_fleet_64_clients_depth2_live(rng):
+    """The acceptance-shaped round: 64 live loopback clients, 8 relays
+    of 8, one root — completes under the bounded handler pool with the
+    root aggregate crc-bit-exact vs the pinned tree replay."""
+    models, results, groups, root_aggs, peak = _run_tree(
+        rng, 64, 8, leaf_shape=(64,), chunk=256
+    )
+    want = aggregate_tree(models, None, groups)
+    assert wire.flat_crc32(root_aggs[0]) == wire.flat_crc32(want)
+    crcs = {wire.flat_crc32(results[cid]) for cid in range(64)}
+    assert crcs == {wire.flat_crc32(want)}
+    assert peak > 0
+
+
+# -------------------------------------------- fold-order determinism @ 64
+def test_fold_order_determinism_64_contributors(rng):
+    """Property test: 64 seeded contributors folded through StreamAgg in
+    shuffled arrival orders — flat and depth-2 — always produce ONE crc
+    (the pinned ascending-id / fixed-subtree-order arithmetic is arrival-
+    order invariant)."""
+    n, n_groups = 64, 8
+    keys = tuple(sorted(f"k{i}" for i in range(3)))
+    models = [
+        {k: rng.normal(size=(8, 3)).astype(np.float32) for k in keys}
+        for _ in range(n)
+    ]
+    weights = [float(w) for w in rng.integers(1, 9, size=n)]
+    groups = [
+        list(range(g * (n // n_groups), (g + 1) * (n // n_groups)))
+        for g in range(n_groups)
+    ]
+
+    def flat_crc(order):
+        st = StreamAgg()
+        for cid in order:
+            st.register(cid, keys=keys, n_samples=weights[cid])
+        st.freeze(list(range(n)), weights)
+        for cid in order:
+            st.add_dense(cid, models[cid])
+        return wire.flat_crc32(st.finalize(list(range(n)), weights))
+
+    def tree_crc(order):
+        partials, masses = [], []
+        for g in groups:
+            st = StreamAgg()
+            ws = [weights[i] for i in g]
+            for cid in [c for c in order if c in g]:
+                st.register(cid, keys=keys, n_samples=weights[cid])
+            st.freeze(list(g), ws)
+            for cid in [c for c in order if c in g]:
+                st.add_dense(cid, models[cid])
+            partials.append(st.finalize(list(g), ws))
+            masses.append(sum(ws))
+        root = StreamAgg()
+        for r in range(n_groups):
+            root.register(r, keys=keys, n_samples=masses[r])
+        root.freeze(list(range(n_groups)), masses)
+        for r in range(n_groups):
+            root.add_dense(r, partials[r])
+        return wire.flat_crc32(
+            root.finalize(list(range(n_groups)), masses)
+        )
+
+    orders = [list(range(n))]
+    for _ in range(3):
+        o = list(range(n))
+        rng.shuffle(o)
+        orders.append(o)
+    flat_crcs = {flat_crc(o) for o in orders}
+    assert flat_crcs == {wire.flat_crc32(aggregate_flat(models, weights))}
+    tree_crcs = {tree_crc(o) for o in orders}
+    assert tree_crcs == {
+        wire.flat_crc32(aggregate_tree(models, weights, groups))
+    }
+
+
+def test_aggregate_tree_validates_groups(rng):
+    with pytest.raises(ValueError, match="non-empty"):
+        aggregate_tree([_leaves(rng)], None, [])
+    with pytest.raises(ValueError, match="non-empty"):
+        aggregate_tree([_leaves(rng)], None, [[0], []])
+
+
+# ---------------------------------------------------- server fleet plumbing
+def test_bounded_pool_and_backlog_sizing():
+    with AggregationServer(port=0, num_clients=256, timeout=5) as server:
+        # Bounded handler pool: 2x the fleet + slack, never unbounded.
+        assert server._pool._max_workers == 2 * 256 + 8
+    with AggregationServer(port=0, num_clients=2, timeout=5) as server:
+        assert server._pool._max_workers == 12
+
+
+def test_reply_via_refused_under_dp_and_secure():
+    with AggregationServer(
+        port=0, num_clients=2, timeout=1, dp_clip=1.0
+    ) as server:
+        server.reply_via = lambda agg, info: agg
+        with pytest.raises(ValueError, match="reply_via"):
+            server.serve_round(deadline=0.2)
+    with AggregationServer(
+        port=0, num_clients=2, timeout=1, secure_agg=True
+    ) as server:
+        server.reply_via = lambda agg, info: agg
+        with pytest.raises(ValueError, match="reply_via"):
+            server.serve_round(deadline=0.2)
+
+
+def test_dense_fallback_reason_logged_once(rng):
+    """A client that cannot stream logs its one-line reason exactly once
+    per reason (old peers would otherwise say it every round)."""
+    models = [_leaves(rng) for _ in range(1)]
+    with AggregationServer(
+        port=0, num_clients=1, timeout=30, stream_chunk_bytes=1 << 10
+    ) as server:
+        fc = FederatedClient(
+            "127.0.0.1", server.port, client_id=0, timeout=30, stream=False
+        )
+        for _ in range(2):
+            t = threading.Thread(target=server.serve_round, daemon=True)
+            t.start()
+            fc.exchange(models[0])
+            t.join(timeout=30)
+        assert fc._fallback_logged == {"--no-stream-upload"}
+        assert server.stream_totals["stream_fallbacks"] == 2
+        assert server.stream_totals["stream_replies"] == 0
+
+
+def test_relay_cli_parser_wiring():
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli.parser import (
+        build_parser,
+    )
+
+    args = build_parser().parse_args(
+        [
+            "relay", "--port", "0", "--parent-port", "12345",
+            "--relay-id", "3", "--num-clients", "8", "--rounds", "2",
+            "--stream-chunk-mb", "1",
+        ]
+    )
+    assert args.relay_id == 3 and args.num_clients == 8
+    assert args.fn.__name__ == "cmd_relay"
+    assert args.stream_upload is True
+
+
+@pytest.mark.slow
+def test_fleet_128_clients_depth2_live(rng):
+    """Scale margin beyond the acceptance floor: 128 clients, 16 relays."""
+    models, results, groups, root_aggs, _peak = _run_tree(
+        rng, 128, 16, leaf_shape=(32,), chunk=128
+    )
+    want = aggregate_tree(models, None, groups)
+    assert wire.flat_crc32(root_aggs[0]) == wire.flat_crc32(want)
+    assert {wire.flat_crc32(results[c]) for c in range(128)} == {
+        wire.flat_crc32(want)
+    }
