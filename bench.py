@@ -1473,8 +1473,45 @@ def _preflight() -> None:
 MODES = (
     "train", "bert", "bertlarge", "eval", "fedavg", "flash", "ring",
     "fed2", "fedseq", "serve", "clientdp", "controller", "scenario",
-    "fleet",
+    "fleet", "check",
 )
+
+
+def bench_check() -> dict:
+    """The static-analysis gate (ISSUE 8): `fedtpu check` over this
+    tree with the reviewed baseline. Headline fields:
+    ``check_findings_new`` — non-baselined findings, asserted 0 (exit 3:
+    an invariant regression must fail the bench exactly like a broken
+    crc contract, not scroll past) — and ``check_runtime_s`` — the full
+    four-pass scan wall, the cost of running the gate in CI."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.analysis import (
+        run_check,
+    )
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    try:
+        result = run_check(root)
+    except Exception as e:
+        record = {
+            "metric": "bench_error",
+            "error": "check_failed",
+            "detail": f"{type(e).__name__}: {str(e)[:300]}",
+        }
+        _emit(record)
+        return record
+    record = {
+        "metric": "check",
+        "value": len(result.new),
+        "unit": "new_findings",
+        "check_findings_new": len(result.new),
+        "check_runtime_s": round(result.runtime_s, 3),
+        "check_findings_baselined": len(result.baselined),
+        "check_findings_allowed": result.allowed,
+        "check_modules_scanned": result.modules_scanned,
+        "check_new": [f.render() for f in result.new[:20]],
+    }
+    _emit(record)
+    return record
 
 #: Federated product-step MFU floor (fed2/fedseq): the driver-captured
 #: records sit at 0.585/0.56 (BENCH_r05); a regression below 0.50 exits
@@ -1497,6 +1534,14 @@ def main() -> None:
     mode = os.environ.get("BENCH_MODE", "train")
     if mode not in MODES:  # validate before paying for the tunnel handshake
         raise SystemExit(f"unknown BENCH_MODE {mode!r} ({'|'.join(MODES)})")
+    if mode == "check":
+        # Pure-AST scan: no accelerator, no preflight, no watchdog.
+        rec = bench_check()
+        if rec.get("metric") == "bench_error" or rec.get(
+            "check_findings_new", 1
+        ):
+            raise SystemExit(3)
+        return
     if mode == "clientdp" and os.environ.get("BENCH_CLIENTDP_FORCE_CPU"):
         # The virtual-device fallback subprocess (bench_client_dp): force
         # the CPU platform before backend init — this environment's
@@ -1531,7 +1576,7 @@ def main() -> None:
             # federated MFUs as machine-parsed fields. BENCH_SECONDARY=0
             # restores the single-line behavior.
             rec_fed2 = rec_fedseq = rec_ctrl = rec_resid = rec_scn = None
-            rec_fleet = None
+            rec_fleet = rec_check = None
             if os.environ.get("BENCH_SECONDARY", "1").lower() not in (
                 "", "0", "false",
             ):
@@ -1546,6 +1591,7 @@ def main() -> None:
                 rec_ctrl = bench_controller()
                 rec_scn = bench_scenario()
                 rec_fleet = bench_fleet()
+                rec_check = bench_check()
             extra = {}
             for key, rec in (("fed2", rec_fed2), ("fedseq", rec_fedseq)):
                 if rec is not None and rec.get("mfu") is not None:
@@ -1657,13 +1703,39 @@ def main() -> None:
                 ):
                     extra[k] = rec_fleet[k]
                 fleet_broken = rec_fleet["fleet_crc_exact"] < 1.0
+            check_broken = False
+            if rec_check is not None and (
+                rec_check.get("metric") != "bench_error"
+            ):
+                # Static-analysis headline fields (ISSUE 8): ASSERTED
+                # present, and check_findings_new asserted 0 (exit 3) —
+                # an invariant regression fails the driver bench exactly
+                # like a crc mismatch or a broken MFU floor would.
+                missing = [
+                    k
+                    for k in ("check_findings_new", "check_runtime_s")
+                    if k not in rec_check
+                ]
+                if missing:
+                    _emit(
+                        {
+                            "metric": "bench_error",
+                            "error": "check_fields_missing",
+                            "detail": f"check record lacks {missing} "
+                            "(analysis.run_check result shape broken?)",
+                        }
+                    )
+                    raise SystemExit(3)
+                extra["check_findings_new"] = rec_check["check_findings_new"]
+                extra["check_runtime_s"] = rec_check["check_runtime_s"]
+                check_broken = rec_check["check_findings_new"] > 0
             broken = _check_mfu_floor(
                 {"fed2": rec_fed2, "fedseq": rec_fedseq}
             )
             if broken:
                 extra.update(mfu_floor=MFU_FLOOR, mfu_floor_broken=broken)
             bench_train(ModelConfig(), "distilbert", extra=extra or None)
-            if broken or scenario_broken or fleet_broken:
+            if broken or scenario_broken or fleet_broken or check_broken:
                 raise SystemExit(3)
         elif mode == "bert":
             bench_train(ModelConfig.bert_base(), "bertbase")

@@ -52,6 +52,7 @@ import json
 import sys
 from typing import Sequence
 
+from .check import cmd_check
 from .comm import cmd_client, cmd_relay, cmd_serve
 from .common import resolve_config
 from .control import cmd_controller, cmd_registry
@@ -977,6 +978,42 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--out", help="output path (export)")
     p.set_defaults(fn=cmd_obs)
+
+    p = sub.add_parser(
+        "check",
+        help="invariant-aware static analysis: wire-domain, determinism, "
+        "concurrency, and obs-vocabulary passes over the tree",
+        epilog="Findings are suppressed only by a reviewed per-line "
+        "`# fedtpu: allow(<rule>): reason` pragma or an entry (with "
+        "reason) in the repo-root ANALYSIS_BASELINE.json. Exit 0 = "
+        "clean, 1 = non-baselined findings, 2 = usage/internal error.",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable result object instead of the finding list",
+    )
+    p.add_argument(
+        "--baseline",
+        help="baseline JSON path (default: ANALYSIS_BASELINE.json at the "
+        "scanned root, when present)",
+    )
+    p.add_argument(
+        "--root",
+        help="tree to scan (default: this checkout's repo root) — the "
+        "seeded-mutation self-tests point this at a temp copy",
+    )
+    p.add_argument(
+        "--rules",
+        help="comma-separated subset of rule names (default: all; see "
+        "--list-rules)",
+    )
+    p.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the registered rules and exit",
+    )
+    p.set_defaults(fn=cmd_check)
 
     p = sub.add_parser(
         "registry",

@@ -1049,6 +1049,7 @@ class FederatedClient:
             chunk_bytes=chunk_bytes,
             payload_nbytes=payload_nbytes,
             auth_key=self.auth_key,
+            direction="up",
         )
         log.info(
             f"[CLIENT {self.client_id}] streaming "
@@ -1072,7 +1073,11 @@ class FederatedClient:
                     chunk = bytes(buf[:chunk_bytes])
                     del buf[:chunk_bytes]
                     frame = wire.encode_stream_chunk(
-                        seq, chunk, auth_key=self.auth_key, nonce=nonce
+                        seq,
+                        chunk,
+                        auth_key=self.auth_key,
+                        nonce=nonce,
+                        direction="up",
                     )
                     sender.send(frame)
                     sent += len(frame)
@@ -1097,7 +1102,7 @@ class FederatedClient:
             # path's per-frame ACK, paid once per upload instead).
             sender.send(
                 wire.encode_stream_end(
-                    seq, auth_key=self.auth_key, nonce=nonce
+                    seq, auth_key=self.auth_key, nonce=nonce, direction="up"
                 ),
                 await_ack=True,
             )

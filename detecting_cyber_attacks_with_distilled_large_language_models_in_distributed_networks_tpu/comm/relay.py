@@ -157,6 +157,8 @@ class RelayAggregator:
         span — the upward exchange window, the tree tier's line on the
         obs timeline."""
         total = sum(info["n_samples"].values())
+        # fedtpu: allow(determinism): span wall-clock timestamp — feeds the
+        # obs timeline only, never the fold value or order
         t_unix = time.time()
         t0 = time.monotonic()
         out = self.parent.exchange(agg, n_samples=max(1, int(round(total))))

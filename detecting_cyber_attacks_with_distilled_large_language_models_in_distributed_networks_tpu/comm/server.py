@@ -384,6 +384,14 @@ class AggregationServer:
         # phase (overlapped with the wire) vs after it, and the peak
         # aggregation-state footprint — the comm_overlap_frac /
         # server_peak_agg_bytes bench headline fields.
+        # One lock for every stream_totals mutation: upload handlers on
+        # the pool increment fallback/upload counters while serve_round
+        # folds reply/peak stats — per-key dict ops are GIL-atomic, but
+        # the discipline "all writers hold _totals_lock" is what the
+        # static concurrency pass can actually verify, and it makes the
+        # read side (comm_overlap_frac's two-key ratio) consistent
+        # instead of torn-across-keys.
+        self._totals_lock = threading.Lock()
         self.stream_totals = {
             "early_bytes": 0,
             "late_bytes": 0,
@@ -936,7 +944,8 @@ class AggregationServer:
                     # a fallback (old peer, topk, a retry, or round 1
                     # before the client saw the advert). The client logs
                     # its one-line reason; this side just counts.
-                    self.stream_totals["stream_fallbacks"] += 1
+                    with self._totals_lock:
+                        self.stream_totals["stream_fallbacks"] += 1
                     self._m_stream_fallbacks.inc()
                 rnd.conns[client_id] = conn
                 if nonce_hex is not None:
@@ -1121,7 +1130,10 @@ class AggregationServer:
                 "uploads (secure-agg), which are single-frame by design"
             )
         tensors, meta, chunk_bytes, payload_nbytes = wire.decode_stream_header(
-            header, auth_key=self.auth_key, max_payload=framing.MAX_FRAME
+            header,
+            auth_key=self.auth_key,
+            max_payload=framing.MAX_FRAME,
+            direction="up",
         )
         client_id = self._validate_upload_identity(
             meta, nonce_hex=nonce_hex, dpid=dpid
@@ -1264,6 +1276,7 @@ class AggregationServer:
                     expect_seq=seq,
                     auth_key=self.auth_key,
                     nonce=nonce,
+                    direction="up",
                 )
                 if not data:
                     # A well-formed sender never chunks to zero bytes
@@ -1285,6 +1298,7 @@ class AggregationServer:
                 expect_chunks=seq,
                 auth_key=self.auth_key,
                 nonce=nonce,
+                direction="up",
             )
             self._g_inflight_streams.dec()
             in_flight = False
@@ -1377,13 +1391,11 @@ class AggregationServer:
             if nonce_hex is not None:
                 rnd.nonces[client_id] = nonce_hex
             if not discard:
-                # Under rnd.lock: per-client handler threads are the only
-                # concurrent writers of this counter (every other
-                # stream_totals mutation is on the serve_round thread).
                 # Drained duplicates contributed nothing — the counters
                 # (and /metrics' "accepted into a round" totals) only
                 # count uploads that did.
-                self.stream_totals["stream_uploads"] += 1
+                with self._totals_lock:
+                    self.stream_totals["stream_uploads"] += 1
             done = self._round_done(rnd)
         if discard:
             log.info(
@@ -2575,7 +2587,8 @@ class AggregationServer:
                 len(hdr) + stream_plan["payload_nbytes"]
                 for hdr, _ in stream_jobs.values()
             )
-            self.stream_totals["stream_replies"] += len(stream_jobs)
+            with self._totals_lock:
+                self.stream_totals["stream_replies"] += len(stream_jobs)
             self._m_stream_replies.inc(float(len(stream_jobs)))
         self._m_bytes_out.inc(out_bytes)
         if self.tracer is not None:
@@ -2615,19 +2628,20 @@ class AggregationServer:
             self._m_round_failures.inc()
         if rnd.stream is not None:
             s = rnd.stream.stats()
-            tot = self.stream_totals
-            tot["early_bytes"] += s["early_bytes"]
-            tot["late_bytes"] += s["late_bytes"]
-            tot["early_s"] += s["early_s"]
-            tot["late_s"] += s["late_s"]
-            tot["peak_agg_bytes"] = max(
-                tot["peak_agg_bytes"], s["peak_bytes"]
-            )
-            # Last ROUND's peak separately: a mixed campaign's first
-            # (dense, pre-advert) round peaks at O(clients x model) and
-            # would mask the streamed rounds' O(model + in-flight) in
-            # the cross-round max.
-            tot["last_round_peak_bytes"] = s["peak_bytes"]
+            with self._totals_lock:
+                tot = self.stream_totals
+                tot["early_bytes"] += s["early_bytes"]
+                tot["late_bytes"] += s["late_bytes"]
+                tot["early_s"] += s["early_s"]
+                tot["late_s"] += s["late_s"]
+                tot["peak_agg_bytes"] = max(
+                    tot["peak_agg_bytes"], s["peak_bytes"]
+                )
+                # Last ROUND's peak separately: a mixed campaign's first
+                # (dense, pre-advert) round peaks at O(clients x model)
+                # and would mask the streamed rounds' O(model +
+                # in-flight) in the cross-round max.
+                tot["last_round_peak_bytes"] = s["peak_bytes"]
             self._g_peak_agg.set(float(s["peak_bytes"]))
             if self.tracer is not None and s["early_s"] > 0.0:
                 # Overlapped-vs-exposed wire attribution: how much fold
@@ -2781,11 +2795,10 @@ class AggregationServer:
         """Bytes-weighted fraction of this server's aggregation input
         folded while the round's wire phase was still active (0.0 on a
         pure barrier run) — the bench's ``comm_overlap_frac`` headline."""
-        tot = (
-            self.stream_totals["early_bytes"]
-            + self.stream_totals["late_bytes"]
-        )
-        return self.stream_totals["early_bytes"] / tot if tot else 0.0
+        with self._totals_lock:
+            early = self.stream_totals["early_bytes"]
+            tot = early + self.stream_totals["late_bytes"]
+        return early / tot if tot else 0.0
 
     def serve(self, rounds: int = 1) -> None:
         """Multi-round loop: one failed round (quorum missed, DP base

@@ -259,6 +259,9 @@ class StreamAgg:
         leaves = self._pending.get(key)
         if leaves is None or any(c not in leaves for c in self.fold_ids):
             return
+        # fedtpu: allow(determinism): first-fold wall-clock for the
+        # wire-overlap span's t_start — observability only, the fold value
+        # and order come from fold_ids
         t_unix = time.time()
         t0 = time.monotonic()
         try:

@@ -325,6 +325,8 @@ def run_cell(
                         )
                         continue
                     if trainer is not None:
+                        # fedtpu: allow(determinism): client-local span
+                        # timestamp — timing attribution, not plan state
                         t0 = time.time()
                         tm0 = time.monotonic()
                         with train_lock:

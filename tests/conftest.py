@@ -49,3 +49,47 @@ def synthetic_csv(tmp_path_factory):
 @pytest.fixture()
 def rng():
     return np.random.default_rng(0)
+
+
+# ----------------------------------------------------- lock-order detector
+# The runtime half of `fedtpu check`'s concurrency pass (analysis/
+# lockorder.py): every threading.Lock/RLock the package creates during
+# the session is wrapped, acquisition-order edges are collected per
+# creation site, and a cycle (two code paths taking the same two lock
+# sites in opposite orders — the ABBA deadlock class) FAILS the session.
+# FEDTPU_LOCKORDER=0 disarms. Same-site nesting (e.g. per-client locks
+# acquired in a pinned order) is reported, not failed.
+_LOCKORDER = {"armed": False}
+
+
+def pytest_configure(config):
+    if os.environ.get("FEDTPU_LOCKORDER", "1").lower() in ("", "0", "false"):
+        return
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.analysis import (
+        lockorder,
+    )
+
+    lockorder.arm()
+    _LOCKORDER["armed"] = True
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not _LOCKORDER["armed"]:
+        return
+    _LOCKORDER["armed"] = False
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.analysis import (
+        lockorder,
+    )
+
+    report = lockorder.disarm()
+    if report is None:
+        return
+    tr = session.config.pluginmanager.get_plugin("terminalreporter")
+    out = tr.write_line if tr is not None else print
+    out(report.render())
+    if report.cycles:
+        out(
+            "lock-order cycles detected — failing the session "
+            "(see analysis/lockorder.py; FEDTPU_LOCKORDER=0 disarms)"
+        )
+        session.exitstatus = 1
