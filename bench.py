@@ -53,6 +53,13 @@ Secondary modes via BENCH_MODE:
                       one weighted root, streamed both ways; headline
                       fleet_rounds_per_hour + relay_peak_agg_bytes, root
                       aggregate crc-pinned vs the aggregate_tree replay
+    router            the serving replica fleet (router/): live loopback
+                      A/B of one scorer replica vs BENCH_ROUTER_REPLICAS
+                      (default 3) behind the thin router, with a registry
+                      promotion fired MID-LOAD so the rolling hot-reload
+                      runs under traffic; headline router_qps_sustained +
+                      router_p99_ms (vs the pinned BENCH_ROUTER_SLO_MS)
+                      + router_rolling_reload_dropped asserted == 0
 
 Every record is one JSON line of the shape
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
@@ -1158,6 +1165,394 @@ def bench_fleet() -> dict | None:
     return record
 
 
+def _router_worker(spec_json: str) -> None:
+    """One serving-tier subprocess for bench_router's A/B arms — a
+    scorer replica (``role: "replica"``) or the router itself
+    (``role: "router"``). Subprocesses on purpose: the PRODUCTION fleet
+    shape is separate ``infer-serve`` processes behind a separate
+    ``fedtpu route`` process, one GIL each; in-process arms would
+    serialize the whole tier's Python on the parent's GIL (and bias the
+    A/B — the parent also runs the load generator). Forced-CPU like the
+    clientdp child: the parent may hold the (tunneled) accelerator, and
+    N children competing for it would stall the bench, not speed it up.
+    Writes the bound port to the port-file, then parks until the parent
+    terminates it."""
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    spec = json.loads(spec_json)
+    if spec.get("role") == "router":
+        from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.router import (
+            ScoringRouter,
+        )
+
+        server = ScoringRouter(
+            [(h, p) for h, p in spec["backends"]],
+            probe_interval_s=0.25,
+        ).start()
+    else:
+        from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+            default_tokenizer,
+        )
+        from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.datasets import (
+            get_dataset,
+        )
+        from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.registry import (
+            ModelRegistry,
+        )
+        from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.router import (
+            FleetReplica,
+        )
+
+        tok = default_tokenizer()
+        registry = ModelRegistry(spec["registry"])
+        info = registry.serving_info()
+        manifest = registry.manifest(info["artifact"])
+        model_cfg = ModelConfig(**manifest["model_config"])
+        params = registry.load_params(info["artifact"])
+        server = FleetReplica(
+            int(spec["replica"]),
+            model_cfg,
+            params,
+            tok,
+            spec=get_dataset("cicids2017"),
+            round_id=int(manifest.get("round", 1)),
+            buckets=tuple(spec["buckets"]),
+            max_queue=max(1024, 4 * max(spec["buckets"])),
+        ).start()
+    tmp = spec["port_file"] + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(server.port))
+    os.replace(tmp, spec["port_file"])
+    while True:  # parked; the parent terminates this process
+        time.sleep(3600)
+
+
+def _spawn_router_workers(specs, tmpdir, timeout_s=180):
+    """Spawn one forced-CPU subprocess per worker spec; returns (procs,
+    ports) once every child reported its bound port."""
+    import subprocess
+
+    procs = []
+    for i, spec in enumerate(specs):
+        spec["port_file"] = os.path.join(
+            tmpdir, f"worker-{spec.get('role', 'replica')}-{i}.port"
+        )
+        try:
+            # A stale file from an earlier arm's worker of the same name
+            # would satisfy the wait below instantly with a DEAD port.
+            os.remove(spec["port_file"])
+        except OSError:
+            pass
+        env = {**os.environ, "BENCH_ROUTER_WORKER": json.dumps(spec)}
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+        )
+    ports = []
+    deadline = time.monotonic() + timeout_s
+    for i, spec in enumerate(specs):
+        while not os.path.exists(spec["port_file"]):
+            if procs[i].poll() is not None or time.monotonic() > deadline:
+                for p in procs:
+                    p.terminate()
+                raise RuntimeError(
+                    f"worker subprocess {i} failed to come up "
+                    f"(exit {procs[i].poll()})"
+                )
+            time.sleep(0.1)
+        with open(spec["port_file"]) as f:
+            ports.append(int(f.read().strip()))
+    return procs, ports
+
+
+def bench_router() -> dict | None:
+    """Serving replica fleet (ISSUE 9): a live loopback A/B — ONE scorer
+    replica driven directly vs BENCH_ROUTER_REPLICAS (default 3) behind
+    the thin router (router/) — with a registry promotion fired MID-LOAD
+    so the fleet's rolling hot-reload (drain one replica at a time,
+    swap, readmit) runs under traffic.
+
+    "Sustained QPS at a pinned p99 SLO" is measured the way the phrase
+    means: each arm walks an OPEN-LOOP QPS ladder (run_load target_qps —
+    requests fire on a fixed schedule regardless of replies, so queueing
+    shows up as latency instead of sender self-throttling) and its
+    sustained QPS is the highest rung it achieves with p99 <=
+    BENCH_ROUTER_SLO_MS. A single scorer near capacity queues — its p99
+    blows the SLO rungs below its raw throughput — while the fleet
+    spreads the same offered load over N scorer processes; the ladder is
+    anchored at the single arm's measured closed-loop capacity so the
+    two arms climb identical rungs. Headline fields (asserted present by
+    the train-mode headline, exit 3): ``router_qps_sustained`` — the
+    fleet's highest in-SLO rung's achieved QPS — ``router_p99_ms`` — its
+    p99 at that rung — and ``router_rolling_reload_dropped`` — requests
+    that failed across the whole fleet run, **asserted == 0**: a
+    promotion under load must complete without shedding a single request
+    (the PR-3 ladder's zero-downtime deploy contract, measured).
+
+    The tiny preset is the default on purpose: the router tier's win is
+    fan-out of the per-request host work (framing, tokenize, dispatch
+    bookkeeping) across scorer processes' threads — with a model small
+    enough that compute doesn't serialize the arms on one shared
+    accelerator, the A/B isolates exactly that. BENCH_ROUTER_PRESET=
+    distilbert measures the flagship-model shape instead."""
+    import tempfile
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+        default_tokenizer,
+        make_synthetic,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.datasets import (
+        get_dataset,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.registry import (
+        ModelRegistry,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.router import (
+        FleetReplica,
+        ServingFleet,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.serving import (
+        run_load,
+    )
+
+    n_replicas = max(2, int(os.environ.get("BENCH_ROUTER_REPLICAS", "3")))
+    concurrency = int(os.environ.get("BENCH_ROUTER_CONCURRENCY", "16"))
+    requests = int(os.environ.get("BENCH_ROUTER_REQUESTS", "1024"))
+    pipeline = int(os.environ.get("BENCH_ROUTER_PIPELINE", "4"))
+    slo_ms = float(os.environ.get("BENCH_ROUTER_SLO_MS", "500"))
+    target_qps = float(os.environ.get("BENCH_ROUTER_QPS", "0")) or None
+    preset = os.environ.get("BENCH_ROUTER_PRESET", "tiny")
+    tok = default_tokenizer()
+    model_cfg = (
+        ModelConfig.tiny(vocab_size=len(tok.vocab))
+        if preset == "tiny"
+        else ModelConfig(vocab_size=len(tok.vocab))
+    )
+    buckets = tuple(
+        int(b)
+        for b in os.environ.get("BENCH_ROUTER_BUCKETS", "1,8,32").split(",")
+    )
+    trainer = Trainer(model_cfg, TrainConfig(), pad_id=tok.pad_id)
+    params1 = trainer.init_state(seed=0).params
+    params2 = trainer.init_state(seed=1).params
+    spec = get_dataset("cicids2017")
+    texts = spec.render_texts(make_synthetic("cicids2017", 128, seed=0))
+
+    def load(port, n_requests, qps=None):
+        return run_load(
+            "127.0.0.1",
+            port,
+            texts,
+            concurrency=concurrency,
+            requests=n_requests,
+            pipeline=pipeline,
+            target_qps=qps,
+            timeout=120.0,
+        )
+
+    def climb_ladder(port, rungs):
+        """Open-loop SLO search: walk the shared QPS rungs upward; the
+        sustained point is the last rung whose measured p99 held the
+        SLO. Returns (sustained stats | the first rung's stats, rung
+        index or -1)."""
+        best, best_i = None, -1
+        for i, rung in enumerate(rungs):
+            n = max(6 * concurrency, int(rung * 4))  # ~4 s per rung
+            s = load(port, n, qps=rung)
+            if best is None:
+                best = s  # report the first rung even when out of SLO
+            if s["p99_ms"] <= slo_ms and s["rejected"] == 0:
+                best, best_i = s, i
+            else:
+                break
+        return best, best_i
+
+    try:
+        root = tempfile.mkdtemp(prefix="bench-router-registry-")
+        registry = ModelRegistry(root)
+        aid1 = registry.add(params1, round_index=1, model_config=model_cfg)
+        registry.promote(aid1, to="serving")
+
+        # Arm A: ONE replica subprocess, driven directly (no router in
+        # the path). Subprocesses on purpose — the production fleet
+        # shape is separate scorer processes; see _router_worker.
+        replica_spec = {"registry": root, "buckets": list(buckets)}
+        procs, ports = _spawn_router_workers(
+            [{**replica_spec, "replica": 0}], root
+        )
+        try:
+            load(ports[0], 4 * concurrency)  # warm sockets + caches
+            s_single_cap = load(ports[0], requests)
+            # The shared ladder, anchored at the single arm's measured
+            # closed-loop capacity: both arms climb identical rungs.
+            cap = max(s_single_cap["flows_per_sec"], 4.0)
+            rungs = [cap * f for f in (0.4, 0.7, 1.0, 1.4, 2.0, 2.8)]
+            if target_qps is not None:
+                rungs = [target_qps]  # operator-pinned single rung
+            s_single, single_rung = climb_ladder(ports[0], rungs)
+        finally:
+            for p in procs:
+                p.terminate()
+
+        # Arm B: n replica subprocesses behind a ROUTER subprocess (its
+        # own process, like `fedtpu route` — the parent keeps only the
+        # load generator, exactly as in arm A), same rungs.
+        procs, ports = _spawn_router_workers(
+            [{**replica_spec, "replica": i} for i in range(n_replicas)],
+            root,
+        )
+        rprocs, rports = _spawn_router_workers(
+            [
+                {
+                    "role": "router",
+                    "backends": [["127.0.0.1", p] for p in ports],
+                }
+            ],
+            root,
+        )
+        try:
+            load(rports[0], 4 * concurrency)  # warm
+            s_fleet_cap = load(rports[0], requests)
+            s_fleet_slo, fleet_rung = climb_ladder(rports[0], rungs)
+        finally:
+            for p in rprocs + procs:
+                p.terminate()
+
+        # Phase C: the zero-drop contract — the MANAGED in-process fleet
+        # (fedtpu fleet's shape, where the manager can drive each
+        # engine's hot-swap) under closed-loop load with a promotion
+        # fired mid-run; every reject across the window is a drop.
+        replicas = [
+            FleetReplica(
+                i, model_cfg, params1, tok, spec=spec, round_id=1,
+                buckets=buckets, max_queue=max(1024, 4 * buckets[-1]),
+            ).start()
+            for i in range(n_replicas)
+        ]
+        fleet = ServingFleet(
+            replicas,
+            registry=registry,
+            probe_interval_s=0.25,
+            reload_poll_s=0.25,
+            drain_timeout_s=30.0,
+        ).start()
+        errors: list[Exception] = []
+        fleet_out: list[dict] = []
+        try:
+            load(fleet.port, 4 * concurrency)  # warm
+
+            def fleet_load():
+                try:
+                    # The promotion races THIS closed-loop run (max
+                    # pressure — the hardest time to not drop).
+                    fleet_out.append(load(fleet.port, requests))
+                except Exception as e:  # a dropped request IS the finding
+                    errors.append(e)
+
+            lt = threading.Thread(target=fleet_load, daemon=True)
+            lt.start()
+            # Fire the promotion once the load is demonstrably mid-run,
+            # then let the manager's rolling sweep race live traffic.
+            deadline = time.monotonic() + 60.0
+            while (
+                fleet.router.stats()["forwarded"] < requests // 4
+                and lt.is_alive()
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            aid2 = registry.add(
+                params2, round_index=2, model_config=model_cfg
+            )
+            registry.promote(aid2, to="serving")
+            lt.join(timeout=180.0)
+            # The reload may outlive the load; trickle requests while it
+            # finishes so zero-drop stays measured under traffic.
+            trickle_dropped = 0
+            deadline = time.monotonic() + 60.0
+            while (
+                fleet.stats()["reloads"] < 1
+                and time.monotonic() < deadline
+            ):
+                t = load(fleet.port, concurrency)
+                trickle_dropped += t["rejected"]
+            rounds = [rep.round_id for rep in replicas]
+        finally:
+            fleet.close()
+            import shutil
+
+            shutil.rmtree(root, ignore_errors=True)
+    except Exception as e:  # noqa: BLE001 - one parseable line, not a dump
+        record = {
+            "metric": "bench_error",
+            "error": "router_ab_failed",
+            "detail": f"{type(e).__name__}: {str(e)[:300]}",
+        }
+        _emit(record)
+        return record
+    if errors or not fleet_out:
+        record = {
+            "metric": "bench_error",
+            "error": "router_fleet_load_failed",
+            "detail": (
+                str(errors[0])[:300] if errors else "fleet load never ran"
+            ),
+        }
+        _emit(record)
+        return record
+    s_reload = fleet_out[0]
+    dropped = s_reload["rejected"] + trickle_dropped
+    reload_ok = rounds == [2] * n_replicas
+    record = {
+        "metric": f"router_qps_{preset}_r{n_replicas}_c{concurrency}",
+        "value": round(s_fleet_slo["flows_per_sec"], 2),
+        "unit": "flows/sec",
+        # The A/B itself: the fleet's sustained-in-SLO QPS over the
+        # single replica's, on the identical open-loop rung ladder.
+        "vs_baseline": round(
+            s_fleet_slo["flows_per_sec"]
+            / max(s_single["flows_per_sec"], 1e-9),
+            2,
+        ),
+        "baseline_note": f"vs one replica driven directly: "
+        f"{s_single['flows_per_sec']:.1f} flows/s sustained at p99 <= "
+        f"{slo_ms:.0f} ms (rung {single_rung}); a promotion fired "
+        "mid-load and rolling-reloaded under traffic",
+        "router_qps_sustained": round(s_fleet_slo["flows_per_sec"], 2),
+        "router_p99_ms": round(s_fleet_slo["p99_ms"], 2),
+        "router_p99_slo_ms": slo_ms,
+        "router_p99_within_slo": 1.0 if fleet_rung >= 0 else 0.0,
+        "router_sustained_rung": fleet_rung,
+        "router_rolling_reload_dropped": int(dropped),
+        "router_reload_complete": 1.0 if reload_ok else 0.0,
+        "router_single_qps": round(s_single["flows_per_sec"], 2),
+        "router_single_p99_ms": round(s_single["p99_ms"], 2),
+        "router_single_rung": single_rung,
+        "router_fleet_capacity_qps": round(
+            s_fleet_cap["flows_per_sec"], 2
+        ),
+        "router_single_capacity_qps": round(
+            s_single_cap["flows_per_sec"], 2
+        ),
+        "router_replicas": n_replicas,
+        "router_requests": requests,
+        "router_pipeline": pipeline,
+        # The A/B's physical precondition: the fleet arm runs
+        # n_replicas + 1 extra processes — on a host with fewer cores
+        # than that, the ratio reads contention, not the tier's scaling.
+        "router_host_cpus": os.cpu_count(),
+        "replica_rounds": rounds,
+        "device": jax.devices()[0].device_kind,
+    }
+    _emit(record)
+    return record
+
+
 def bench_scenario() -> dict | None:
     """Persona-matrix loopback sweep (ISSUE 6): the `fedtpu scenario`
     harness run small — a persona x partition matrix of LIVE TCP rounds
@@ -1473,7 +1868,7 @@ def _preflight() -> None:
 MODES = (
     "train", "bert", "bertlarge", "eval", "fedavg", "flash", "ring",
     "fed2", "fedseq", "serve", "clientdp", "controller", "scenario",
-    "fleet", "check",
+    "fleet", "check", "router",
 )
 
 
@@ -1531,6 +1926,12 @@ def _check_mfu_floor(records: dict[str, dict | None]) -> list[str]:
 
 
 def main() -> None:
+    worker_spec = os.environ.get("BENCH_ROUTER_WORKER")
+    if worker_spec:
+        # A bench_router replica subprocess: no preflight, no watchdog,
+        # forced-CPU — serves until the parent terminates it.
+        _router_worker(worker_spec)
+        return
     mode = os.environ.get("BENCH_MODE", "train")
     if mode not in MODES:  # validate before paying for the tunnel handshake
         raise SystemExit(f"unknown BENCH_MODE {mode!r} ({'|'.join(MODES)})")
@@ -1576,7 +1977,7 @@ def main() -> None:
             # federated MFUs as machine-parsed fields. BENCH_SECONDARY=0
             # restores the single-line behavior.
             rec_fed2 = rec_fedseq = rec_ctrl = rec_resid = rec_scn = None
-            rec_fleet = rec_check = None
+            rec_fleet = rec_check = rec_router = None
             if os.environ.get("BENCH_SECONDARY", "1").lower() not in (
                 "", "0", "false",
             ):
@@ -1591,6 +1992,7 @@ def main() -> None:
                 rec_ctrl = bench_controller()
                 rec_scn = bench_scenario()
                 rec_fleet = bench_fleet()
+                rec_router = bench_router()
                 rec_check = bench_check()
             extra = {}
             for key, rec in (("fed2", rec_fed2), ("fedseq", rec_fedseq)):
@@ -1703,6 +2105,47 @@ def main() -> None:
                 ):
                     extra[k] = rec_fleet[k]
                 fleet_broken = rec_fleet["fleet_crc_exact"] < 1.0
+            router_broken = False
+            if rec_router is not None and (
+                rec_router.get("metric") != "bench_error"
+            ):
+                # Serving-fleet headline fields (ISSUE 9): ASSERTED
+                # present, and router_rolling_reload_dropped asserted 0
+                # (exit 3) — a promotion under load that sheds even one
+                # request is a zero-downtime-deploy regression, failed
+                # exactly like a crc mismatch.
+                missing = [
+                    k
+                    for k in (
+                        "router_qps_sustained",
+                        "router_p99_ms",
+                        "router_rolling_reload_dropped",
+                    )
+                    if k not in rec_router
+                ]
+                if missing:
+                    _emit(
+                        {
+                            "metric": "bench_error",
+                            "error": "router_fields_missing",
+                            "detail": f"router record lacks {missing} "
+                            "(router/fleet load accounting broken?)",
+                        }
+                    )
+                    raise SystemExit(3)
+                for k in (
+                    "router_qps_sustained",
+                    "router_p99_ms",
+                    "router_rolling_reload_dropped",
+                    "router_single_qps",
+                    "router_p99_within_slo",
+                ):
+                    if k in rec_router:
+                        extra[k] = rec_router[k]
+                router_broken = (
+                    rec_router["router_rolling_reload_dropped"] > 0
+                    or rec_router.get("router_reload_complete", 1.0) < 1.0
+                )
             check_broken = False
             if rec_check is not None and (
                 rec_check.get("metric") != "bench_error"
@@ -1735,7 +2178,13 @@ def main() -> None:
             if broken:
                 extra.update(mfu_floor=MFU_FLOOR, mfu_floor_broken=broken)
             bench_train(ModelConfig(), "distilbert", extra=extra or None)
-            if broken or scenario_broken or fleet_broken or check_broken:
+            if (
+                broken
+                or scenario_broken
+                or fleet_broken
+                or router_broken
+                or check_broken
+            ):
                 raise SystemExit(3)
         elif mode == "bert":
             bench_train(ModelConfig.bert_base(), "bertbase")
@@ -1774,6 +2223,13 @@ def main() -> None:
             rec = bench_fleet()
             if rec is not None and rec.get("metric") != "bench_error" and (
                 rec["fleet_crc_exact"] < 1.0
+            ):
+                raise SystemExit(3)
+        elif mode == "router":
+            rec = bench_router()
+            if rec is not None and rec.get("metric") != "bench_error" and (
+                rec["router_rolling_reload_dropped"] > 0
+                or rec.get("router_reload_complete", 1.0) < 1.0
             ):
                 raise SystemExit(3)
     finally:

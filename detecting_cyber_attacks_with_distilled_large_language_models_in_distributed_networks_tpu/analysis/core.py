@@ -246,6 +246,38 @@ def load_baseline(path: str) -> dict[tuple[str, str, str], str]:
     return out
 
 
+def prune_baseline(path: str, stale: Iterable[Mapping]) -> int:
+    """Rewrite the baseline at ``path`` minus the given stale entries
+    (the remediation path for ``fedtpu check``'s reported-not-failed
+    stale findings: ``--prune-baseline``). Every other field — the
+    review comment, entry order, the reasons of entries that still fire
+    — survives byte-for-byte in spirit (same JSON shape, 2-space
+    indent). Atomic replace, so a crashed prune never leaves a torn
+    baseline. Returns the number of entries removed."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    stale_keys = {
+        (str(e["rule"]), str(e["path"]), str(e["message"])) for e in stale
+    }
+    findings = list(data.get("findings", ()))
+    kept = [
+        e
+        for e in findings
+        if (str(e.get("rule")), str(e.get("path")), str(e.get("message")))
+        not in stale_keys
+    ]
+    removed = len(findings) - len(kept)
+    if removed == 0:
+        return 0
+    data["findings"] = kept
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, path)
+    return removed
+
+
 @dataclass
 class CheckResult:
     """One ``fedtpu check`` run's outcome."""

@@ -25,6 +25,7 @@ import os
 import sys
 
 from ..analysis import all_rules, run_check
+from ..analysis.core import BASELINE_NAME, prune_baseline
 
 
 def _default_root() -> str:
@@ -52,6 +53,36 @@ def cmd_check(args) -> int:
     except (ValueError, OSError) as e:
         print(f"fedtpu check: {e}", file=sys.stderr)
         return 2
+
+    if getattr(args, "prune_baseline", False):
+        # The remediation path for stale entries: rewrite the baseline
+        # minus findings that no longer fire. Resolve the path exactly
+        # as run_check did (explicit --baseline, else the scanned
+        # root's ANALYSIS_BASELINE.json when present).
+        bpath = getattr(args, "baseline", None)
+        if bpath is None:
+            candidate = os.path.join(os.path.abspath(root), BASELINE_NAME)
+            bpath = candidate if os.path.isfile(candidate) else None
+        if bpath is None:
+            print(
+                "fedtpu check: --prune-baseline found no baseline file "
+                "to prune",
+                file=sys.stderr,
+            )
+            return 2
+        removed = (
+            prune_baseline(bpath, result.stale_baseline)
+            if result.stale_baseline
+            else 0
+        )
+        print(
+            f"fedtpu check: pruned {removed} stale baseline entr"
+            f"{'y' if removed == 1 else 'ies'} from {bpath}",
+            # --json consumers parse stdout as ONE JSON document; the
+            # human-facing prune notice must not corrupt it.
+            file=sys.stderr if getattr(args, "json", False) else sys.stdout,
+        )
+        result.stale_baseline = []
 
     if getattr(args, "json", False):
         json.dump(result.to_dict(), sys.stdout, indent=2)

@@ -9,19 +9,77 @@ controller, registry, infer-serve) appends spans to its own events-JSONL
     fedtpu obs timeline --trace server.jsonl --trace client0.jsonl --json
     fedtpu obs export --trace-dir runs/obs --out trace.json
         # load trace.json in chrome://tracing or ui.perfetto.dev
+    fedtpu obs tail --trace-dir runs/obs --round 3
+        # live follow mode: one line per span as processes append them
+        # (--trace-id/--round filter; --from-start replays history first)
 """
 
 from __future__ import annotations
 
 import json
 import sys
+import time
 
 from ..obs import (
     export_chrome_trace,
     load_spans,
     round_summaries,
+    tail_spans,
     timeline_table,
 )
+
+
+def _tail_line(rec: dict) -> str:
+    """One human-readable line per span (the tail format): local time,
+    proc, span, duration, identity, then every extra attribute."""
+    ts = time.strftime("%H:%M:%S", time.localtime(rec["ts"]))
+    head = (
+        f"{ts} {str(rec.get('proc', '?')):<12} {rec['span']:<15} "
+        f"{rec['dur_s'] * 1e3:9.1f}ms"
+    )
+    ident = []
+    if rec.get("trace") is not None:
+        ident.append(f"trace={rec['trace']}")
+    if rec.get("round") is not None:
+        ident.append(f"round={rec['round']}")
+    skip = {"schema", "run_id", "proc", "span", "ts", "dur_s", "trace", "round"}
+    attrs = [f"{k}={rec[k]}" for k in rec if k not in skip]
+    return " ".join([head] + ident + attrs)
+
+
+def _cmd_tail(args, paths, trace_dir) -> int:
+    """Live follow mode over the events-JSONL set. Unlike the batch
+    actions, an empty/missing input is NOT an error — tailing a
+    directory that processes will write into shortly is the point."""
+    trace_filter = getattr(args, "trace_id", None)
+    round_filter = getattr(args, "round", None)
+    max_seconds = getattr(args, "max_seconds", None)
+    deadline = (
+        time.monotonic() + float(max_seconds)
+        if max_seconds is not None
+        else None
+    )
+    stop = (
+        (lambda: time.monotonic() >= deadline)
+        if deadline is not None
+        else None
+    )
+    try:
+        for rec in tail_spans(
+            paths,
+            trace_dir=trace_dir,
+            poll_s=getattr(args, "poll", None) or 0.5,
+            from_start=getattr(args, "from_start", False),
+            stop=stop,
+        ):
+            if trace_filter is not None and rec.get("trace") != trace_filter:
+                continue
+            if round_filter is not None and rec.get("round") != round_filter:
+                continue
+            print(_tail_line(rec), flush=True)
+    except KeyboardInterrupt:
+        pass
+    return 0
 
 
 def cmd_obs(args) -> int:
@@ -32,6 +90,8 @@ def cmd_obs(args) -> int:
             "fedtpu obs needs span inputs: --trace-dir DIR (merges every "
             "*.jsonl) and/or --trace FILE (repeatable)"
         )
+    if args.action == "tail":
+        return _cmd_tail(args, paths, trace_dir)
     spans = load_spans(paths, trace_dir=trace_dir)
     if not spans:
         raise SystemExit(

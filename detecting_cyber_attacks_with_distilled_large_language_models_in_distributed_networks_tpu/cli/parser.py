@@ -27,6 +27,14 @@ covers every deployment shape, parameterized by client id / count:
               forward one streamed upload per round to the parent — how a
               round scales past one server process to 64-256-client
               cohorts (run the root serve with --weighted)
+  route       serving router: load-balance the scoring protocol across N
+              infer-serve replicas (router/) — least-in-flight pick,
+              in-band stats health probes, eject/readmit on failure,
+              HMAC auth passed through end-to-end
+  fleet       local replica fleet: N infer-serve replicas behind the
+              router, following the registry serving pointer with
+              ROLLING hot-reload — promotions drain and swap one replica
+              at a time, so the pointer move never drops traffic
   controller  control plane: unattended continuous federated rounds with
               an eval-gated model registry — round -> held-out eval ->
               candidate artifact -> promote (or reject on regression) ->
@@ -61,6 +69,7 @@ from .federated import cmd_federated
 from .local import cmd_local
 from .obs import cmd_obs
 from .predict import cmd_export_hf, cmd_predict
+from .router import cmd_fleet, cmd_route
 from .scenario import cmd_scenario
 from .serving import cmd_infer_serve
 
@@ -745,6 +754,140 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_infer_serve)
 
     p = sub.add_parser(
+        "route",
+        help="serving router: load-balance the scoring protocol across N "
+        "infer-serve replicas (least-in-flight pick, health probes, "
+        "eject/readmit)",
+        epilog="The router is model-free — it never tokenizes or scores; "
+        "per-request cost is two id rewrites and two socket writes. "
+        "Health rides the in-band stats() probe on each replica "
+        "connection, so 'probe healthy' cannot diverge from 'requests "
+        "flow'. With FEDTPU_SECRET + --auth the whole chain "
+        "(client -> router -> replica) is HMAC-authenticated.",
+    )
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=12390)
+    p.add_argument(
+        "--backend",
+        action="append",
+        metavar="HOST:PORT",
+        help="an infer-serve replica to route across (repeatable, >= 1)",
+    )
+    p.add_argument(
+        "--auth",
+        action="store_true",
+        help="HMAC challenge-response on the front port AND on every "
+        "backend dial (shared secret from FEDTPU_SECRET)",
+    )
+    p.add_argument(
+        "--probe-interval",
+        type=float,
+        default=1.0,
+        help="seconds between per-replica stats() health probes (default 1)",
+    )
+    p.add_argument(
+        "--probe-timeout",
+        type=float,
+        default=5.0,
+        help="unanswered-probe age that ejects a replica (default 5)",
+    )
+    p.add_argument(
+        "--max-inflight",
+        type=int,
+        default=1024,
+        help="per-replica in-flight bound; a replica at the bound leaves "
+        "the pick set until replies drain it (default 1024)",
+    )
+    p.add_argument(
+        "--trace-jsonl",
+        help="append obs spans (router-forward) to this events-JSONL",
+    )
+    p.add_argument(
+        "--trace-sample",
+        type=float,
+        default=None,
+        help="router-forward span sampling rate in (0, 1] (counter-strided"
+        ", like infer-serve --trace-sample); default 1.0",
+    )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=0,
+        help="Prometheus /metrics: per-replica in-flight gauges, eject and "
+        "forward counters (0 = off, the default)",
+    )
+    p.set_defaults(fn=cmd_route)
+
+    p = sub.add_parser(
+        "fleet",
+        help="local replica fleet: N infer-serve replicas behind the "
+        "router with registry-following ROLLING hot-reload (zero-drop "
+        "promotions)",
+        epilog="Serves the registry's PROMOTED artifact on every replica. "
+        "On a promotion the fleet manager drains one replica at a time "
+        "(router pick-set removal -> in-flight wait -> hot-swap -> "
+        "readmit), so the serving pointer moves under load without "
+        "dropping a request — the bench pins "
+        "router_rolling_reload_dropped == 0.",
+    )
+    _add_common(p)  # model/tokenizer/dataset resolution flags
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=12390)
+    p.add_argument(
+        "--replicas",
+        type=int,
+        default=None,
+        help="replica count (default: config router.replicas = 3)",
+    )
+    p.add_argument(
+        "--registry-dir",
+        required=True,
+        help="model registry whose serving pointer the fleet follows",
+    )
+    p.add_argument(
+        "--auth",
+        action="store_true",
+        help="HMAC auth end-to-end: front port, every replica port, and "
+        "the router's backend dials (FEDTPU_SECRET)",
+    )
+    p.add_argument(
+        "--buckets",
+        default="1,8,32,128",
+        help="per-replica micro-batch bucket shapes (default 1,8,32,128)",
+    )
+    p.add_argument(
+        "--max-wait-ms",
+        type=float,
+        default=5.0,
+        help="per-replica batch gather window (default 5)",
+    )
+    p.add_argument(
+        "--max-queue",
+        type=int,
+        default=1024,
+        help="per-replica admission-control queue bound (default 1024)",
+    )
+    p.add_argument(
+        "--reload-poll",
+        type=float,
+        default=2.0,
+        help="seconds between serving-pointer polls (default 2)",
+    )
+    p.add_argument(
+        "--threshold",
+        type=float,
+        default=0.5,
+        help="P(attack) decision threshold in replies (default 0.5)",
+    )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=0,
+        help="Prometheus /metrics for the router + replicas (0 = off)",
+    )
+    p.set_defaults(fn=cmd_fleet)
+
+    p = sub.add_parser(
         "controller",
         help="control plane: continuous eval-gated federated rounds "
         "(round -> gate -> promote -> serve -> drift-monitor loop)",
@@ -956,10 +1099,11 @@ def build_parser() -> argparse.ArgumentParser:
         "round's wall-clock to per-client compute / straggler wait / "
         "wire / agg; `export` writes chrome://tracing JSON.",
     )
-    p.add_argument("action", choices=["timeline", "export"])
+    p.add_argument("action", choices=["timeline", "export", "tail"])
     p.add_argument(
         "--trace-dir",
-        help="directory of span JSONLs (every *.jsonl is merged)",
+        help="directory of span JSONLs (every *.jsonl is merged; tail "
+        "also picks up files that appear later)",
     )
     p.add_argument(
         "--trace",
@@ -969,7 +1113,34 @@ def build_parser() -> argparse.ArgumentParser:
         "--trace-dir)",
     )
     p.add_argument(
-        "--round", type=int, default=None, help="only this round (timeline)"
+        "--round",
+        type=int,
+        default=None,
+        help="only this round (timeline/tail)",
+    )
+    p.add_argument(
+        "--trace-id",
+        default=None,
+        help="tail: only spans carrying this trace id",
+    )
+    p.add_argument(
+        "--from-start",
+        action="store_true",
+        help="tail: replay existing spans before following (default: "
+        "start at each file's end, new spans only)",
+    )
+    p.add_argument(
+        "--poll",
+        type=float,
+        default=0.5,
+        help="tail: seconds between file polls (default 0.5)",
+    )
+    p.add_argument(
+        "--max-seconds",
+        type=float,
+        default=None,
+        help="tail: stop after this many seconds (default: follow until "
+        "interrupted — the live-ops shape)",
     )
     p.add_argument(
         "--json",
@@ -1012,6 +1183,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--list-rules",
         action="store_true",
         help="print the registered rules and exit",
+    )
+    p.add_argument(
+        "--prune-baseline",
+        action="store_true",
+        help="rewrite the baseline file minus STALE entries (findings "
+        "that no longer fire) — the remediation path for the "
+        "reported-not-failed stale list; live entries and the review "
+        "comment survive untouched",
     )
     p.set_defaults(fn=cmd_check)
 

@@ -1,0 +1,198 @@
+"""fedtpu route / fedtpu fleet — the serving replica tier (router/).
+
+``route`` runs the thin model-free router standalone over already-
+running ``infer-serve`` backends (cross-host deployments: replicas on
+their own machines, one router in front). ``fleet`` is the one-command
+local shape: spawn N in-process replicas from the registry's promoted
+artifact, put the router in front, and follow the serving pointer with
+**rolling hot-reload** — on every promotion the manager drains and
+swaps one replica at a time, so the pointer move never drops a request
+(the PR-3 promotion ladder's zero-downtime deploy path).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..utils.logging import get_logger
+from .common import _obs_setup, _resolve_with_pretrained
+
+log = get_logger()
+
+
+def _parse_backends(specs) -> list[tuple[str, int]]:
+    backends = []
+    for spec in specs or ():
+        host, sep, port = str(spec).rpartition(":")
+        if not sep or not port.isdigit():
+            raise SystemExit(
+                f"--backend {spec!r}: want HOST:PORT (e.g. 127.0.0.1:12380)"
+            )
+        backends.append((host or "127.0.0.1", int(port)))
+    if not backends:
+        raise SystemExit(
+            "fedtpu route needs at least one --backend HOST:PORT "
+            "(repeatable) — the infer-serve replicas to route across"
+        )
+    return backends
+
+
+def _auth_key_or_exit(args) -> bytes | None:
+    if not getattr(args, "auth", False):
+        return None
+    from .comm import _auth_key
+
+    auth_key = _auth_key()
+    if auth_key is None:
+        raise SystemExit(
+            "--auth needs the shared secret in the FEDTPU_SECRET env var "
+            "(same value on the router, every replica, and every client)"
+        )
+    return auth_key
+
+
+def _log_router_stats(tag: str, s: dict) -> None:
+    ups = ", ".join(
+        f"r{b['replica']}"
+        f"{'' if b['healthy'] else ' DOWN'}"
+        f"{' draining' if b['draining'] else ''}"
+        f"(inflight {b['inflight']}, round {b['round']})"
+        for b in s["backends"]
+    )
+    log.info(
+        f"[{tag}] forwarded {s['forwarded']}, rejects {s['rejects_total']}, "
+        f"{s['healthy']}/{len(s['backends'])} replicas up: {ups}"
+    )
+
+
+def cmd_route(args) -> int:
+    from ..router import ScoringRouter
+
+    backends = _parse_backends(getattr(args, "backend", None))
+    auth_key = _auth_key_or_exit(args)
+    tracer, _metrics = _obs_setup(args, proc="router", metrics_host=args.host)
+    router = ScoringRouter(
+        backends,
+        host=args.host,
+        port=args.port,
+        auth_key=auth_key,
+        probe_interval_s=args.probe_interval,
+        probe_timeout_s=args.probe_timeout,
+        max_inflight_per_replica=args.max_inflight,
+        tracer=tracer,
+        trace_sample=(
+            args.trace_sample
+            if getattr(args, "trace_sample", None) is not None
+            else 1.0
+        ),
+    )
+    with router:
+        log.info(
+            f"[ROUTER] fronting {len(backends)} replica(s) on "
+            f"{args.host}:{router.port} (auth "
+            f"{'on' if auth_key else 'off — open port'})"
+        )
+        try:
+            while True:
+                time.sleep(30.0)
+                _log_router_stats("ROUTER", router.stats())
+        except KeyboardInterrupt:
+            log.info("[ROUTER] interrupted; draining")
+    return 0
+
+
+def cmd_fleet(args) -> int:
+    from ..config import ModelConfig
+    from ..data.datasets import get_dataset
+    from ..registry import ModelRegistry
+    from ..router import FleetReplica, ServingFleet
+    from .serving import _parse_buckets
+
+    tok, cfg, _pretrained = _resolve_with_pretrained(
+        args, load_weights=False
+    )
+    auth_key = _auth_key_or_exit(args)
+    buckets = _parse_buckets(args.buckets)
+    n = int(args.replicas) if args.replicas else cfg.router.replicas
+    if n < 1:
+        raise SystemExit(f"--replicas {n}: a fleet needs at least one")
+    # Pointer-following only: a fleet exists to make PROMOTIONS
+    # zero-downtime, and promotions are a registry concept.
+    registry = ModelRegistry(args.registry_dir)
+    info = registry.serving_info()
+    if info is None:
+        raise SystemExit(
+            f"registry {args.registry_dir} has no serving artifact yet — "
+            "run `fedtpu controller` (or `fedtpu registry promote`) to "
+            "promote one first"
+        )
+    manifest = registry.manifest(info["artifact"])
+    model_cfg = cfg.model
+    if manifest.get("model_config"):
+        model_cfg = ModelConfig(**manifest["model_config"])
+    if model_cfg.vocab_size != len(tok.vocab):
+        raise SystemExit(
+            f"serving artifact's model vocab ({model_cfg.vocab_size}) != "
+            f"tokenizer vocab ({len(tok.vocab)}); pass the matching "
+            "--hf-dir / vocab"
+        )
+    params = registry.load_params(info["artifact"])
+    round_id = int(manifest.get("round", 0))
+    tracer, _metrics = _obs_setup(args, proc="fleet", cfg=cfg, metrics_host=args.host)
+    log.info(
+        f"[FLEET] spawning {n} replica(s) of artifact {info['artifact']} "
+        f"(round {round_id}) from registry {args.registry_dir}"
+    )
+    replicas = [
+        FleetReplica(
+            i,
+            model_cfg,
+            params,
+            tok,
+            spec=get_dataset(cfg.data.dataset),
+            round_id=round_id,
+            buckets=buckets,
+            max_queue=args.max_queue,
+            gather_window_s=args.max_wait_ms / 1e3,
+            threshold=args.threshold,
+            auth_key=auth_key,
+            tracer=tracer,
+        ).start()
+        for i in range(n)
+    ]
+    fleet = ServingFleet(
+        replicas,
+        registry=registry,
+        auth_key=auth_key,
+        router_host=args.host,
+        router_port=args.port,
+        probe_interval_s=cfg.router.probe_interval_s,
+        probe_timeout_s=cfg.router.probe_timeout_s,
+        drain_timeout_s=cfg.router.drain_timeout_s,
+        reload_poll_s=args.reload_poll,
+        max_inflight_per_replica=cfg.router.max_inflight_per_replica,
+        tracer=tracer,
+    )
+    try:
+        with fleet:
+            log.info(
+                f"[FLEET] scoring {cfg.data.dataset} flows on "
+                f"{args.host}:{fleet.port} ({n} replicas, rolling reload "
+                f"on promotion; auth {'on' if auth_key else 'off'})"
+            )
+            try:
+                while True:
+                    time.sleep(30.0)
+                    s = fleet.stats()
+                    _log_router_stats("FLEET", s)
+                    log.info(
+                        f"[FLEET] serving {s['serving_artifact']} "
+                        f"(rounds {s['replica_rounds']}, "
+                        f"{s['reloads']} rolling reload(s))"
+                    )
+            except KeyboardInterrupt:
+                log.info("[FLEET] interrupted; draining")
+    finally:
+        for rep in replicas:
+            rep.close()
+    return 0

@@ -66,7 +66,7 @@ log = get_logger()
 def aggregate_tree(
     models: list[dict[str, np.ndarray]],
     weights: list[float] | None,
-    groups: list[list[int]],
+    groups: list,
 ) -> dict[str, np.ndarray]:
     """The fold tree's pinned arithmetic, replayed flat: per group (a
     subtree, indices into ``models`` in ascending client-id order) the
@@ -74,16 +74,34 @@ def aggregate_tree(
     weighted by each group's weight mass — exactly the fp32 ops, in
     exactly the order, the relay tier performs. The A/B harnesses
     (tests/test_fleet.py, bench.py fleet) pin the live depth-2 root
-    aggregate against this crc-bit-exactly."""
-    if not groups or any(not g for g in groups):
+    aggregate against this crc-bit-exactly.
+
+    ``groups`` may nest to ANY depth: an element that is itself a list
+    is a deeper subtree (a relay whose parent is another relay — the
+    wire composes, and this is its replay). Each subtree folds bottom-up
+    to a (weighted mean, weight mass) pair; the parent folds child
+    partials weighted by their masses. The classic depth-2 call shape
+    (``[[0, 1], [2, 3]]``) takes exactly the code path — and produces
+    exactly the fp32 ops in exactly the order — it always did."""
+    if not isinstance(groups, list) or not groups:
         raise ValueError("aggregate_tree needs non-empty groups")
-    partials: list[dict[str, np.ndarray]] = []
-    masses: list[float] = []
-    for g in groups:
-        ws = [1.0 if weights is None else float(weights[i]) for i in g]
-        partials.append(aggregate_flat([models[i] for i in g], ws))
-        masses.append(sum(ws))
-    return aggregate_flat(partials, masses)
+
+    def _fold(node) -> tuple[dict[str, np.ndarray], float]:
+        if isinstance(node, (int, np.integer)):
+            w = 1.0 if weights is None else float(weights[node])
+            return models[node], w
+        if not isinstance(node, list) or not node:
+            raise ValueError("aggregate_tree needs non-empty groups")
+        parts: list[dict[str, np.ndarray]] = []
+        masses: list[float] = []
+        for child in node:
+            part, mass = _fold(child)
+            parts.append(part)
+            masses.append(mass)
+        return aggregate_flat(parts, masses), sum(masses)
+
+    agg, _mass = _fold(groups)
+    return agg
 
 
 class RelayAggregator:

@@ -152,6 +152,15 @@ SCORE_REJ_MAGIC = b"SCRJ"
 #: is the reference-style open protocol, as before.
 SCORE_AUTH_MAGIC = b"SCAU"
 SCORE_AUTH_DOMAIN = b"fedtpu-score-auth-v1"
+#: Scoring-fleet stats frames (serving/protocol.py): SCORE_STAT requests
+#: a ``stats()`` snapshot over the scoring connection itself and
+#: SCORE_STATR answers with it — the in-band health/telemetry probe the
+#: router tier (router/) load-balances and ejects replicas on. In-band
+#: on purpose: a probe exercises the same socket, auth handshake, and
+#: reader thread a real request rides, so "probe healthy" cannot
+#: diverge from "requests flow".
+SCORE_STAT_MAGIC = b"SCST"
+SCORE_STATR_MAGIC = b"SCSR"
 #: Streamed-upload frames (module docstring "Streamed uploads"): header,
 #: sequential payload chunk, trailer. The capability rides reply meta
 #: under STREAM_META_KEY as the server's preferred chunk byte count.

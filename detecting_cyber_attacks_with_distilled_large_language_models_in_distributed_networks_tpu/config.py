@@ -528,6 +528,55 @@ class ControlConfig:
 
 
 @dataclass(frozen=True)
+class RouterConfig:
+    """Serving replica fleet (router/): the knobs of the thin router and
+    the rolling hot-reload manager behind ``fedtpu route`` / ``fedtpu
+    fleet``. The reference serves nothing at all; the single-process
+    ``infer-serve`` tier serves from one scorer — these knobs govern the
+    tier that scales past it."""
+
+    #: Local replicas ``fedtpu fleet`` spawns behind the router.
+    replicas: int = 3
+    #: Seconds between in-band stats() health probes per replica.
+    probe_interval_s: float = 1.0
+    #: Unanswered-probe age that ejects a replica from the pick set.
+    probe_timeout_s: float = 5.0
+    #: Rolling reload: how long to wait for one replica's in-flight
+    #: requests to finish before swapping anyway.
+    drain_timeout_s: float = 30.0
+    #: Seconds between serving-pointer polls by the fleet manager.
+    reload_poll_s: float = 2.0
+    #: Router-side admission bound: a replica at this many in-flight
+    #: requests leaves the pick set until replies drain it.
+    max_inflight_per_replica: int = 1024
+
+    def __post_init__(self) -> None:
+        if self.replicas < 1:
+            raise ValueError(f"replicas={self.replicas} must be >= 1")
+        if self.probe_interval_s <= 0.0:
+            raise ValueError(
+                f"probe_interval_s={self.probe_interval_s} must be > 0"
+            )
+        if self.probe_timeout_s <= 0.0:
+            raise ValueError(
+                f"probe_timeout_s={self.probe_timeout_s} must be > 0"
+            )
+        if self.drain_timeout_s < 0.0:
+            raise ValueError(
+                f"drain_timeout_s={self.drain_timeout_s} must be >= 0"
+            )
+        if self.reload_poll_s <= 0.0:
+            raise ValueError(
+                f"reload_poll_s={self.reload_poll_s} must be > 0"
+            )
+        if self.max_inflight_per_replica < 1:
+            raise ValueError(
+                f"max_inflight_per_replica={self.max_inflight_per_replica} "
+                "must be >= 1"
+            )
+
+
+@dataclass(frozen=True)
 class ObsConfig:
     """Observability (obs/): cross-tier round tracing + /metrics.
 
@@ -607,6 +656,7 @@ class ExperimentConfig:
     distill: DistillConfig = field(default_factory=DistillConfig)
     control: ControlConfig = field(default_factory=ControlConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    router: RouterConfig = field(default_factory=RouterConfig)
     output_dir: str = "outputs"
     checkpoint_dir: str | None = None
 
@@ -650,6 +700,7 @@ class ExperimentConfig:
             "distill": DistillConfig,
             "control": ControlConfig,
             "obs": ObsConfig,
+            "router": RouterConfig,
         }
         scalars = ("output_dir", "checkpoint_dir")
         unknown_top = set(d) - set(sections) - set(scalars)

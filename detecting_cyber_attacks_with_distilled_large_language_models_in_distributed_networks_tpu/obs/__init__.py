@@ -27,6 +27,7 @@ from .timeline import (  # noqa: F401
     load_spans,
     round_breakdown,
     round_summaries,
+    tail_spans,
     timeline_table,
 )
 from .trace import (  # noqa: F401

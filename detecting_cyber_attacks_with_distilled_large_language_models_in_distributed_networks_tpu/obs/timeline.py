@@ -32,6 +32,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import time
 from typing import Any, Iterable, Mapping
 
 from .trace import SCHEMA
@@ -66,6 +67,72 @@ def load_spans(
             spans.append(rec)
     spans.sort(key=lambda r: r["ts"])
     return spans
+
+
+def tail_spans(
+    paths: Iterable[str] | None = None,
+    *,
+    trace_dir: str | None = None,
+    poll_s: float = 0.5,
+    from_start: bool = False,
+    stop=None,
+):
+    """Follow-mode span reader (the ``fedtpu obs tail`` engine): a
+    generator yielding span dicts as they are APPENDED to the
+    events-JSONL set — the live counterpart of :func:`load_spans`.
+
+    Files named up front start at their end (``from_start=True`` replays
+    them first); files that APPEAR later under ``trace_dir`` (a process
+    opening its ``--trace-jsonl`` mid-run) are picked up from offset 0 —
+    a late-starting client's spans are new by definition. Partial tails
+    are never parsed: a line is consumed only once its newline landed,
+    so a mid-append poll cannot yield half a record (the writers append
+    whole lines atomically, obs/trace.py). ``stop`` is a zero-arg
+    callable polled between passes — the tailer's only exit besides
+    GeneratorExit."""
+    offsets: dict[str, int] = {}
+
+    def _files() -> list[str]:
+        files = list(paths or [])
+        if trace_dir:
+            files.extend(
+                sorted(glob.glob(os.path.join(trace_dir, "*.jsonl")))
+            )
+        return files
+
+    for path in _files():
+        try:
+            offsets[path] = 0 if from_start else os.path.getsize(path)
+        except OSError:
+            offsets[path] = 0
+    while True:
+        for path in _files():
+            off = offsets.setdefault(path, 0)
+            try:
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    chunk = f.read()
+            except OSError:
+                continue
+            if not chunk:
+                continue
+            end = chunk.rfind(b"\n")
+            if end < 0:
+                continue  # no complete line yet
+            offsets[path] = off + end + 1
+            for line in chunk[: end + 1].splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(rec, dict) or rec.get("schema") != SCHEMA:
+                    continue
+                if "span" not in rec or "ts" not in rec or "dur_s" not in rec:
+                    continue
+                yield rec
+        if stop is not None and stop():
+            return
+        time.sleep(poll_s)
 
 
 def group_rounds(spans: Iterable[dict]) -> dict[tuple, list[dict]]:
@@ -231,6 +298,8 @@ def timeline_table(
                 "serve-batch",
                 "batch-prefetch",
                 "relay-forward",
+                "router-forward",
+                "replica-drain",
             )
         ]
         for s in extra:

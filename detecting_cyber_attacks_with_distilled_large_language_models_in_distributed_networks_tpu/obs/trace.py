@@ -40,6 +40,13 @@ Span vocabulary (names are the contract the timeline tool groups by)::
     promote       a registry state transition / pointer swap
     serve-batch   one coalesced scoring dispatch on the serving tier
                   (``sampled_batches`` when span sampling is on)
+    router-forward  one request's trip through the serving router
+                  (router/core.py): send-to-replica -> reply-rewritten,
+                  with ``replica`` + ``inflight`` (``sampled_requests``
+                  when span sampling is on)
+    replica-drain one replica's drain -> hot-swap -> readmit cycle of a
+                  rolling fleet reload (router/fleet.py), with
+                  ``replica``/``artifact``/``drained``
 
 Timestamps are wall-clock unix seconds (``ts``) with a separately
 measured monotonic duration (``dur_s``): cross-process correlation needs
@@ -73,6 +80,8 @@ SPAN_NAMES = (
     "eval-gate",
     "promote",
     "serve-batch",
+    "router-forward",
+    "replica-drain",
 )
 
 #: Wire meta key the trace id rides under (comm/server.py reply meta,

@@ -107,6 +107,15 @@ class ModelRegistry:
         with open(os.path.join(self.root, _EVENTS), "a") as f:
             f.write(json.dumps(rec) + "\n")
 
+    def record_reload(self, aid: str, *, consumer: str) -> None:
+        """Audit one serving-tier adoption of an artifact on the events
+        trail — the fleet's rolling reload (router/fleet.py) records each
+        replica's completed hot-swap here, so ``events.jsonl`` answers
+        "which replica served which artifact when" without scraping
+        process logs. Append-only telemetry: never touches manifests or
+        the pointer."""
+        self._event("reload", artifact=str(aid), consumer=str(consumer))
+
     def _promote_span(
         self, t_unix: float, t0: float, aid: str, state: str, round_index
     ) -> None:

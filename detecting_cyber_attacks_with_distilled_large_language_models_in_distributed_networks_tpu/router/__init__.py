@@ -1,0 +1,20 @@
+"""Serving replica fleet: a thin router over N ``infer-serve`` scorers.
+
+The serving tier's scale-out layer (ROADMAP "Serving tier for millions
+of users"): ``fedtpu route`` runs the model-free TCP router
+(:mod:`.core` — least-in-flight pick, in-band stats health probes,
+eject/readmit, end-to-end HMAC), and ``fedtpu fleet`` composes N local
+replicas behind it with registry-following **rolling hot-reload**
+(:mod:`.fleet` — drain one replica at a time around each promotion, so
+the serving pointer moves without dropping a single request).
+"""
+
+from .core import Replica, ScoringRouter
+from .fleet import FleetReplica, ServingFleet
+
+__all__ = [
+    "FleetReplica",
+    "Replica",
+    "ScoringRouter",
+    "ServingFleet",
+]

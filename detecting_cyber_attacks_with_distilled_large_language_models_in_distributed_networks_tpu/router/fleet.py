@@ -1,0 +1,333 @@
+"""The replica fleet: N local scorers + router + rolling hot-reload.
+
+``fedtpu fleet`` composes what already exists — :class:`~..serving.
+server.ScoringServer` replicas (each with its own bucketed engine) and
+the :class:`~.core.ScoringRouter` in front — and adds the one genuinely
+new behavior: **rolling reload**. The single-replica tiers swap params
+in place (atomic under the engine lock, fine for a same-architecture
+swap); a fleet can do strictly better: take ONE replica out of the pick
+set, wait out its in-flight requests, swap it, readmit it, move to the
+next. During the whole sweep N-1 replicas keep serving, so a promotion
+— however slow the params load — is a zero-drop event, which is the
+property the bench pins (``router_rolling_reload_dropped == 0``).
+
+The manager follows the registry's serving pointer exactly like
+serving/reload.RegistryWatcher, with the fleet-shaped differences: ONE
+poll for the whole fleet (N replicas polling independently would reload
+in an uncoordinated burst, the opposite of rolling), the architecture
+guard runs once against the shared engine config, and every completed
+per-replica swap is recorded back into the registry's events trail
+(:meth:`~..registry.store.ModelRegistry.record_reload`) — the audit
+answer to "which replica is serving which artifact right now".
+
+Each drain→swap→readmit cycle emits a ``replica-drain`` span (obs
+vocabulary), so the obs timeline shows promotion cost per replica next
+to round compute and the eval gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from ..serving import MicroBatcher, ScoreEngine, ScoringServer
+from ..utils.logging import get_logger
+from .core import ScoringRouter
+
+log = get_logger()
+
+
+class FleetReplica:
+    """One in-process serving replica: engine + scoring server on its
+    own loopback port. ``adopt()`` is the hot-swap target the rolling
+    reload drives (same-architecture params only — the fleet manager
+    guards architecture before the sweep starts)."""
+
+    def __init__(
+        self,
+        replica_id: int,
+        model_cfg,
+        params,
+        tok,
+        *,
+        spec=None,
+        round_id: int = 0,
+        host: str = "127.0.0.1",
+        buckets: tuple[int, ...] = (1, 8, 32),
+        max_queue: int = 256,
+        gather_window_s: float = 0.002,
+        threshold: float = 0.5,
+        auth_key: bytes | None = None,
+        warmup: bool = True,
+        idle_tick_s: float = 0.02,
+        tracer=None,
+        trace_sample: float = 1.0,
+    ):
+        self.replica_id = int(replica_id)
+        self.engine = ScoreEngine(
+            model_cfg,
+            params,
+            pad_id=tok.pad_id,
+            buckets=buckets,
+            round_id=round_id,
+        )
+        self.server = ScoringServer(
+            self.engine,
+            tok,
+            host=host,
+            port=0,
+            spec=spec,
+            threshold=threshold,
+            batcher=MicroBatcher(
+                max_batch=buckets[-1],
+                max_queue=max(max_queue, buckets[-1]),
+                gather_window_s=gather_window_s,
+            ),
+            auth_key=auth_key,
+            warmup=warmup,
+            idle_tick_s=idle_tick_s,
+            tracer=tracer,
+            trace_sample=trace_sample,
+            replica_id=replica_id,
+        )
+        self.host = host
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    @property
+    def round_id(self) -> int:
+        return self.engine.round_id
+
+    def adopt(self, params, *, round_id: int) -> None:
+        """Atomic same-architecture hot-swap (engine lock)."""
+        self.engine.swap(params, round_id=round_id)
+
+    def start(self) -> "FleetReplica":
+        self.server.start()
+        return self
+
+    def close(self) -> None:
+        self.server.close()
+
+
+class ServingFleet:
+    """Replicas + router + (optionally) the pointer-following rolling-
+    reload manager.
+
+    ``registry``: a :class:`~..registry.store.ModelRegistry` to follow —
+    the manager thread polls its serving pointer every
+    ``reload_poll_s`` and answers a pointer move with one rolling
+    sweep. None = no manager; :meth:`rolling_reload` can still be driven
+    directly (tests, manual ops).
+    """
+
+    def __init__(
+        self,
+        replicas: list[FleetReplica],
+        *,
+        registry=None,
+        auth_key: bytes | None = None,
+        router_host: str = "127.0.0.1",
+        router_port: int = 0,
+        probe_interval_s: float = 0.5,
+        probe_timeout_s: float = 5.0,
+        drain_timeout_s: float = 30.0,
+        reload_poll_s: float = 2.0,
+        max_inflight_per_replica: int = 1024,
+        tracer=None,
+        trace_sample: float = 1.0,
+    ):
+        if not replicas:
+            raise ValueError("fleet needs at least one replica")
+        self.replicas = replicas
+        self.registry = registry
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.reload_poll_s = float(reload_poll_s)
+        self.tracer = tracer
+        self.router = ScoringRouter(
+            [(r.host, r.port) for r in replicas],
+            host=router_host,
+            port=router_port,
+            auth_key=auth_key,
+            probe_interval_s=probe_interval_s,
+            probe_timeout_s=probe_timeout_s,
+            max_inflight_per_replica=max_inflight_per_replica,
+            tracer=tracer,
+            trace_sample=trace_sample,
+        )
+        self.port = self.router.port
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self._manager: threading.Thread | None = None
+        self._seen: str | None = None
+        self._warned: str | None = None
+        self.reloads = 0  # completed rolling sweeps
+        self.serving_artifact: str | None = None
+
+    # --------------------------------------------------------------- control
+    def start(self) -> "ServingFleet":
+        self.router.start()
+        if self.registry is not None:
+            info = self.registry.serving_info()
+            # Prime on the artifact the replicas were BUILT from (the
+            # caller restored the current pointer); a promotion that
+            # lands between restore and here is caught by the first poll.
+            with self._lock:
+                self._seen = info["artifact"] if info else None
+                self.serving_artifact = self._seen
+            self._manager = threading.Thread(
+                target=self._manager_loop,
+                name="fedtpu-fleet-manager",
+                daemon=True,
+            )
+            self._manager.start()
+        log.info(
+            f"[FLEET] {len(self.replicas)} replica(s) behind router port "
+            f"{self.port}"
+            + (
+                f", following registry pointer ({self._seen})"
+                if self.registry is not None
+                else ""
+            )
+        )
+        return self
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._manager is not None:
+            self._manager.join(timeout=10.0)
+        self.router.close()
+        for rep in self.replicas:
+            rep.close()
+
+    def __enter__(self) -> "ServingFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        with self._lock:
+            reloads = self.reloads
+            artifact = self.serving_artifact
+        return {
+            **self.router.stats(),
+            "reloads": reloads,
+            "serving_artifact": artifact,
+            "replica_rounds": [r.round_id for r in self.replicas],
+        }
+
+    # ------------------------------------------------------- rolling reload
+    def rolling_reload(
+        self, params, *, round_id: int, artifact: str | None = None
+    ) -> dict:
+        """Drain → swap → readmit, one replica at a time. Never drains
+        the only pick-set member to zero on a single-replica fleet (the
+        swap is atomic anyway — draining the whole pick set would CAUSE
+        the drops rolling reload exists to prevent). Returns per-replica
+        timings for the caller's logs/bench."""
+        sweep: list[dict] = []
+        solo = len(self.replicas) == 1
+        for rep in self.replicas:
+            t_unix = time.time()
+            t0 = time.monotonic()
+            drained = True
+            if not solo:
+                self.router.drain(rep.replica_id)
+                drained = self.router.wait_drained(
+                    rep.replica_id, timeout=self.drain_timeout_s
+                )
+                if not drained:
+                    log.warning(
+                        f"[FLEET] replica {rep.replica_id} did not drain "
+                        f"within {self.drain_timeout_s}s; swapping anyway "
+                        "(in-flight batches finish on the old weights)"
+                    )
+            rep.adopt(params, round_id=round_id)
+            if not solo:
+                self.router.undrain(rep.replica_id)
+            dur = time.monotonic() - t0
+            sweep.append(
+                {
+                    "replica": rep.replica_id,
+                    "drained": drained,
+                    "swap_s": dur,
+                }
+            )
+            if self.tracer is not None:
+                self.tracer.record(
+                    "replica-drain",
+                    t_start=t_unix,
+                    dur_s=dur,
+                    round=round_id,
+                    replica=rep.replica_id,
+                    artifact=artifact,
+                    drained=drained,
+                )
+            if self.registry is not None and artifact is not None:
+                self.registry.record_reload(
+                    artifact, consumer=f"replica-{rep.replica_id}"
+                )
+            log.info(
+                f"[FLEET] replica {rep.replica_id} -> round {round_id}"
+                + (f" ({artifact})" if artifact else "")
+                + f" in {dur:.3f}s (drained={drained})"
+            )
+        with self._lock:
+            self.reloads += 1
+            self.serving_artifact = artifact
+        return {"replicas": sweep, "round": round_id, "artifact": artifact}
+
+    # ---------------------------------------------------------- the manager
+    def _manager_loop(self) -> None:
+        while not self._closed.wait(self.reload_poll_s):
+            try:
+                info = self.registry.serving_info()
+            except Exception as e:
+                log.warning(f"[FLEET] registry pointer read failed: {e}")
+                continue
+            with self._lock:
+                seen, warned = self._seen, self._warned
+            if info is None or info.get("artifact") == seen:
+                continue
+            aid = info["artifact"]
+            engine = self.replicas[0].engine
+            try:
+                manifest = self.registry.manifest(aid)
+                mc = manifest.get("model_config")
+                if mc is not None and mc != dataclasses.asdict(
+                    engine.model_cfg
+                ):
+                    # Not marked seen: a rollback to a compatible
+                    # artifact must still be adopted (RegistryWatcher's
+                    # contract, fleet-wide).
+                    if warned != aid:
+                        with self._lock:
+                            self._warned = aid
+                        log.warning(
+                            f"[FLEET] serving artifact {aid} declares a "
+                            "different architecture than the fleet's "
+                            "engines; skipping rolling reload (restart "
+                            "the fleet to change shapes)"
+                        )
+                    continue
+                params = self.registry.load_params(aid)
+            except Exception as e:
+                log.warning(
+                    f"[FLEET] reload of serving artifact {aid} failed "
+                    f"({type(e).__name__}: {e}); keeping the serving "
+                    "weights"
+                )
+                continue
+            self.rolling_reload(
+                params,
+                round_id=int(manifest.get("round", 0)),
+                artifact=aid,
+            )
+            with self._lock:
+                self._seen = aid
+                self._warned = None

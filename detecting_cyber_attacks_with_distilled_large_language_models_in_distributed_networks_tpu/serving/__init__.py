@@ -30,7 +30,14 @@ Layers (each its own module, composable and unit-testable):
 """
 
 from .batcher import MicroBatcher, ScoreRequest
-from .client import ScoreRejected, ScoringClient, run_load
+from .client import (
+    AsyncScoringClient,
+    PipelinedScoringClient,
+    ScoreRejected,
+    ScoringClient,
+    fetch_stats,
+    run_load,
+)
 from .engine import ScoreEngine
 from .protocol import (
     build_reject,
@@ -44,9 +51,11 @@ from .reload import CheckpointWatcher, RegistryWatcher
 from .server import ScoringServer
 
 __all__ = [
+    "AsyncScoringClient",
     "CheckpointWatcher",
     "RegistryWatcher",
     "MicroBatcher",
+    "PipelinedScoringClient",
     "ScoreEngine",
     "ScoreRejected",
     "ScoreRequest",
@@ -55,6 +64,7 @@ __all__ = [
     "build_reject",
     "build_reply",
     "build_request",
+    "fetch_stats",
     "parse_reject",
     "parse_reply",
     "parse_request",

@@ -1,12 +1,25 @@
 """Scoring-service SDK + load generator (shared by tests and bench.py).
 
-One :class:`ScoringClient` = one TCP connection with synchronous
-request/reply (``score()``); concurrency comes from many clients — which
-is exactly what makes the server's micro-batcher earn its keep: N
-concurrent connections coalesce into one padded bucket dispatch.
-:func:`run_load` spins that shape up (a thread per connection, a shared
-work queue) and reports client-observed throughput and latency
-percentiles — the numbers bench.py publishes.
+Three client shapes over the same wire:
+
+* :class:`ScoringClient` — one TCP connection, synchronous
+  request/reply (``score()``); concurrency comes from many clients —
+  which is what makes the server's micro-batcher earn its keep: N
+  concurrent connections coalesce into one padded bucket dispatch.
+* :class:`PipelinedScoringClient` — multi-request pipelining on ONE
+  connection: ``submit()`` returns a future immediately and a reader
+  thread matches replies to pending requests by the protocol's id echo.
+  Replies may arrive out of order (a deadline reject overtakes scoring;
+  a router fans one connection across replicas), which is exactly why
+  the wire carries ids instead of relying on ordering.
+* :class:`AsyncScoringClient` — the asyncio variant of the pipelined
+  shape: ``await score(...)`` from any number of concurrent tasks on
+  one connection, no threads.
+
+:func:`run_load` drives a service with any of them (closed-loop threads,
+optional pipelining depth, optional open-loop pacing at a target QPS)
+and reports client-observed throughput and latency percentiles — the
+numbers bench.py publishes.
 """
 
 from __future__ import annotations
@@ -14,6 +27,7 @@ from __future__ import annotations
 import socket
 import threading
 import time
+from concurrent.futures import Future
 from typing import Any, Mapping, Sequence
 
 import numpy as np
@@ -31,6 +45,42 @@ class ScoreRejected(Exception):
         self.code = int(code)
         self.reason = reason
         self.req_id = int(req_id)
+
+
+def _set_nodelay(sock: socket.socket) -> None:
+    """Disable Nagle on a scoring socket: the frames are small and the
+    transport writes header + payload separately (write-write-read), a
+    pattern Nagle + delayed ACK turns into per-frame stalls — visibly so
+    once a router hop doubles the TCP legs per request."""
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass
+
+
+def answer_auth_challenge(sock: socket.socket, auth_key: bytes) -> None:
+    """Client side of the scoring port's HMAC handshake: read the
+    server's NONCE challenge, answer with the keyed proof. Shared by
+    every client shape here AND the router's backend dials — the
+    handshake must not exist four times and drift."""
+    try:
+        chal = bytes(framing.recv_frame(sock, send_ack=False))
+    except (OSError, ConnectionError) as e:
+        raise WireError(
+            "server sent no auth challenge — is it running with "
+            f"--auth? ({e})"
+        ) from None
+    if len(chal) != len(NONCE_MAGIC) + NONCE_LEN or not chal.startswith(
+        NONCE_MAGIC
+    ):
+        raise WireError(
+            f"bad auth challenge from server (magic {chal[:4]!r})"
+        )
+    framing.send_frame(
+        sock,
+        protocol.build_auth_response(auth_key, chal[len(NONCE_MAGIC) :]),
+        await_ack=False,
+    )
 
 
 class ScoringClient:
@@ -52,30 +102,14 @@ class ScoringClient:
     ):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.settimeout(timeout)
+        _set_nodelay(self.sock)
         self._next_id = 0
         if auth_key is not None:
             try:
-                chal = bytes(framing.recv_frame(self.sock, send_ack=False))
-            except (OSError, ConnectionError) as e:
+                answer_auth_challenge(self.sock, auth_key)
+            except WireError:
                 self.close()
-                raise WireError(
-                    "server sent no auth challenge — is it running with "
-                    f"--auth? ({e})"
-                ) from None
-            if len(chal) != len(NONCE_MAGIC) + NONCE_LEN or not chal.startswith(
-                NONCE_MAGIC
-            ):
-                self.close()
-                raise WireError(
-                    f"bad auth challenge from server (magic {chal[:4]!r})"
-                )
-            framing.send_frame(
-                self.sock,
-                protocol.build_auth_response(
-                    auth_key, chal[len(NONCE_MAGIC) :]
-                ),
-                await_ack=False,
-            )
+                raise
 
     def score(
         self,
@@ -122,6 +156,24 @@ class ScoringClient:
             )
         return body
 
+    def stats(self) -> dict:
+        """Fetch the server's ``stats()`` snapshot over this connection
+        (the in-band probe the router's health checks ride)."""
+        self._next_id += 1
+        req_id = self._next_id
+        framing.send_frame(
+            self.sock, protocol.build_stats_request(req_id), await_ack=False
+        )
+        body = protocol.parse_stats_reply(
+            bytes(framing.recv_frame(self.sock, send_ack=False))
+        )
+        if body["id"] != req_id:
+            raise WireError(
+                f"stats reply for request {body['id']} arrived while "
+                f"awaiting {req_id}"
+            )
+        return body["stats"]
+
     def close(self) -> None:
         try:
             self.sock.close()
@@ -135,6 +187,396 @@ class ScoringClient:
         self.close()
 
 
+class PipelinedScoringClient:
+    """Multi-request pipelining on one connection.
+
+    ``submit()`` sends immediately and returns a
+    :class:`concurrent.futures.Future`; a reader thread matches replies
+    to pending requests by the protocol's id echo, so any number of
+    requests ride the wire concurrently and out-of-order replies (a
+    deadline reject overtaking scoring, a router fanning one connection
+    across replicas) resolve correctly. Thread-safe: any thread may
+    submit. A rejected request resolves its future with
+    :class:`ScoreRejected`; a dead connection fails every pending future
+    with the underlying error."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        timeout: float = 30.0,
+        auth_key: bytes | None = None,
+    ):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.settimeout(timeout)
+        _set_nodelay(self.sock)
+        if auth_key is not None:
+            try:
+                answer_auth_challenge(self.sock, auth_key)
+            except WireError:
+                self.close()
+                raise
+        self._lock = threading.Lock()  # pending map + id counter + _err
+        self._wlock = threading.Lock()  # serializes frame writes
+        self._pending: dict[int, Future] = {}
+        self._next_id = 0
+        self._err: Exception | None = None
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    # ------------------------------------------------------------ submit
+    def submit(
+        self,
+        *,
+        text: str | None = None,
+        features: Mapping[str, Any] | None = None,
+        deadline_ms: float | None = None,
+        trace: str | None = None,
+    ) -> Future:
+        with self._lock:
+            if self._err is not None:
+                raise self._err
+            self._next_id += 1
+            req_id = self._next_id
+            fut: Future = Future()
+            self._pending[req_id] = fut
+        frame = protocol.build_request(
+            req_id,
+            text=text,
+            features=features,
+            deadline_ms=deadline_ms,
+            trace=trace,
+        )
+        try:
+            with self._wlock:
+                framing.send_frame(self.sock, frame, await_ack=False)
+        except (OSError, ConnectionError) as e:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            # The reader may have raced us to the dead socket and failed
+            # this future via _fail_all already — never double-resolve.
+            if not fut.done():
+                fut.set_exception(WireError(f"send failed: {e}"))
+        return fut
+
+    def score(self, *, timeout: float | None = None, **kw) -> dict:
+        """Synchronous convenience over :meth:`submit` (one in flight)."""
+        return self.submit(**kw).result(timeout=timeout)
+
+    # ------------------------------------------------------------- reader
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                frame = bytes(
+                    framing.recv_frame(self.sock, send_ack=False)
+                )
+            except (OSError, ConnectionError, WireError) as e:
+                self._fail_all(
+                    e
+                    if isinstance(e, WireError)
+                    else WireError(f"connection lost: {e}")
+                )
+                return
+            if frame[:4] == NONCE_MAGIC:
+                self._fail_all(
+                    WireError(
+                        "server requires authentication — construct the "
+                        "client with auth_key (server runs with --auth)"
+                    )
+                )
+                return
+            try:
+                req_id = protocol.frame_id(frame)
+            except WireError as e:
+                self._fail_all(e)
+                return
+            with self._lock:
+                fut = self._pending.pop(req_id, None)
+            if fut is None:
+                continue  # reply for a send that already failed locally
+            try:
+                if protocol.is_reject(frame):
+                    body = protocol.parse_reject(frame)
+                    fut.set_exception(
+                        ScoreRejected(body["code"], body["reason"], body["id"])
+                    )
+                elif protocol.is_stats_reply(frame):
+                    fut.set_result(protocol.parse_stats_reply(frame)["stats"])
+                else:
+                    fut.set_result(protocol.parse_reply(frame))
+            except WireError as e:
+                fut.set_exception(e)
+
+    def _fail_all(self, err: Exception) -> None:
+        with self._lock:
+            if self._closed:
+                err = WireError("client closed")
+            self._err = err
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for fut in pending:
+            if not fut.done():
+                fut.set_exception(err)
+
+    # ---------------------------------------------------------------- misc
+    def stats(self, *, timeout: float | None = None) -> dict:
+        """The server's ``stats()`` snapshot, pipelined like any request."""
+        with self._lock:
+            if self._err is not None:
+                raise self._err
+            self._next_id += 1
+            req_id = self._next_id
+            fut: Future = Future()
+            self._pending[req_id] = fut
+        try:
+            with self._wlock:
+                framing.send_frame(
+                    self.sock,
+                    protocol.build_stats_request(req_id),
+                    await_ack=False,
+                )
+        except (OSError, ConnectionError) as e:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise WireError(f"send failed: {e}") from None
+        return fut.result(timeout=timeout)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+        try:
+            # shutdown() BEFORE close(): a plain close while the reader
+            # blocks in recv is deferred by CPython until the recv
+            # returns (the faults/proxy.py lesson) — the reader would
+            # sit its full socket timeout out and stall this join.
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self._reader.join(timeout=5.0)
+
+    def __enter__(self) -> "PipelinedScoringClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class AsyncScoringClient:
+    """asyncio scoring client: ``await score(...)`` from any number of
+    concurrent tasks over one connection.
+
+    The async twin of :class:`PipelinedScoringClient` — same id-matched
+    pipelining, no threads: a reader task resolves per-request futures
+    as frames arrive. Framing is re-implemented on asyncio streams in
+    fire-and-forget mode (``await_ack=False`` both directions, exactly
+    the sync protocol), including the CRC check — the transport contract
+    must not weaken because the caller went async.
+
+    Construct with ``await AsyncScoringClient.connect(host, port)``.
+    """
+
+    def __init__(self, reader, writer):
+        self._reader = reader
+        self._writer = writer
+        self._pending: dict[int, Any] = {}  # id -> asyncio.Future
+        self._next_id = 0
+        self._err: Exception | None = None
+        self._reader_task = None
+
+    # -------------------------------------------------------------- framing
+    async def _recv_frame(self) -> bytes:
+        import struct
+
+        from ..comm import native
+
+        header = await self._reader.readexactly(len(framing.FRAME_MAGIC) + 12)
+        if header[:4] != framing.FRAME_MAGIC:
+            raise WireError(f"bad frame magic {bytes(header[:4])!r}")
+        length, crc = struct.unpack("<QI", header[4:])
+        if length > framing.MAX_FRAME:
+            raise WireError(f"frame length {length} exceeds {framing.MAX_FRAME}")
+        payload = await self._reader.readexactly(length)
+        if native.crc32(payload) != crc:
+            raise WireError("frame CRC mismatch")
+        return bytes(payload)
+
+    async def _send_frame(self, payload: bytes) -> None:
+        import struct
+
+        from ..comm import native
+
+        self._writer.write(
+            framing.FRAME_MAGIC
+            + struct.pack("<QI", len(payload), native.crc32(payload))
+            + payload
+        )
+        await self._writer.drain()
+
+    # ------------------------------------------------------------- lifecycle
+    @classmethod
+    async def connect(
+        cls,
+        host: str,
+        port: int,
+        *,
+        auth_key: bytes | None = None,
+    ) -> "AsyncScoringClient":
+        import asyncio
+
+        reader, writer = await asyncio.open_connection(host, port)
+        self = cls(reader, writer)
+        if auth_key is not None:
+            chal = await self._recv_frame()
+            if len(chal) != len(NONCE_MAGIC) + NONCE_LEN or not chal.startswith(
+                NONCE_MAGIC
+            ):
+                writer.close()
+                raise WireError(
+                    f"bad auth challenge from server (magic {chal[:4]!r})"
+                )
+            await self._send_frame(
+                protocol.build_auth_response(
+                    auth_key, chal[len(NONCE_MAGIC) :]
+                )
+            )
+        self._reader_task = asyncio.ensure_future(self._read_loop())
+        return self
+
+    async def _read_loop(self) -> None:
+        import asyncio
+
+        try:
+            while True:
+                frame = await self._recv_frame()
+                if frame[:4] == NONCE_MAGIC:
+                    raise WireError(
+                        "server requires authentication — connect with "
+                        "auth_key (server runs with --auth)"
+                    )
+                req_id = protocol.frame_id(frame)
+                fut = self._pending.pop(req_id, None)
+                if fut is None or fut.done():
+                    continue
+                if protocol.is_reject(frame):
+                    body = protocol.parse_reject(frame)
+                    fut.set_exception(
+                        ScoreRejected(body["code"], body["reason"], body["id"])
+                    )
+                elif protocol.is_stats_reply(frame):
+                    fut.set_result(protocol.parse_stats_reply(frame)["stats"])
+                else:
+                    fut.set_result(protocol.parse_reply(frame))
+        except asyncio.CancelledError:
+            # close() cancelled us: awaiters blocked in score()/stats()
+            # must not hang forever on futures nobody will resolve.
+            self._fail_pending(WireError("client closed"))
+            raise
+        except (OSError, ConnectionError, WireError, EOFError) as e:
+            self._fail_pending(
+                e
+                if isinstance(e, WireError)
+                else WireError(f"connection lost: {e}")
+            )
+
+    def _fail_pending(self, err: Exception) -> None:
+        self._err = err
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(err)
+        self._pending.clear()
+
+    async def score(
+        self,
+        *,
+        text: str | None = None,
+        features: Mapping[str, Any] | None = None,
+        deadline_ms: float | None = None,
+        trace: str | None = None,
+    ) -> dict:
+        """Score one flow; safe to call from many tasks concurrently —
+        requests pipeline on the single connection and replies match by
+        id. Raises :class:`ScoreRejected` on an explicit reject."""
+        import asyncio
+
+        if self._err is not None:
+            raise self._err
+        self._next_id += 1
+        req_id = self._next_id
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        try:
+            await self._send_frame(
+                protocol.build_request(
+                    req_id,
+                    text=text,
+                    features=features,
+                    deadline_ms=deadline_ms,
+                    trace=trace,
+                )
+            )
+        except BaseException:
+            self._pending.pop(req_id, None)  # never leak the entry
+            raise
+        return await fut
+
+    async def stats(self) -> dict:
+        import asyncio
+
+        if self._err is not None:
+            raise self._err
+        self._next_id += 1
+        req_id = self._next_id
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        try:
+            await self._send_frame(protocol.build_stats_request(req_id))
+        except BaseException:
+            self._pending.pop(req_id, None)  # never leak the entry
+            raise
+        return await fut
+
+    async def close(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            try:
+                await self._reader_task
+            except BaseException:
+                pass
+        self._fail_pending(WireError("client closed"))
+        try:
+            self._writer.close()
+            await self._writer.wait_closed()
+        except (OSError, ConnectionError):
+            pass
+
+    async def __aenter__(self) -> "AsyncScoringClient":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+
+def fetch_stats(
+    host: str,
+    port: int,
+    *,
+    timeout: float = 10.0,
+    auth_key: bytes | None = None,
+) -> dict:
+    """One-shot ``stats()`` fetch: dial, (auth,) probe, close. The ops
+    convenience behind ``fedtpu route``'s status logging and tests."""
+    with ScoringClient(
+        host, port, timeout=timeout, auth_key=auth_key
+    ) as cli:
+        return cli.stats()
+
+
 def run_load(
     host: str,
     port: int,
@@ -145,13 +587,30 @@ def run_load(
     deadline_ms: float | None = None,
     timeout: float = 60.0,
     auth_key: bytes | None = None,
+    pipeline: int = 1,
+    target_qps: float | None = None,
 ) -> dict:
-    """Closed-loop load generator: ``concurrency`` connections, each
-    scoring the next text round-robin until ``requests`` total (default:
-    one pass over ``texts``) have been answered. Returns client-observed
-    stats: flows/s, p50/p95/p99 ms, reject count, per-reply batch sizes
-    (the coalescing evidence tests assert on)."""
+    """Load generator: ``concurrency`` connections scoring the next text
+    round-robin until ``requests`` total (default: one pass over
+    ``texts``) have been answered. Returns client-observed stats:
+    flows/s, p50/p95/p99 ms, reject count, per-reply batch sizes (the
+    coalescing evidence tests assert on).
+
+    ``pipeline`` > 1 keeps that many requests in flight PER CONNECTION
+    (:class:`PipelinedScoringClient`) — the closed loop stops being
+    bounded by one round-trip per connection. ``target_qps`` switches to
+    open-loop pacing: requests are issued on a fixed fleet-wide schedule
+    (request i not before ``t0 + i/target_qps``) regardless of how fast
+    replies come back, which is how you measure a latency distribution
+    AT a load point instead of the closed loop's self-throttled
+    equilibrium; pacing implies pipelining (a paced sender must not
+    block on the previous reply)."""
     total = len(texts) if requests is None else int(requests)
+    pipeline = max(1, int(pipeline))
+    if target_qps is not None:
+        if target_qps <= 0:
+            raise ValueError(f"target_qps={target_qps} must be > 0")
+        pipeline = max(pipeline, 32)  # pacing must not block on replies
     idx = iter(range(total))
     idx_lock = threading.Lock()
     latencies: list[float] = []
@@ -159,31 +618,89 @@ def run_load(
     rejects = [0]
     errors: list[Exception] = []
     out_lock = threading.Lock()
+    t_sched = time.monotonic()
+
+    def worker_sync() -> None:
+        with ScoringClient(
+            host, port, timeout=timeout, auth_key=auth_key
+        ) as cli:
+            while True:
+                with idx_lock:
+                    i = next(idx, None)
+                if i is None:
+                    return
+                t0 = time.monotonic()
+                try:
+                    reply = cli.score(
+                        text=texts[i % len(texts)], deadline_ms=deadline_ms
+                    )
+                except ScoreRejected:
+                    with out_lock:
+                        rejects[0] += 1
+                    continue
+                dt = time.monotonic() - t0
+                with out_lock:
+                    latencies.append(dt)
+                    batch_sizes.append(int(reply["batch_size"]))
+
+    def worker_pipelined() -> None:
+        import collections
+
+        def on_done(fut, t0) -> None:
+            # Runs on the reader thread AT resolution — the latency is
+            # send -> reply, not send -> whenever-the-sender-drained.
+            dt = time.monotonic() - t0
+            try:
+                reply = fut.result()
+            except ScoreRejected:
+                with out_lock:
+                    rejects[0] += 1
+                return
+            except Exception:
+                return  # surfaced by the drain's result() below
+            with out_lock:
+                latencies.append(dt)
+                batch_sizes.append(int(reply["batch_size"]))
+
+        def drain(fut) -> None:
+            # Backpressure + error surfacing only; recording happened in
+            # the done-callback.
+            try:
+                fut.result(timeout=timeout)
+            except ScoreRejected:
+                pass
+
+        with PipelinedScoringClient(
+            host, port, timeout=timeout, auth_key=auth_key
+        ) as cli:
+            window: collections.deque = collections.deque()
+            while True:
+                with idx_lock:
+                    i = next(idx, None)
+                if i is None:
+                    break
+                if target_qps is not None:
+                    # Fleet-wide schedule: request i fires at i/qps.
+                    delay = (t_sched + i / target_qps) - time.monotonic()
+                    if delay > 0:
+                        time.sleep(delay)
+                t0 = time.monotonic()
+                fut = cli.submit(
+                    text=texts[i % len(texts)], deadline_ms=deadline_ms
+                )
+                fut.add_done_callback(lambda f, t0=t0: on_done(f, t0))
+                window.append(fut)
+                while len(window) >= pipeline:
+                    drain(window.popleft())
+            while window:
+                drain(window.popleft())
 
     def worker() -> None:
         try:
-            with ScoringClient(
-                host, port, timeout=timeout, auth_key=auth_key
-            ) as cli:
-                while True:
-                    with idx_lock:
-                        i = next(idx, None)
-                    if i is None:
-                        return
-                    t0 = time.monotonic()
-                    try:
-                        reply = cli.score(
-                            text=texts[i % len(texts)],
-                            deadline_ms=deadline_ms,
-                        )
-                    except ScoreRejected:
-                        with out_lock:
-                            rejects[0] += 1
-                        continue
-                    dt = time.monotonic() - t0
-                    with out_lock:
-                        latencies.append(dt)
-                        batch_sizes.append(int(reply["batch_size"]))
+            if pipeline > 1:
+                worker_pipelined()
+            else:
+                worker_sync()
         except Exception as e:  # surface worker crashes to the caller
             with out_lock:
                 errors.append(e)
@@ -211,6 +728,8 @@ def run_load(
         "rejected": rejects[0],
         "wall_s": wall,
         "flows_per_sec": len(latencies) / wall,
+        "target_qps": target_qps,
+        "pipeline": pipeline,
         "mean_batch": float(np.mean(batch_sizes)) if batch_sizes else 0.0,
         "max_batch": max(batch_sizes, default=0),
         "batch_sizes": batch_sizes,

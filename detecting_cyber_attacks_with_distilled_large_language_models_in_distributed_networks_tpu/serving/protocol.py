@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import hmac
 import json
+import re
 from typing import Any, Mapping
 
 from ..comm.wire import (
@@ -29,6 +30,8 @@ from ..comm.wire import (
     SCORE_REJ_MAGIC,
     SCORE_REP_MAGIC,
     SCORE_REQ_MAGIC,
+    SCORE_STAT_MAGIC,
+    SCORE_STATR_MAGIC,
     WireError,
 )
 
@@ -171,6 +174,120 @@ def parse_reject(frame: bytes) -> dict:
 
 def is_reject(frame: bytes) -> bool:
     return bytes(frame[:4]) == SCORE_REJ_MAGIC
+
+
+# ------------------------------------------------------------------- stats
+def build_stats_request(req_id: int) -> bytes:
+    """In-band telemetry probe: ask the server for its ``stats()``
+    snapshot on this connection. Rides the ordinary request stream (same
+    socket, same auth), which is what makes it the router's health probe:
+    a replica that answers probes is a replica that answers requests."""
+    return _build(SCORE_STAT_MAGIC, {"id": int(req_id)})
+
+
+def parse_stats_request(frame: bytes) -> dict:
+    body = _parse(frame, SCORE_STAT_MAGIC, "stats request")
+    if not isinstance(body.get("id"), int) or isinstance(body["id"], bool):
+        raise WireError("stats request id must be an integer")
+    return body
+
+
+def is_stats_request(frame: bytes) -> bool:
+    return bytes(frame[:4]) == SCORE_STAT_MAGIC
+
+
+def is_request(frame: bytes) -> bool:
+    """Magic sniff only — the router's hot path routes on this plus
+    :func:`frame_id`, leaving full body validation to the replica (which
+    answers a malformed body with a 400 reject, so a hostile client
+    cannot poison the shared router->replica connection)."""
+    return bytes(frame[:4]) == SCORE_REQ_MAGIC
+
+
+def build_stats_reply(req_id: int, stats: Mapping[str, Any]) -> bytes:
+    return _build(
+        SCORE_STATR_MAGIC, {"id": int(req_id), "stats": dict(stats)}
+    )
+
+
+def parse_stats_reply(frame: bytes) -> dict:
+    body = _parse(frame, SCORE_STATR_MAGIC, "stats reply")
+    if not isinstance(body.get("id"), int) or isinstance(body["id"], bool):
+        raise WireError("stats reply id must be an integer")
+    if not isinstance(body.get("stats"), dict):
+        raise WireError("stats reply must carry a stats object")
+    return body
+
+
+def is_stats_reply(frame: bytes) -> bool:
+    return bytes(frame[:4]) == SCORE_STATR_MAGIC
+
+
+# ---------------------------------------------------------------- id remap
+#: Frame types whose JSON body carries the correlating ``id`` field —
+#: everything the router forwards or answers.
+_ID_MAGICS = (
+    SCORE_REQ_MAGIC,
+    SCORE_REP_MAGIC,
+    SCORE_REJ_MAGIC,
+    SCORE_STAT_MAGIC,
+    SCORE_STATR_MAGIC,
+)
+
+#: The canonical leading-``id`` shape every builder in this module
+#: emits: ``MAGIC{"id":<int>,...`` — the id remap's fast path matches it
+#: at the fixed position (anchored right after the magic), so the
+#: router's hot path is a byte splice, not a parse+re-encode of the
+#: whole body. A frame whose id is NOT at the canonical position (a
+#: foreign builder, hostile input) falls back to the full JSON parse —
+#: same result, just slower; correctness never rides the fast path.
+_LEAD_ID_RE = re.compile(rb'^\{"id":(-?\d+)')
+
+
+def frame_id(frame: bytes) -> int:
+    """The correlating request id of any scoring frame (request, reply,
+    reject, stats) without full per-type validation — what the router's
+    reply path matches pending requests on."""
+    magic = bytes(frame[:4])
+    if magic not in _ID_MAGICS:
+        raise WireError(f"not an id-correlated scoring frame ({magic!r})")
+    window = bytes(frame[4:40])
+    m = _LEAD_ID_RE.match(window)
+    if m and m.end(1) < len(window):  # digit run terminated in-window
+        return int(m.group(1))
+    body = _parse(frame, magic, "scoring")
+    rid = body.get("id")
+    if not isinstance(rid, int) or isinstance(rid, bool):
+        raise WireError("scoring frame id must be an integer")
+    return rid
+
+
+def rewrite_id(frame: bytes, new_id: int) -> bytes:
+    """Re-address a scoring frame to a different request id (the body is
+    otherwise untouched). The router multiplexes many client connections
+    onto one backend connection, so client-chosen ids collide — each
+    forwarded request gets a router-minted id, and the matching reply is
+    rewritten back. The fast path splices the canonical leading id in
+    place — every other body byte is preserved EXACTLY, so a rewritten
+    reply's ``prob`` is bit-identical to the replica's original; the
+    JSON fallback preserves it too (doubles round-trip bit-for-bit
+    through ``json.loads``/``dumps``)."""
+    frame = bytes(frame)
+    magic = frame[:4]
+    if magic not in _ID_MAGICS:
+        raise WireError(f"not an id-correlated scoring frame ({magic!r})")
+    window = frame[4:40]
+    m = _LEAD_ID_RE.match(window)
+    if m and m.end(1) < len(window):  # digit run terminated in-window
+        return (
+            frame[:4]
+            + b'{"id":'
+            + str(int(new_id)).encode()
+            + frame[4 + m.end(1) :]
+        )
+    body = _parse(frame, magic, "scoring")
+    body["id"] = int(new_id)
+    return _build(magic, body)
 
 
 # -------------------------------------------------------------------- auth

@@ -141,6 +141,7 @@ class ScoringServer:
         score_bins: int = 10,
         tracer=None,
         trace_sample: float = 1.0,
+        replica_id: int | None = None,
     ):
         if not 0.0 < float(trace_sample) <= 1.0:
             raise ValueError(
@@ -164,6 +165,9 @@ class ScoringServer:
                 f"largest engine bucket {engine.buckets[-1]}"
             )
         self.watcher = watcher
+        # Fleet identity (router/): stamped into stats() so a probe can
+        # tell WHICH replica answered; None = standalone deployment.
+        self.replica_id = None if replica_id is None else int(replica_id)
         self.default_deadline_s = default_deadline_s
         self.idle_tick_s = float(idle_tick_s)
         self.metrics_jsonl = metrics_jsonl
@@ -318,6 +322,7 @@ class ScoringServer:
             else {"p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0}
         )
         return {
+            "replica": self.replica_id,
             "scored": scored,
             "batches": batches,
             "mean_batch": scored / batches if batches else 0.0,
@@ -340,6 +345,15 @@ class ScoringServer:
                 conn, _addr = self._sock.accept()
             except OSError:
                 return  # listener closed
+            try:
+                # Scoring frames are small and the transport writes
+                # header + payload separately (write-write-read): Nagle
+                # + delayed ACK turns that into per-frame stalls under
+                # multi-hop (router) deployments. Latency beats batching
+                # bytes here.
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
             with self._conn_lock:
                 self._conns.add(conn)
             t = threading.Thread(
@@ -372,11 +386,39 @@ class ScoringServer:
                     # noise), the client sees EOF and reconnects.
                     log.warning(f"[SERVE] dropping connection: {e}")
                     return
+                fb = bytes(frame)
+                if protocol.is_stats_request(fb):
+                    # In-band telemetry probe (router health checks, ops
+                    # tooling): answered from the reader thread — a probe
+                    # must not queue behind scoring work, its whole point
+                    # is to answer while the scorer is busy.
+                    try:
+                        sbody = protocol.parse_stats_request(fb)
+                    except WireError as e:
+                        log.warning(f"[SERVE] dropping connection: {e}")
+                        return
+                    writer.send(
+                        protocol.build_stats_reply(sbody["id"], self.stats())
+                    )
+                    continue
                 try:
-                    body = protocol.parse_request(bytes(frame))
+                    body = protocol.parse_request(fb)
                 except WireError as e:
-                    log.warning(f"[SERVE] dropping connection: {e}")
-                    return
+                    # Framing was intact (we got a whole frame) — if the
+                    # body still names an id, answer an explicit 400
+                    # instead of dropping: on a ROUTER connection many
+                    # clients share this socket, and one client's
+                    # malformed body must not sever everyone's.
+                    try:
+                        bad_id = protocol.frame_id(fb)
+                    except WireError:
+                        log.warning(f"[SERVE] dropping connection: {e}")
+                        return
+                    self._count_reject("bad_request")
+                    writer.send(
+                        protocol.build_reject(bad_id, code=400, reason=str(e))
+                    )
+                    continue
                 req_id = body["id"]  # parse_request pinned the type
                 req_trace = body.get("trace")
                 reject = self._make_reject(writer, req_id)

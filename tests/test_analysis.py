@@ -502,6 +502,93 @@ def test_baseline_suppresses_and_reports_stale(tmp_path):
     assert len(result.stale_baseline) == 1
 
 
+def test_prune_baseline_rewrites_minus_stale_only(tmp_path, capsys):
+    """`fedtpu check --prune-baseline`: stale entries are REMOVED from
+    the baseline file, live entries and the review comment survive, and
+    a re-run against the pruned baseline is clean with zero stale."""
+    root = _mini_tree(
+        tmp_path,
+        {
+            "faults/proxy.py": """
+                import time
+
+                def stamp():
+                    return time.time()
+            """
+        },
+    )
+    finding = _findings(root, ["determinism"])[0]
+    baseline = tmp_path / "BASELINE.json"
+    live_entry = {
+        "rule": finding.rule,
+        "path": finding.path,
+        "message": finding.message,
+        "reason": "fixture",
+    }
+    baseline.write_text(
+        json.dumps(
+            {
+                "comment": "review note must survive the prune",
+                "findings": [
+                    live_entry,
+                    {
+                        "rule": "determinism",
+                        "path": "faults/gone.py",
+                        "message": "no longer fires",
+                        "reason": "stale entry",
+                    },
+                    {
+                        "rule": "determinism",
+                        "path": "faults/also_gone.py",
+                        "message": "also gone",
+                        "reason": "second stale entry",
+                    },
+                ],
+            }
+        )
+    )
+    args = argparse.Namespace(
+        root=root,
+        rules="determinism",
+        baseline=str(baseline),
+        prune_baseline=True,
+        json=False,
+        list_rules=False,
+    )
+    assert cmd_check(args) == 0
+    out = capsys.readouterr().out
+    assert "pruned 2 stale baseline entries" in out
+    data = json.loads(baseline.read_text())
+    assert data["comment"] == "review note must survive the prune"
+    assert data["findings"] == [live_entry]
+    # The pruned baseline stays clean: still suppresses the live
+    # finding, reports ZERO stale.
+    result = run_check(
+        root, rules=["determinism"], baseline_path=str(baseline)
+    )
+    assert result.exit_code == 0
+    assert len(result.baselined) == 1
+    assert result.stale_baseline == []
+    # A second prune is a no-op (removes 0).
+    assert cmd_check(args) == 0
+    assert "pruned 0 stale baseline entries" in capsys.readouterr().out
+    assert json.loads(baseline.read_text())["findings"] == [live_entry]
+
+
+def test_prune_baseline_without_file_errors(tmp_path, capsys):
+    root = _mini_tree(tmp_path, {"comm/a.py": "X = 1\n"})
+    args = argparse.Namespace(
+        root=root,
+        rules="determinism",
+        baseline=None,
+        prune_baseline=True,
+        json=False,
+        list_rules=False,
+    )
+    assert cmd_check(args) == 2
+    assert "no baseline file" in capsys.readouterr().err
+
+
 def test_baseline_entry_without_reason_rejected(tmp_path):
     baseline = tmp_path / "BASELINE.json"
     baseline.write_text(

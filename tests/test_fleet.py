@@ -492,6 +492,132 @@ def test_aggregate_tree_validates_groups(rng):
         aggregate_tree([_leaves(rng)], None, [])
     with pytest.raises(ValueError, match="non-empty"):
         aggregate_tree([_leaves(rng)], None, [[0], []])
+    with pytest.raises(ValueError, match="non-empty"):
+        # Nested subtrees validate at every depth.
+        aggregate_tree([_leaves(rng)], None, [[[0], []]])
+
+
+def test_aggregate_tree_nested_depth3_replay(rng):
+    """The nested-groups replay (a relay whose parent is another relay):
+    a depth-3 tree folds bottom-up, each fold the exact weighted
+    ``aggregate_flat`` over its children, and the depth-2 call shape is
+    byte-for-byte what it always was."""
+    n = 8
+    models = [_leaves(rng, n=3, shape=(8, 3)) for _ in range(n)]
+    weights = [float(w) for w in rng.integers(1, 9, size=n)]
+    tree = [[[0, 1], [2, 3]], [[4, 5], [6, 7]]]
+    got = aggregate_tree(models, weights, tree)
+    # Manual bottom-up replay with aggregate_flat.
+    lows, lmass = [], []
+    for g in ([0, 1], [2, 3], [4, 5], [6, 7]):
+        ws = [weights[i] for i in g]
+        lows.append(aggregate_flat([models[i] for i in g], ws))
+        lmass.append(sum(ws))
+    mids = [
+        aggregate_flat(lows[:2], lmass[:2]),
+        aggregate_flat(lows[2:], lmass[2:]),
+    ]
+    want = aggregate_flat(
+        mids, [lmass[0] + lmass[1], lmass[2] + lmass[3]]
+    )
+    assert wire.flat_crc32(got) == wire.flat_crc32(want)
+    # Depth-2 shape: the classic groups call is arithmetic-identical to
+    # composing the same groups as one-level subtrees.
+    groups = [[0, 1, 2, 3], [4, 5, 6, 7]]
+    d2 = aggregate_tree(models, weights, groups)
+    p0 = aggregate_flat([models[i] for i in groups[0]],
+                        [weights[i] for i in groups[0]])
+    p1 = aggregate_flat([models[i] for i in groups[1]],
+                        [weights[i] for i in groups[1]])
+    d2_want = aggregate_flat(
+        [p0, p1],
+        [sum(weights[i] for i in groups[0]),
+         sum(weights[i] for i in groups[1])],
+    )
+    assert wire.flat_crc32(d2) == wire.flat_crc32(d2_want)
+    # Bare int leaves may sit next to subtrees at any level.
+    mixed = aggregate_tree(models, weights, [0, [1, 2], 3])
+    inner = aggregate_flat([models[1], models[2]], weights[1:3])
+    mixed_want = aggregate_flat(
+        [models[0], inner, models[3]],
+        [weights[0], weights[1] + weights[2], weights[3]],
+    )
+    assert wire.flat_crc32(mixed) == wire.flat_crc32(mixed_want)
+
+
+@pytest.mark.slow
+def test_relay_depth3_live_bit_exact_vs_nested_replay(rng):
+    """A LIVE 3-level loopback round — 8 clients under 4 leaf relays
+    under 2 mid relays under one weighted root (a relay's parent IS
+    another relay; the wire composes) — crc-pinned bit-exact against the
+    depth-3 ``aggregate_tree`` replay, with every client receiving the
+    root aggregate."""
+    n_clients, n_leaf, n_mid = 8, 4, 2
+    n_samples = {i: int(w) for i, w in enumerate(
+        rng.integers(1, 9, size=n_clients)
+    )}
+    models = [_leaves(rng, n=3, shape=(16, 3)) for _ in range(n_clients)]
+    chunk = 1 << 10
+    results: dict[int, dict] = {}
+    root_aggs: list[dict] = []
+    with AggregationServer(
+        port=0, num_clients=n_mid, weighted=True, timeout=60,
+        stream_chunk_bytes=chunk,
+    ) as root:
+        mids = [
+            RelayAggregator(
+                "127.0.0.1", 0, parent_host="127.0.0.1",
+                parent_port=root.port, relay_id=m, num_clients=2,
+                timeout=60, stream_chunk_bytes=chunk,
+            )
+            for m in range(n_mid)
+        ]
+        leafs = [
+            RelayAggregator(
+                "127.0.0.1", 0, parent_host="127.0.0.1",
+                parent_port=mids[r // 2].port, relay_id=r % 2,
+                num_clients=2, timeout=60, stream_chunk_bytes=chunk,
+            )
+            for r in range(n_leaf)
+        ]
+        try:
+            rt = threading.Thread(
+                target=lambda: root_aggs.append(root.serve_round()),
+                daemon=True,
+            )
+            rt.start()
+            for rel in mids + leafs:
+                threading.Thread(
+                    target=rel.serve, args=(1,), daemon=True
+                ).start()
+            clients = {
+                cid: FederatedClient(
+                    "127.0.0.1", leafs[cid // 2].port, client_id=cid,
+                    timeout=60,
+                )
+                for cid in range(n_clients)
+            }
+            results, errors = _run_clients(
+                clients, models, n_samples=n_samples
+            )
+            rt.join(timeout=90)
+            assert not errors, errors
+        finally:
+            for rel in mids + leafs:
+                rel.close()
+    weights = [float(n_samples[i]) for i in range(n_clients)]
+    tree = [[[0, 1], [2, 3]], [[4, 5], [6, 7]]]
+    want = aggregate_tree(models, weights, tree)
+    assert len(root_aggs) == 1 and root_aggs[0] is not None
+    assert wire.flat_crc32(root_aggs[0]) == wire.flat_crc32(want)
+    for cid in range(n_clients):
+        assert wire.flat_crc32(results[cid]) == wire.flat_crc32(want)
+    # Sanity: the depth-3 replay differs from flat all-N by reduction-
+    # order ulps only.
+    flat_ref = aggregate_flat(models, weights)
+    for k in want:
+        np.testing.assert_allclose(want[k], flat_ref[k], rtol=1e-5,
+                                   atol=1e-6)
 
 
 # ---------------------------------------------------- server fleet plumbing

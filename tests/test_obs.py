@@ -452,3 +452,129 @@ def test_wire_overlap_span_and_timeline_row(tmp_path):
     table = timeline_table(spans)
     assert "wire-overlap" in table and "folded during the wire phase" in table
     assert "batch-prefetch" in table
+
+
+# --------------------------------------------------------- live tailing
+def test_tail_spans_follows_appends_and_new_files(tmp_path):
+    """ISSUE 9 satellite: the follow-mode reader yields spans as they
+    are APPENDED — pre-existing spans only under from_start, files that
+    appear mid-tail picked up from their start, foreign/partial lines
+    skipped."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs import (
+        tail_spans,
+    )
+
+    d = tmp_path / "tail"
+    d.mkdir()
+    pre = Tracer(str(d / "pre.jsonl"), proc="early")
+    pre.record("round", t_start=1.0, dur_s=0.5, trace="aa", round=1)
+
+    got: list[dict] = []
+    stop_at = [8]
+
+    def collect(**kw):
+        for rec in tail_spans(
+            trace_dir=str(d), poll_s=0.05,
+            stop=lambda: len(got) >= stop_at[0], **kw
+        ):
+            got.append(rec)
+
+    # Without from_start: the pre-existing span is NOT replayed.
+    stop_at[0] = 2
+    t = threading.Thread(target=collect, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    pre.record("agg", t_start=2.0, dur_s=0.1, trace="aa", round=1)
+    late = Tracer(str(d / "late.jsonl"), proc="late")  # appears mid-tail
+    late.record("router-forward", t_start=3.0, dur_s=0.01, replica=0)
+    with open(d / "pre.jsonl", "a") as f:
+        f.write('{"not": "a span"}\n')  # foreign line: skipped
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert {r["span"] for r in got} == {"agg", "router-forward"}
+    # With from_start: history replays first.
+    got.clear()
+    stop_at[0] = 3
+    t = threading.Thread(
+        target=collect, kwargs={"from_start": True}, daemon=True
+    )
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert {r["span"] for r in got} == {"round", "agg", "router-forward"}
+    # Per-file append order is preserved (cross-file order is by name).
+    pre_spans = [r["span"] for r in got if r["proc"] == "early"]
+    assert pre_spans == ["round", "agg"]
+
+
+def test_obs_cli_tail_filters_and_format(tmp_path, capsys):
+    """`fedtpu obs tail`: one line per span with proc/span/duration,
+    --round and --trace-id filters applied, bounded by --max-seconds;
+    an empty directory is NOT an error (tailing it is the point)."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli.parser import (
+        main,
+    )
+
+    d = tmp_path / "tailcli"
+    d.mkdir()
+    t = Tracer(str(d / "s.jsonl"), proc="server")
+    t.record("round", t_start=1.0, dur_s=0.5, trace="aa", round=1)
+    t.record("agg", t_start=2.0, dur_s=0.25, trace="aa", round=1)
+    t.record("replica-drain", t_start=3.0, dur_s=0.1, round=2, replica=1)
+    assert (
+        main(
+            [
+                "obs", "tail", "--trace-dir", str(d), "--from-start",
+                "--max-seconds", "0.3", "--poll", "0.05",
+            ]
+        )
+        == 0
+    )
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    assert len(lines) == 3
+    assert "server" in lines[0] and "round" in lines[0]
+    assert "trace=aa" in lines[1]
+    assert "replica=1" in lines[2] and "replica-drain" in lines[2]
+    # --round filter
+    assert (
+        main(
+            [
+                "obs", "tail", "--trace-dir", str(d), "--from-start",
+                "--round", "2", "--max-seconds", "0.3", "--poll", "0.05",
+            ]
+        )
+        == 0
+    )
+    lines = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.strip()
+    ]
+    assert len(lines) == 1 and "replica-drain" in lines[0]
+    # --trace-id filter
+    assert (
+        main(
+            [
+                "obs", "tail", "--trace-dir", str(d), "--from-start",
+                "--trace-id", "aa", "--max-seconds", "0.3", "--poll",
+                "0.05",
+            ]
+        )
+        == 0
+    )
+    lines = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.strip()
+    ]
+    assert len(lines) == 2
+    # An empty dir tails cleanly (no spans yet — not an error).
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert (
+        main(
+            [
+                "obs", "tail", "--trace-dir", str(empty),
+                "--max-seconds", "0.2", "--poll", "0.05",
+            ]
+        )
+        == 0
+    )
+    assert capsys.readouterr().out.strip() == ""
