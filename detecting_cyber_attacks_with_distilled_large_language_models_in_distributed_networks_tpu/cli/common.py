@@ -45,8 +45,19 @@ def _obs_setup(
     so the inner trainer's fallback hook must stay disarmed — a
     --seq-parallel client's embedded fedseq trainer would otherwise emit
     a SECOND client-local span per round and double the timeline's
-    compute attribution."""
-    from ..obs import Tracer, maybe_start_metrics_server, set_global_tracer
+    compute attribution.
+
+    ``--flight-dir`` (or obs.flight_dir) additionally installs the
+    process failure flight recorder (obs/flight.py): the daemon keeps a
+    bounded ring of recent spans and dumps a postmortem bundle there on
+    round failure / replica eject storm / SLO page."""
+    from ..obs import (
+        FlightRecorder,
+        Tracer,
+        maybe_start_metrics_server,
+        set_global_recorder,
+        set_global_tracer,
+    )
     from ..obs.trace import set_run_id
 
     obs_cfg = cfg.obs if cfg is not None else None
@@ -66,6 +77,31 @@ def _obs_setup(
     # commands per process; a stale global tracer would keep appending to
     # a dead path).
     set_global_tracer(tracer if install_global else None)
+    flight_dir = getattr(args, "flight_dir", None) or (
+        obs_cfg.flight_dir if obs_cfg else None
+    )
+    recorder = None
+    if flight_dir:
+        recorder = FlightRecorder(
+            flight_dir,
+            proc=proc,
+            ring=obs_cfg.flight_ring if obs_cfg else 256,
+            # The bundle's config section: what this process was
+            # actually running with — the first thing a postmortem
+            # reader checks against their expectations.
+            config={
+                "proc": proc,
+                **({"experiment": cfg.to_dict()} if cfg is not None else {}),
+            },
+            tracer=tracer,
+        )
+        log.info(
+            f"[OBS] {proc}: flight recorder armed, postmortem bundles "
+            f"-> {flight_dir}"
+        )
+    # Same unconditional rule as the tracer: clear a previous in-process
+    # invocation's recorder when this one doesn't ask for one.
+    set_global_recorder(recorder)
     port = getattr(args, "metrics_port", None) or (
         obs_cfg.metrics_port if obs_cfg else 0
     )

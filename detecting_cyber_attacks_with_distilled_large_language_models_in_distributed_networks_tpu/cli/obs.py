@@ -1,4 +1,4 @@
-"""fedtpu obs — merge per-process span JSONLs into round timelines.
+"""fedtpu obs — timelines, live tailing, fleet health, postmortems.
 
 The read side of the obs/ subsystem: every tier (server, clients,
 controller, registry, infer-serve) appends spans to its own events-JSONL
@@ -12,6 +12,16 @@ controller, registry, infer-serve) appends spans to its own events-JSONL
     fedtpu obs tail --trace-dir runs/obs --round 3
         # live follow mode: one line per span as processes append them
         # (--trace-id/--round filter; --from-start replays history first)
+    fedtpu obs health --target serve=127.0.0.1:9100 \\
+                      --target route=127.0.0.1:9102
+        # one scrape pass over every daemon's /metrics.json + the SLO
+        # burn-rate verdicts, rendered as a one-screen fleet view
+        # (--slo FILE for custom objectives, --alerts-jsonl /
+        # --snapshot-jsonl to persist alerts + fleet snapshots)
+    fedtpu obs watch --target ... --interval 2
+        # the live-refresh twin (`health --watch` is the same loop)
+    fedtpu obs postmortem --flight-dir runs/flight [--bundle NAME]
+        # list flight-recorder bundles / inspect one (--json full dump)
 """
 
 from __future__ import annotations
@@ -21,9 +31,16 @@ import sys
 import time
 
 from ..obs import (
+    ScrapeHub,
+    Tracer,
+    default_slos,
     export_chrome_trace,
+    list_bundles,
+    load_bundle,
     load_spans,
+    parse_target,
     round_summaries,
+    slos_from_spec,
     tail_spans,
     timeline_table,
 )
@@ -82,7 +99,148 @@ def _cmd_tail(args, paths, trace_dir) -> int:
     return 0
 
 
+def _build_hub(args) -> ScrapeHub:
+    specs = getattr(args, "target", None) or []
+    if not specs:
+        raise SystemExit(
+            "fedtpu obs health/watch needs at least one "
+            "--target TIER=HOST:PORT[,events=PATH] (the daemon's "
+            "--metrics-port endpoint; /metrics.json is served there)"
+        )
+    try:
+        targets = [parse_target(s) for s in specs]
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    slos = None
+    slo_path = getattr(args, "slo", None)
+    if slo_path:
+        with open(slo_path) as f:
+            spec = json.load(f)
+        try:
+            slos = slos_from_spec(spec)
+        except (TypeError, ValueError) as e:
+            raise SystemExit(f"--slo {slo_path}: {e}") from None
+    else:
+        slos = default_slos()
+    tracer = None
+    if getattr(args, "trace_jsonl", None):
+        tracer = Tracer(args.trace_jsonl, proc="obs-hub")
+    recorder = None
+    if getattr(args, "flight_dir", None):
+        # The hub is where SLO evaluation actually happens, so the hub
+        # is where a page-severity fire can dump a postmortem — the
+        # daemons' own recorders live in other processes and never
+        # learn of the page.
+        from ..obs import FlightRecorder
+
+        recorder = FlightRecorder(
+            args.flight_dir, proc="obs-hub", tracer=tracer
+        )
+    try:
+        return ScrapeHub(
+            targets,
+            slos=slos,
+            alerts_jsonl=getattr(args, "alerts_jsonl", None),
+            snapshot_jsonl=getattr(args, "snapshot_jsonl", None),
+            scrape_timeout_s=getattr(args, "scrape_timeout", None) or 2.0,
+            tracer=tracer,
+            recorder=recorder,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+
+
+def _cmd_health(args) -> int:
+    """One scrape pass (or the --watch loop) + the fleet status screen."""
+    hub = _build_hub(args)
+    if getattr(args, "watch", False) or args.action == "watch":
+        hub.watch(
+            interval_s=getattr(args, "interval", None) or 2.0,
+            max_seconds=getattr(args, "max_seconds", None),
+        )
+        return 0
+    # TWO spaced polls, not one: burn rates and round cadence are
+    # DELTAS of cumulative counters — a single scrape has no baseline,
+    # so a one-shot pass could never report a firing SLO and the
+    # cron-able exit code would only ever detect down targets.
+    hub.poll()
+    time.sleep(getattr(args, "interval", None) or 2.0)
+    snapshot = hub.poll()
+    if getattr(args, "json", False):
+        json.dump(snapshot, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(hub.render_status(snapshot))
+    firing = sum(1 for s in snapshot["slo"] if s["firing"])
+    down = sum(1 for t in snapshot["targets"] if not t["up"])
+    # Exit code is the health verdict (cron-able): 0 healthy, 1 not.
+    return 1 if (firing or down) else 0
+
+
+def _cmd_postmortem(args) -> int:
+    """List/inspect flight-recorder bundles."""
+    flight_dir = getattr(args, "flight_dir", None)
+    if not flight_dir:
+        raise SystemExit("fedtpu obs postmortem needs --flight-dir DIR")
+    bundle_name = getattr(args, "bundle", None)
+    if bundle_name:
+        import os
+
+        path = (
+            bundle_name
+            if os.path.sep in bundle_name
+            else os.path.join(flight_dir, bundle_name)
+        )
+        b = load_bundle(path)
+        if b is None:
+            raise SystemExit(f"no readable postmortem bundle at {path}")
+        if getattr(args, "json", False):
+            json.dump(b, sys.stdout, indent=2)
+            sys.stdout.write("\n")
+            return 0
+        ts = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(b["ts"]))
+        print(f"bundle   {path}")
+        print(f"proc     {b['proc']}")
+        print(f"reason   {b['reason']}  ({ts})")
+        if b.get("extra"):
+            print(f"context  {json.dumps(b['extra'])}")
+        alerts = b.get("alerts") or []
+        print(f"alerts   {len(alerts)}")
+        for a in alerts[-5:]:
+            print(
+                f"  {a.get('event')} {a.get('slo')} on "
+                f"{a.get('instance')} burn={a.get('burn')}"
+            )
+        spans = b.get("spans") or []
+        print(f"spans    {len(spans)} (newest last)")
+        for s in spans[-10:]:
+            print("  " + _tail_line(s))
+        return 0
+    bundles = list_bundles(flight_dir)
+    if getattr(args, "json", False):
+        json.dump(bundles, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        return 0
+    if not bundles:
+        print(f"(no postmortem bundles under {flight_dir})")
+        return 0
+    for b in bundles:
+        ts = time.strftime(
+            "%Y-%m-%d %H:%M:%S", time.localtime(b["ts"] or 0)
+        )
+        print(
+            f"{ts}  {b['proc']:<12} {b['reason']:<16} "
+            f"{b['spans']:>4} span(s) {b['alerts']:>3} alert(s)  "
+            f"{b['name']}"
+        )
+    return 0
+
+
 def cmd_obs(args) -> int:
+    if args.action in ("health", "watch"):
+        return _cmd_health(args)
+    if args.action == "postmortem":
+        return _cmd_postmortem(args)
     paths = list(getattr(args, "trace", None) or [])
     trace_dir = getattr(args, "trace_dir", None)
     if not paths and not trace_dir:

@@ -110,6 +110,22 @@ def cmd_export_config(args) -> int:
 
 
 # ------------------------------------------------------------------ parser
+def _add_flight_dir(p: argparse.ArgumentParser) -> None:
+    """The daemons' shared failure-flight-recorder flag (serve | relay |
+    infer-serve | route | fleet | controller)."""
+    p.add_argument(
+        "--flight-dir",
+        default=None,
+        help="arm the failure flight recorder (obs/flight.py): keep a "
+        "bounded in-memory ring of recent spans and dump a postmortem "
+        "bundle (ring + config + /metrics snapshot) to this directory "
+        "on round failure, replica eject storm, or scoring-dispatch "
+        "failure; SLO pages dump from the process that evaluates them "
+        "— `fedtpu obs health|watch --flight-dir`. Inspect with "
+        "`fedtpu obs postmortem`",
+    )
+
+
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--config", help="JSON config file (ExperimentConfig.to_dict shape)")
     p.add_argument(
@@ -438,6 +454,7 @@ def build_parser() -> argparse.ArgumentParser:
         "history. Post-noise deltas are DP outputs; persisting them "
         "costs no privacy",
     )
+    _add_flight_dir(p)
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
@@ -510,6 +527,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="Prometheus /metrics for this relay's round engine "
         "(0 = off, the default)",
     )
+    _add_flight_dir(p)
     p.set_defaults(fn=cmd_relay)
 
     p = sub.add_parser(
@@ -751,6 +769,7 @@ def build_parser() -> argparse.ArgumentParser:
         "carries sampled_batches so the timeline can re-scale). Default "
         "1.0 = every batch, the pre-sampling behavior",
     )
+    _add_flight_dir(p)
     p.set_defaults(fn=cmd_infer_serve)
 
     p = sub.add_parser(
@@ -816,6 +835,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="Prometheus /metrics: per-replica in-flight gauges, eject and "
         "forward counters (0 = off, the default)",
     )
+    _add_flight_dir(p)
     p.set_defaults(fn=cmd_route)
 
     p = sub.add_parser(
@@ -885,6 +905,7 @@ def build_parser() -> argparse.ArgumentParser:
         default=0,
         help="Prometheus /metrics for the router + replicas (0 = off)",
     )
+    _add_flight_dir(p)
     p.set_defaults(fn=cmd_fleet)
 
     p = sub.add_parser(
@@ -1011,6 +1032,7 @@ def build_parser() -> argparse.ArgumentParser:
         "artifact and its rollback chain are never pruned); default: "
         "keep everything",
     )
+    _add_flight_dir(p)
     p.set_defaults(fn=cmd_controller)
 
     p = sub.add_parser(
@@ -1091,15 +1113,24 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser(
         "obs",
-        help="observability: merge per-process span JSONLs into a "
-        "per-round timeline table or a Chrome trace-event export",
+        help="observability: round timelines, Chrome export, live span "
+        "tailing, fleet health (SLO burn alerts), postmortem bundles",
         epilog="Every tier writes spans with --trace-jsonl; the server "
         "stamps one trace id per round into its replies, so the merged "
         "files agree on (trace, round). `timeline` attributes each "
         "round's wall-clock to per-client compute / straggler wait / "
-        "wire / agg; `export` writes chrome://tracing JSON.",
+        "wire / agg; `export` writes chrome://tracing JSON. `health` "
+        "scrapes every --target daemon's /metrics.json, evaluates the "
+        "SLO burn rates, and renders the one-screen fleet view (`watch` "
+        "= the live-refresh loop); `postmortem` lists/inspects the "
+        "flight recorder's failure bundles (--flight-dir).",
     )
-    p.add_argument("action", choices=["timeline", "export", "tail"])
+    p.add_argument(
+        "action",
+        choices=[
+            "timeline", "export", "tail", "health", "watch", "postmortem",
+        ],
+    )
     p.add_argument(
         "--trace-dir",
         help="directory of span JSONLs (every *.jsonl is merged; tail "
@@ -1145,9 +1176,74 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--json",
         action="store_true",
-        help="timeline as machine-readable JSON instead of the table",
+        help="machine-readable JSON instead of the rendered output "
+        "(timeline/health/postmortem)",
     )
     p.add_argument("--out", help="output path (export)")
+    p.add_argument(
+        "--target",
+        action="append",
+        metavar="TIER=HOST:PORT[,events=PATH]",
+        help="health/watch: a daemon's /metrics.json endpoint to scrape "
+        "(repeatable; TIER in serve|relay|controller|infer-serve|route|"
+        "fleet names the lane; events=PATH additionally tails that "
+        "process's span JSONL for drift/postmortem state)",
+    )
+    p.add_argument(
+        "--slo",
+        help="health/watch: JSON file of SLO objects (obs/slo.py SLO "
+        "fields) replacing the built-in fleet objectives",
+    )
+    p.add_argument(
+        "--alerts-jsonl",
+        help="health/watch: append burn-alert fire/clear events here "
+        "(one atomic JSON line each)",
+    )
+    p.add_argument(
+        "--snapshot-jsonl",
+        help="health/watch: append one merged fleet snapshot record "
+        "per poll here, keyed by (tier, instance)",
+    )
+    p.add_argument(
+        "--watch",
+        action="store_true",
+        help="health: live-refresh loop instead of one pass (same as "
+        "the watch action)",
+    )
+    p.add_argument(
+        "--interval",
+        type=float,
+        default=None,
+        help="watch: seconds between scrape passes; health: spacing of "
+        "the one-shot pass's two polls — burn rates and cadence are "
+        "counter DELTAS, so one scrape has no baseline (default 2)",
+    )
+    p.add_argument(
+        "--scrape-timeout",
+        type=float,
+        default=None,
+        help="health/watch: per-target scrape timeout seconds "
+        "(default 2); a slower daemon is marked DOWN, never blocks "
+        "the screen",
+    )
+    p.add_argument(
+        "--trace-jsonl",
+        help="health/watch: append the hub's own slo-eval spans here",
+    )
+    p.add_argument(
+        "--flight-dir",
+        help="postmortem: the flight-recorder bundle directory the "
+        "daemons were started with (--flight-dir on serve/relay/"
+        "controller/infer-serve/route/fleet); health/watch: ALSO arm "
+        "the hub's own recorder there, so a page-severity SLO fire "
+        "dumps a postmortem bundle (the hub is the process that "
+        "evaluates SLOs — daemon recorders never learn of a page)",
+    )
+    p.add_argument(
+        "--bundle",
+        help="postmortem: inspect this bundle (name from the list, or "
+        "a path) instead of listing",
+    )
     p.set_defaults(fn=cmd_obs)
 
     p = sub.add_parser(

@@ -32,6 +32,7 @@ from typing import Any
 
 import numpy as np
 
+from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
 from ..obs.trace import new_trace_id
 from ..utils.logging import get_logger
@@ -470,6 +471,12 @@ class AggregationServer:
             )
             for p in ("wait", "agg", "reply")
         }
+        self._h_round = m.histogram(
+            "fedtpu_server_round_seconds",
+            help="aggregation round wall-clock, failed rounds included "
+            "(the round-duration SLO's burn-rate source, obs/slo.py)",
+            buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0),
+        )
 
     # ------------------------------------------------------------- lifecycle
     def close(self) -> None:
@@ -2621,9 +2628,11 @@ class AggregationServer:
         round's streaming stats into the cross-round totals (plus the
         ``wire-overlap`` span when any fold overlapped the wire), and
         emit the round span."""
+        round_wall = time.monotonic() - t0
         for name, dur in (("wait", wait_s), ("agg", agg_s), ("reply", reply_s)):
             self.phase_seconds[name] += dur
             self._m_phase[name].inc(max(dur, 0.0))
+        self._h_round.observe(max(round_wall, 0.0))
         if failed:
             self._m_round_failures.inc()
         if rnd.stream is not None:
@@ -2661,11 +2670,33 @@ class AggregationServer:
             self.tracer.record(
                 "round",
                 t_start=t_unix,
-                dur_s=time.monotonic() - t0,
+                dur_s=round_wall,
                 trace=rnd.trace,
                 round=rnd.round_no,
                 failed=True if failed else None,
             )
+        if failed:
+            # Flight recorder (obs/flight.py): a failed round is exactly
+            # the moment whose surrounding spans + metric state an
+            # operator wants preserved. After the round span above so
+            # the bundle's ring includes the failure itself. Rate-
+            # limited; never fatal to the round path.
+            recorder = obs_flight.get_global_recorder()
+            if recorder is not None:
+                try:
+                    recorder.maybe_dump(
+                        "round-failure",
+                        extra={
+                            "round": rnd.round_no,
+                            "trace": rnd.trace,
+                            "expected": rnd.expected,
+                            "wall_s": round(round_wall, 3),
+                        },
+                    )
+                except OSError as e:
+                    log.warning(
+                        f"[SERVER] postmortem dump failed (non-fatal): {e}"
+                    )
 
     def _encode_reply(self, agg: dict, meta: dict, nonce: str | None) -> bytes:
         """One reply blob, auth-aware (echoes the client's nonce with

@@ -604,6 +604,13 @@ class ObsConfig:
     #: Round-scoped spans (round/agg/wire-*) are never sampled — they
     #: are one-per-round by construction.
     trace_sample: float = 1.0
+    #: Failure flight recorder (obs/flight.py): postmortem bundles land
+    #: in this directory on round failure / eject storm / SLO page.
+    #: None (default) = recorder off — no ring, no hot-path cost. The
+    #: matching CLI flag is ``--flight-dir``.
+    flight_dir: str | None = None
+    #: Span-ring depth the flight recorder retains per process.
+    flight_ring: int = 256
 
     def __post_init__(self) -> None:
         if not 0 <= self.metrics_port <= 65535:
@@ -614,6 +621,10 @@ class ObsConfig:
         if not 0.0 < self.trace_sample <= 1.0:
             raise ValueError(
                 f"trace_sample={self.trace_sample} must be in (0, 1]"
+            )
+        if self.flight_ring < 1:
+            raise ValueError(
+                f"flight_ring={self.flight_ring} must be >= 1"
             )
 
 

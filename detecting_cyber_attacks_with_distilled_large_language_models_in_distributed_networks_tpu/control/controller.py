@@ -238,6 +238,21 @@ class Controller:
                 self.stats.drift_triggers += 1
                 self._m_drift_triggers.inc()
                 self._record("drift_trigger", **verdict)
+                if self.tracer is not None:
+                    # No (trace, round) yet — the round this verdict
+                    # starts hasn't minted one; the round index links
+                    # them. top_bins is the PSI localization: WHICH
+                    # score region moved (control/drift.py).
+                    self.tracer.record(
+                        "drift-trigger",
+                        t_start=time.time(),
+                        dur_s=0.0,
+                        round=self._next_round,
+                        drift=verdict["drift"],
+                        method=verdict["method"],
+                        scores=verdict["scores"],
+                        top_bins=verdict.get("top_bins"),
+                    )
                 return "drift"
             if (
                 c.max_interval_s is not None

@@ -50,16 +50,62 @@ def _fractions(counts: Any) -> np.ndarray:
     return c / total
 
 
-def psi(expected: Any, observed: Any) -> float:
-    """Population stability index between two count histograms (same
-    binning). 0 = identical; > 0.25 = significant shift (classic bound)."""
+def _psi_terms(expected: Any, observed: Any) -> tuple:
+    """(per-bin PSI terms, clipped e fractions, clipped o fractions) —
+    the ONE copy of the clip/renormalize/term arithmetic both
+    :func:`psi` (their sum) and :func:`psi_contributions` (their
+    ranking) are defined over, so the localization decomposes the
+    reported distance EXACTLY by construction."""
     e = np.clip(_fractions(expected), _EPS, None)
     o = np.clip(_fractions(observed), _EPS, None)
     if e.shape != o.shape:
         raise ValueError(f"bin count mismatch: {e.shape} vs {o.shape}")
     # Renormalize after clipping so both still sum to 1.
     e, o = e / e.sum(), o / o.sum()
-    return float(np.sum((o - e) * np.log(o / e)))
+    return (o - e) * np.log(o / e), e, o
+
+
+def psi(expected: Any, observed: Any) -> float:
+    """Population stability index between two count histograms (same
+    binning). 0 = identical; > 0.25 = significant shift (classic bound)."""
+    terms, _, _ = _psi_terms(expected, observed)
+    return float(np.sum(terms))
+
+
+def psi_contributions(
+    expected: Any, observed: Any, *, top_k: int = 3
+) -> list[dict]:
+    """Per-bin PSI localization: WHICH score region moved.
+
+    PSI is a sum of per-bin terms ``(o_i - e_i) * ln(o_i / e_i)`` (each
+    >= 0 after the clipping both :func:`psi` and this function apply),
+    so the bins sorted by term ARE the drift's location. A page that
+    says "PSI 0.4" sends the operator histogram-diffing; one that says
+    "bin 9 (the top score decile) holds 80% of the shift" says a new
+    attack family is scoring hot — the ROADMAP's drift-localization
+    residual. Ties break toward the lower bin index (deterministic
+    output for identical inputs).
+
+    Returns the ``top_k`` bins as ``{"bin": i, "psi": term,
+    "expected_frac": e_i, "observed_frac": o_i}``, largest term first,
+    zero-contribution bins omitted. Built on the SAME ``_psi_terms``
+    arithmetic as :func:`psi`, so ``sum(term over ALL bins) == psi()``
+    exactly by construction.
+    """
+    terms, e, o = _psi_terms(expected, observed)
+    order = sorted(
+        range(terms.size), key=lambda i: (-terms[i], i)
+    )[: max(int(top_k), 0)]
+    return [
+        {
+            "bin": int(i),
+            "psi": round(float(terms[i]), 6),
+            "expected_frac": round(float(e[i]), 6),
+            "observed_frac": round(float(o[i]), 6),
+        }
+        for i in order
+        if terms[i] > 0.0
+    ]
 
 
 def ks_distance(expected: Any, observed: Any) -> float:
@@ -183,24 +229,14 @@ class DriftMonitor:
         return self.check()
 
     def _ingest_jsonl(self) -> None:
-        try:
-            size = os.path.getsize(self.jsonl_path)
-        except OSError:
-            return
-        if size < self._offset:
-            self._offset = 0  # file truncated/rotated: start over
-        if size == self._offset:
-            return
-        with open(self.jsonl_path, "rb") as f:
-            f.seek(self._offset)
-            chunk = f.read(size - self._offset)
-        # Only complete lines; a partially-flushed record waits for the
-        # next poll (the writer appends whole lines, so the split is safe).
-        end = chunk.rfind(b"\n")
-        if end < 0:
-            return
-        self._offset += end + 1
-        for line in chunk[: end + 1].splitlines():
+        # Shared incremental tail (obs/timeline.py): complete lines
+        # only, truncation restarts at 0, missing file is empty.
+        from ..obs.timeline import read_new_jsonl_lines
+
+        self._offset, lines = read_new_jsonl_lines(
+            self.jsonl_path, self._offset
+        )
+        for line in lines:
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
@@ -236,10 +272,22 @@ class DriftMonitor:
             "method": self.method,
             "threshold": self.threshold,
             "scores": n,
+            # Localization: the top per-bin PSI contributions (computed
+            # regardless of the verdict method — PSI's additive terms
+            # are the localization; KS's max-gap is not decomposable).
+            "top_bins": psi_contributions(self._ref, self._obs),
         }
+        top = verdict["top_bins"]
+        where = (
+            ", ".join(
+                f"bin {b['bin']} ({b['psi']:.3f})" for b in top
+            )
+            if top
+            else "no single bin dominates"
+        )
         log.info(
             f"[DRIFT] {self.method}={d:.4f} >= {self.threshold} over {n} "
-            "live scores — triggering a training round"
+            f"live scores — triggering a training round (moved: {where})"
         )
         self.reset_window()
         return verdict

@@ -1,16 +1,30 @@
-"""Cross-tier observability: round tracing, metrics registry, timelines.
+"""Cross-tier observability: tracing, metrics, timelines, fleet health.
 
-Three pieces (see each module's docstring):
+Six pieces (see each module's docstring):
 
 * :mod:`.trace` — round-scoped trace contexts with span ids propagated
   across the TCP wire protocols via an optional meta field; every
   process appends spans to a unified events-JSONL.
 * :mod:`.metrics` — in-process counters/gauges/histograms exposed over a
-  stdlib-HTTP ``/metrics`` endpoint in Prometheus text format.
+  stdlib-HTTP ``/metrics`` endpoint in Prometheus text format, plus the
+  machine-readable ``/metrics.json`` twin.
 * :mod:`.timeline` — the ``fedtpu obs`` merge/analysis layer: per-round
   timeline tables and Chrome trace-event export.
+* :mod:`.slo` — declarative SLOs evaluated as multi-window burn rates
+  over metric-snapshot deltas, with fire/clear alert state machines.
+* :mod:`.fleet` — the scrape hub behind ``fedtpu obs health|watch``:
+  poll every daemon, merge into fleet snapshots, judge the SLOs.
+* :mod:`.flight` — the failure flight recorder: bounded in-memory rings
+  dumped as postmortem bundles on round failure / eject storm / SLO page.
 """
 
+from .flight import (  # noqa: F401
+    FlightRecorder,
+    get_global_recorder,
+    list_bundles,
+    load_bundle,
+    set_global_recorder,
+)
 from .metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -19,6 +33,17 @@ from .metrics import (  # noqa: F401
     MetricsServer,
     default_registry,
     maybe_start_metrics_server,
+)
+from .slo import (  # noqa: F401
+    SLO,
+    AlertManager,
+    default_slos,
+    slos_from_spec,
+)
+from .fleet import (  # noqa: F401
+    ScrapeHub,
+    Target,
+    parse_target,
 )
 from .timeline import (  # noqa: F401
     chrome_trace,

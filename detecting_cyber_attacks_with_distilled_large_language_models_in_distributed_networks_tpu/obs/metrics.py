@@ -24,6 +24,24 @@ from typing import Iterable, Mapping
 
 _INF = float("inf")
 
+#: Schema tag on every ``/metrics.json`` body, so the scrape hub can
+#: reject (or version-switch on) foreign JSON documents.
+SNAPSHOT_SCHEMA = "fedtpu-metrics-v1"
+
+
+def _parse_label_str(label_str: str) -> dict[str, str]:
+    """Invert :func:`_label_str` for snapshot(): the registry memoizes
+    children on the rendered label string, so the machine-readable twin
+    recovers the mapping from it (values never contain quotes here — the
+    registry's own call sites use plain identifiers)."""
+    if not label_str:
+        return {}
+    out: dict[str, str] = {}
+    for part in label_str[1:-1].split(","):
+        k, _, v = part.partition("=")
+        out[k] = v.strip('"')
+    return out
+
 
 def _fmt(v: float) -> str:
     """Prometheus float formatting: integers without the trailing .0."""
@@ -188,6 +206,54 @@ class MetricsRegistry:
         )
 
     # ------------------------------------------------------------- rendering
+    def snapshot(self) -> dict:
+        """Machine-readable registry state (the ``/metrics.json`` body and
+        the scrape hub's input): one JSON-able dict, no text-format parser
+        needed on the consuming side. Histogram buckets are CUMULATIVE
+        ``[edge_str, count]`` pairs ending at ``"+Inf"`` — the same
+        numbers the Prometheus rendering exposes, so the two endpoints
+        can never disagree."""
+        with self._lock:
+            families = {
+                name: (
+                    fam["type"],
+                    fam["help"],
+                    dict(fam["children"]),
+                )
+                for name, fam in sorted(self._families.items())
+            }
+        out: dict[str, dict] = {}
+        for name, (kind, help_text, children) in families.items():
+            samples: list[dict] = []
+            for label_str, metric in sorted(children.items()):
+                labels = _parse_label_str(label_str)
+                if kind == "histogram":
+                    edges, counts, total, n = metric.snapshot()
+                    cum = 0
+                    buckets: list[list] = []
+                    for edge, c in zip(edges + (_INF,), counts):
+                        cum += c
+                        buckets.append([_fmt(edge), cum])
+                    samples.append(
+                        {
+                            "labels": labels,
+                            "buckets": buckets,
+                            "sum": total,
+                            "count": n,
+                        }
+                    )
+                else:
+                    samples.append(
+                        {"labels": labels, "value": metric.value}
+                    )
+            out[name] = {"type": kind, "help": help_text, "samples": samples}
+        return {"schema": SNAPSHOT_SCHEMA, "families": out}
+
+    def render_json(self) -> str:
+        import json
+
+        return json.dumps(self.snapshot())
+
     def render(self) -> str:
         """Prometheus text exposition format (version 0.0.4)."""
         lines: list[str] = []
@@ -238,14 +304,21 @@ class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry  # set per server class below
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib API name)
-        if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics.json":
+            # The machine-readable twin (obs/fleet.py scrape hub, tests):
+            # same numbers as the text rendering, no exposition-format
+            # parser needed on the consuming side.
+            body = self.registry.render_json().encode()
+            ctype = "application/json"
+        elif path in ("/metrics", "/"):
+            body = self.registry.render().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        else:
             self.send_error(404)
             return
-        body = self.registry.render().encode()
         self.send_response(200)
-        self.send_header(
-            "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
-        )
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)

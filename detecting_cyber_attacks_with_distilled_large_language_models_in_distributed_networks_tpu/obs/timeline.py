@@ -69,6 +69,33 @@ def load_spans(
     return spans
 
 
+def read_new_jsonl_lines(
+    path: str, offset: int
+) -> tuple[int, list[bytes]]:
+    """Incremental complete-line tail of one JSONL file: read from
+    ``offset``, return ``(new_offset, complete line bytes)``. The one
+    copy of the byte-offset resume pattern the drift monitor
+    (control/drift.py) and the scrape hub's events tail (obs/fleet.py)
+    both poll with: a missing file is empty (not an error), truncation/
+    rotation restarts at 0, and a partially-flushed trailing line waits
+    for the next poll (writers append whole lines atomically)."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return offset, []
+    if size < offset:
+        offset = 0  # file truncated/rotated: start over
+    if size == offset:
+        return offset, []
+    with open(path, "rb") as f:
+        f.seek(offset)
+        chunk = f.read(size - offset)
+    end = chunk.rfind(b"\n")
+    if end < 0:
+        return offset, []
+    return offset + end + 1, chunk[: end + 1].splitlines()
+
+
 def tail_spans(
     paths: Iterable[str] | None = None,
     *,
@@ -300,6 +327,9 @@ def timeline_table(
                 "relay-forward",
                 "router-forward",
                 "replica-drain",
+                "slo-eval",
+                "postmortem-dump",
+                "drift-trigger",
             )
         ]
         for s in extra:
@@ -311,6 +341,29 @@ def timeline_table(
             out.append(
                 f"  slowest span: {sl['span']} on {sl['proc']} "
                 f"({sl['dur_s']:.3f}s)"
+            )
+        out.append("")
+    # Health-plane spans carry NO (trace, round) by construction — the
+    # hub's slo-eval poll and a flight-recorder dump happen outside any
+    # round's identity — so they live in the (None, None) group the
+    # per-round rendering above excludes. Surface the notable ones in a
+    # trailing section (newest last, capped) instead of dropping them.
+    unscoped = [
+        s
+        for s in groups.get((None, None), ())
+        if s["span"] in ("postmortem-dump", "drift-trigger", "slo-eval")
+    ]
+    if unscoped and round_filter is None:
+        out.append("unscoped health-plane spans:")
+        for s in unscoped[-10:]:
+            attrs = " ".join(
+                f"{k}={s[k]}"
+                for k in ("reason", "bundle", "drift", "firing", "up")
+                if s.get(k) is not None
+            )
+            out.append(
+                f"  {s['span']:<16} {s['dur_s']:>8.3f}s  "
+                f"({s.get('proc')})" + (f"  {attrs}" if attrs else "")
             )
         out.append("")
     if not out:

@@ -47,6 +47,14 @@ Span vocabulary (names are the contract the timeline tool groups by)::
     replica-drain one replica's drain -> hot-swap -> readmit cycle of a
                   rolling fleet reload (router/fleet.py), with
                   ``replica``/``artifact``/``drained``
+    slo-eval      one scrape-hub pass over the fleet's /metrics.json +
+                  burn-rate evaluation (obs/fleet.py), with ``targets``/
+                  ``up``/``firing``/``scrape_lag_ms``
+    postmortem-dump  a flight-recorder bundle write (obs/flight.py),
+                  with ``reason``/``bundle``/``spans``
+    drift-trigger the controller's drift verdict that started a round
+                  (control/controller.py), with the distance, method,
+                  and ``top_bins`` per-bin PSI localization
 
 Timestamps are wall-clock unix seconds (``ts``) with a separately
 measured monotonic duration (``dur_s``): cross-process correlation needs
@@ -61,6 +69,8 @@ import threading
 import time
 from contextlib import contextmanager
 from typing import Any, Iterator
+
+from .flight import get_global_recorder
 
 #: Every span record carries this so stream consumers can reject (or
 #: version-switch on) foreign JSONL lines when files get concatenated.
@@ -82,6 +92,9 @@ SPAN_NAMES = (
     "serve-batch",
     "router-forward",
     "replica-drain",
+    "slo-eval",
+    "postmortem-dump",
+    "drift-trigger",
 )
 
 #: Wire meta key the trace id rides under (comm/server.py reply meta,
@@ -194,6 +207,12 @@ class Tracer:
             if v is not None:
                 rec[k] = v
         append_jsonl_line(self.path, json.dumps(rec))
+        # Flight recorder tap (obs/flight.py): every traced process
+        # keeps its recent spans in the postmortem ring for free — one
+        # deque append when a recorder is installed, nothing otherwise.
+        recorder = get_global_recorder()
+        if recorder is not None:
+            recorder.note_span(rec)
         return rec
 
     @contextmanager

@@ -66,6 +66,7 @@ from typing import Sequence
 
 from ..comm import framing
 from ..comm.wire import NONCE_LEN, NONCE_MAGIC, WireError
+from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
 from ..serving import protocol
 from ..serving.client import _set_nodelay, answer_auth_challenge
@@ -132,6 +133,8 @@ class ScoringRouter:
         max_inflight_per_replica: int = 1024,
         tracer=None,
         trace_sample: float = 1.0,
+        eject_storm_n: int = 3,
+        eject_storm_window_s: float = 60.0,
     ):
         if not backends:
             raise ValueError("router needs at least one backend")
@@ -159,6 +162,13 @@ class ScoringRouter:
         self._stats_lock = threading.Lock()
         self._forwarded = 0
         self._rejects = {"no_replica": 0, "replica_lost": 0, "auth": 0}
+        # Eject-storm detection (obs/flight.py): N ejects across the
+        # fleet inside the window dumps ONE postmortem bundle — a dying
+        # backend host shows up as a burst of ejects long before any
+        # operator reads the counters.
+        self._eject_storm_n = max(1, int(eject_storm_n))
+        self._eject_storm_window_s = float(eject_storm_window_s)
+        self._eject_times: list[float] = []
         m = obs_metrics.default_registry()
         self._m_forwarded = m.counter(
             "fedtpu_router_forwarded_total",
@@ -651,6 +661,30 @@ class ScoringRouter:
             f"[ROUTER] ejected replica {rep.replica_id} ({rep.addr}): "
             f"{reason}; {len(dropped)} in-flight request(s) shed"
         )
+        now = time.monotonic()
+        with self._stats_lock:
+            self._eject_times.append(now)
+            cutoff = now - self._eject_storm_window_s
+            self._eject_times = [t for t in self._eject_times if t >= cutoff]
+            in_window = len(self._eject_times)
+            storm = in_window >= self._eject_storm_n
+        if storm:
+            recorder = obs_flight.get_global_recorder()
+            if recorder is not None:
+                try:
+                    recorder.maybe_dump(
+                        "eject-storm",
+                        extra={
+                            "ejects_in_window": in_window,
+                            "window_s": self._eject_storm_window_s,
+                            "replica": rep.replica_id,
+                            "reason": reason,
+                        },
+                    )
+                except OSError as e:
+                    log.warning(
+                        f"[ROUTER] postmortem dump failed (non-fatal): {e}"
+                    )
 
     def _count_reject(self, kind: str) -> None:
         with self._stats_lock:

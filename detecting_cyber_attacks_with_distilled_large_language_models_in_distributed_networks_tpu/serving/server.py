@@ -40,6 +40,7 @@ import numpy as np
 from ..comm import framing
 from ..comm.wire import WireError
 from ..data.textualize import render_row
+from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
 from ..utils.logging import get_logger
 from . import protocol
@@ -581,17 +582,29 @@ class ScoringServer:
                     # must show in stats()/JSONL, not just client-side.
                     self._count_reject("error")
                     r.reject(500, f"scoring failed: {type(e).__name__}")
+                # Flight recorder (obs/flight.py): a failed dispatch IS
+                # the scoring tier's 3 a.m. moment — `infer-serve
+                # --flight-dir` preserves the surrounding spans + metric
+                # state (rate-limited; never fatal to the batch loop).
+                recorder = obs_flight.get_global_recorder()
+                if recorder is not None:
+                    try:
+                        recorder.maybe_dump(
+                            "scoring-error",
+                            extra={
+                                "error": f"{type(e).__name__}: {e}"[:300],
+                                "rejected": len(live),
+                                "bucket_batch": len(live),
+                            },
+                        )
+                    except OSError as dump_err:
+                        log.warning(
+                            "[SERVE] postmortem dump failed "
+                            f"(non-fatal): {dump_err}"
+                        )
                 continue
             done = time.monotonic()
             n = len(live)
-            for r, p in zip(live, probs):
-                r.reply(
-                    prob=float(p),
-                    round_id=round_id,
-                    batch_size=n,
-                    bucket=bucket,
-                    queue_ms=(now - r.t_enqueue) * 1e3,
-                )
             # The batch's score-distribution histogram: the drift signal
             # (control/drift.py) — binned counts, never raw scores, so the
             # JSONL stays small under any traffic volume.
@@ -600,6 +613,11 @@ class ScoringServer:
                 bins=self._hist_edges,
             )
             queue_depth = self.batcher.qsize()
+            # Accumulate BEFORE replying: a synchronous client that got
+            # its reply may probe stats() immediately, and every flow it
+            # was answered for must already be counted — replying first
+            # opens a window where scored/score_hist lag the last reply
+            # (seen as a rare co-tenancy flake in the histogram test).
             with self._stats_lock:
                 self._scored += n
                 self._batches += 1
@@ -613,6 +631,14 @@ class ScoringServer:
             self._g_round.set(round_id)
             for r in live:
                 self._h_queue_ms.observe(now - r.t_enqueue)
+            for r, p in zip(live, probs):
+                r.reply(
+                    prob=float(p),
+                    round_id=round_id,
+                    batch_size=n,
+                    bucket=bucket,
+                    queue_ms=(now - r.t_enqueue) * 1e3,
+                )
             if self.tracer is not None and (
                 # Counter-stride sampling: batch 1, 1+stride, 1+2*stride,
                 # ... (self._batches was already incremented above, so

@@ -1,0 +1,756 @@
+"""Fleet health plane (ISSUE 11): SLO burn-rate fire/clear state
+machines over synthetic histogram deltas (no sleeps), the live loopback
+multi-daemon scrape hub, the failure flight recorder's dump-on-failure
+contract, drift localization, and the `fedtpu obs health|postmortem`
+CLIs.
+
+All host-side (sockets + stdlib HTTP + JSONL) — no JAX programs — so the
+whole module stays in the fast lane.
+"""
+
+import json
+import os
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.server import (
+    AggregationServer,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.control.drift import (
+    DriftMonitor,
+    psi,
+    psi_contributions,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs import (
+    SLO,
+    AlertManager,
+    FlightRecorder,
+    MetricsRegistry,
+    MetricsServer,
+    ScrapeHub,
+    Target,
+    Tracer,
+    default_slos,
+    list_bundles,
+    load_bundle,
+    parse_target,
+    set_global_recorder,
+    slos_from_spec,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs.flight import (
+    BUNDLE_SCHEMA,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs.slo import (
+    extract_bad_total,
+)
+
+
+def _latency_families(good: int, bad: int) -> dict:
+    """A fedtpu_server_round_seconds snapshot: ``good`` observations at
+    or under the 0.5 s edge, ``bad`` above it (cumulative buckets, the
+    obs/metrics.py snapshot shape)."""
+    total = good + bad
+    return {
+        "fedtpu_server_round_seconds": {
+            "type": "histogram",
+            "help": "",
+            "samples": [
+                {
+                    "labels": {},
+                    "buckets": [
+                        ["0.1", 0],
+                        ["0.5", good],
+                        ["5", total],
+                        ["+Inf", total],
+                    ],
+                    "sum": 1.0,
+                    "count": total,
+                }
+            ],
+        }
+    }
+
+
+def _ratio_families(bad: int, total: int) -> dict:
+    return {
+        "fedtpu_server_stream_fallbacks_total": {
+            "type": "counter",
+            "help": "",
+            "samples": [{"labels": {}, "value": bad}],
+        },
+        "fedtpu_server_uploads_total": {
+            "type": "counter",
+            "help": "",
+            "samples": [{"labels": {}, "value": total}],
+        },
+    }
+
+
+_SLO = SLO(
+    name="round-duration",
+    metric="fedtpu_server_round_seconds",
+    kind="latency",
+    le=0.5,
+    objective=0.9,
+    windows=((120.0, 6.0), (30.0, 6.0)),
+)
+
+
+# ------------------------------------------------- burn-rate state machine
+def test_burn_alert_fires_and_clears_on_synthetic_deltas(tmp_path):
+    """The acceptance state machine, sleep-free: cumulative snapshots in,
+    fire when EVERY window breaches, clear when the short window drains;
+    fire/clear land on the alerts-JSONL as atomic JSON lines."""
+    sink = tmp_path / "alerts.jsonl"
+    am = AlertManager((_SLO,), sink_path=str(sink))
+    am.ingest(_latency_families(good=5, bad=0), now=0.0)
+    assert am.evaluate(now=0.0) == []  # single point: no delta, no burn
+    # 4 bad events inside both windows: bad_frac 1.0 / budget 0.1 = 10x.
+    am.ingest(_latency_families(good=5, bad=4), now=10.0)
+    events = am.evaluate(now=10.0)
+    assert [e["event"] for e in events] == ["fire"]
+    assert events[0]["slo"] == "round-duration"
+    assert events[0]["severity"] == "page"
+    assert all(v >= 6.0 for v in events[0]["burn"].values())
+    assert am.fired_total == 1
+    # Still firing while the short window holds bad events: no new event.
+    am.ingest(_latency_families(good=5, bad=5), now=20.0)
+    assert am.evaluate(now=20.0) == []
+    # 40s later: fresh good traffic only inside the 30s window -> clear
+    # (the long window still remembers the burn; clear is short-window).
+    am.ingest(_latency_families(good=20, bad=5), now=60.0)
+    events = am.evaluate(now=60.0)
+    assert [e["event"] for e in events] == ["clear"]
+    assert am.cleared_total == 1
+    lines = [json.loads(ln) for ln in sink.read_text().splitlines()]
+    assert [r["event"] for r in lines] == ["fire", "clear"]
+    assert all(r["schema"] == "fedtpu-alert-v1" for r in lines)
+
+
+def test_burn_alert_needs_every_window_to_breach():
+    """Multi-window AND: a burst that already left the short window must
+    NOT fire (that is the whole point of the two-window pattern)."""
+    am = AlertManager((_SLO,))
+    am.ingest(_latency_families(good=0, bad=0), now=0.0)
+    am.ingest(_latency_families(good=0, bad=4), now=10.0)
+    # 80s later the bad burst is outside the 30s window (only good
+    # events in it) but still inside the 120s one.
+    am.ingest(_latency_families(good=50, bad=4), now=90.0)
+    assert am.evaluate(now=90.0) == []
+    assert am.states()[0]["firing"] is False
+
+
+def test_ratio_slo_and_no_traffic_burns_nothing():
+    slo = SLO(
+        name="stream-fallback-ratio",
+        metric="fedtpu_server_stream_fallbacks_total",
+        kind="ratio",
+        total="fedtpu_server_uploads_total",
+        objective=0.9,
+        windows=((120.0, 2.0), (30.0, 2.0)),
+        severity="ticket",
+    )
+    am = AlertManager((slo,))
+    am.ingest(_ratio_families(bad=0, total=10), now=0.0)
+    am.evaluate(now=0.0)
+    am.ingest(_ratio_families(bad=8, total=20), now=10.0)
+    events = am.evaluate(now=10.0)
+    assert [e["event"] for e in events] == ["fire"]
+    assert events[0]["severity"] == "ticket"
+    # A trafficless window (no new uploads at all) burns nothing: the
+    # firing alert clears once bad events STOP, by definition.
+    am.ingest(_ratio_families(bad=8, total=20), now=60.0)
+    assert [e["event"] for e in am.evaluate(now=60.0)] == ["clear"]
+
+
+def test_counter_reset_drops_history_instead_of_phantom_burn():
+    am = AlertManager((_SLO,))
+    am.ingest(_latency_families(good=50, bad=5), now=0.0)
+    am.evaluate(now=0.0)
+    # Daemon restart: cumulative counts fall. The series must restart,
+    # not compute negative/phantom deltas.
+    am.ingest(_latency_families(good=1, bad=0), now=10.0)
+    assert am.evaluate(now=10.0) == []
+    am.ingest(_latency_families(good=5, bad=0), now=20.0)
+    assert am.evaluate(now=20.0) == []
+    assert am.states()[0]["firing"] is False
+
+
+def test_page_fire_trips_flight_recorder(tmp_path):
+    rec = FlightRecorder(
+        str(tmp_path / "flight"), proc="hub", min_interval_s=0.0
+    )
+    am = AlertManager((_SLO,), recorder=rec)
+    am.ingest(_latency_families(good=0, bad=0), now=0.0)
+    am.ingest(_latency_families(good=0, bad=4), now=10.0)
+    am.evaluate(now=10.0)
+    bundles = list_bundles(str(tmp_path / "flight"))
+    assert len(bundles) == 1 and bundles[0]["reason"] == "slo-page"
+    b = load_bundle(bundles[0]["path"])
+    assert b["schema"] == BUNDLE_SCHEMA
+    # The firing alert itself rides in the bundle.
+    assert any(a["event"] == "fire" for a in b["alerts"])
+
+
+def test_slo_validation_and_spec_roundtrip():
+    with pytest.raises(ValueError):
+        SLO(name="x", metric="m", kind="latency")  # latency needs le
+    with pytest.raises(ValueError):
+        SLO(name="x", metric="m", kind="ratio")  # ratio needs total
+    with pytest.raises(ValueError):
+        SLO(name="x", metric="m", le=1.0, objective=1.0)
+    with pytest.raises(ValueError):
+        SLO(name="x", metric="m", le=1.0, windows=())
+    with pytest.raises(ValueError):
+        AlertManager((_SLO, _SLO))  # duplicate names
+    spec = [
+        {
+            "name": "a",
+            "metric": "fedtpu_server_round_seconds",
+            "le": 1.0,
+            "windows": [[60.0, 2.0], [10.0, 2.0]],
+        }
+    ]
+    (slo,) = slos_from_spec(spec)
+    assert slo.windows == ((60.0, 2.0), (10.0, 2.0))
+    assert slo.shortest_window == (10.0, 2.0)
+    # Families the target never exports are "not my tier", not an error.
+    assert extract_bad_total(slo, {}) is None
+    assert default_slos()  # the stock objectives construct
+
+
+# --------------------------------------------------------- scrape hub
+@pytest.fixture(scope="module")
+def live_fleet(tmp_path_factory):
+    """Two live /metrics.json daemons on private registries (an FL
+    server shape and a router shape) + one dead target. Module-scoped
+    (HTTP server teardown costs ~1 s each): every test builds its own
+    hub, and the first test below is the only one reading absolute
+    counter values."""
+    tmp_path = tmp_path_factory.mktemp("health-fleet")
+    reg_serve = MetricsRegistry()
+    reg_serve.counter("fedtpu_server_rounds_total").inc(3)
+    reg_serve.counter("fedtpu_server_uploads_total").inc(6)
+    h = reg_serve.histogram(
+        "fedtpu_server_round_seconds", buckets=(0.1, 0.5, 5.0)
+    )
+    h.observe(0.2)
+    reg_route = MetricsRegistry()
+    reg_route.counter("fedtpu_router_forwarded_total").inc(100)
+    reg_route.counter(
+        "fedtpu_router_ejects_total", labels={"replica": "0"}
+    ).inc(1)
+    reg_route.gauge(
+        "fedtpu_router_inflight", labels={"replica": "0"}
+    ).set(2)
+    reg_route.gauge(
+        "fedtpu_router_inflight", labels={"replica": "1"}
+    ).set(1)
+    srv_a = MetricsServer(0, host="127.0.0.1", registry=reg_serve).start()
+    srv_b = MetricsServer(0, host="127.0.0.1", registry=reg_route).start()
+    # A port nothing listens on: the down target.
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+    yield {
+        "serve_reg": reg_serve,
+        "serve": srv_a,
+        "route": srv_b,
+        "dead_port": dead_port,
+        "dir": tmp_path,
+    }
+    srv_a.close()
+    srv_b.close()
+
+
+def test_scrape_hub_merges_live_multi_daemon_fleet(live_fleet):
+    """The acceptance scrape test: one poll over two LIVE daemons + one
+    dead target -> a merged snapshot keyed by (tier, instance) with
+    up/down, per-target scrape lag, per-tier summaries, and the fleet
+    snapshot JSONL on disk."""
+    snap_path = live_fleet["dir"] / "fleet.jsonl"
+    hub = ScrapeHub(
+        [
+            Target("serve", "127.0.0.1", live_fleet["serve"].port),
+            Target("route", "127.0.0.1", live_fleet["route"].port),
+            Target("relay", "127.0.0.1", live_fleet["dead_port"]),
+        ],
+        slos=(_SLO,),
+        snapshot_jsonl=str(snap_path),
+    )
+    snap = hub.poll(now=0.0)
+    by_key = {(t["tier"], t["instance"]): t for t in snap["targets"]}
+    assert len(by_key) == 3
+    serve_row = by_key[("serve", f"127.0.0.1:{live_fleet['serve'].port}")]
+    route_row = by_key[("route", f"127.0.0.1:{live_fleet['route'].port}")]
+    dead_row = by_key[("relay", f"127.0.0.1:{live_fleet['dead_port']}")]
+    assert serve_row["up"] and route_row["up"] and not dead_row["up"]
+    assert dead_row["error"]
+    assert serve_row["summary"]["counters"][
+        "fedtpu_server_rounds_total"
+    ] == 3
+    assert route_row["summary"]["gauges"]["fedtpu_router_inflight"] == {
+        "replica=0": 2.0,
+        "replica=1": 1.0,
+    }
+    for row in (serve_row, route_row):
+        assert row["scrape_lag_ms"] is not None and row["scrape_lag_ms"] >= 0
+    assert snap["scrape_lag_ms"] is not None
+    assert hub.last_scrape_lag_ms == snap["scrape_lag_ms"]
+    # Round cadence needs a second poll: 3 more rounds over 60s.
+    live_fleet["serve_reg"].counter("fedtpu_server_rounds_total").inc(3)
+    snap2 = hub.poll(now=60.0)
+    serve_row2 = [t for t in snap2["targets"] if t["tier"] == "serve"][0]
+    assert serve_row2["cadence"]["fedtpu_server_rounds_total"] == (
+        pytest.approx(0.05)
+    )  # 3 rounds / 60 s
+    # The merged snapshot JSONL: one record per poll, schema-tagged.
+    recs = [
+        json.loads(ln) for ln in snap_path.read_text().splitlines()
+    ]
+    assert len(recs) == 2
+    assert all(r["schema"] == "fedtpu-fleet-v1" for r in recs)
+    assert {t["tier"] for t in recs[0]["targets"]} == {
+        "serve", "route", "relay",
+    }
+    # Rendering: every tier + the DOWN marker + SLO block on one screen.
+    screen = hub.render_status(snap2)
+    assert "serve" in screen and "route" in screen and "DOWN" in screen
+    assert "SLO burn" in screen and "round-duration" in screen
+    assert "rounds/min" in screen
+
+
+def test_scrape_hub_slo_fire_over_live_scrapes(live_fleet, tmp_path):
+    """Burn alerts fire from actually-scraped deltas, the slo-eval span
+    is emitted per poll, and alert events ride the snapshot record."""
+    trace_path = tmp_path / "hub.jsonl"
+    hub = ScrapeHub(
+        [Target("serve", "127.0.0.1", live_fleet["serve"].port)],
+        slos=(_SLO,),
+        alerts_jsonl=str(tmp_path / "alerts.jsonl"),
+        tracer=Tracer(str(trace_path), proc="obs-hub"),
+    )
+    hub.poll(now=0.0)
+    h = live_fleet["serve_reg"].histogram(
+        "fedtpu_server_round_seconds", buckets=(0.1, 0.5, 5.0)
+    )
+    for _ in range(4):
+        h.observe(2.0)  # bad: above the 0.5s objective bound
+    snap = hub.poll(now=10.0)
+    assert [e["event"] for e in snap["events"]] == ["fire"]
+    assert [s for s in snap["slo"] if s["firing"]]
+    spans = [
+        json.loads(ln) for ln in trace_path.read_text().splitlines()
+    ]
+    evals = [s for s in spans if s["span"] == "slo-eval"]
+    assert len(evals) == 2
+    assert evals[-1]["firing"] == 1 and evals[-1]["up"] == 1
+    assert evals[-1]["scrape_lag_ms"] is not None
+
+
+def test_scrape_hub_tails_events_jsonl_for_drift_and_postmortems(
+    live_fleet, tmp_path
+):
+    """events=PATH targets surface span-level state: the controller's
+    drift-trigger localization and flight-recorder dumps."""
+    events = tmp_path / "ctl.jsonl"
+    t = Tracer(str(events), proc="controller")
+    t.record(
+        "drift-trigger", t_start=1.0, dur_s=0.0, round=4,
+        drift=0.31, method="psi",
+        top_bins=[{"bin": 9, "psi": 0.25}],
+    )
+    t.record(
+        "postmortem-dump", t_start=2.0, dur_s=0.01,
+        reason="round-failure", bundle="b.json", spans=12,
+    )
+    t.record(
+        "round", t_start=3.0, dur_s=1.2, trace="aa", round=4, failed=True,
+    )
+    hub = ScrapeHub(
+        [
+            Target(
+                "controller",
+                "127.0.0.1",
+                live_fleet["serve"].port,
+                events_jsonl=str(events),
+            )
+        ],
+        slos=(_SLO,),
+    )
+    snap = hub.poll(now=0.0)
+    row = snap["targets"][0]
+    assert row["last_drift"]["drift"] == 0.31
+    assert row["last_drift"]["top_bins"][0]["bin"] == 9
+    assert row["postmortems"] == 1
+    assert row["last_round_failed"] is True
+    screen = hub.render_status(snap)
+    assert "drift psi=0.31" in screen and "top_bins" in screen
+    assert "postmortem bundle" in screen
+    assert "LAST ROUND FAILED" in screen
+    # render_status(None) — the no-scrape path — shows the same row
+    # shape (one _row builder for both).
+    assert "LAST ROUND FAILED" in hub.render_status(None)
+
+
+def test_parse_target_shapes():
+    t = parse_target("serve=127.0.0.1:9100")
+    assert (t.tier, t.host, t.port, t.events_jsonl) == (
+        "serve", "127.0.0.1", 9100, None,
+    )
+    assert t.url.endswith("/metrics.json")
+    t = parse_target("route=10.0.0.2:9102,events=/var/log/r.jsonl")
+    assert t.events_jsonl == "/var/log/r.jsonl"
+    for bad in ("serve", "serve=127.0.0.1", "serve=h:x", "s=h:1,foo=bar"):
+        with pytest.raises(ValueError):
+            parse_target(bad)
+    with pytest.raises(ValueError):
+        ScrapeHub([])  # no targets
+    with pytest.raises(ValueError):
+        tgt = Target("serve", "127.0.0.1", 1)
+        ScrapeHub([tgt, tgt])  # duplicate keys
+
+
+# ------------------------------------------------------ flight recorder
+def test_flight_recorder_dumps_on_live_round_failure(tmp_path):
+    """The acceptance regression: a quorum-missed LIVE round dumps a
+    postmortem bundle carrying the failed round's span, the trigger
+    context, and the process /metrics state — with the recorder
+    installed exactly as the CLI installs it (global)."""
+    flight_dir = tmp_path / "flight"
+    tracer = Tracer(str(tmp_path / "server.jsonl"), proc="server")
+    rec = FlightRecorder(
+        str(flight_dir), proc="server", tracer=tracer, min_interval_s=0.0
+    )
+    set_global_recorder(rec)
+    try:
+        server = AggregationServer(port=0, num_clients=2, timeout=30)
+        server.tracer = tracer
+        with pytest.raises(RuntimeError):
+            server.serve_round(deadline=0.3)  # nobody connects
+        server.close()
+    finally:
+        set_global_recorder(None)
+    bundles = list_bundles(str(flight_dir))
+    assert len(bundles) == 1
+    assert bundles[0]["reason"] == "round-failure"
+    b = load_bundle(bundles[0]["path"])
+    assert b["extra"]["round"] == 0 and b["extra"]["expected"] == 2
+    ring_spans = [s["span"] for s in b["spans"]]
+    assert "round" in ring_spans  # the failed round itself is in the ring
+    failed = [s for s in b["spans"] if s["span"] == "round"][-1]
+    assert failed.get("failed") is True
+    # Dump-time /metrics pull: the failure counter is in the bundle.
+    fams = b["metrics_now"]["families"]
+    assert fams["fedtpu_server_round_failures_total"]["samples"][0][
+        "value"
+    ] >= 1
+    # The dump emitted its own vocabulary span.
+    spans = [
+        json.loads(ln)
+        for ln in (tmp_path / "server.jsonl").read_text().splitlines()
+    ]
+    assert any(s["span"] == "postmortem-dump" for s in spans)
+
+
+def test_flight_recorder_ring_bound_and_rate_limit(tmp_path):
+    rec = FlightRecorder(
+        str(tmp_path), proc="x", ring=4, min_interval_s=3600.0,
+        max_bundles=2,
+    )
+    for i in range(10):
+        rec.note_span({"span": "round", "ts": float(i), "dur_s": 0.0})
+    p1 = rec.maybe_dump("round-failure")
+    assert p1 is not None
+    b = load_bundle(p1)
+    assert len(b["spans"]) == 4  # bounded ring keeps the newest 4
+    assert [s["ts"] for s in b["spans"]] == [6.0, 7.0, 8.0, 9.0]
+    # Storm guard: same reason inside the interval is suppressed...
+    assert rec.maybe_dump("round-failure") is None
+    # ...a different reason is not, and dump() never rate-limits.
+    assert rec.maybe_dump("eject-storm") is not None
+    rec.dump("round-failure")
+    # Directory bound: oldest pruned beyond max_bundles.
+    assert len(list_bundles(str(tmp_path))) == 2
+
+
+def test_flight_recorder_restart_never_overwrites_prior_bundles(tmp_path):
+    """A restarted daemon (exactly what follows a failure) reuses the
+    same --flight-dir; its sequence must seed PAST the previous run's
+    bundles instead of os.replace()-ing the evidence."""
+    first = FlightRecorder(str(tmp_path), proc="relay-0", min_interval_s=0.0)
+    p1 = first.dump("round-failure")
+    # Process restart: a fresh recorder over the same directory.
+    second = FlightRecorder(str(tmp_path), proc="relay-0", min_interval_s=0.0)
+    p2 = second.dump("round-failure")
+    assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
+    assert len(list_bundles(str(tmp_path))) == 2
+    # A different proc sharing the directory has its own sequence, and
+    # its prune budget must NEVER count or delete the siblings' files —
+    # even at max_bundles=1 with a dash-prefix name collision around.
+    other = FlightRecorder(
+        str(tmp_path), proc="server", min_interval_s=0.0, max_bundles=1
+    )
+    other.dump("round-failure")
+    bundles = list_bundles(str(tmp_path))
+    assert len(bundles) == 3
+    assert sum(1 for b in bundles if b["proc"] == "relay-0") == 2
+
+
+def test_flight_recorder_skips_torn_bundle(tmp_path):
+    rec = FlightRecorder(str(tmp_path), proc="x", min_interval_s=0.0)
+    rec.dump("round-failure")
+    (tmp_path / "postmortem-x-9999-torn.json").write_text('{"half":')
+    bundles = list_bundles(str(tmp_path))
+    assert len(bundles) == 1 and bundles[0]["reason"] == "round-failure"
+
+
+def test_router_eject_storm_dumps_postmortem(tmp_path):
+    """N ejects inside the window -> ONE bundle (the storm guard), with
+    the eject context attached."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.router.core import (
+        ScoringRouter,
+    )
+
+    rec = FlightRecorder(
+        str(tmp_path / "flight"), proc="router", min_interval_s=3600.0
+    )
+    set_global_recorder(rec)
+    try:
+        router = ScoringRouter(
+            [("127.0.0.1", 1)],
+            port=0,
+            eject_storm_n=2,
+            eject_storm_window_s=60.0,
+        )
+        rep = router.replicas[0]
+        for _ in range(3):
+            # Install a live socket so _eject has a connection to tear
+            # down; three ejects, storm threshold 2.
+            a, b = socket.socketpair()
+            with rep.lock:
+                rep.sock = a
+                rep.healthy = True
+            router._eject(rep, a, "probe timeout")
+            b.close()
+        router.close()
+    finally:
+        set_global_recorder(None)
+    bundles = list_bundles(str(tmp_path / "flight"))
+    assert len(bundles) == 1  # storm-guarded: one bundle, not three
+    b = load_bundle(bundles[0]["path"])
+    assert b["reason"] == "eject-storm"
+    assert b["extra"]["ejects_in_window"] >= 2
+
+
+# -------------------------------------------------- drift localization
+def test_psi_contributions_decompose_psi_exactly():
+    ref = [100, 100, 100, 100]
+    obs = [100, 100, 40, 160]
+    terms = psi_contributions(ref, obs, top_k=4)
+    assert terms  # something moved
+    # The per-bin terms sum to the PSI (same smoothing arithmetic).
+    assert sum(t["psi"] for t in terms) == pytest.approx(
+        psi(ref, obs), abs=1e-5
+    )
+    # Largest contribution first; bin 2 (shrunk 100->40) dominates.
+    assert terms[0]["psi"] >= terms[-1]["psi"]
+    assert {t["bin"] for t in terms[:2]} == {2, 3}
+    assert terms[0]["expected_frac"] == pytest.approx(0.25, abs=1e-3)
+    # Identical histograms contribute nothing.
+    assert psi_contributions(ref, ref) == []
+    with pytest.raises(ValueError):
+        psi_contributions([1, 2], [1, 2, 3])
+
+
+def test_drift_verdict_carries_top_bins():
+    """The drift record (controller state JSONL + drift-trigger span
+    attrs) says WHICH score region moved."""
+    mon = DriftMonitor(
+        reference=[100, 100, 100, 100], threshold=0.05, min_scores=100
+    )
+    mon.observe([10, 10, 10, 370])
+    verdict = mon.check()
+    assert verdict is not None
+    assert verdict["top_bins"][0]["bin"] == 3  # the hot tail moved
+    assert verdict["top_bins"][0]["observed_frac"] > verdict["top_bins"][
+        0
+    ]["expected_frac"]
+
+
+# ------------------------------------------------------------------ CLI
+def test_obs_health_cli_renders_and_exit_codes(live_fleet, capsys):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli.parser import (
+        main,
+    )
+
+    rc = main(
+        [
+            "obs", "health", "--interval", "0.05",
+            "--target", f"serve=127.0.0.1:{live_fleet['serve'].port}",
+            "--target", f"route=127.0.0.1:{live_fleet['route'].port}",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0  # everything up, nothing firing
+    assert "fedtpu fleet health" in out
+    assert "serve" in out and "route" in out
+    assert "2/2 targets up" in out
+    # A down target flips the exit code (the cron-able verdict).
+    rc = main(
+        [
+            "obs", "health", "--interval", "0.05",
+            "--target", f"serve=127.0.0.1:{live_fleet['serve'].port}",
+            "--target", f"relay=127.0.0.1:{live_fleet['dead_port']}",
+        ]
+    )
+    capsys.readouterr()
+    assert rc == 1
+    # --json emits the machine-readable snapshot; --flight-dir arms the
+    # HUB's recorder (the process that evaluates SLOs is the one that
+    # can dump on a page).
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
+        obs as cli_obs,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli.parser import (
+        build_parser,
+    )
+
+    args = build_parser().parse_args(
+        [
+            "obs", "health", "--json", "--interval", "0.05",
+            "--target", f"serve=127.0.0.1:{live_fleet['serve'].port}",
+            "--flight-dir", str(live_fleet["dir"] / "hub-flight"),
+        ]
+    )
+    hub = cli_obs._build_hub(args)
+    assert hub.alerts._recorder is not None
+    assert hub.alerts._recorder.proc == "obs-hub"
+    rc = main(
+        [
+            "obs", "health", "--json", "--interval", "0.05",
+            "--target", f"serve=127.0.0.1:{live_fleet['serve'].port}",
+        ]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0 and doc["schema"] == "fedtpu-fleet-v1"
+    # Missing --target is an operator error.
+    with pytest.raises(SystemExit):
+        main(["obs", "health"])
+    with pytest.raises(SystemExit):
+        main(["obs", "health", "--target", "not-a-target"])
+
+
+def test_obs_watch_cli_live_refresh(live_fleet, capsys):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli.parser import (
+        main,
+    )
+
+    rc = main(
+        [
+            "obs", "watch",
+            "--target", f"serve=127.0.0.1:{live_fleet['serve'].port}",
+            "--interval", "0.05", "--max-seconds", "0.2",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.count("fedtpu fleet health") >= 2  # actually refreshed
+
+
+def test_obs_postmortem_cli_lists_and_inspects(tmp_path, capsys):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli.parser import (
+        main,
+    )
+
+    flight = tmp_path / "flight"
+    rec = FlightRecorder(str(flight), proc="server", min_interval_s=0.0)
+    rec.note_span(
+        {
+            "schema": "fedtpu-obs-v1", "proc": "server", "span": "round",
+            "ts": 1.0, "dur_s": 0.4, "failed": True,
+        }
+    )
+    rec.note_alert(
+        {
+            "event": "fire", "slo": "round-duration", "instance": "i",
+            "burn": {"30s": 9.0},
+        }
+    )
+    path = rec.dump("round-failure", extra={"round": 7})
+    assert main(["obs", "postmortem", "--flight-dir", str(flight)]) == 0
+    out = capsys.readouterr().out
+    assert "round-failure" in out and "server" in out
+    name = os.path.basename(path)
+    assert (
+        main(
+            [
+                "obs", "postmortem", "--flight-dir", str(flight),
+                "--bundle", name,
+            ]
+        )
+        == 0
+    )
+    out = capsys.readouterr().out
+    assert "reason   round-failure" in out
+    assert '"round": 7' in out
+    assert "fire round-duration" in out
+    assert "failed=True" in out
+    # --json round-trips the whole bundle.
+    assert (
+        main(
+            [
+                "obs", "postmortem", "--flight-dir", str(flight),
+                "--bundle", name, "--json",
+            ]
+        )
+        == 0
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema"] == BUNDLE_SCHEMA and doc["extra"]["round"] == 7
+    # An empty dir lists cleanly; a bad bundle name is an error.
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main(["obs", "postmortem", "--flight-dir", str(empty)]) == 0
+    capsys.readouterr()
+    with pytest.raises(SystemExit):
+        main(
+            [
+                "obs", "postmortem", "--flight-dir", str(flight),
+                "--bundle", "nope.json",
+            ]
+        )
+
+
+def test_flight_dir_flag_arms_recorder_via_obs_setup(tmp_path):
+    """The daemons' --flight-dir wiring: _obs_setup installs the global
+    recorder (and clears it when absent — the stale-state rule)."""
+    import argparse
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli.common import (
+        _obs_setup,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs import (
+        get_global_recorder,
+    )
+
+    args = argparse.Namespace(
+        trace_jsonl=None,
+        metrics_port=0,
+        flight_dir=str(tmp_path / "flight"),
+    )
+    _obs_setup(args, proc="server")
+    rec = get_global_recorder()
+    assert rec is not None and rec.proc == "server"
+    # No flight_dir: the next invocation disarms the recorder.
+    _obs_setup(
+        argparse.Namespace(
+            trace_jsonl=None, metrics_port=0, flight_dir=None
+        ),
+        proc="server",
+    )
+    assert get_global_recorder() is None

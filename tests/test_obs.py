@@ -254,6 +254,85 @@ def test_prometheus_endpoint_scrapes_and_parses():
         assert name_part.startswith("demo_")
 
 
+def test_metrics_json_twin_endpoint_matches_text_rendering():
+    """ISSUE 11 satellite: /metrics.json serves the SAME numbers as the
+    Prometheus text format — machine-readable, schema-tagged, no
+    exposition-format parser needed (the scrape hub's input)."""
+    reg = MetricsRegistry()
+    reg.counter("demo_rounds_total", help="rounds").inc(3)
+    reg.gauge("demo_queue_depth").set(7)
+    h = reg.histogram("demo_wait_seconds", buckets=(0.01, 0.1, 1.0))
+    h.observe(0.05)
+    h.observe(2.0)
+    reg.counter("demo_rejects_total", labels={"kind": "deadline"}).inc()
+    with MetricsServer(0, host="127.0.0.1", registry=reg) as srv:
+        raw = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics.json", timeout=10
+        )
+        assert raw.headers["Content-Type"] == "application/json"
+        doc = json.loads(raw.read())
+    assert doc["schema"] == "fedtpu-metrics-v1"
+    fams = doc["families"]
+    assert fams["demo_rounds_total"]["type"] == "counter"
+    assert fams["demo_rounds_total"]["samples"][0]["value"] == 3
+    assert fams["demo_queue_depth"]["samples"][0]["value"] == 7
+    # Labeled sample keeps its labels as a dict.
+    (rej,) = fams["demo_rejects_total"]["samples"]
+    assert rej["labels"] == {"kind": "deadline"} and rej["value"] == 1
+    # Histogram buckets are CUMULATIVE [edge, count] pairs ending +Inf —
+    # identical numbers to the text rendering's _bucket lines.
+    (hs,) = fams["demo_wait_seconds"]["samples"]
+    assert hs["buckets"] == [["0.01", 0], ["0.1", 1], ["1", 1], ["+Inf", 2]]
+    assert hs["count"] == 2 and hs["sum"] == pytest.approx(2.05)
+    # Twin consistency: every text sample value appears in the JSON.
+    text = reg.render()
+    assert 'demo_wait_seconds_bucket{le="+Inf"} 2' in text
+    assert "demo_rounds_total 3" in text
+
+
+def test_new_health_span_names_registered():
+    """The PR-10 spans are IN the closed vocabulary (the obs-span-vocab
+    static pass anchors on this tuple) and the timeline renders them as
+    extra rows."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs import (
+        SPAN_NAMES,
+    )
+
+    assert {"slo-eval", "postmortem-dump", "drift-trigger"} <= set(
+        SPAN_NAMES
+    )
+    # The REAL emission shapes: slo-eval and postmortem-dump carry NO
+    # (trace, round) — they happen outside any round's identity — and
+    # drift-trigger carries only the round index. The timeline must
+    # render all three anyway (the unscoped trailing section / the
+    # per-round extra rows), not silently drop them.
+    t_spans = [
+        {
+            "schema": SCHEMA, "proc": "obs-hub", "span": "slo-eval",
+            "ts": 1.0, "dur_s": 0.002, "firing": 1, "up": 1,
+        },
+        {
+            "schema": SCHEMA, "proc": "server", "span": "postmortem-dump",
+            "ts": 2.0, "dur_s": 0.01, "reason": "round-failure",
+            "bundle": "b.json",
+        },
+        {
+            "schema": SCHEMA, "proc": "controller", "span": "drift-trigger",
+            "ts": 3.0, "dur_s": 0.0, "round": 1, "drift": 0.31,
+        },
+        # An anchoring round so the per-round half renders too.
+        {
+            "schema": SCHEMA, "proc": "server", "span": "round",
+            "ts": 0.5, "dur_s": 1.0, "trace": "aa", "round": 1,
+        },
+    ]
+    table = timeline_table(t_spans)
+    assert "slo-eval" in table and "firing=1" in table
+    assert "postmortem-dump" in table and "reason=round-failure" in table
+    assert "drift-trigger" in table
+    assert "unscoped health-plane spans" in table
+
+
 def test_http_404_off_path():
     reg = MetricsRegistry()
     with MetricsServer(0, host="127.0.0.1", registry=reg) as srv:
