@@ -60,6 +60,16 @@ Secondary modes via BENCH_MODE:
                       runs under traffic; headline router_qps_sustained +
                       router_p99_ms (vs the pinned BENCH_ROUTER_SLO_MS)
                       + router_rolling_reload_dropped asserted == 0
+    profile           the device performance plane (obs/profile.py): one
+                      run_profile_session over the flagship train step —
+                      compile ledger + recompile flags, fenced host/
+                      dispatch/device step split, memory watermarks,
+                      analytic-vs-XLA FLOPs cross-check (pinned inside
+                      FLOPS_RATIO_TOLERANCE), and the bucketed serving
+                      path's zero-recompile storm (asserted 0, exit 3);
+                      headline profile_compile_count / profile_recompiles
+                      / profile_step_device_ms_p50 /
+                      profile_peak_device_bytes
     obs               the fleet health plane (obs/slo+fleet+flight): a
                       live loopback round campaign under the scrape hub
                       — a slow round FIRES the round-duration burn
@@ -1876,8 +1886,133 @@ def _preflight() -> None:
 MODES = (
     "train", "bert", "bertlarge", "eval", "fedavg", "flash", "ring",
     "fed2", "fedseq", "serve", "clientdp", "controller", "scenario",
-    "fleet", "check", "router", "obs",
+    "fleet", "check", "router", "obs", "profile",
 )
+
+
+def bench_profile() -> dict | None:
+    """The device performance plane (ISSUE 12): one run_profile_session
+    over the REAL flagship train step — compile ledger with recompile
+    flagging, fenced host/dispatch/device step attribution, memory
+    watermarks, the analytic-vs-XLA FLOPs cross-check, and the bucketed
+    serving path's zero-recompile storm.
+
+    Headline fields (asserted present by the train-mode headline,
+    exit 3): ``profile_compile_count`` — session compiles across every
+    ledger site; ``profile_recompiles`` — new-signature-at-warm-site
+    events, the shape-leak detector (train sites may legitimately see
+    warm-up shapes; the SERVING path's ``profile_serving_recompiles``
+    is asserted 0 — the bucket ladder makes a recompile a bug);
+    ``profile_step_device_ms_p50`` — sampled device-execute median;
+    ``profile_peak_device_bytes`` — the high-water memory watermark
+    (0 on backends without memory_stats, with
+    ``profile_memory_available`` saying which case you're in). The
+    XLA-vs-analytic ``profile_flops_ratio`` is pinned inside
+    FLOPS_RATIO_TOLERANCE whenever the backend exposes a cost model —
+    the MFU headline's analytic FLOPs, anchored to what XLA built.
+
+    BENCH_PROFILE_PRESET=tiny swaps the tiny config in for quick local
+    runs; batch/prng default to the headline bench's own so the profile
+    session and the dense headline share one compiled program."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        TrainConfig as _TrainConfig,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs.profile import (
+        run_profile_session,
+    )
+
+    preset = os.environ.get("BENCH_PROFILE_PRESET", "distilbert")
+    presets = {
+        "tiny": ModelConfig.tiny,
+        "distilbert": ModelConfig,
+        "bert": ModelConfig.bert_base,
+        "bertlarge": ModelConfig.bert_large,
+    }
+    if preset not in presets:  # loud, like the BENCH_MODE validation —
+        # a typo must not silently profile the wrong model under a
+        # healthy-looking record
+        raise SystemExit(
+            f"unknown BENCH_PROFILE_PRESET {preset!r} "
+            f"({'|'.join(presets)})"
+        )
+    model_cfg = presets[preset]()
+    batch = int(
+        os.environ.get(
+            "BENCH_PROFILE_BATCH", os.environ.get("BENCH_BATCH", "64")
+        )
+    )
+    steps = int(os.environ.get("BENCH_PROFILE_STEPS", "8"))
+    stride = int(os.environ.get("BENCH_PROFILE_STRIDE", "2"))
+    t0 = time.perf_counter()
+    try:
+        rep = run_profile_session(
+            model_cfg,
+            _TrainConfig(prng_impl=os.environ.get("BENCH_PRNG", "rbg")),
+            steps=steps,
+            batch_size=batch,
+            stride=stride,
+        )
+    except Exception as e:
+        record = {
+            "metric": "bench_error",
+            "error": "profile_failed",
+            "detail": f"{type(e).__name__}: {str(e)[:300]}",
+        }
+        _emit(record)
+        return record
+    dt = time.perf_counter() - t0
+    step = rep.get("step") or {}
+    device = step.get("device") or {}
+    host = step.get("host") or {}
+    dispatch = step.get("dispatch") or {}
+    srv = rep.get("serving") or {}
+    mem_available = any(
+        v.get("available") for v in (rep.get("memory") or {}).values()
+    )
+    record = {
+        "metric": "profile_plane",
+        "value": round(device.get("p50", 0.0) * 1e3, 3),
+        "unit": "ms/step-device-p50",
+        "device": jax.devices()[0].device_kind,
+        "profile_compile_count": rep["compile_count"],
+        "profile_recompiles": len(rep["recompiles"]),
+        "profile_step_device_ms_p50": round(
+            device.get("p50", 0.0) * 1e3, 3
+        ),
+        "profile_step_device_ms_p95": round(
+            device.get("p95", 0.0) * 1e3, 3
+        ),
+        "profile_step_host_ms_p50": round(host.get("p50", 0.0) * 1e3, 3),
+        "profile_step_dispatch_ms_p50": round(
+            dispatch.get("p50", 0.0) * 1e3, 3
+        ),
+        "profile_peak_device_bytes": int(rep["peak_device_bytes"]),
+        "profile_memory_available": 1 if mem_available else 0,
+        "profile_flops_analytic": rep["flops_analytic"],
+        "profile_flops_xla": rep["flops_xla"],
+        "profile_flops_ratio": rep["flops_ratio"],
+        "profile_serving_compiles": srv.get("compiles", 0),
+        "profile_serving_recompiles": srv.get("recompiles", -1),
+        "profile_sites": {
+            k: v["compiles"] for k, v in rep["sites"].items()
+        },
+        "profile_runtime_s": round(dt, 2),
+    }
+    _emit(record)
+    return record
+
+
+def _profile_broken(rec: dict) -> bool:
+    """The profile record's exit-3 contract: the bucketed serving path
+    must not recompile, and the XLA-vs-analytic FLOPs ratio must sit
+    inside FLOPS_RATIO_TOLERANCE whenever the backend reported one."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs.profile import (
+        flops_ratio_ok,
+    )
+
+    return rec["profile_serving_recompiles"] != 0 or not flops_ratio_ok(
+        rec["profile_flops_ratio"]
+    )
 
 
 def bench_obs() -> dict:
@@ -2174,6 +2309,7 @@ def main() -> None:
             # restores the single-line behavior.
             rec_fed2 = rec_fedseq = rec_ctrl = rec_resid = rec_scn = None
             rec_fleet = rec_check = rec_router = rec_obs = None
+            rec_profile = None
             if os.environ.get("BENCH_SECONDARY", "1").lower() not in (
                 "", "0", "false",
             ):
@@ -2190,6 +2326,12 @@ def main() -> None:
                 rec_fleet = bench_fleet()
                 rec_router = bench_router()
                 rec_obs = bench_obs()
+                # Profile LAST among the jitted secondaries: it marks
+                # the engine train site warm, and the headline
+                # bench_train below shares its compiled program (same
+                # batch/prng), so nothing after it traces a new shape
+                # at a warm site.
+                rec_profile = bench_profile()
                 rec_check = bench_check()
             extra = {}
             for key, rec in (("fed2", rec_fed2), ("fedseq", rec_fedseq)):
@@ -2386,6 +2528,49 @@ def main() -> None:
                     or rec_obs["postmortem_bundles"] < 1
                     or rec_obs["obs_scrape_lag_ms"] is None
                 )
+            profile_broken = False
+            if rec_profile is not None and (
+                rec_profile.get("metric") != "bench_error"
+            ):
+                # Device-plane headline fields (ISSUE 12): ASSERTED
+                # present — a refactor that drops the compile ledger,
+                # the fenced step timers, or the memory watermarks must
+                # fail the bench loudly — with the serving path's
+                # recompiles asserted 0 and the XLA-vs-analytic FLOPs
+                # ratio pinned inside FLOPS_RATIO_TOLERANCE.
+                missing = [
+                    k
+                    for k in (
+                        "profile_compile_count",
+                        "profile_recompiles",
+                        "profile_step_device_ms_p50",
+                        "profile_peak_device_bytes",
+                    )
+                    if k not in rec_profile
+                ]
+                if missing:
+                    _emit(
+                        {
+                            "metric": "bench_error",
+                            "error": "profile_fields_missing",
+                            "detail": f"profile record lacks {missing} "
+                            "(obs/profile.py session accounting broken?)",
+                        }
+                    )
+                    raise SystemExit(3)
+                for k in (
+                    "profile_compile_count",
+                    "profile_recompiles",
+                    "profile_step_device_ms_p50",
+                    "profile_step_host_ms_p50",
+                    "profile_peak_device_bytes",
+                    "profile_memory_available",
+                    "profile_flops_ratio",
+                    "profile_serving_recompiles",
+                ):
+                    if k in rec_profile:
+                        extra[k] = rec_profile[k]
+                profile_broken = _profile_broken(rec_profile)
             check_broken = False
             if rec_check is not None and (
                 rec_check.get("metric") != "bench_error"
@@ -2424,6 +2609,7 @@ def main() -> None:
                 or fleet_broken
                 or router_broken
                 or obs_broken
+                or profile_broken
                 or check_broken
             ):
                 raise SystemExit(3)
@@ -2471,6 +2657,12 @@ def main() -> None:
             if rec is not None and rec.get("metric") != "bench_error" and (
                 rec["router_rolling_reload_dropped"] > 0
                 or rec.get("router_reload_complete", 1.0) < 1.0
+            ):
+                raise SystemExit(3)
+        elif mode == "profile":
+            rec = bench_profile()
+            if rec is None or rec.get("metric") == "bench_error" or (
+                _profile_broken(rec)
             ):
                 raise SystemExit(3)
     finally:

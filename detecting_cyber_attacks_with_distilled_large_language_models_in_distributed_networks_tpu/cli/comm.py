@@ -19,6 +19,14 @@ from .common import (
 log = get_logger()
 
 
+def _step_attrs(trainer) -> dict:
+    """The trainer's sampled step-profile attrs for the client-local
+    span (obs/profile.py StepProfiler.span_attrs); {} when profiling is
+    off or the trainer shape has no profiler."""
+    fn = getattr(trainer, "step_profile_attrs", None)
+    return fn() if fn is not None else {}
+
+
 def _auth_key() -> bytes | None:
     """Shared-secret HMAC key for the TCP demo-parity mode, from the
     FEDTPU_SECRET env var (never argv — process listings leak flags). The
@@ -249,6 +257,11 @@ def cmd_client(args) -> int:
                 f"[CLIENT {args.client_id}] warm start from "
                 f"{cfg.checkpoint_dir} (step {step})"
             )
+        from ..obs.profile import note_memory
+
+        # Device-memory watermark at the restore boundary
+        # (obs/profile.py; graceful no-op on stats-less backends).
+        note_memory("post-restore")
         ckpt = Checkpointer(cfg.checkpoint_dir)
 
     import jax
@@ -376,8 +389,14 @@ def cmd_client(args) -> int:
             )
         # Buffered until the exchange reveals the round's trace id —
         # the span then lands with the server's (trace, round) identity.
+        # Step-profile attrs (obs/profile.py, --profile-stride) ride the
+        # span so the timeline can render this client's device-vs-host
+        # split; {} when profiling is off.
         fed.note_local_phase(
-            t_local, tinfo["seconds"], client=args.client_id
+            t_local,
+            tinfo["seconds"],
+            client=args.client_id,
+            **_step_attrs(trainer),
         )
         local = trainer.evaluate_state(state, client_data.test)
         if ckpt is not None:
@@ -449,6 +468,9 @@ def cmd_client(args) -> int:
             # a meshed trainer scatters the aggregate onto its device mesh
             # here, with no intermediate full-replica state.
             state = trainer.adopt_aggregate(state, aggregated)
+            from ..obs.profile import note_memory as _note_memory
+
+            _note_memory("post-round")
             if ckpt is not None:
                 # Post-aggregate save — the reference's client1.py:403.
                 save_seq += 1

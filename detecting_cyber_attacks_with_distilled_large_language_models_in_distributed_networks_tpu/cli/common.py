@@ -102,6 +102,22 @@ def _obs_setup(
     # Same unconditional rule as the tracer: clear a previous in-process
     # invocation's recorder when this one doesn't ask for one.
     set_global_recorder(recorder)
+    # Device performance plane (obs/profile.py): install the step-
+    # profiling stride process-wide — unconditional, like the tracer,
+    # so a previous in-process invocation's stride never leaks into a
+    # run that didn't ask for profiling. Trainers/engines built before
+    # this call re-check the stride at fit time.
+    from ..obs.profile import set_profile_stride
+
+    stride = getattr(args, "profile_stride", None)
+    if stride is None and obs_cfg is not None:
+        stride = obs_cfg.profile_stride
+    set_profile_stride(stride or 0)
+    if stride:
+        log.info(
+            f"[OBS] {proc}: step profiling armed (every {stride}th step "
+            "fenced into host/dispatch/device)"
+        )
     port = getattr(args, "metrics_port", None) or (
         obs_cfg.metrics_port if obs_cfg else 0
     )

@@ -22,6 +22,10 @@ controller, registry, infer-serve) appends spans to its own events-JSONL
         # the live-refresh twin (`health --watch` is the same loop)
     fedtpu obs postmortem --flight-dir runs/flight [--bundle NAME]
         # list flight-recorder bundles / inspect one (--json full dump)
+    fedtpu obs profile --preset tiny --steps 12 [--capture DIR]
+        # device performance plane (obs/profile.py): compile ledger by
+        # site, recompile flags, fenced host/dispatch/device step
+        # split, memory watermarks, analytic-vs-XLA FLOPs cross-check
 """
 
 from __future__ import annotations
@@ -136,6 +140,7 @@ def _build_hub(args) -> ScrapeHub:
         recorder = FlightRecorder(
             args.flight_dir, proc="obs-hub", tracer=tracer
         )
+    alert_interval = getattr(args, "alert_interval", None)
     try:
         return ScrapeHub(
             targets,
@@ -145,6 +150,12 @@ def _build_hub(args) -> ScrapeHub:
             scrape_timeout_s=getattr(args, "scrape_timeout", None) or 2.0,
             tracer=tracer,
             recorder=recorder,
+            alert_cmd=getattr(args, "alert_cmd", None),
+            # is-None, not falsy-or: an explicit --alert-interval 0
+            # means "spawn on every page fire", not the 30 s default.
+            alert_cmd_interval_s=(
+                30.0 if alert_interval is None else alert_interval
+            ),
         )
     except ValueError as e:
         raise SystemExit(str(e)) from None
@@ -236,11 +247,57 @@ def _cmd_postmortem(args) -> int:
     return 0
 
 
+def _cmd_profile(args) -> int:
+    """Run the device performance plane end-to-end on real train steps
+    (obs/profile.py run_profile_session) and render the report. Exit 1
+    when a recompile was flagged or the FLOPs ratio broke tolerance —
+    the cron-able "device plane healthy" verdict."""
+    from ..config import ModelConfig, TrainConfig
+    from ..obs.profile import render_profile_report, run_profile_session
+
+    preset = getattr(args, "preset", None) or "tiny"
+    presets = {
+        "tiny": ModelConfig.tiny,
+        "distilbert": ModelConfig,
+        "bert": ModelConfig.bert_base,
+        "bert-large": ModelConfig.bert_large,
+    }
+    if preset not in presets:
+        raise SystemExit(
+            f"unknown --preset {preset!r} (tiny|distilbert|bert|bert-large)"
+        )
+    # `is None` checks, not `or`: an explicit `--stride 0` is the
+    # documented fence-nothing value and must reach the session as 0.
+    steps = getattr(args, "steps", None)
+    batch_size = getattr(args, "batch_size", None)
+    stride = getattr(args, "stride", None)
+    report = run_profile_session(
+        presets[preset](),
+        TrainConfig(),
+        steps=12 if steps is None else steps,
+        batch_size=8 if batch_size is None else batch_size,
+        stride=1 if stride is None else stride,
+        capture_dir=getattr(args, "capture", None),
+    )
+    if getattr(args, "json", False):
+        json.dump(report, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render_profile_report(report))
+    broken = bool(report["recompiles"]) or not report["flops_ratio_ok"]
+    srv = report.get("serving")
+    if srv is not None and srv["recompiles"]:
+        broken = True
+    return 1 if broken else 0
+
+
 def cmd_obs(args) -> int:
     if args.action in ("health", "watch"):
         return _cmd_health(args)
     if args.action == "postmortem":
         return _cmd_postmortem(args)
+    if args.action == "profile":
+        return _cmd_profile(args)
     paths = list(getattr(args, "trace", None) or [])
     trace_dir = getattr(args, "trace_dir", None)
     if not paths and not trace_dir:

@@ -221,6 +221,16 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "process its own file and merge with `fedtpu obs timeline "
         "--trace-dir DIR`",
     )
+    p.add_argument(
+        "--profile-stride",
+        type=int,
+        default=None,
+        help="device performance plane (obs/profile.py): fence every Nth "
+        "train/score step into host/dispatch/device-execute timers "
+        "(fedtpu_*_step_seconds on /metrics + step attrs on the "
+        "client-local span). 0/absent = off — the hot loops run the "
+        "literal unprofiled path",
+    )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -1114,7 +1124,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser(
         "obs",
         help="observability: round timelines, Chrome export, live span "
-        "tailing, fleet health (SLO burn alerts), postmortem bundles",
+        "tailing, fleet health (SLO burn alerts), postmortem bundles, "
+        "device profiling",
         epilog="Every tier writes spans with --trace-jsonl; the server "
         "stamps one trace id per round into its replies, so the merged "
         "files agree on (trace, round). `timeline` attributes each "
@@ -1123,12 +1134,19 @@ def build_parser() -> argparse.ArgumentParser:
         "scrapes every --target daemon's /metrics.json, evaluates the "
         "SLO burn rates, and renders the one-screen fleet view (`watch` "
         "= the live-refresh loop); `postmortem` lists/inspects the "
-        "flight recorder's failure bundles (--flight-dir).",
+        "flight recorder's failure bundles (--flight-dir). `profile` "
+        "runs the device performance plane (obs/profile.py) end-to-end "
+        "on real train steps: compile ledger by site, recompile flags, "
+        "fenced host/dispatch/device step split, memory watermarks, "
+        "the analytic-vs-XLA FLOPs cross-check, and the bucketed "
+        "serving path's zero-recompile storm (--capture DIR wraps "
+        "jax.profiler around the profiled steps).",
     )
     p.add_argument(
         "action",
         choices=[
             "timeline", "export", "tail", "health", "watch", "postmortem",
+            "profile",
         ],
     )
     p.add_argument(
@@ -1243,6 +1261,52 @@ def build_parser() -> argparse.ArgumentParser:
         "--bundle",
         help="postmortem: inspect this bundle (name from the list, or "
         "a path) instead of listing",
+    )
+    p.add_argument(
+        "--alert-cmd",
+        help="health/watch: run this shell command once per page-"
+        "severity SLO fire, with the alert event JSON on stdin (the "
+        "notification fan-out next to --alerts-jsonl); rate-limited to "
+        "one spawn per --alert-interval, OSError-guarded — a broken "
+        "pager never kills the poll loop",
+    )
+    p.add_argument(
+        "--alert-interval",
+        type=float,
+        default=None,
+        help="health/watch: minimum seconds between --alert-cmd spawns "
+        "(default 30)",
+    )
+    p.add_argument(
+        "--preset",
+        default="tiny",
+        help="profile: model preset to profile "
+        "(tiny|distilbert|bert|bert-large; default tiny)",
+    )
+    p.add_argument(
+        "--steps",
+        type=int,
+        default=12,
+        help="profile: profiled train steps after warmup (default 12)",
+    )
+    p.add_argument(
+        "--batch-size",
+        type=int,
+        default=8,
+        help="profile: train batch size (default 8)",
+    )
+    p.add_argument(
+        "--stride",
+        type=int,
+        default=1,
+        help="profile: sample every Nth step (default 1 — every step "
+        "fenced; production daemons use --profile-stride instead)",
+    )
+    p.add_argument(
+        "--capture",
+        metavar="DIR",
+        help="profile: additionally wrap jax.profiler around the "
+        "profiled steps and write the trace here (xprof/tensorboard)",
     )
     p.set_defaults(fn=cmd_obs)
 

@@ -34,6 +34,7 @@ import numpy as np
 
 from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
+from ..obs.profile import note_memory
 from ..obs.trace import new_trace_id
 from ..utils.logging import get_logger
 from . import framing, secure, wire
@@ -2633,6 +2634,10 @@ class AggregationServer:
             self.phase_seconds[name] += dur
             self._m_phase[name].inc(max(dur, 0.0))
         self._h_round.observe(max(round_wall, 0.0))
+        # Device-memory watermark at the round's aggregation boundary
+        # (obs/profile.py): meaningful on accelerator-backed server
+        # hosts, a graceful no-op on the host-only numpy tier.
+        note_memory("post-aggregate")
         if failed:
             self._m_round_failures.inc()
         if rnd.stream is not None:

@@ -611,6 +611,13 @@ class ObsConfig:
     flight_dir: str | None = None
     #: Span-ring depth the flight recorder retains per process.
     flight_ring: int = 256
+    #: Device performance plane (obs/profile.py): sample every Nth
+    #: train/score step with fenced host/dispatch/device timers
+    #: (``fedtpu_*_step_seconds`` histograms + span attrs). 0 (default)
+    #: = off — the hot loops run the literal unprofiled path (no
+    #: fences, no timer reads). The matching CLI flag is
+    #: ``--profile-stride``; a deterministic counter stride, no RNG.
+    profile_stride: int = 0
 
     def __post_init__(self) -> None:
         if not 0 <= self.metrics_port <= 65535:
@@ -625,6 +632,11 @@ class ObsConfig:
         if self.flight_ring < 1:
             raise ValueError(
                 f"flight_ring={self.flight_ring} must be >= 1"
+            )
+        if self.profile_stride < 0:
+            raise ValueError(
+                f"profile_stride={self.profile_stride} must be >= 0 "
+                "(0 = off)"
             )
 
 

@@ -1,6 +1,6 @@
 """Cross-tier observability: tracing, metrics, timelines, fleet health.
 
-Six pieces (see each module's docstring):
+Seven pieces (see each module's docstring):
 
 * :mod:`.trace` — round-scoped trace contexts with span ids propagated
   across the TCP wire protocols via an optional meta field; every
@@ -16,6 +16,10 @@ Six pieces (see each module's docstring):
   poll every daemon, merge into fleet snapshots, judge the SLOs.
 * :mod:`.flight` — the failure flight recorder: bounded in-memory rings
   dumped as postmortem bundles on round failure / eject storm / SLO page.
+* :mod:`.profile` — the device performance plane: XLA compile ledger
+  (per-site compile/recompile accounting), strided fenced step-time
+  attribution, device-memory watermarks, and the analytic-vs-XLA FLOPs
+  cross-check behind ``fedtpu obs profile`` / ``BENCH_MODE=profile``.
 """
 
 from .flight import (  # noqa: F401
@@ -33,6 +37,20 @@ from .metrics import (  # noqa: F401
     MetricsServer,
     default_registry,
     maybe_start_metrics_server,
+)
+from .profile import (  # noqa: F401
+    FLOPS_RATIO_TOLERANCE,
+    CompileLedger,
+    StepProfiler,
+    default_ledger,
+    device_memory_stats,
+    memory_report,
+    note_memory,
+    profile_stride,
+    render_profile_report,
+    run_profile_session,
+    set_profile_stride,
+    xla_cost_flops,
 )
 from .slo import (  # noqa: F401
     SLO,

@@ -170,6 +170,8 @@ class ScrapeHub:
         scrape_timeout_s: float = 2.0,
         tracer=None,
         recorder=None,
+        alert_cmd: str | None = None,
+        alert_cmd_interval_s: float = 30.0,
     ):
         self.targets = list(targets)
         if not self.targets:
@@ -181,7 +183,11 @@ class ScrapeHub:
         self.scrape_timeout_s = float(scrape_timeout_s)
         self.tracer = tracer
         self.alerts = AlertManager(
-            slos, sink_path=alerts_jsonl, recorder=recorder
+            slos,
+            sink_path=alerts_jsonl,
+            recorder=recorder,
+            alert_cmd=alert_cmd,
+            alert_cmd_interval_s=alert_cmd_interval_s,
         )
         self._lock = threading.Lock()
         # target.key -> scrape state: last summary, cadence base, events

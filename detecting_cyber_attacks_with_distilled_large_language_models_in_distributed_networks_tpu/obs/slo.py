@@ -305,6 +305,14 @@ class AlertManager:
     the installed flight recorder, so an SLO page leaves a postmortem
     bundle behind without any daemon-side wiring.
 
+    ``alert_cmd`` is the notification fan-out (``--alert-cmd``): a user
+    shell command spawned once per page-severity fire with the alert
+    event JSON on stdin — the alerts-JSONL stops being the only
+    consumer. Rate-limited to one spawn per ``alert_cmd_interval_s``
+    on the EVENT clock (the same injectable timeline the burn windows
+    ride, so tests drive it synthetically), and OSError-guarded: a
+    broken pager never kills the poll loop.
+
     Thread-safe: the scrape hub's watch loop and a test driving
     synthetic snapshots both funnel through one lock.
     """
@@ -316,6 +324,8 @@ class AlertManager:
         sink_path: str | None = None,
         on_event: Callable[[dict], None] | None = None,
         recorder=None,
+        alert_cmd: str | None = None,
+        alert_cmd_interval_s: float = 30.0,
     ):
         self.slos = tuple(slos if slos is not None else default_slos())
         names = [s.name for s in self.slos]
@@ -324,12 +334,17 @@ class AlertManager:
         self.sink_path = sink_path
         self.on_event = on_event
         self._recorder = recorder
+        self.alert_cmd = alert_cmd
+        self.alert_cmd_interval_s = float(alert_cmd_interval_s)
         self._lock = threading.Lock()
+        self._last_notify_ts: float | None = None
         # (slo.name, instance) -> {"series": _BurnSeries, "firing": bool,
         #                          "since": ts, "last_burn": {...}}
         self._state: dict[tuple[str, str], dict] = {}
         self.fired_total = 0
         self.cleared_total = 0
+        self.notified_total = 0
+        self.notify_suppressed_total = 0
 
     # ------------------------------------------------------------- ingest
     def ingest(
@@ -429,6 +444,7 @@ class AlertManager:
                 pass
         if self.on_event is not None:
             self.on_event(ev)
+        self._notify(ev)
         rec = self._recorder
         if rec is None:
             from .flight import get_global_recorder
@@ -447,6 +463,52 @@ class AlertManager:
                 rec.maybe_dump("slo-page", extra=ev)
             except OSError:
                 pass
+
+    def _notify(self, ev: dict) -> None:
+        """Spawn ``alert_cmd`` for one page-severity fire (event JSON on
+        stdin, fire-and-forget). Rate limit rides the event's own ``ts``
+        — the injectable clock every burn decision already uses."""
+        if (
+            self.alert_cmd is None
+            or ev["event"] != "fire"
+            or ev["severity"] != "page"
+        ):
+            return
+        now = float(ev["ts"])
+        with self._lock:
+            last = self._last_notify_ts
+            if last is not None and now - last < self.alert_cmd_interval_s:
+                self.notify_suppressed_total += 1
+                return
+            self._last_notify_ts = now
+        import json
+        import subprocess
+
+        try:
+            proc = subprocess.Popen(
+                self.alert_cmd,
+                shell=True,
+                stdin=subprocess.PIPE,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+        except (OSError, ValueError):
+            # A broken pager (missing shell, bad fd) must never kill
+            # the poll loop at the moment the fleet paged. The rate-
+            # limit slot stays claimed: a persistently broken command
+            # retries once per interval, not once per event.
+            return
+        try:
+            if proc.stdin is not None:
+                proc.stdin.write((json.dumps(ev) + "\n").encode())
+                proc.stdin.close()
+        except OSError:
+            # The command spawned but exited before reading stdin
+            # (BrokenPipe) — that IS a delivered notification; a pager
+            # is free to ignore its input.
+            pass
+        with self._lock:
+            self.notified_total += 1
 
     # ------------------------------------------------------------- render
     def states(self) -> list[dict]:

@@ -304,6 +304,21 @@ def timeline_table(
                     f"{row['reply_s']:>8.3f}s {row['attributed_s']:>8.3f}s "
                     f"{row['measured_s']:>8.3f}s"
                 )
+        # Device-vs-host row (obs/profile.py StepProfiler): client-local
+        # spans carrying sampled step attrs split their compute seconds
+        # into host batch-prep / dispatch / device-execute.
+        for s in groups[key]:
+            if s["span"] != "client-local" or s.get(
+                "step_device_ms_p50"
+            ) is None:
+                continue
+            out.append(
+                f"  step-profile   {str(s.get('proc', '?')):<14} "
+                f"host {s.get('step_host_ms_p50', 0.0):.2f}ms  "
+                f"dispatch {s.get('step_dispatch_ms_p50', 0.0):.2f}ms  "
+                f"device {s['step_device_ms_p50']:.2f}ms p50 "
+                f"({s.get('step_sampled', 0)} sampled)"
+            )
         if b["overlap_s"] > 0.0:
             # Overlapped vs exposed wire/aggregation time: fold seconds
             # hidden inside the wire phase, next to the exposed agg.
@@ -330,6 +345,7 @@ def timeline_table(
                 "slo-eval",
                 "postmortem-dump",
                 "drift-trigger",
+                "xla-compile",
             )
         ]
         for s in extra:
@@ -351,14 +367,18 @@ def timeline_table(
     unscoped = [
         s
         for s in groups.get((None, None), ())
-        if s["span"] in ("postmortem-dump", "drift-trigger", "slo-eval")
+        if s["span"]
+        in ("postmortem-dump", "drift-trigger", "slo-eval", "xla-compile")
     ]
     if unscoped and round_filter is None:
         out.append("unscoped health-plane spans:")
         for s in unscoped[-10:]:
             attrs = " ".join(
                 f"{k}={s[k]}"
-                for k in ("reason", "bundle", "drift", "firing", "up")
+                for k in (
+                    "reason", "bundle", "drift", "firing", "up",
+                    "site", "recompile",
+                )
                 if s.get(k) is not None
             )
             out.append(

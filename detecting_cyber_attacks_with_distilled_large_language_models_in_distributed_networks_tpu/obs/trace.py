@@ -55,6 +55,11 @@ Span vocabulary (names are the contract the timeline tool groups by)::
     drift-trigger the controller's drift verdict that started a round
                   (control/controller.py), with the distance, method,
                   and ``top_bins`` per-bin PSI localization
+    xla-compile   one XLA trace+compile of a jitted program
+                  (obs/profile.py CompileLedger), with ``site``/
+                  ``signature`` and ``recompile=True`` when the shape
+                  appeared at an already-warm site (the flagged event
+                  that can trip the flight recorder)
 
 Timestamps are wall-clock unix seconds (``ts``) with a separately
 measured monotonic duration (``dur_s``): cross-process correlation needs
@@ -95,6 +100,7 @@ SPAN_NAMES = (
     "slo-eval",
     "postmortem-dump",
     "drift-trigger",
+    "xla-compile",
 )
 
 #: Wire meta key the trace id rides under (comm/server.py reply meta,

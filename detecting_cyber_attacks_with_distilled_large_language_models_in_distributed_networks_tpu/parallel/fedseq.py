@@ -371,8 +371,13 @@ def build_fedseq_steps(cfg, model, optimizer, mesh: Mesh) -> FedSeqSteps:
 
     loss = make_fedseq_loss(model, mesh, dropout=dropout, prox_mu=mu)
     batch_sh = {"input_ids": seq_sh, "attention_mask": seq_sh, "labels": row_sh}
+    from ..obs.profile import default_ledger
+
+    ledger = default_ledger()
+    note_train = ledger.hook("fedseq.train_step")
 
     def _train_body(state: FedState, batch, anchor):
+        note_train(tuple(batch["input_ids"].shape))
         keys = (
             (jax.vmap(jax.random.fold_in, in_axes=(0, None))(
                 state.rngs, state.step
@@ -425,6 +430,7 @@ def build_fedseq_steps(cfg, model, optimizer, mesh: Mesh) -> FedSeqSteps:
             in_shardings=(state_sh, batch_sh),
             out_shardings=(state_sh, csh),
         )(lambda state, batch: _train_body(state, batch, None))
+    train_step = ledger.timed("fedseq.train_step", train_step)
 
     ragged_batch_sh = dict(batch_sh, valid=row_sh, warmup_step=row_sh)
     masked_loss = make_fedseq_masked_loss(

@@ -15,9 +15,15 @@ which is what makes served probabilities bit-for-bit equal to ``fedtpu
 predict``'s (pinned in tests/test_serving.py).
 
 Compile counting: the Python body of a jitted function runs once per
-traced shape — so the counter increment inside ``_probs`` IS a compile
-hook, not a call counter. ``compile_counts`` maps (batch, seq) to trace
-count; the e2e test storms mixed sizes and asserts every value == 1.
+traced shape — so a trace hook inside ``_probs`` IS a compile hook, not
+a call counter. That discipline is now the repo-wide
+:class:`~..obs.profile.CompileLedger` (this module pioneered it as a
+local dict); each engine holds a PRIVATE ledger under the
+``serving.probs`` site so ``compile_counts`` stays per-engine while the
+``fedtpu_xla_*`` /metrics families aggregate process-wide.
+``compile_counts`` maps (batch, seq) to trace count; the e2e test
+storms mixed sizes and asserts every value == 1, and ``warmup()`` marks
+the site warm so any later novel shape is flagged as a recompile.
 """
 
 from __future__ import annotations
@@ -29,6 +35,7 @@ import numpy as np
 
 from ..config import ModelConfig
 from ..models.distilbert import DDoSClassifier
+from ..obs.profile import CompileLedger, maybe_step_profiler, profile_stride
 from ..utils.logging import get_logger
 
 log = get_logger()
@@ -61,7 +68,15 @@ class ScoreEngine:
         self.pad_id = int(pad_id)
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.seq_len = int(model_cfg.max_len)
-        self.compile_counts: dict[tuple[int, int], int] = {}
+        # Private compile ledger (obs/profile.py): per-engine counts —
+        # two engines in one process must not mix their compile-count
+        # assertions — while the metric families it increments are the
+        # shared process-wide fedtpu_xla_* ones.
+        self.ledger = CompileLedger()
+        note_compile = self.ledger.hook("serving.probs")
+        # Score-path step attribution: armed only when profiling is on
+        # process-wide (--profile-stride / ObsConfig.profile_stride).
+        self.step_profiler = maybe_step_profiler("score")
         self._lock = threading.Lock()
         self._params = jax.device_put(params)
         self._round_id = int(round_id)
@@ -70,15 +85,21 @@ class ScoreEngine:
         def _probs(p, input_ids, attention_mask):
             # Trace-time hook: this Python body runs exactly once per
             # (batch, seq) shape — each execution of the compiled program
-            # skips it. The dict update is the compile counter.
-            shape = (input_ids.shape[0], input_ids.shape[1])
-            self.compile_counts[shape] = self.compile_counts.get(shape, 0) + 1
+            # skips it. The ledger note is the compile counter.
+            note_compile((input_ids.shape[0], input_ids.shape[1]))
             logits = model.apply(
                 {"params": p}, input_ids, attention_mask, True
             )
             return jax.nn.softmax(logits, axis=-1)[:, 1]
 
-        self._probs = jax.jit(_probs)
+        self._probs = self.ledger.timed("serving.probs", jax.jit(_probs))
+
+    @property
+    def compile_counts(self) -> dict[tuple[int, int], int]:
+        """(batch, seq) -> trace count, straight off the ledger (the
+        pre-ledger dict's exact shape; stats() and the compile-count-
+        asserted tests read it unchanged)."""
+        return self.ledger.compile_counts("serving.probs")
 
     # ------------------------------------------------------------ versioning
     @property
@@ -112,12 +133,16 @@ class ScoreEngine:
         )
 
     def warmup(self) -> None:
-        """Pay every bucket's compilation before traffic arrives."""
+        """Pay every bucket's compilation before traffic arrives, then
+        mark the site warm: any later novel shape is a flagged recompile
+        (obs/profile.py — the bucket ladder makes one impossible unless
+        the padding discipline breaks)."""
         for b in self.buckets:
             self.score(
                 np.full((b, self.seq_len), self.pad_id, np.int32),
                 np.zeros((b, self.seq_len), np.int32),
             )
+        self.ledger.mark_warm("serving.probs")
         log.info(
             f"[SERVE] warmed {len(self.buckets)} bucket programs "
             f"(batch in {self.buckets}, seq {self.seq_len})"
@@ -140,6 +165,16 @@ class ScoreEngine:
                 f"rows have seq {input_ids.shape[1]}, engine expects "
                 f"{self.seq_len}"
             )
+        # Strided step attribution (obs/profile.py): a sampled dispatch
+        # splits host pad-prep / dispatch / device-execute; unsampled
+        # dispatches (and profiling off) run the bare path. Re-checked
+        # lazily (one lock-free int read when off) because the CLI
+        # installs the stride after the engine is built.
+        prof = self.step_profiler
+        if prof is None and profile_stride() > 0:
+            prof = self.step_profiler = maybe_step_profiler("score")
+        sampled = prof.tick() if prof is not None else False
+        t0 = prof.clock() if sampled else 0.0
         if n < bucket:
             pad_ids = np.full(
                 (bucket - n, self.seq_len), self.pad_id, np.int32
@@ -148,9 +183,14 @@ class ScoreEngine:
             input_ids = np.concatenate([input_ids, pad_ids])
             attention_mask = np.concatenate([attention_mask, pad_mask])
         params, round_id = self.snapshot()
-        probs = self._probs(
-            params,
-            np.ascontiguousarray(input_ids, np.int32),
-            np.ascontiguousarray(attention_mask, np.int32),
-        )
+        ids = np.ascontiguousarray(input_ids, np.int32)
+        mask = np.ascontiguousarray(attention_mask, np.int32)
+        if sampled:
+            prof.note_host(prof.clock() - t0)
+            t1 = prof.clock()
+            probs = self._probs(params, ids, mask)
+            prof.note_dispatch(prof.clock() - t1)
+            prof.fence(probs)
+        else:
+            probs = self._probs(params, ids, mask)
         return np.asarray(probs)[:n], bucket, round_id

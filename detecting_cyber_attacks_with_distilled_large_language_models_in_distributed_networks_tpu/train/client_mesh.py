@@ -258,6 +258,12 @@ class FedSeqClientTrainer:
             self._train_cache[1], epoch, batch_size, k=k
         )
 
+    def step_profile_attrs(self) -> dict:
+        """The inner fedseq trainer's sampled step attrs (obs/profile.py)
+        — the TCP round loop stamps them on the client-local span."""
+        prof = self.inner.step_profiler
+        return prof.span_attrs() if prof is not None else {}
+
     def host_params(self, state) -> Any:
         """One replica of the single client's params, unstacked, on host —
         the wire-upload form."""

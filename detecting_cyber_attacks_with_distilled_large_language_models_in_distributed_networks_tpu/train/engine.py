@@ -23,6 +23,12 @@ import optax
 from ..config import ModelConfig, TrainConfig
 from ..data.pipeline import TokenizedSplit, batch_iterator, pad_split_to_batch
 from ..models.distilbert import DDoSClassifier, init_params
+from ..obs.profile import (
+    default_ledger,
+    maybe_step_profiler,
+    note_memory,
+    profiled_step_iter,
+)
 from ..ops.metrics import BinaryCounts, binary_counts, finalize_metrics
 from .batches import PrefetchSlot
 from ..utils.logging import get_logger
@@ -211,9 +217,14 @@ def make_train_step(
     warmup_steps: int = 0,
 ) -> Callable[[TrainState, dict], tuple[TrainState, jnp.ndarray]]:
     """One jitted SGD step; params/opt_state buffers are donated."""
+    ledger = default_ledger()
+    note_compile = ledger.hook("engine.train_step")
 
     @partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, batch) -> tuple[TrainState, jnp.ndarray]:
+        # Compile-ledger trace hook (obs/profile.py): this body runs once
+        # per traced shape, so the note IS a compile event, never a call.
+        note_compile(tuple(batch["input_ids"].shape))
         step_rng = jax.random.fold_in(state.rng, state.step)
         loss, grads = jax.value_and_grad(
             lambda p: loss_fn(model, p, batch, step_rng)
@@ -223,17 +234,20 @@ def make_train_step(
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1, state.rng), loss
 
-    return train_step
+    return ledger.timed("engine.train_step", train_step)
 
 
 def make_eval_step(model: DDoSClassifier) -> Callable:
     """Jitted eval step -> (BinaryCounts, P(class 1) probs for ROC/PR)."""
+    ledger = default_ledger()
+    note_compile = ledger.hook("engine.eval_step")
 
     @jax.jit
     def eval_step(params, batch, valid) -> tuple[BinaryCounts, jnp.ndarray]:
+        note_compile(tuple(batch["input_ids"].shape))
         return eval_counts(model, params, batch, valid)
 
-    return eval_step
+    return ledger.timed("engine.eval_step", eval_step)
 
 
 @lru_cache(maxsize=None)
@@ -298,6 +312,12 @@ class Trainer:
         self.model, self.optimizer, self.train_step, self.eval_step = (
             _engine_steps(model_cfg, train_cfg)
         )
+        # Step-time attribution (obs/profile.py): None unless profiling
+        # is armed process-wide (--profile-stride / ObsConfig) — the hot
+        # loop then runs the literal pre-profiling path. Re-checked at
+        # fit time because the CLI installs the stride after trainers
+        # are built.
+        self.step_profiler = maybe_step_profiler("train")
 
     def init_state(self, seed: int | None = None, params: Any | None = None) -> TrainState:
         seed = self.train_cfg.seed if seed is None else seed
@@ -382,6 +402,24 @@ class Trainer:
             k=k,
         )
 
+    def _armed_profiler(self):
+        """The fit loop's step profiler: the one built at construction,
+        or a late arm when the CLI installed the stride afterwards, with
+        a fresh reporting window either way. None = profiling off (the
+        zero-overhead path)."""
+        prof = self.step_profiler
+        if prof is None:
+            prof = self.step_profiler = maybe_step_profiler("train")
+        if prof is not None:
+            prof.begin_window()
+        return prof
+
+    def step_profile_attrs(self) -> dict:
+        """Sampled step p50/p95 attrs of the last fit window (ms) for
+        stamping on the client-local span; {} when profiling is off."""
+        prof = self.step_profiler
+        return prof.span_attrs() if prof is not None else {}
+
     def fit(
         self,
         state: TrainState,
@@ -424,14 +462,33 @@ class Trainer:
         telemetry = make_step_telemetry(
             self.train_cfg.log_every, prefix=tag, label=loss_label
         )
+        prof = self._armed_profiler()
+        first_memory = prof is not None
+        last_loss = None  # carried ACROSS epochs: the drain fence target
         for epoch in range(epoch_offset, epoch_offset + epochs):
             # Collect device scalars and sync once per epoch — float(loss)
             # per step would block async dispatch and stall the TPU.
             losses: list[jnp.ndarray] = []
-            for batch in self.epoch_batches(split, epoch, batch_size):
-                state, loss = step_fn(state, batch)
+            for batch, sampled in profiled_step_iter(
+                prof, self.epoch_batches(split, epoch, batch_size)
+            ):
+                if sampled:
+                    # Fenced sampled step: drain the async backlog so
+                    # the measurement is this step's own device work,
+                    # then split dispatch from device-execute.
+                    prof.drain(last_loss)
+                    t0 = prof.clock()
+                    state, loss = step_fn(state, batch)
+                    prof.note_dispatch(prof.clock() - t0)
+                    prof.fence(loss)
+                else:
+                    state, loss = step_fn(state, batch)
                 losses.append(loss)
+                last_loss = loss
                 telemetry(loss, batch_size)
+                if first_memory:
+                    first_memory = False
+                    note_memory("post-first-step")
             avg = float(jnp.stack(losses).mean()) if losses else 0.0
             epoch_losses.append(avg)
             log.info(

@@ -34,6 +34,7 @@ import numpy as np
 from ..config import ExperimentConfig
 from ..data.pipeline import StackedClients, TokenizedSplit
 from ..models.distilbert import DDoSClassifier, init_params
+from ..obs.profile import maybe_step_profiler, note_memory, profiled_step_iter
 from ..parallel.fedavg import stack_params
 from ..parallel.mesh import FedShardings, make_mesh
 from ..train.engine import make_optimizer
@@ -118,6 +119,10 @@ class FederatedTrainer:
         # the global tracer (set_global_tracer) is the fallback so
         # embedded constructions need no plumbing.
         self.tracer = None
+        # Step-time attribution (obs/profile.py): None unless profiling
+        # is armed process-wide; re-checked at fit time because the CLI
+        # installs the stride after trainers are built.
+        self.step_profiler = maybe_step_profiler("train")
         # One-slot epoch prefetch (train/batches.PrefetchSlot), armed
         # by prefetch_epoch while the round's wire exchange is in flight;
         # _epoch_batches consumes a matching key, so the batch sequence
@@ -352,6 +357,19 @@ class FederatedTrainer:
             k=k,
         )
 
+    def _armed_profiler(self):
+        """The fit loops' shared step profiler: the one built at
+        construction, or a late arm when the CLI installed the stride
+        afterwards, with a fresh reporting window either way (the same
+        helper shape as engine.Trainer._armed_profiler — the dense and
+        packed loops must not drift). None = profiling off."""
+        prof = self.step_profiler
+        if prof is None:
+            prof = self.step_profiler = maybe_step_profiler("train")
+        if prof is not None:
+            prof.begin_window()
+        return prof
+
     def fit_local(
         self,
         state: FedState,
@@ -373,6 +391,11 @@ class FederatedTrainer:
         — run() and the CLI's own loop — emit one ``client-local`` obs
         span per call, with the round derived from ``epoch_offset`` (the
         loops pass ``r * epochs_per_round``)."""
+        # Arm (and window-reset) the profiler HERE, once per fit — the
+        # dense and packed loops below read the armed instance, and a
+        # ragged fit (unprofiled) still resets the window so its span
+        # never carries a previous fit's samples.
+        prof = self._armed_profiler()
         t_unix = time.time()
         t0 = time.monotonic()
         out = self._fit_local_impl(
@@ -387,6 +410,10 @@ class FederatedTrainer:
             t_unix,
             time.monotonic() - t0,
             epoch_offset // max(self.cfg.train.epochs_per_round, 1),
+            # Sampled step-time attribution (obs/profile.py): host vs
+            # dispatch vs device p50/p95 ride the span so the timeline
+            # can render the device-vs-host row. {} when profiling off.
+            **(prof.span_attrs() if prof is not None else {}),
         )
         return out
 
@@ -442,13 +469,31 @@ class FederatedTrainer:
             step = self.train_step
         out = []
         telemetry = self._step_telemetry()
+        prof = self.step_profiler  # armed + window-reset by fit_local
+        first_memory = prof is not None
+        last_loss = None  # carried ACROSS epochs: the drain fence target
         for epoch in range(epoch_offset, epoch_offset + E):
             losses = []
             batches = self._epoch_batches(stacked_train, bs, epoch)
-            for _, batch in zip(range(n_batches), batches):
-                state, loss = step(state, self._feed(batch))
+            for batch, sampled in profiled_step_iter(
+                prof, (b for _, b in zip(range(n_batches), batches))
+            ):
+                if sampled:
+                    # Fenced sampled step (obs/profile.py): drain the
+                    # async backlog, then split dispatch from device.
+                    prof.drain(last_loss)
+                    t_d = prof.clock()
+                    state, loss = step(state, self._feed(batch))
+                    prof.note_dispatch(prof.clock() - t_d)
+                    prof.fence(loss)
+                else:
+                    state, loss = step(state, self._feed(batch))
                 losses.append(loss)
+                last_loss = loss
                 telemetry(loss, batch["labels"].size)
+                if first_memory:
+                    first_memory = False
+                    note_memory("post-first-step")
             epoch_avg = jnp.stack(losses).mean(axis=0) if losses else jnp.zeros(self.C)
             out.append(self._host(epoch_avg))
             for c in range(self.C):
@@ -581,10 +626,21 @@ class FederatedTrainer:
         cstates = self._unstack_cstates(state)
         out = []
         telemetry = self._step_telemetry()
+        prof = self.step_profiler  # armed + window-reset by fit_local
+        first_memory = prof is not None
+        last_loss = None  # carried ACROSS epochs: the drain fence target
         for epoch in range(epoch_offset, epoch_offset + E):
             losses = []
             batches = self._epoch_batches(stacked_train, bs, epoch)
-            for _, batch in zip(range(n_batches), batches):
+            for batch, sampled in profiled_step_iter(
+                prof, (b for _, b in zip(range(n_batches), batches))
+            ):
+                # A "step" here is one full lockstep batch: C per-client
+                # dispatches. A sampled one fences the previous batch's
+                # losses first, then splits dispatch from device.
+                if sampled:
+                    prof.drain(last_loss)
+                    t_d = prof.clock()
                 per = []
                 for c in range(C):
                     cb = {k: v[c] for k, v in batch.items()}
@@ -596,8 +652,15 @@ class FederatedTrainer:
                         cstates[c], task = step_fn(cstates[c], cb)
                     per.append(task)
                 loss_vec = jnp.stack(per)
+                if sampled:
+                    prof.note_dispatch(prof.clock() - t_d)
+                    prof.fence(loss_vec)
                 losses.append(loss_vec)
+                last_loss = loss_vec
                 telemetry(loss_vec, batch["labels"].size)
+                if first_memory:
+                    first_memory = False
+                    note_memory("post-first-step")
             epoch_avg = (
                 jnp.stack(losses).mean(axis=0) if losses else jnp.zeros(C)
             )
@@ -735,7 +798,12 @@ class FederatedTrainer:
         return self.tracer if self.tracer is not None else get_global_tracer()
 
     def _trace_phase(
-        self, name: str, t_start: float, dur_s: float, round_index: int
+        self,
+        name: str,
+        t_start: float,
+        dur_s: float,
+        round_index: int,
+        **extra: Any,
     ) -> None:
         tracer = self._obs_tracer()
         if tracer is not None:
@@ -745,6 +813,7 @@ class FederatedTrainer:
                 dur_s=dur_s,
                 round=round_index,
                 **self._trace_attrs(),
+                **extra,
             )
 
     def evaluate_clients(
@@ -865,6 +934,9 @@ class FederatedTrainer:
             enforce_min_fraction=not poisson,
         )
         self._trace_phase("agg", t_unix, time.monotonic() - t0, round_index)
+        # Memory watermark at the round's aggregation boundary
+        # (obs/profile.py — graceful no-op on stats-less backends).
+        note_memory("post-aggregate")
         return state
 
     def round_anchor(self, state: FedState) -> Any | None:

@@ -22,6 +22,7 @@ import optax
 
 import numpy as np
 
+from ..obs.profile import default_ledger
 from ..parallel.fedavg import make_fedavg_step
 from ..train.engine import (
     apply_warmup,
@@ -47,7 +48,10 @@ def make_packed_step(objective, optimizer, wsteps: int, mu: float) -> Callable:
     ``cstate = (params, opt_state, step, rng)`` (one client's buffers,
     donated)."""
 
+    note_compile = default_ledger().hook("fed.packed_step")
+
     def body(cstate, batch, anchor):
+        note_compile(tuple(batch["input_ids"].shape))
         params, opt_state, step, rng = cstate
         step_rng = jax.random.fold_in(rng, step)
         (_, task), grads = jax.value_and_grad(
@@ -62,11 +66,13 @@ def make_packed_step(objective, optimizer, wsteps: int, mu: float) -> Callable:
         )
 
     if mu > 0.0:
-        return jax.jit(body, donate_argnums=(0,))
-    return jax.jit(
-        lambda cstate, batch: body(cstate, batch, None),
-        donate_argnums=(0,),
-    )
+        jitted = jax.jit(body, donate_argnums=(0,))
+    else:
+        jitted = jax.jit(
+            lambda cstate, batch: body(cstate, batch, None),
+            donate_argnums=(0,),
+        )
+    return default_ledger().timed("fed.packed_step", jitted)
 
 
 class FedState(NamedTuple):
@@ -131,8 +137,11 @@ def build_federated_steps(cfg, model, optimizer, sh) -> FedSteps:
 
     state_sh = FedState(csh, csh, sh.replicated, csh, sh.replicated)
     batch_sh = {"input_ids": bsh, "attention_mask": bsh, "labels": bsh}
+    ledger = default_ledger()
+    note_train = ledger.hook("fed.train_step")
 
     def _step_body(state: FedState, batch, anchor):
+        note_train(tuple(batch["input_ids"].shape))
         step_rngs = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
             state.rngs, state.step
         )
@@ -165,6 +174,7 @@ def build_federated_steps(cfg, model, optimizer, sh) -> FedSteps:
             in_shardings=(state_sh, batch_sh),
             out_shardings=(state_sh, csh),
         )(lambda state, batch: _step_body(state, batch, None))
+    train_step = ledger.timed("fed.train_step", train_step)
 
     def per_client_step_masked(params, opt_state, batch, rng, anchor):
         """Row-masked variant for the ragged stacked path: the loss
@@ -197,8 +207,10 @@ def build_federated_steps(cfg, model, optimizer, sh) -> FedSteps:
         return params, opt_state, task, has.astype(jnp.float32)
 
     ragged_batch_sh = dict(batch_sh, valid=bsh, warmup_step=bsh)
+    note_ragged = ledger.hook("fed.ragged_step")
 
     def _ragged_body(state: FedState, batch, anchor):
+        note_ragged(tuple(batch["input_ids"].shape))
         step_rngs = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
             state.rngs, state.step
         )
@@ -239,18 +251,22 @@ def build_federated_steps(cfg, model, optimizer, sh) -> FedSteps:
         the extra compilation); memoized so same-config trainers share the
         compiled executable."""
         if mu > 0.0:
-            return partial(
+            jitted = partial(
                 jax.jit,
                 donate_argnums=(0,),
                 in_shardings=(state_sh, ragged_batch_sh, csh),
                 out_shardings=(state_sh, (csh, csh)),
             )(_ragged_body)
-        return partial(
-            jax.jit,
-            donate_argnums=(0,),
-            in_shardings=(state_sh, ragged_batch_sh),
-            out_shardings=(state_sh, (csh, csh)),
-        )(lambda state, batch: _ragged_body(state, batch, None))
+        else:
+            jitted = partial(
+                jax.jit,
+                donate_argnums=(0,),
+                in_shardings=(state_sh, ragged_batch_sh),
+                out_shardings=(state_sh, (csh, csh)),
+            )(lambda state, batch: _ragged_body(state, batch, None))
+        return ledger.timed("fed.ragged_step", jitted)
+
+    note_eval = ledger.hook("fed.eval_step")
 
     @partial(
         jax.jit,
@@ -261,9 +277,12 @@ def build_federated_steps(cfg, model, optimizer, sh) -> FedSteps:
         ),
     )
     def eval_step(stacked_params, batch, valid):
+        note_eval(tuple(batch["input_ids"].shape))
         return jax.vmap(lambda p, b, v: eval_counts(model, p, b, v))(
             stacked_params, batch, valid
         )
+
+    eval_step = ledger.timed("fed.eval_step", eval_step)
 
     if cfg.fed.server_opt_enabled():
         from ..parallel.fedavg import make_server_optimizer, weighted_mean

@@ -1,0 +1,602 @@
+"""Device performance plane (ISSUE 12): the XLA compile ledger's
+per-(site, signature) accounting + recompile flagging, strided fenced
+step-time attribution (and its zero-overhead off path), memory
+watermarks degrading gracefully on stats-less backends, the
+analytic-vs-XLA FLOPs cross-check, the serving engine's ledger dedupe,
+the AlertManager --alert-cmd notification fan-out, and the
+`fedtpu obs profile` CLI."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+    ModelConfig,
+    TrainConfig,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.pipeline import (
+    TokenizedSplit,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs import (
+    SLO,
+    AlertManager,
+    FlightRecorder,
+    MetricsRegistry,
+    set_global_recorder,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs.profile import (
+    FLOPS_RATIO_TOLERANCE,
+    CompileLedger,
+    StepProfiler,
+    device_memory_stats,
+    flops_ratio_ok,
+    maybe_step_profiler,
+    note_memory,
+    profiled_step_iter,
+    run_profile_session,
+    set_profile_stride,
+    xla_cost_flops,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.engine import (
+    Trainer,
+)
+
+
+def _tiny_split(n: int = 16, seed: int = 0) -> TokenizedSplit:
+    cfg = ModelConfig.tiny()
+    r = np.random.default_rng(seed)
+    return TokenizedSplit(
+        r.integers(1, cfg.vocab_size, (n, cfg.max_len)).astype(np.int32),
+        np.ones((n, cfg.max_len), np.int32),
+        r.integers(0, 2, n).astype(np.int32),
+    )
+
+
+# ------------------------------------------------------------ compile ledger
+def test_ledger_counts_per_site_and_signature():
+    """One note per traced shape: a repeat call at a warm shape counts
+    nothing, a new shape counts one, and the timed wrapper attributes
+    the compiling call's wall seconds to the ledger."""
+    reg = MetricsRegistry()
+    led = CompileLedger(registry=reg)
+    note = led.hook("t.step")
+
+    @jax.jit
+    def f(x):
+        note(tuple(x.shape))
+        return x * 2
+
+    ft = led.timed("t.step", f)
+    ft(np.ones((2,), np.float32))
+    ft(np.ones((2,), np.float32))  # warm: no new trace
+    ft(np.ones((3,), np.float32))
+    assert led.compile_counts("t.step") == {(2,): 1, (3,): 1}
+    rep = led.report()
+    assert rep["sites"]["t.step"]["compiles"] == 2
+    assert rep["sites"]["t.step"]["signatures"] == 2
+    # The wrapper timed both compiling calls: wall seconds attributed.
+    assert rep["sites"]["t.step"]["trace_s"] > 0.0
+    assert rep["recompiles"] == []
+    # /metrics families carry the same counts.
+    snap = reg.snapshot()["families"]
+    assert snap["fedtpu_xla_compiles_total"]["samples"][0]["value"] == 2.0
+
+
+def test_recompile_storm_exactly_one_event_per_new_signature():
+    """The seeded recompile-storm contract: after mark_warm, each NEW
+    signature is flagged exactly once — repeats of a flagged shape and
+    of pre-warm shapes never re-flag."""
+    reg = MetricsRegistry()
+    led = CompileLedger(registry=reg)
+    note = led.hook("t.step")
+
+    @jax.jit
+    def f(x):
+        note(tuple(x.shape))
+        return x + 1
+
+    f(np.ones((2,), np.float32))
+    led.mark_warm("t.step")
+    # The storm: three novel shapes, each traced once, called twice.
+    for n in (4, 5, 6, 4, 5, 6, 2):
+        f(np.ones((n,), np.float32))
+    events = led.recompiles("t.step")
+    assert [e["signature"] for e in events] == [(4,), (5,), (6,)]
+    snap = reg.snapshot()["families"]
+    assert (
+        snap["fedtpu_xla_recompiles_total"]["samples"][0]["value"] == 3.0
+    )
+
+
+def test_recompile_trips_flight_recorder(tmp_path):
+    """A recompile at a warm site is a flight-recorder trigger: the
+    installed recorder dumps an xla-recompile postmortem bundle."""
+    rec = FlightRecorder(str(tmp_path), proc="prof", min_interval_s=0.0)
+    set_global_recorder(rec)
+    try:
+        led = CompileLedger(registry=MetricsRegistry())
+        note = led.hook("t.step")
+
+        @jax.jit
+        def f(x):
+            note(tuple(x.shape))
+            return x
+
+        f(np.ones((2,), np.float32))
+        led.mark_warm()
+        f(np.ones((3,), np.float32))
+    finally:
+        set_global_recorder(None)
+    bundles = list(tmp_path.glob("postmortem-*.json"))
+    assert len(bundles) == 1
+    b = json.loads(bundles[0].read_text())
+    assert b["reason"] == "xla-recompile"
+    assert b["extra"]["site"] == "t.step"
+
+
+def test_ledger_untimed_site_counts_without_wrapper():
+    """A site registered with only the trace hook (no timed wrapper)
+    still counts compiles — trace seconds just stay unattributed."""
+    led = CompileLedger(registry=MetricsRegistry())
+    note = led.hook("bare")
+
+    @jax.jit
+    def f(x):
+        note(tuple(x.shape))
+        return x
+
+    f(np.ones((2,), np.float32))
+    assert led.compile_counts("bare") == {(2,): 1}
+    assert led.report()["sites"]["bare"]["trace_s"] == 0.0
+
+
+# -------------------------------------------------------- step attribution
+def test_step_profiler_zero_stride_is_off():
+    """Stride 0 is the zero-overhead path: disabled, never samples,
+    registers NO metric families, and the module-level hook returns
+    None so hot loops keep the literal unprofiled shape."""
+    reg = MetricsRegistry()
+    prof = StepProfiler(0, site="train", registry=reg)
+    assert not prof.enabled
+    assert all(not prof.tick() for _ in range(5))
+    assert prof.summary() == {}
+    assert prof.span_attrs() == {}
+    assert reg.snapshot()["families"] == {}
+    set_profile_stride(0)
+    assert maybe_step_profiler("train") is None
+    # The loop shim passes straight through with no profiler.
+    assert [b for b, s in profiled_step_iter(None, iter([1, 2, 3]))] == [
+        1, 2, 3,
+    ]
+
+
+def test_step_profiler_stride_sampling_and_summary():
+    reg = MetricsRegistry()
+    prof = StepProfiler(2, site="train", registry=reg)
+    assert [prof.tick() for _ in range(5)] == [
+        True, False, True, False, True,
+    ]
+    for dt in (0.010, 0.020, 0.030):
+        prof.note_host(0.001)
+        prof.note_dispatch(0.002)
+        prof._note("device", dt)
+    s = prof.summary()
+    assert s["device"]["n"] == 3
+    assert s["device"]["p50"] == pytest.approx(0.020)
+    attrs = prof.span_attrs()
+    assert attrs["step_device_ms_p50"] == pytest.approx(20.0)
+    assert attrs["step_sampled"] == 3
+    fam = reg.snapshot()["families"]["fedtpu_train_step_seconds"]
+    by_phase = {
+        s["labels"]["phase"]: s["count"] for s in fam["samples"]
+    }
+    assert by_phase == {"host": 3, "dispatch": 3, "device": 3}
+
+
+def test_step_profiler_window_attrs_reset_per_fit():
+    """begin_window CLEARS the sample lists (a long-lived daemon must
+    never fill the bound once and silently stop reporting)."""
+    prof = StepProfiler(1, site="train", registry=MetricsRegistry())
+    prof._note("device", 1.0)
+    prof.begin_window()
+    assert prof.span_attrs() == {}  # nothing sampled THIS window
+    prof._note("device", 0.004)
+    attrs = prof.span_attrs()
+    assert attrs["step_device_ms_p50"] == pytest.approx(4.0)
+    assert attrs["step_sampled"] == 1
+    # Even after max_samples windows, a fresh window still reports.
+    prof._samples["device"].extend([0.001] * prof._max_samples)
+    prof.begin_window()
+    prof._note("device", 0.002)
+    assert prof.span_attrs()["step_sampled"] == 1
+
+
+def test_engine_fit_records_all_three_phases():
+    """The real fit loop under a stride-1 profiler: host batch-prep,
+    dispatch, and fenced device-execute all sampled; attrs exposed for
+    the client-local span."""
+    cfg = ModelConfig.tiny()
+    trainer = Trainer(cfg, TrainConfig(epochs_per_round=1))
+    trainer.step_profiler = StepProfiler(
+        1, site="train", registry=MetricsRegistry()
+    )
+    state = trainer.init_state(seed=0)
+    state, _ = trainer.fit(state, _tiny_split(16), batch_size=8)
+    s = trainer.step_profiler.summary()
+    assert set(s) == {"host", "dispatch", "device"}
+    assert s["device"]["n"] == 2  # 16 rows / bs 8, every step sampled
+    attrs = trainer.step_profile_attrs()
+    assert attrs["step_sampled"] == 2
+    assert "step_device_ms_p50" in attrs
+    # Profiling off: the attrs helper degrades to {}.
+    bare = Trainer(cfg, TrainConfig(epochs_per_round=1))
+    assert bare.step_profiler is None
+    assert bare.step_profile_attrs() == {}
+
+
+# ------------------------------------------------------- memory watermarks
+def test_note_memory_graceful_on_statsless_backend(monkeypatch):
+    """A backend whose memory_stats() is None/missing records the phase
+    as unavailable — no gauges, no exception (the CPU tier-1 lane)."""
+    import detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs.profile as prof_mod
+
+    class _Dev:
+        def memory_stats(self):
+            return None
+
+    reg = MetricsRegistry()
+    assert note_memory("t-none", device=_Dev(), registry=reg) is None
+    assert prof_mod.memory_report()["t-none"] == {"available": False}
+    assert reg.snapshot()["families"] == {}
+
+
+def test_note_memory_records_watermark_gauges():
+    import detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs.profile as prof_mod
+
+    class _Dev:
+        def __init__(self, in_use, peak):
+            self._s = {"bytes_in_use": in_use, "peak_bytes_in_use": peak}
+
+        def memory_stats(self):
+            return self._s
+
+    reg = MetricsRegistry()
+    snap = note_memory("t-dev", device=_Dev(100, 150), registry=reg)
+    assert snap["bytes_in_use"] == 100.0 and snap["peak_bytes"] == 150.0
+    # Watermark semantics: a later lower reading keeps the high peak.
+    snap = note_memory("t-dev", device=_Dev(50, 60), registry=reg)
+    assert snap["peak_bytes"] == 150.0
+    fams = reg.snapshot()["families"]
+    assert (
+        fams["fedtpu_device_bytes_in_use"]["samples"][0]["value"] == 50.0
+    )
+    assert (
+        fams["fedtpu_device_peak_bytes"]["samples"][0]["value"] == 150.0
+    )
+    assert prof_mod.peak_device_bytes() >= 150.0
+
+
+def test_device_memory_stats_never_raises():
+    class _Raises:
+        def memory_stats(self):
+            raise RuntimeError("backend says no")
+
+    assert device_memory_stats(_Raises()) is None
+    assert device_memory_stats(object()) is None
+
+
+# ------------------------------------------------------ FLOPs cross-check
+def test_xla_cost_flops_vs_analytic_within_tolerance():
+    """The MFU anchor: XLA's own cost-model FLOPs for the compiled tiny
+    train step sit inside the documented tolerance of the analytic
+    model (utils/profiling.train_step_flops)."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.utils.profiling import (
+        train_step_flops,
+    )
+
+    cfg = ModelConfig.tiny()
+    trainer = Trainer(cfg, TrainConfig())
+    state = trainer.init_state(seed=0)
+    r = np.random.default_rng(0)
+    batch = {
+        "input_ids": r.integers(
+            0, cfg.vocab_size, (4, cfg.max_len)
+        ).astype(np.int32),
+        "attention_mask": np.ones((4, cfg.max_len), np.int32),
+        "labels": r.integers(0, 2, 4).astype(np.int32),
+    }
+    xla = xla_cost_flops(trainer.train_step, state, batch)
+    if xla is None:
+        pytest.skip("backend exposes no cost model")
+    ratio = xla / train_step_flops(cfg, 4)
+    lo, hi = FLOPS_RATIO_TOLERANCE
+    assert lo <= ratio <= hi
+    assert flops_ratio_ok(ratio)
+    assert flops_ratio_ok(None)  # no cost model is not a failure
+    assert not flops_ratio_ok(hi * 2)
+
+
+def test_xla_cost_flops_unlowerable_returns_none():
+    assert xla_cost_flops(lambda x: x, 1) is None
+
+
+# ------------------------------------------------- serving ledger dedupe
+def test_serving_engine_rides_shared_ledger():
+    """The serving tier's compile_counts now IS a CompileLedger view:
+    same numbers as the pre-ledger dict, per-engine isolation, site
+    marked warm by warmup(), zero recompiles through the bucket storm."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.models.distilbert import (
+        DDoSClassifier,
+        init_params,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.serving.engine import (
+        ScoreEngine,
+    )
+
+    cfg = ModelConfig.tiny()
+    params = init_params(DDoSClassifier(cfg), cfg, jax.random.key(0))
+    eng = ScoreEngine(cfg, params, buckets=(1, 4))
+    eng.warmup()
+    L = cfg.max_len
+    assert eng.compile_counts == {(1, L): 1, (4, L): 1}
+    r = np.random.default_rng(0)
+    for n in (1, 2, 3, 4, 1):
+        ids = r.integers(0, cfg.vocab_size, (n, L)).astype(np.int32)
+        eng.score(ids, np.ones((n, L), np.int32))
+    assert eng.compile_counts == {(1, L): 1, (4, L): 1}
+    assert eng.ledger.recompiles() == []
+    # A second engine's counts are its own (private ledger).
+    eng2 = ScoreEngine(cfg, params, buckets=(1,))
+    assert eng2.compile_counts == {}
+
+
+# ------------------------------------------------------- alert-cmd fan-out
+_SLO = SLO(
+    name="round-duration",
+    metric="fedtpu_server_round_seconds",
+    kind="latency",
+    le=0.5,
+    objective=0.9,
+    windows=((120.0, 6.0), (30.0, 6.0)),
+)
+
+
+def _latency_families(good: int, bad: int) -> dict:
+    total = good + bad
+    return {
+        "fedtpu_server_round_seconds": {
+            "type": "histogram",
+            "help": "",
+            "samples": [
+                {
+                    "labels": {},
+                    "buckets": [
+                        ["0.1", 0],
+                        ["0.5", good],
+                        ["5", total],
+                        ["+Inf", total],
+                    ],
+                    "sum": 1.0,
+                    "count": total,
+                }
+            ],
+        }
+    }
+
+
+def _fire_once(am: AlertManager, *, t0: float = 0.0) -> list:
+    am.ingest(_latency_families(good=5, bad=0), now=t0)
+    am.evaluate(now=t0)
+    am.ingest(_latency_families(good=5, bad=4), now=t0 + 10.0)
+    return am.evaluate(now=t0 + 10.0)
+
+
+def test_alert_cmd_runs_on_page_fire(tmp_path):
+    """--alert-cmd: one spawn per page fire, the event JSON on stdin."""
+    out = tmp_path / "paged.jsonl"
+    am = AlertManager(
+        (_SLO,), alert_cmd=f"cat >> {out}", alert_cmd_interval_s=0.0
+    )
+    events = _fire_once(am)
+    assert [e["event"] for e in events] == ["fire"]
+    # Popen is fire-and-forget; wait for the pager to land.
+    import time as _t
+
+    deadline = _t.monotonic() + 5.0
+    while _t.monotonic() < deadline and not out.exists():
+        _t.sleep(0.02)
+    while _t.monotonic() < deadline and not out.read_text().strip():
+        _t.sleep(0.02)
+    rec = json.loads(out.read_text().splitlines()[0])
+    assert rec["event"] == "fire" and rec["slo"] == "round-duration"
+    assert am.notified_total == 1
+
+
+def test_alert_cmd_rate_limited_on_event_clock():
+    """Two page fires inside the interval -> one spawn (the limiter
+    rides the events' own ts — the injectable clock — so the test needs
+    no sleeps): two SLOs breach on the same snapshots, the second
+    page is suppressed."""
+    slo2 = SLO(
+        name="round-duration-strict",
+        metric="fedtpu_server_round_seconds",
+        kind="latency",
+        le=0.5,
+        objective=0.95,
+        windows=((120.0, 6.0), (30.0, 6.0)),
+    )
+    am = AlertManager(
+        (_SLO, slo2), alert_cmd="true", alert_cmd_interval_s=300.0
+    )
+    events = _fire_once(am)
+    assert [e["event"] for e in events] == ["fire", "fire"]
+    assert am.fired_total == 2
+    assert am.notified_total == 1
+    assert am.notify_suppressed_total == 1
+
+
+def test_alert_cmd_oserror_never_kills_the_loop(monkeypatch):
+    """A broken pager (Popen raising) is swallowed; the state machine
+    and the fire event survive untouched."""
+    import subprocess
+
+    def _boom(*a, **kw):
+        raise OSError("no shell for you")
+
+    monkeypatch.setattr(subprocess, "Popen", _boom)
+    am = AlertManager((_SLO,), alert_cmd="whatever", alert_cmd_interval_s=0.0)
+    events = _fire_once(am)
+    assert [e["event"] for e in events] == ["fire"]
+    assert am.notified_total == 0
+
+
+def test_alert_cmd_ignores_non_page_events(tmp_path):
+    """Ticket-severity fires and clears never page."""
+    ticket = SLO(
+        name="t",
+        metric="fedtpu_server_round_seconds",
+        kind="latency",
+        le=0.5,
+        objective=0.9,
+        windows=((120.0, 6.0), (30.0, 6.0)),
+        severity="ticket",
+    )
+    am = AlertManager(
+        (ticket,), alert_cmd="false", alert_cmd_interval_s=0.0
+    )
+    events = _fire_once(am)
+    assert [e["event"] for e in events] == ["fire"]
+    assert am.notified_total == 0 and am.notify_suppressed_total == 0
+
+
+# --------------------------------------------------------- session + CLI
+def test_run_profile_session_tiny_end_to_end():
+    rep = run_profile_session(
+        ModelConfig.tiny(), TrainConfig(), steps=4, batch_size=4, stride=1
+    )
+    assert rep["recompiles"] == []
+    assert rep["flops_ratio_ok"]
+    assert set(rep["step"]) == {"host", "dispatch", "device"}
+    assert rep["serving"]["recompiles"] == 0
+    assert rep["serving"]["compiles"] == 2  # the (1, 4) bucket ladder
+    # Memory phases visited (available or gracefully not).
+    assert "post-first-step" in rep["memory"]
+    assert "post-round" in rep["memory"]
+    assert rep["flops_tolerance"] == list(FLOPS_RATIO_TOLERANCE)
+
+
+def test_obs_profile_cli_json(capsys):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli.parser import (
+        build_parser,
+    )
+
+    args = build_parser().parse_args(
+        [
+            "obs", "profile", "--preset", "tiny", "--steps", "2",
+            "--batch-size", "4", "--json",
+        ]
+    )
+    rc = args.fn(args)
+    out = capsys.readouterr().out
+    rep = json.loads(out[out.index("{"):])
+    assert rc == 0
+    assert rep["serving"]["recompiles"] == 0
+    assert rep["flops_ratio_ok"]
+
+
+def test_obs_profile_cli_renders_report(capsys):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli.parser import (
+        build_parser,
+    )
+
+    args = build_parser().parse_args(
+        ["obs", "profile", "--preset", "tiny", "--steps", "2",
+         "--batch-size", "4"]
+    )
+    rc = args.fn(args)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "compile ledger" in out
+    assert "flops cross-check" in out
+    assert "serving bucketed path" in out
+
+
+def test_xla_compile_span_in_vocabulary_and_timeline():
+    """The new span is IN the closed vocabulary (the obs-span-vocab
+    static pass anchors on SPAN_NAMES) and the timeline renders it in
+    the unscoped trailing section rather than dropping it."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs import (
+        SPAN_NAMES,
+        timeline_table,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs.trace import (
+        SCHEMA,
+    )
+
+    assert "xla-compile" in SPAN_NAMES
+    spans = [
+        {
+            "schema": SCHEMA, "proc": "client-0", "span": "xla-compile",
+            "ts": 1.0, "dur_s": 0.8, "site": "engine.train_step",
+            "signature": "(16, 128)", "recompile": True,
+        },
+    ]
+    table = timeline_table(spans)
+    assert "xla-compile" in table
+    assert "site=engine.train_step" in table
+
+
+def test_profile_stride_config_flag_round_trip():
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        ObsConfig,
+    )
+
+    assert ObsConfig().profile_stride == 0
+    assert ObsConfig(profile_stride=8).profile_stride == 8
+    with pytest.raises(ValueError):
+        ObsConfig(profile_stride=-1)
+
+
+def test_client_local_span_attrs_via_federated_fit(tmp_path):
+    """The dense federated fit loop stamps sampled step attrs on its
+    client-local span when a profiler is armed."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        FedConfig,
+        MeshConfig,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.pipeline import (
+        stack_clients,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs import (
+        Tracer,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.federated import (
+        FederatedTrainer,
+    )
+
+    model = ModelConfig.tiny()
+    cfg = ExperimentConfig(
+        model=model,
+        data=DataConfig(max_len=model.max_len, batch_size=4),
+        train=TrainConfig(epochs_per_round=1),
+        fed=FedConfig(num_clients=2, rounds=1),
+        mesh=MeshConfig(clients=1, data=1),
+    )
+    trainer = FederatedTrainer(cfg)
+    path = tmp_path / "spans.jsonl"
+    trainer.tracer = Tracer(str(path), proc="fed")
+    trainer.step_profiler = StepProfiler(
+        1, site="train", registry=MetricsRegistry()
+    )
+    state = trainer.init_state(seed=0)
+    stacked = stack_clients([_tiny_split(8, 1), _tiny_split(8, 2)])
+    trainer.fit_local(state, stacked)
+    recs = [json.loads(ln) for ln in path.read_text().splitlines()]
+    local = [r for r in recs if r["span"] == "client-local"]
+    assert len(local) == 1
+    assert local[0]["step_sampled"] >= 1
+    assert "step_device_ms_p50" in local[0]
