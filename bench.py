@@ -70,6 +70,15 @@ Secondary modes via BENCH_MODE:
                       headline profile_compile_count / profile_recompiles
                       / profile_step_device_ms_p50 /
                       profile_peak_device_bytes
+    shadow            the shadow evaluation plane (shadow/): a live
+                      loopback disagreement-gated promotion — router
+                      under closed-loop load with the traffic mirror
+                      armed, an agreeing candidate promoted through the
+                      gate on >= N mirrored pairs and a regressed one
+                      rejected with the verdict on the registry event;
+                      headline shadow_pairs_total / shadow_gate_verdicts
+                      / shadow_added_p99_ms (asserted ~0 vs the
+                      mirror-off arm), zero live drops asserted (exit 3)
     obs               the fleet health plane (obs/slo+fleet+flight): a
                       live loopback round campaign under the scrape hub
                       — a slow round FIRES the round-duration burn
@@ -1886,8 +1895,309 @@ def _preflight() -> None:
 MODES = (
     "train", "bert", "bertlarge", "eval", "fedavg", "flash", "ring",
     "fed2", "fedseq", "serve", "clientdp", "controller", "scenario",
-    "fleet", "check", "router", "obs", "profile",
+    "fleet", "check", "router", "obs", "profile", "shadow",
 )
+
+
+def bench_shadow() -> dict | None:
+    """Shadow evaluation plane (ISSUE 13): a live loopback run of the
+    whole disagreement-gated promotion path — router under closed-loop
+    load, the traffic mirror armed, and TWO gated candidates: one that
+    agrees with the incumbent on live traffic (promotes through the
+    gate, rolling-reloads the fleet) and one that demonstrably regresses
+    (every mirrored pair flips: REJECTED, the pointer never moves, the
+    registry event records the measured verdict).
+
+    Headline fields (asserted present by the train-mode headline,
+    exit 3): ``shadow_pairs_total`` — mirrored pairs accumulated across
+    both gates (each asserted >= the gate's min_pairs: the promotion was
+    GATED on live evidence, not a rubber stamp); ``shadow_gate_verdicts``
+    — gate decisions rendered (asserted 2: one promote, one reject);
+    ``shadow_added_p99_ms`` — the mirror-armed arm's client-observed p99
+    minus the mirror-off arm's, asserted ~0 (the fire-and-forget
+    contract: mirroring must not ride the serving path), with
+    ``shadow_live_dropped`` — live requests rejected across every arm —
+    asserted 0.
+
+    The regressed candidate is constructed, not trained: the incumbent's
+    params with the classifier bias slammed to [+10, -10], which drives
+    P(attack) to ~0 on every flow a ~0.5-scoring incumbent serves — a
+    deterministic 100% flip rate, so the reject arm can never flake.
+
+    BENCH_SHADOW_SAMPLE defaults to 8 (mirror 1 in 8), the production
+    shape: the added-p99 contract is about the MIRROR staying off the
+    serving path, and on a core-starved host a 100% mirror would read
+    the shadow replica's own scoring as serving contention —
+    ``shadow_host_cpus`` is recorded for exactly that caveat, like the
+    router A/B's."""
+    import tempfile
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        wire as _wire,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+        default_tokenizer,
+        make_synthetic,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.datasets import (
+        get_dataset,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.registry import (
+        ModelRegistry,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.router import (
+        FleetReplica,
+        ServingFleet,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.serving import (
+        run_load,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.shadow import (
+        ShadowGate,
+        read_status,
+    )
+
+    n_replicas = max(2, int(os.environ.get("BENCH_SHADOW_REPLICAS", "2")))
+    concurrency = int(os.environ.get("BENCH_SHADOW_CONCURRENCY", "8"))
+    requests = int(os.environ.get("BENCH_SHADOW_REQUESTS", "256"))
+    min_pairs = int(os.environ.get("BENCH_SHADOW_PAIRS", "64"))
+    sample = max(1, int(os.environ.get("BENCH_SHADOW_SAMPLE", "8")))
+    p99_slack_ms = float(os.environ.get("BENCH_SHADOW_P99_SLACK_MS", "50"))
+    tok = default_tokenizer()
+    model_cfg = ModelConfig.tiny(vocab_size=len(tok.vocab))
+    trainer = Trainer(model_cfg, TrainConfig(), pad_id=tok.pad_id)
+    params1 = trainer.init_state(seed=0).params
+    flat = _wire.flatten_params(params1)
+    # Agreeing candidate: one leaf nudged by 1e-6 — a distinct artifact
+    # id whose scores are indistinguishable on live traffic.
+    agree = dict(flat)
+    k0 = sorted(agree)[0]
+    agree[k0] = np.asarray(agree[k0]) + np.float32(1e-6)
+    params_agree = _wire.unflatten_params(agree)
+    # Regressing candidate: classifier bias slammed so P(attack) ~ 0.
+    bad = dict(flat)
+    bad["classifier/bias"] = np.asarray([10.0, -10.0], np.float32)
+    params_bad = _wire.unflatten_params(bad)
+    spec = get_dataset("cicids2017")
+    texts = spec.render_texts(make_synthetic("cicids2017", 64, seed=0))
+
+    def load(port, n):
+        return run_load(
+            "127.0.0.1", port, texts, concurrency=concurrency,
+            requests=n, pipeline=4, timeout=120.0,
+        )
+
+    try:
+        root = tempfile.mkdtemp(prefix="bench-shadow-registry-")
+        registry = ModelRegistry(root)
+        aid1 = registry.add(params1, round_index=1, model_config=model_cfg)
+        registry.promote(aid1, to="serving")
+        replicas = [
+            FleetReplica(
+                i, model_cfg, params1, tok, spec=spec, round_id=1,
+                buckets=(1, 8), max_queue=1024,
+            ).start()
+            for i in range(n_replicas)
+        ]
+
+        def shadow_factory(s_params, *, round_id):
+            return FleetReplica(
+                n_replicas, model_cfg, s_params, tok, spec=spec,
+                round_id=round_id, buckets=(1, 8), max_queue=1024,
+            ).start()
+
+        fleet = ServingFleet(
+            replicas,
+            registry=registry,
+            probe_interval_s=0.25,
+            reload_poll_s=0.1,
+            shadow_factory=shadow_factory,
+            shadow_sample=sample,
+        ).start()
+        dropped = 0
+        verdicts = 0
+        pairs_total = 0
+        p99_reps = max(1, int(os.environ.get("BENCH_SHADOW_P99_REPS", "3")))
+        try:
+            load(fleet.port, 4 * concurrency)  # warm sockets + buckets
+
+            def p99_arm():
+                """Min-of-N p99: on a single-core loopback host a lone
+                p99 sample swings 3-5x on scheduler noise (which only
+                ever ADDS latency) — the minimum over a few short runs
+                is the stable estimate of each arm's intrinsic tail."""
+                best = None
+                drops = 0
+                for _ in range(p99_reps):
+                    s = load(fleet.port, requests)
+                    drops += s["rejected"]
+                    if best is None or s["p99_ms"] < best["p99_ms"]:
+                        best = s
+                return best, drops
+
+            # Arm A: mirror OFF (nothing in the shadow state).
+            s_off, d = p99_arm()
+            dropped += d
+
+            def wait_armed(aid, timeout=30.0):
+                deadline = time.monotonic() + timeout
+                while fleet.stats()["shadow_artifact"] != aid:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"shadow plane never armed for {aid}"
+                        )
+                    time.sleep(0.05)
+
+            def drive_gate(aid):
+                """Closed-loop load until the gate rules on live pairs."""
+                out: dict = {}
+                stop = threading.Event()
+
+                def loader():
+                    while not stop.is_set():
+                        s = load(fleet.port, requests)
+                        out["rejected"] = (
+                            out.get("rejected", 0) + s["rejected"]
+                        )
+                        out.setdefault("arms", []).append(s)
+
+                lt = threading.Thread(target=loader, daemon=True)
+                lt.start()
+                try:
+                    gate = ShadowGate(
+                        root, min_pairs=min_pairs, timeout_s=120.0,
+                        poll_s=0.1,
+                    )
+                    ok, verdict = gate.wait(aid)
+                finally:
+                    stop.set()
+                    lt.join(timeout=180.0)
+                return ok, verdict, out
+
+            # Arm B: the AGREEING candidate — mirror armed, gate passes,
+            # promotion rolling-reloads the fleet under the same load.
+            aid2 = registry.add(
+                params_agree, round_index=2, model_config=model_cfg
+            )
+            registry.promote(aid2, to="shadow")
+            wait_armed(aid2)
+            s_on, d = p99_arm()
+            dropped += d
+            ok_agree, v_agree, out_agree = drive_gate(aid2)
+            verdicts += 1
+            pairs_total += int(v_agree.get("pairs") or 0)
+            dropped += out_agree.get("rejected", 0)
+            if ok_agree:
+                registry.promote(aid2, to="serving")
+            deadline = time.monotonic() + 60.0
+            while (
+                fleet.stats()["reloads"] < 1
+                and time.monotonic() < deadline
+            ):
+                t = load(fleet.port, concurrency)
+                dropped += t["rejected"]
+            promoted_ok = (
+                ok_agree
+                and registry.serving_info()["artifact"] == aid2
+                and fleet.stats()["reloads"] >= 1
+            )
+            # Arm C: the REGRESSED candidate — every pair flips; the
+            # gate fails closed, the pointer stays on aid2, the verdict
+            # rides the registry event.
+            aid3 = registry.add(
+                params_bad, round_index=3, model_config=model_cfg
+            )
+            registry.promote(aid3, to="shadow")
+            wait_armed(aid3)
+            ok_bad, v_bad, out_bad = drive_gate(aid3)
+            verdicts += 1
+            pairs_total += int(v_bad.get("pairs") or 0)
+            dropped += out_bad.get("rejected", 0)
+            if not ok_bad:
+                registry.reject(
+                    aid3, reason=v_bad["reason"], verdict=v_bad
+                )
+            held_out = (
+                not ok_bad
+                and registry.serving_info()["artifact"] == aid2
+                and registry.manifest(aid3)["state"] == "rejected"
+            )
+            status_bad = read_status(root, aid3)
+        finally:
+            fleet.close()
+            for rep in replicas:
+                rep.close()
+            import shutil
+
+            shutil.rmtree(root, ignore_errors=True)
+    except Exception as e:  # noqa: BLE001 - one parseable line, not a dump
+        record = {
+            "metric": "bench_error",
+            "error": "shadow_plane_failed",
+            "detail": f"{type(e).__name__}: {str(e)[:300]}",
+        }
+        _emit(record)
+        return record
+    added_p99 = s_on["p99_ms"] - s_off["p99_ms"]
+    record = {
+        "metric": f"shadow_gate_r{n_replicas}_c{concurrency}",
+        "value": round(added_p99, 3),
+        "unit": "added_p99_ms",
+        "vs_baseline": round(
+            s_on["p99_ms"] / max(s_off["p99_ms"], 1e-9), 3
+        ),
+        "baseline_note": "mirror-armed arm p99 vs the mirror-off arm on "
+        "the same closed-loop load; two candidates gated on live "
+        "mirrored pairs (agree -> promoted+rolling-reloaded, regressed "
+        "-> rejected with the verdict on the registry event)",
+        "shadow_pairs_total": pairs_total,
+        "shadow_gate_verdicts": verdicts,
+        "shadow_added_p99_ms": round(added_p99, 3),
+        "shadow_p99_off_ms": round(s_off["p99_ms"], 3),
+        "shadow_p99_on_ms": round(s_on["p99_ms"], 3),
+        "shadow_p99_slack_ms": p99_slack_ms,
+        "shadow_live_dropped": int(dropped),
+        "shadow_min_pairs": min_pairs,
+        "shadow_promoted": 1.0 if promoted_ok else 0.0,
+        "shadow_rejected_held_out": 1.0 if held_out else 0.0,
+        "shadow_reject_flip_rate": (
+            round(float(v_bad.get("flip_rate") or 0.0), 4)
+        ),
+        "shadow_reject_psi": (
+            status_bad.get("psi") if status_bad else None
+        ),
+        "shadow_sample": sample,
+        "shadow_replicas": n_replicas,
+        # The added-p99 caveat's physical precondition: with fewer cores
+        # than replicas + shadow + loadgen, the delta reads host
+        # contention from the shadow replica's own scoring, not
+        # serving-path cost (the mirror is still off the serving path).
+        "shadow_host_cpus": os.cpu_count(),
+        "device": jax.devices()[0].device_kind,
+    }
+    _emit(record)
+    return record
+
+
+def shadow_broken(rec: dict) -> bool:
+    """The exit-3 contract shared by BENCH_MODE=shadow and the train-
+    mode headline: the promotion must be GATED on >= min_pairs live
+    pairs, zero live requests dropped, the regressed candidate held out
+    of serving, and the mirror's added p99 inside the slack (vs the
+    mirror-off arm — approximately zero on any healthy host)."""
+    return (
+        rec.get("shadow_gate_verdicts", 0) < 2
+        or rec.get("shadow_pairs_total", 0) < 2 * rec.get(
+            "shadow_min_pairs", 1
+        )
+        or rec.get("shadow_live_dropped", 1) > 0
+        or rec.get("shadow_promoted", 0.0) < 1.0
+        or rec.get("shadow_rejected_held_out", 0.0) < 1.0
+        or rec.get("shadow_added_p99_ms", 1e9) > max(
+            rec.get("shadow_p99_slack_ms", 50.0),
+            0.5 * rec.get("shadow_p99_off_ms", 0.0),
+        )
+    )
 
 
 def bench_profile() -> dict | None:
@@ -2309,7 +2619,7 @@ def main() -> None:
             # restores the single-line behavior.
             rec_fed2 = rec_fedseq = rec_ctrl = rec_resid = rec_scn = None
             rec_fleet = rec_check = rec_router = rec_obs = None
-            rec_profile = None
+            rec_profile = rec_shadow = None
             if os.environ.get("BENCH_SECONDARY", "1").lower() not in (
                 "", "0", "false",
             ):
@@ -2325,6 +2635,7 @@ def main() -> None:
                 rec_scn = bench_scenario()
                 rec_fleet = bench_fleet()
                 rec_router = bench_router()
+                rec_shadow = bench_shadow()
                 rec_obs = bench_obs()
                 # Profile LAST among the jitted secondaries: it marks
                 # the engine train site warm, and the headline
@@ -2485,6 +2796,46 @@ def main() -> None:
                     rec_router["router_rolling_reload_dropped"] > 0
                     or rec_router.get("router_reload_complete", 1.0) < 1.0
                 )
+            shadow_gate_broken = False
+            if rec_shadow is not None and (
+                rec_shadow.get("metric") != "bench_error"
+            ):
+                # Shadow-plane headline fields (ISSUE 13): ASSERTED
+                # present — a refactor that drops the mirror/compare/gate
+                # accounting must fail the bench loudly — with zero live
+                # requests dropped, the promotion gated on >= min_pairs
+                # mirrored pairs, the regressed candidate held out of
+                # serving, and the mirror's added p99 inside the slack.
+                missing = [
+                    k
+                    for k in (
+                        "shadow_pairs_total",
+                        "shadow_gate_verdicts",
+                        "shadow_added_p99_ms",
+                    )
+                    if k not in rec_shadow
+                ]
+                if missing:
+                    _emit(
+                        {
+                            "metric": "bench_error",
+                            "error": "shadow_fields_missing",
+                            "detail": f"shadow record lacks {missing} "
+                            "(shadow/ mirror/compare/gate accounting "
+                            "broken?)",
+                        }
+                    )
+                    raise SystemExit(3)
+                for k in (
+                    "shadow_pairs_total",
+                    "shadow_gate_verdicts",
+                    "shadow_added_p99_ms",
+                    "shadow_live_dropped",
+                    "shadow_reject_flip_rate",
+                ):
+                    if k in rec_shadow:
+                        extra[k] = rec_shadow[k]
+                shadow_gate_broken = shadow_broken(rec_shadow)
             obs_broken = False
             if rec_obs is not None and (
                 rec_obs.get("metric") != "bench_error"
@@ -2608,6 +2959,7 @@ def main() -> None:
                 or scenario_broken
                 or fleet_broken
                 or router_broken
+                or shadow_gate_broken
                 or obs_broken
                 or profile_broken
                 or check_broken
@@ -2663,6 +3015,12 @@ def main() -> None:
             rec = bench_profile()
             if rec is None or rec.get("metric") == "bench_error" or (
                 _profile_broken(rec)
+            ):
+                raise SystemExit(3)
+        elif mode == "shadow":
+            rec = bench_shadow()
+            if rec is None or rec.get("metric") == "bench_error" or (
+                shadow_broken(rec)
             ):
                 raise SystemExit(3)
     finally:

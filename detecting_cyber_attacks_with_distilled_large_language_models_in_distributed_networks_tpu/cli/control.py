@@ -88,12 +88,30 @@ def cmd_controller(args) -> int:
         ("drift_method", "drift_method"),
         ("round_deadline", "round_deadline_s"),
         ("max_artifacts", "max_artifacts"),
+        ("slo_deadline_factor", "slo_deadline_factor"),
     ):
         v = getattr(args, flag, None)
         if v is not None:
             ctl_kw[field_name] = v
+    if getattr(args, "adaptive_cadence", False):
+        ctl_kw["adaptive_cadence"] = True
     try:
         ctl = dataclasses.replace(ctl, **ctl_kw) if ctl_kw else ctl
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    shw = cfg.shadow
+    shw_kw = {}
+    for flag, field_name in (
+        ("shadow_min_pairs", "min_pairs"),
+        ("shadow_timeout", "timeout_s"),
+        ("shadow_max_flip_rate", "max_flip_rate"),
+        ("shadow_psi_threshold", "psi_threshold"),
+    ):
+        v = getattr(args, flag, None)
+        if v is not None:
+            shw_kw[field_name] = v
+    try:
+        shw = dataclasses.replace(shw, **shw_kw) if shw_kw else shw
     except ValueError as e:
         raise SystemExit(str(e)) from None
 
@@ -152,6 +170,37 @@ def cmd_controller(args) -> int:
         tracer=tracer,
         stream_chunk_bytes=_wire.stream_chunk_bytes_from_mb(stream_mb),
     ) as server:
+        shadow_gate = None
+        if getattr(args, "shadow_gate", False):
+            from ..shadow import ShadowGate
+
+            shadow_gate = ShadowGate(
+                args.registry_dir,
+                min_pairs=shw.min_pairs,
+                max_flip_rate=shw.max_flip_rate,
+                psi_threshold=shw.psi_threshold,
+                timeout_s=shw.timeout_s,
+                poll_s=shw.poll_s,
+                tracer=tracer,
+            )
+            log.info(
+                f"[CONTROLLER] shadow gate armed: promote after >= "
+                f"{shw.min_pairs} mirrored pair(s) with flip_rate <= "
+                f"{shw.max_flip_rate} and psi <= {shw.psi_threshold} "
+                f"(fail closed after {shw.timeout_s:.0f}s)"
+            )
+        actuator = None
+        if getattr(args, "slo_alerts_jsonl", None):
+            from ..control import SloActuator
+
+            actuator = SloActuator(
+                args.slo_alerts_jsonl, factor=ctl.slo_deadline_factor
+            )
+            log.info(
+                f"[CONTROLLER] SLO actuation armed: round-duration fire "
+                f"on {args.slo_alerts_jsonl} tightens the straggler "
+                f"deadline x{ctl.slo_deadline_factor}"
+            )
         controller = Controller(
             server,
             registry,
@@ -161,6 +210,8 @@ def cmd_controller(args) -> int:
             drift_monitor=drift,
             model_config=cfg.model,
             tracer=tracer,
+            shadow_gate=shadow_gate,
+            slo_actuator=actuator,
         )
         max_rounds = args.rounds if args.rounds and args.rounds > 0 else None
         log.info(

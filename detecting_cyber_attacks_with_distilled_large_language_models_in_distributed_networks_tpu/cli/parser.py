@@ -42,6 +42,9 @@ covers every deployment shape, parameterized by client id / count:
               on serving-score drift instead of a fixed clock (control/)
   registry    inspect/operate the model registry: list artifacts, promote
               one by hand, roll the serving pointer back (registry/)
+  shadow      shadow evaluation plane: what is under live shadow
+              evaluation (status) and the paired serving/shadow
+              disagreement evidence behind a gate verdict (report)
   scenario    "federated in the wild": sweep a client-persona x data-
               partition matrix of live loopback rounds with wire-level
               fault injection (faults/), assert every quorum-satisfiable
@@ -72,6 +75,7 @@ from .predict import cmd_export_hf, cmd_predict
 from .router import cmd_fleet, cmd_route
 from .scenario import cmd_scenario
 from .serving import cmd_infer_serve
+from .shadow import cmd_shadow
 
 
 def _wire_compression(spec: str) -> str:
@@ -915,6 +919,17 @@ def build_parser() -> argparse.ArgumentParser:
         default=0,
         help="Prometheus /metrics for the router + replicas (0 = off)",
     )
+    p.add_argument(
+        "--shadow-sample",
+        type=int,
+        default=None,
+        help="arm the shadow evaluation plane (shadow/): mirror one live "
+        "request in N onto the registry's shadow-state artifact "
+        "(deterministic counter stride, fire-and-forget — a full mirror "
+        "queue drops the copy, never a live reply). The shadow replica "
+        "is spun up by this fleet manager and NEVER joins the router's "
+        "pick set. Default: config shadow.sample (0 = off)",
+    )
     _add_flight_dir(p)
     p.set_defaults(fn=cmd_fleet)
 
@@ -1041,6 +1056,67 @@ def build_parser() -> argparse.ArgumentParser:
         "retired/rejected artifacts beyond this count (the serving "
         "artifact and its rollback chain are never pruned); default: "
         "keep everything",
+    )
+    p.add_argument(
+        "--shadow-gate",
+        action="store_true",
+        help="hold every eval-passing candidate in the registry SHADOW "
+        "state and promote only after the live mirror (fedtpu fleet "
+        "--shadow-sample) accumulated >= --shadow-min-pairs pairs with "
+        "disagreement under threshold; regression (or no evidence "
+        "inside --shadow-timeout) fails closed to rejected with the "
+        "verdict on the registry event",
+    )
+    p.add_argument(
+        "--shadow-min-pairs",
+        type=int,
+        default=None,
+        help="mirrored pairs required before the shadow gate rules "
+        "(default: config shadow.min_pairs = 256)",
+    )
+    p.add_argument(
+        "--shadow-timeout",
+        type=float,
+        default=None,
+        help="seconds the shadow gate waits for its evidence before "
+        "failing closed (default: config shadow.timeout_s = 600)",
+    )
+    p.add_argument(
+        "--shadow-max-flip-rate",
+        type=float,
+        default=None,
+        help="max tolerated prediction-flip fraction across mirrored "
+        "pairs (default: config shadow.max_flip_rate = 0.02)",
+    )
+    p.add_argument(
+        "--shadow-psi-threshold",
+        type=float,
+        default=None,
+        help="max tolerated PSI between the paired serving/shadow score "
+        "histograms (default: config shadow.psi_threshold = 0.25)",
+    )
+    p.add_argument(
+        "--adaptive-cadence",
+        action="store_true",
+        help="scale the inter-round interval between --interval and "
+        "--max-interval by each drift verdict's magnitude (barely over "
+        "threshold -> relaxed max; >= 2x threshold -> urgent min); the "
+        "chosen interval rides the drift-trigger span",
+    )
+    p.add_argument(
+        "--slo-alerts-jsonl",
+        help="tail the health plane's alerts-JSONL (fedtpu obs "
+        "health|watch --alerts-jsonl) and, while the round-duration "
+        "burn alert FIRES, tighten the straggler deadline by "
+        "--slo-deadline-factor until it clears",
+    )
+    p.add_argument(
+        "--slo-deadline-factor",
+        type=float,
+        default=None,
+        help="straggler-deadline multiplier applied while the "
+        "round-duration SLO fires (default: config "
+        "control.slo_deadline_factor = 0.5)",
     )
     _add_flight_dir(p)
     p.set_defaults(fn=cmd_controller)
@@ -1378,6 +1454,29 @@ def build_parser() -> argparse.ArgumentParser:
         "(required for the gc action)",
     )
     p.set_defaults(fn=cmd_registry)
+
+    p = sub.add_parser(
+        "shadow",
+        help="shadow evaluation plane: status | report — what is under "
+        "live shadow evaluation and the paired disagreement evidence",
+        epilog="Reads the registry directory only (the shadow pointer, "
+        "the comparator's atomic status snapshot, and the paired-records "
+        "JSONL under <registry>/shadow/) — works from any host that "
+        "mounts it, like every other control-plane surface.",
+    )
+    p.add_argument("action", choices=["status", "report"])
+    p.add_argument("--registry-dir", required=True)
+    p.add_argument(
+        "--artifact",
+        help="report: this artifact's paired records (default: the "
+        "artifact currently under shadow evaluation)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable output instead of the rendered summary",
+    )
+    p.set_defaults(fn=cmd_shadow)
 
     p = sub.add_parser("distill", help="teacher -> student knowledge distillation")
     _add_common(p)

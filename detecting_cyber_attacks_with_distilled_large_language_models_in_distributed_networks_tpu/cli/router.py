@@ -143,13 +143,14 @@ def cmd_fleet(args) -> int:
         f"[FLEET] spawning {n} replica(s) of artifact {info['artifact']} "
         f"(round {round_id}) from registry {args.registry_dir}"
     )
+    spec = get_dataset(cfg.data.dataset)
     replicas = [
         FleetReplica(
             i,
             model_cfg,
             params,
             tok,
-            spec=get_dataset(cfg.data.dataset),
+            spec=spec,
             round_id=round_id,
             buckets=buckets,
             max_queue=args.max_queue,
@@ -160,6 +161,37 @@ def cmd_fleet(args) -> int:
         ).start()
         for i in range(n)
     ]
+    shadow_sample = (
+        args.shadow_sample
+        if getattr(args, "shadow_sample", None) is not None
+        else cfg.shadow.sample
+    )
+    shadow_factory = None
+    if shadow_sample >= 1:
+        # The shadow replica: one more FleetReplica built exactly like
+        # the serving ones (same buckets/auth/tracer), spun up/down by
+        # the fleet manager as artifacts enter/leave the shadow state —
+        # and never handed to the router's pick set.
+        def shadow_factory(s_params, *, round_id):
+            return FleetReplica(
+                n,  # one past the serving fleet: distinct stats identity
+                model_cfg,
+                s_params,
+                tok,
+                spec=spec,
+                round_id=round_id,
+                buckets=buckets,
+                max_queue=args.max_queue,
+                gather_window_s=args.max_wait_ms / 1e3,
+                threshold=args.threshold,
+                auth_key=auth_key,
+                tracer=tracer,
+            ).start()
+
+        log.info(
+            f"[FLEET] shadow plane enabled: mirroring 1/{shadow_sample} "
+            "of live traffic onto shadow-state artifacts"
+        )
     fleet = ServingFleet(
         replicas,
         registry=registry,
@@ -172,6 +204,11 @@ def cmd_fleet(args) -> int:
         reload_poll_s=args.reload_poll,
         max_inflight_per_replica=cfg.router.max_inflight_per_replica,
         tracer=tracer,
+        shadow_factory=shadow_factory,
+        shadow_sample=shadow_sample,
+        shadow_threshold=cfg.shadow.threshold,
+        shadow_bins=cfg.shadow.bins,
+        shadow_queue=cfg.shadow.queue,
     )
     try:
         with fleet:

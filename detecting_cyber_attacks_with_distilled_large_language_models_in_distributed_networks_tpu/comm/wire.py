@@ -161,6 +161,18 @@ SCORE_AUTH_DOMAIN = b"fedtpu-score-auth-v1"
 #: diverge from "requests flow".
 SCORE_STAT_MAGIC = b"SCST"
 SCORE_STATR_MAGIC = b"SCSR"
+#: Scoring-fleet reload choreography (serving/protocol.py): SCORE_RELOAD
+#: asks a replica to drain-then-reload NOW — check its checkpoint/registry
+#: watcher immediately (bypassing the poll interval) at the next batch
+#: boundary — and SCORE_RELOADR answers once the adoption attempt
+#: finished, carrying whether anything was adopted and the round now
+#: serving. In-band like the stats probe, which is what lets a router/
+#: fleet manager coordinate drain-first rolling reloads across
+#: OUT-of-process replicas it cannot hot-swap directly: drain the pick
+#: set, send SCORE_RELOAD on the same authenticated backend connection,
+#: readmit on the reply.
+SCORE_RELOAD_MAGIC = b"SCRL"
+SCORE_RELOADR_MAGIC = b"SCRD"
 #: Streamed-upload frames (module docstring "Streamed uploads"): header,
 #: sequential payload chunk, trailer. The capability rides reply meta
 #: under STREAM_META_KEY as the server's preferred chunk byte count.

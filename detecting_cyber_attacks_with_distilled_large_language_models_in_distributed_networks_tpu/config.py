@@ -486,8 +486,25 @@ class ControlConfig:
     # serving artifact and its rollback chain are never pruned —
     # registry/store.py gc()). None (default) keeps everything.
     max_artifacts: int | None = None
+    # Adaptive cadence (control/drift.py cadence_interval_s): a fired
+    # drift verdict's MAGNITUDE scales the next inter-round interval
+    # between min_interval_s (drift >= 2x threshold: urgent) and
+    # max_interval_s (barely over threshold: relaxed). Needs both bounds
+    # configured; the chosen interval rides the drift-trigger span.
+    adaptive_cadence: bool = False
+    # SLO-driven actuation: while a round-duration burn alert FIRES on
+    # the tailed alerts-JSONL (controller --slo-alerts-jsonl), the
+    # round's straggler deadline is multiplied by this factor — a fleet
+    # already blowing its round SLO should cut stragglers loose sooner,
+    # not wait the full budget on them. 1.0 disables the tightening.
+    slo_deadline_factor: float = 0.5
 
     def __post_init__(self) -> None:
+        if not 0.0 < self.slo_deadline_factor <= 1.0:
+            raise ValueError(
+                f"slo_deadline_factor={self.slo_deadline_factor} must be "
+                "in (0, 1] (1 = no tightening)"
+            )
         if self.max_artifacts is not None and self.max_artifacts < 1:
             raise ValueError(
                 f"max_artifacts={self.max_artifacts} must be >= 1 "
@@ -525,6 +542,68 @@ class ControlConfig:
                 f"max_interval_s={self.max_interval_s} below "
                 f"min_interval_s={self.min_interval_s}"
             )
+
+
+@dataclass(frozen=True)
+class ShadowConfig:
+    """Shadow evaluation plane (shadow/): mirror a sampled fraction of
+    live scoring traffic onto the registry's ``shadow``-state artifact
+    and gate promotion on the measured live disagreement instead of
+    offline eval alone. The reference (and the pre-shadow controller)
+    promotes on held-out metrics only — exactly the gate that misses
+    live-distribution drift."""
+
+    #: Mirror stride: duplicate one live request in ``sample`` onto the
+    #: shadow backend (deterministic counter stride, no RNG — the
+    #: serve-batch trace-sampling discipline). 0 = shadow plane off.
+    sample: int = 0
+    #: Minimum mirrored pairs before the gate may rule; fewer at timeout
+    #: FAILS CLOSED (the candidate is rejected, the pointer never moves).
+    min_pairs: int = 256
+    #: Max tolerated fraction of pairs whose thresholded prediction
+    #: flipped between serving and shadow.
+    max_flip_rate: float = 0.02
+    #: Max tolerated PSI between the paired serving/shadow score
+    #: histograms (the drift monitor's distance, same 0.25 lore).
+    psi_threshold: float = 0.25
+    #: Gate patience: how long the controller waits for the evidence.
+    timeout_s: float = 600.0
+    #: Seconds between the gate's status polls.
+    poll_s: float = 0.5
+    #: Prediction threshold the flip comparison applies on both sides.
+    threshold: float = 0.5
+    #: Histogram bins for the paired score distributions (must match the
+    #: drift tier's resolution so PSI thresholds transfer).
+    bins: int = 10
+    #: Mirror queue bound: a full queue drops the mirror COPY — never
+    #: delays or fails the live request.
+    queue: int = 256
+
+    def __post_init__(self) -> None:
+        if self.sample < 0:
+            raise ValueError(f"sample={self.sample} must be >= 0 (0 = off)")
+        if self.min_pairs < 1:
+            raise ValueError(f"min_pairs={self.min_pairs} must be >= 1")
+        if not 0.0 <= self.max_flip_rate <= 1.0:
+            raise ValueError(
+                f"max_flip_rate={self.max_flip_rate} must be in [0, 1]"
+            )
+        if self.psi_threshold <= 0.0:
+            raise ValueError(
+                f"psi_threshold={self.psi_threshold} must be > 0"
+            )
+        if self.timeout_s <= 0.0:
+            raise ValueError(f"timeout_s={self.timeout_s} must be > 0")
+        if self.poll_s <= 0.0:
+            raise ValueError(f"poll_s={self.poll_s} must be > 0")
+        if not 0.0 < self.threshold < 1.0:
+            raise ValueError(
+                f"threshold={self.threshold} must be in (0, 1)"
+            )
+        if not 2 <= self.bins <= 64:
+            raise ValueError(f"bins={self.bins} must be in [2, 64]")
+        if self.queue < 1:
+            raise ValueError(f"queue={self.queue} must be >= 1")
 
 
 @dataclass(frozen=True)
@@ -680,6 +759,7 @@ class ExperimentConfig:
     control: ControlConfig = field(default_factory=ControlConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     router: RouterConfig = field(default_factory=RouterConfig)
+    shadow: ShadowConfig = field(default_factory=ShadowConfig)
     output_dir: str = "outputs"
     checkpoint_dir: str | None = None
 
@@ -724,6 +804,7 @@ class ExperimentConfig:
             "control": ControlConfig,
             "obs": ObsConfig,
             "router": RouterConfig,
+            "shadow": ShadowConfig,
         }
         scalars = ("output_dir", "checkpoint_dir")
         unknown_top = set(d) - set(sections) - set(scalars)

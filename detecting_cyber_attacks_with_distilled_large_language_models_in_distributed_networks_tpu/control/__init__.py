@@ -24,13 +24,15 @@ objective. This package is that loop:
   what triggers the next training round instead of a fixed clock.
 """
 
-from .controller import Controller, ControllerStats
-from .drift import DriftMonitor, ks_distance, psi
+from .controller import Controller, ControllerStats, SloActuator
+from .drift import DriftMonitor, cadence_interval_s, ks_distance, psi
 
 __all__ = [
     "Controller",
     "ControllerStats",
     "DriftMonitor",
+    "SloActuator",
+    "cadence_interval_s",
     "ks_distance",
     "psi",
 ]

@@ -41,9 +41,76 @@ from ..obs import metrics as obs_metrics
 from ..registry import ModelRegistry, RegistryError
 from ..train.fedeval import eval_gate, reference_histogram
 from ..utils.logging import get_logger
-from .drift import DriftMonitor
+from .drift import DriftMonitor, cadence_interval_s
 
 log = get_logger()
+
+
+class SloActuator:
+    """Health-plane actuation (the first SLO->control rung): tail the
+    scrape hub's alerts-JSONL and, WHILE a round-duration burn alert is
+    firing, tighten the controller's straggler deadline by a configured
+    factor — a fleet already blowing its round SLO should cut stragglers
+    loose sooner, not spend the full budget waiting on them. The alert
+    clearing restores the configured deadline.
+
+    Pure event arithmetic: no clock reads, no sleeps — state is exactly
+    the fire/clear events consumed so far (per (slo, instance), so two
+    hubs or two instances can fire independently), which is what makes
+    the whole behavior unit-testable from a synthetic alerts file."""
+
+    def __init__(
+        self,
+        alerts_jsonl: str,
+        *,
+        slo_name: str = "round-duration",
+        factor: float = 0.5,
+    ):
+        if not 0.0 < float(factor) <= 1.0:
+            raise ValueError(
+                f"factor={factor} must be in (0, 1] (1 = no tightening)"
+            )
+        self.alerts_jsonl = alerts_jsonl
+        self.slo_name = str(slo_name)
+        self.factor = float(factor)
+        self._offset = 0
+        self._firing: set[str] = set()
+
+    @property
+    def firing(self) -> bool:
+        return bool(self._firing)
+
+    def poll(self) -> bool:
+        """Ingest new alert events; True while the matched SLO fires
+        somewhere. Malformed lines are skipped (the alerts file is
+        another process's output)."""
+        from ..obs.timeline import read_new_jsonl_lines
+
+        self._offset, lines = read_new_jsonl_lines(
+            self.alerts_jsonl, self._offset
+        )
+        for line in lines:
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(ev, dict) or ev.get("slo") != self.slo_name:
+                continue
+            key = str(ev.get("instance"))
+            if ev.get("event") == "fire":
+                self._firing.add(key)
+            elif ev.get("event") == "clear":
+                self._firing.discard(key)
+        return self.firing
+
+    def effective_deadline(self, base: float | None) -> float | None:
+        """The straggler deadline to hand the round engine: tightened by
+        ``factor`` while firing, the configured ``base`` otherwise (a
+        None base — server-timeout-governed rounds — stays None; there
+        is no number to tighten)."""
+        if base is None or not self._firing:
+            return base
+        return float(base) * self.factor
 
 #: eval_fn contract: nested params dict -> metrics mapping. Must carry the
 #: gate metric; a "probs" array (np.ndarray) makes the candidate's eval
@@ -58,6 +125,9 @@ class ControllerStats:
     rounds_failed: int = 0
     promotions: int = 0
     gate_rejections: int = 0
+    #: Candidates that passed offline eval but FAILED the live shadow
+    #: disagreement gate (shadow/) — rejected with the verdict recorded.
+    shadow_rejections: int = 0
     drift_triggers: int = 0
     #: round-engine wall seconds (inside serve_round) vs full cycle wall:
     #: the orchestration overhead the bench record reports.
@@ -88,6 +158,8 @@ class Controller:
         model_config: Any | None = None,
         drift_poll_s: float = 1.0,
         tracer=None,
+        shadow_gate=None,
+        slo_actuator: SloActuator | None = None,
     ):
         if getattr(server, "dp_clip", 0.0) > 0.0:
             raise ValueError(
@@ -103,7 +175,18 @@ class Controller:
         self.drift = drift_monitor
         self.model_config = model_config
         self.drift_poll_s = float(drift_poll_s)
+        # Shadow gate (shadow/gate.py): when set, a candidate that passes
+        # offline eval is HELD in the registry shadow state until live
+        # mirrored traffic produced a disagreement verdict; regression
+        # fails closed to rejected. slo_actuator: the health plane's
+        # round-duration alert tightening the straggler deadline.
+        self.shadow_gate = shadow_gate
+        self.slo_actuator = slo_actuator
         self.stats = ControllerStats()
+        # Adaptive cadence: a drift verdict's magnitude sets the NEXT
+        # inter-round throttle (None = the configured min_interval_s).
+        self._interval_override: float | None = None
+        self._slo_tightened = False
         # Observability (obs/): spans stamped with the round engine's
         # (trace, round) — server.last_trace after each serve_round — so
         # the obs timeline shows eval-gate/promote time next to the
@@ -121,6 +204,10 @@ class Controller:
         self._m_gate_rejections = m.counter(
             "fedtpu_controller_gate_rejections_total",
             help="candidates rejected by the eval gate",
+        )
+        self._m_shadow_rejections = m.counter(
+            "fedtpu_controller_shadow_rejections_total",
+            help="candidates rejected by the live shadow disagreement gate",
         )
         self._m_drift_triggers = m.counter(
             "fedtpu_controller_drift_triggers_total",
@@ -158,6 +245,7 @@ class Controller:
             if ev in (
                 "promoted",
                 "gate_rejected",
+                "shadow_rejected",
                 "promote_noop",
                 "round_noop",
                 "round_failed",
@@ -165,13 +253,16 @@ class Controller:
             ):
                 self.stats.rounds_attempted += 1
             if ev in (
-                "promoted", "gate_rejected", "promote_noop", "cycle_error",
+                "promoted", "gate_rejected", "shadow_rejected",
+                "promote_noop", "cycle_error",
             ):
                 self.stats.rounds_completed += 1
             if ev == "promoted":
                 self.stats.promotions += 1
             elif ev == "gate_rejected":
                 self.stats.gate_rejections += 1
+            elif ev == "shadow_rejected":
+                self.stats.shadow_rejections += 1
             elif ev == "round_failed":
                 self.stats.rounds_failed += 1
             elif ev == "drift_trigger":
@@ -232,12 +323,49 @@ class Controller:
             )
             return "interval"
         start = time.monotonic()
+        # Adaptive cadence applies to the CLOCK FALLBACK, not the hard
+        # min-interval throttle above: a mild verdict relaxes the next
+        # guaranteed round toward max_interval_s, a severe one pulls it
+        # toward min_interval_s — while drift keeps being polled the
+        # whole time, so a new emergency still fires immediately. The
+        # recorded next_interval_s is therefore the true time to the
+        # next round absent further drift.
+        effective_max = (
+            self._interval_override
+            if self._interval_override is not None
+            else c.max_interval_s
+        )
         while True:
             verdict = self.drift.poll()
             if verdict is not None:
                 self.stats.drift_triggers += 1
                 self._m_drift_triggers.inc()
-                self._record("drift_trigger", **verdict)
+                # Adaptive cadence: the verdict's MAGNITUDE (for PSI,
+                # exactly the psi_contributions total) picks the next
+                # inter-round throttle between the configured bounds.
+                next_interval = None
+                if c.adaptive_cadence:
+                    next_interval = cadence_interval_s(
+                        verdict["drift"],
+                        threshold=self.drift.threshold,
+                        min_s=c.min_interval_s,
+                        max_s=c.max_interval_s,
+                    )
+                    self._interval_override = next_interval
+                    log.info(
+                        f"[CONTROLLER] adaptive cadence: drift "
+                        f"{verdict['drift']:.4f} -> next interval "
+                        f"{next_interval:.1f}s"
+                    )
+                self._record(
+                    "drift_trigger",
+                    **verdict,
+                    **(
+                        {"next_interval_s": round(next_interval, 3)}
+                        if next_interval is not None
+                        else {}
+                    ),
+                )
                 if self.tracer is not None:
                     # No (trace, round) yet — the round this verdict
                     # starts hasn't minted one; the round index links
@@ -252,12 +380,21 @@ class Controller:
                         method=verdict["method"],
                         scores=verdict["scores"],
                         top_bins=verdict.get("top_bins"),
+                        next_interval_s=(
+                            round(next_interval, 3)
+                            if next_interval is not None
+                            else None
+                        ),
                     )
                 return "drift"
             if (
-                c.max_interval_s is not None
-                and time.monotonic() - start >= c.max_interval_s
+                effective_max is not None
+                and time.monotonic() - start >= effective_max
             ):
+                # A clock round means the drift stayed quiet for the
+                # whole (possibly adapted) interval: relax the override
+                # back to the configured cadence.
+                self._interval_override = None
                 return "interval"
             if stop.wait(self.drift_poll_s):
                 return None
@@ -273,10 +410,25 @@ class Controller:
         self.stats.rounds_attempted += 1
         self._m_rounds.inc()
         log.info(f"[CONTROLLER] round {r} starting (trigger: {trigger})")
+        # SLO-driven actuation: while the health plane's round-duration
+        # alert fires, the straggler deadline tightens by the configured
+        # factor (and restores the moment the alert clears).
+        deadline = c.round_deadline_s
+        self._slo_tightened = False
+        if self.slo_actuator is not None and self.slo_actuator.poll():
+            tightened = self.slo_actuator.effective_deadline(deadline)
+            if tightened != deadline:
+                self._slo_tightened = True
+                log.info(
+                    f"[CONTROLLER] round-duration SLO firing: straggler "
+                    f"deadline {deadline:.1f}s -> {tightened:.1f}s until "
+                    "the alert clears"
+                )
+                deadline = tightened
         try:
             t0 = time.monotonic()
             agg = self.server.serve_round(
-                deadline=c.round_deadline_s, round_index=r
+                deadline=deadline, round_index=r
             )
             round_wall = time.monotonic() - t0
         except (RuntimeError, OSError, ConnectionError, ValueError) as e:
@@ -391,6 +543,8 @@ class Controller:
             "reason": reason,
             "round_wall_s": round(round_wall, 3),
         }
+        if self._slo_tightened:
+            rec["slo_tightened"] = True
         if c.gate_metric in metrics:
             try:
                 rec["metric_value"] = float(metrics[c.gate_metric])
@@ -415,10 +569,41 @@ class Controller:
         t_pro0 = time.monotonic()
         try:
             self.registry.promote(aid, to="shadow")
-            self.registry.promote(aid, to="serving")
         except RegistryError as e:
             # Content-addressed dedup corner: a round whose aggregate is
             # bit-identical to the serving artifact has nothing to swap.
+            rec["note"] = str(e)
+            self._record("promote_noop", **rec)
+            return {"event": "promote_noop", **rec}
+        if self.shadow_gate is not None:
+            # The candidate is now HELD in the shadow state: the fleet
+            # manager mirrors live traffic onto it (shadow/), and the
+            # pointer moves only on measured live agreement. Disagreement
+            # — or no evidence inside the gate's patience — fails closed.
+            ok_live, verdict = self.shadow_gate.wait(aid)
+            rec["shadow_verdict"] = {
+                k: verdict.get(k)
+                for k in ("pairs", "flip_rate", "psi", "reason")
+            }
+            if not ok_live:
+                self.stats.shadow_rejections += 1
+                self._m_shadow_rejections.inc()
+                self.registry.reject(
+                    aid, reason=verdict["reason"], verdict=verdict
+                )
+                self._maybe_gc()
+                rec["incumbent"] = incumbent["id"] if incumbent else None
+                self._record("shadow_rejected", **rec)
+                log.info(
+                    f"[CONTROLLER] round {r}: candidate {aid} REJECTED by "
+                    f"the live shadow gate ({verdict['reason']}); serving "
+                    "pointer unchanged"
+                    + (f" ({rec['incumbent']})" if rec["incumbent"] else "")
+                )
+                return {"event": "shadow_rejected", **rec}
+        try:
+            self.registry.promote(aid, to="serving")
+        except RegistryError as e:
             rec["note"] = str(e)
             self._record("promote_noop", **rec)
             return {"event": "promote_noop", **rec}

@@ -108,6 +108,40 @@ def psi_contributions(
     ]
 
 
+def cadence_interval_s(
+    drift: float,
+    *,
+    threshold: float,
+    min_s: float,
+    max_s: float | None,
+    urgency_span: float = 2.0,
+) -> float:
+    """Adaptive round cadence: map a fired verdict's drift MAGNITUDE to
+    the controller's next inter-round interval.
+
+    A verdict always means ``drift >= threshold``, but 0.26 and 2.6 are
+    different emergencies: the first is a slow seasonal shift the fleet
+    can absorb on a relaxed cadence, the second is a new attack family
+    scoring hot right now. Linear interpolation between the configured
+    bounds: at the bare threshold the interval stays at ``max_s`` (the
+    relaxed clock), at ``urgency_span * threshold`` or beyond it floors
+    at ``min_s`` (back-to-back throttle only). Pure arithmetic — no
+    clock reads, unit-testable from synthetic verdicts — and with
+    ``max_s`` unset (purely drift-driven campaigns with no clock at
+    all) it degrades to ``min_s``.
+    """
+    min_s = float(min_s)
+    if max_s is None or float(max_s) <= min_s:
+        return min_s
+    threshold = float(threshold)
+    hi = threshold * float(urgency_span)
+    if hi <= threshold:
+        return min_s
+    frac = (float(drift) - threshold) / (hi - threshold)
+    frac = min(max(frac, 0.0), 1.0)
+    return float(max_s) - (float(max_s) - min_s) * frac
+
+
 def ks_distance(expected: Any, observed: Any) -> float:
     """Max absolute CDF gap between two count histograms (same binning)."""
     e = _fractions(expected)

@@ -368,7 +368,15 @@ def timeline_table(
         s
         for s in groups.get((None, None), ())
         if s["span"]
-        in ("postmortem-dump", "drift-trigger", "slo-eval", "xla-compile")
+        in (
+            "postmortem-dump",
+            "drift-trigger",
+            "slo-eval",
+            "xla-compile",
+            "shadow-mirror",
+            "shadow-compare",
+            "shadow-gate",
+        )
     ]
     if unscoped and round_filter is None:
         out.append("unscoped health-plane spans:")
@@ -377,7 +385,8 @@ def timeline_table(
                 f"{k}={s[k]}"
                 for k in (
                     "reason", "bundle", "drift", "firing", "up",
-                    "site", "recompile",
+                    "site", "recompile", "pairs", "flip_rate", "passed",
+                    "artifact", "mirrored",
                 )
                 if s.get(k) is not None
             )

@@ -60,6 +60,15 @@ Span vocabulary (names are the contract the timeline tool groups by)::
                   ``signature`` and ``recompile=True`` when the shape
                   appeared at an already-warm site (the flagged event
                   that can trip the flight recorder)
+    shadow-mirror a sampled live request duplicated onto the shadow
+                  backend (shadow/mirror.py), counter-strided like
+                  serve-batch spans, with the running ``mirrored`` count
+    shadow-compare one completed serving/shadow probability pair's
+                  running disagreement stats (shadow/compare.py), with
+                  ``pairs``/``flip_rate``/``psi``
+    shadow-gate   the controller's live disagreement verdict for a
+                  shadow-state candidate (shadow/gate.py), with
+                  ``artifact``/``passed``/``pairs``/``flip_rate``/``psi``
 
 Timestamps are wall-clock unix seconds (``ts``) with a separately
 measured monotonic duration (``dur_s``): cross-process correlation needs
@@ -101,6 +110,9 @@ SPAN_NAMES = (
     "postmortem-dump",
     "drift-trigger",
     "xla-compile",
+    "shadow-mirror",
+    "shadow-compare",
+    "shadow-gate",
 )
 
 #: Wire meta key the trace id rides under (comm/server.py reply meta,

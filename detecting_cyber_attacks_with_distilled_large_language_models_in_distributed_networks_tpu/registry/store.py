@@ -41,6 +41,13 @@ STATES = ("candidate", "shadow", "serving", "rejected", "retired")
 _LADDER = ("candidate", "shadow", "serving")
 
 _POINTER = "serving.json"
+#: The shadow pointer (shadow/): the artifact currently under live
+#: shadow evaluation. Written when an artifact is promoted TO ``shadow``
+#: and cleared when it leaves the state (serving, rejected, or an
+#: explicit re-promote) — the fleet manager follows it to spin the
+#: shadow replica up and down, exactly as the serving tier follows
+#: serving.json.
+_SHADOW = "shadow.json"
 _EVENTS = "events.jsonl"
 _ID_HEX = 16  # 64 bits of sha256 — collision-safe for any real fleet
 
@@ -259,6 +266,33 @@ class ModelRegistry:
         info = self.serving_info()
         return None if info is None else self.manifest(info["artifact"])
 
+    def shadow_info(self) -> dict | None:
+        """The shadow pointer's content (None when nothing is under live
+        shadow evaluation). Same atomicity contract as the serving
+        pointer — one small JSON file swapped with os.replace."""
+        try:
+            with open(os.path.join(self.root, _SHADOW)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, json.JSONDecodeError) as e:
+            raise RegistryError(f"corrupt shadow pointer: {e}") from None
+
+    def _clear_shadow(self, aid: str) -> None:
+        """Drop the shadow pointer iff it names ``aid`` (the artifact
+        left the shadow state). A pointer naming a DIFFERENT artifact is
+        untouched — promotions of unrelated candidates must not tear
+        down a live shadow evaluation."""
+        try:
+            info = self.shadow_info()
+        except RegistryError:
+            info = None
+        if info is not None and info.get("artifact") == aid:
+            try:
+                os.remove(os.path.join(self.root, _SHADOW))
+            except OSError:
+                pass
+
     # ----------------------------------------------------- state transitions
     def _set_state(self, aid: str, state: str) -> dict:
         if state not in STATES:
@@ -292,7 +326,51 @@ class ModelRegistry:
         if to not in STATES:
             raise RegistryError(f"unknown state {to!r}")
         if to != "serving":
+            if to == "shadow":
+                serving = self.serving_info()
+                if serving is not None and serving.get("artifact") == aid:
+                    # The explicit --to shadow path must refuse the
+                    # incumbent: mirroring the serving artifact against
+                    # itself spins a duplicate replica forever and can
+                    # never produce a meaningful verdict.
+                    raise RegistryError(
+                        f"artifact {aid} is serving; a shadow evaluation "
+                        "compares a CANDIDATE against the incumbent"
+                    )
             m = self._set_state(aid, to)
+            if to == "shadow":
+                # Clear any PREVIOUS evaluation's evidence BEFORE the
+                # pointer announces the new one: the controller's gate
+                # starts polling status.json the moment promote()
+                # returns, while the fleet manager arms (and does its
+                # own arm-time clearing) only a poll later — leftover
+                # evidence from an earlier run of this same artifact
+                # must lose that race here, not there. The pairs JSONL
+                # is truncated, not unlinked (the obs append path
+                # caches one O_APPEND fd per path).
+                from ..shadow.gate import pairs_path, status_path
+
+                try:
+                    os.remove(status_path(self.root, aid))
+                except OSError:
+                    pass
+                try:
+                    os.truncate(pairs_path(self.root, aid), 0)
+                except OSError:
+                    pass
+                # Announce the live shadow evaluation: the fleet manager
+                # follows this pointer to spin up the shadow replica and
+                # arm the traffic mirror (shadow/).
+                _atomic_write_json(
+                    os.path.join(self.root, _SHADOW),
+                    {
+                        "artifact": aid,
+                        "round": m.get("round"),
+                        "since_unix": time.time(),
+                    },
+                )
+            else:
+                self._clear_shadow(aid)
             self._event("promoted", artifact=aid, state=to)
             log.info(f"[REGISTRY] {aid}: {cur} -> {to}")
             self._promote_span(t_unix, t0, aid, to, m.get("round"))
@@ -302,6 +380,7 @@ class ModelRegistry:
         if prev_id == aid:
             raise RegistryError(f"artifact {aid} is already serving")
         m = self._set_state(aid, "serving")
+        self._clear_shadow(aid)
         pointer = {
             "artifact": aid,
             "round": m.get("round"),
@@ -324,12 +403,19 @@ class ModelRegistry:
         self._promote_span(t_unix, t0, aid, "serving", m.get("round"))
         return m
 
-    def reject(self, aid: str, *, reason: str = "") -> dict:
-        """The eval gate's verdict: mark a candidate rejected (it stays on
-        disk as lineage; it can never reach the pointer without an
-        explicit operator re-promote)."""
+    def reject(
+        self, aid: str, *, reason: str = "", verdict: Mapping[str, Any] | None = None
+    ) -> dict:
+        """The eval (or shadow) gate's verdict: mark a candidate rejected
+        (it stays on disk as lineage; it can never reach the pointer
+        without an explicit operator re-promote). ``verdict`` — the
+        shadow gate's measured disagreement (pairs, flip rate, PSI) —
+        rides the registry event so the audit trail records WHY live
+        traffic refused this candidate, not just that it was refused."""
         m = self._set_state(aid, "rejected")
-        self._event("rejected", artifact=aid, reason=reason)
+        self._clear_shadow(aid)
+        extra = {"verdict": dict(verdict)} if verdict is not None else {}
+        self._event("rejected", artifact=aid, reason=reason, **extra)
         log.info(f"[REGISTRY] rejected {aid}" + (f": {reason}" if reason else ""))
         return m
 

@@ -78,14 +78,24 @@ log = get_logger()
 
 class _Pending:
     """One forwarded request awaiting its backend reply. ``writer`` is
-    None for the router's own health probes."""
+    None for the router's own control traffic (health probes, reload
+    frames). ``mirror_id`` links a shadow-mirrored request (shadow/
+    mirror.py) to its pair key, so the serving-side probability can be
+    handed to the comparator when the reply flows back."""
 
-    __slots__ = ("writer", "client_id", "t_sent")
+    __slots__ = ("writer", "client_id", "t_sent", "mirror_id")
 
-    def __init__(self, writer, client_id: int, t_sent: float):
+    def __init__(
+        self,
+        writer,
+        client_id: int,
+        t_sent: float,
+        mirror_id: int | None = None,
+    ):
         self.writer = writer
         self.client_id = client_id
         self.t_sent = t_sent
+        self.mirror_id = mirror_id
 
 
 class Replica:
@@ -111,6 +121,12 @@ class Replica:
         self.last_stats: dict | None = None
         self.probe_id: int | None = None
         self.probe_sent_t = 0.0
+        # In-flight SCORE_RELOAD choreography (reload_replica): the
+        # pending control frame's id, its parsed reply, and the event the
+        # coordinating caller waits on. All guarded by ``lock``.
+        self.reload_id: int | None = None
+        self.reload_reply: dict | None = None
+        self.reload_evt: threading.Event | None = None
 
     @property
     def addr(self) -> str:
@@ -160,6 +176,13 @@ class ScoringRouter:
         self._conns: set[socket.socket] = set()
         self._conn_lock = threading.Lock()
         self._stats_lock = threading.Lock()
+        # Shadow mirror hook (shadow/mirror.py): when armed, a counter-
+        # strided sample of live requests is duplicated onto the shadow
+        # backend (fire-and-forget — admit() never blocks) and the
+        # matching serving replies are handed to the comparator. None =
+        # the literal pre-shadow forward path.
+        self._mirror_lock = threading.Lock()
+        self._mirror = None
         self._forwarded = 0
         self._rejects = {"no_replica": 0, "replica_lost": 0, "auth": 0}
         # Eject-storm detection (obs/flight.py): N ejects across the
@@ -296,6 +319,19 @@ class ScoringRouter:
             "healthy": sum(1 for b in backends if b["healthy"]),
         }
 
+    # -------------------------------------------------------- shadow mirror
+    def set_mirror(self, mirror) -> None:
+        """Arm (or, with None, disarm) the shadow-traffic mirror. The
+        mirror object's contract (shadow/mirror.py ShadowMirror):
+        ``admit(frame) -> mirror_id | None`` (O(1), never blocks),
+        ``note_serving_reply(mirror_id, frame)``, ``abandon(mirror_id)``."""
+        with self._mirror_lock:
+            self._mirror = mirror
+
+    def _get_mirror(self):
+        with self._mirror_lock:
+            return self._mirror
+
     # -------------------------------------------------------- drain control
     def drain(self, replica_id: int) -> None:
         """Remove a replica from the pick set (in-flight requests keep
@@ -321,6 +357,130 @@ class ScoringRouter:
             time.sleep(0.005)
         with rep.lock:
             return rep.inflight == 0
+
+    # ------------------------------------------- out-of-process reload
+    def reload_replica(
+        self,
+        replica_id: int,
+        *,
+        timeout_s: float = 60.0,
+        drain: bool = True,
+        drain_timeout_s: float = 30.0,
+    ) -> dict | None:
+        """Drain-then-reload-now for ONE backend the router cannot
+        hot-swap directly (a subprocess/remote ``infer-serve`` replica):
+        remove it from the pick set, wait out its in-flight requests,
+        send the SCORE_RELOAD control frame on the same authenticated
+        backend connection, and readmit once the replica answers that
+        its adoption attempt finished. Returns the parsed reload reply
+        (``{"reloaded": bool, "round": int}``) or None when the replica
+        was unreachable / never answered — the caller decides whether a
+        missing reply fails the sweep."""
+        rep = self.replicas[replica_id]
+        drained = True
+        if drain:
+            self.drain(replica_id)
+            drained = self.wait_drained(
+                replica_id, timeout=drain_timeout_s
+            )
+            if not drained:
+                log.warning(
+                    f"[ROUTER] replica {replica_id} did not drain within "
+                    f"{drain_timeout_s}s; sending reload anyway (its "
+                    "in-flight batches finish on the old weights)"
+                )
+        try:
+            eject_sock = None
+            with rep.lock:
+                if rep.sock is None or rep.reload_id is not None:
+                    return None
+                rep.next_id += 1
+                bid = rep.next_id
+                rep.pending[bid] = _Pending(None, 0, time.monotonic())
+                rep.reload_id = bid
+                rep.reload_reply = None
+                rep.reload_evt = evt = threading.Event()
+                try:
+                    framing.send_frame(
+                        rep.sock,
+                        protocol.build_reload_request(bid),
+                        await_ack=False,
+                    )
+                except (OSError, ConnectionError):
+                    rep.pending.pop(bid, None)
+                    rep.reload_id = None
+                    rep.reload_evt = None
+                    eject_sock = rep.sock
+            if eject_sock is not None:
+                self._eject(rep, eject_sock, "reload send failed")
+                return None
+            if not evt.wait(timeout_s):
+                with rep.lock:
+                    rep.pending.pop(bid, None)
+                    if rep.reload_id == bid:
+                        rep.reload_id = None
+                        rep.reload_evt = None
+                log.warning(
+                    f"[ROUTER] replica {replica_id} did not answer the "
+                    f"reload frame within {timeout_s}s"
+                )
+                return None
+            with rep.lock:
+                return rep.reload_reply
+        finally:
+            if drain:
+                self.undrain(replica_id)
+
+    def rolling_remote_reload(
+        self,
+        *,
+        drain_timeout_s: float = 30.0,
+        reload_timeout_s: float = 120.0,
+    ) -> dict:
+        """The out-of-process rolling sweep: drain -> SCORE_RELOAD ->
+        readmit, one replica at a time, so N-1 replicas keep serving
+        while each one reloads — the same zero-drop choreography
+        ServingFleet.rolling_reload runs for in-process replicas, for
+        backends that live in their own processes/hosts. Single-replica
+        deployments skip the drain (draining the whole pick set would
+        CAUSE the drops). Returns per-replica outcomes."""
+        sweep: list[dict] = []
+        solo = len(self.replicas) == 1
+        for rep in self.replicas:
+            t_unix = time.time()
+            t0 = time.monotonic()
+            reply = self.reload_replica(
+                rep.replica_id,
+                timeout_s=reload_timeout_s,
+                drain=not solo,
+                drain_timeout_s=drain_timeout_s,
+            )
+            dur = time.monotonic() - t0
+            out = {
+                "replica": rep.replica_id,
+                "answered": reply is not None,
+                "reloaded": bool(reply and reply.get("reloaded")),
+                "round": reply.get("round") if reply else None,
+                "swap_s": dur,
+            }
+            sweep.append(out)
+            if self.tracer is not None:
+                self.tracer.record(
+                    "replica-drain",
+                    t_start=t_unix,
+                    dur_s=dur,
+                    round=out["round"],
+                    replica=rep.replica_id,
+                    drained=out["answered"],
+                    remote=True,
+                )
+            log.info(
+                f"[ROUTER] replica {rep.replica_id} reload "
+                f"{'answered' if out['answered'] else 'UNANSWERED'} "
+                f"(reloaded={out['reloaded']}, round {out['round']}) in "
+                f"{dur:.3f}s"
+            )
+        return {"replicas": sweep}
 
     # ------------------------------------------------------------ accept path
     def _accept_loop(self) -> None:
@@ -411,6 +571,14 @@ class ScoringRouter:
                 except WireError as e:
                     log.warning(f"[ROUTER] dropping connection: {e}")
                     return
+                # Shadow mirroring (shadow/mirror.py): a deterministic
+                # counter-strided sample of live requests is duplicated
+                # onto the shadow backend. admit() is an O(1) enqueue
+                # that NEVER blocks or fails the serving path — a full
+                # mirror queue drops the COPY, the live request proceeds
+                # untouched.
+                mirror = self._get_mirror()
+                mid = mirror.admit(fb) if mirror is not None else None
                 # One failover retry: the pick can race an eject (the
                 # send discovers the dead socket first) — a second pick
                 # excludes the replica the first attempt marked down.
@@ -419,10 +587,14 @@ class ScoringRouter:
                     rep = self._pick()
                     if rep is None:
                         break
-                    if self._forward(rep, fb, req_id, writer):
+                    if self._forward(
+                        rep, fb, req_id, writer, mirror_id=mid
+                    ):
                         sent = True
                         break
                 if not sent:
+                    if mid is not None and mirror is not None:
+                        mirror.abandon(mid)
                     kind = (
                         "no_replica" if self._pick() is None
                         else "replica_lost"
@@ -465,7 +637,12 @@ class ScoringRouter:
         return best
 
     def _forward(
-        self, rep: Replica, frame: bytes, client_id: int, writer
+        self,
+        rep: Replica,
+        frame: bytes,
+        client_id: int,
+        writer,
+        mirror_id: int | None = None,
     ) -> bool:
         """Rewrite + send one request to ``rep``; False = the replica
         went away under us (caller retries elsewhere)."""
@@ -476,7 +653,9 @@ class ScoringRouter:
             rep.next_id += 1
             bid = rep.next_id
             out = protocol.rewrite_id(frame, bid)
-            rep.pending[bid] = _Pending(writer, client_id, time.monotonic())
+            rep.pending[bid] = _Pending(
+                writer, client_id, time.monotonic(), mirror_id
+            )
             rep.inflight += 1
             inflight = rep.inflight
             try:
@@ -506,25 +685,48 @@ class ScoringRouter:
             except (OSError, ConnectionError, WireError) as e:
                 self._eject(rep, sock, f"connection lost ({e})")
                 return
+            reload_evt = None
             with rep.lock:
                 pend = rep.pending.pop(bid, None)
                 if pend is not None and pend.writer is not None:
                     rep.inflight -= 1
                 inflight = rep.inflight
                 if pend is not None and pend.writer is None:
-                    # Probe result: adopt the stats snapshot; a healthy
-                    # answer is also the readmit signal after an eject.
-                    rep.probe_id = None
-                    if protocol.is_stats_reply(frame):
+                    if rep.reload_id is not None and bid == rep.reload_id:
+                        # SCORE_RELOAD answered: the adoption attempt on
+                        # the replica finished — wake the coordinator.
+                        rep.reload_id = None
                         try:
-                            rep.last_stats = protocol.parse_stats_reply(
+                            rep.reload_reply = protocol.parse_reload_reply(
                                 frame
-                            )["stats"]
+                            )
                         except WireError:
-                            rep.last_stats = None
-                    rep.healthy = True
+                            rep.reload_reply = None
+                        reload_evt = rep.reload_evt
+                    else:
+                        # Probe result: adopt the stats snapshot; a
+                        # healthy answer is also the readmit signal
+                        # after an eject.
+                        rep.probe_id = None
+                        if protocol.is_stats_reply(frame):
+                            try:
+                                rep.last_stats = protocol.parse_stats_reply(
+                                    frame
+                                )["stats"]
+                            except WireError:
+                                rep.last_stats = None
+                        rep.healthy = True
+            if reload_evt is not None:
+                reload_evt.set()
             if pend is None or pend.writer is None:
                 continue
+            if pend.mirror_id is not None:
+                # The mirrored request's serving-side reply: hand the
+                # probability to the comparator (outside rep.lock — the
+                # mirror takes its own locks). A reject abandons the pair.
+                mirror = self._get_mirror()
+                if mirror is not None:
+                    mirror.note_serving_reply(pend.mirror_id, frame)
             self._g_inflight[rep.replica_id].set(inflight)
             pend.writer.send(protocol.rewrite_id(frame, pend.client_id))
             self._m_forwarded.inc()
@@ -642,13 +844,22 @@ class ScoringRouter:
             rep.pending.clear()
             rep.inflight = 0
             rep.ejects += 1
+            # A reload coordinator waiting on this connection must wake
+            # now (its reply can never arrive) instead of its timeout.
+            rep.reload_id = None
+            reload_evt, rep.reload_evt = rep.reload_evt, None
+        if reload_evt is not None:
+            reload_evt.set()
         try:
             sock.close()
         except OSError:
             pass
         self._m_ejects[rep.replica_id].inc()
         self._g_inflight[rep.replica_id].set(0)
+        mirror = self._get_mirror()
         for pend in dropped:
+            if pend.mirror_id is not None and mirror is not None:
+                mirror.abandon(pend.mirror_id)
             self._count_reject("replica_lost")
             pend.writer.send(
                 protocol.build_reject(

@@ -28,6 +28,7 @@ to round compute and the eval gate.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 
@@ -122,6 +123,17 @@ class ServingFleet:
     ``reload_poll_s`` and answers a pointer move with one rolling
     sweep. None = no manager; :meth:`rolling_reload` can still be driven
     directly (tests, manual ops).
+
+    **Shadow plane** (shadow/): with ``shadow_factory`` set and
+    ``shadow_sample >= 1``, the same manager poll also follows the
+    registry's SHADOW pointer — an artifact promoted to the ``shadow``
+    state gets its own replica (spun from ``shadow_factory``, NEVER in
+    the router's pick set), the router's traffic mirror is armed at the
+    configured stride, and the comparator publishes paired records +
+    an atomic status file under ``<registry>/shadow/`` for the
+    controller's disagreement gate. When the artifact leaves the shadow
+    state (promoted or rejected) the mirror disarms and the shadow
+    replica is torn down.
     """
 
     def __init__(
@@ -139,14 +151,39 @@ class ServingFleet:
         max_inflight_per_replica: int = 1024,
         tracer=None,
         trace_sample: float = 1.0,
+        shadow_factory=None,
+        shadow_sample: int = 0,
+        shadow_threshold: float = 0.5,
+        shadow_bins: int = 10,
+        shadow_queue: int = 256,
     ):
         if not replicas:
             raise ValueError("fleet needs at least one replica")
+        if shadow_sample < 0:
+            raise ValueError(
+                f"shadow_sample={shadow_sample} must be >= 0 (0 = off)"
+            )
         self.replicas = replicas
         self.registry = registry
         self.drain_timeout_s = float(drain_timeout_s)
         self.reload_poll_s = float(reload_poll_s)
         self.tracer = tracer
+        self.auth_key = auth_key
+        # Shadow plane state (all guarded by _lock; the manager thread
+        # owns the lifecycle, stats() reads).
+        self.shadow_factory = shadow_factory
+        self.shadow_sample = int(shadow_sample)
+        self.shadow_threshold = float(shadow_threshold)
+        self.shadow_bins = int(shadow_bins)
+        self.shadow_queue = int(shadow_queue)
+        self._shadow_aid: str | None = None
+        self._shadow_replica = None
+        self._shadow_mirror = None
+        self._shadow_compare = None
+        self._shadow_warned: str | None = None
+        # Spin-up failure backoff: a corrupt artifact or failing factory
+        # must not cost a full params load + engine build every poll.
+        self._shadow_retry_at = 0.0
         self.router = ScoringRouter(
             [(r.host, r.port) for r in replicas],
             host=router_host,
@@ -199,6 +236,7 @@ class ServingFleet:
         self._closed.set()
         if self._manager is not None:
             self._manager.join(timeout=10.0)
+        self._teardown_shadow()
         self.router.close()
         for rep in self.replicas:
             rep.close()
@@ -214,11 +252,15 @@ class ServingFleet:
         with self._lock:
             reloads = self.reloads
             artifact = self.serving_artifact
+            shadow_aid = self._shadow_aid
+            mirror = self._shadow_mirror
         return {
             **self.router.stats(),
             "reloads": reloads,
             "serving_artifact": artifact,
             "replica_rounds": [r.round_id for r in self.replicas],
+            "shadow_artifact": shadow_aid,
+            "shadow_mirror": mirror.stats() if mirror is not None else None,
         }
 
     # ------------------------------------------------------- rolling reload
@@ -282,9 +324,155 @@ class ServingFleet:
             self.serving_artifact = artifact
         return {"replicas": sweep, "round": round_id, "artifact": artifact}
 
+    # ----------------------------------------------------- the shadow plane
+    def shadow_enabled(self) -> bool:
+        return self.shadow_factory is not None and self.shadow_sample >= 1
+
+    def _teardown_shadow(self) -> None:
+        """Disarm the mirror FIRST (the router's forward path must stop
+        touching it before it dies), publish the final status, then
+        close the shadow replica."""
+        with self._lock:
+            aid = self._shadow_aid
+            mirror, self._shadow_mirror = self._shadow_mirror, None
+            compare, self._shadow_compare = self._shadow_compare, None
+            replica, self._shadow_replica = self._shadow_replica, None
+            self._shadow_aid = None
+        if aid is None:
+            return
+        self.router.set_mirror(None)
+        if mirror is not None:
+            mirror.close()
+        if compare is not None:
+            compare.write_status()
+        if replica is not None:
+            try:
+                replica.close()
+            except Exception as e:
+                log.warning(
+                    f"[FLEET] shadow replica close failed (non-fatal): {e}"
+                )
+        log.info(f"[FLEET] shadow plane for {aid} torn down")
+
+    def _poll_shadow(self) -> None:
+        """One manager pass over the registry's SHADOW pointer: arm the
+        plane when an artifact enters the shadow state, tear it down
+        when it leaves. Any failure degrades to no-shadow — the live
+        fleet must never die for its shadow."""
+        if not self.shadow_enabled():
+            return
+        from ..shadow import ShadowCompare, ShadowMirror, pairs_path, status_path
+
+        try:
+            info = self.registry.shadow_info()
+        except Exception as e:
+            log.warning(f"[FLEET] shadow pointer read failed: {e}")
+            return
+        aid = info.get("artifact") if info else None
+        with self._lock:
+            cur = self._shadow_aid
+        if aid == cur:
+            return
+        if cur is not None:
+            self._teardown_shadow()
+        if aid is None:
+            return
+        if (
+            self._shadow_warned == aid
+            and time.monotonic() < self._shadow_retry_at
+        ):
+            return  # recent spin-up failure for this artifact: back off
+        engine = self.replicas[0].engine
+        try:
+            manifest = self.registry.manifest(aid)
+            mc = manifest.get("model_config")
+            if mc is not None and mc != dataclasses.asdict(engine.model_cfg):
+                if self._shadow_warned != aid:
+                    with self._lock:
+                        self._shadow_warned = aid
+                    log.warning(
+                        f"[FLEET] shadow artifact {aid} declares a "
+                        "different architecture than the fleet's engines; "
+                        "not mirroring (the gate will fail closed)"
+                    )
+                return
+            params = self.registry.load_params(aid)
+            replica = self.shadow_factory(
+                params, round_id=int(manifest.get("round", 0))
+            )
+        except Exception as e:
+            with self._lock:
+                self._shadow_warned = aid
+            self._shadow_retry_at = time.monotonic() + max(
+                5.0, 10.0 * self.reload_poll_s
+            )
+            log.warning(
+                f"[FLEET] shadow replica spin-up for {aid} failed "
+                f"({type(e).__name__}: {e}); not mirroring (retrying "
+                "with backoff while the shadow pointer names it)"
+            )
+            return
+        root = self.registry.root
+        # Fresh evidence per evaluation: a PREVIOUS shadow run of this
+        # same artifact (a gate rejection later re-promoted, a crashed
+        # gate) left its status/pairs files behind, and the gate would
+        # rule on that stale evidence within one poll — the registry
+        # events keep the historical verdicts, the files do not need to.
+        # The pairs JSONL is TRUNCATED, not removed: the obs append path
+        # caches one O_APPEND fd per path, and unlinking would strand a
+        # previous in-process comparator's cached fd on a dead inode.
+        try:
+            os.remove(status_path(root, aid))
+        except OSError:
+            pass
+        try:
+            os.truncate(pairs_path(root, aid), 0)
+        except OSError:
+            pass
+        compare = ShadowCompare(
+            threshold=self.shadow_threshold,
+            bins=self.shadow_bins,
+            pairs_jsonl=pairs_path(root, aid),
+            status_path=status_path(root, aid),
+            # Publish every 8th pair, not every pair: the status rewrite
+            # (snapshot + tmp + os.replace) per pair would make the
+            # compare thread the bottleneck at exactly the mirror rates
+            # the plane exists to measure; the gate's min_pairs is
+            # always a multiple of this granularity in practice.
+            status_every=8,
+            tracer=self.tracer,
+        )
+        mirror = ShadowMirror(
+            replica.host,
+            replica.port,
+            sample=self.shadow_sample,
+            compare=compare,
+            auth_key=self.auth_key,
+            max_queue=self.shadow_queue,
+            tracer=self.tracer,
+        ).start()
+        with self._lock:
+            self._shadow_aid = aid
+            self._shadow_replica = replica
+            self._shadow_mirror = mirror
+            self._shadow_compare = compare
+            self._shadow_warned = None
+        self.router.set_mirror(mirror)
+        log.info(
+            f"[FLEET] shadow plane armed for {aid}: replica on "
+            f"{replica.host}:{replica.port}, mirroring "
+            f"1/{self.shadow_sample} of live requests"
+        )
+
     # ---------------------------------------------------------- the manager
     def _manager_loop(self) -> None:
         while not self._closed.wait(self.reload_poll_s):
+            try:
+                self._poll_shadow()
+            except Exception as e:
+                log.warning(
+                    f"[FLEET] shadow poll failed (non-fatal): {e}"
+                )
             try:
                 info = self.registry.serving_info()
             except Exception as e:

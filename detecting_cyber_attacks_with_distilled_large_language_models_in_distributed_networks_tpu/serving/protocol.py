@@ -28,6 +28,8 @@ from ..comm.wire import (
     SCORE_AUTH_DOMAIN,
     SCORE_AUTH_MAGIC,
     SCORE_REJ_MAGIC,
+    SCORE_RELOAD_MAGIC,
+    SCORE_RELOADR_MAGIC,
     SCORE_REP_MAGIC,
     SCORE_REQ_MAGIC,
     SCORE_STAT_MAGIC,
@@ -223,6 +225,59 @@ def is_stats_reply(frame: bytes) -> bool:
     return bytes(frame[:4]) == SCORE_STATR_MAGIC
 
 
+# ------------------------------------------------------------------ reload
+def build_reload_request(req_id: int) -> bytes:
+    """Drain-then-reload-now control frame (comm/wire.py SCORE_RELOAD):
+    ask the replica to check its checkpoint/registry watcher IMMEDIATELY
+    (bypassing the poll interval) at the next batch boundary, and answer
+    only once the adoption attempt finished. The out-of-process rolling
+    reload's coordination primitive: the router drains a replica, sends
+    this on the same authenticated backend connection, and readmits on
+    the reply."""
+    return _build(SCORE_RELOAD_MAGIC, {"id": int(req_id)})
+
+
+def parse_reload_request(frame: bytes) -> dict:
+    body = _parse(frame, SCORE_RELOAD_MAGIC, "reload request")
+    if not isinstance(body.get("id"), int) or isinstance(body["id"], bool):
+        raise WireError("reload request id must be an integer")
+    return body
+
+
+def is_reload_request(frame: bytes) -> bool:
+    return bytes(frame[:4]) == SCORE_RELOAD_MAGIC
+
+
+def build_reload_reply(
+    req_id: int, *, reloaded: bool, round_id: int
+) -> bytes:
+    """``reloaded`` = whether the forced watcher poll adopted anything;
+    ``round`` = the model round serving AFTER the attempt (the manager's
+    completion check)."""
+    return _build(
+        SCORE_RELOADR_MAGIC,
+        {
+            "id": int(req_id),
+            "reloaded": bool(reloaded),
+            "round": int(round_id),
+        },
+    )
+
+
+def parse_reload_reply(frame: bytes) -> dict:
+    body = _parse(frame, SCORE_RELOADR_MAGIC, "reload reply")
+    for key in ("id", "reloaded", "round"):
+        if key not in body:
+            raise WireError(f"reload reply missing {key!r}")
+    if not isinstance(body["id"], int) or isinstance(body["id"], bool):
+        raise WireError("reload reply id must be an integer")
+    return body
+
+
+def is_reload_reply(frame: bytes) -> bool:
+    return bytes(frame[:4]) == SCORE_RELOADR_MAGIC
+
+
 # ---------------------------------------------------------------- id remap
 #: Frame types whose JSON body carries the correlating ``id`` field —
 #: everything the router forwards or answers.
@@ -232,6 +287,8 @@ _ID_MAGICS = (
     SCORE_REJ_MAGIC,
     SCORE_STAT_MAGIC,
     SCORE_STATR_MAGIC,
+    SCORE_RELOAD_MAGIC,
+    SCORE_RELOADR_MAGIC,
 )
 
 #: The canonical leading-``id`` shape every builder in this module

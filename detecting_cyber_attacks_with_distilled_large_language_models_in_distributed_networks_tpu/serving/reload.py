@@ -130,10 +130,12 @@ class CheckpointWatcher:
         )
         self._primed = True
 
-    def poll(self, engine) -> bool:
-        """One idle-tick check; True when a new checkpoint was adopted."""
+    def poll(self, engine, *, force: bool = False) -> bool:
+        """One idle-tick check; True when a new checkpoint was adopted.
+        ``force`` bypasses the poll-interval rate limit — the
+        SCORE_RELOAD control frame's drain-then-reload-NOW semantics."""
         now = time.monotonic()
-        if now - self._last_poll < self.poll_interval_s:
+        if not force and now - self._last_poll < self.poll_interval_s:
             return False
         self._last_poll = now
         step = latest_finalized_step(self.ckpt_dir)
@@ -227,13 +229,14 @@ class RegistryWatcher:
         self._seen = artifact
         self._primed = True
 
-    def poll(self, engine) -> bool:
+    def poll(self, engine, *, force: bool = False) -> bool:
         """One idle-tick check; True when a newly promoted (or rolled-
         back-to) artifact was adopted. Any registry error leaves the
         serving params untouched — reload is an optimization; the
-        service must never die for it."""
+        service must never die for it. ``force`` bypasses the poll
+        interval (the SCORE_RELOAD control frame)."""
         now = time.monotonic()
-        if now - self._last_poll < self.poll_interval_s:
+        if not force and now - self._last_poll < self.poll_interval_s:
             return False
         self._last_poll = now
         try:

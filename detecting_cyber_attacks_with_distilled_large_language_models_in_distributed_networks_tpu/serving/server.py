@@ -184,6 +184,14 @@ class ScoringServer:
             "deadline": 0, "overloaded": 0, "bad_request": 0, "error": 0,
             "auth": 0,
         }
+        # Out-of-process reload choreography (comm/wire.py SCORE_RELOAD):
+        # reader threads enqueue (req_id, writer) here; the scorer thread
+        # answers at its next batch boundary after a FORCED watcher poll,
+        # so the reply means "the adoption attempt finished", not "the
+        # frame arrived". _reload_frames counts arrivals for stats() (the
+        # in-process rolling-reload regression asserts it stays 0).
+        self._reload_q: collections.deque = collections.deque()
+        self._reload_frames = 0
         # Scoring-port auth (the FL tier's HMAC challenge-response reused
         # here): with a key, every connection must answer the nonce
         # challenge before its first request is read. None = the
@@ -313,6 +321,7 @@ class ScoringServer:
             rejects = dict(self._rejects)
             hist = dict(sorted(self._batch_hist.items()))
             score_hist = self._score_hist.tolist()
+            reload_frames = self._reload_frames
         uptime = max(time.monotonic() - self._t_start, 1e-9)
         pct = (
             {
@@ -333,6 +342,7 @@ class ScoringServer:
             "rejects_total": sum(rejects.values()),
             "queue_depth": self.batcher.qsize(),
             "reloads": getattr(self.watcher, "reload_count", 0),
+            "reload_frames": reload_frames,
             "round": self.engine.round_id,
             "uptime_s": uptime,
             "flows_per_sec": scored / uptime,
@@ -401,6 +411,20 @@ class ScoringServer:
                     writer.send(
                         protocol.build_stats_reply(sbody["id"], self.stats())
                     )
+                    continue
+                if protocol.is_reload_request(fb):
+                    # Reload-now control frame: queue for the SCORER
+                    # thread — the reply must mean the adoption attempt
+                    # finished, and only the scorer may touch the
+                    # watcher/engine (reloads never race a batch).
+                    try:
+                        rbody = protocol.parse_reload_request(fb)
+                    except WireError as e:
+                        log.warning(f"[SERVE] dropping connection: {e}")
+                        return
+                    with self._stats_lock:
+                        self._reload_frames += 1
+                    self._reload_q.append((rbody["id"], writer))
                     continue
                 try:
                     body = protocol.parse_request(fb)
@@ -542,8 +566,39 @@ class ScoringServer:
             self._rejects[kind] += 1
         self._m_rejects[kind].inc()
 
+    def _drain_reload_requests(self) -> None:
+        """Answer queued SCORE_RELOAD frames from the scorer thread: one
+        FORCED watcher poll (interval bypassed) covers every request that
+        arrived since the last batch, then each gets a reply carrying the
+        round now serving. No watcher configured = nothing to reload —
+        answered honestly with reloaded=False."""
+        if not self._reload_q:
+            return
+        reloaded = False
+        if self.watcher is not None:
+            try:
+                reloaded = bool(self.watcher.poll(self.engine, force=True))
+            except Exception as e:
+                # The watcher's own contract is never-fatal; a surprise
+                # here must not kill the scorer thread either.
+                log.warning(
+                    f"[SERVE] forced reload poll failed (non-fatal): {e}"
+                )
+        round_id = self.engine.round_id
+        while True:
+            try:
+                req_id, writer = self._reload_q.popleft()
+            except IndexError:
+                break
+            writer.send(
+                protocol.build_reload_reply(
+                    req_id, reloaded=reloaded, round_id=round_id
+                )
+            )
+
     def _score_loop(self) -> None:
         while not self._closed.is_set():
+            self._drain_reload_requests()
             if self.watcher is not None:
                 self.watcher.poll(self.engine)
             batch = self.batcher.next_batch(timeout=self.idle_tick_s)

@@ -1,0 +1,398 @@
+"""Live-traffic mirroring onto the shadow artifact — never in the way.
+
+The registry ladder has had a ``shadow`` state since the control plane
+landed, but no traffic ever flowed through it: promotion gated on
+held-out offline eval alone, which is exactly the gate that misses
+live-distribution drift (arXiv:2509.17836 — federated cybersecurity
+deployments degrade under non-IID, shifting traffic that the validation
+split never saw). :class:`ShadowMirror` closes the traffic half of that
+gap: hooked into the router's forward path (router/core.py
+``set_mirror``), it duplicates a deterministic counter-strided sample of
+live scoring requests onto a shadow backend, so the candidate scores the
+SAME flows the incumbent scores, at the same moment, on real traffic.
+
+The one non-negotiable invariant is that the serving path must not be
+able to tell the mirror exists:
+
+* ``admit()`` — the only call on the serving hot path — is a counter
+  increment plus a bounded-queue ``put_nowait``: no RNG (the same
+  no-wall-clock/no-entropy discipline as serve-batch trace sampling —
+  reruns mirror the same requests), no I/O, no blocking. A **full queue
+  drops the mirror copy** (counted, never retried) — backpressure from a
+  slow shadow replica sheds shadow work, never delays a live reply.
+* The actual duplicate send, the shadow connection, and the reply
+  decode all live on the mirror's own worker/reader threads. A **dead
+  shadow replica degrades to pass-through**: dials fail quietly on a
+  monotonic backoff, every affected pair is abandoned, and the serving
+  tier's p99 is bench-asserted unchanged (``shadow_added_p99_ms``).
+
+The mirror is model-free like the router: it re-addresses the already-
+encoded request frame (serving/protocol.py ``rewrite_id``) to its pair
+key and ships the bytes — no tokenize, no JSON rebuild. Replies come
+back id-matched on the single shadow connection and land in the
+comparator (shadow/compare.py) as the pair's shadow side.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import time
+
+from ..comm import framing
+from ..comm.wire import WireError
+from ..obs import metrics as obs_metrics
+from ..serving import protocol
+from ..serving.client import _set_nodelay, answer_auth_challenge
+from ..serving.server import MAX_REQUEST_FRAME
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+
+class ShadowMirror:
+    """Fire-and-forget duplicator of sampled scoring requests.
+
+    Router contract (router/core.py): ``admit(frame)`` on the forward
+    path returns a mirror id when this request was sampled and enqueued
+    (None otherwise — not sampled, or the queue was full and the COPY
+    was dropped); ``note_serving_reply(mid, frame)`` hands the serving
+    side of a sampled pair to the comparator; ``abandon(mid)`` sheds a
+    pair whose serving half died (eject, no replica).
+
+    ``sample`` is the stride: mirror one request in ``sample`` via the
+    admission counter — deterministic, no RNG. 1 mirrors everything.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        sample: int = 1,
+        compare=None,
+        auth_key: bytes | None = None,
+        max_queue: int = 256,
+        connect_timeout_s: float = 5.0,
+        redial_interval_s: float = 1.0,
+        tracer=None,
+        span_stride: int = 64,
+    ):
+        if int(sample) < 1:
+            raise ValueError(f"sample={sample} must be >= 1 (the stride)")
+        self.host = host
+        self.port = int(port)
+        self.sample = int(sample)
+        self.compare = compare
+        self.auth_key = auth_key
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.redial_interval_s = float(redial_interval_s)
+        self.tracer = tracer
+        self._span_stride = max(1, int(span_stride))
+        self._lock = threading.Lock()
+        self._seen = 0
+        self._next_mid = 0
+        self._mirrored = 0
+        self._dropped = 0
+        self._errors = 0
+        self._inflight: set[int] = set()
+        self._q: "queue.Queue[tuple[int, bytes] | None]" = queue.Queue(
+            maxsize=max(1, int(max_queue))
+        )
+        # Serving-side pair completions ride their own bounded queue to
+        # a mirror-owned thread: completing a pair appends the paired
+        # JSONL record and rewrites status.json, and that disk I/O must
+        # not run on the ROUTER's backend reply thread (it would delay
+        # every multiplexed live reply behind it — the exact invariant
+        # the mirror exists to keep). Full queue = the pair is shed.
+        self._cq: "queue.Queue[tuple[str, int, bytes | None] | None]" = (
+            queue.Queue(maxsize=max(4 * int(max_queue), 1024))
+        )
+        self._sock: socket.socket | None = None
+        self._next_dial = 0.0
+        self._closed = threading.Event()
+        self._threads: list[threading.Thread] = []
+        m = obs_metrics.default_registry()
+        self._m_mirrored = m.counter(
+            "fedtpu_shadow_mirrored_total",
+            help="live scoring requests duplicated onto the shadow backend",
+        )
+        self._m_dropped = m.counter(
+            "fedtpu_shadow_mirror_dropped_total",
+            help="mirror copies dropped (bounded queue full) — the live "
+            "request was never delayed",
+        )
+        self._m_errors = m.counter(
+            "fedtpu_shadow_errors_total",
+            help="mirror sends/replies lost to a dead or failing shadow "
+            "backend (pass-through: serving unaffected)",
+        )
+
+    # --------------------------------------------------------------- control
+    def start(self) -> "ShadowMirror":
+        for target, name in (
+            (self._worker, "mirror"),
+            (self._compare_loop, "compare"),
+        ):
+            t = threading.Thread(
+                target=target, name=f"fedtpu-shadow-{name}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        log.info(
+            f"[SHADOW] mirroring 1/{self.sample} of live requests onto "
+            f"{self.host}:{self.port} (queue {self._q.maxsize})"
+        )
+        return self
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        for q in (self._q, self._cq):
+            try:
+                q.put_nowait(None)  # wake the workers
+            except queue.Full:
+                pass
+        self._teardown_conn()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        s = self.stats()
+        log.info(
+            f"[SHADOW] mirror closed: {s['mirrored']} mirrored, "
+            f"{s['dropped']} dropped (queue full), {s['errors']} "
+            "shadow-side errors"
+        )
+
+    def __enter__(self) -> "ShadowMirror":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "seen": self._seen,
+                "mirrored": self._mirrored,
+                "dropped": self._dropped,
+                "errors": self._errors,
+                "inflight": len(self._inflight),
+                "sample": self.sample,
+            }
+
+    # ------------------------------------------------------- serving-path API
+    def admit(self, frame: bytes) -> int | None:
+        """Counter-strided sampling decision + O(1) enqueue. Runs ON the
+        router's client loop: a counter increment, a dict-free stride
+        check, and one ``put_nowait`` — never blocks, never raises out.
+        Returns the pair key (mirror id) or None."""
+        with self._lock:
+            self._seen += 1
+            if (self._seen - 1) % self.sample != 0:
+                return None
+            self._next_mid += 1
+            mid = self._next_mid
+        try:
+            self._q.put_nowait((mid, bytes(frame)))
+        except queue.Full:
+            # The mirror copy is SHED — the live request proceeds
+            # untouched, and no pair is ever opened for this id.
+            with self._lock:
+                self._dropped += 1
+            self._m_dropped.inc()
+            return None
+        with self._lock:
+            self._mirrored += 1
+            mirrored = self._mirrored
+        self._m_mirrored.inc()
+        if self.tracer is not None and (
+            (mirrored - 1) % self._span_stride == 0
+        ):
+            self.tracer.record(
+                "shadow-mirror",
+                t_start=time.time(),
+                dur_s=0.0,
+                mirrored=mirrored,
+                sampled_requests=(
+                    self._span_stride if self._span_stride > 1 else None
+                ),
+            )
+        return mid
+
+    def note_serving_reply(self, mid: int, frame: bytes) -> None:
+        """The serving side of a sampled pair arrived (router reply
+        path). ONE bounded put_nowait and nothing else runs here: the
+        parse, the pairing, and the pair-completion disk I/O all happen
+        on the mirror's compare thread — the router's reply path must
+        never wait on the comparator's JSONL/status writes. A full
+        queue sheds the pair (counted)."""
+        if self.compare is None:
+            return
+        try:
+            self._cq.put_nowait(("serving", mid, bytes(frame)))
+        except queue.Full:
+            self._count_error(None)
+
+    def abandon(self, mid: int) -> None:
+        """Shed a pair (router path: eject / no replica / send failed).
+        Same one-enqueue discipline as :meth:`note_serving_reply`; on a
+        full queue the half-open entry is left to the comparator's
+        bounded-pending eviction."""
+        if self.compare is None:
+            return
+        try:
+            self._cq.put_nowait(("abandon", mid, None))
+        except queue.Full:
+            self._count_error(None)
+
+    def _compare_loop(self) -> None:
+        """Drain serving-side completions into the comparator. A reject
+        (shed request) abandons the pair — there is no serving
+        probability to compare."""
+        while True:
+            try:
+                item = self._cq.get(timeout=0.2)
+            except queue.Empty:
+                if self._closed.is_set():
+                    return
+                continue
+            if item is None or self._closed.is_set():
+                return
+            kind, mid, payload = item
+            if kind == "abandon":
+                self.compare.abandon(mid)
+                continue
+            try:
+                if protocol.is_reject(payload):
+                    self.compare.abandon(mid)
+                    continue
+                prob = float(protocol.parse_reply(payload)["prob"])
+            except (WireError, TypeError, ValueError):
+                self.compare.abandon(mid)
+                continue
+            self.compare.note_serving(mid, prob)
+
+    # ------------------------------------------------------- shadow-side work
+    def _count_error(self, mid: int | None = None) -> None:
+        with self._lock:
+            self._errors += 1
+        self._m_errors.inc()
+        if mid is not None:
+            self.abandon(mid)
+
+    def _teardown_conn(self) -> None:
+        with self._lock:
+            sock, self._sock = self._sock, None
+            stranded = list(self._inflight)
+            self._inflight.clear()
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for mid in stranded:
+            self.abandon(mid)
+
+    def _ensure_conn(self) -> socket.socket | None:
+        """Dial the shadow backend lazily, at most once per
+        ``redial_interval_s`` — a DEAD shadow replica must cost the
+        worker one bounded connect attempt per interval, not one per
+        mirrored request (pass-through, cheaply)."""
+        with self._lock:
+            if self._sock is not None:
+                return self._sock
+        now = time.monotonic()
+        if now < self._next_dial:
+            return None
+        self._next_dial = now + self.redial_interval_s
+        try:
+            sock = socket.create_connection(
+                (self.host, self.port), timeout=self.connect_timeout_s
+            )
+            sock.settimeout(None)
+            _set_nodelay(sock)
+            if self.auth_key is not None:
+                sock.settimeout(self.connect_timeout_s)
+                answer_auth_challenge(sock, self.auth_key)
+                sock.settimeout(None)
+        except (OSError, ConnectionError, WireError) as e:
+            log.debug(f"[SHADOW] shadow backend dial failed: {e}")
+            return None
+        with self._lock:
+            self._sock = sock
+        threading.Thread(
+            target=self._reader, args=(sock,), daemon=True
+        ).start()
+        return sock
+
+    def _worker(self) -> None:
+        """Drain the bounded queue onto the shadow connection. Only this
+        thread ever writes the socket, so frames cannot interleave."""
+        while True:
+            try:
+                item = self._q.get(timeout=0.2)
+            except queue.Empty:
+                if self._closed.is_set():
+                    return
+                continue
+            if item is None or self._closed.is_set():
+                return
+            mid, frame = item
+            sock = self._ensure_conn()
+            if sock is None:
+                self._count_error(mid)
+                continue
+            try:
+                out = protocol.rewrite_id(frame, mid)
+            except WireError:
+                self._count_error(mid)
+                continue
+            with self._lock:
+                self._inflight.add(mid)
+            try:
+                framing.send_frame(sock, out, await_ack=False)
+            except (OSError, ConnectionError):
+                self._count_error(None)
+                with self._lock:
+                    self._inflight.discard(mid)
+                self.abandon(mid)
+                self._teardown_conn()
+
+    def _reader(self, sock: socket.socket) -> None:
+        """Resolve shadow replies by the protocol's id echo — the pair's
+        shadow side goes to the comparator; rejects abandon the pair."""
+        while not self._closed.is_set():
+            try:
+                frame = bytes(
+                    framing.recv_frame(
+                        sock, send_ack=False, max_frame=MAX_REQUEST_FRAME
+                    )
+                )
+                mid = protocol.frame_id(frame)
+            except (OSError, ConnectionError, WireError):
+                with self._lock:
+                    lost = self._sock is sock
+                if lost:
+                    self._count_error(None)
+                    self._teardown_conn()
+                return
+            with self._lock:
+                known = mid in self._inflight
+                self._inflight.discard(mid)
+            if not known or self.compare is None:
+                continue
+            try:
+                if protocol.is_reject(frame):
+                    self.compare.abandon(mid)
+                else:
+                    self.compare.note_shadow(
+                        mid, float(protocol.parse_reply(frame)["prob"])
+                    )
+            except (WireError, TypeError, ValueError):
+                self._count_error(mid)

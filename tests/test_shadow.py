@@ -1,0 +1,1047 @@
+"""Shadow evaluation plane (ISSUE 13): live-traffic mirroring
+(shadow/mirror.py), paired disagreement accounting (shadow/compare.py),
+the fail-closed promotion gate (shadow/gate.py), the registry shadow
+pointer, the fleet manager's shadow lifecycle, the SCORE_RELOAD
+out-of-process reload choreography, and the controller's adaptive
+cadence + SLO actuation satellites.
+
+Contracts pinned here:
+
+* Mirrored pairs are BIT-EXACT: the shadow side of a pair equals the
+  predict pipeline's probability for the shadow params, the serving
+  side the incumbent's — the mirror ships the same request bytes.
+* A full mirror queue drops the COPY; the live reply is never delayed
+  or failed. A dead shadow backend degrades to pass-through.
+* The gate promotes an agreeing candidate and REJECTS a regressing one
+  with the verdict recorded on the registry event; the serving pointer
+  never moves on a gate miss. Timeout with no evidence fails closed.
+* ``ScoringRouter.reload_replica`` drives a drain-then-reload-now sweep
+  over out-of-process replicas via the SCORE_RELOAD frame, while the
+  in-process rolling-reload path keeps sending ZERO reload frames.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+    wire,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+    ControlConfig,
+    ModelConfig,
+    ShadowConfig,
+    TrainConfig,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.control import (
+    Controller,
+    SloActuator,
+    cadence_interval_s,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+    default_tokenizer,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.registry import (
+    ModelRegistry,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.router import (
+    FleetReplica,
+    ScoringRouter,
+    ServingFleet,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.serving import (
+    ScoringClient,
+    protocol,
+    run_load,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.shadow import (
+    ShadowCompare,
+    ShadowGate,
+    ShadowMirror,
+    evaluate_status,
+    pairs_path,
+    read_status,
+)
+from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train.engine import (
+    Trainer,
+)
+
+TEXTS = [
+    f"Destination port is {p}. Flow duration is {d} microseconds. "
+    f"Total forward packets are {n}."
+    for p, d, n in [
+        (80, 100, 3),
+        (443, 2500, 9),
+        (8080, 7, 1),
+        (53, 120000, 44),
+    ]
+]
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    tok = default_tokenizer()
+    model_cfg = ModelConfig.tiny(vocab_size=len(tok.vocab))
+    trainer = Trainer(model_cfg, TrainConfig(), pad_id=tok.pad_id)
+    params = trainer.init_state(seed=0).params
+    flat = wire.flatten_params(params)
+    # Agreeing candidate: one leaf nudged 1e-6 — distinct artifact id,
+    # indistinguishable scores.
+    agree = dict(flat)
+    k0 = sorted(agree)[0]
+    agree[k0] = np.asarray(agree[k0]) + np.float32(1e-6)
+    # Regressing candidate: classifier bias slammed so P(attack) ~ 0 —
+    # every pair against a ~0.5-scoring incumbent flips, deterministically.
+    bad = dict(flat)
+    bad["classifier/bias"] = np.asarray([10.0, -10.0], np.float32)
+    return (
+        tok,
+        model_cfg,
+        trainer,
+        params,
+        wire.unflatten_params(agree),
+        wire.unflatten_params(bad),
+    )
+
+
+def _replica(tiny_setup, replica_id=0, *, params=None, round_id=1, **kw):
+    tok, model_cfg, _t, p1, _pa, _pb = tiny_setup
+    kw.setdefault("buckets", (1, 4))
+    kw.setdefault("gather_window_s", 0.002)
+    return FleetReplica(
+        replica_id,
+        model_cfg,
+        params if params is not None else p1,
+        tok,
+        round_id=round_id,
+        **kw,
+    ).start()
+
+
+def _expected_probs(tiny_setup, texts, params):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.pipeline import (
+        TokenizedSplit,
+    )
+
+    tok, model_cfg, trainer, _p, _pa, _pb = tiny_setup
+    enc = tok.batch_encode(texts, max_len=model_cfg.max_len)
+    split = TokenizedSplit(
+        enc["input_ids"],
+        enc["attention_mask"],
+        np.zeros(len(texts), np.int32),
+    )
+    return trainer.evaluate(params, split, batch_size=4)["probs"]
+
+
+# -------------------------------------------------------------- compare unit
+def test_compare_pairs_either_order_and_stats(tmp_path):
+    """Pairs complete regardless of arrival order; flips, |dprob|, and
+    the paired JSONL/status artifacts all agree with hand arithmetic."""
+    pairs = str(tmp_path / "pairs.jsonl")
+    status = str(tmp_path / "status.json")
+    c = ShadowCompare(
+        threshold=0.5, bins=10, pairs_jsonl=pairs, status_path=status,
+        status_every=1,
+    )
+    c.note_serving(1, 0.9)
+    c.note_shadow(1, 0.91)  # agree (both >= 0.5)
+    c.note_shadow(2, 0.2)  # shadow first
+    c.note_serving(2, 0.8)  # flip
+    c.note_serving(3, 0.4)
+    c.abandon(3)  # shed before the shadow side arrived
+    s = c.snapshot()
+    assert s["pairs"] == 2 and s["flips"] == 1
+    assert s["flip_rate"] == pytest.approx(0.5)
+    assert s["mean_abs_dprob"] == pytest.approx((0.01 + 0.6) / 2)
+    assert s["abandoned"] == 1 and s["pending"] == 0
+    assert sum(s["hist_serving"]) == 2 and sum(s["hist_shadow"]) == 2
+    recs = [json.loads(ln) for ln in open(pairs)]
+    assert [r["flip"] for r in recs] == [0, 1]
+    assert recs[0]["serving_prob"] == 0.9  # exact doubles round-trip
+    on_disk = json.load(open(status))
+    assert on_disk["pairs"] == 2  # the atomic cross-process surface
+    # Duplicate one-sided arrival keeps the first value, stays half-open.
+    c.note_serving(9, 0.7)
+    c.note_serving(9, 0.1)
+    assert c.snapshot()["pending"] == 1
+
+
+def test_compare_bounded_pending_drops_oldest():
+    c = ShadowCompare(max_pending=2)
+    c.note_serving(1, 0.5)
+    c.note_serving(2, 0.5)
+    c.note_serving(3, 0.5)  # evicts mid 1
+    s = c.snapshot()
+    assert s["pending"] == 2 and s["pending_dropped"] == 1
+    c.note_shadow(1, 0.5)  # its other half: now just a half-open orphan
+    c.note_shadow(3, 0.5)  # still paired fine
+    s = c.snapshot()
+    assert s["pairs"] == 1
+
+
+def test_evaluate_status_verdicts_both_directions():
+    """The gate arithmetic: agree promotes, each disagreement axis (and
+    missing evidence) fails closed."""
+    base = {"pairs": 100, "flip_rate": 0.0, "psi": 0.01}
+    ok, reason = evaluate_status(
+        base, min_pairs=50, max_flip_rate=0.02, psi_threshold=0.25
+    )
+    assert ok and "agreement" in reason
+    ok, reason = evaluate_status(
+        {**base, "pairs": 10},
+        min_pairs=50, max_flip_rate=0.02, psi_threshold=0.25,
+    )
+    assert not ok and "insufficient" in reason
+    ok, reason = evaluate_status(
+        {**base, "flip_rate": 0.5},
+        min_pairs=50, max_flip_rate=0.02, psi_threshold=0.25,
+    )
+    assert not ok and "flip_rate" in reason
+    ok, reason = evaluate_status(
+        {**base, "psi": 1.7},
+        min_pairs=50, max_flip_rate=0.02, psi_threshold=0.25,
+    )
+    assert not ok and "psi" in reason
+    ok, reason = evaluate_status(
+        {**base, "psi": None},
+        min_pairs=50, max_flip_rate=0.02, psi_threshold=0.25,
+    )
+    assert not ok  # uncomputable distance fails closed
+
+
+def test_gate_timeout_fails_closed_injectable_clock(tmp_path):
+    """No evidence inside the gate's patience = rejection, measured on
+    an injected clock — no wall time passes in this test."""
+    clock = [0.0]
+    sleeps = []
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        clock[0] += s
+
+    gate = ShadowGate(
+        str(tmp_path),
+        min_pairs=8,
+        timeout_s=5.0,
+        poll_s=1.0,
+        clock=lambda: clock[0],
+        sleep=fake_sleep,
+    )
+    ok, verdict = gate.wait("cafebabe")
+    assert not ok
+    assert "timeout" in verdict["reason"] and "failing closed" in verdict["reason"]
+    assert verdict["pairs"] == 0
+    assert len(sleeps) == 5  # 5 x 1s polls then the deadline
+
+
+def test_shadow_config_validation():
+    ShadowConfig(sample=4)
+    with pytest.raises(ValueError):
+        ShadowConfig(sample=-1)
+    with pytest.raises(ValueError):
+        ShadowConfig(max_flip_rate=1.5)
+    with pytest.raises(ValueError):
+        ShadowConfig(min_pairs=0)
+    with pytest.raises(ValueError):
+        ShadowConfig(threshold=0.0)
+
+
+# ------------------------------------------------------ registry shadow ptr
+def test_registry_shadow_pointer_lifecycle(tmp_path):
+    """promote(to='shadow') announces the evaluation; leaving the state
+    (serving, rejected) clears it; an unrelated artifact's transitions
+    never tear down a live shadow pointer. reject(verdict=) records the
+    measured disagreement on the audit trail."""
+    r = ModelRegistry(str(tmp_path / "reg"))
+    a = r.add({"w": np.zeros(4, np.float32)}, round_index=0)
+    b = r.add({"w": np.ones(4, np.float32)}, round_index=1)
+    assert r.shadow_info() is None
+    r.promote(a, to="shadow")
+    assert r.shadow_info()["artifact"] == a
+    # Unrelated artifact promoted to serving: shadow pointer untouched.
+    r.promote(b, to="serving")
+    assert r.shadow_info()["artifact"] == a
+    r.promote(a, to="serving")
+    assert r.shadow_info() is None  # left the state by promotion
+    # The incumbent can never shadow-evaluate against itself.
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.registry.store import (
+        RegistryError,
+    )
+
+    with pytest.raises(RegistryError, match="serving"):
+        r.promote(a, to="shadow")
+    c = r.add({"w": np.full(4, 2.0, np.float32)}, round_index=2)
+    r.promote(c, to="shadow")
+    verdict = {"pairs": 80, "flip_rate": 0.4, "psi": 1.2, "ok": False}
+    r.reject(c, reason="live disagreement", verdict=verdict)
+    assert r.shadow_info() is None  # left the state by rejection
+    events = [
+        json.loads(ln)
+        for ln in (tmp_path / "reg" / "events.jsonl").read_text().splitlines()
+    ]
+    rej = [e for e in events if e["event"] == "rejected"][-1]
+    assert rej["artifact"] == c and rej["verdict"]["flip_rate"] == 0.4
+
+
+# ----------------------------------------------------------- live mirroring
+def test_mirror_pairs_bit_exact_live(tiny_setup, tmp_path):
+    """Router + incumbent replica + shadow replica on DIFFERENT params:
+    every mirrored pair's serving side equals the reply the live client
+    received (and the incumbent pipeline's probability) bit-for-bit, and
+    the shadow side equals scoring the same text on the shadow replica
+    directly — the mirror ships the same request bytes both ways.
+    Singleton buckets on the shadow replica pin the batch shape, so the
+    comparison is structural, not timing-dependent."""
+    tok, model_cfg, _t, p1, _pa, p_bad = tiny_setup
+    serve_rep = _replica(tiny_setup, 0)
+    shadow_rep = _replica(
+        tiny_setup, 9, params=p_bad, round_id=2, buckets=(1,)
+    )
+    # Direct sequential scores on the shadow replica: the reference the
+    # mirrored copies must reproduce bit-for-bit (same bytes, same
+    # singleton bucket program).
+    with ScoringClient("127.0.0.1", shadow_rep.port) as cli:
+        direct_shadow = [cli.score(text=t)["prob"] for t in TEXTS]
+    compare = ShadowCompare(
+        threshold=0.5, bins=10,
+        pairs_jsonl=str(tmp_path / "pairs.jsonl"),
+    )
+    router = ScoringRouter(
+        [("127.0.0.1", serve_rep.port)], probe_interval_s=0.2
+    )
+    mirror = ShadowMirror(
+        "127.0.0.1", shadow_rep.port, sample=1, compare=compare
+    ).start()
+    try:
+        router.start()
+        router.set_mirror(mirror)
+        want_serve = _expected_probs(tiny_setup, TEXTS, p1)
+        live_replies = []
+        with ScoringClient("127.0.0.1", router.port) as cli:
+            for text, p in zip(TEXTS, want_serve):
+                reply = cli.score(text=text)
+                assert reply["prob"] == float(np.float32(p))
+                live_replies.append(reply["prob"])
+        deadline = time.monotonic() + 15.0
+        while compare.snapshot()["pairs"] < len(TEXTS):
+            assert time.monotonic() < deadline, compare.snapshot()
+            time.sleep(0.05)
+        recs = [
+            json.loads(ln) for ln in open(str(tmp_path / "pairs.jsonl"))
+        ]
+        assert len(recs) == len(TEXTS)
+        by_mid = sorted(recs, key=lambda r: r["mid"])
+        for rec, live, direct in zip(by_mid, live_replies, direct_shadow):
+            assert rec["serving_prob"] == live  # the pair IS the reply
+            assert rec["shadow_prob"] == direct  # bit-exact either side
+            # The saturated candidate scores ~0: a flip wherever the
+            # incumbent answered "attack".
+            assert rec["shadow_prob"] < 1e-6
+            assert rec["flip"] == int(rec["serving_prob"] >= 0.5)
+        s = compare.snapshot()
+        assert s["psi"] is not None
+    finally:
+        router.set_mirror(None)
+        mirror.close()
+        router.close()
+        serve_rep.close()
+        shadow_rep.close()
+
+
+def test_mirror_full_queue_drops_copy_not_live_reply(tiny_setup):
+    """A shadow backend that accepts but never answers + a 1-slot mirror
+    queue: live replies keep flowing at full speed, dropped mirror
+    copies are counted, and no live request is rejected."""
+    import socket as _socket
+
+    serve_rep = _replica(tiny_setup, 0)
+    # A sink that accepts and reads nothing: the mirror's worker blocks
+    # on backpressure eventually, so admit()'s bounded queue fills.
+    sink = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    sink.bind(("127.0.0.1", 0))
+    sink.listen(8)
+    sink_conns = []
+
+    def sink_accept():
+        while True:
+            try:
+                conn, _ = sink.accept()
+            except OSError:
+                return
+            sink_conns.append(conn)
+
+    threading.Thread(target=sink_accept, daemon=True).start()
+    compare = ShadowCompare()
+    router = ScoringRouter(
+        [("127.0.0.1", serve_rep.port)], probe_interval_s=0.2
+    )
+    mirror = ShadowMirror(
+        "127.0.0.1",
+        sink.getsockname()[1],
+        sample=1,
+        compare=compare,
+        max_queue=1,
+    ).start()
+    try:
+        router.start()
+        router.set_mirror(mirror)
+        stats = run_load(
+            "127.0.0.1", router.port, TEXTS, concurrency=4,
+            requests=64, pipeline=4, timeout=30,
+        )
+        assert stats["scored"] == 64 and stats["rejected"] == 0
+        ms = mirror.stats()
+        assert ms["seen"] == 64
+        # The 1-slot queue sheds copies under load; nothing live paid.
+        assert ms["dropped"] + ms["mirrored"] == 64
+        assert ms["dropped"] > 0
+    finally:
+        router.set_mirror(None)
+        mirror.close()
+        router.close()
+        serve_rep.close()
+        try:
+            sink.close()
+        except OSError:
+            pass
+        for c in sink_conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+def test_mirror_dead_shadow_is_pass_through(tiny_setup):
+    """A shadow backend that refuses connections entirely: live scoring
+    is untouched, errors are counted, nothing raises on the hot path."""
+    import socket as _socket
+
+    # Reserve a port that is closed by the time the mirror dials it.
+    probe = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+    serve_rep = _replica(tiny_setup, 0)
+    compare = ShadowCompare()
+    router = ScoringRouter(
+        [("127.0.0.1", serve_rep.port)], probe_interval_s=0.2
+    )
+    mirror = ShadowMirror(
+        "127.0.0.1", dead_port, sample=1, compare=compare,
+        redial_interval_s=0.05,
+    ).start()
+    try:
+        router.start()
+        router.set_mirror(mirror)
+        stats = run_load(
+            "127.0.0.1", router.port, TEXTS, concurrency=2,
+            requests=16, timeout=30,
+        )
+        assert stats["scored"] == 16 and stats["rejected"] == 0
+        deadline = time.monotonic() + 10.0
+        while mirror.stats()["errors"] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        assert compare.snapshot()["pairs"] == 0
+    finally:
+        router.set_mirror(None)
+        mirror.close()
+        router.close()
+        serve_rep.close()
+
+
+def test_mirror_sample_stride_is_deterministic(tiny_setup):
+    """--shadow-sample N mirrors exactly every Nth admitted request via
+    the counter — no RNG, so the sampled set is a pure function of
+    arrival order."""
+    compare = ShadowCompare()
+    mirror = ShadowMirror(
+        "127.0.0.1", 1, sample=4, compare=compare, max_queue=64
+    )
+    # admit() alone (no worker started): pure sampling arithmetic.
+    frame = protocol.build_request(1, text="x")
+    mids = [mirror.admit(frame) for _ in range(16)]
+    assert [m is not None for m in mids] == [
+        i % 4 == 0 for i in range(16)
+    ]
+    assert mirror.stats()["mirrored"] == 4
+
+
+# -------------------------------------------- fleet lifecycle + gated e2e
+def test_fleet_shadow_gate_promotes_and_rejects_e2e(tiny_setup, tmp_path):
+    """The acceptance-shaped flow: an agreeing candidate enters shadow,
+    accumulates live pairs under load, passes the gate, and promotes
+    (fleet rolling-reloads, shadow plane torn down); a regressing
+    candidate is REJECTED with the verdict on the registry event and the
+    pointer never moves. Spans: shadow-compare + shadow-gate emitted."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs import (
+        Tracer,
+        load_spans,
+    )
+
+    tok, model_cfg, _t, p1, p_agree, p_bad = tiny_setup
+    registry = ModelRegistry(str(tmp_path / "reg"))
+    aid1 = registry.add(p1, round_index=1, model_config=model_cfg)
+    registry.promote(aid1, to="serving")
+    tracer = Tracer(str(tmp_path / "shadow.jsonl"), proc="fleet")
+    reps = [_replica(tiny_setup, i) for i in range(2)]
+
+    def shadow_factory(params, *, round_id):
+        return _replica(
+            tiny_setup, 9, params=params, round_id=round_id
+        )
+
+    fleet = ServingFleet(
+        reps,
+        registry=registry,
+        probe_interval_s=0.2,
+        reload_poll_s=0.05,
+        shadow_factory=shadow_factory,
+        shadow_sample=1,
+        tracer=tracer,
+    ).start()
+    min_pairs = 16
+    root = str(tmp_path / "reg")
+
+    def wait_armed(aid):
+        deadline = time.monotonic() + 20.0
+        while fleet.stats()["shadow_artifact"] != aid:
+            assert time.monotonic() < deadline, "shadow never armed"
+            time.sleep(0.05)
+
+    def drive(aid):
+        stop = threading.Event()
+        dropped = [0]
+
+        def loader():
+            while not stop.is_set():
+                s = run_load(
+                    "127.0.0.1", fleet.port, TEXTS, concurrency=4,
+                    requests=32, pipeline=4, timeout=30,
+                )
+                dropped[0] += s["rejected"]
+
+        lt = threading.Thread(target=loader, daemon=True)
+        lt.start()
+        try:
+            gate = ShadowGate(
+                root, min_pairs=min_pairs, timeout_s=60.0, poll_s=0.1,
+                tracer=tracer,
+            )
+            ok, verdict = gate.wait(aid)
+        finally:
+            stop.set()
+            lt.join(timeout=60.0)
+        assert dropped[0] == 0  # mirroring never cost a live request
+        return ok, verdict
+
+    try:
+        # (1) The agreeing candidate promotes through the gate.
+        aid2 = registry.add(p_agree, round_index=2, model_config=model_cfg)
+        registry.promote(aid2, to="shadow")
+        wait_armed(aid2)
+        ok, verdict = drive(aid2)
+        assert ok and verdict["pairs"] >= min_pairs
+        assert verdict["flip_rate"] == 0.0
+        registry.promote(aid2, to="serving")
+        deadline = time.monotonic() + 20.0
+        while fleet.stats()["reloads"] < 1:
+            assert time.monotonic() < deadline, "rolling reload never ran"
+            time.sleep(0.05)
+        deadline = time.monotonic() + 10.0
+        while fleet.stats()["shadow_artifact"] is not None:
+            assert time.monotonic() < deadline, "shadow never torn down"
+            time.sleep(0.05)
+        assert [r.round_id for r in reps] == [2, 2]
+        # (2) The regressing candidate is held out of serving.
+        aid3 = registry.add(p_bad, round_index=3, model_config=model_cfg)
+        registry.promote(aid3, to="shadow")
+        wait_armed(aid3)
+        ok3, verdict3 = drive(aid3)
+        assert not ok3
+        # The saturated candidate disagrees massively on SOME axis —
+        # flips wherever the incumbent answers "attack", and a huge PSI
+        # regardless (its whole score mass sits in the bottom bin).
+        assert (
+            verdict3["flip_rate"] > 0.02
+            or (verdict3["psi"] is not None and verdict3["psi"] > 0.25)
+        )
+        registry.reject(aid3, reason=verdict3["reason"], verdict=verdict3)
+        assert registry.serving_info()["artifact"] == aid2
+        assert registry.manifest(aid3)["state"] == "rejected"
+        # Paired evidence on disk for the post-hoc report.
+        assert read_status(root, aid3)["pairs"] >= min_pairs
+        assert len(open(pairs_path(root, aid3)).readlines()) >= min_pairs
+        # (3) Operator re-promote of the rejected artifact: the re-armed
+        # plane starts from ZERO evidence — the gate must never rule on
+        # the previous evaluation's stale status within one poll.
+        deadline = time.monotonic() + 10.0
+        while fleet.stats()["shadow_artifact"] is not None:
+            assert time.monotonic() < deadline, "shadow never torn down"
+            time.sleep(0.05)
+        registry.promote(aid3, to="shadow")
+        wait_armed(aid3)
+        st = read_status(root, aid3)
+        assert st is None or st["pairs"] == 0
+    finally:
+        fleet.close()
+        for r in reps:
+            r.close()
+    events = [
+        json.loads(ln)
+        for ln in (tmp_path / "reg" / "events.jsonl").read_text().splitlines()
+    ]
+    rej = [e for e in events if e["event"] == "rejected"][-1]
+    assert rej["artifact"] == aid3
+    # WHY, on the audit trail: the measured verdict rides the event.
+    assert rej["verdict"]["pairs"] >= min_pairs
+    assert "disagreement" in rej["reason"]
+    spans = load_spans([str(tmp_path / "shadow.jsonl")])
+    names = {s["span"] for s in spans}
+    assert "shadow-compare" in names and "shadow-gate" in names
+    gates = [s for s in spans if s["span"] == "shadow-gate"]
+    assert {g["artifact"] for g in gates} == {aid2, aid3}
+    assert {g["passed"] for g in gates} == {True, False}
+    mirrors = [s for s in spans if s["span"] == "shadow-mirror"]
+    assert mirrors  # the mirror's strided spans landed too
+
+
+def test_controller_shadow_gate_integration(tmp_path):
+    """Controller + a stub gate: a passing verdict promotes through
+    shadow -> serving; a failing one records shadow_rejected with the
+    verdict, leaves the pointer on the incumbent, and the state JSONL
+    replays the tallies."""
+
+    class Srv:
+        dp_clip = 0.0
+
+        def __init__(self):
+            self.n = 0
+
+        def serve_round(self, *, deadline=None, round_index=None):
+            self.n += 1
+            return {"w": np.full(8, float(self.n), np.float32)}
+
+    class StubGate:
+        def __init__(self, outcomes):
+            self.outcomes = list(outcomes)
+            self.asked = []
+
+        def wait(self, aid):
+            self.asked.append(aid)
+            ok = self.outcomes.pop(0)
+            return ok, {
+                "ok": ok,
+                "reason": "stub",
+                "pairs": 99,
+                "flip_rate": 0.0 if ok else 1.0,
+                "psi": 0.0 if ok else 9.9,
+            }
+
+    def eval_fn(params):
+        w = float(np.asarray(params["w"]).mean())
+        rng = np.random.default_rng(3)
+        return {"Accuracy": w, "probs": rng.uniform(0, 1, 64)}
+
+    registry = ModelRegistry(str(tmp_path / "reg"))
+    state = str(tmp_path / "state.jsonl")
+    gate = StubGate([True, False])
+    ctl = Controller(
+        Srv(), registry, eval_fn, state_path=state, shadow_gate=gate
+    )
+    out1 = ctl.run_cycle()
+    assert out1["event"] == "promoted"
+    first = registry.serving_info()["artifact"]
+    out2 = ctl.run_cycle()  # better eval, but the LIVE gate refuses
+    assert out2["event"] == "shadow_rejected"
+    assert out2["shadow_verdict"]["flip_rate"] == 1.0
+    assert registry.serving_info()["artifact"] == first  # pointer held
+    assert ctl.stats.promotions == 1 and ctl.stats.shadow_rejections == 1
+    assert len(gate.asked) == 2
+    rejected = [
+        m for m in registry.list() if m["state"] == "rejected"
+    ]
+    assert len(rejected) == 1
+    # Resume replay keeps the tallies consistent.
+    resumed = Controller(
+        Srv(), registry, eval_fn, state_path=state
+    )
+    assert resumed.stats.shadow_rejections == 1
+    assert resumed.stats.rounds_completed == 2
+
+
+# --------------------------------------------------- SCORE_RELOAD satellite
+def test_reload_frame_codecs_roundtrip():
+    req = protocol.build_reload_request(7)
+    assert protocol.is_reload_request(req)
+    assert protocol.parse_reload_request(req)["id"] == 7
+    rep = protocol.build_reload_reply(7, reloaded=True, round_id=3)
+    assert protocol.is_reload_reply(rep)
+    body = protocol.parse_reload_reply(rep)
+    assert body == {"id": 7, "reloaded": True, "round": 3}
+    # The router remaps reload frames like everything else it relays.
+    assert protocol.frame_id(protocol.rewrite_id(req, 42)) == 42
+    with pytest.raises(wire.WireError):
+        protocol.parse_reload_reply(req)
+
+
+def test_router_reload_replica_drives_out_of_process_adoption(
+    tiny_setup, tmp_path
+):
+    """A replica the router cannot hot-swap (its own RegistryWatcher, as
+    a subprocess replica would run): promote an artifact, then
+    ``rolling_remote_reload`` — the SCORE_RELOAD frame forces the
+    watcher poll NOW and the reply reports the adopted round."""
+    import dataclasses
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.serving import (
+        MicroBatcher,
+        RegistryWatcher,
+        ScoreEngine,
+        ScoringServer,
+    )
+
+    tok, model_cfg, _t, p1, p_agree, _pb = tiny_setup
+    registry = ModelRegistry(str(tmp_path / "reg"))
+    mc = dataclasses.asdict(model_cfg)
+    a = registry.add(p1, round_index=1, model_config=mc)
+    registry.promote(a, to="serving")
+    engine = ScoreEngine(
+        model_cfg, registry.load_params(a), pad_id=tok.pad_id,
+        buckets=(1, 4), round_id=1,
+    )
+    # A LONG poll interval: without the force path, adoption would take
+    # ~an hour — the prompt reply below proves SCORE_RELOAD bypassed it.
+    watcher = RegistryWatcher(registry, poll_interval_s=3600.0)
+    watcher.prime(a)
+    server = ScoringServer(
+        engine, tok,
+        batcher=MicroBatcher(max_batch=4, gather_window_s=0.002),
+        watcher=watcher, idle_tick_s=0.01, replica_id=0, warmup=False,
+    ).start()
+    router = ScoringRouter(
+        [("127.0.0.1", server.port)], probe_interval_s=0.2
+    )
+    try:
+        router.start()
+        with ScoringClient("127.0.0.1", router.port) as cli:
+            assert cli.score(text=TEXTS[0])["round"] == 1
+        b = registry.add(p_agree, round_index=2, model_config=mc)
+        registry.promote(b, to="serving")
+        out = router.rolling_remote_reload(reload_timeout_s=30.0)
+        rep0 = out["replicas"][0]
+        assert rep0["answered"] and rep0["reloaded"]
+        assert rep0["round"] == 2
+        assert watcher.reload_count == 1
+        with ScoringClient("127.0.0.1", router.port) as cli:
+            assert cli.score(text=TEXTS[0])["round"] == 2
+        stats = ScoringClient("127.0.0.1", server.port)
+        try:
+            assert stats.stats()["reload_frames"] == 1
+        finally:
+            stats.close()
+    finally:
+        router.close()
+        server.close()
+
+
+def test_in_process_rolling_reload_sends_no_reload_frames(
+    tiny_setup, tmp_path
+):
+    """Regression for the existing zero-drop path: the in-process fleet
+    manager drives engine hot-swaps directly — the new SCORE_RELOAD
+    choreography must not ride it (reload_frames stays 0) and the swap
+    still lands with zero drops."""
+    tok, model_cfg, _t, p1, p_agree, _pb = tiny_setup
+    registry = ModelRegistry(str(tmp_path / "reg"))
+    aid1 = registry.add(p1, round_index=1, model_config=model_cfg)
+    registry.promote(aid1, to="serving")
+    reps = [_replica(tiny_setup, i) for i in range(2)]
+    fleet = ServingFleet(
+        reps, registry=registry, probe_interval_s=0.2, reload_poll_s=0.05
+    ).start()
+    try:
+        aid2 = registry.add(p_agree, round_index=2, model_config=model_cfg)
+        registry.promote(aid2, to="serving")
+        deadline = time.monotonic() + 20.0
+        while fleet.stats()["reloads"] < 1:
+            assert time.monotonic() < deadline, "rolling reload never ran"
+            time.sleep(0.05)
+        stats = run_load(
+            "127.0.0.1", fleet.port, TEXTS, concurrency=2, requests=16
+        )
+        assert stats["rejected"] == 0
+        assert [r.round_id for r in reps] == [2, 2]
+        for rep in reps:
+            assert rep.server.stats()["reload_frames"] == 0
+    finally:
+        fleet.close()
+        for r in reps:
+            r.close()
+
+
+# ------------------------------------------------- controller satellites
+def test_cadence_interval_pure_function():
+    """Drift magnitude -> inter-round interval: max at the bare
+    threshold, min at 2x threshold and beyond, linear between, and the
+    degenerate configs degrade to min."""
+    kw = dict(threshold=0.25, min_s=5.0, max_s=65.0)
+    assert cadence_interval_s(0.25, **kw) == 65.0
+    assert cadence_interval_s(0.50, **kw) == 5.0
+    assert cadence_interval_s(9.99, **kw) == 5.0
+    mid = cadence_interval_s(0.375, **kw)
+    assert mid == pytest.approx(35.0)
+    assert cadence_interval_s(0.1, **kw) == 65.0  # below threshold clamps
+    assert cadence_interval_s(0.5, threshold=0.25, min_s=5.0, max_s=None) == 5.0
+    assert cadence_interval_s(0.5, threshold=0.25, min_s=10.0, max_s=3.0) == 10.0
+
+
+def test_adaptive_cadence_records_interval_on_drift_span(tmp_path):
+    """A synthetic drift verdict through _wait_for_trigger: the chosen
+    interval rides the drift-trigger span + state record and becomes
+    the next throttle; a clock-fallback trigger relaxes it back."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.control import (
+        DriftMonitor,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs import (
+        Tracer,
+        load_spans,
+    )
+
+    registry = ModelRegistry(str(tmp_path / "reg"))
+    a = registry.add({"w": np.zeros(4, np.float32)}, round_index=0)
+    registry.promote(a, to="serving")
+
+    class Srv:
+        dp_clip = 0.0
+
+        def serve_round(self, *, deadline=None, round_index=None):
+            return {"w": np.full(4, 0.5, np.float32)}
+
+    # Threshold 7.0: the first synthetic shift (psi ~7.31) fires BARELY
+    # over it -> a relaxed, near-max interval; the full collapse below
+    # (psi ~17, >= 2x threshold) floors at min. No wall-clock anywhere:
+    # _wait_for_trigger returns immediately on a ready verdict because
+    # min_interval applies only after a round started.
+    dm = DriftMonitor(
+        reference=[100, 0, 0, 0, 0, 0, 0, 0, 0, 100],
+        threshold=7.0,
+        min_scores=8,
+    )
+    tracer = Tracer(str(tmp_path / "ctl.jsonl"), proc="controller")
+    ctl = Controller(
+        Srv(),
+        registry,
+        lambda p: {"Accuracy": 0.9},
+        control=ControlConfig(
+            adaptive_cadence=True, min_interval_s=1.0, max_interval_s=30.0
+        ),
+        state_path=str(tmp_path / "state.jsonl"),
+        drift_monitor=dm,
+        drift_poll_s=0.01,
+        tracer=tracer,
+    )
+    dm.observe([0, 0, 0, 40, 40, 0, 0, 0, 0, 120])
+    stop = threading.Event()
+    trig = ctl._wait_for_trigger(stop)
+    assert trig == "drift"
+    assert ctl._interval_override is not None
+    chosen = ctl._interval_override
+    assert 1.0 < chosen <= 30.0  # mild verdict -> relaxed cadence
+    spans = load_spans([str(tmp_path / "ctl.jsonl")])
+    dspan = [s for s in spans if s["span"] == "drift-trigger"][-1]
+    assert dspan["next_interval_s"] == pytest.approx(chosen, abs=1e-3)
+    events = [
+        json.loads(ln) for ln in open(str(tmp_path / "state.jsonl"))
+    ]
+    drec = [e for e in events if e["event"] == "drift_trigger"][-1]
+    assert drec["next_interval_s"] == pytest.approx(chosen, abs=1e-3)
+    # Massive shift -> urgent: the override collapses to the min.
+    dm.observe([0, 0, 0, 0, 500, 500, 0, 0, 0, 0])
+    assert ctl._wait_for_trigger(stop) == "drift"
+    assert ctl._interval_override < chosen
+    assert ctl._interval_override == 1.0
+
+
+def test_slo_actuator_tightens_until_clear(tmp_path):
+    """Fire/clear events from a synthetic alerts-JSONL: the straggler
+    deadline tightens by the factor while firing and restores on clear.
+    Pure event arithmetic — no clocks, no sleeps."""
+    alerts = str(tmp_path / "alerts.jsonl")
+    act = SloActuator(alerts, factor=0.5)
+    assert act.poll() is False  # missing file = quiet
+    assert act.effective_deadline(20.0) == 20.0
+    assert act.effective_deadline(None) is None
+
+    def emit(event, slo="round-duration", instance="server:1"):
+        with open(alerts, "a") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "schema": "fedtpu-alert-v1",
+                        "event": event,
+                        "slo": slo,
+                        "instance": instance,
+                        "severity": "page",
+                    }
+                )
+                + "\n"
+            )
+
+    emit("fire")
+    assert act.poll() is True
+    assert act.effective_deadline(20.0) == 10.0
+    assert act.effective_deadline(None) is None  # nothing to tighten
+    emit("fire", slo="scoring-queue-p99")  # unrelated SLO: ignored
+    emit("clear")
+    assert act.poll() is False
+    assert act.effective_deadline(20.0) == 20.0
+    # Two instances fire independently; both must clear.
+    emit("fire", instance="a")
+    emit("fire", instance="b")
+    emit("clear", instance="a")
+    assert act.poll() is True
+    emit("clear", instance="b")
+    assert act.poll() is False
+    with pytest.raises(ValueError):
+        SloActuator(alerts, factor=0.0)
+
+
+def test_controller_slo_actuation_tightens_round_deadline(tmp_path):
+    """The controller hands the TIGHTENED deadline to the round engine
+    while the alert fires, and the configured one after it clears."""
+    alerts = str(tmp_path / "alerts.jsonl")
+
+    def emit(event):
+        with open(alerts, "a") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "event": event,
+                        "slo": "round-duration",
+                        "instance": "server:1",
+                    }
+                )
+                + "\n"
+            )
+
+    seen = []
+
+    class Srv:
+        dp_clip = 0.0
+
+        def __init__(self):
+            self.n = 0
+
+        def serve_round(self, *, deadline=None, round_index=None):
+            seen.append(deadline)
+            self.n += 1
+            return {"w": np.full(4, float(self.n), np.float32)}
+
+    registry = ModelRegistry(str(tmp_path / "reg"))
+    ctl = Controller(
+        Srv(),
+        registry,
+        lambda p: {"Accuracy": float(np.asarray(p["w"]).mean())},
+        control=ControlConfig(
+            round_deadline_s=40.0, slo_deadline_factor=0.25
+        ),
+        state_path=str(tmp_path / "state.jsonl"),
+        slo_actuator=SloActuator(alerts, factor=0.25),
+    )
+    ctl.run_cycle()
+    assert seen == [40.0]
+    emit("fire")
+    out = ctl.run_cycle()
+    assert seen[-1] == 10.0  # tightened while firing
+    assert out.get("slo_tightened") is True
+    emit("clear")
+    ctl.run_cycle()
+    assert seen[-1] == 40.0  # restored on clear
+
+
+# ------------------------------------------------------------------- CLI
+def test_shadow_cli_parser_wiring(tmp_path, capsys):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli.parser import (
+        build_parser,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli.shadow import (
+        cmd_shadow,
+    )
+
+    ap = build_parser()
+    a = ap.parse_args(
+        ["shadow", "status", "--registry-dir", str(tmp_path / "reg")]
+    )
+    assert a.fn.__name__ == "cmd_shadow" and a.action == "status"
+    a = ap.parse_args(
+        ["fleet", "--registry-dir", "/tmp/r", "--shadow-sample", "8"]
+    )
+    assert a.shadow_sample == 8
+    a = ap.parse_args(
+        [
+            "controller", "--registry-dir", "/tmp/r", "--shadow-gate",
+            "--shadow-min-pairs", "32", "--shadow-timeout", "9",
+            "--adaptive-cadence", "--slo-alerts-jsonl", "/tmp/a.jsonl",
+            "--slo-deadline-factor", "0.3",
+        ]
+    )
+    assert a.shadow_gate and a.shadow_min_pairs == 32
+    assert a.adaptive_cadence and a.slo_deadline_factor == 0.3
+    # status/report run against a real (empty, then populated) registry.
+    registry = ModelRegistry(str(tmp_path / "reg"))
+    a = ap.parse_args(
+        ["shadow", "status", "--registry-dir", str(tmp_path / "reg")]
+    )
+    assert cmd_shadow(a) == 0
+    out = capsys.readouterr().out
+    assert "nothing is under shadow evaluation" in out
+    aid = registry.add({"w": np.zeros(4, np.float32)}, round_index=0)
+    registry.promote(aid, to="shadow")
+    a = ap.parse_args(
+        [
+            "shadow", "status", "--registry-dir", str(tmp_path / "reg"),
+            "--json",
+        ]
+    )
+    assert cmd_shadow(a) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["shadow"]["artifact"] == aid and rec["status"] is None
+
+
+def test_shadow_vocab_registered():
+    """The shadow plane's spans are in the closed obs vocabulary (the
+    static pass anchors on SPAN_NAMES) and the timeline's unscoped
+    section renders them."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs import (
+        SPAN_NAMES,
+        timeline_table,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs.trace import (
+        SCHEMA,
+    )
+
+    for name in ("shadow-mirror", "shadow-compare", "shadow-gate"):
+        assert name in SPAN_NAMES
+    spans = [
+        {
+            "schema": SCHEMA, "proc": "fleet", "span": "shadow-mirror",
+            "ts": 0.5, "dur_s": 0.0, "mirrored": 128,
+        },
+        {
+            "schema": SCHEMA, "proc": "fleet", "span": "shadow-compare",
+            "ts": 1.0, "dur_s": 0.0, "pairs": 64, "flip_rate": 0.0,
+        },
+        {
+            "schema": SCHEMA, "proc": "controller", "span": "shadow-gate",
+            "ts": 2.0, "dur_s": 3.0, "artifact": "abc", "passed": True,
+            "pairs": 64,
+        },
+    ]
+    table = timeline_table(spans)
+    assert "shadow-compare" in table and "shadow-gate" in table
+    assert "shadow-mirror" in table and "mirrored=128" in table
+    assert "pairs=64" in table and "passed=True" in table
