@@ -52,7 +52,12 @@ Secondary modes via BENCH_MODE:
                       (default 64) behind BENCH_FLEET_RELAYS relays behind
                       one weighted root, streamed both ways; headline
                       fleet_rounds_per_hour + relay_peak_agg_bytes, root
-                      aggregate crc-pinned vs the aggregate_tree replay
+                      aggregate crc-pinned vs the aggregate_tree replay;
+                      plus the chaos arm — one relay killed mid-round
+                      (seeded dead-relay fault), clients re-home, the
+                      root completes a degraded round crc-exact vs the
+                      recorded actual assignment (fleet_rehomes_total,
+                      fleet_subtree_failures, fleet_degraded_rounds_ok)
     router            the serving replica fleet (router/): live loopback
                       A/B of one scorer replica vs BENCH_ROUTER_REPLICAS
                       (default 3) behind the thin router, with a registry
@@ -1030,6 +1035,177 @@ def _run_controller_fleet(
     return stats, wall, comm_phases, stream_info
 
 
+def _fleet_chaos_arm() -> dict:
+    """The fleet bench's chaos arm (ISSUE 14): a depth-2 tree with ONE
+    relay killed mid-round by the seeded dead-relay fault plan
+    (faults/deadrelay.py — a throttling FaultProxy fronts the victim's
+    subtree and tears the relay down once the forwarded upload bytes
+    cross the seeded threshold). The victim's clients re-home to the
+    surviving relay (ranked fallback parents), the root completes a
+    DEGRADED round over the surviving subtree within its deadline, and
+    the aggregate must be crc-bit-exact vs ``aggregate_tree`` replayed
+    over the ROOT's recorded actual (relay -> contributors) assignment.
+    Returns the fleet record's chaos fields (or ``{"error": ...}``)."""
+    import threading as _threading
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        AggregationServer,
+        FederatedClient,
+        RelayAggregator,
+        aggregate_tree,
+        wire,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.faults import (
+        DeadRelayFault,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.faults.deadrelay import (
+        wait_registered,
+    )
+
+    n_clients, half = 8, 4
+    root_deadline = float(os.environ.get("BENCH_CHAOS_DEADLINE", "8"))
+    rehome_budget = 2.0
+    # Zero-hung-rounds bound: the acceptance contract — the degraded
+    # round must resolve within root-deadline + one re-home dial budget
+    # (slack for thread scheduling).
+    hang_bound = root_deadline + rehome_budget + 4.0
+    rng = np.random.default_rng(1)
+    uploads = [
+        {
+            f"w{j}": rng.normal(size=4096).astype(np.float32)
+            for j in range(4)
+        }
+        for _ in range(n_clients)
+    ]
+    victims = list(range(half, n_clients))
+    results: dict[int, dict] = {}
+    rehomes: dict[int, dict] = {}
+    errors: list = []
+    root_agg: list = [None]
+    t0 = time.perf_counter()
+    try:
+        with AggregationServer(
+            port=0, num_clients=2, min_clients=1, weighted=True,
+            timeout=60, stream_chunk_bytes=1 << 15,
+        ) as root:
+            relays = [
+                RelayAggregator(
+                    "127.0.0.1", 0, parent_host="127.0.0.1",
+                    parent_port=root.port, relay_id=r, num_clients=half,
+                    timeout=60, stream_chunk_bytes=1 << 15,
+                )
+                for r in range(2)
+            ]
+            fault = DeadRelayFault(relays[1], seed=0)
+            try:
+                def root_loop() -> None:
+                    try:
+                        root_agg[0] = root.serve_round(
+                            deadline=root_deadline
+                        )
+                    except RuntimeError as e:
+                        errors.append(e)
+
+                rt = _threading.Thread(target=root_loop, daemon=True)
+                rt.start()
+                for rel in relays:
+                    _threading.Thread(
+                        target=rel.serve, args=(1,), daemon=True
+                    ).start()
+
+                def client_loop(cid: int) -> None:
+                    victim = cid in victims
+                    fc = FederatedClient(
+                        fault.host if victim else "127.0.0.1",
+                        fault.port if victim else relays[0].port,
+                        client_id=cid, timeout=30,
+                        fallback_parents=(
+                            [("127.0.0.1", relays[0].port)]
+                            if victim
+                            else None
+                        ),
+                        rehome_dial_budget=rehome_budget,
+                    )
+                    try:
+                        results[cid] = fc.exchange(
+                            uploads[cid], n_samples=cid + 1,
+                            max_retries=3,
+                        )
+                        rehomes[cid] = dict(fc.rehomes)
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(e)
+
+                vt = [
+                    _threading.Thread(
+                        target=client_loop, args=(c,), daemon=True
+                    )
+                    for c in victims
+                ]
+                for t in vt:
+                    t.start()
+                # Deterministic ordering: the surviving relay's own
+                # clients hold their uploads until the kill landed and
+                # the re-homed uploads registered there — the adoption
+                # window stays open.
+                fault.killed.wait(timeout=hang_bound)
+                wait_registered(
+                    relays[0].server, victims, timeout=hang_bound
+                )
+                st = [
+                    _threading.Thread(
+                        target=client_loop, args=(c,), daemon=True
+                    )
+                    for c in range(half)
+                ]
+                for t in st:
+                    t.start()
+                for t in vt + st:
+                    t.join(timeout=hang_bound + 30)
+                rt.join(timeout=hang_bound + 30)
+            finally:
+                fault.close()
+                for rel in relays:
+                    rel.close()
+            assignment = root.last_assignment
+            subtree_failures = root.tree_totals["subtree_failures"]
+            degraded_rounds = root.tree_totals["degraded_rounds"]
+    except Exception as e:  # noqa: BLE001 - one parseable line
+        return {"error": f"{type(e).__name__}: {e}"}
+    wall = time.perf_counter() - t0
+    if root_agg[0] is None or assignment is None:
+        return {
+            "error": (
+                f"degraded round failed: {errors[0]}"
+                if errors
+                else "degraded round produced no aggregate"
+            )
+        }
+    want = aggregate_tree(
+        uploads,
+        [float(c + 1) for c in range(n_clients)],
+        assignment["groups"],
+    )
+    crc_exact = wire.flat_crc32(root_agg[0]) == wire.flat_crc32(want)
+    rehomes_total = sum(sum(r.values()) for r in rehomes.values())
+    completed = {c for c in results}
+    degraded_ok = (
+        crc_exact
+        and degraded_rounds >= 1
+        and subtree_failures >= 1
+        and rehomes_total >= len(victims)
+        and completed == set(range(n_clients))
+        and wall <= hang_bound + 30  # joins bound it; belt + braces
+    )
+    return {
+        "fleet_rehomes_total": int(rehomes_total),
+        "fleet_subtree_failures": int(subtree_failures),
+        "fleet_degraded_rounds_ok": 1.0 if degraded_ok else 0.0,
+        "fleet_chaos_crc_exact": 1.0 if crc_exact else 0.0,
+        "fleet_chaos_wall_s": round(wall, 3),
+        "fleet_chaos_assignment": assignment["groups"],
+    }
+
+
 def bench_fleet() -> dict | None:
     """Fleet-scale rounds (ISSUE 7): a LIVE loopback depth-2 fold tree —
     BENCH_FLEET_CLIENTS simulated clients (default 64) behind
@@ -1166,6 +1342,18 @@ def bench_fleet() -> dict | None:
     crc_ok = wire.flat_crc32(root_aggs[-1]) == want_crc and all(
         wire.flat_crc32(replies[c]) == want_crc for c in replies
     )
+    # Chaos arm (ISSUE 14): one relay killed mid-round; the round must
+    # complete over re-homed + surviving contributors, crc-exact vs the
+    # recorded actual assignment, with no hung round.
+    chaos = _fleet_chaos_arm()
+    if chaos.get("error"):
+        record = {
+            "metric": "bench_error",
+            "error": "fleet_chaos_failed",
+            "detail": str(chaos["error"])[:300],
+        }
+        _emit(record)
+        return record
     record = {
         "metric": f"fleet_rounds_per_hour_c{n_clients}_r{n_relays}",
         "value": round(rounds / wall * 3600.0, 1),
@@ -1187,6 +1375,7 @@ def bench_fleet() -> dict | None:
         "param_mb": param_mb,
         "stream_replies": int(stream_replies),
         "wall_s": round(wall, 3),
+        **chaos,
     }
     _emit(record)
     return record
@@ -2735,7 +2924,18 @@ def main() -> None:
                 # like the comm_phase_* / comm_overlap_frac contract.
                 missing = [
                     k
-                    for k in ("fleet_rounds_per_hour", "relay_peak_agg_bytes")
+                    for k in (
+                        "fleet_rounds_per_hour",
+                        "relay_peak_agg_bytes",
+                        # Survivability headline fields (ISSUE 14): the
+                        # chaos arm's re-home / degraded-round proof
+                        # must stay machine-parsed — a refactor that
+                        # drops the failover plane fails the bench
+                        # loudly, exactly like a crc mismatch.
+                        "fleet_rehomes_total",
+                        "fleet_subtree_failures",
+                        "fleet_degraded_rounds_ok",
+                    )
                     if k not in rec_fleet
                 ]
                 if missing:
@@ -2744,7 +2944,8 @@ def main() -> None:
                             "metric": "bench_error",
                             "error": "fleet_fields_missing",
                             "detail": f"fleet record lacks {missing} "
-                            "(relay stream_totals accounting broken?)",
+                            "(relay stream_totals / chaos-arm "
+                            "accounting broken?)",
                         }
                     )
                     raise SystemExit(3)
@@ -2752,9 +2953,18 @@ def main() -> None:
                     "fleet_rounds_per_hour",
                     "relay_peak_agg_bytes",
                     "fleet_crc_exact",
+                    "fleet_rehomes_total",
+                    "fleet_subtree_failures",
+                    "fleet_degraded_rounds_ok",
                 ):
                     extra[k] = rec_fleet[k]
-                fleet_broken = rec_fleet["fleet_crc_exact"] < 1.0
+                # Degraded rounds asserted OK: a chaos round that hung,
+                # lost a re-homed contributor, or landed off-crc is a
+                # robustness regression (exit 3).
+                fleet_broken = (
+                    rec_fleet["fleet_crc_exact"] < 1.0
+                    or rec_fleet["fleet_degraded_rounds_ok"] < 1.0
+                )
             router_broken = False
             if rec_router is not None and (
                 rec_router.get("metric") != "bench_error"

@@ -39,7 +39,15 @@ import ast
 import re
 from typing import Iterator
 
-from .core import Finding, Project, bytes_const, call_name, kwarg, register
+from .core import (
+    Finding,
+    Project,
+    bytes_const,
+    call_name,
+    kwarg,
+    register,
+    str_const,
+)
 
 WIRE_REL = "comm/wire.py"
 #: The wire layer: the modules allowed to DEFINE frame magics / HMAC
@@ -295,3 +303,81 @@ def check_stream_direction(project: Project) -> Iterator[Finding]:
                     "reply-side caller inheriting it reopens the "
                     "reflection hole",
                 )
+
+
+#: Modules allowed to DECLARE wire meta keys — the plain-JSON capability
+#: adverts and markers riding upload/reply meta (stream chunk advert,
+#: streamed-reply advert, re-home marker, subtree contributor record).
+#: obs/trace.py owns the trace-identity key. Everywhere else must
+#: import, so key-string uniqueness stays checkable in one pass exactly
+#: like the magic/domain byte universes.
+META_KEY_RELS = ("comm/wire.py", "obs/trace.py")
+
+
+@register(
+    "wire-meta-key-unique",
+    "*_META_KEY meta-field names declared only in the wire layer "
+    "(comm/wire.py, obs/trace.py), non-empty string literals, globally "
+    "unique",
+)
+def check_meta_key_unique(project: Project) -> Iterator[Finding]:
+    wire = project.module(WIRE_REL)
+    if wire is None:
+        return
+    seen: dict[str, str] = {}
+    declared = 0
+    for m in project.modules:
+        in_layer = any(m.rel.endswith(rel) for rel in META_KEY_RELS)
+        if m.tree is None:
+            continue
+        for node in m.tree.body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if not isinstance(target, ast.Name) or not target.id.endswith(
+                "_META_KEY"
+            ):
+                continue
+            if not in_layer:
+                yield Finding(
+                    "wire-meta-key-unique",
+                    m.rel,
+                    node.lineno,
+                    f"{target.id} declared outside the wire layer "
+                    f"({' | '.join(META_KEY_RELS)}) — meta keys must "
+                    "live where their uniqueness is checkable in one "
+                    "pass (import the constant instead)",
+                )
+                continue
+            declared += 1
+            value = str_const(node.value)
+            if not value:
+                yield Finding(
+                    "wire-meta-key-unique",
+                    m.rel,
+                    node.lineno,
+                    f"{target.id} must be a non-empty string literal "
+                    "(meta keys are plain-JSON field names)",
+                )
+                continue
+            prior = seen.get(value)
+            if prior is not None:
+                yield Finding(
+                    "wire-meta-key-unique",
+                    m.rel,
+                    node.lineno,
+                    f"{target.id} duplicates the meta-key string of "
+                    f"{prior} ({value!r}) — two capabilities sharing one "
+                    "meta field would silently shadow each other on old "
+                    "peers",
+                )
+            else:
+                seen[value] = target.id
+    if declared == 0:
+        yield Finding(
+            "wire-meta-key-unique",
+            wire.rel,
+            1,
+            "no *_META_KEY constants found in the wire layer — the "
+            "meta-key pass has lost its anchor (renamed constants?)",
+        )

@@ -200,6 +200,9 @@ def cmd_relay(args) -> int:
         auth_key=_auth_key(),
         stream_chunk_bytes=stream_chunk_bytes,
         stream=bool(getattr(args, "stream_upload", True)),
+        subtree_deadline_factor=getattr(
+            args, "subtree_deadline_factor", 0.5
+        ),
         tracer=tracer,
     ) as relay:
         log.info(
@@ -278,12 +281,31 @@ def cmd_client(args) -> int:
     # so start the server first.
     persona = proxy = None
     server_host, server_port = args.host, args.port
+    # Ranked parent list (--parent HOST:PORT, repeatable): the first
+    # entry is the primary — it overrides --host/--port — and the rest
+    # are the fallbacks the client re-homes through when the primary's
+    # dial budget runs out or its connection dies mid-exchange.
+    fallback_parents = None
+    parent_args = getattr(args, "parent", None)
+    if parent_args:
+        parsed = []
+        for entry in parent_args:
+            host_s, sep, port_s = str(entry).rpartition(":")
+            if not sep or not host_s or not port_s.isdigit():
+                raise SystemExit(
+                    f"malformed --parent {entry!r} (want HOST:PORT)"
+                )
+            parsed.append((host_s, int(port_s)))
+        server_host, server_port = parsed[0]
+        fallback_parents = parsed[1:] or None
     if getattr(args, "persona", None):
         from ..faults.personas import get_persona, start_persona_proxy
 
         persona = get_persona(args.persona)
+        # The proxy fronts the PRIMARY parent only; fallback parents are
+        # dialed directly (a re-home is already the failure path).
         proxy = start_persona_proxy(
-            persona, args.host, args.port,
+            persona, server_host, server_port,
             fault_seed=getattr(args, "fault_seed", 0) or 0,
             client_id=args.client_id,
         )
@@ -311,6 +333,11 @@ def cmd_client(args) -> int:
         secure_threshold=getattr(args, "secure_threshold", None),
         tracer=client_tracer,
         stream=bool(getattr(args, "stream_upload", True)),
+        fallback_parents=fallback_parents,
+        # No `or 8.0` coercion: an explicit invalid value (e.g. 0) must
+        # surface FederatedClient's validation error, not silently
+        # become the default.
+        rehome_dial_budget=getattr(args, "rehome_dial_budget", 8.0),
     )
     sink = getattr(trainer, "reply_leaf_sink", None)
     if sink is not None:

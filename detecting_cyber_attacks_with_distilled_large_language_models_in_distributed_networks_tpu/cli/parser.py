@@ -530,6 +530,17 @@ def build_parser() -> argparse.ArgumentParser:
         "streamed-reply advert to the parent)",
     )
     p.add_argument(
+        "--subtree-deadline-factor",
+        type=float,
+        default=0.5,
+        help="per-subtree straggler deadline as a fraction of --timeout, "
+        "strictly inside (0, 1): a slow subtree sheds its stragglers "
+        "(set --min-clients below the subtree size) or fails its local "
+        "quorum — so its clients can re-home — while the root is still "
+        "inside ITS deadline, instead of stalling the whole tree "
+        "(default 0.5)",
+    )
+    p.add_argument(
         "--trace-jsonl",
         help="append obs spans (round/agg/wire-reply/relay-forward) to "
         "this events-JSONL; merge with `fedtpu obs timeline --trace-dir`",
@@ -553,6 +564,28 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=12345)
+    p.add_argument(
+        "--parent",
+        action="append",
+        metavar="HOST:PORT",
+        default=None,
+        help="parent aggregator as HOST:PORT; REPEATABLE — the first is "
+        "the primary (overrides --host/--port), every further one a "
+        "ranked fallback. When the primary's dial budget runs out, or "
+        "its connection dies mid-exchange before the reply lands, the "
+        "client re-homes to the next parent and re-uploads (dense, "
+        "marked): the adoptive relay folds it as an EXTRA contributor. "
+        "List sibling relays — client ids are globally unique across "
+        "subtrees; relay ids at the root are a different namespace",
+    )
+    p.add_argument(
+        "--rehome-dial-budget",
+        type=float,
+        default=8.0,
+        help="seconds of seeded dial backoff per parent when fallback "
+        "parents are configured (a dead parent costs this, not the "
+        "whole --timeout; default 8)",
+    )
     p.add_argument("--client-id", type=int, required=True)
     p.add_argument("--num-clients", type=int, default=None)  # None: config wins
     p.add_argument(
@@ -1183,6 +1216,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-auth-cell",
         action="store_true",
         help="skip the extra HMAC-authenticated cell",
+    )
+    p.add_argument(
+        "--no-dead-relay-cell",
+        action="store_true",
+        help="skip the dead-relay cell (depth-2 fold tree with a seeded "
+        "mid-round relay kill: the victim subtree's clients re-home to "
+        "the surviving relay and the root completes a degraded round, "
+        "crc-pinned against the actual-contributor replay)",
     )
     p.add_argument(
         "--no-stream",

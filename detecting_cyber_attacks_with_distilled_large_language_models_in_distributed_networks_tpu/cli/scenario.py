@@ -56,6 +56,7 @@ def cmd_scenario(args) -> int:
         deadline_s=args.deadline,
         stream_chunk_bytes=0 if args.no_stream else (1 << 15),
         auth_cell=not args.no_auth_cell,
+        dead_relay_cell=not getattr(args, "no_dead_relay_cell", False),
         train=args.train,
     )
     results, grid = run_matrix(cfg, args.out_dir)

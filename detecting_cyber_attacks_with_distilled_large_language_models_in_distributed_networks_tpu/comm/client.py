@@ -14,15 +14,38 @@ from __future__ import annotations
 
 import os
 import socket
+import threading
 import time
 from typing import Any, Mapping
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
 from ..utils.logging import get_logger
 from . import framing, secure, wire
 
 log = get_logger()
+
+#: Re-home reasons (the ``fedtpu_client_rehomes_total`` label values):
+#: the primary's dial budget ran out vs an established connection dying
+#: before the round's reply landed.
+REHOME_REASONS = ("dial-exhausted", "mid-exchange")
+
+
+def _rehome_counters() -> dict:
+    """Per-reason re-home counters on the default registry. The registry
+    is get-or-create, so every FederatedClient in a process shares one
+    family (registered only from this module — obs-metric-once)."""
+    m = obs_metrics.default_registry()
+    return {
+        r: m.counter(
+            "fedtpu_client_rehomes_total",
+            help="exchanges moved to a fallback parent, by reason "
+            "(dial-exhausted | mid-exchange)",
+            labels={"reason": r},
+        )
+        for r in REHOME_REASONS
+    }
 
 
 def _host_params(tree: Any) -> Any:
@@ -77,6 +100,7 @@ def connect_with_retry(
     poll_interval: float = 1.0,  # the reference's 1 s first-probe cadence
     max_interval: float = 15.0,
     retry_seed: int | None = None,
+    abort_event: threading.Event | None = None,
 ) -> socket.socket:
     """Dial until the server is up or ``timeout`` elapses.
 
@@ -84,13 +108,18 @@ def connect_with_retry(
     ``poll_interval``, then capped exponential growth with seeded
     jitter) instead of the reference's fixed 1 s polling — a fleet of
     clients waiting out a long server restart stops hammering it once a
-    second each, without giving up any first-connect latency."""
+    second each, without giving up any first-connect latency.
+
+    ``abort_event`` (FederatedClient.abort / relay teardown) interrupts
+    the backoff sleeps so a shutdown never waits out a dial budget."""
     deadline = time.monotonic() + timeout
     last: Exception | None = None
     sched = backoff_intervals(
         base=poll_interval, cap=max_interval, seed=retry_seed
     )
     while time.monotonic() < deadline:
+        if abort_event is not None and abort_event.is_set():
+            raise ConnectionError(f"dial of {host}:{port} aborted")
         try:
             sock = socket.create_connection(
                 (host, port), timeout=max(0.1, deadline - time.monotonic())
@@ -98,9 +127,14 @@ def connect_with_retry(
             return sock
         except OSError as e:
             last = e
-            time.sleep(
-                min(next(sched), max(0.0, deadline - time.monotonic()))
-            )
+            pause = min(next(sched), max(0.0, deadline - time.monotonic()))
+            if abort_event is not None:
+                if abort_event.wait(pause):
+                    raise ConnectionError(
+                        f"dial of {host}:{port} aborted"
+                    ) from e
+            else:
+                time.sleep(pause)
     raise ConnectionError(f"server {host}:{port} unreachable after {timeout}s: {last}")
 
 
@@ -130,7 +164,25 @@ class FederatedClient:
         secure_threshold: int | None = None,
         tracer=None,
         stream: bool = True,
+        fallback_parents: list[tuple[str, int]] | None = None,
+        rehome_dial_budget: float = 8.0,
     ):
+        if fallback_parents and (secure_agg or dp):
+            # A secure-agg session is keyed to ONE server's (session,
+            # round) advert and central DP to one server's resync
+            # history; silently re-masking / re-basing against an
+            # unrelated aggregator is never correct. Relay trees — the
+            # re-homing deployment shape — refuse both modes anyway
+            # (comm/server.py reply_via).
+            raise ValueError(
+                "fallback_parents (client re-homing) is a plain/relay-"
+                "tree feature: secure aggregation and central DP bind "
+                "the exchange to a single aggregator"
+            )
+        if rehome_dial_budget <= 0.0:
+            raise ValueError(
+                f"rehome_dial_budget={rehome_dial_budget} must be > 0"
+            )
         if client_key is not None and auth_key is None:
             raise ValueError(
                 "client_key (per-client DH identity binding) requires "
@@ -205,6 +257,36 @@ class FederatedClient:
                 )
         self.host = host
         self.port = port
+        # Survivable fold trees (fallback parents): the ranked parent
+        # list this client walks when its current parent dies —
+        # [primary, fallback 1, fallback 2, ...]. Advancing is STICKY
+        # (the adoptive parent keeps this client for later rounds; a
+        # restarted primary is re-ranked by restarting the client), and
+        # every upload after a re-home carries wire.REHOME_META_KEY so
+        # the adoptive subtree folds it as an EXTRA contributor instead
+        # of counting it toward its own quorum. With fallbacks
+        # configured, each dial gets ``rehome_dial_budget`` seconds of
+        # the seeded backoff schedule instead of the full exchange
+        # timeout — a dead parent costs one budget, not the round.
+        self._parents: list[tuple[str, int]] = [(host, int(port))] + [
+            (h, int(p)) for h, p in (fallback_parents or [])
+        ]
+        self._parent_idx = 0
+        self.rehome_dial_budget = float(rehome_dial_budget)
+        self._rehomed = False
+        #: Re-homes performed, by reason (mirrors the
+        #: fedtpu_client_rehomes_total counter labels).
+        self.rehomes: dict[str, int] = {}
+        self._m_rehomes = _rehome_counters()
+        # abort(): prompt teardown for a client mid-exchange (the relay's
+        # parent-facing leg must not wait out a socket timeout when the
+        # relay closes mid-round). _live_sock tracks the attempt's
+        # socket under a lock so abort() can shut it down from another
+        # thread — shutdown(SHUT_RDWR) interrupts a blocked recv where a
+        # bare close() would be deferred by the interpreter.
+        self._abort = threading.Event()
+        self._sock_lock = threading.Lock()
+        self._live_sock: socket.socket | None = None
         self.client_id = client_id
         self.timeout = timeout
         self.compression = compression
@@ -361,6 +443,11 @@ class FederatedClient:
             "n_samples": int(n_samples),
             **dict(meta or {}),
         }
+        if self._rehomed:
+            # Sticky marker: the adoptive parent folds this client as an
+            # EXTRA contributor every round (it is not in that subtree's
+            # own expected count).
+            base_meta[wire.REHOME_META_KEY] = 1
         if self.stream and not self.secure_agg:
             # Streamed-reply advert: plain meta, so an old server ignores
             # it and keeps sending the dense frame (interop unchanged).
@@ -414,17 +501,49 @@ class FederatedClient:
         )
         last: Exception | None = None
         this_call: tuple[bytes, int] | None = None  # (session, round) masked now
+        fresh_parent = False  # just re-homed: next dial is this parent's first
         for attempt in range(1, max_retries + 1):
             sock = None
             sparse_in_flight = False  # this attempt's delta hit the wire
+            upload_timing = None
+            upload_started = None  # (t_unix, t0, bytes): send began
             try:
+                if self._abort.is_set():
+                    raise ConnectionError(
+                        f"client {self.client_id}: exchange aborted"
+                    )
                 # retry_seed=client_id: each client's dial-retry jitter is
-                # deterministic but fleet-desynchronized.
+                # deterministic but fleet-desynchronized. With fallback
+                # parents, each dial gets the bounded re-home budget so a
+                # dead parent costs seconds, not the exchange timeout.
                 sock = connect_with_retry(
-                    self.host, self.port, timeout=self.timeout,
+                    self.host, self.port,
+                    timeout=(
+                        min(self.timeout, self.rehome_dial_budget)
+                        if len(self._parents) > 1
+                        else self.timeout
+                    ),
                     retry_seed=self.client_id,
+                    abort_event=self._abort,
                 )
                 sock.settimeout(self.timeout)
+                with self._sock_lock:
+                    self._live_sock = sock
+                if self._abort.is_set():
+                    # abort() may have landed between the dial returning
+                    # and _live_sock registration — its socket shutdown
+                    # then missed this connection, so re-check here or
+                    # the exchange would proceed into a blocking recv.
+                    raise ConnectionError(
+                        f"client {self.client_id}: exchange aborted"
+                    )
+                # A re-homed attempt is this parent's FIRST contact: skip
+                # the failed-attempt mode-diagnosis peek below (it would
+                # stall the re-upload by the peek window against a
+                # healthy adoptive parent, for a failure that happened
+                # elsewhere).
+                first_contact = fresh_parent
+                fresh_parent = False
                 nonce_hex = None
                 attempt_meta = dict(base_meta)
                 upload = params
@@ -436,7 +555,12 @@ class FederatedClient:
                         raise wire.WireError("bad auth challenge from server")
                     nonce_hex = chal[len(wire.NONCE_MAGIC) :].hex()
                     attempt_meta.update(role="client", nonce=nonce_hex)
-                if not self.secure_agg and not self.dp and attempt > 1:
+                if (
+                    not self.secure_agg
+                    and not self.dp
+                    and attempt > 1
+                    and not first_contact
+                ):
                     # Mode diagnosis after a failed first attempt: a
                     # secure/DP/auth server speaks FIRST (round advert /
                     # DP advert / nonce challenge), which a plain client
@@ -739,6 +863,7 @@ class FederatedClient:
                         )
                         t_up_unix = time.time()
                         t_up0 = time.monotonic()
+                        upload_started = (t_up_unix, t_up0, 0)
                         sent, chunks, overlap_s = self._stream_upload(
                             sock, up_flat, attempt_meta,
                             attempt_compression, nonce_hex,
@@ -783,6 +908,7 @@ class FederatedClient:
                         sparse_in_flight = delta_flat is not None
                         t_up_unix = time.time()
                         t_up0 = time.monotonic()
+                        upload_started = (t_up_unix, t_up0, len(msg))
                         framing.send_frame(sock, msg)
                         upload_timing = (
                             t_up_unix, time.monotonic() - t_up0, len(msg),
@@ -1012,14 +1138,122 @@ class FederatedClient:
                     # ambiguity resolves conservatively: drop it.
                     self._residual = None
                 log.info(f"[CLIENT {self.client_id}] round attempt {attempt} failed: {e}")
+                if self._abort.is_set():
+                    # abort() means TEARDOWN: burning the remaining
+                    # retries (each with its backoff sleep) would hold
+                    # the caller — a closing relay's forward thread —
+                    # for the whole budget.
+                    break
+                failed_upload = upload_timing
+                if failed_upload is None and upload_started is not None:
+                    # Died mid-send: still a wire-upload window worth a
+                    # span (the re-home's first-attempt evidence).
+                    failed_upload = (
+                        upload_started[0],
+                        time.monotonic() - upload_started[1],
+                        upload_started[2],
+                        None,
+                    )
+                if (
+                    attempt < max_retries
+                    and not self._abort.is_set()
+                    and self._rehome(
+                        "dial-exhausted" if sock is None else "mid-exchange",
+                        err=e,
+                        failed_upload=failed_upload,
+                    )
+                ):
+                    # Re-homed: the next attempt dials the adoptive
+                    # parent NOW — the inter-attempt backoff exists for a
+                    # server that may come back, and this one will not.
+                    base_meta[wire.REHOME_META_KEY] = 1
+                    msg = None  # re-encode: the meta gains the marker
+                    fresh_parent = True
+                    continue
                 if attempt < max_retries:
                     time.sleep(min(2.0**attempt, 10.0))
             finally:
+                with self._sock_lock:
+                    self._live_sock = None
                 if sock is not None:
                     sock.close()
         raise ConnectionError(
             f"client {self.client_id}: round failed after {max_retries} attempts: {last}"
         )
+
+    # ------------------------------------------------- re-homing / abort
+    def _rehome(
+        self,
+        reason: str,
+        *,
+        err: Exception | None = None,
+        failed_upload=None,
+    ) -> bool:
+        """Advance to the next parent in the ranked fallback list.
+
+        Returns False when there is no parent left to try (the caller
+        then follows the classic retry path against the last parent).
+        The move is sticky — later rounds keep exchanging with the
+        adoptive parent and keep stamping the re-home marker, so it
+        keeps folding this client as an extra contributor. The failed
+        attempt's upload window (when any bytes hit the wire) is
+        buffered as a ``wire-upload`` span with ``rehome_failed=1`` —
+        the obs timeline shows the re-home as a second upload span on
+        the adoptive round's trace."""
+        if self._parent_idx + 1 >= len(self._parents):
+            return False
+        self._parent_idx += 1
+        self.host, self.port = self._parents[self._parent_idx]
+        self._rehomed = True
+        # Capabilities and bases learned from the dead parent do not
+        # transfer: re-advertise from scratch (dense upload — the
+        # adoptive server's stream advert arrives with its first reply)
+        # and abandon the sparse-delta base (the adoptive parent's
+        # aggregate history is unrelated; a delta against the old base
+        # would be refused and burn a retry).
+        self._server_stream = None
+        self._base = self._base_round = None
+        self.rehomes[reason] = self.rehomes.get(reason, 0) + 1
+        self._m_rehomes[reason].inc()
+        if failed_upload is not None:
+            # (t_unix, dur_s, bytes, extra) — the attempt whose upload
+            # hit the dead parent's wire before the failure.
+            self.note_phase(
+                "wire-upload",
+                failed_upload[0],
+                failed_upload[1],
+                client=self.client_id,
+                bytes=failed_upload[2],
+                rehome_failed=1,
+            )
+        log.warning(
+            f"[CLIENT {self.client_id}] re-homing ({reason}"
+            + (f": {err}" if err is not None else "")
+            + f") -> fallback parent {self.host}:{self.port} "
+            f"({self._parent_idx}/{len(self._parents) - 1})"
+        )
+        return True
+
+    def abort(self) -> None:
+        """Prompt teardown for an in-flight exchange (relay close(),
+        operator shutdown): interrupt the dial backoff and shut the live
+        socket down so a blocked recv fails NOW instead of waiting out
+        its timeout. A later exchange() raises immediately."""
+        self._abort.set()
+        with self._sock_lock:
+            s = self._live_sock
+        if s is not None:
+            # shutdown, then close: close() alone is deferred by the
+            # interpreter while another thread is blocked in a syscall
+            # on the fd (the faults-layer prompt-close lesson, PR 6).
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
 
     # ------------------------------------------------- streamed uploads
     def _stream_upload(

@@ -132,8 +132,22 @@ class RelayAggregator:
         auth_key: bytes | None = None,
         stream_chunk_bytes: int = wire.DEFAULT_STREAM_CHUNK,
         stream: bool = True,
+        subtree_deadline_factor: float = 0.5,
         tracer=None,
     ):
+        # Per-subtree straggler deadline, STRICTLY tighter than the
+        # round budget (config.py FedConfig validates the same bound):
+        # a slow subtree sheds its stragglers at factor * timeout — run
+        # this relay with --min-clients below the subtree size to
+        # proceed over survivors — instead of stalling the root until
+        # ITS deadline. factor >= 1 would re-create exactly the failure
+        # mode this tier exists to remove, so it is refused.
+        if not 0.0 < float(subtree_deadline_factor) < 1.0:
+            raise ValueError(
+                f"subtree_deadline_factor={subtree_deadline_factor} "
+                "must be in (0, 1): the subtree deadline has to be "
+                "strictly tighter than the round budget"
+            )
         # Sample-count weighting is the relay-tier contract (module
         # docstring): subtree means must recombine at the parent by
         # their true mass, so the subtree fold is always weighted
@@ -163,6 +177,7 @@ class RelayAggregator:
             tracer=tracer,
         )
         self.relay_id = int(relay_id)
+        self.subtree_deadline_factor = float(subtree_deadline_factor)
         self.tracer = tracer
         self.server.reply_via = self._forward
         self.port = self.server.port
@@ -179,7 +194,18 @@ class RelayAggregator:
         # obs timeline only, never the fold value or order
         t_unix = time.time()
         t0 = time.monotonic()
-        out = self.parent.exchange(agg, n_samples=max(1, int(round(total))))
+        out = self.parent.exchange(
+            agg,
+            n_samples=max(1, int(round(total))),
+            # Contributor record for the parent's assignment ledger
+            # (wire.SUBTREE_IDS_META_KEY): the ascending client ids this
+            # partial folded — how the root replays (and crc-pins) the
+            # round's ACTUAL tree, re-homed adoptions included, and how
+            # it detects a double-counted re-homed upload.
+            meta={
+                wire.SUBTREE_IDS_META_KEY: [int(i) for i in info["ids"]]
+            },
+        )
         dur = time.monotonic() - t0
         if self.tracer is not None:
             parent_trace, parent_round = self.parent.last_trace
@@ -204,7 +230,17 @@ class RelayAggregator:
     def serve_round(self, **kw) -> dict | None:
         """One relay round: gather + fold the subtree, forward the
         partial, fan the root aggregate out to the subtree's clients.
-        Returns the ROOT aggregate (flat)."""
+        Returns the ROOT aggregate (flat).
+
+        The default round deadline is ``subtree_deadline_factor *
+        timeout`` — strictly tighter than the round budget, so a slow
+        subtree resolves (sheds its stragglers, or fails its local
+        quorum) while the root is still accepting the other subtrees'
+        uploads, instead of stalling the whole tree."""
+        kw.setdefault(
+            "deadline",
+            self.subtree_deadline_factor * self.server.timeout,
+        )
         return self.server.serve_round(**kw)
 
     def serve(self, rounds: int = 1) -> None:
@@ -222,6 +258,15 @@ class RelayAggregator:
 
     # --------------------------------------------------------- lifecycle
     def close(self) -> None:
+        """Tear the relay down PROMPTLY, mid-round included: abort the
+        parent-facing exchange first (a forward blocked on the root's
+        reply — or in a dial backoff — must not wait out its socket
+        timeout), then close the subtree server, which sheds every
+        pending child upload as an explicit failure (comm/server.py
+        close: shutdown-then-close, the prompt-close discipline). The
+        children's dead connections are what trigger their re-homing —
+        so this teardown path is the failover plane's latency floor."""
+        self.parent.abort()
         self.server.close()
 
     def __enter__(self) -> "RelayAggregator":

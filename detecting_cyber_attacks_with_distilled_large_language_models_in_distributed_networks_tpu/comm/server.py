@@ -132,6 +132,20 @@ class _Round:
     # (wire.STREAM_REPLY_META_KEY): their reply fan-out goes out as
     # STRH/STRC/STRT frames instead of one dense model-sized frame.
     stream_replies: set = field(default_factory=set)
+    # Survivable fold trees (comm/relay.py): ids adopted into this round
+    # via the re-home marker (wire.REHOME_META_KEY) — EXTRA contributors
+    # from a dead sibling subtree. They fold with everyone else
+    # (ascending id) but never count toward ``expected``, so adoption
+    # cannot mask a local quorum miss; completion additionally waits for
+    # every adopted upload to finish (they widen the fold set).
+    adopted: set = field(default_factory=set)
+    # Per-upload contributor record (wire.SUBTREE_IDS_META_KEY, stamped
+    # by relays on their upward upload): uploader id -> the ascending
+    # client ids its partial folded. The round's ACTUAL (relay ->
+    # contributors) assignment — the crc contract's replay input — and
+    # the double-count tripwire (one client in two subtrees' lists
+    # fails the round loudly).
+    subtree_ids: dict[int, list] = field(default_factory=dict)
 
 
 class AggregationServer:
@@ -355,6 +369,11 @@ class AggregationServer:
             max_workers=2 * num_clients + 8,
             thread_name_prefix="fedtpu-upload",
         )
+        # Every connection a handler is CURRENTLY serving (registered or
+        # not — a mid-upload child is not in rnd.conns yet): close()
+        # must be able to shed them all promptly.
+        self._conn_lock = threading.Lock()
+        self._open_conns: set[socket.socket] = set()
         # Observability (obs/): optional span tracer + always-on cheap
         # phase accounting. phase_seconds accumulates where each round's
         # wall went — wait (accept + straggler + upload wire), agg
@@ -472,6 +491,36 @@ class AggregationServer:
             )
             for p in ("wait", "agg", "reply")
         }
+        self._m_subtree_failures = m.counter(
+            "fedtpu_relay_subtree_failures_total",
+            help="expected fold-tree children missing from a completed "
+            "round at a parent of relays (the subtree was dropped from "
+            "the fold; the mean renormalized over survivors)",
+        )
+        self._m_stragglers_shed = m.counter(
+            "fedtpu_relay_stragglers_shed_total",
+            help="expected leaf clients missing from a completed quorum "
+            "round (shed locally at this aggregator's deadline instead "
+            "of stalling its parent)",
+        )
+        # Plain attribute twins for harnesses that hold the server object
+        # (bench chaos arm, tests): mutated under _totals_lock like
+        # stream_totals.
+        self.tree_totals = {
+            "subtree_failures": 0,
+            "stragglers_shed": 0,
+            "degraded_rounds": 0,
+        }
+        # The last completed round's ACTUAL aggregation assignment:
+        # {"round": n, "groups": [...]} where each group is either a
+        # bare uploader id (a leaf client / relay with no contributor
+        # record) or the list of client ids a relay's partial folded —
+        # exactly aggregate_tree's ``groups`` argument, in the root's
+        # fold order. The crc contract replays over THIS, so a degraded
+        # round (dead subtree, re-homed contributors) stays bit-exactly
+        # checkable.
+        self.last_assignment: dict | None = None
+        self._cur_rnd: _Round | None = None
         self._h_round = m.histogram(
             "fedtpu_server_round_seconds",
             help="aggregation round wall-clock, failed rounds included "
@@ -483,6 +532,36 @@ class AggregationServer:
     def close(self) -> None:
         self._stop.set()
         self._sock.close()
+        # Shed the current round's registered connections as EXPLICIT
+        # failures, promptly: shutdown(SHUT_RDWR) interrupts both ends'
+        # blocked recvs (the child waiting on its reply, the handler
+        # mid-stream) where a bare close() is deferred by the
+        # interpreter while a sibling thread sits in a syscall on the fd
+        # (the faults-layer prompt-close discipline, PR 6). Without
+        # this, a relay torn down mid-round left its children blocked
+        # until their own socket timeouts — exactly the window client
+        # re-homing needs to be short.
+        rnd = self._cur_rnd
+        shed: list[socket.socket] = []
+        if rnd is not None:
+            with rnd.lock:
+                shed += list(rnd.conns.values()) + list(
+                    rnd.skip_conns.values()
+                )
+        with self._conn_lock:
+            # Mid-upload connections too: their handlers are still
+            # reading the payload, so they are not registered yet — but
+            # their clients are equally blocked and must fail now.
+            shed += list(self._open_conns)
+        for c in shed:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
         # Queued-but-unstarted handler tasks are abandoned (their
         # connections close with the process); running ones are daemons
         # of the pool and drop out on their socket errors.
@@ -507,6 +586,8 @@ class AggregationServer:
     ) -> None:
         if deadline is None:
             deadline = time.monotonic() + self.timeout
+        with self._conn_lock:
+            self._open_conns.add(conn)
         try:
             conn.settimeout(self.timeout)
             nonce_hex = None
@@ -868,6 +949,10 @@ class AggregationServer:
                     )
                     conn.close()
                     return
+                if not self._register_tree_meta(
+                    rnd, conn, client_id, meta
+                ):
+                    return
                 dup_folded = False
                 if client_id in rnd.models or (
                     # A still-in-flight STREAM from this client (intent
@@ -996,14 +1081,24 @@ class AggregationServer:
         ) as e:
             log.info(f"[SERVER] upload failed: {e}")
             conn.close()
+        finally:
+            with self._conn_lock:
+                self._open_conns.discard(conn)
 
     def _round_done(self, rnd: _Round) -> bool:
         """Round completion test (caller holds ``rnd.lock``): every
         expected upload arrived — the full fleet, the secure keyed subset,
         or the sampled cohort — AND, under cohort sampling, every
         non-sampled client has connected to collect the round's reply
-        (their bases must track the fleet's)."""
-        uploads_done = len(rnd.models) >= rnd.expected or (
+        (their bases must track the fleet's). Adopted (re-homed) ids
+        never count toward ``expected`` — adoption must not let a
+        stranger's upload mask a missing local child — but every adopted
+        upload must itself complete before the round does (it widened
+        the fold set)."""
+        own = len(rnd.models) - len(rnd.adopted & set(rnd.models))
+        uploads_done = (
+            own >= rnd.expected and rnd.adopted <= set(rnd.models)
+        ) or (
             # Secure subset round (dropout before keys): complete as soon
             # as every KEYED participant uploaded — the unkeyed never will.
             self.secure_agg
@@ -1041,7 +1136,11 @@ class AggregationServer:
                 return
             ids_all = sorted(rnd.cohort)
         else:
-            if len(have) < rnd.expected:
+            # Adopted (re-homed) intents join the fold set but do not
+            # satisfy the expected count — freezing over strangers while
+            # a local child is still dialing would fix the weights
+            # without it.
+            if len(have - rnd.adopted) < rnd.expected:
                 return
             ids_all = sorted(have)
         if self.dp_clip > 0.0:
@@ -1115,6 +1214,56 @@ class AggregationServer:
                 ) from None
         return dp_mode, dp_crc
 
+    def _register_tree_meta(
+        self, rnd: _Round, conn: socket.socket, client_id: int, meta
+    ) -> bool:
+        """Survivable-fold-tree meta handling shared by the dense and
+        streamed upload paths (caller holds ``rnd.lock``; the two wire
+        shapes MUST treat the tree meta identically, same rationale as
+        ``_validate_upload_identity``). Records a relay upload's
+        contributor list (the round's assignment record) and adopts a
+        re-homed NEW id as an extra contributor — widening a frozen-but-
+        unfolded fold set, refusing once folds consumed it. Returns
+        False when the adoption was refused: the connection is closed
+        and the client retries against its next parent or next round."""
+        sub = meta.get(wire.SUBTREE_IDS_META_KEY)
+        if sub is not None:
+            try:
+                rnd.subtree_ids[client_id] = [int(c) for c in sub]
+            except (TypeError, ValueError):
+                raise wire.WireError(
+                    f"malformed {wire.SUBTREE_IDS_META_KEY} meta {sub!r} "
+                    "(want a list of client ids)"
+                ) from None
+        if not bool(meta.get(wire.REHOME_META_KEY, False)):
+            return True
+        if self.secure_agg or self.dp_clip > 0.0:
+            # Single-aggregator modes never sit behind a fold tree
+            # (reply_via refuses them); the marker is ignored and the
+            # upload faces those modes' own validation.
+            return True
+        known = client_id in rnd.models or (
+            rnd.stream is not None and client_id in rnd.stream.intents
+        )
+        if known or client_id in rnd.adopted:
+            # An adopted client's retry: the duplicate path's rules
+            # apply (supersede pre-fold, keep the folded original).
+            return True
+        if rnd.stream is not None and not rnd.stream.admit(client_id):
+            log.info(
+                f"[SERVER] re-homed client {client_id} arrived after "
+                "folds began; refusing the adoption (it retries against "
+                "its next parent or the next round)"
+            )
+            conn.close()
+            return False
+        rnd.adopted.add(client_id)
+        log.info(
+            f"[SERVER] adopted re-homed client {client_id} into round "
+            f"{rnd.round_no} as an extra contributor"
+        )
+        return True
+
     def _handle_stream_upload(
         self,
         conn: socket.socket,
@@ -1173,6 +1322,8 @@ class AggregationServer:
         with rnd.lock:
             if rnd.closed:
                 conn.close()
+                return
+            if not self._register_tree_meta(rnd, conn, client_id, meta):
                 return
             if client_id in rnd.models or client_id in st.intents:
                 folded = not st.drop_client(client_id, poison=False)
@@ -1363,6 +1514,9 @@ class AggregationServer:
                 with rnd.lock:
                     if rnd.conns.get(client_id) is conn:
                         st.drop_client(client_id)
+                        # A dead ADOPTED stream must also stop gating
+                        # round completion (it widened the wait set).
+                        rnd.adopted.discard(client_id)
             raise
         with rnd.lock:
             if rnd.closed:
@@ -1927,6 +2081,9 @@ class AggregationServer:
             round_no=self._round_counter if round_index is None else round_index,
         )
         self._round_counter = rnd.round_no + 1
+        # close() mid-round sheds THIS round's registered connections
+        # promptly (explicit failures, not timeouts).
+        self._cur_rnd = rnd
         # Round trace identity (obs/): minted here, stamped into every
         # reply's meta — clients adopt it for their own spans, so the
         # obs timeline can correlate both sides of the wire. Old clients
@@ -2059,6 +2216,8 @@ class AggregationServer:
             n_samples = dict(rnd.n_samples)
             nonces = dict(rnd.nonces)
             dp_crcs = dict(rnd.dp_crcs)
+            adopted = set(rnd.adopted)
+            subtree_ids = {k: list(v) for k, v in rnd.subtree_ids.items()}
         # Failure cleanup must cover every registered connection,
         # contributors and sitting-out clients alike.
         all_conns = {**skip_conns, **conns}
@@ -2109,6 +2268,28 @@ class AggregationServer:
                     + ")"
                 )
             ids = sorted(models)
+            # Survivable fold trees: missing expected contributors (the
+            # degraded-round accounting below distinguishes dropped
+            # SUBTREES — this server parents relays, some uploads carry
+            # contributor records — from locally shed leaf stragglers),
+            # the round's ACTUAL assignment, and the double-count
+            # tripwire: one client id claimed by two subtree partials
+            # means a re-homed upload was also folded by a surviving old
+            # parent — no renormalization can fix that mean, so the
+            # round fails loudly and the fleet retries.
+            missing_n = max(
+                0, rnd.expected - (len(models) - len(adopted & set(models)))
+            )
+            listed = [c for i in ids for c in subtree_ids.get(i, [])]
+            if len(listed) != len(set(listed)):
+                dup_claims = sorted(
+                    {c for c in listed if listed.count(c) > 1}
+                )
+                raise RuntimeError(
+                    f"clients {dup_claims} appear in more than one "
+                    "subtree's contributor record — a re-homed upload "
+                    "was double-counted; failing the round"
+                )
             dp_mode = self.dp_clip > 0.0
             stale_resync: dict[int, int] = {}  # client id -> history index
             resync_payloads: dict[int, tuple[dict, int]] = {}
@@ -2571,7 +2752,81 @@ class AggregationServer:
             )
             raise
         agg_s = time.monotonic() - t_agg0
+        # The round's ACTUAL aggregation assignment (fold order at this
+        # tier, each relay contributor expanded to the client ids its
+        # partial folded) — what the crc contract replays over.
+        self.last_assignment = {
+            "round": rnd.round_no,
+            "groups": [
+                list(subtree_ids[i]) if i in subtree_ids else int(i)
+                for i in ids
+            ],
+        }
+        degraded = (
+            missing_n > 0 and rnd.cohort is None and not self.secure_agg
+        )
+        if degraded:
+            # Quorum semantics, one level up: the round COMPLETED over
+            # the survivors. At a parent of relays the missing children
+            # are whole subtrees — stamp the event, count it, and
+            # preserve the evidence (subtree-failure flight bundle); at
+            # a leaf tier they are stragglers shed at this aggregator's
+            # local deadline. Known coarseness: a plain round's expected
+            # count carries no per-child identity, so a MIXED tier (some
+            # children relays, some direct leaves) attributes every
+            # missing child to the dominant shape — subtrees whenever
+            # any upload carried a contributor record. Keep tiers
+            # homogeneous (the documented topology) for exact counts.
+            tree_key = (
+                "subtree_failures" if subtree_ids else "stragglers_shed"
+            )
+            with self._totals_lock:
+                self.tree_totals[tree_key] += missing_n
+                self.tree_totals["degraded_rounds"] += 1
+            if subtree_ids:
+                self._m_subtree_failures.inc(float(missing_n))
+                log.warning(
+                    f"[SERVER] round {rnd.round_no} completed DEGRADED: "
+                    f"{missing_n} expected subtree(s) never uploaded "
+                    f"within the deadline; folded the surviving "
+                    f"contributors {ids} (mean renormalized over their "
+                    "mass)"
+                )
+                recorder = obs_flight.get_global_recorder()
+                if recorder is not None:
+                    try:
+                        recorder.maybe_dump(
+                            "subtree-failure",
+                            extra={
+                                "round": rnd.round_no,
+                                "trace": rnd.trace,
+                                "expected": rnd.expected,
+                                "missing_subtrees": missing_n,
+                                "survivors": [int(i) for i in ids],
+                            },
+                        )
+                    except OSError as e:
+                        log.warning(
+                            "[SERVER] subtree-failure postmortem dump "
+                            f"failed (non-fatal): {e}"
+                        )
+            else:
+                self._m_stragglers_shed.inc(float(missing_n))
+                log.info(
+                    f"[SERVER] round {rnd.round_no}: shed {missing_n} "
+                    "straggler(s) at the local deadline; proceeding "
+                    f"over {ids}"
+                )
         if self.tracer is not None:
+            extra = {}
+            if degraded and subtree_ids:
+                extra["missing_subtrees"] = missing_n
+            elif degraded:
+                extra["stragglers_shed"] = missing_n
+            if adopted:
+                extra["adopted"] = sorted(int(i) for i in adopted)
+            if subtree_ids:
+                extra["assignment"] = self.last_assignment["groups"]
             self.tracer.record(
                 "agg",
                 t_start=t_agg_unix,
@@ -2584,6 +2839,7 @@ class AggregationServer:
                 # aggregated vs who uploaded-but-was-excluded vs who
                 # never arrived (faults/scenario.py consumes this).
                 contributors=[int(i) for i in ids],
+                **extra,
             )
         t_rep_unix = time.time()
         t_rep0 = time.monotonic()

@@ -121,6 +121,23 @@ class StreamAgg:
                 "dp_crc": dp_crc,
             }
 
+    def admit(self, cid: int) -> bool:
+        """Late-adopt a NEW contributor (a re-homed client, comm/server.py)
+        into the round's fold. Before any fold ran, a frozen fold set is
+        simply un-frozen — the next freeze re-normalizes the weights over
+        the grown set, still the exact barrier mean. Once folds consumed
+        the frozen weights no correct mean including ``cid`` exists any
+        more: returns False and the caller refuses the adoption (the
+        round's integrity beats the straggler's membership)."""
+        with self._lock:
+            if self.fold_ids is None or cid in self.fold_ids:
+                return True
+            if self._folded:
+                return False
+            self.fold_ids = None
+            self._weights = None
+            return True
+
     def drop_client(self, cid: int, *, poison: bool = True) -> bool:
         """Forget a client's unfolded state (mid-stream death, duplicate
         re-upload). Returns False when folds already consumed its leaves

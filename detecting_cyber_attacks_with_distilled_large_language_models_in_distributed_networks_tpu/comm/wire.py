@@ -184,6 +184,20 @@ STREAM_META_KEY = "stream"
 #: "Streamed replies"): a truthy value means this client decodes
 #: STRH/STRC/STRT reply frames; old servers ignore it (plain meta).
 STREAM_REPLY_META_KEY = "stream_reply"
+#: Upload-meta re-home marker (comm/client.py fallback parents): a truthy
+#: value means this upload comes from a client whose ranked parent list
+#: moved it off a dead primary. The adoptive server folds it as an EXTRA
+#: contributor — it never counts toward the subtree's own quorum — so a
+#: re-homed cohort can complete a degraded round without masking a local
+#: straggler miss. Plain meta: old servers treat the upload as any other.
+REHOME_META_KEY = "rehomed"
+#: Upload-meta contributor record on a relay's UPWARD upload
+#: (comm/relay.py): the ascending client ids its subtree partial folded.
+#: The root keeps the per-round (relay -> contributors) assignment from
+#: these — the replay input for the crc contract over the round's ACTUAL
+#: tree — and refuses a round where two subtrees claim one client (a
+#: re-homed upload double-counted by a surviving old parent).
+SUBTREE_IDS_META_KEY = "subtree_ids"
 DEFAULT_STREAM_CHUNK = 4 << 20  # 4 MiB: bounds receiver buffering
 #: Worst-case STRC frame bytes beyond the chunk data itself (magic + u64
 #: seq + auth tag). A configured/advertised chunk size must leave this

@@ -311,6 +311,14 @@ class FedConfig:
     # and adapts only the classifier head (FedPer, Arivazhagan et al.).
     personalize_epochs: int = 0
     personalize_scope: str = "full"
+    # Survivable fold trees (comm/relay.py): a relay's per-subtree
+    # straggler deadline as a fraction of the round budget. Strictly
+    # inside (0, 1) — the whole point is that a slow subtree resolves
+    # (sheds stragglers locally, or fails its local quorum so its
+    # clients re-home) while the root is still inside ITS deadline; a
+    # factor >= 1 re-creates the stalled-root failure mode the relay
+    # tier exists to remove.
+    subtree_deadline_factor: float = 0.5
 
     def server_opt_enabled(self) -> bool:
         return self.server_opt != "none"
@@ -386,6 +394,12 @@ class FedConfig:
             raise ValueError(
                 f"personalize_scope={self.personalize_scope!r} must be "
                 "'full' or 'head'"
+            )
+        if not 0.0 < self.subtree_deadline_factor < 1.0:
+            raise ValueError(
+                f"subtree_deadline_factor={self.subtree_deadline_factor} "
+                "must be in (0, 1): the per-subtree straggler deadline "
+                "has to be strictly tighter than the round budget"
             )
         if self.participation < self.min_client_fraction:
             raise ValueError(

@@ -21,7 +21,12 @@ timeout when a client dies (reference server.py:69-71,124-132; SURVEY
                      attribution, straggler wait) with every cell's
                      aggregate crc-pinned bit-exact against a clean
                      barrier mean over the same survivor set.
+* :mod:`.deadrelay` — the ``dead-relay`` fault plan (PR 14): a seeded
+                     mid-round kill of a fold-tree relay behind a
+                     throttling FaultProxy — the chaos driver for client
+                     re-homing and degraded-root rounds.
 """
 
+from .deadrelay import DeadRelayFault  # noqa: F401
 from .personas import PERSONA_NAMES, Persona, get_persona  # noqa: F401
 from .proxy import CLEAN, FaultProxy, FaultSpec  # noqa: F401

@@ -138,10 +138,18 @@ class FaultProxy:
         plan: Plan | None = None,
         seed: Any = 0,
         host: str = "127.0.0.1",
+        on_forward: Callable[[int, int], None] | None = None,
     ):
         self.upstream = (upstream_host, int(upstream_port))
         self.plan = plan
         self.seed = seed
+        # Byte-progress hook ``(conn_index, chunk_bytes)`` called after
+        # every upstream-forwarded chunk — the dead-relay fault plan's
+        # trigger (faults/deadrelay.py kills the victim process once the
+        # cumulative upload bytes cross its seeded threshold, so the
+        # kill lands genuinely MID-transfer). Runs on the pump thread;
+        # keep it cheap and never raise.
+        self.on_forward = on_forward
         self.events: list[dict] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -350,6 +358,8 @@ class FaultProxy:
                     return
                 conn.upstream.sendall(data)
                 forwarded += len(data)
+                if self.on_forward is not None:
+                    self.on_forward(conn.index, len(data))
                 if spec.throttle_bps > 0.0:
                     if not throttled:
                         throttled = True

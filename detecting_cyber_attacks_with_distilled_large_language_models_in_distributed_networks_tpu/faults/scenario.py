@@ -79,6 +79,12 @@ class ScenarioConfig:
     stream_chunk_bytes: int = 1 << 15
     #: Append one extra cell running the first persona under HMAC auth.
     auth_cell: bool = True
+    #: Append the dead-relay cell: a depth-2 fold tree (two relays, one
+    #: weighted root) with a seeded mid-round relay kill
+    #: (faults/deadrelay.py) — the victim's clients re-home to the
+    #: surviving relay and the root completes a degraded round,
+    #: crc-pinned against the actual-contributor replay.
+    dead_relay_cell: bool = False
     #: Train a tiny real model per client (accuracy column) instead of
     #: synthetic payloads.
     train: bool = False
@@ -519,6 +525,181 @@ def _build_training(cfg: ScenarioConfig, parts, labels):
     return trainer, shards, eval_split
 
 
+# ------------------------------------------------------ dead-relay cell
+def run_dead_relay_cell(
+    cfg: ScenarioConfig, out_dir: str
+) -> CellResult:
+    """One live depth-2 fold-tree campaign with a seeded mid-round relay
+    kill (faults/deadrelay.py): the victim relay's clients dial through
+    the fault's throttling proxy, the kill lands while their uploads are
+    in flight, they re-home to the surviving relay (ranked fallback
+    parents), and the weighted root completes a DEGRADED round over the
+    surviving subtree within its deadline. The outcome is attributed on
+    the obs timeline (the re-home is a second ``wire-upload`` span on
+    the re-homed client's trace) and the aggregate is crc-pinned
+    bit-exact against :func:`~..comm.relay.aggregate_tree` replayed over
+    the round's ACTUAL recorded (relay -> contributors) assignment."""
+    from ..comm.relay import RelayAggregator, aggregate_tree
+    from .deadrelay import DeadRelayFault, wait_registered
+
+    spec = CellSpec(
+        name=f"dead-relay|{cfg.partitions[0]}",
+        personas=("honest",) * cfg.num_clients,
+        partition=cfg.partitions[0],
+    )
+    workdir = os.path.join(out_dir, "cells", spec.name.replace("|", "_"))
+    trace_dir = os.path.join(workdir, "traces")
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    os.makedirs(trace_dir, exist_ok=True)
+    parts, labels, manifest = _cell_partition(cfg, spec)
+    n_samples = [max(1, len(p)) for p in parts]
+    result = CellResult(spec=spec, manifest=manifest, quorum=1)
+    n = cfg.num_clients
+    half = max(1, n // 2)  # clients [0, half) on the surviving relay
+    victims = list(range(half, n))
+    persona = get_persona("honest")
+    uploads = {
+        cid: _synthetic_upload(cfg, spec, persona, cid, 0)
+        for cid in range(n)
+    }
+    timeout = max(30.0, cfg.deadline_s * 3)
+    results: dict[int, dict] = {}
+    errors: dict[int, str] = {}
+    root_agg: list = [None]
+    root_err: list = [None]
+    with AggregationServer(
+        port=0, num_clients=2, min_clients=1, weighted=True,
+        timeout=timeout, stream_chunk_bytes=cfg.stream_chunk_bytes,
+        tracer=Tracer(os.path.join(trace_dir, "root.jsonl"), proc="root"),
+    ) as root:
+        relays = [
+            RelayAggregator(
+                "127.0.0.1", 0, parent_host="127.0.0.1",
+                parent_port=root.port, relay_id=r,
+                num_clients=(half if r == 0 else n - half),
+                timeout=timeout,
+                stream_chunk_bytes=cfg.stream_chunk_bytes,
+            )
+            for r in range(2)
+        ]
+        fault = DeadRelayFault(relays[1], seed=cfg.seed)
+        try:
+            def root_loop() -> None:
+                try:
+                    root_agg[0] = root.serve_round(
+                        deadline=cfg.deadline_s * 2
+                    )
+                except RuntimeError as e:
+                    root_err[0] = str(e)
+
+            rt = threading.Thread(target=root_loop, daemon=True)
+            rt.start()
+            for rel in relays:
+                threading.Thread(
+                    target=rel.serve, args=(1,), daemon=True
+                ).start()
+
+            def client_loop(cid: int) -> None:
+                victim = cid in victims
+                fc = FederatedClient(
+                    fault.host if victim else "127.0.0.1",
+                    fault.port if victim else relays[0].port,
+                    client_id=cid,
+                    timeout=timeout,
+                    fallback_parents=(
+                        [("127.0.0.1", relays[0].port)] if victim else None
+                    ),
+                    rehome_dial_budget=2.0,
+                    tracer=Tracer(
+                        os.path.join(trace_dir, f"client-{cid}.jsonl"),
+                        proc=f"client-{cid}",
+                    ),
+                )
+                try:
+                    results[cid] = fc.exchange(
+                        uploads[cid],
+                        n_samples=n_samples[cid],
+                        max_retries=3,
+                    )
+                    if fc.rehomes:
+                        result.notes.append(
+                            f"client {cid} rehomes: {fc.rehomes}"
+                        )
+                except (ConnectionError, OSError, wire.WireError) as e:
+                    errors[cid] = str(e)
+
+            vt = [
+                threading.Thread(target=client_loop, args=(c,), daemon=True)
+                for c in victims
+            ]
+            for t in vt:
+                t.start()
+            # The survivors' clients hold their uploads until the kill
+            # landed AND the re-homed uploads registered at the adoptive
+            # relay — the deterministic ordering that keeps relay 0's
+            # round open through the adoption window.
+            fault.killed.wait(timeout=cfg.deadline_s * 2)
+            wait_registered(
+                relays[0].server, victims, timeout=cfg.deadline_s * 2
+            )
+            st = [
+                threading.Thread(target=client_loop, args=(c,), daemon=True)
+                for c in range(half)
+            ]
+            for t in st:
+                t.start()
+            for t in vt + st:
+                t.join(timeout=timeout)
+            rt.join(timeout=timeout)
+        finally:
+            fault.close()
+            for rel in relays:
+                rel.close()
+    out = RoundOutcome(
+        round=0,
+        ok=root_agg[0] is not None,
+        error=root_err[0],
+        contributors=sorted(results),
+        dropped=sorted(errors),
+    )
+    if root_agg[0] is not None and root.last_assignment is not None:
+        # The recorded assignment's groups hold CLIENT ids, which here
+        # are exactly indices into the uploads list — aggregate_tree
+        # replays the round's ACTUAL tree directly (dropped clients are
+        # simply absent from every group).
+        groups = root.last_assignment["groups"]
+        ref = aggregate_tree(
+            [uploads[c] for c in range(n)],
+            [float(n_samples[c]) for c in range(n)],
+            groups,
+        )
+        out.live_crc = wire.flat_crc32(
+            {k: np.asarray(v, np.float32) for k, v in root_agg[0].items()}
+        )
+        out.clean_crc = wire.flat_crc32(ref)
+        out.bitexact = out.live_crc == out.clean_crc
+        result.notes.append(f"assignment: {groups}")
+    result.rounds.append(out)
+    # Re-home visibility: the obs timeline shows a second wire-upload
+    # span (the failed attempt against the dead relay, rehome_failed=1)
+    # for each victim.
+    spans = load_spans(trace_dir=trace_dir)
+    rehome_spans = [
+        s for s in spans
+        if s["span"] == "wire-upload" and s.get("rehome_failed")
+    ]
+    result.notes.append(
+        f"rehome wire-upload spans: {len(rehome_spans)} "
+        f"(victims: {victims})"
+    )
+    if not rehome_spans:
+        result.notes.append(
+            "round 0: no rehome_failed wire-upload span on the timeline "
+            "(bookkeeping slip)"
+        )
+    return result
+
+
 # ----------------------------------------------------------- reporting
 def run_matrix(
     cfg: ScenarioConfig, out_dir: str
@@ -539,6 +720,19 @@ def run_matrix(
         log.info(
             f"[SCENARIO] cell {spec.name}: {res.ok_rounds}/{cfg.rounds} "
             f"rounds ok, {res.exact_rounds} crc-exact, "
+            f"{time.monotonic() - t0:.1f}s"
+        )
+        results.append(res)
+    if cfg.dead_relay_cell:
+        log.info(
+            "[SCENARIO] cell dead-relay: depth-2 tree, seeded mid-round "
+            "relay kill, re-home + degraded root"
+        )
+        t0 = time.monotonic()
+        res = run_dead_relay_cell(cfg, out_dir)
+        log.info(
+            f"[SCENARIO] cell {res.spec.name}: "
+            f"{res.ok_rounds}/1 rounds ok, {res.exact_rounds} crc-exact, "
             f"{time.monotonic() - t0:.1f}s"
         )
         results.append(res)
@@ -598,7 +792,8 @@ def comparison_grid(
         return txt
 
     by_key = {(r.spec.personas[0], r.spec.partition, r.spec.auth): r
-              for r in results}
+              for r in results
+              if not r.spec.name.startswith("dead-relay")}
     parts = list(cfg.partitions)
     width = 34
     lines = [
@@ -620,6 +815,14 @@ def comparison_grid(
                 + f"{res.spec.personas[0]}+auth".ljust(14)
                 + _cell_text(res).ljust(width)
                 + f"({res.spec.partition})"
+            )
+        elif res.spec.name.startswith("dead-relay"):
+            lines.append(
+                "  "
+                + "dead-relay".ljust(14)
+                + _cell_text(res).ljust(width)
+                + f"({res.spec.partition}; depth-2 tree, mid-round kill, "
+                "re-home)"
             )
     lines.append("")
     for res in results:

@@ -119,6 +119,50 @@ def test_wire_domain_clean_tree_passes(tmp_path):
     assert _findings(root, ["wire-domain-unique"]) == []
 
 
+def test_wire_meta_key_unique_flags_duplicate_empty_and_stray(tmp_path):
+    root = _mini_tree(
+        tmp_path,
+        {
+            "comm/wire.py": """
+                STREAM_META_KEY = "stream"
+                REHOME_META_KEY = "stream"
+                EMPTY_META_KEY = ""
+            """,
+            "comm/client.py": """
+                LOCAL_META_KEY = "local"
+            """,
+        },
+    )
+    found = _findings(root, ["wire-meta-key-unique"])
+    messages = "\n".join(f.message for f in found)
+    assert (
+        "REHOME_META_KEY duplicates the meta-key string of "
+        "STREAM_META_KEY" in messages
+    )
+    assert "EMPTY_META_KEY must be a non-empty string" in messages
+    assert "LOCAL_META_KEY declared outside the wire layer" in messages
+
+
+def test_wire_meta_key_clean_tree_and_lost_anchor(tmp_path):
+    root = _mini_tree(
+        tmp_path,
+        {
+            "comm/wire.py": """
+                A_META_KEY = "a"
+            """,
+            "obs/trace.py": """
+                TRACE_META_KEY = "trace"
+            """,
+        },
+    )
+    assert _findings(root, ["wire-meta-key-unique"]) == []
+    bare = _mini_tree(
+        tmp_path / "bare", {"comm/wire.py": "A_MAGIC = b'AAAA'\n"}
+    )
+    found = _findings(bare, ["wire-meta-key-unique"])
+    assert any("lost its anchor" in f.message for f in found)
+
+
 def test_wire_magic_coverage_flags_one_sided_and_adhoc(tmp_path):
     root = _mini_tree(
         tmp_path,
@@ -662,6 +706,24 @@ def test_mutation_duplicate_hmac_domain_fails(repo_copy):
     )
 
 
+def test_mutation_duplicate_meta_key_fails(repo_copy):
+    # Two capabilities collapsing onto one upload-meta field: the PR-14
+    # subtree contributor record silently shadowing the streamed-reply
+    # advert.
+    _mutate(
+        repo_copy,
+        "comm/wire.py",
+        'SUBTREE_IDS_META_KEY = "subtree_ids"',
+        'SUBTREE_IDS_META_KEY = "stream_reply"',
+    )
+    result = run_check(str(repo_copy))
+    assert result.exit_code == 1
+    assert any(
+        f.rule == "wire-meta-key-unique" and "duplicates" in f.message
+        for f in result.new
+    )
+
+
 def test_mutation_wall_clock_in_fold_path_fails(repo_copy):
     _mutate(
         repo_copy,
@@ -744,10 +806,10 @@ def test_mutation_missing_stream_direction_fails(repo_copy):
 def test_mutation_ghost_headline_field_fails(repo_copy):
     path = os.path.join(repo_copy, "bench.py")
     src = open(path).read()
-    anchor = '"fleet_rounds_per_hour", "relay_peak_agg_bytes"'
+    anchor = '"fleet_rounds_per_hour",'
     assert anchor in src
     src = src.replace(
-        anchor, anchor + ', "ghost_headline_field_s"', 1
+        anchor, anchor + ' "ghost_headline_field_s",', 1
     )
     open(path, "w").write(src)
     result = run_check(str(repo_copy))

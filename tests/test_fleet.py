@@ -17,7 +17,9 @@ Contracts pinned here:
   StreamAgg (flat and depth-2) produce ONE crc.
 """
 
+import socket
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -692,3 +694,689 @@ def test_fleet_128_clients_depth2_live(rng):
     assert {wire.flat_crc32(results[c]) for c in range(128)} == {
         wire.flat_crc32(want)
     }
+
+
+# ------------------------------------------- survivable fold trees (PR 14)
+def _wait_registered(server, ids, timeout):
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.faults.deadrelay import (
+        wait_registered,
+    )
+
+    return wait_registered(server, ids, timeout=timeout)
+
+
+def _dead_port() -> int:
+    """A loopback port with nothing listening (bind, read, release)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_rehome_tree(rng, *, kill, trace_dir=None, root_deadline=6.0):
+    """Depth-2 tree where subtree 1 dies and its clients re-home to
+    relay 0. ``kill="dial"``: the victims' primary is a dead port (their
+    dial budget exhausts). ``kill="mid"``: relay 1 is alive (expecting a
+    phantom third client, so its round stays open), and is torn down
+    AFTER the victims' uploads landed — they observe a mid-exchange
+    death. Returns (models, results, clients, root_state, timings)."""
+    n = 4
+    models = [_leaves(rng, n=3, shape=(16, 5)) for _ in range(n)]
+    n_samples = {c: c + 1 for c in range(n)}
+    results: dict[int, dict] = {}
+    errors: list = []
+    root_aggs: list = []
+    timings: dict[str, float] = {}
+    tracer = None
+    if trace_dir is not None:
+        from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs import (
+            Tracer,
+        )
+
+        tracer = Tracer(f"{trace_dir}/root.jsonl", proc="root")
+    with AggregationServer(
+        port=0, num_clients=2, min_clients=1, weighted=True, timeout=30,
+        stream_chunk_bytes=1 << 10, tracer=tracer,
+    ) as root:
+        relay0 = RelayAggregator(
+            "127.0.0.1", 0, parent_host="127.0.0.1",
+            parent_port=root.port, relay_id=0, num_clients=2,
+            timeout=30, stream_chunk_bytes=1 << 10,
+        )
+        relay1 = (
+            RelayAggregator(
+                "127.0.0.1", 0, parent_host="127.0.0.1",
+                parent_port=root.port, relay_id=1, num_clients=3,
+                timeout=30, stream_chunk_bytes=1 << 10,
+            )
+            if kill == "mid"
+            else None
+        )
+        try:
+            rt = threading.Thread(
+                target=lambda: root_aggs.append(
+                    root.serve_round(deadline=root_deadline)
+                ),
+                daemon=True,
+            )
+            rt.start()
+            threading.Thread(target=relay0.serve, args=(1,), daemon=True).start()
+            if relay1 is not None:
+                threading.Thread(
+                    target=relay1.serve, args=(1,), daemon=True
+                ).start()
+            victim_port = relay1.port if relay1 is not None else _dead_port()
+            clients = {}
+            for cid in (0, 1):
+                clients[cid] = FederatedClient(
+                    "127.0.0.1", relay0.port, client_id=cid, timeout=20
+                )
+            for cid in (2, 3):
+                clients[cid] = FederatedClient(
+                    "127.0.0.1", victim_port, client_id=cid, timeout=20,
+                    fallback_parents=[("127.0.0.1", relay0.port)],
+                    rehome_dial_budget=1.2,
+                )
+
+            def go(cid):
+                try:
+                    results[cid] = clients[cid].exchange(
+                        models[cid], n_samples=n_samples[cid],
+                        max_retries=3,
+                    )
+                except Exception as e:  # noqa: BLE001
+                    errors.append((cid, e))
+
+            vt = [
+                threading.Thread(target=go, args=(c,), daemon=True)
+                for c in (2, 3)
+            ]
+            for t in vt:
+                t.start()
+            if relay1 is not None:
+                # Wait until both victim uploads REGISTERED at relay 1
+                # (they then block on its reply), and kill it — the
+                # victims see a mid-exchange death, promptly.
+                _wait_registered(relay1.server, {2, 3}, 10)
+                timings["killed_at"] = time.monotonic()
+                relay1.close()
+            # Adoption gate: hold relay 0's own clients until the
+            # re-homed uploads registered there, keeping its round open
+            # through the adoption window.
+            _wait_registered(relay0.server, {2, 3}, 15)
+            timings["adopted_at"] = time.monotonic()
+            st = [
+                threading.Thread(target=go, args=(c,), daemon=True)
+                for c in (0, 1)
+            ]
+            for t in st:
+                t.start()
+            for t in vt + st:
+                t.join(timeout=40)
+            rt.join(timeout=20)
+            assert not errors, errors
+        finally:
+            relay0.close()
+            if relay1 is not None:
+                relay1.close()
+        root_state = {
+            "agg": root_aggs[0] if root_aggs else None,
+            "assignment": root.last_assignment,
+            "tree_totals": dict(root.tree_totals),
+        }
+    want = aggregate_tree(
+        models,
+        [float(n_samples[c]) for c in range(n)],
+        root_state["assignment"]["groups"],
+    )
+    return models, results, clients, root_state, want, timings
+
+
+def test_rehome_on_dial_exhausted_converges_in_round(rng, tmp_path):
+    """The victims' primary never answers: their seeded dial budget
+    exhausts, they re-home to the sibling relay, and the degraded root
+    round completes over the surviving subtree — crc-bit-exact vs
+    aggregate_tree over the ROOT's recorded actual assignment."""
+    models, results, clients, root_state, want, _ = _run_rehome_tree(
+        rng, kill="dial", trace_dir=str(tmp_path)
+    )
+    assert root_state["agg"] is not None
+    # The recorded assignment: one surviving subtree that folded
+    # everyone, own + adopted, in ascending client id.
+    assert root_state["assignment"]["groups"] == [[0, 1, 2, 3]]
+    assert wire.flat_crc32(root_state["agg"]) == wire.flat_crc32(want)
+    for cid in range(4):
+        assert wire.flat_crc32(results[cid]) == wire.flat_crc32(want)
+    for cid in (2, 3):
+        assert clients[cid].rehomes == {"dial-exhausted": 1}
+    for cid in (0, 1):
+        assert clients[cid].rehomes == {}
+    # Root-side degradation accounting: one whole subtree dropped.
+    assert root_state["tree_totals"]["subtree_failures"] == 1
+    assert root_state["tree_totals"]["degraded_rounds"] == 1
+    assert root_state["tree_totals"]["stragglers_shed"] == 0
+    # The missing-subtree event is stamped on the root's agg span.
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs.timeline import (
+        load_spans,
+    )
+
+    aggs = [
+        s for s in load_spans(trace_dir=str(tmp_path)) if s["span"] == "agg"
+    ]
+    assert aggs and aggs[-1]["missing_subtrees"] == 1
+    assert aggs[-1]["assignment"] == [[0, 1, 2, 3]]
+    # Adoption happened at the relay tier, not the root — the root's
+    # span carries no adopted list.
+    assert aggs[-1].get("adopted") in (None, [])
+
+
+def test_rehome_on_mid_exchange_death_converges_in_round(rng):
+    """Relay 1 dies AFTER the victims' uploads landed (they are blocked
+    on its reply): close() sheds them promptly — explicit failures, not
+    socket timeouts — they re-home as mid-exchange, re-upload dense, and
+    the round converges bit-exactly."""
+    models, results, clients, root_state, want, timings = _run_rehome_tree(
+        rng, kill="mid"
+    )
+    assert root_state["agg"] is not None
+    assert wire.flat_crc32(root_state["agg"]) == wire.flat_crc32(want)
+    for cid in range(4):
+        assert wire.flat_crc32(results[cid]) == wire.flat_crc32(want)
+    for cid in (2, 3):
+        assert clients[cid].rehomes == {"mid-exchange": 1}
+    # Prompt shedding (the PR 6 prompt-close discipline applied to
+    # subtree teardown): the window from the kill to both re-homed
+    # uploads being ADOPTED at the sibling must be seconds, not a
+    # socket-timeout (20 s here, 300 s default).
+    assert timings["adopted_at"] - timings["killed_at"] < 5.0
+    assert root_state["tree_totals"]["subtree_failures"] == 1
+
+
+def test_rehome_duplicate_after_fold_refused_on_adoptive_parent(rng):
+    """A re-homed client whose streamed upload already FOLDED at the
+    adoptive parent retries (dense, still marked): the duplicate is
+    refused, the folded original stands, and the retry connection still
+    receives the round's reply — the supersede semantics, re-homed
+    flavor."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        framing,
+    )
+
+    own = _leaves(rng, n=2, shape=(8, 3))
+    adopted_upload = _leaves(rng, n=2, shape=(8, 3))
+    poison = {k: v + np.float32(99.0) for k, v in adopted_upload.items()}
+    with AggregationServer(
+        port=0, num_clients=1, timeout=15, stream_chunk_bytes=1 << 10
+    ) as server:
+        agg_out: list = []
+        t = threading.Thread(
+            target=lambda: agg_out.append(server.serve_round(deadline=10)),
+            daemon=True,
+        )
+        t.start()
+        # Adopted client 5: streamed upload, header + every leaf chunk,
+        # but NO trailer — the round must hold for it (adopted uploads
+        # gate completion) while its leaves are all present and can fold.
+        flat5 = wire.flatten_params(adopted_upload)
+        tensors, payload_nbytes = wire.plan_stream(flat5, "none")
+        s5 = socket.create_connection(("127.0.0.1", server.port), timeout=10)
+        header = wire.encode_stream_header(
+            tensors,
+            meta={
+                "client_id": 5,
+                "n_samples": 7,
+                wire.REHOME_META_KEY: 1,
+            },
+            chunk_bytes=1 << 10,
+            payload_nbytes=payload_nbytes,
+            direction="up",
+        )
+        framing.send_frame(s5, header)
+        payload = b"".join(
+            wire.encode_stream_leaf(flat5[t_["key"]], t_["enc"])
+            for t_ in tensors
+        )
+        seq = 0
+        for off in range(0, len(payload), 1 << 10):
+            framing.send_frame(
+                s5,
+                wire.encode_stream_chunk(
+                    seq, payload[off : off + (1 << 10)], direction="up"
+                ),
+                await_ack=False,
+            )
+            seq += 1
+        # Own client 0 uploads dense: the fold set freezes over
+        # {0, adopted 5} and — all leaves present — folds both.
+        fc0 = FederatedClient(
+            "127.0.0.1", server.port, client_id=0, timeout=15
+        )
+        r0 = {}
+        t0 = threading.Thread(
+            target=lambda: r0.update(fc0.exchange(own, n_samples=3)),
+            daemon=True,
+        )
+        t0.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            rnd = server._cur_rnd
+            if rnd is not None and rnd.stream is not None and (
+                rnd.stream.fold_ids is not None
+                and len(rnd.stream._folded) == len(tensors)
+            ):
+                break
+            time.sleep(0.02)
+        # The re-homed retry: DENSE, marked, different bytes (poison) —
+        # must be refused in favor of the folded original.
+        dup = wire.encode(
+            poison,
+            meta={"client_id": 5, "n_samples": 7, wire.REHOME_META_KEY: 1},
+        )
+        s5b = socket.create_connection(("127.0.0.1", server.port), timeout=10)
+        framing.send_frame(s5b, dup)
+        reply = framing.recv_frame(s5b)
+        got, meta = wire.decode(reply)
+        t0.join(timeout=15)
+        t.join(timeout=15)
+        s5.close()
+        s5b.close()
+        want = aggregate_flat(
+            [wire.flatten_params(own), flat5], None
+        )
+        assert wire.flat_crc32(agg_out[0]) == wire.flat_crc32(want)
+        assert wire.flat_crc32(wire.flatten_params(got)) == wire.flat_crc32(
+            want
+        )
+        assert wire.flat_crc32(wire.flatten_params(r0)) == wire.flat_crc32(
+            want
+        )
+
+
+def test_fold_order_determinism_rehomed_assignment(rng):
+    """The shuffled-arrival fold-order property extended to a re-homed
+    assignment: group 3's contributors adopted by groups 1 and 5 — every
+    arrival order through StreamAgg produces ONE crc, equal to
+    aggregate_tree over the ACTUAL (post-re-home) groups."""
+    n = 64
+    keys = tuple(sorted(f"k{i}" for i in range(3)))
+    modelz = [
+        {k: rng.normal(size=(8, 3)).astype(np.float32) for k in keys}
+        for _ in range(n)
+    ]
+    weights = [float(w) for w in rng.integers(1, 9, size=n)]
+    base = [list(range(g * 8, (g + 1) * 8)) for g in range(8)]
+    dead = base[3]
+    # The actual assignment after re-homing: dead subtree's clients
+    # split across two adoptive subtrees; ascending id inside each.
+    groups = [
+        sorted(base[1] + dead[:4]),
+        *[sorted(g) for g in (base[0], base[2])],
+        sorted(base[5] + dead[4:]),
+        *[sorted(g) for g in (base[4], base[6], base[7])],
+    ]
+    groups = sorted(groups)  # fixed subtree order at the root
+
+    def tree_crc(order):
+        partials, masses = [], []
+        for g in groups:
+            st = StreamAgg()
+            ws = [weights[i] for i in g]
+            for cid in [c for c in order if c in g]:
+                st.register(cid, keys=keys, n_samples=weights[cid])
+            st.freeze(list(g), ws)
+            for cid in [c for c in order if c in g]:
+                st.add_dense(cid, modelz[cid])
+            partials.append(st.finalize(list(g), ws))
+            masses.append(sum(ws))
+        root = StreamAgg()
+        for r in range(len(groups)):
+            root.register(r, keys=keys, n_samples=masses[r])
+        root.freeze(list(range(len(groups))), masses)
+        for r in range(len(groups)):
+            root.add_dense(r, partials[r])
+        return wire.flat_crc32(
+            root.finalize(list(range(len(groups))), masses)
+        )
+
+    orders = [list(range(n))]
+    for _ in range(3):
+        o = list(range(n))
+        rng.shuffle(o)
+        orders.append(o)
+    crcs = {tree_crc(o) for o in orders}
+    assert crcs == {
+        wire.flat_crc32(aggregate_tree(modelz, weights, groups))
+    }
+
+
+def test_subtree_deadline_sheds_locally_while_root_stays_green(rng):
+    """A relay with a tight subtree deadline and a quorum sheds its
+    missing straggler LOCALLY (stragglers_shed, not a failed round) and
+    still forwards in time — the root round completes green, within the
+    root deadline, not degraded."""
+    model0 = _leaves(rng, n=3, shape=(8, 3))
+    root_aggs: list = []
+    with AggregationServer(
+        port=0, num_clients=1, weighted=True, timeout=30,
+        stream_chunk_bytes=1 << 10,
+    ) as root:
+        relay = RelayAggregator(
+            "127.0.0.1", 0, parent_host="127.0.0.1",
+            parent_port=root.port, relay_id=0, num_clients=2,
+            min_clients=1, timeout=8.0, subtree_deadline_factor=0.25,
+        )
+        try:
+            rt = threading.Thread(
+                target=lambda: root_aggs.append(
+                    root.serve_round(deadline=15.0)
+                ),
+                daemon=True,
+            )
+            rt.start()
+            t0 = time.monotonic()
+            threading.Thread(target=relay.serve, args=(1,), daemon=True).start()
+            fc = FederatedClient(
+                "127.0.0.1", relay.port, client_id=0, timeout=20
+            )
+            got = fc.exchange(model0, n_samples=5)
+            relay_wall = time.monotonic() - t0
+        finally:
+            relay.close()
+        # Shed at ~factor * timeout = 2 s, well under the root's 15 s.
+        assert relay_wall < 8.0
+        assert relay.server.tree_totals["stragglers_shed"] == 1
+        assert relay.server.tree_totals["subtree_failures"] == 0
+        # The root saw its one expected subtree: green, not degraded.
+        assert root.tree_totals["degraded_rounds"] == 0
+        want = aggregate_tree([model0], [5.0], [[0]])
+        assert wire.flat_crc32(root_aggs[0]) == wire.flat_crc32(want)
+        assert wire.flat_crc32(got) == wire.flat_crc32(want)
+        assert root.last_assignment["groups"] == [[0]]
+
+
+def test_relay_close_aborts_parent_exchange_promptly(rng):
+    """close() mid-round: the parent-facing exchange (blocked in its
+    dial backoff against a dead root) aborts NOW, and the pending child
+    upload is shed as an explicit failure — neither waits out a socket
+    timeout (the PR 6 prompt-close discipline applied to teardown)."""
+    relay = RelayAggregator(
+        "127.0.0.1", 0, parent_host="127.0.0.1",
+        parent_port=_dead_port(), relay_id=0, num_clients=1,
+        timeout=120.0,
+    )
+    serve_done = threading.Event()
+
+    def serve():
+        relay.serve(rounds=1)
+        serve_done.set()
+
+    threading.Thread(target=serve, daemon=True).start()
+    fc = FederatedClient("127.0.0.1", relay.port, client_id=0, timeout=120)
+    err: list = []
+
+    def child():
+        try:
+            fc.exchange(_leaves(rng, n=2, shape=(4, 2)), max_retries=1)
+        except (ConnectionError, OSError, WireError) as e:
+            err.append(e)
+
+    ct = threading.Thread(target=child, daemon=True)
+    ct.start()
+    # Let the child upload land and the relay's forward start dialing
+    # the dead root.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        rnd = relay.server._cur_rnd
+        if rnd is not None and 0 in rnd.models:
+            break
+        time.sleep(0.02)
+    time.sleep(0.5)
+    t0 = time.monotonic()
+    relay.close()
+    ct.join(timeout=10)
+    assert err and time.monotonic() - t0 < 8.0, (
+        "child upload not shed promptly on relay close()"
+    )
+    assert serve_done.wait(timeout=10.0), (
+        "relay serve loop still blocked after close() "
+        "(parent dial not aborted)"
+    )
+
+
+def test_root_refuses_overlapping_subtree_claims(rng):
+    """Two uploads whose subtree contributor records claim the same
+    client id (a re-homed upload double-counted by a surviving old
+    parent): the round fails loudly — no renormalization can repair
+    that mean."""
+    models = [_leaves(rng, n=2, shape=(4, 2)) for _ in range(2)]
+    err: list = []
+    with AggregationServer(
+        port=0, num_clients=2, weighted=True, timeout=15
+    ) as server:
+        def serve():
+            try:
+                server.serve_round(deadline=8)
+            except RuntimeError as e:
+                err.append(e)
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        clients = {
+            cid: FederatedClient(
+                "127.0.0.1", server.port, client_id=cid, timeout=10
+            )
+            for cid in range(2)
+        }
+        metas = {
+            0: {wire.SUBTREE_IDS_META_KEY: [10, 11]},
+            1: {wire.SUBTREE_IDS_META_KEY: [11, 12]},
+        }
+        results, cerrs = {}, []
+
+        def go(cid):
+            try:
+                results[cid] = clients[cid].exchange(
+                    models[cid], meta=metas[cid], max_retries=1
+                )
+            except Exception as e:  # noqa: BLE001
+                cerrs.append((cid, e))
+
+        threads = [
+            threading.Thread(target=go, args=(c,), daemon=True)
+            for c in range(2)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=20)
+        t.join(timeout=15)
+    assert err and "double-counted" in str(err[0])
+    assert len(cerrs) == 2  # both clients failed fast, round retried
+
+
+def test_rehome_config_and_parser_wiring():
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli.parser import (
+        build_parser,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.config import (
+        FedConfig,
+    )
+
+    # client --parent repeatable + --rehome-dial-budget.
+    args = build_parser().parse_args(
+        [
+            "client", "--client-id", "2",
+            "--parent", "10.0.0.1:12346", "--parent", "10.0.0.2:12346",
+            "--rehome-dial-budget", "3.5",
+        ]
+    )
+    assert args.parent == ["10.0.0.1:12346", "10.0.0.2:12346"]
+    assert args.rehome_dial_budget == 3.5
+    # relay --subtree-deadline-factor + --flight-dir parity.
+    args = build_parser().parse_args(
+        [
+            "relay", "--relay-id", "1", "--subtree-deadline-factor",
+            "0.3", "--flight-dir", "/tmp/fl",
+        ]
+    )
+    assert args.subtree_deadline_factor == 0.3
+    assert args.flight_dir == "/tmp/fl"
+    # Validation: the factor must be strictly inside (0, 1) everywhere.
+    with pytest.raises(ValueError, match="subtree_deadline_factor"):
+        FedConfig(subtree_deadline_factor=1.0)
+    with pytest.raises(ValueError, match="subtree_deadline_factor"):
+        RelayAggregator(
+            "127.0.0.1", 0, parent_host="127.0.0.1", parent_port=1,
+            relay_id=0, num_clients=1, subtree_deadline_factor=1.5,
+        )
+    # Re-homing refuses the single-aggregator modes.
+    with pytest.raises(ValueError, match="fallback_parents"):
+        FederatedClient(
+            "127.0.0.1", 1, client_id=0, secure_agg=True, num_clients=2,
+            fallback_parents=[("127.0.0.1", 2)],
+        )
+    with pytest.raises(ValueError, match="rehome_dial_budget"):
+        FederatedClient(
+            "127.0.0.1", 1, client_id=0, rehome_dial_budget=0.0,
+        )
+
+
+def test_rehome_counters_on_default_registry():
+    """fedtpu_client_rehomes_total is a labeled counter family on the
+    default registry (registered ONLY from comm/client.py —
+    obs-metric-once), shared by every client in the process, and
+    incremented on each re-home by reason."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs import (
+        metrics as obs_metrics,
+    )
+
+    m = obs_metrics.default_registry()
+    c_dial = m.counter(
+        "fedtpu_client_rehomes_total", labels={"reason": "dial-exhausted"}
+    )
+    c_mid = m.counter(
+        "fedtpu_client_rehomes_total", labels={"reason": "mid-exchange"}
+    )
+    before = (c_dial.value, c_mid.value)
+    fc = FederatedClient(
+        "127.0.0.1", _dead_port(), client_id=9,
+        fallback_parents=[("127.0.0.1", _dead_port())],
+    )
+    assert fc._rehome("dial-exhausted")
+    assert not fc._rehome("mid-exchange")  # list exhausted
+    assert c_dial.value == before[0] + 1
+    assert c_mid.value == before[1]
+    assert fc.rehomes == {"dial-exhausted": 1}
+    snap = m.snapshot()["families"]["fedtpu_client_rehomes_total"]
+    assert snap["type"] == "counter"
+    assert {s["labels"]["reason"] for s in snap["samples"]} >= {
+        "dial-exhausted",
+        "mid-exchange",
+    }
+
+
+@pytest.mark.slow
+def test_fleet_128_clients_two_relays_killed(rng):
+    """Scale margin for the failover plane: 128 clients / 16 relays,
+    TWO relays killed mid-round (their clients re-home to two surviving
+    siblings); the degraded root round completes crc-bit-exact vs the
+    recorded actual assignment."""
+    n_clients, n_relays, per = 128, 16, 8
+    models = [_leaves(rng, n=3, shape=(16,)) for _ in range(n_clients)]
+    victim_relays = {3, 11}
+    adoptive = {3: 0, 11: 8}  # victim relay -> fallback relay index
+    results: dict[int, dict] = {}
+    errors: list = []
+    root_aggs: list = []
+    with AggregationServer(
+        port=0, num_clients=n_relays, min_clients=1, weighted=True,
+        timeout=60, stream_chunk_bytes=1 << 10,
+    ) as root:
+        relays = [
+            RelayAggregator(
+                "127.0.0.1", 0, parent_host="127.0.0.1",
+                parent_port=root.port, relay_id=r, num_clients=per,
+                timeout=60, stream_chunk_bytes=1 << 10,
+            )
+            for r in range(n_relays)
+        ]
+        try:
+            rt = threading.Thread(
+                target=lambda: root_aggs.append(
+                    root.serve_round(deadline=20.0)
+                ),
+                daemon=True,
+            )
+            rt.start()
+            for r, rel in enumerate(relays):
+                if r not in victim_relays:
+                    threading.Thread(
+                        target=rel.serve, args=(1,), daemon=True
+                    ).start()
+                else:
+                    rel.close()  # dead from the start: dial-exhausted
+            clients = {}
+            for cid in range(n_clients):
+                r = cid // per
+                if r in victim_relays:
+                    clients[cid] = FederatedClient(
+                        "127.0.0.1", relays[r].port, client_id=cid,
+                        timeout=40,
+                        fallback_parents=[
+                            ("127.0.0.1", relays[adoptive[r]].port)
+                        ],
+                        rehome_dial_budget=1.5,
+                    )
+                else:
+                    clients[cid] = FederatedClient(
+                        "127.0.0.1", relays[r].port, client_id=cid,
+                        timeout=40,
+                    )
+
+            def go(cid):
+                try:
+                    results[cid] = clients[cid].exchange(
+                        models[cid], max_retries=3
+                    )
+                except Exception as e:  # noqa: BLE001
+                    errors.append((cid, e))
+
+            victim_ids = [
+                c for c in range(n_clients) if c // per in victim_relays
+            ]
+            vt = [
+                threading.Thread(target=go, args=(c,), daemon=True)
+                for c in victim_ids
+            ]
+            for t in vt:
+                t.start()
+            # Hold the adoptive relays' own clients until every victim
+            # re-homed and registered.
+            for ar, want_ids in (
+                (0, {c for c in victim_ids if c // per == 3}),
+                (8, {c for c in victim_ids if c // per == 11}),
+            ):
+                _wait_registered(relays[ar].server, want_ids, 30)
+            st = [
+                threading.Thread(target=go, args=(c,), daemon=True)
+                for c in range(n_clients)
+                if c // per not in victim_relays
+            ]
+            for t in st:
+                t.start()
+            for t in vt + st:
+                t.join(timeout=90)
+            rt.join(timeout=60)
+            assert not errors, errors[:3]
+        finally:
+            for rel in relays:
+                rel.close()
+        assert root_aggs and root_aggs[0] is not None
+        assert root.tree_totals["subtree_failures"] == 2
+        want = aggregate_tree(
+            models, None, root.last_assignment["groups"]
+        )
+        assert wire.flat_crc32(root_aggs[0]) == wire.flat_crc32(want)
+        crcs = {wire.flat_crc32(results[c]) for c in results}
+        assert crcs == {wire.flat_crc32(want)}

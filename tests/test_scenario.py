@@ -163,3 +163,30 @@ def test_contract_violation_reported_for_failed_round(tmp_path):
     assert res.rounds[1].ok is False
     v = contract_violations([res])
     assert any("round 1" in x for x in v)
+
+
+def test_dead_relay_cell_rehomes_and_stays_bitexact(tmp_path):
+    """The dead-relay cell (PR 14): a depth-2 tree with a seeded
+    mid-round relay kill — the victim subtree's clients re-home to the
+    surviving relay, the root completes a DEGRADED round, the aggregate
+    is crc-pinned bit-exact vs aggregate_tree over the recorded actual
+    assignment, and the re-home is visible on the obs timeline as a
+    second wire-upload span (rehome_failed=1)."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.faults.scenario import (
+        run_dead_relay_cell,
+    )
+
+    cfg = _cfg(num_clients=4, deadline_s=4.0, dead_relay_cell=True)
+    res = run_dead_relay_cell(cfg, str(tmp_path))
+    assert res.spec.name == "dead-relay|iid"
+    assert [r.ok for r in res.rounds] == [True]
+    assert res.rounds[0].bitexact is True, res.notes
+    assert res.rounds[0].contributors == [0, 1, 2, 3]
+    notes = "\n".join(res.notes)
+    assert "rehomes" in notes
+    assert "rehome wire-upload spans: 2" in notes, res.notes
+    # The matrix runner appends it behind the flag and the grid renders
+    # its row; contract_violations stays empty for the green cell.
+    assert contract_violations([res]) == []
+    grid = comparison_grid([res], cfg)
+    assert "dead-relay" in grid and "mid-round kill" in grid
