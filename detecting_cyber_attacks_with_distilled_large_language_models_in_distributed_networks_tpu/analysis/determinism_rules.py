@@ -41,9 +41,13 @@ from typing import Iterator
 from .core import Finding, Project, call_name, register
 
 #: The crc-contract surface (ISSUE 8): fold arithmetic, fold order,
-#: partition assignment, and the chaos layer's replayable plans.
+#: partition assignment, the chaos layer's replayable plans, and the
+#: FSDP shard-spec builders (parallel/mesh.py fsdp_dim/fsdp_spec must
+#: pick the SAME shard layout on every process/round — the wire tier
+#: scatters reply leaves onto specs it derives independently).
 SCOPE = (
     "parallel/fedavg.py",
+    "parallel/mesh.py",
     "comm/stream_agg.py",
     "comm/relay.py",
     "data/partition.py",

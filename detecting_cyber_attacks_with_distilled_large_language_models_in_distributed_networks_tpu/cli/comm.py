@@ -247,6 +247,12 @@ def cmd_client(args) -> int:
             f"[CLIENT {args.client_id}] local mesh: data={cfg.mesh.data}"
             + (f" x seq={cfg.mesh.seq}" if cfg.mesh.seq > 1 else "")
             + f" over {cfg.mesh.data * cfg.mesh.seq} local device(s)"
+            + (
+                " — FSDP shard-at-rest (params+opt ~1/N per chip, "
+                "gather-at-use)"
+                if cfg.mesh.fsdp
+                else ""
+            )
         )
     state = trainer.init_state(params=pretrained)
     ckpt = None
@@ -497,7 +503,11 @@ def cmd_client(args) -> int:
             state = trainer.adopt_aggregate(state, aggregated)
             from ..obs.profile import note_memory as _note_memory
 
-            _note_memory("post-round")
+            # Adopt-aggregate boundary watermark: the meshed/FSDP
+            # trainers materialize fresh (sharded) Adam moments HERE —
+            # the stamp the FSDP memory story is proven on (PR-12
+            # residual: this boundary was unstamped).
+            _note_memory("post-aggregate")
             if ckpt is not None:
                 # Post-aggregate save — the reference's client1.py:403.
                 save_seq += 1
@@ -511,6 +521,10 @@ def cmd_client(args) -> int:
                         "aggregated": True,
                     },
                 )
+            # End-of-round watermark, AFTER the checkpoint enqueue — a
+            # distinct reading from post-aggregate (which brackets the
+            # adoption spike the moment the fresh moments land).
+            _note_memory("post-round")
         except (ConnectionError, OSError, SecureAggError) as e:
             agg_metrics = None
             log.info(

@@ -154,11 +154,15 @@ def _resolve_mesh(args, cfg: ExperimentConfig, n: int) -> MeshConfig:
     default), and validation errors surface as operator messages."""
     dp = getattr(args, "data_parallel", None)
     sp = getattr(args, "seq_parallel", None)
+    fsdp = getattr(args, "fsdp", None)
     try:
         return MeshConfig(
             clients=n,
             data=cfg.mesh.data if dp is None else dp,
             seq=cfg.mesh.seq if sp is None else sp,
+            # store_true default is False; the config file wins unless
+            # the flag was actually given.
+            fsdp=cfg.mesh.fsdp or bool(fsdp),
         )
     except ValueError as e:
         raise SystemExit(str(e)) from None

@@ -603,6 +603,18 @@ def build_parser() -> argparse.ArgumentParser:
         "attention over a local 'seq' mesh axis via a C=1 fedseq trainer; "
         "model.max_len must divide by it)",
     )
+    p.add_argument(
+        "--fsdp",
+        action="store_true",
+        default=None,
+        help="FSDP shard-at-rest with --data-parallel N: params AND "
+        "optimizer state shard per-leaf over the N local devices "
+        "(all-gather at use, backward re-gathers via remat, grads "
+        "reduce-scatter) so per-chip static bytes scale ~1/N — big-model "
+        "clients become compute-bound again. Trajectory matches the "
+        "replicated mesh to fp32 reduction-order ulps; the wire "
+        "exchange, secure-agg, and DP compose unchanged",
+    )
     p.add_argument("--timeout", type=float, default=300.0)
     p.add_argument(
         "--compression",

@@ -382,6 +382,14 @@ class FederatedClient:
         # not per round — an old peer would otherwise say it every
         # exchange).
         self._fallback_logged: set[str] = set()
+        # Wire-codec step profiler (obs/profile.py, --profile-stride):
+        # samples the streamed pack (leaf gather+encode) and unpack
+        # (chunk decode+place) hot loops, surfacing step_wire_ms_* attrs
+        # on the wire-upload/wire-reply spans. None when profiling is
+        # off — the loops then run the literal pre-profiling path.
+        # Re-armed lazily per exchange (the CLI installs the stride
+        # after this client may have been built).
+        self._wire_profiler = None
         if secure_agg and auth_key is None:
             log.warning(
                 f"[CLIENT {client_id}] --secure-agg without an auth key "
@@ -864,14 +872,17 @@ class FederatedClient:
                         t_up_unix = time.time()
                         t_up0 = time.monotonic()
                         upload_started = (t_up_unix, t_up0, 0)
-                        sent, chunks, overlap_s = self._stream_upload(
-                            sock, up_flat, attempt_meta,
-                            attempt_compression, nonce_hex,
+                        sent, chunks, overlap_s, wire_attrs = (
+                            self._stream_upload(
+                                sock, up_flat, attempt_meta,
+                                attempt_compression, nonce_hex,
+                            )
                         )
                         upload_timing = (
                             t_up_unix, time.monotonic() - t_up0, sent,
                             {"chunks": chunks,
-                             "overlap_s": round(overlap_s, 6)},
+                             "overlap_s": round(overlap_s, 6),
+                             **wire_attrs},
                         )
                     else:
                         if stream_flat is not None:
@@ -983,7 +994,7 @@ class FederatedClient:
                     # the header frame already arrived; leaves decode —
                     # and, on a meshed client, land on device — as the
                     # remaining chunks come off the wire.
-                    agg_flat, agg_meta, reply_bytes = (
+                    agg_flat, agg_meta, reply_bytes, reply_wire_attrs = (
                         self._recv_stream_reply(sock, reply, nonce_hex)
                     )
                     agg = wire.unflatten_params(agg_flat)
@@ -992,8 +1003,10 @@ class FederatedClient:
                         reply, auth_key=self.auth_key
                     )
                     reply_bytes = len(reply)
+                    reply_wire_attrs = {}
                 reply_timing = (
                     t_rep_unix, time.monotonic() - t_rep0, reply_bytes,
+                    reply_wire_attrs or None,
                 )
                 if self.auth_key is not None and (
                     agg_meta.get("role") != "server"
@@ -1256,6 +1269,20 @@ class FederatedClient:
                 pass
 
     # ------------------------------------------------- streamed uploads
+    def _armed_wire_profiler(self):
+        """The wire-codec StepProfiler with a fresh window, or None when
+        profiling is off (maybe_step_profiler re-checked per call — the
+        CLI installs the stride after construction, the trainers'
+        _armed_profiler pattern)."""
+        from ..obs.profile import maybe_step_profiler
+
+        prof = self._wire_profiler
+        if prof is None:
+            prof = self._wire_profiler = maybe_step_profiler("wire")
+        if prof is not None:
+            prof.begin_window()
+        return prof
+
     def _stream_upload(
         self,
         sock: socket.socket,
@@ -1263,14 +1290,17 @@ class FederatedClient:
         meta: dict,
         compression: str,
         nonce_hex: str | None,
-    ) -> tuple[int, int, float]:
+    ) -> tuple[int, int, float, dict]:
         """Ship one upload as header + chunk frames + trailer (wire.py
         "Streamed uploads"): leaves are gathered/encoded one at a time on
         THIS thread while a background wire thread (framing.
         PipelinedSender) sends the chunks already packed — the compute/
         comms overlap the single-frame path cannot have. Returns
-        ``(bytes sent, chunk count, overlap seconds)`` where overlap is
-        the pack+send time hidden by running the two concurrently.
+        ``(bytes sent, chunk count, overlap seconds, wire attrs)``
+        where overlap is the pack+send time hidden by running the two
+        concurrently and wire attrs are the sampled per-leaf pack
+        timings (``step_wire_ms_*``, {} when profiling is off) for the
+        wire-upload span.
 
         Leaves materialized here are cached back into ``flat`` so a
         dense fallback attempt never re-crosses the device boundary."""
@@ -1317,8 +1347,10 @@ class FederatedClient:
                     sent += len(frame)
                     seq += 1
 
+            wire_prof = self._armed_wire_profiler()
             for t in tensors:
                 key = t["key"]
+                sampled = wire_prof.tick() if wire_prof is not None else False
                 tp0 = time.monotonic()
                 leaf = flat[key]
                 if not isinstance(leaf, wire.PreEncoded) and not isinstance(
@@ -1328,7 +1360,13 @@ class FederatedClient:
                     # cached so retries reuse it.
                     leaf = flat[key] = np.asarray(leaf)
                 data = wire.encode_stream_leaf(leaf, t["enc"])
-                pack_s += time.monotonic() - tp0
+                leaf_dt = time.monotonic() - tp0
+                pack_s += leaf_dt
+                if sampled:
+                    # One pack "step" = one leaf's host gather + encode
+                    # — the loop the step profiler never covered
+                    # (PR-12 residual).
+                    wire_prof.note("wire", leaf_dt)
                 buf += data
                 _flush()
             _flush(final=True)
@@ -1349,7 +1387,10 @@ class FederatedClient:
             raise
         wall = max(time.monotonic() - t0, 1e-9)
         overlap_s = max(0.0, pack_s + send_s - wall)
-        return sent, seq, overlap_s
+        return (
+            sent, seq, overlap_s,
+            wire_prof.span_attrs() if wire_prof is not None else {},
+        )
 
     def _log_dense_fallback(self, attempt: int) -> None:
         """One line naming WHY this upload goes dense while the streamed
@@ -1379,7 +1420,7 @@ class FederatedClient:
 
     def _recv_stream_reply(
         self, sock: socket.socket, header, nonce_hex: str | None
-    ) -> tuple[dict, dict, int]:
+    ) -> tuple[dict, dict, int, dict]:
         """Receive one chunk-streamed aggregate reply (wire.py "Streamed
         replies"): decode each leaf the moment its bytes complete. In
         auth mode every frame's tag verifies under the REPLY-direction
@@ -1388,7 +1429,10 @@ class FederatedClient:
         never pass as aggregate data. Plain (non-DP, non-sparse) replies
         pass each decoded leaf through ``reply_leaf_sink`` when set —
         the mesh tier's on-device placement — while later chunks are
-        still in flight. Returns ``(flat leaves, meta, bytes read)``."""
+        still in flight. Returns ``(flat leaves, meta, bytes read, wire
+        attrs)`` — the last being the sampled per-leaf unpack timings
+        (``step_wire_ms_*``, {} when profiling is off) for the
+        wire-reply span."""
         tensors, meta, _chunk_bytes, payload_nbytes = (
             wire.decode_stream_header(
                 header,
@@ -1417,6 +1461,7 @@ class FederatedClient:
         flat: dict[str, Any] = {}
         ti = 0
         leaf_buf = bytearray()
+        wire_prof = self._armed_wire_profiler()
 
         def _consume(data) -> None:
             nonlocal ti, leaf_buf
@@ -1426,8 +1471,16 @@ class FederatedClient:
                     tensors[ti]["nbytes"]
                 ):
                     t = tensors[ti]
+                    sampled = (
+                        wire_prof.tick() if wire_prof is not None else False
+                    )
+                    td0 = time.monotonic() if sampled else 0.0
                     arr = wire.decode_tensor_entry(t, bytes(leaf_buf))
                     flat[t["key"]] = sink(t["key"], arr) if sink else arr
+                    if sampled:
+                        # One unpack "step" = one leaf's decode + (on a
+                        # meshed client) on-device placement.
+                        wire_prof.note("wire", time.monotonic() - td0)
                     leaf_buf = bytearray()
                     ti += 1
                 if off >= len(data):
@@ -1477,7 +1530,10 @@ class FederatedClient:
             nonce=nonce,
             direction="down",
         )
-        return flat, meta, got
+        return (
+            flat, meta, got,
+            wire_prof.span_attrs() if wire_prof is not None else {},
+        )
 
     # ------------------------------------------------------ observability
     def note_local_phase(
@@ -1504,7 +1560,7 @@ class FederatedClient:
         self,
         agg_meta: Mapping[str, Any],
         upload: tuple[float, float, int, dict | None] | None,
-        reply: tuple[float, float, int] | None,
+        reply: tuple[float, float, int, dict | None] | None,
     ) -> None:
         """Adopt the reply's (trace, round) identity and write this
         round's spans: buffered client-local phases first (they happened
@@ -1544,6 +1600,7 @@ class FederatedClient:
                 trace=trace,
                 round=rnd,
                 bytes=reply[2],
+                **(reply[3] if len(reply) > 3 and reply[3] else {}),
             )
 
     # ------------------------------------------------- sparse round deltas

@@ -750,6 +750,14 @@ class MeshConfig:
     # data/seq axes as that host's LOCAL mesh (train/client_mesh.py); the
     # clients axis is the wire there, not a mesh dimension.
     seq: int = 1
+    # FSDP shard-at-rest on the TCP client's local mesh (`client
+    # --data-parallel N --fsdp`, train/client_mesh.py FsdpMeshTrainer):
+    # params AND optimizer state shard per-leaf over the `data` axis
+    # (all-gather at use inside the jitted step, backward re-gathers via
+    # remat, grads reduce-scatter) so per-chip static bytes scale ~1/N —
+    # the big-model-client mode. Trajectory matches the replicated mesh
+    # to fp32 reduction-order ulps.
+    fsdp: bool = False
     axis_names: tuple[str, str] = ("clients", "data")
 
     def __post_init__(self) -> None:
@@ -760,6 +768,17 @@ class MeshConfig:
             )
         if self.seq < 1:
             raise ValueError(f"mesh.seq={self.seq} must be >= 1")
+        if self.fsdp and self.data < 2:
+            raise ValueError(
+                "mesh.fsdp needs data >= 2 (--data-parallel N): sharding "
+                "the static state over one device is a no-op"
+            )
+        if self.fsdp and self.seq > 1:
+            raise ValueError(
+                "mesh.fsdp does not compose with seq > 1: the C=1 fedseq "
+                "trainer owns the 3-axis layout (sharded-scorer/fedseq "
+                "FSDP is the ROADMAP follow-up)"
+            )
 
 
 @dataclass(frozen=True)

@@ -69,10 +69,21 @@ STEP_BUCKETS = (
 
 #: StepProfiler site -> /metrics histogram family (one literal per
 #: family, registered from this module only — the obs-metric-once
-#: contract).
+#: contract). The "wire" site covers the TCP tier's pack/unpack hot
+#: loops (comm/client.py streamed-upload leaf encode + streamed-reply
+#: leaf decode) — the PR-12 device-plane residual.
 _STEP_FAMILIES = {
     "train": "fedtpu_train_step_seconds",
     "score": "fedtpu_score_step_seconds",
+    "wire": "fedtpu_wire_step_seconds",
+}
+
+#: Per-site phase vocabulary: the train/score sites split a step into
+#: host/dispatch/device; the wire site times one leaf's encode or
+#: decode as a single "wire" phase (direction comes from the span the
+#: attrs land on: wire-upload = pack, wire-reply = unpack).
+_SITE_PHASES = {
+    "wire": ("wire",),
 }
 
 
@@ -397,9 +408,12 @@ class StepProfiler:
         self.stride = int(stride)
         self.enabled = self.stride > 0
         self.site = str(site)
+        # Per-site phase vocabulary (the wire site has one phase; the
+        # step sites keep the host/dispatch/device split).
+        self.phases: tuple[str, ...] = _SITE_PHASES.get(self.site, self.PHASES)
         self._n = 0
         self._lock = threading.Lock()
-        self._samples: dict[str, list[float]] = {p: [] for p in self.PHASES}
+        self._samples: dict[str, list[float]] = {p: [] for p in self.phases}
         self._max_samples = int(max_samples)
         self._hists = None
         if self.enabled:
@@ -409,12 +423,11 @@ class StepProfiler:
                 self._hists = {
                     p: reg.histogram(
                         family,
-                        help="sampled step seconds by phase "
-                        "(host batch-prep / dispatch / device-execute)",
+                        help="sampled step seconds by phase",
                         labels={"phase": p},
                         buckets=STEP_BUCKETS,
                     )
-                    for p in self.PHASES
+                    for p in self.phases
                 }
 
     # ------------------------------------------------------------- sampling
@@ -446,6 +459,17 @@ class StepProfiler:
         if self._hists is not None:
             self._hists[phase].observe(float(dt))
 
+    def note(self, phase: str, dt: float) -> None:
+        """Record one sampled duration for a named phase — the generic
+        entry for sites whose phases aren't the host/dispatch/device
+        split (the wire pack/unpack loops note ``"wire"``)."""
+        if phase not in self._samples:
+            raise ValueError(
+                f"unknown phase {phase!r} for site {self.site!r} "
+                f"(have {self.phases})"
+            )
+        self._note(phase, dt)
+
     def note_host(self, dt: float) -> None:
         self._note("host", dt)
 
@@ -468,7 +492,7 @@ class StepProfiler:
         sample bound once and silently stop reporting (the histograms
         above carry the cumulative record)."""
         with self._lock:
-            for p in self.PHASES:
+            for p in self.phases:
                 self._samples[p].clear()
 
     def _phase_stats(self, vals: list[float]) -> dict | None:
@@ -486,7 +510,7 @@ class StepProfiler:
         (empty when no samples)."""
         with self._lock:
             out = {}
-            for p in self.PHASES:
+            for p in self.phases:
                 st = self._phase_stats(self._samples[p])
                 if st is not None:
                     out[p] = st

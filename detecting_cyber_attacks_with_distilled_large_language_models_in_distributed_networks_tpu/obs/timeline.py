@@ -319,6 +319,22 @@ def timeline_table(
                 f"device {s['step_device_ms_p50']:.2f}ms p50 "
                 f"({s.get('step_sampled', 0)} sampled)"
             )
+        # Wire-codec row (obs/profile.py "wire" site): wire-upload /
+        # wire-reply spans carrying sampled per-leaf pack/unpack timings
+        # — the stream hot loops the step profiler's train/score sites
+        # never covered.
+        for s in groups[key]:
+            if s["span"] not in ("wire-upload", "wire-reply") or s.get(
+                "step_wire_ms_p50"
+            ) is None:
+                continue
+            kind = "pack" if s["span"] == "wire-upload" else "unpack"
+            out.append(
+                f"  wire-codec     {str(s.get('proc', '?')):<14} "
+                f"{kind} {s['step_wire_ms_p50']:.2f}ms p50 / "
+                f"{s.get('step_wire_ms_p95', 0.0):.2f}ms p95 per leaf "
+                f"({s.get('step_sampled', 0)} sampled)"
+            )
         if b["overlap_s"] > 0.0:
             # Overlapped vs exposed wire/aggregation time: fold seconds
             # hidden inside the wire phase, next to the exposed agg.

@@ -33,17 +33,29 @@ for the single-device client.
 from __future__ import annotations
 
 import dataclasses
+from functools import lru_cache
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import ExperimentConfig, ModelConfig, TrainConfig
 from ..data.pipeline import TokenizedSplit, shard_rows, stack_clients
-from ..parallel.mesh import make_host_mesh
+from ..parallel.mesh import (
+    device_tree_bytes,
+    fsdp_sharding,
+    fsdp_tree_shardings,
+    make_host_mesh,
+)
 from ..utils.logging import get_logger
-from .engine import Trainer, TrainState
+from .engine import (
+    Trainer,
+    TrainState,
+    make_fsdp_eval_step,
+    make_fsdp_train_step,
+)
 
 log = get_logger()
 
@@ -73,7 +85,18 @@ class MeshTrainer(Trainer):
         self.mesh = mesh
         self.batch_sharding = NamedSharding(mesh, P("data"))
         self.replicated = NamedSharding(mesh, P())
-        base_train, base_eval = self.train_step, self.eval_step
+        self._install_steps(
+            self.train_step,
+            self.eval_step,
+            lambda p: jax.device_put(p, self.replicated),
+        )
+
+    def _install_steps(self, base_train, base_eval, place_params) -> None:
+        """Wrap base jitted steps with the mesh tier's batch placement
+        (rows over ``data``; a short batch that doesn't divide goes
+        replicated, keeping the math identical) and ``place_params`` for
+        the eval path — the ONE wrapper shape shared by the replicated
+        and FSDP trainers, so batch-placement fixes can't drift apart."""
 
         def train_step(state, batch):
             return base_train(
@@ -87,7 +110,7 @@ class MeshTrainer(Trainer):
                 self.replicated,
             )
             return base_eval(
-                params=jax.device_put(params, self.replicated),
+                params=place_params(params),
                 batch={k: v for k, v in placed.items() if k != "valid"},
                 valid=placed["valid"],
             )
@@ -124,6 +147,224 @@ class MeshTrainer(Trainer):
         values are bit-identical to the host-tree path (placement only,
         no arithmetic)."""
         return jax.device_put(arr, self.replicated)
+
+
+class FsdpMeshTrainer(MeshTrainer):
+    """FSDP shard-at-rest over the per-host ``data`` mesh axis
+    (``client --data-parallel N --fsdp``).
+
+    :class:`MeshTrainer` buys batch throughput but replicates params AND
+    Adam moments on every chip — the multi-chip tier stays memory-bound
+    at the single-chip model ceiling. Here the static state shards at
+    rest (per-leaf specs from ``parallel/mesh.fsdp_spec``: the largest
+    axis-divisible dimension of each leaf over ``data``; undividable
+    leaves replicate) and the jitted train step all-gathers params AT
+    USE inside a remat region tagged so the backward RE-GATHERS instead
+    of retaining full-size weights; gradients reduce-scatter back onto
+    the shards and Adam updates run shard-local. Per-chip static bytes
+    scale ~1/N (bench-asserted, ``fsdp_peak_param_opt_bytes_ratio``).
+
+    Contracts carried over from the replicated mesh:
+
+    * trajectory: same threefry PRNG streams, same shuffles, same update
+      arithmetic — params agree with the replicated/single-device client
+      to fp32 reduction-order ulps (reduce-scatter may sum grad partials
+      in a different order than the all-reduce; allclose-pinned, the
+      PR-2/PR-7 documented class), metrics equal.
+    * wire tier untouched: ``host_params`` gathers one full tree at the
+      exchange/checkpoint boundary ONLY (``comm/client.py`` keeps the
+      gather lazy via ``flatten_lazy`` — leaf k+1 gathers while chunk k
+      streams), ``reply_leaf_sink`` scatters each decoded reply leaf
+      straight onto its shard, so secure-agg/DP/streamed uploads compose
+      unchanged.
+    """
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        train_cfg: TrainConfig,
+        *,
+        mesh,
+        pad_id: int = 0,
+        drop_remainder: bool = True,
+    ):
+        super().__init__(
+            model_cfg,
+            train_cfg,
+            mesh=mesh,
+            pad_id=pad_id,
+            drop_remainder=drop_remainder,
+        )
+        self.n_shards = int(mesh.shape["data"])
+        # Per-trainer memo of the jitted sharded optimizer.init (see
+        # _init_opt_state — adopt_aggregate hits it every round).
+        self._opt_init_jit = None
+        # Replace the replicated base steps MeshTrainer installed with
+        # the spec-parameterized FSDP programs; the batch-placement
+        # wrapper shape is shared (_install_steps), only the base steps
+        # and the eval params placement differ. The programs are
+        # process-wide memoized on (configs, mesh) like the engine's —
+        # same-config trainers (multi-round flows, the test suite) share
+        # one set of compiled executables.
+        from .engine import step_key_cfg
+
+        base_train, base_eval = _fsdp_steps(
+            model_cfg, step_key_cfg(train_cfg), mesh
+        )
+        # Eval params placement is identity per batch: evaluate() below
+        # owns the ONE host->shard placement before the batch sweep, and
+        # evaluate_state feeds the live (already sharded) state — a
+        # per-batch place_state_tree would rebuild the whole per-leaf
+        # sharding tree on every metrics batch for a guaranteed no-op.
+        self._install_steps(base_train, base_eval, lambda params: params)
+
+    # ------------------------------------------------------------ placement
+    def leaf_sharding(self, shape) -> NamedSharding:
+        """The shard-at-rest placement of one leaf — shape-deterministic
+        (parallel/mesh.fsdp_spec), so the wire tier can place a decoded
+        reply leaf with no layout negotiation."""
+        return fsdp_sharding(self.mesh, tuple(int(d) for d in shape))
+
+    def place_state_tree(self, tree: Any) -> Any:
+        """Scatter a host (or replicated) tree onto its per-leaf shards;
+        a leaf already living on its shard spec is a no-op."""
+        return jax.device_put(tree, fsdp_tree_shardings(tree, self.mesh))
+
+    def init_state(
+        self, seed: int | None = None, params: Any | None = None
+    ) -> TrainState:
+        """Engine state scattered shard-at-rest — also the
+        aggregate-adoption path: a received round reply lands directly on
+        its shards (leaves the streamed-reply sink already placed pass
+        through untouched), and fresh Adam moments materialize SHARDED
+        (zeros_like of sharded params), never full-size per chip.
+        The seed/PRNG/param-init sequence is the base Trainer's (the
+        trajectory contract lives in ONE place); only placement differs,
+        via the _place_init_params/_init_opt_state hooks below —
+        MeshTrainer's replicated placement is deliberately skipped."""
+        state = Trainer.init_state(self, seed=seed, params=params)
+        # params are shard-at-rest via _place_init_params and the
+        # moments via the jitted init's out_shardings — one placement
+        # mechanism, nothing to re-place here (step/rng are scalar/key
+        # leaves the first jitted step commits).
+        self._note_static_bytes(state)
+        return state
+
+    def _place_init_params(self, params: Any) -> Any:
+        return self.place_state_tree(params)
+
+    def _init_opt_state(self, params: Any) -> Any:
+        # Jitted init with EXPLICIT out_shardings: zeros_like moments
+        # materialize directly ON their shards — never full-size per
+        # chip. Propagation from the sharded params alone is not enough
+        # (measured: it replicates the moments), so the at-rest layout
+        # is pinned from the eval_shape template. The wrapper is cached
+        # per trainer — init_state runs on EVERY round's aggregate
+        # adoption, and a fresh jax.jit per call would re-trace there.
+        fn = self._opt_init_jit
+        if fn is None:
+            template = jax.eval_shape(self.optimizer.init, params)
+            fn = self._opt_init_jit = jax.jit(
+                self.optimizer.init,
+                out_shardings=fsdp_tree_shardings(template, self.mesh),
+            )
+        return fn(params)
+
+    def _note_static_bytes(self, state: TrainState) -> None:
+        """Per-chip static-state accounting gauge
+        (``fedtpu_fsdp_static_state_bytes``): exact addressable-shard
+        bytes of params + optimizer state on one device — the number the
+        FSDP bench's peak ratio is built from, exported so a live client
+        shows its sharding actually engaged."""
+        from ..obs.metrics import default_registry
+
+        default_registry().gauge(
+            "fedtpu_fsdp_static_state_bytes",
+            help="per-device bytes of FSDP shard-at-rest params + "
+            "optimizer state",
+        ).set(
+            float(
+                device_tree_bytes((state.params, state.opt_state))
+            )
+        )
+
+    # ----------------------------------------------------------- wire tier
+    def evaluate(self, params: Any, split, **kw: Any) -> dict:
+        """Place host params onto their shards ONCE before the batch
+        sweep (the per-batch wrapper's placement is then a no-op).
+        Skips MeshTrainer.evaluate — its replicated device_put would
+        un-shard the tree (a full copy per chip, exactly what FSDP
+        exists to avoid)."""
+        return Trainer.evaluate(
+            self, self.place_state_tree(params), split, **kw
+        )
+
+    def host_params(self, state) -> Any:
+        """The wire-upload form WITHOUT an eager device->host gather:
+        leaves stay device-backed on their shards, so the streamed
+        upload's packer (comm/client.py: ``wire.flatten_lazy`` plans
+        from shape/dtype metadata, ``_stream_upload`` np.asarray's one
+        leaf at a time) gathers leaf k+1 off its shards while chunk k
+        is already on the wire — at no point does a full host-side tree
+        exist beyond the in-flight leaf. The dense/DP/secure paths call
+        ``_host_params`` on this tree themselves (one gather per
+        exchange); values are identical either way."""
+        return state.params
+
+    def reply_leaf_sink(self, key: str, arr: np.ndarray) -> Any:
+        """Streamed-reply leaf placement: scatter one decoded aggregate
+        leaf DIRECTLY ONTO ITS SHARD the moment its chunk bytes land —
+        the FSDP twin of MeshTrainer's replicated sink, so adoption
+        never materializes a full host-side tree AND never replicates a
+        leaf that is about to live sharded anyway. Values bit-identical
+        to the host-tree path (placement only, no arithmetic)."""
+        return jax.device_put(arr, self.leaf_sharding(np.shape(arr)))
+
+
+@lru_cache(maxsize=None)
+def _fsdp_steps(model_cfg: ModelConfig, key_cfg: TrainConfig, mesh):
+    """Process-wide memo of the FSDP jitted programs, keyed on the
+    frozen configs + the mesh they are pure functions of (the caller
+    canonicalizes step-irrelevant TrainConfig fields out, exactly like
+    engine._engine_steps — and two ``make_host_mesh(N)`` calls over the
+    same devices compare equal, so same-shape trainers share one set of
+    compiled executables). gather/constrain are pure functions of the
+    mesh: gather places every leaf replicated (the all-gather-at-use);
+    constrain pins a tree back onto its shard-at-rest specs
+    (the reduce-scatter / shard-at-rest layout)."""
+    from .engine import _engine_steps
+
+    model, optimizer, _, _ = _engine_steps(model_cfg, key_cfg)
+    replicated = NamedSharding(mesh, P())
+
+    def gather(params):
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, replicated),
+            params,
+        )
+
+    def constrain(tree):
+        # fsdp_tree_shardings is the ONE layout definition (dtype-guarded:
+        # non-float/int leaves replicate) — the same call init_state/
+        # place_state_tree place at-rest state with, so the in-step
+        # constraint can never disagree with the adoption path's layout.
+        # Works on tracers too (only .shape/.dtype are read).
+        return jax.tree.map(
+            jax.lax.with_sharding_constraint,
+            tree,
+            fsdp_tree_shardings(tree, mesh),
+        )
+
+    return (
+        make_fsdp_train_step(
+            model,
+            optimizer,
+            key_cfg.warmup_steps,
+            gather=gather,
+            constrain=constrain,
+        ),
+        make_fsdp_eval_step(model, gather=gather),
+    )
 
 
 class FedSeqClientTrainer:
@@ -282,8 +523,9 @@ def make_client_trainer(
     cfg: ExperimentConfig, *, pad_id: int = 0
 ) -> Trainer | FedSeqClientTrainer:
     """The TCP client's local-phase trainer for the resolved mesh config:
-    plain engine (1x1), data-parallel meshed engine (Nx1), or the C=1
-    sequence-parallel composition (NxM, M > 1)."""
+    plain engine (1x1), data-parallel meshed engine (Nx1) — replicated or
+    FSDP shard-at-rest (``--fsdp``) — or the C=1 sequence-parallel
+    composition (NxM, M > 1)."""
     data, seq = cfg.mesh.data, cfg.mesh.seq
     if data > 1 and cfg.data.batch_size % data:
         # Both branches: fail at construction with an operator-readable
@@ -291,6 +533,22 @@ def make_client_trainer(
         raise ValueError(
             f"batch_size={cfg.data.batch_size} must divide over "
             f"--data-parallel {data} (row shards)"
+        )
+    if cfg.mesh.fsdp:
+        # (MeshConfig validates fsdp needs data >= 2 and no seq axis;
+        # make_host_mesh validates the local device count.)
+        if cfg.train.prng_impl != "threefry2x32":
+            log.warning(
+                f"[CLIENT-FSDP] prng_impl={cfg.train.prng_impl!r}: dropout "
+                "masks are not shard-invariant under this impl; set "
+                "train.prng_impl='threefry2x32' for replicated-mesh parity"
+            )
+        return FsdpMeshTrainer(
+            cfg.model,
+            cfg.train,
+            mesh=make_host_mesh(data),
+            pad_id=pad_id,
+            drop_remainder=cfg.data.drop_remainder,
         )
     if seq > 1:
         # (FedSeqTrainer's own __init__ validates max_len % seq and the

@@ -215,10 +215,30 @@ def make_train_step(
     model: DDoSClassifier,
     optimizer: optax.GradientTransformation,
     warmup_steps: int = 0,
+    *,
+    gather: Callable | None = None,
+    constrain: Callable | None = None,
+    site: str = "engine.train_step",
 ) -> Callable[[TrainState, dict], tuple[TrainState, jnp.ndarray]]:
-    """One jitted SGD step; params/opt_state buffers are donated."""
+    """One jitted SGD step; params/opt_state buffers are donated.
+
+    ``gather``/``constrain`` spec-parameterize the step for FSDP
+    shard-at-rest state (see :func:`make_fsdp_train_step`, the named
+    entry): gather runs inside a :func:`fsdp_remat_loss` region so the
+    backward re-gathers; constrain reduce-scatters grads and pins the
+    updated params/opt leaves back onto their shards. None/None (the
+    default) is the literal replicated step — ONE update-math
+    implementation, the replicated/FSDP trajectories can't drift."""
     ledger = default_ledger()
-    note_compile = ledger.hook("engine.train_step")
+    note_compile = ledger.hook(site)
+    if gather is not None:
+        tagged = _tag_gather(gather)
+        loss_rm = fsdp_remat_loss(
+            lambda p, batch, step_rng: loss_fn(model, tagged(p), batch, step_rng)
+        )
+    else:
+        def loss_rm(p, batch, step_rng):
+            return loss_fn(model, p, batch, step_rng)
 
     @partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, batch) -> tuple[TrainState, jnp.ndarray]:
@@ -226,28 +246,156 @@ def make_train_step(
         # per traced shape, so the note IS a compile event, never a call.
         note_compile(tuple(batch["input_ids"].shape))
         step_rng = jax.random.fold_in(state.rng, state.step)
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(model, p, batch, step_rng)
-        )(state.params)
+        loss, grads = jax.value_and_grad(loss_rm)(
+            state.params, batch, step_rng
+        )
+        if constrain is not None:
+            grads = constrain(grads)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         updates = apply_warmup(updates, state.step, warmup_steps)
         params = optax.apply_updates(state.params, updates)
+        if constrain is not None:
+            params, opt_state = constrain(params), constrain(opt_state)
         return TrainState(params, opt_state, state.step + 1, state.rng), loss
 
-    return ledger.timed("engine.train_step", train_step)
+    return ledger.timed(site, train_step)
 
 
-def make_eval_step(model: DDoSClassifier) -> Callable:
-    """Jitted eval step -> (BinaryCounts, P(class 1) probs for ROC/PR)."""
+def make_eval_step(
+    model: DDoSClassifier,
+    *,
+    gather: Callable | None = None,
+    site: str = "engine.eval_step",
+) -> Callable:
+    """Jitted eval step -> (BinaryCounts, P(class 1) probs for ROC/PR).
+    ``gather`` places shard-at-rest params replicated at use (the FSDP
+    entry :func:`make_fsdp_eval_step`); no remat needed — eval saves no
+    residuals."""
     ledger = default_ledger()
-    note_compile = ledger.hook("engine.eval_step")
+    note_compile = ledger.hook(site)
 
     @jax.jit
     def eval_step(params, batch, valid) -> tuple[BinaryCounts, jnp.ndarray]:
         note_compile(tuple(batch["input_ids"].shape))
+        if gather is not None:
+            params = gather(params)
         return eval_counts(model, params, batch, valid)
 
-    return ledger.timed("engine.eval_step", eval_step)
+    return ledger.timed(site, eval_step)
+
+
+# ----------------------------------------------------- FSDP (sharded) steps
+#: checkpoint_name tag on every FSDP all-gather output: the remat policy
+#: below saves EVERYTHING ELSE, so the backward pass re-runs only the
+#: gathers instead of retaining full-size gathered weights as residuals
+#: — ZeRO-3's recompute-the-gather, not full activation remat.
+FSDP_GATHER_NAME = "fsdp_gathered"
+
+
+def _tag_gather(gather: Callable) -> Callable:
+    """checkpoint_name-tag every gathered leaf — the value the FSDP
+    remat policy refuses to save (re-gathered in the backward)."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    def tagged(params):
+        return jax.tree.map(
+            lambda x: checkpoint_name(x, FSDP_GATHER_NAME), gather(params)
+        )
+
+    return tagged
+
+
+def _fsdp_policy() -> Callable | None:
+    """Remat policy for the FSDP loss region: save every forward
+    intermediate EXCEPT the all-gathered weights — the checkpoint_name-
+    tagged gather outputs AND the sharding-constraint outputs feeding
+    them. The stock except-these-names policy alone is NOT enough: the
+    un-named constraint output is the same full-size array and the
+    policy happily saves it, so the backward would retain the gathered
+    weights anyway (verified against the saved-residual list; the
+    partial eval saves the nearest policy-saveable producer). None when
+    this jax build lacks named policies or moved the constraint
+    primitive — callers fall back to plain remat (memory still bounded,
+    at a forward replay's extra cost)."""
+    named = getattr(
+        jax.checkpoint_policies, "save_anything_except_these_names", None
+    )
+    if named is None:  # pragma: no cover - older jax fallback
+        return None
+    try:
+        from jax._src.pjit import sharding_constraint_p
+    except Exception:  # pragma: no cover - jax internals moved
+        return None
+    base = named(FSDP_GATHER_NAME)
+
+    def policy(prim, *args, **params):
+        if prim is sharding_constraint_p:
+            return False
+        return base(prim, *args, **params)
+
+    return policy
+
+
+def fsdp_remat_loss(fn: Callable) -> Callable:
+    """Wrap the WHOLE loss computation (the gather runs inside ``fn``)
+    in ``jax.remat`` under the FSDP policy, so the only values the
+    backward recomputes are the all-gathers: full-size gathered weights
+    are never retained as residuals and the activations stay saved (no
+    forward replay). The remat must wrap the loss, not just the gather
+    — a remat region's outputs consumed by un-rematted downstream code
+    are always saved, which would defeat the policy."""
+    policy = _fsdp_policy()
+    if policy is None:  # pragma: no cover - older jax fallback
+        return jax.remat(fn)
+    return jax.remat(fn, policy=policy)
+
+
+def make_fsdp_train_step(
+    model: DDoSClassifier,
+    optimizer: optax.GradientTransformation,
+    warmup_steps: int,
+    *,
+    gather: Callable,
+    constrain: Callable,
+    site: str = "engine.fsdp_train_step",
+) -> Callable:
+    """The engine train step, spec-parameterized for FSDP shard-at-rest:
+
+    * ``gather(params) -> params`` places every leaf replicated (the
+      all-gather-at-use); it runs inside a ``jax.remat`` region tagged so
+      the backward RE-GATHERS instead of retaining full-size weights.
+    * ``constrain(tree) -> tree`` pins a tree back onto its per-leaf
+      shard specs — applied to the grads (the reduce-scatter feeding
+      sharded Adam), the updated params, and the new optimizer state, so
+      the static state never exists full-size outside the gather window.
+
+    SAME implementation as :func:`make_train_step` — this is a thin
+    named entry (its own compile-ledger site) over the base builder's
+    gather=/constrain= parameterization, so the PRNG stream, warmup,
+    and update arithmetic CANNOT drift; the trajectory matches the
+    replicated mesh to fp32 reduction-order ulps (the grad
+    reduce-scatter may sum partials in a different order than the
+    all-reduce; documented and A/B allclose-pinned like the PR-2
+    meshed-vs-single contract)."""
+    return make_train_step(
+        model,
+        optimizer,
+        warmup_steps,
+        gather=gather,
+        constrain=constrain,
+        site=site,
+    )
+
+
+def make_fsdp_eval_step(
+    model: DDoSClassifier,
+    *,
+    gather: Callable,
+    site: str = "engine.fsdp_eval_step",
+) -> Callable:
+    """:func:`make_eval_step` over shard-at-rest params: one gather at
+    use, no remat needed (eval saves no residuals)."""
+    return make_eval_step(model, gather=gather, site=site)
 
 
 @lru_cache(maxsize=None)
@@ -268,13 +416,20 @@ def _cached_engine_steps(model_cfg: ModelConfig, train_cfg: TrainConfig):
     )
 
 
-def _engine_steps(model_cfg: ModelConfig, train_cfg: TrainConfig):
+def step_key_cfg(train_cfg: TrainConfig) -> TrainConfig:
     """Zero the TrainConfig fields the compiled programs don't read (host
     loop/init/telemetry knobs) so e.g. seed-only variations share one
     cache entry. Conservative direction: a newly added field defaults to
-    being part of the key (worst case a lost share, never wrong sharing)."""
-    key_cfg = replace(train_cfg, seed=0, epochs_per_round=1, log_every=0)
-    return _cached_engine_steps(model_cfg, key_cfg)
+    being part of the key (worst case a lost share, never wrong sharing).
+    The ONE canonicalizer for every compiled-program memo key — the FSDP
+    step cache (train/client_mesh._fsdp_steps) keys on it too, so the
+    field list can't drift between the two caches."""
+    return replace(train_cfg, seed=0, epochs_per_round=1, log_every=0)
+
+
+def _engine_steps(model_cfg: ModelConfig, train_cfg: TrainConfig):
+    """Memo entry point: canonicalize the key, then hit the cache."""
+    return _cached_engine_steps(model_cfg, step_key_cfg(train_cfg))
 
 
 def adopt_aggregate_with_fresh_opt(trainer: Any, state: Any, aggregated: Any) -> Any:
@@ -324,12 +479,26 @@ class Trainer:
         rng = jax.random.key(seed, impl=self.train_cfg.prng_impl)
         if params is None:
             params = init_params(self.model, self.model_cfg, rng)
+        params = self._place_init_params(params)
         return TrainState(
             params=params,
-            opt_state=self.optimizer.init(params),
+            opt_state=self._init_opt_state(params),
             step=jnp.zeros((), jnp.int32),
             rng=jax.random.fold_in(rng, 1),
         )
+
+    def _place_init_params(self, params: Any) -> Any:
+        """Hook: where freshly built/adopted params live BEFORE the
+        optimizer init sees them. The seed/PRNG/param-init sequence
+        above is the ONE trajectory-defining implementation; subclasses
+        override only placement (the FSDP trainer scatters onto shards
+        so the moments inherit the layout)."""
+        return params
+
+    def _init_opt_state(self, params: Any) -> Any:
+        """Hook: optimizer-state construction (the FSDP trainer jits it
+        so sharding propagation keeps zeros_like moments sharded)."""
+        return self.optimizer.init(params)
 
     def evaluate_state(
         self, state: TrainState, split: TokenizedSplit, **kw: Any
@@ -342,8 +511,10 @@ class Trainer:
     def host_params(self, state: TrainState) -> Any:
         """Gather the state's params to host numpy — the wire-upload form
         the TCP client feeds FederatedClient.exchange. The single-device
-        engine's gather is a plain readback; meshed subclasses override
-        none of this (replicated params read back one replica)."""
+        engine's gather is a plain readback; the replicated mesh trainer
+        keeps this (one replica reads back); the FSDP trainer overrides
+        it to return device-backed shards so the streamed upload's
+        pack-time gather stays lazy."""
         return jax.tree.map(np.asarray, state.params)
 
     def adopt_aggregate(self, state: TrainState, aggregated: Any) -> TrainState:

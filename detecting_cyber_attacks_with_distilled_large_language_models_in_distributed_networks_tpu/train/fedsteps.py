@@ -36,7 +36,15 @@ from ..utils.logging import get_logger
 log = get_logger()
 
 
-def make_packed_step(objective, optimizer, wsteps: int, mu: float) -> Callable:
+def make_packed_step(
+    objective,
+    optimizer,
+    wsteps: int,
+    mu: float,
+    *,
+    gather: Callable | None = None,
+    constrain: Callable | None = None,
+) -> Callable:
     """The SINGLE per-client packed step builder (shared by the dense and
     3-axis fedseq paths — their update math must never diverge).
 
@@ -46,9 +54,25 @@ def make_packed_step(objective, optimizer, wsteps: int, mu: float) -> Callable:
     of the stacked vmapped step. Signature of the returned program:
     ``(cstate, batch[, anchor]) -> (cstate, task_loss)`` with
     ``cstate = (params, opt_state, step, rng)`` (one client's buffers,
-    donated)."""
+    donated).
+
+    ``gather``/``constrain`` spec-parameterize the step for FSDP
+    shard-at-rest state (train/engine.py's contract: gather runs inside
+    a remat region so the backward re-gathers; constrain reduce-scatters
+    grads and pins the updated params/opt leaves back onto their
+    shards). None/None (the default) is the literal replicated step."""
 
     note_compile = default_ledger().hook("fed.packed_step")
+    if gather is not None:
+        from .engine import _tag_gather, fsdp_remat_loss
+
+        # The remat wraps the WHOLE objective with the tagged gather
+        # inside (engine.fsdp_remat_loss): wrapping only the gather
+        # would save its full-size outputs as residuals anyway.
+        base_objective, tagged = objective, _tag_gather(gather)
+        objective = fsdp_remat_loss(
+            lambda p, b, r, a: base_objective(tagged(p), b, r, a)
+        )
 
     def body(cstate, batch, anchor):
         note_compile(tuple(batch["input_ids"].shape))
@@ -58,12 +82,15 @@ def make_packed_step(objective, optimizer, wsteps: int, mu: float) -> Callable:
             lambda p: objective(p, batch, step_rng, anchor),
             has_aux=True,
         )(params)
+        if constrain is not None:
+            grads = constrain(grads)
         updates, new_opt = optimizer.update(grads, opt_state, params)
         updates = apply_warmup(updates, step, wsteps)
-        return (
-            (optax.apply_updates(params, updates), new_opt, step + 1, rng),
-            task,
-        )
+        new_params = optax.apply_updates(params, updates)
+        if constrain is not None:
+            new_params = constrain(new_params)
+            new_opt = constrain(new_opt)
+        return ((new_params, new_opt, step + 1, rng), task)
 
     if mu > 0.0:
         jitted = jax.jit(body, donate_argnums=(0,))

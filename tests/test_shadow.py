@@ -326,12 +326,25 @@ def test_mirror_pairs_bit_exact_live(tiny_setup, tmp_path):
                 assert reply["prob"] == float(np.float32(p))
                 live_replies.append(reply["prob"])
         deadline = time.monotonic() + 15.0
-        while compare.snapshot()["pairs"] < len(TEXTS):
+
+        def _pair_recs():
+            try:
+                with open(str(tmp_path / "pairs.jsonl")) as f:
+                    return [json.loads(ln) for ln in f]
+            except FileNotFoundError:
+                return []
+
+        # Wait for the FILE too, not just the in-memory counter: the
+        # compare increments pairs under its lock but appends the JSONL
+        # line after releasing it (I/O outside the pairing lock by
+        # design), so the last record can trail the counter briefly.
+        while (
+            compare.snapshot()["pairs"] < len(TEXTS)
+            or len(_pair_recs()) < len(TEXTS)
+        ):
             assert time.monotonic() < deadline, compare.snapshot()
             time.sleep(0.05)
-        recs = [
-            json.loads(ln) for ln in open(str(tmp_path / "pairs.jsonl"))
-        ]
+        recs = _pair_recs()
         assert len(recs) == len(TEXTS)
         by_mid = sorted(recs, key=lambda r: r["mid"])
         for rec, live, direct in zip(by_mid, live_replies, direct_shadow):
