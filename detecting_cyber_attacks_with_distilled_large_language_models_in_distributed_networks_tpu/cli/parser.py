@@ -332,9 +332,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--server-opt",
-        choices=["none", "momentum", "adam"],
+        choices=["none", "momentum", "adam", "yogi"],
         help="FedOpt server optimizer over the round's mean update: "
-        "momentum = FedAvgM, adam = FedAdam (default none = plain FedAvg)",
+        "momentum = FedAvgM, adam = FedAdam, yogi = FedYogi (default "
+        "none = plain FedAvg)",
     )
     p.add_argument(
         "--server-lr", type=float, help="server optimizer learning rate (default 1.0)"
@@ -468,6 +469,18 @@ def build_parser() -> argparse.ArgumentParser:
         "history. Post-noise deltas are DP outputs; persisting them "
         "costs no privacy",
     )
+    p.add_argument(
+        "--strategy",
+        default=None,
+        help="server aggregation strategy applied to the folded mean at "
+        "finalize, as NAME[:k=v,k=v] — fedavg (default, bit-identical "
+        "to the plain fold), fedprox[:mu=0.01] (advertises the proximal "
+        "weight to clients), fedopt[:opt=adam|yogi,lr=0.1], "
+        "momentum[:lr=1.0,momentum=0.9], headboost[:gamma=1.5,"
+        "match=classifier]. Streamed folding, crc replay and relay "
+        "trees are unchanged underneath; non-fedavg strategies refuse "
+        "--secure-agg and --dp-clip",
+    )
     _add_flight_dir(p)
     p.set_defaults(fn=cmd_serve)
 
@@ -551,6 +564,14 @@ def build_parser() -> argparse.ArgumentParser:
         default=0,
         help="Prometheus /metrics for this relay's round engine "
         "(0 = off, the default)",
+    )
+    p.add_argument(
+        "--strategy",
+        default="fedavg",
+        help="strategy id this relay declares on every upward upload "
+        "(strategies apply at the ROOT only; the root refuses a relay "
+        "whose declared strategy differs from its own — the split-brain "
+        "guard). Must name the root's --strategy (default fedavg)",
     )
     _add_flight_dir(p)
     p.set_defaults(fn=cmd_relay)
@@ -715,6 +736,17 @@ def build_parser() -> argparse.ArgumentParser:
         default=0,
         help="seed for the persona's deterministic wire-fault draws "
         "(same seed = same faults, byte-for-byte)",
+    )
+    p.add_argument(
+        "--prox-mu",
+        type=float,
+        default=None,
+        help="FedProx proximal weight for the LOCAL phase: each train "
+        "step adds mu/2 * ||params - round-start aggregate||^2, pulling "
+        "client drift back toward the global (pairs with the server's "
+        "--strategy fedprox, whose reply meta advertises the fleet's "
+        "mu). 0/unset = plain local SGD; composes with --data-parallel "
+        "and --fsdp",
     )
     p.set_defaults(fn=cmd_client)
 
@@ -1242,6 +1274,17 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="dense single-frame uploads in every cell (default: the "
         "server advertises chunk-streamed uploads, so round 2+ streams)",
+    )
+    p.add_argument(
+        "--strategies",
+        default=None,
+        help="';'-separated server strategy specs (NAME[:k=v,...], see "
+        "`serve --strategy`; plain ',' also works for bare names) to "
+        "APPEND as extra matrix cells — each persona x partition pair "
+        "re-runs under every listed non-fedavg strategy, with the base "
+        "cells as the fedavg baseline (add --train for the accuracy "
+        "comparator). fedprox specs thread their mu into the cell's "
+        "client training automatically",
     )
     p.add_argument(
         "--json",

@@ -45,6 +45,22 @@ def cmd_scenario(args) -> int:
             )
     if not personas or not partitions:
         raise SystemExit("need at least one persona and one partition")
+    strategies: tuple[str, ...] = ()
+    if getattr(args, "strategies", None):
+        from ..strategies import parse_strategy
+
+        # ';' separates specs (a spec's own params use ',':
+        # fedopt:opt=adam,lr=0.1); plain ',' still works for bare names.
+        raw = args.strategies
+        sep = ";" if ";" in raw or ":" in raw else ","
+        strategies = tuple(
+            s.strip() for s in raw.split(sep) if s.strip()
+        )
+        for s in strategies:
+            try:
+                parse_strategy(s)  # operator message, not a traceback
+            except ValueError as e:
+                raise SystemExit(str(e)) from None
     cfg = ScenarioConfig(
         num_clients=args.clients,
         rounds=args.rounds,
@@ -58,6 +74,7 @@ def cmd_scenario(args) -> int:
         auth_cell=not args.no_auth_cell,
         dead_relay_cell=not getattr(args, "no_dead_relay_cell", False),
         train=args.train,
+        strategies=strategies,
     )
     results, grid = run_matrix(cfg, args.out_dir)
     if args.json:

@@ -134,6 +134,7 @@ class RelayAggregator:
         stream: bool = True,
         subtree_deadline_factor: float = 0.5,
         tracer=None,
+        strategy: str = "fedavg",
     ):
         # Per-subtree straggler deadline, STRICTLY tighter than the
         # round budget (config.py FedConfig validates the same bound):
@@ -179,6 +180,17 @@ class RelayAggregator:
         self.relay_id = int(relay_id)
         self.subtree_deadline_factor = float(subtree_deadline_factor)
         self.tracer = tracer
+        # Strategy agreement stamp (strategies/, wire.STRATEGY_META_KEY):
+        # strategies apply at the ROOT only — a subtree partial is not a
+        # global, so the relay's own fold never transforms — but the
+        # relay declares which strategy it believes the fleet runs on
+        # every upward upload, and the root refuses a mismatch (a
+        # split-brain fleet folding under two aggregation rules). The
+        # declaration is validated here so a typo'd --strategy fails at
+        # relay start, not at the root's round.
+        from .. import strategies as _strategies
+
+        self.strategy_name = _strategies.make_strategy(strategy).name
         self.server.reply_via = self._forward
         self.port = self.server.port
 
@@ -203,7 +215,10 @@ class RelayAggregator:
             # round's ACTUAL tree, re-homed adoptions included, and how
             # it detects a double-counted re-homed upload.
             meta={
-                wire.SUBTREE_IDS_META_KEY: [int(i) for i in info["ids"]]
+                wire.SUBTREE_IDS_META_KEY: [int(i) for i in info["ids"]],
+                # Strategy agreement: the root WireErrors this upload if
+                # its active strategy id differs (split-brain guard).
+                wire.STRATEGY_META_KEY: {"name": self.strategy_name},
             },
         )
         dur = time.monotonic() - t0

@@ -32,6 +32,7 @@ from typing import Any
 
 import numpy as np
 
+from .. import strategies
 from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
 from ..obs.profile import note_memory
@@ -180,6 +181,7 @@ class AggregationServer:
         dp_history_path: str | None = None,
         tracer=None,
         stream_chunk_bytes: int = wire.DEFAULT_STREAM_CHUNK,
+        strategy: str | None = None,
     ):
         if client_keys is not None and auth_key is None:
             raise ValueError(
@@ -232,6 +234,29 @@ class AggregationServer:
                 "topk is an upload-side (sparse round-delta) compression; "
                 "the reply is an absolute aggregate — use none/bf16/int8"
             )
+        # Server aggregation strategy (strategies/): a pure transform of
+        # (previous global, folded mean) applied at finalize — the fold
+        # itself is untouched, so "fedavg" is bit-identical to the
+        # historical round. Validated here so a typo fails at construction,
+        # not mid-round.
+        self._strategy = strategies.make_strategy(strategy)
+        if self._strategy.name != "fedavg":
+            if secure_agg:
+                raise ValueError(
+                    f"strategy {self._strategy.name!r} is incompatible "
+                    "with secure aggregation: the unmask protocol "
+                    "releases exactly the masked SUM; a server-side "
+                    "post-transform would operate on (and leak through) "
+                    "a different release"
+                )
+            if dp_clip > 0.0:
+                raise ValueError(
+                    f"strategy {self._strategy.name!r} is incompatible "
+                    "with central DP: the DP release is the noised mean "
+                    "DELTA with a calibrated sensitivity; an optimizer "
+                    "transform on top would change what is released "
+                    "without re-deriving the bound"
+                )
         self.num_clients = num_clients
         self.weighted = weighted
         self.min_clients = num_clients if min_clients is None else min_clients
@@ -467,6 +492,16 @@ class AggregationServer:
             "fedtpu_server_rounds_total",
             help="aggregation rounds started",
         )
+        # Strategy plane (strategies/): rounds finalized per strategy —
+        # the /metrics label postmortems join against the round trace's
+        # strategy attr and the reply meta stamp. Created per label value
+        # at finalize (set_strategy can swap mid-run); the registry
+        # memoizes on (name, labels) so this is the single family owner.
+        self._m_strategy_rounds = lambda name: m.counter(
+            "fedtpu_strategy_rounds_total",
+            help="aggregation rounds finalized, by server strategy",
+            labels={"strategy": name},
+        )
         self._m_round_failures = m.counter(
             "fedtpu_server_round_failures_total",
             help="rounds that raised (quorum miss, deadline, bad uploads)",
@@ -527,6 +562,26 @@ class AggregationServer:
             "(the round-duration SLO's burn-rate source, obs/slo.py)",
             buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0),
         )
+
+    # -------------------------------------------------------------- strategy
+    @property
+    def strategy(self) -> "strategies.Strategy":
+        return self._strategy
+
+    def set_strategy(self, spec) -> "strategies.Strategy":
+        """Swap the aggregation strategy BETWEEN rounds (per-round
+        selection: a controller reads the round-START meta, decides, and
+        swaps before calling ``serve_round``). Same compatibility rules
+        as the constructor; optimizer state starts fresh — a strategy's
+        server-optimizer memory is meaningless across a rule change."""
+        strat = strategies.make_strategy(spec)
+        if strat.name != "fedavg" and (self.secure_agg or self.dp_clip > 0.0):
+            raise ValueError(
+                f"strategy {strat.name!r} is incompatible with "
+                "secure-agg/DP rounds (see the constructor's rationale)"
+            )
+        self._strategy = strat
+        return strat
 
     # ------------------------------------------------------------- lifecycle
     def close(self) -> None:
@@ -1235,6 +1290,23 @@ class AggregationServer:
                     f"malformed {wire.SUBTREE_IDS_META_KEY} meta {sub!r} "
                     "(want a list of client ids)"
                 ) from None
+        # Strategy agreement (strategies/): a relay stamps the strategy
+        # id it believes the fleet runs on its upward upload. A mismatch
+        # means a split-brain fleet — two aggregation rules folding into
+        # one global — so the ROOT refuses the upload loudly instead of
+        # silently folding it. Absent stamp = old peer, accepted as-is.
+        claimed = meta.get(wire.STRATEGY_META_KEY)
+        if claimed is not None:
+            name = (
+                claimed.get("name") if isinstance(claimed, dict) else claimed
+            )
+            if str(name) != self._strategy.name:
+                raise wire.WireError(
+                    f"relay {client_id} fans down strategy {name!r} but "
+                    f"this root runs {self._strategy.name!r}; refusing "
+                    "the split-brain round (restart the relay with the "
+                    "root's --strategy)"
+                )
         if not bool(meta.get(wire.REHOME_META_KEY, False)):
             return True
         if self.secure_agg or self.dp_clip > 0.0:
@@ -2642,10 +2714,30 @@ class AggregationServer:
                         ]
                     self._persist_dp_history()
             else:
+                if self.reply_via is None:
+                    # Aggregation strategy (strategies/): a pure transform
+                    # of (previous global, folded mean) — the fold above
+                    # stays bit-exact, fedavg's transform is the identity,
+                    # and relays never transform (the root already did;
+                    # a subtree partial is not a global). The per-client
+                    # fold stats ride along for telemetry.
+                    agg = self._strategy.apply(
+                        self._last_agg,
+                        agg,
+                        round_no=rnd.round_no,
+                        client_stats=(
+                            rnd.stream.client_stats()
+                            if rnd.stream is not None
+                            else None
+                        ),
+                    )
+                    self._m_strategy_rounds(self._strategy.name).inc()
                 # The new base for next round's sparse deltas, advertised
                 # in every reply. Secure mode tracks it too (harmless), but
                 # delta uploads are refused there (mask streams carry no
-                # sparsity).
+                # sparsity). Under a non-fedavg strategy the base is the
+                # POST-transform global — exactly what clients adopt, so
+                # next round's deltas difference against the right tree.
                 self._last_agg = agg
                 self._last_agg_round = rnd.round_no
                 # agg_crc: the base-agreement contract. Clients only adopt
@@ -2661,6 +2753,15 @@ class AggregationServer:
                     "agg_round": rnd.round_no,
                     "trace": rnd.trace,
                 }
+                if self.reply_via is None:
+                    # Strategy stamp (wire.STRATEGY_META_KEY): which
+                    # strategy produced THIS global, doubling as the
+                    # round-START advert for the next round — a fedprox
+                    # stamp carries the mu clients should anchor their
+                    # local loss with. Plain meta: old clients ignore it.
+                    reply_meta[wire.STRATEGY_META_KEY] = (
+                        self._strategy.describe()
+                    )
                 if rnd.wants_delta and not self.secure_agg:
                     reply_meta["agg_crc"] = wire.flat_crc32(agg)
             if self.stream_chunk_bytes > 0 and not self.secure_agg:
@@ -2827,6 +2928,16 @@ class AggregationServer:
                 extra["adopted"] = sorted(int(i) for i in adopted)
             if subtree_ids:
                 extra["assignment"] = self.last_assignment["groups"]
+            if self.reply_via is None:
+                # Which strategy produced this round's global (+ its
+                # hyperparams): the postmortem flight bundle / obs watch
+                # answer to "what aggregation rule was live here".
+                extra["strategy"] = self._strategy.name
+                s_params = self._strategy.params()
+                if s_params:
+                    extra["strategy_params"] = {
+                        k: s_params[k] for k in sorted(s_params)
+                    }
             self.tracer.record(
                 "agg",
                 t_start=t_agg_unix,

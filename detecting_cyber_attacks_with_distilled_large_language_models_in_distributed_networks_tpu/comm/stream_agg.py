@@ -94,6 +94,13 @@ class StreamAgg:
         #: cids whose upload fully arrived: a fold only counts as
         #: "overlapped" while some member's bytes are still in flight.
         self._complete: set[int] = set()
+        #: Per-client fold stats handed to the round's aggregation
+        #: strategy at finalize (strategies/core.py ``client_stats``):
+        #: cid -> {"weight", "bytes", "scale"}. An entry lives exactly
+        #: as long as the client's intent — ``drop_client`` purges it
+        #: unconditionally (even on the poisoned path) so a dropped
+        #: client can never leak into the strategy's view of the round.
+        self._strategy_stats: dict[int, dict[str, float]] = {}
         # accounting (the obs layer's wire-overlap span + bench headline)
         self._cur_bytes = 0
         self.peak_bytes = 0
@@ -119,6 +126,11 @@ class StreamAgg:
                 "n_samples": float(n_samples),
                 "delta": bool(delta),
                 "dp_crc": dp_crc,
+            }
+            self._strategy_stats[cid] = {
+                "weight": float(n_samples),
+                "bytes": 0.0,
+                "scale": 1.0,
             }
 
     def admit(self, cid: int) -> bool:
@@ -157,10 +169,19 @@ class StreamAgg:
                             f"{len(self._folded)} leaf folds already "
                             "consumed it"
                         )
+                        # The round is dead either way, but the strategy
+                        # view must not keep a ghost contributor: a
+                        # poisoned-round retry reuses nothing, and the
+                        # stats() invariant (strategy stats ⊆ intents)
+                        # holds even on this failure path.
+                        self.intents.pop(cid, None)
+                        self._strategy_stats.pop(cid, None)
+                        self._complete.discard(cid)
                     return False
                 self.fold_ids = None
                 self._weights = None
             self.intents.pop(cid, None)
+            self._strategy_stats.pop(cid, None)
             self._complete.discard(cid)
             for leaves in self._pending.values():
                 arr = leaves.pop(cid, None)
@@ -191,6 +212,8 @@ class StreamAgg:
                     leaves[cid] = np.asarray(
                         leaves[cid], np.float32
                     ) * np.float32(scale)
+            if cid in self._strategy_stats:
+                self._strategy_stats[cid]["scale"] *= float(scale)
             return True
 
     # ------------------------------------------------------------- leaves
@@ -208,6 +231,8 @@ class StreamAgg:
                 self._cur_bytes -= prev.nbytes
             self._pending[key][cid] = arr
             self._cur_bytes += arr.nbytes
+            if cid in self._strategy_stats:
+                self._strategy_stats[cid]["bytes"] += float(arr.nbytes)
             self.peak_bytes = max(self.peak_bytes, self._cur_bytes)
             if self.fold_ids is not None:
                 self._maybe_fold(key)
@@ -226,6 +251,8 @@ class StreamAgg:
                     self._cur_bytes -= prev.nbytes
                 self._pending[key][cid] = arr
                 self._cur_bytes += arr.nbytes
+                if cid in self._strategy_stats:
+                    self._strategy_stats[cid]["bytes"] += float(arr.nbytes)
             self.peak_bytes = max(self.peak_bytes, self._cur_bytes)
             if self.fold_ids is not None:
                 for key in list(self._pending):
@@ -359,8 +386,25 @@ class StreamAgg:
             return dict(sorted(self._acc.items()))
 
     # -------------------------------------------------------------- stats
+    def client_stats(self) -> dict[int, dict[str, float]]:
+        """Per-client fold stats for the round's aggregation strategy
+        (snapshot copy: the strategy must see the round, not a live
+        mutable view)."""
+        with self._lock:
+            return {
+                cid: dict(self._strategy_stats[cid])
+                for cid in sorted(self._strategy_stats)
+            }
+
     def stats(self) -> dict[str, Any]:
         with self._lock:
+            # Invariant (strategies/ PR): a dropped client's strategy
+            # stats entry dies with its intent — a poisoned mid-round
+            # drop must not leave a ghost contributor for the strategy.
+            stale = sorted(set(self._strategy_stats) - set(self.intents))
+            assert not stale, (
+                f"strategy stats leak for dropped clients {stale}"
+            )
             folded = self.early_bytes + self.late_bytes
             return {
                 "peak_bytes": int(self.peak_bytes),

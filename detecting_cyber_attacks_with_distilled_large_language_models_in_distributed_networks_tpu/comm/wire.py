@@ -198,6 +198,15 @@ REHOME_META_KEY = "rehomed"
 #: tree — and refuses a round where two subtrees claim one client (a
 #: re-homed upload double-counted by a surviving old parent).
 SUBTREE_IDS_META_KEY = "subtree_ids"
+#: Strategy stamp (strategies/). On a round REPLY: the
+#: ``{"name", "params"}`` describe() of the strategy that produced this
+#: round's global, doubling as the round-START advert for the next round
+#: (a fedprox advert carries the mu clients should train with). On a
+#: relay's UPWARD upload: the strategy id the relay believes the fleet
+#: runs — the root refuses the round when it mismatches the root's
+#: active strategy (a split-brain fleet folding under two different
+#: aggregation rules). Plain meta: old peers ignore it.
+STRATEGY_META_KEY = "strategy"
 DEFAULT_STREAM_CHUNK = 4 << 20  # 4 MiB: bounds receiver buffering
 #: Worst-case STRC frame bytes beyond the chunk data itself (magic + u64
 #: seq + auth tag). A configured/advertised chunk size must leave this

@@ -224,6 +224,12 @@ class TrainConfig:
     # personalization scope, also usable standalone for linear probing of
     # a pretrained encoder.
     trainable: str = "all"
+    # FedProx proximal term for the TCP-tier client loop (strategies/):
+    # local loss += mu/2 * ||w - w_round_start||^2 against the round's
+    # adopted aggregate. 0 = plain local SGD. The SPMD mesh tier carries
+    # the same knob as FedConfig.prox_mu (train/fedsteps.py); this one
+    # reaches the per-client train-step builders in train/engine.py.
+    prox_mu: float = 0.0
 
     def __post_init__(self) -> None:
         if self.prng_impl not in ("rbg", "threefry2x32", "unsafe_rbg"):
@@ -232,6 +238,8 @@ class TrainConfig:
             raise ValueError(
                 f"trainable={self.trainable!r} must be 'all' or 'head'"
             )
+        if self.prox_mu < 0.0:
+            raise ValueError(f"prox_mu={self.prox_mu} must be >= 0")
 
 
 @dataclass(frozen=True)
@@ -298,8 +306,10 @@ class FedConfig:
     # Server-side optimizer over the round's mean update (FedOpt, Reddi et
     # al.): "none" = plain FedAvg (new global = mean, the reference's
     # algorithm); "momentum" = FedAvgM (heavy-ball over round updates);
-    # "adam" = FedAdam (adaptive per-parameter server steps). Server state
-    # persists across rounds (unlike the per-round client optimizer reset).
+    # "adam" = FedAdam and "yogi" = FedYogi (adaptive per-parameter server
+    # steps; yogi's additive second moment resists the non-IID variance
+    # spikes that swamp adam's EMA). Server state persists across rounds
+    # (unlike the per-round client optimizer reset).
     server_opt: str = "none"
     server_lr: float = 1.0
     server_momentum: float = 0.9
@@ -424,9 +434,10 @@ class FedConfig:
                 "dp_clip > 0 is incompatible with weighted FedAvg: the DP "
                 "sensitivity bound assumes a uniform mean over participants"
             )
-        if self.server_opt not in ("none", "momentum", "adam"):
+        if self.server_opt not in ("none", "momentum", "adam", "yogi"):
             raise ValueError(
-                f"unknown server_opt {self.server_opt!r} (none|momentum|adam)"
+                f"unknown server_opt {self.server_opt!r} "
+                "(none|momentum|adam|yogi)"
             )
         if self.server_lr <= 0.0:
             raise ValueError(f"server_lr={self.server_lr} must be > 0")

@@ -88,6 +88,12 @@ class ScenarioConfig:
     #: Train a tiny real model per client (accuracy column) instead of
     #: synthetic payloads.
     train: bool = False
+    #: Server strategy specs (strategies/, ``NAME[:k=v,...]``) to APPEND
+    #: as extra cells: every persona x partition pair re-runs under each
+    #: non-fedavg spec, with the base cells as the fedavg baseline. The
+    #: default () adds nothing — the matrix shape (and the fast lane's
+    #: cell-count pin) is unchanged unless strategies are asked for.
+    strategies: tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -97,6 +103,9 @@ class CellSpec:
     partition: str  # "iid" | "dirichlet" | "quantity"
     auth: bool = False
     stream: bool = True
+    #: Server aggregation strategy spec for this cell's root
+    #: (strategies/); "fedavg" is the identity baseline.
+    strategy: str = "fedavg"
 
 
 @dataclass
@@ -163,6 +172,28 @@ def build_matrix(cfg: ScenarioConfig) -> list[CellSpec]:
                 auth=True,
             )
         )
+    # Strategy comparison cells: every persona x partition pair re-runs
+    # under each requested non-fedavg strategy. The base cells above ARE
+    # the fedavg arm (identity strategy), so a "fedavg" spec is skipped
+    # rather than duplicated — the comparator reads base vs strategy
+    # cells for the same (persona, partition) key.
+    from ..strategies import parse_strategy
+
+    for spec_str in cfg.strategies:
+        s_name, _ = parse_strategy(spec_str)  # validates early
+        if s_name == "fedavg":
+            continue
+        for p in cfg.personas:
+            for part in cfg.partitions:
+                cells.append(
+                    CellSpec(
+                        name=f"{p}|{part}|{spec_str}",
+                        personas=(p,)
+                        + ("honest",) * (cfg.num_clients - 1),
+                        partition=part,
+                        strategy=spec_str,
+                    )
+                )
     return cells
 
 
@@ -240,6 +271,16 @@ def run_cell(
     round_done = [threading.Event() for _ in range(rounds)]
     client_errors: dict[tuple[int, int], str] = {}
 
+    # The cell's strategy, twice over: the SERVER instance transforms
+    # the live fold at finalize; the REPLAY instance is fed the clean
+    # barrier means in round order, so the crc pin extends to any
+    # strategy — both sides run the identical pure (prev, mean)
+    # transform, and client stats stay telemetry-only by contract.
+    from ..strategies import make_strategy
+
+    replay_strategy = make_strategy(spec.strategy)
+    client_mu = replay_strategy.client_mu()
+
     trainer = None
     shards = eval_split = None
     train_lock = threading.Lock()
@@ -251,7 +292,9 @@ def run_cell(
     # exchangers.
     arm_barriers: list[threading.Barrier] | None = None
     if cfg.train:
-        trainer, shards, eval_split = _build_training(cfg, parts, labels)
+        trainer, shards, eval_split = _build_training(
+            cfg, parts, labels, prox_mu=client_mu
+        )
         arm_barriers = [
             threading.Barrier(
                 1 + sum(
@@ -269,6 +312,7 @@ def run_cell(
         timeout=max(30.0, cfg.deadline_s * 3),
         auth_key=auth_key,
         stream_chunk_bytes=cfg.stream_chunk_bytes if spec.stream else 0,
+        strategy=spec.strategy,
         tracer=Tracer(
             os.path.join(trace_dir, "server.jsonl"), proc="server"
         ),
@@ -418,6 +462,14 @@ def run_cell(
     by_round = {
         b["round"]: b for b in round_summaries(spans) if b["round"] is not None
     }
+    # The replay chain's previous-global: the live server transformed
+    # each successful round's mean against ITS previous post-strategy
+    # global, so the replay feeds refs forward the same way (FedAvg is
+    # the identity and chains trivially). A round without a clean
+    # reference resyncs the chain from the live aggregate — the later
+    # rounds' pins stay meaningful instead of inheriting the gap.
+    replay_strategy.reset()
+    replay_prev: dict | None = None
     for r in range(rounds):
         b = by_round.get(r, {})
         contributors = list(b.get("contributors") or [])
@@ -439,21 +491,25 @@ def run_cell(
             round_wall_s=b.get("round_wall_s"),
         )
         if aggs[r] is not None:
-            out.live_crc = wire.flat_crc32(
-                {
-                    k: np.asarray(v, np.float32)
-                    for k, v in aggs[r].items()
-                }
-            )
+            live = {
+                k: np.asarray(v, np.float32) for k, v in aggs[r].items()
+            }
+            out.live_crc = wire.flat_crc32(live)
             missing = [c for c in contributors if (c, r) not in captured]
             if contributors and not missing:
                 ref = aggregate_flat(
                     [captured[(c, r)][0] for c in contributors],
                     [captured[(c, r)][1] for c in contributors],
                 )
+                # Replay the strategy transform over the clean barrier
+                # mean — fedavg returns it unchanged, so base cells pin
+                # exactly what they always pinned.
+                ref = replay_strategy.apply(replay_prev, ref, round_no=r)
+                replay_prev = ref
                 out.clean_crc = wire.flat_crc32(ref)
                 out.bitexact = out.clean_crc == out.live_crc
             else:
+                replay_prev = live  # resync the chain for later rounds
                 result.notes.append(
                     f"round {r}: no clean reference "
                     f"(contributors {contributors}, missing {missing})"
@@ -472,15 +528,35 @@ def run_cell(
                 batch_size=8,
             )
             result.accuracy = round(float(m["Accuracy"]), 4)
+            # Comparator surface: the final aggregate's held-out
+            # accuracy, labeled by cell and strategy — what the
+            # strategy sweep (and BENCH_MODE=strategy) scrapes to pin
+            # the non-IID lift over the fedavg baseline cells.
+            from ..obs import metrics as obs_metrics
+
+            obs_metrics.default_registry().gauge(
+                "fedtpu_round_accuracy",
+                help="final-aggregate held-out accuracy per scenario "
+                "cell, by server strategy",
+                labels={
+                    "cell": spec.name,
+                    "strategy": replay_strategy.name,
+                },
+            ).set(result.accuracy)
     for (cid, r), err in sorted(client_errors.items()):
         result.notes.append(f"client {cid} round {r}: {err[:160]}")
     return result
 
 
-def _build_training(cfg: ScenarioConfig, parts, labels):
+def _build_training(
+    cfg: ScenarioConfig, parts, labels, prox_mu: float = 0.0
+):
     """Tiny-model training assets for ``train=True`` cells: per-client
     tokenized shards over the partitioned rows + a shared held-out eval
-    split (the accuracy column's denominator)."""
+    split (the accuracy column's denominator). ``prox_mu`` > 0 makes
+    every client run the FedProx local step (train/engine.py) against
+    each round's adopted aggregate — the client half of a fedprox
+    cell."""
     from ..config import ModelConfig, TrainConfig
     from ..data.pipeline import TokenizedSplit
     from ..train.engine import Trainer
@@ -488,7 +564,8 @@ def _build_training(cfg: ScenarioConfig, parts, labels):
     model = ModelConfig.tiny()
     trainer = Trainer(
         model, TrainConfig(learning_rate=1e-3, epochs_per_round=1,
-                           seed=cfg.seed, log_every=0)
+                           seed=cfg.seed, log_every=0,
+                           prox_mu=float(prox_mu))
     )
     rng = np.random.default_rng(cfg.seed + 1)
     L = model.max_len
@@ -756,6 +833,7 @@ def cell_record(res: CellResult) -> dict:
         "personas": list(res.spec.personas),
         "partition": res.spec.partition,
         "auth": res.spec.auth,
+        "strategy": res.spec.strategy,
         "quorum": res.quorum,
         "stream_uploads": res.stream_uploads,
         "accuracy": res.accuracy,
@@ -793,7 +871,8 @@ def comparison_grid(
 
     by_key = {(r.spec.personas[0], r.spec.partition, r.spec.auth): r
               for r in results
-              if not r.spec.name.startswith("dead-relay")}
+              if not r.spec.name.startswith("dead-relay")
+              and r.spec.strategy == "fedavg"}
     parts = list(cfg.partitions)
     width = 34
     lines = [
@@ -815,6 +894,16 @@ def comparison_grid(
                 + f"{res.spec.personas[0]}+auth".ljust(14)
                 + _cell_text(res).ljust(width)
                 + f"({res.spec.partition})"
+            )
+        elif res.spec.strategy != "fedavg":
+            # Strategy comparison rows: same (persona, partition) key as
+            # a base cell above — read down a column to compare against
+            # the fedavg arm's accuracy/crc line.
+            lines.append(
+                "  "
+                + res.spec.personas[0].ljust(14)
+                + _cell_text(res).ljust(width)
+                + f"({res.spec.partition}; strategy {res.spec.strategy})"
             )
         elif res.spec.name.startswith("dead-relay"):
             lines.append(

@@ -88,15 +88,22 @@ def fedavg(
 def make_server_optimizer(fed_cfg) -> "optax.GradientTransformation | None":
     """The FedOpt server optimizer (Reddi et al.): applied to the round's
     mean update at the aggregation boundary. "momentum" = FedAvgM (SGD with
-    heavy-ball momentum over round updates), "adam" = FedAdam. At
-    server_lr=1 with no momentum, the step reduces exactly to plain FedAvg
-    (new global = mean)."""
+    heavy-ball momentum over round updates), "adam" = FedAdam, "yogi" =
+    FedYogi (additive second moment — more stable under the bursty
+    pseudo-gradient variance of non-IID rounds). At server_lr=1 with no
+    momentum, the step reduces exactly to plain FedAvg (new global = mean).
+
+    Shared by the SPMD mesh tier (FederatedTrainer) and the TCP tier's
+    strategy registry (strategies/core.py), which wraps it around the
+    streamed fold's finalize-time mean."""
     import optax
 
     if fed_cfg.server_opt == "momentum":
         return optax.sgd(fed_cfg.server_lr, momentum=fed_cfg.server_momentum)
     if fed_cfg.server_opt == "adam":
         return optax.adam(fed_cfg.server_lr)
+    if fed_cfg.server_opt == "yogi":
+        return optax.yogi(fed_cfg.server_lr)
     return None
 
 

@@ -1,0 +1,37 @@
+"""Pluggable server-side aggregation strategies for the TCP round engine.
+
+The streamed fold (comm/stream_agg.py) stays exactly what it is — raw
+leaves folded in ascending-id order into the bit-exact weighted mean.
+A Strategy is a PURE transform applied once per round at finalize time:
+
+    new_global = strategy.apply(prev_global, folded_mean,
+                                round_no=..., client_stats=...)
+
+``fedavg`` is the identity on the mean, so ``serve --strategy fedavg``
+is bit-identical to the historical fold and every crc replay gate
+(fleet_crc_exact, aggregate_tree) extends unchanged.
+"""
+
+from .core import (
+    STRATEGIES,
+    FedAvg,
+    FedOpt,
+    FedProx,
+    HeadBoost,
+    Momentum,
+    Strategy,
+    make_strategy,
+    parse_strategy,
+)
+
+__all__ = [
+    "STRATEGIES",
+    "FedAvg",
+    "FedOpt",
+    "FedProx",
+    "HeadBoost",
+    "Momentum",
+    "Strategy",
+    "make_strategy",
+    "parse_strategy",
+]

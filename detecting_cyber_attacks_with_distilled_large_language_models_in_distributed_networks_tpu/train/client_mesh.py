@@ -98,9 +98,14 @@ class MeshTrainer(Trainer):
         the eval path — the ONE wrapper shape shared by the replicated
         and FSDP trainers, so batch-placement fixes can't drift apart."""
 
-        def train_step(state, batch):
+        def train_step(state, batch, *extra):
+            # *extra: the FedProx anchor when TrainConfig.prox_mu > 0 —
+            # it is already placed (a copy of live params, so it carries
+            # their sharding); only the batch needs row placement.
             return base_train(
-                state, shard_rows(batch, self.batch_sharding, self.replicated)
+                state,
+                shard_rows(batch, self.batch_sharding, self.replicated),
+                *extra,
             )
 
         def eval_step(params, batch, valid):
@@ -360,6 +365,7 @@ def _fsdp_steps(model_cfg: ModelConfig, key_cfg: TrainConfig, mesh):
             model,
             optimizer,
             key_cfg.warmup_steps,
+            prox_mu=key_cfg.prox_mu,
             gather=gather,
             constrain=constrain,
         ),

@@ -216,6 +216,7 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     warmup_steps: int = 0,
     *,
+    prox_mu: float = 0.0,
     gather: Callable | None = None,
     constrain: Callable | None = None,
     site: str = "engine.train_step",
@@ -228,7 +229,16 @@ def make_train_step(
     backward re-gathers; constrain reduce-scatters grads and pins the
     updated params/opt leaves back onto their shards. None/None (the
     default) is the literal replicated step — ONE update-math
-    implementation, the replicated/FSDP trajectories can't drift."""
+    implementation, the replicated/FSDP trajectories can't drift.
+
+    ``prox_mu > 0`` is the FedProx client step (strategies/ fedprox):
+    the returned callable takes ``(state, batch, anchor)`` and adds
+    ``mu/2 * ||p - anchor||^2`` (:func:`prox_sq`) to the loss — on the
+    RAW (possibly shard-at-rest) params outside the remat region, so
+    its gradient ``mu * (p - anchor)`` needs no gather and inherits the
+    params' sharding, composing with ``--fsdp`` for free. The anchor is
+    a call argument, not a closure: it changes every round and must not
+    retrace."""
     ledger = default_ledger()
     note_compile = ledger.hook(site)
     if gather is not None:
@@ -240,6 +250,41 @@ def make_train_step(
         def loss_rm(p, batch, step_rng):
             return loss_fn(model, p, batch, step_rng)
 
+    def _apply_grads(state, loss, grads):
+        # The ONE update tail (constrain -> optimizer -> warmup -> apply)
+        # shared by the plain and prox entries — the update math cannot
+        # drift between them.
+        if constrain is not None:
+            grads = constrain(grads)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        updates = apply_warmup(updates, state.step, warmup_steps)
+        params = optax.apply_updates(state.params, updates)
+        if constrain is not None:
+            params, opt_state = constrain(params), constrain(opt_state)
+        return TrainState(params, opt_state, state.step + 1, state.rng), loss
+
+    if prox_mu > 0.0:
+        mu = float(prox_mu)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def train_step_prox(
+            state: TrainState, batch, anchor
+        ) -> tuple[TrainState, jnp.ndarray]:
+            note_compile(tuple(batch["input_ids"].shape))
+            step_rng = jax.random.fold_in(state.rng, state.step)
+
+            def prox_loss(p, batch, step_rng):
+                return loss_rm(p, batch, step_rng) + 0.5 * mu * prox_sq(
+                    p, anchor
+                )
+
+            loss, grads = jax.value_and_grad(prox_loss)(
+                state.params, batch, step_rng
+            )
+            return _apply_grads(state, loss, grads)
+
+        return ledger.timed(site, train_step_prox)
+
     @partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, batch) -> tuple[TrainState, jnp.ndarray]:
         # Compile-ledger trace hook (obs/profile.py): this body runs once
@@ -249,14 +294,7 @@ def make_train_step(
         loss, grads = jax.value_and_grad(loss_rm)(
             state.params, batch, step_rng
         )
-        if constrain is not None:
-            grads = constrain(grads)
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-        updates = apply_warmup(updates, state.step, warmup_steps)
-        params = optax.apply_updates(state.params, updates)
-        if constrain is not None:
-            params, opt_state = constrain(params), constrain(opt_state)
-        return TrainState(params, opt_state, state.step + 1, state.rng), loss
+        return _apply_grads(state, loss, grads)
 
     return ledger.timed(site, train_step)
 
@@ -355,6 +393,7 @@ def make_fsdp_train_step(
     optimizer: optax.GradientTransformation,
     warmup_steps: int,
     *,
+    prox_mu: float = 0.0,
     gather: Callable,
     constrain: Callable,
     site: str = "engine.fsdp_train_step",
@@ -381,6 +420,7 @@ def make_fsdp_train_step(
         model,
         optimizer,
         warmup_steps,
+        prox_mu=prox_mu,
         gather=gather,
         constrain=constrain,
         site=site,
@@ -411,7 +451,12 @@ def _cached_engine_steps(model_cfg: ModelConfig, train_cfg: TrainConfig):
     return (
         model,
         optimizer,
-        make_train_step(model, optimizer, warmup_steps=train_cfg.warmup_steps),
+        make_train_step(
+            model,
+            optimizer,
+            warmup_steps=train_cfg.warmup_steps,
+            prox_mu=train_cfg.prox_mu,
+        ),
         make_eval_step(model),
     )
 
@@ -467,6 +512,12 @@ class Trainer:
         self.model, self.optimizer, self.train_step, self.eval_step = (
             _engine_steps(model_cfg, train_cfg)
         )
+        # FedProx anchor (train_cfg.prox_mu > 0): the round-start params
+        # the proximal term pulls toward — the last adopted aggregate,
+        # or the fit-entry params before any round completed. Fresh
+        # buffers always (jnp.copy): the train step donates the state,
+        # so an aliased anchor would be invalidated mid-epoch.
+        self._prox_anchor = None
         # Step-time attribution (obs/profile.py): None unless profiling
         # is armed process-wide (--profile-stride / ObsConfig) — the hot
         # loop then runs the literal pre-profiling path. Re-checked at
@@ -524,8 +575,21 @@ class Trainer:
         single shared implementation for the plain and meshed TCP clients
         — ``init_state`` places the aggregate, so a meshed subclass
         scatters it straight onto its device mesh with no intermediate
-        full-replica state."""
-        return adopt_aggregate_with_fresh_opt(self, state, aggregated)
+        full-replica state. Under FedProx the adopted aggregate IS the
+        next round's proximal anchor (w_round_start)."""
+        state = adopt_aggregate_with_fresh_opt(self, state, aggregated)
+        if self.train_cfg.prox_mu > 0.0:
+            self._prox_anchor = jax.tree.map(jnp.copy, state.params)
+        return state
+
+    def _round_anchor(self, state: TrainState) -> Any:
+        """The FedProx anchor for this fit: the last adopted aggregate,
+        or (first round — no aggregate exists yet) a copy of the
+        fit-entry params, for which the proximal term starts at zero
+        exactly as FedProx prescribes."""
+        if self._prox_anchor is None:
+            self._prox_anchor = jax.tree.map(jnp.copy, state.params)
+        return self._prox_anchor
 
     def epoch_batches(
         self, split: TokenizedSplit, epoch: int, batch_size: int
@@ -605,10 +669,20 @@ class Trainer:
         order across repeated fit() calls (e.g. pass ``round * E`` from a
         multi-round driver); without it every round would replay the same
         batch permutations."""
+        step_fn = self.train_step
+        if self.train_cfg.prox_mu > 0.0:
+            # FedProx: the prox-variant step takes the round anchor as a
+            # third argument (same jitted program across rounds — the
+            # anchor is data, not a closure constant).
+            anchor = self._round_anchor(state)
+
+            def step_fn(s, b, _step=self.train_step, _a=anchor):
+                return _step(s, b, _a)
+
         return self._fit_loop(
             state,
             split,
-            self.train_step,
+            step_fn,
             batch_size=batch_size,
             epochs=epochs,
             epoch_offset=epoch_offset,

@@ -287,6 +287,7 @@ def test_registry_shadow_pointer_lifecycle(tmp_path):
 
 
 # ----------------------------------------------------------- live mirroring
+@pytest.mark.slow
 def test_mirror_pairs_bit_exact_live(tiny_setup, tmp_path):
     """Router + incumbent replica + shadow replica on DIFFERENT params:
     every mirrored pair's serving side equals the reply the live client
@@ -364,6 +365,7 @@ def test_mirror_pairs_bit_exact_live(tiny_setup, tmp_path):
         shadow_rep.close()
 
 
+@pytest.mark.slow
 def test_mirror_full_queue_drops_copy_not_live_reply(tiny_setup):
     """A shadow backend that accepts but never answers + a 1-slot mirror
     queue: live replies keep flowing at full speed, dropped mirror
@@ -427,6 +429,7 @@ def test_mirror_full_queue_drops_copy_not_live_reply(tiny_setup):
                 pass
 
 
+@pytest.mark.slow
 def test_mirror_dead_shadow_is_pass_through(tiny_setup):
     """A shadow backend that refuses connections entirely: live scoring
     is untouched, errors are counted, nothing raises on the hot path."""
@@ -484,6 +487,7 @@ def test_mirror_sample_stride_is_deterministic(tiny_setup):
 
 
 # -------------------------------------------- fleet lifecycle + gated e2e
+@pytest.mark.slow
 def test_fleet_shadow_gate_promotes_and_rejects_e2e(tiny_setup, tmp_path):
     """The acceptance-shaped flow: an agreeing candidate enters shadow,
     accumulates live pairs under load, passes the gate, and promotes
@@ -761,6 +765,7 @@ def test_router_reload_replica_drives_out_of_process_adoption(
         server.close()
 
 
+@pytest.mark.slow
 def test_in_process_rolling_reload_sends_no_reload_frames(
     tiny_setup, tmp_path
 ):
