@@ -1402,6 +1402,354 @@ def bench_fleet() -> dict | None:
     return record
 
 
+def _wire_fleet_arm(
+    *,
+    wire_dtype: str = "fp32",
+    upward_topk: float | None = None,
+    n_clients: int = 64,
+    n_relays: int = 8,
+    rounds: int = 2,
+    param_mb: float = 1.0,
+) -> dict:
+    """One wire-efficiency A/B arm: a live loopback depth-2 tree
+    (bench_fleet's shape) driven ROUND-BY-ROUND so per-round byte counts
+    are exact — clients all land round r before round r+1 starts.
+    Returns walls, per-round client-upload and relay-upward bytes, the
+    final replies/root aggregate, and the inputs the caller replays."""
+    import threading as _threading
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        AggregationServer,
+        FederatedClient,
+        RelayAggregator,
+    )
+
+    per = max(1, n_clients // n_relays)
+    n_clients = per * n_relays
+    n_leaves = 16
+    leaf_elems = max(1, int(param_mb * 1e6 / 4 / n_leaves))
+    rng = np.random.default_rng(0)
+    base = {
+        f"w{i:02d}": rng.normal(size=leaf_elems).astype(np.float32)
+        for i in range(n_leaves)
+    }
+    chunk = max(64 << 10, int(param_mb * (1 << 20)) // 8)
+    groups = [list(range(r * per, (r + 1) * per)) for r in range(n_relays)]
+    uploads = [
+        {k: v + np.float32(0.001 * (cid + 1)) for k, v in base.items()}
+        for cid in range(n_clients)
+    ]
+    errors: list[Exception] = []
+    root_aggs: list[dict] = []
+    replies: dict[int, dict] = {}
+    round_walls: list[float] = []
+    up_bytes_by_round: list[int] = []
+    client_bytes_by_round: list[int] = []
+    with AggregationServer(
+        port=0, num_clients=n_relays, weighted=True, timeout=120,
+        stream_chunk_bytes=chunk,
+    ) as root:
+        relays = [
+            RelayAggregator(
+                "127.0.0.1", 0, parent_host="127.0.0.1",
+                parent_port=root.port, relay_id=r, num_clients=per,
+                timeout=120, stream_chunk_bytes=chunk,
+                upward_topk=upward_topk,
+            )
+            for r in range(n_relays)
+        ]
+        try:
+            def root_loop():
+                for _ in range(rounds):
+                    try:
+                        root_aggs.append(root.serve_round())
+                    except RuntimeError as e:
+                        errors.append(e)
+
+            rt = _threading.Thread(target=root_loop, daemon=True)
+            rt.start()
+            for rel in relays:
+                _threading.Thread(
+                    target=rel.serve, args=(rounds,), daemon=True
+                ).start()
+            clients = [
+                FederatedClient(
+                    "127.0.0.1", relays[cid // per].port,
+                    client_id=cid, timeout=120, wire_dtype=wire_dtype,
+                )
+                for cid in range(n_clients)
+            ]
+
+            def one(cid: int) -> None:
+                try:
+                    replies[cid] = clients[cid].exchange(uploads[cid])
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            up_prev = 0
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                ths = [
+                    _threading.Thread(target=one, args=(c,), daemon=True)
+                    for c in range(n_clients)
+                ]
+                for t in ths:
+                    t.start()
+                for t in ths:
+                    t.join(timeout=240)
+                round_walls.append(time.perf_counter() - t0)
+                up_now = sum(rel.upward_bytes for rel in relays)
+                up_bytes_by_round.append(up_now - up_prev)
+                up_prev = up_now
+                client_bytes_by_round.append(
+                    sum(c.last_upload_bytes for c in clients)
+                )
+            rt.join(timeout=60)
+        finally:
+            for rel in relays:
+                rel.close()
+    return {
+        "errors": errors,
+        "uploads": uploads,
+        "groups": groups,
+        "root_aggs": root_aggs,
+        "replies": replies,
+        "round_walls": round_walls,
+        "up_bytes_by_round": up_bytes_by_round,
+        "client_bytes_by_round": client_bytes_by_round,
+        "last_wire_dtypes": {c.client_id: c.last_wire_dtype for c in clients},
+        "fold_engine": root.stream_totals.get("fold_engine", ""),
+        "n_clients": n_clients,
+        "n_relays": n_relays,
+    }
+
+
+def _wire_fold_ab(
+    k: int = 8, elems: int | None = None, reps: int = 3
+) -> dict:
+    """Compiled-vs-naive fold A/B in the out-of-cache regime the blocked
+    engine exists for: K leaves large enough that the K-leaf working set
+    exceeds the host's last-level cache. Best-of-reps per engine; both
+    engines' outputs are asserted bit-identical (the crc contract)."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.ops import (
+        fold,
+    )
+
+    elems = elems or int(os.environ.get("BENCH_WIRE_FOLD_ELEMS", str(1 << 24)))
+    rng = np.random.default_rng(0)
+    leaves = [
+        rng.normal(size=elems).astype(np.float32) for _ in range(k)
+    ]
+    weights = [np.float32(1.0 / k)] * k
+    folded_bytes = 4 * k * elems
+
+    def best(engine: str) -> tuple[float, np.ndarray]:
+        t_best, out = float("inf"), None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fold.fold_ordered(leaves, weights, engine=engine)
+            t_best = min(t_best, time.perf_counter() - t0)
+        return t_best, out
+
+    t_naive, out_naive = best("naive")
+    engine = fold.engine_name() if fold.engine_name() != "naive" else "blocked"
+    t_fast, out_fast = best(engine)
+    bit_exact = bool(np.array_equal(out_naive, out_fast))
+    return {
+        "fold_engine": engine,
+        "fold_throughput_gbps": round(folded_bytes / t_fast / 1e9, 3),
+        "fold_naive_gbps": round(folded_bytes / t_naive / 1e9, 3),
+        "fold_speedup": round(t_naive / t_fast, 3),
+        "fold_bit_exact": 1.0 if bit_exact else 0.0,
+        "fold_k": k,
+        "fold_elems": elems,
+    }
+
+
+def bench_wire() -> dict:
+    """Wire efficiency (ISSUE 17): three live loopback fleet arms at 64
+    clients / 8 relays — fp32-dense (today's wire, asserted bit-identical
+    to aggregate_tree), int8-streamed (negotiated quantized uploads,
+    crc-pinned against the deterministic dequantization replay), and
+    sparse-upward (relays diff their subtree partial against the last
+    root aggregate and send topk deltas up) — plus a compiled-vs-numpy
+    fold A/B in the out-of-cache regime. Headline fields (asserted
+    present by the train-mode headline, exit 3):
+    ``relay_upward_bytes`` — the sparse arm's round-2 relay-to-root hop
+    bytes — ``fold_throughput_gbps`` — the batched fold engine's rate —
+    and ``wire_round_cadence_ratio`` — fp32 round wall over int8 round
+    wall at equal fleet shape. Gates: >= 3x upload-byte reduction (int8
+    vs fp32), >= 3x upward-hop reduction (sparse vs dense), >= 2x fold
+    speedup, and every arm crc-exact."""
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm import (
+        aggregate_tree,
+        wire,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.comm.quant import (
+        dequantize_int8c,
+        quantize_int8c,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.ops import (
+        fold,
+    )
+
+    n_clients = int(os.environ.get("BENCH_WIRE_CLIENTS", "64"))
+    n_relays = int(os.environ.get("BENCH_WIRE_RELAYS", "8"))
+    param_mb = float(os.environ.get("BENCH_WIRE_PARAM_MB", "1"))
+    topk = float(os.environ.get("BENCH_WIRE_TOPK", "0.05"))
+    try:
+        arm_fp32 = _wire_fleet_arm(
+            wire_dtype="fp32", n_clients=n_clients, n_relays=n_relays,
+            param_mb=param_mb,
+        )
+        arm_int8 = _wire_fleet_arm(
+            wire_dtype="int8", n_clients=n_clients, n_relays=n_relays,
+            param_mb=param_mb,
+        )
+        arm_sparse = _wire_fleet_arm(
+            upward_topk=topk, n_clients=n_clients, n_relays=n_relays,
+            param_mb=param_mb,
+        )
+    except Exception as e:  # noqa: BLE001 - one parseable line, not a dump
+        record = {
+            "metric": "bench_error",
+            "error": "wire_arm_failed",
+            "detail": str(e)[:300],
+        }
+        _emit(record)
+        return record
+    for name, arm in (
+        ("fp32", arm_fp32), ("int8", arm_int8), ("sparse", arm_sparse)
+    ):
+        if arm["errors"] or len(arm["root_aggs"]) < 2 or (
+            len(arm["replies"]) < arm["n_clients"]
+        ):
+            record = {
+                "metric": "bench_error",
+                "error": f"wire_{name}_arm_failed",
+                "detail": (
+                    str(arm["errors"][0])[:300]
+                    if arm["errors"]
+                    else f"{len(arm['root_aggs'])}/2 rounds, "
+                    f"{len(arm['replies'])}/{arm['n_clients']} clients"
+                ),
+            }
+            _emit(record)
+            return record
+
+    # fp32 arm: bit-identical to today's fold — the aggregate_tree
+    # replay of the raw uploads, the exact PR 5/6 contract.
+    want_fp32 = aggregate_tree(arm_fp32["uploads"], None, arm_fp32["groups"])
+    crc_fp32 = wire.flat_crc32(want_fp32)
+    fp32_ok = wire.flat_crc32(arm_fp32["root_aggs"][-1]) == crc_fp32 and all(
+        wire.flat_crc32(r) == crc_fp32 for r in arm_fp32["replies"].values()
+    )
+    # int8 arm round 2: every client upgraded (round 1 carried the
+    # advert) and the fold equals the deterministic dequantization
+    # replay — fleet_crc_exact extends to quantized rounds.
+    int8_upgraded = all(
+        d == "int8" for d in arm_int8["last_wire_dtypes"].values()
+    )
+    rt_uploads = [
+        {
+            k: dequantize_int8c(quantize_int8c(v), v.shape)
+            for k, v in up.items()
+        }
+        for up in arm_int8["uploads"]
+    ]
+    crc_int8 = wire.flat_crc32(
+        aggregate_tree(rt_uploads, None, arm_int8["groups"])
+    )
+    int8_ok = int8_upgraded and wire.flat_crc32(
+        arm_int8["root_aggs"][-1]
+    ) == crc_int8
+    # Sparse arm round 2: every relay sent topk(partial - base); the
+    # root reconstructed base + densify per relay and folded by mass.
+    # Replay with the same fold arithmetic (uniform subtrees: the
+    # normalized weight is exactly 1/n_relays in fp32).
+    base_agg = arm_sparse["root_aggs"][0]
+    partials = [
+        aggregate_tree(
+            [arm_sparse["uploads"][c] for c in g], None, [list(range(len(g)))]
+        )
+        for g in arm_sparse["groups"]
+    ]
+    w_r = [np.float32(1.0 / len(partials))] * len(partials)
+    expected_sparse = {}
+    for key in sorted(base_agg):
+        b = np.asarray(base_agg[key], np.float32)
+        recon = []
+        for p in partials:
+            d = np.asarray(p[key], np.float32) - b
+            recon.append(
+                b + wire.densify_topk(wire.sparsify_topk(d, topk), d.shape)
+            )
+        expected_sparse[key] = fold.fold_ordered(recon, w_r)
+    sparse_ok = wire.flat_crc32(arm_sparse["root_aggs"][-1]) == (
+        wire.flat_crc32(expected_sparse)
+    )
+
+    upload_fp32 = arm_fp32["client_bytes_by_round"][-1]
+    upload_int8 = arm_int8["client_bytes_by_round"][-1]
+    upload_reduction = upload_fp32 / max(1, upload_int8)
+    up_dense = arm_fp32["up_bytes_by_round"][-1]
+    up_sparse = arm_sparse["up_bytes_by_round"][-1]
+    upward_reduction = up_dense / max(1, up_sparse)
+    cadence = arm_fp32["round_walls"][-1] / max(
+        1e-9, arm_int8["round_walls"][-1]
+    )
+    fold_ab = _wire_fold_ab()
+    record = {
+        "metric": f"wire_upload_reduction_int8_vs_fp32_c{n_clients}",
+        "value": round(upload_reduction, 2),
+        "unit": "x",
+        "vs_baseline": round(upload_reduction, 2),
+        "baseline_note": "round-2 client upload bytes, fp32-dense arm "
+        "over int8-streamed arm at equal fleet shape",
+        "wire_dtype": "int8",
+        "wire_upload_bytes_fp32": int(upload_fp32),
+        "wire_upload_bytes_int8": int(upload_int8),
+        "wire_upload_reduction": round(upload_reduction, 2),
+        "relay_upward_bytes": int(up_sparse),
+        "relay_upward_bytes_dense": int(up_dense),
+        "relay_upward_reduction": round(upward_reduction, 2),
+        "wire_round_cadence_ratio": round(cadence, 3),
+        "wire_crc_exact": 1.0 if (fp32_ok and int8_ok and sparse_ok) else 0.0,
+        "fleet_crc_exact": 1.0 if fp32_ok else 0.0,
+        "wire_fp32_bit_identical": 1.0 if fp32_ok else 0.0,
+        "wire_int8_upgraded_frac": (
+            sum(
+                1
+                for d in arm_int8["last_wire_dtypes"].values()
+                if d == "int8"
+            )
+            / arm_int8["n_clients"]
+        ),
+        "upward_topk": topk,
+        "fleet_clients": n_clients,
+        "fleet_relays": n_relays,
+        "param_mb": param_mb,
+        **fold_ab,
+    }
+    _emit(record)
+    return record
+
+
+def _wire_broken(rec: dict) -> bool:
+    """The wire-efficiency acceptance gates (exit 3): >= 3x upload-byte
+    reduction, >= 3x sparse upward-hop reduction, >= 2x fold speedup in
+    the out-of-cache regime, every arm crc-exact, and the fold engines
+    bit-identical."""
+    return (
+        rec.get("wire_crc_exact", 0.0) < 1.0
+        or rec.get("fleet_crc_exact", 0.0) < 1.0
+        or rec.get("wire_upload_reduction", 0.0) < 3.0
+        or rec.get("relay_upward_reduction", 0.0) < 3.0
+        or rec.get("fold_speedup", 0.0) < 2.0
+        or rec.get("fold_bit_exact", 0.0) < 1.0
+    )
+
+
 def _router_worker(spec_json: str) -> None:
     """One serving-tier subprocess for bench_router's A/B arms — a
     scorer replica (``role: "replica"``) or the router itself
@@ -2428,7 +2776,7 @@ MODES = (
     "train", "bert", "bertlarge", "eval", "fedavg", "flash", "ring",
     "fed2", "fedseq", "serve", "clientdp", "controller", "scenario",
     "fleet", "check", "router", "obs", "profile", "shadow", "fsdp",
-    "strategy",
+    "strategy", "wire",
 )
 
 
@@ -3117,6 +3465,15 @@ def main() -> None:
         ):
             raise SystemExit(3)
         return
+    if mode == "wire":
+        # numpy + loopback sockets only: no accelerator, no preflight.
+        # The wire-efficiency acceptance: >= 3x int8 upload reduction,
+        # >= 3x sparse upward-hop reduction, >= 2x fold speedup, every
+        # arm crc-exact — anything less exits 3.
+        rec = bench_wire()
+        if rec.get("metric") == "bench_error" or _wire_broken(rec):
+            raise SystemExit(3)
+        return
     if (mode == "clientdp" and os.environ.get("BENCH_CLIENTDP_FORCE_CPU")) or (
         mode == "fsdp" and os.environ.get("BENCH_FSDP_FORCE_CPU")
     ):
@@ -3154,7 +3511,7 @@ def main() -> None:
             # restores the single-line behavior.
             rec_fed2 = rec_fedseq = rec_ctrl = rec_resid = rec_scn = None
             rec_fleet = rec_check = rec_router = rec_obs = None
-            rec_profile = rec_shadow = rec_fsdp = None
+            rec_profile = rec_shadow = rec_fsdp = rec_wire = None
             if os.environ.get("BENCH_SECONDARY", "1").lower() not in (
                 "", "0", "false",
             ):
@@ -3170,6 +3527,7 @@ def main() -> None:
                 rec_ctrl = bench_controller()
                 rec_scn = bench_scenario()
                 rec_fleet = bench_fleet()
+                rec_wire = bench_wire()
                 rec_router = bench_router()
                 rec_shadow = bench_shadow()
                 rec_obs = bench_obs()
@@ -3312,6 +3670,50 @@ def main() -> None:
                     rec_fleet["fleet_crc_exact"] < 1.0
                     or rec_fleet["fleet_degraded_rounds_ok"] < 1.0
                 )
+            wire_broken_flag = False
+            if rec_wire is not None and (
+                rec_wire.get("metric") != "bench_error"
+            ):
+                # Wire-efficiency headline fields (ISSUE 17): ASSERTED
+                # present — a refactor that drops the upward-byte
+                # counter, the fold-throughput accounting, or the
+                # quantized-round crc replay must fail the bench loudly
+                # — with the int8 and sparse reductions, the fold
+                # speedup, and every arm's crc gated exactly like a crc
+                # mismatch (exit 3).
+                missing = [
+                    k
+                    for k in (
+                        "relay_upward_bytes",
+                        "fold_throughput_gbps",
+                        "wire_round_cadence_ratio",
+                        "wire_dtype",
+                    )
+                    if k not in rec_wire
+                ]
+                if missing:
+                    _emit(
+                        {
+                            "metric": "bench_error",
+                            "error": "wire_fields_missing",
+                            "detail": f"wire record lacks {missing} "
+                            "(relay upward_bytes / StreamAgg fold "
+                            "accounting broken?)",
+                        }
+                    )
+                    raise SystemExit(3)
+                for k in (
+                    "relay_upward_bytes",
+                    "relay_upward_reduction",
+                    "wire_upload_reduction",
+                    "fold_throughput_gbps",
+                    "fold_speedup",
+                    "wire_round_cadence_ratio",
+                    "wire_crc_exact",
+                ):
+                    if k in rec_wire:
+                        extra[k] = rec_wire[k]
+                wire_broken_flag = _wire_broken(rec_wire)
             router_broken = False
             if rec_router is not None and (
                 rec_router.get("metric") != "bench_error"
@@ -3556,6 +3958,7 @@ def main() -> None:
                 broken
                 or scenario_broken
                 or fleet_broken
+                or wire_broken_flag
                 or router_broken
                 or shadow_gate_broken
                 or obs_broken

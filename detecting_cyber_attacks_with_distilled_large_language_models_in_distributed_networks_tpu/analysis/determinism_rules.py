@@ -55,6 +55,12 @@ SCOPE = (
     # Server aggregation strategies transform every round's global —
     # any nondeterminism here breaks the crc replay gate directly.
     "strategies/",
+    # Wire-efficiency tier (ISSUE 17): the int8c quantize/dequant codec
+    # and the batched fold engines both sit INSIDE the crc contract —
+    # dequantization must replay bit-exactly and every fold engine must
+    # match the ascending-id numpy accumulation bit-for-bit.
+    "comm/quant.py",
+    "ops/fold.py",
 )
 
 _SEEDED_NP_CTORS = frozenset(

@@ -164,6 +164,7 @@ def cmd_serve(args) -> int:
         tracer=tracer,
         stream_chunk_bytes=stream_chunk_bytes,
         strategy=getattr(args, "strategy", None),
+        strategy_state_path=getattr(args, "strategy_state_file", None),
     ) as server:
         log.info(f"[SERVER] listening on {args.host}:{server.port}")
         server.serve(rounds=rounds)
@@ -206,6 +207,7 @@ def cmd_relay(args) -> int:
         ),
         tracer=tracer,
         strategy=getattr(args, "strategy", "fedavg") or "fedavg",
+        upward_topk=getattr(args, "upward_topk", None),
     ) as relay:
         log.info(
             f"[RELAY {args.relay_id}] listening on {args.host}:{relay.port}"
@@ -346,6 +348,7 @@ def cmd_client(args) -> int:
         # surface FederatedClient's validation error, not silently
         # become the default.
         rehome_dial_budget=getattr(args, "rehome_dial_budget", 8.0),
+        wire_dtype=getattr(args, "wire_dtype", "fp32") or "fp32",
     )
     sink = getattr(trainer, "reply_leaf_sink", None)
     if sink is not None:

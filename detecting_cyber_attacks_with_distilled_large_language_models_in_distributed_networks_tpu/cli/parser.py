@@ -470,6 +470,17 @@ def build_parser() -> argparse.ArgumentParser:
         "costs no privacy",
     )
     p.add_argument(
+        "--strategy-state-file",
+        default=None,
+        help="persist the server's last post-strategy global and the "
+        "strategy's optimizer state (FedOpt/momentum memory) to this "
+        "npz file after every round and reload it on startup — a "
+        "restarted server resumes its optimizer trajectory (and keeps "
+        "sparse-delta clients' base) instead of re-adopting the bare "
+        "mean. Ignored when the persisted strategy differs from "
+        "--strategy",
+    )
+    p.add_argument(
         "--strategy",
         default=None,
         help="server aggregation strategy applied to the folded mean at "
@@ -566,6 +577,18 @@ def build_parser() -> argparse.ArgumentParser:
         "(0 = off, the default)",
     )
     p.add_argument(
+        "--upward-topk",
+        type=float,
+        default=None,
+        help="sparsify the UPWARD hop: after round 1, the relay uploads "
+        "topk deltas of its subtree partial against the last root "
+        "aggregate it fanned down (error feedback carries the dropped "
+        "mass), even when its leaves upload dense — upward bytes drop "
+        "superlinearly with tree depth. Needs the root on lossless "
+        "reply compression (base agreement is crc-pinned); value is "
+        "the kept fraction, e.g. 0.01",
+    )
+    p.add_argument(
         "--strategy",
         default="fedavg",
         help="strategy id this relay declares on every upward upload "
@@ -645,6 +668,19 @@ def build_parser() -> argparse.ArgumentParser:
         "the exchange to sparse round deltas with client-side error "
         "feedback (~50x smaller uploads at the default frac 0.01 after "
         "the first, dense round)",
+    )
+    p.add_argument(
+        "--wire-dtype",
+        choices=["fp32", "bf16", "int8"],
+        default="fp32",
+        help="quantize STREAMED upload chunks to this dtype when the "
+        "server advertises support (negotiated via reply meta, like "
+        "--stream-chunk-mb: round 1 goes fp32, later rounds upgrade). "
+        "int8 carries a per-4096-element fp32 scale and cuts upload "
+        "bytes ~3.98x; an old server keeps getting fp32. Refused "
+        "alongside --secure-agg or --compression (the masked/sparse "
+        "paths have their own encodings); composes with --dp — the "
+        "server re-clips after dequantization",
     )
     p.add_argument(
         "--secure-agg",

@@ -166,7 +166,52 @@ class FederatedClient:
         stream: bool = True,
         fallback_parents: list[tuple[str, int]] | None = None,
         rehome_dial_budget: float = 8.0,
+        wire_dtype: str = "fp32",
     ):
+        # Quantized streamed uploads (--wire-dtype): encode streamed
+        # leaves as bf16 or per-chunk-scaled int8 (comm/quant.py) once
+        # the server's reply meta advertises it accepts that encoding.
+        # Streaming-path only: dense fallbacks/retries always ship fp32
+        # (always correct against any peer), so the knob composes with
+        # nothing that already owns the upload encoding — an explicit
+        # --compression (lossy dense or topk sparse deltas) or masked
+        # secure-agg uploads refuse it rather than silently stacking
+        # two lossy transforms. DP composes: the server holds a lossy
+        # streamed DP upload whole and re-clips it before folding
+        # (comm/server.py dp containment), so quantization can never
+        # widen the mechanism's sensitivity.
+        wire_dtype = str(wire_dtype)
+        if wire_dtype not in wire.WIRE_DTYPE_ENCS:
+            raise ValueError(
+                f"wire_dtype {wire_dtype!r} must be "
+                f"{'|'.join(sorted(wire.WIRE_DTYPE_ENCS))}"
+            )
+        if wire_dtype != "fp32":
+            if secure_agg:
+                raise ValueError(
+                    "wire_dtype quantization is incompatible with secure "
+                    "aggregation: masked uploads are uniform ring "
+                    "elements — quantizing them destroys mask "
+                    "cancellation"
+                )
+            if compression != "none":
+                raise ValueError(
+                    f"wire_dtype={wire_dtype} needs compression='none': "
+                    "the upload encoding is owned by one knob — lossy "
+                    "dense compression would stack two quantizers, and "
+                    "sparse topk deltas are single-frame (never "
+                    "streamed)"
+                )
+        self.wire_dtype = wire_dtype
+        #: Encodings the server's last reply advertised it accepts for
+        #: streamed leaves (wire.WIRE_DTYPE_META_KEY) — the negotiation
+        #: state, one reply behind like the stream-chunk advert. Empty
+        #: against an old peer, so uploads stay fp32 (interop).
+        self._server_wire_dtypes: tuple[str, ...] = ()
+        #: What the last completed upload actually shipped (telemetry +
+        #: the relay-forward span stamp).
+        self.last_wire_dtype = "fp32"
+        self.last_upload_bytes = 0
         if fallback_parents and (secure_agg or dp):
             # A secure-agg session is keyed to ONE server's (session,
             # round) advert and central DP to one server's resync
@@ -869,19 +914,38 @@ class FederatedClient:
                             if stream_flat is not None
                             else wire.flatten_lazy(upload)
                         )
+                        # Negotiated quantization (--wire-dtype): upgrade
+                        # the stream's leaf encoding only when the
+                        # server's last reply advertised it decodes this
+                        # encoding; old peers never advertise, so they
+                        # keep receiving fp32. The meta stamp lets the
+                        # server label the round's uploads by wire dtype.
+                        stream_compression = attempt_compression
+                        used_dtype = "fp32"
+                        enc = wire.WIRE_DTYPE_ENCS[self.wire_dtype]
+                        if (
+                            self.wire_dtype != "fp32"
+                            and enc in self._server_wire_dtypes
+                        ):
+                            stream_compression = enc
+                            used_dtype = self.wire_dtype
+                            attempt_meta["wire_dtype"] = self.wire_dtype
                         t_up_unix = time.time()
                         t_up0 = time.monotonic()
                         upload_started = (t_up_unix, t_up0, 0)
                         sent, chunks, overlap_s, wire_attrs = (
                             self._stream_upload(
                                 sock, up_flat, attempt_meta,
-                                attempt_compression, nonce_hex,
+                                stream_compression, nonce_hex,
                             )
                         )
+                        self.last_wire_dtype = used_dtype
+                        self.last_upload_bytes = sent
                         upload_timing = (
                             t_up_unix, time.monotonic() - t_up0, sent,
                             {"chunks": chunks,
                              "overlap_s": round(overlap_s, 6),
+                             "wire_dtype": used_dtype,
                              **wire_attrs},
                         )
                     else:
@@ -921,6 +985,8 @@ class FederatedClient:
                         t_up0 = time.monotonic()
                         upload_started = (t_up_unix, t_up0, len(msg))
                         framing.send_frame(sock, msg)
+                        self.last_wire_dtype = "fp32"
+                        self.last_upload_bytes = len(msg)
                         upload_timing = (
                             t_up_unix, time.monotonic() - t_up0, len(msg),
                             None,
@@ -1031,6 +1097,19 @@ class FederatedClient:
                     < adv_stream
                     <= framing.MAX_FRAME - wire.STREAM_CHUNK_OVERHEAD
                     else None
+                )
+                # Wire-dtype advert (same one-reply-behind pattern): the
+                # list of stream leaf encodings the server accepts. Only
+                # encodings we recognize survive — a future server
+                # advertising encodings this client never heard of must
+                # not trick it into sending one.
+                adv_encs = agg_meta.get(wire.WIRE_DTYPE_META_KEY)
+                self._server_wire_dtypes = tuple(
+                    str(e)
+                    for e in (
+                        adv_encs if isinstance(adv_encs, (list, tuple)) else ()
+                    )
+                    if str(e) in wire.WIRE_DTYPE_ENCS.values()
                 )
                 self._flush_spans(agg_meta, upload_timing, reply_timing)
                 if self.secure_agg and this_call is not None:
@@ -1225,6 +1304,7 @@ class FederatedClient:
         # aggregate history is unrelated; a delta against the old base
         # would be refused and burn a retry).
         self._server_stream = None
+        self._server_wire_dtypes = ()
         self._base = self._base_round = None
         self.rehomes[reason] = self.rehomes.get(reason, 0) + 1
         self._m_rehomes[reason].inc()

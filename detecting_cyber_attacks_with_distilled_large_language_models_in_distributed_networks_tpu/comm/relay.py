@@ -135,7 +135,29 @@ class RelayAggregator:
         subtree_deadline_factor: float = 0.5,
         tracer=None,
         strategy: str = "fedavg",
+        upward_topk: float | None = None,
     ):
+        # Sparse upward hops (--upward-topk): the relay's parent-facing
+        # leg runs the existing sparse round-delta machinery — its
+        # upward upload becomes topk(subtree partial - last root
+        # aggregate it fanned down, + error-feedback residual), with
+        # base agreement pinned by the root's agg_crc stamp exactly as
+        # for a leaf client. The subtree partial drifts by one round's
+        # client training, so the upward delta is small even when every
+        # leaf uploads dense — upward bytes drop superlinearly with
+        # depth (each tier re-sparsifies its own partial). Round 1 (and
+        # any round after a base refusal) ships dense automatically; a
+        # root running lossy reply compression never confirms a base,
+        # so the relay stays dense rather than diverging.
+        if upward_topk is not None:
+            if compression.startswith("topk"):
+                raise ValueError(
+                    "upward_topk composes the relay's own upward "
+                    "sparsifier; give the subtree-facing --compression "
+                    "a non-topk value"
+                )
+            # Range validation lives in wire.parse_compression.
+            wire.parse_compression(f"topk:{float(upward_topk)}")
         # Per-subtree straggler deadline, STRICTLY tighter than the
         # round budget (config.py FedConfig validates the same bound):
         # a slow subtree sheds its stragglers at factor * timeout — run
@@ -172,10 +194,29 @@ class RelayAggregator:
             parent_port,
             client_id=relay_id,
             timeout=timeout,
-            compression=compression,
+            compression=(
+                f"topk:{float(upward_topk)}"
+                if upward_topk is not None
+                else compression
+            ),
             auth_key=auth_key,
             stream=stream,
             tracer=tracer,
+        )
+        self.upward_topk = (
+            float(upward_topk) if upward_topk is not None else None
+        )
+        #: Cumulative parent-facing upload payload bytes (the
+        #: ``relay_upward_bytes`` bench headline / /metrics counter):
+        #: what the sparse upward tier exists to shrink.
+        self.upward_bytes = 0
+        from ..obs import metrics as _obs_metrics
+
+        self._m_upward_bytes = _obs_metrics.default_registry().counter(
+            "fedtpu_relay_upward_bytes_total",
+            help="parent-facing upload payload bytes shipped by this "
+            "relay (sparse upward deltas shrink this, not the subtree "
+            "tier's receive totals)",
         )
         self.relay_id = int(relay_id)
         self.subtree_deadline_factor = float(subtree_deadline_factor)
@@ -222,6 +263,9 @@ class RelayAggregator:
             },
         )
         dur = time.monotonic() - t0
+        up_bytes = int(self.parent.last_upload_bytes)
+        self.upward_bytes += up_bytes
+        self._m_upward_bytes.inc(float(up_bytes))
         if self.tracer is not None:
             parent_trace, parent_round = self.parent.last_trace
             self.tracer.record(
@@ -234,10 +278,16 @@ class RelayAggregator:
                 subtree_clients=len(info["ids"]),
                 parent_trace=parent_trace,
                 parent_round=parent_round,
+                # Wire-efficiency attribution: what the upward hop
+                # actually cost, and whether it went sparse/quantized.
+                upward_bytes=up_bytes,
+                upward_sparse=1 if self.upward_topk is not None else None,
+                wire_dtype=self.parent.last_wire_dtype,
             )
         log.info(
             f"[RELAY {self.relay_id}] forwarded subtree partial "
-            f"({len(info['ids'])} client(s), mass {total:g}) and received "
+            f"({len(info['ids'])} client(s), mass {total:g}, "
+            f"{up_bytes / 1e6:.2f} MB up) and received "
             f"the root aggregate in {dur:.3f}s"
         )
         return wire.flatten_params(out)

@@ -133,6 +133,12 @@ class _Round:
     # (wire.STREAM_REPLY_META_KEY): their reply fan-out goes out as
     # STRH/STRC/STRT frames instead of one dense model-sized frame.
     stream_replies: set = field(default_factory=set)
+    # Wire dtype each STREAMED upload actually arrived in ("fp32" /
+    # "bf16" / "int8"), derived from its header's leaf encodings — the
+    # wire-overlap span's wire_dtypes attr and the by-dtype /metrics
+    # label. Dense single-frame uploads are not recorded here (their
+    # encoding is the legacy compression knob, not a wire dtype).
+    wire_dtypes: dict[int, str] = field(default_factory=dict)
     # Survivable fold trees (comm/relay.py): ids adopted into this round
     # via the re-home marker (wire.REHOME_META_KEY) — EXTRA contributors
     # from a dead sibling subtree. They fold with everyone else
@@ -182,6 +188,7 @@ class AggregationServer:
         tracer=None,
         stream_chunk_bytes: int = wire.DEFAULT_STREAM_CHUNK,
         strategy: str | None = None,
+        strategy_state_path: str | None = None,
     ):
         if client_keys is not None and auth_key is None:
             raise ValueError(
@@ -370,6 +377,24 @@ class AggregationServer:
         # back to a dense resend.
         self._last_agg: dict | None = None
         self._last_agg_round = -1
+        # Server-state persistence (``strategy_state_path``): the last
+        # post-strategy global, its round index, and the strategy's
+        # optimizer-state leaves, written atomically after every plain
+        # finalized round (dp_history_path's background-writer pattern)
+        # and RELOADED on construction. Closes the PR 16 residual where
+        # a restarted FedOpt/momentum root lost its optimizer memory and
+        # re-adopted the bare mean on its first round — and, since
+        # ``_last_agg``/``_last_agg_round`` come back too, sparse-delta
+        # clients keep their base across the restart instead of paying a
+        # dense resend. A reloaded state whose strategy describe() does
+        # not match the configured strategy is ignored (operator swapped
+        # strategies between runs: fresh optimizer memory is correct).
+        self.strategy_state_path = strategy_state_path
+        self._strategy_persist_lock = threading.Lock()
+        self._strategy_persist_pending: tuple | None = None
+        self._strategy_persist_thread: threading.Thread | None = None
+        if strategy_state_path:
+            self._load_strategy_state()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -452,6 +477,9 @@ class AggregationServer:
             # retry fallbacks — the client logs its one-line reason).
             "stream_replies": 0,
             "stream_fallbacks": 0,
+            # Compiled-fold telemetry (ops/fold.py), refreshed per round.
+            "fold_engine": "",
+            "last_fold_throughput_gbps": 0.0,
         }
         # Hierarchical fold tree hook (comm/relay.py): when set, the
         # plain round's aggregate is handed to this callable BETWEEN
@@ -482,6 +510,21 @@ class AggregationServer:
         self._g_inflight_streams = m.gauge(
             "fedtpu_server_stream_inflight",
             help="chunk-streamed uploads currently mid-transfer",
+        )
+        # Wire efficiency (quantized uploads + compiled fold): uploads
+        # by the wire dtype they actually arrived in, and the last
+        # round's fold throughput. Label families are created per value
+        # at record time (the registry memoizes on (name, labels)).
+        self._m_uploads_by_dtype = lambda wd: m.counter(
+            "fedtpu_server_stream_uploads_by_wire_dtype_total",
+            help="chunk-streamed uploads accepted, by negotiated wire "
+            "dtype (fp32|bf16|int8)",
+            labels={"wire_dtype": wd},
+        )
+        self._g_fold_throughput = m.gauge(
+            "fedtpu_server_fold_throughput_gbps",
+            help="last round's fold throughput (bytes folded / fold "
+            "seconds), by the active fold engine",
         )
         self._g_peak_agg = m.gauge(
             "fedtpu_server_peak_agg_bytes",
@@ -626,6 +669,12 @@ class AggregationServer:
         # exactly the clients persistence exists to heal.
         with self._dp_persist_lock:
             t = self._dp_persist_thread
+        if t is not None:
+            t.join(timeout=60.0)
+        # Same drain for the strategy-state writer: a clean shutdown is
+        # exactly the restart this persistence exists to survive.
+        with self._strategy_persist_lock:
+            t = self._strategy_persist_thread
         if t is not None:
             t.join(timeout=60.0)
 
@@ -1379,6 +1428,13 @@ class AggregationServer:
             )
         dp_mode, dp_crc = self._validate_dp_meta(meta, is_delta=False)
         n_samples = float(meta.get("n_samples", 1.0))
+        # The upload's wire dtype, from what the header actually encodes
+        # (ground truth over any meta claim): the by-dtype /metrics
+        # label and the wire-overlap span's wire_dtypes attr.
+        encs = {t["enc"] for t in tensors}
+        up_dtype = (
+            "int8" if "int8c" in encs else "bf16" if "bf16" in encs else "fp32"
+        )
         # Duplicate stream after folds consumed the first upload: a
         # COMPLETED original stands and this stream is DRAINED (protocol
         # kept intact, bytes discarded) so the retrying client still gets
@@ -1614,6 +1670,7 @@ class AggregationServer:
                 # the actual tensors; rnd.models only tracks WHO completed.
                 rnd.models[client_id] = {}
                 rnd.deltas[client_id] = False
+                rnd.wire_dtypes[client_id] = up_dtype
                 if dp_crc is not None:
                     rnd.dp_crcs[client_id] = dp_crc
                 rnd.n_samples[client_id] = n_samples
@@ -1639,6 +1696,7 @@ class AggregationServer:
         else:
             self._m_uploads.inc()
             self._m_stream_uploads.inc()
+            self._m_uploads_by_dtype(up_dtype).inc()
             log.info(
                 f"[SERVER] received streamed model from client {client_id} "
                 f"({payload_nbytes / 1e6:.1f} MB in {seq} chunk(s); "
@@ -2064,6 +2122,140 @@ class AggregationServer:
                 f"{self.dp_history_path}: {e}"
             )
 
+    # ------------------------------------------- strategy-state persistence
+    def _load_strategy_state(self) -> None:
+        """Reload the persisted server state (``strategy_state_path``):
+        the last post-strategy global + round index, and the strategy's
+        optimizer-state leaves. Missing file = fresh deployment; corrupt
+        file or a strategy mismatch = logged and ignored (the server
+        must come up; a fresh optimizer memory is merely the pre-PR
+        behavior, never wrong)."""
+        import json as _json
+        import zipfile as _zipfile
+
+        try:
+            with np.load(self.strategy_state_path, allow_pickle=False) as z:
+                index = _json.loads(bytes(z["__index__"].tobytes()).decode())
+                agg = {
+                    k: np.asarray(z[f"a{j}"], np.float32)
+                    for j, k in enumerate(index["keys"])
+                }
+                opt_leaves = [
+                    np.asarray(z[f"o{j}"])
+                    for j in range(int(index.get("n_opt", 0)))
+                ]
+        except FileNotFoundError:
+            return
+        except (OSError, ValueError, KeyError, _zipfile.BadZipFile) as e:
+            log.warning(
+                f"[SERVER] could not reload server strategy state from "
+                f"{self.strategy_state_path} ({e}); starting fresh"
+            )
+            return
+        if index.get("strategy") != self._strategy.describe():
+            log.warning(
+                f"[SERVER] persisted strategy state is for "
+                f"{index.get('strategy')}, this server runs "
+                f"{self._strategy.describe()}; starting fresh"
+            )
+            return
+        self._last_agg = agg
+        self._last_agg_round = int(index["round"])
+        # Continue the round numbering monotonically: the restored base
+        # is keyed by its round index on both ends of the wire (delta
+        # uploads declare base_round; replies advertise agg_round).
+        self._round_counter = self._last_agg_round + 1
+        restored_opt = False
+        if opt_leaves:
+            restored_opt = self._strategy.restore_state(opt_leaves, agg)
+            if not restored_opt:
+                log.warning(
+                    "[SERVER] persisted optimizer-state leaves do not "
+                    "match this strategy/model; optimizer memory starts "
+                    "fresh"
+                )
+        log.info(
+            f"[SERVER] reloaded round {self._last_agg_round} global"
+            + (" + optimizer state" if restored_opt else "")
+            + f" from {self.strategy_state_path} "
+            f"(strategy {self._strategy.name})"
+        )
+
+    def _persist_strategy_state(self) -> None:
+        """Queue the current global + optimizer state for the background
+        writer (the dp-history pattern: latest-snapshot slot, coalescing
+        writes — serve_round never blocks on model-sized disk I/O)."""
+        if not self.strategy_state_path or self._last_agg is None:
+            return
+        opt = self._strategy.export_state()
+        snap = (
+            int(self._last_agg_round),
+            {
+                k: np.asarray(v, np.float32)
+                for k, v in self._last_agg.items()
+            },
+            self._strategy.describe(),
+            [np.asarray(a) for a in (opt or [])],
+        )
+        with self._strategy_persist_lock:
+            self._strategy_persist_pending = snap
+            if (
+                self._strategy_persist_thread is None
+                or not self._strategy_persist_thread.is_alive()
+            ):
+                self._strategy_persist_thread = threading.Thread(
+                    target=self._strategy_persist_loop, daemon=True
+                )
+                self._strategy_persist_thread.start()
+
+    def _strategy_persist_loop(self) -> None:
+        while True:
+            with self._strategy_persist_lock:
+                snap = self._strategy_persist_pending
+                self._strategy_persist_pending = None
+                if snap is None:
+                    self._strategy_persist_thread = None
+                    return
+            self._write_strategy_state(snap)
+
+    def _write_strategy_state(self, snap: tuple) -> None:
+        """One atomic snapshot (tmp + replace): a JSON index (round,
+        strategy describe, agg key order, opt leaf count) plus
+        positionally-named arrays — same layout discipline as the DP
+        history file, and the same best-effort failure contract."""
+        import json as _json
+
+        round_no, agg, described, opt_leaves = snap
+        index = {
+            "round": int(round_no),
+            "strategy": described,
+            "keys": list(agg),
+            "n_opt": len(opt_leaves),
+        }
+        arrays: dict[str, np.ndarray] = {
+            "__index__": np.frombuffer(
+                _json.dumps(index).encode(), dtype=np.uint8
+            )
+        }
+        for j, k in enumerate(agg):
+            arrays[f"a{j}"] = agg[k]
+        for j, leaf in enumerate(opt_leaves):
+            arrays[f"o{j}"] = leaf
+        tmp = self.strategy_state_path + ".tmp"
+        try:
+            os.makedirs(
+                os.path.dirname(os.path.abspath(tmp)) or ".",
+                exist_ok=True,
+            )
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, self.strategy_state_path)
+        except OSError as e:
+            log.warning(
+                f"[SERVER] could not persist server strategy state to "
+                f"{self.strategy_state_path}: {e}"
+            )
+
     def _heal_stale_clients(
         self,
         rnd: _Round,
@@ -2313,6 +2505,9 @@ class AggregationServer:
                 }
                 if self.stream_chunk_bytes > 0 and not self.secure_agg:
                     noop_meta[wire.STREAM_META_KEY] = self.stream_chunk_bytes
+                    noop_meta[wire.WIRE_DTYPE_META_KEY] = sorted(
+                        set(wire.WIRE_DTYPE_ENCS.values())
+                    )
                 self._reply_all(
                     {
                         cid: self._encode_reply(
@@ -2740,6 +2935,11 @@ class AggregationServer:
                 # next round's deltas difference against the right tree.
                 self._last_agg = agg
                 self._last_agg_round = rnd.round_no
+                # Persist the post-strategy global + optimizer state so
+                # a restarted server resumes instead of re-adopting the
+                # mean (no-op without strategy_state_path; background
+                # writer keeps the fan-out off the disk's latency).
+                self._persist_strategy_state()
                 # agg_crc: the base-agreement contract. Clients only adopt
                 # the decoded reply as their next delta base when it hashes
                 # to the server's exact fp32 aggregate — under a lossy
@@ -2769,6 +2969,15 @@ class AggregationServer:
                 # trace field): capable clients chunk-stream their NEXT
                 # upload; old peers ignore the extra meta key.
                 reply_meta[wire.STREAM_META_KEY] = self.stream_chunk_bytes
+                # Wire-dtype advert: the stream leaf encodings this
+                # server decodes. A --wire-dtype client quantizes its
+                # NEXT streamed upload only after seeing its encoding
+                # here (old servers never advertise -> clients stay
+                # fp32; old clients ignore the key — interop unchanged
+                # both ways).
+                reply_meta[wire.WIRE_DTYPE_META_KEY] = sorted(
+                    set(wire.WIRE_DTYPE_ENCS.values())
+                )
             # Sitting-out clients (cohort sampling) receive the identical
             # reply: the aggregate is the round's public output and their
             # bases must track the fleet's.
@@ -3023,11 +3232,23 @@ class AggregationServer:
                 # and would mask the streamed rounds' O(model +
                 # in-flight) in the cross-round max.
                 tot["last_round_peak_bytes"] = s["peak_bytes"]
+                # Compiled-fold telemetry (ops/fold.py): which engine
+                # folded and at what throughput — the bench's
+                # fold_throughput_gbps headline source.
+                tot["fold_engine"] = s["fold_engine"]
+                tot["last_fold_throughput_gbps"] = s[
+                    "fold_throughput_gbps"
+                ]
             self._g_peak_agg.set(float(s["peak_bytes"]))
+            if s["fold_s"] > 0.0:
+                self._g_fold_throughput.set(
+                    float(s["fold_throughput_gbps"])
+                )
             if self.tracer is not None and s["early_s"] > 0.0:
                 # Overlapped-vs-exposed wire attribution: how much fold
                 # work ran DURING the wait phase (hidden behind other
                 # clients' transfers) — the obs timeline's overlap row.
+                wire_dtypes = sorted(set(rnd.wire_dtypes.values()))
                 self.tracer.record(
                     "wire-overlap",
                     t_start=s["first_fold_unix"] or t_unix,
@@ -3037,6 +3258,11 @@ class AggregationServer:
                     folded_bytes=s["early_bytes"],
                     overlap_frac=round(s["overlap_frac"], 4),
                     peak_agg_bytes=s["peak_bytes"],
+                    fold_engine=s["fold_engine"],
+                    fold_throughput_gbps=round(
+                        s["fold_throughput_gbps"], 3
+                    ),
+                    wire_dtypes=wire_dtypes or None,
                 )
         if self.tracer is not None:
             self.tracer.record(

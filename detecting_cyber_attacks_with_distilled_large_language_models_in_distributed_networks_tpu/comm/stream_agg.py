@@ -49,6 +49,7 @@ from typing import Any, Mapping
 import numpy as np
 
 from . import wire
+from ..ops import fold as fold_ops
 
 
 class StreamAggPoisoned(RuntimeError):
@@ -309,10 +310,13 @@ class StreamAgg:
         t_unix = time.time()
         t0 = time.monotonic()
         try:
-            first = leaves[self.fold_ids[0]]
-            if self.intents[self.fold_ids[0]].get("delta"):
-                first = self.base[key] + np.asarray(first, np.float32)
-            acc = np.zeros_like(np.asarray(first, np.float32))
+            # Batched fold: materialize the K leaves in ascending-id order
+            # and hand them to the fold engine in ONE dispatch. Every
+            # engine replays the identical per-element fp32 mul/add
+            # sequence, so the result stays bit-exact with the barrier
+            # mean regardless of which engine folded (pinned by the
+            # shuffled-arrival property test).
+            ordered: list[np.ndarray] = []
             for cid in self.fold_ids:
                 arr = leaves[cid]
                 if self.intents[cid].get("delta"):
@@ -320,9 +324,12 @@ class StreamAgg:
                     # validated against the base at upload time.
                     arr = self.base[key] + np.asarray(arr, np.float32)
                 arr = np.asarray(arr, np.float32)
-                if arr.shape != acc.shape:
+                if ordered and arr.shape != ordered[0].shape:
                     raise wire.WireError(f"shape mismatch for {key!r}")
-                acc += np.float32(self._weights[cid]) * arr
+                ordered.append(arr)
+            acc = fold_ops.fold_ordered(
+                ordered, [np.float32(self._weights[c]) for c in self.fold_ids]
+            )
         except Exception as e:  # poison, don't kill the handler thread
             self.poisoned = f"fold of {key!r} failed: {e}"
             return
@@ -406,6 +413,7 @@ class StreamAgg:
                 f"strategy stats leak for dropped clients {stale}"
             )
             folded = self.early_bytes + self.late_bytes
+            fold_s = self.early_s + self.late_s
             return {
                 "peak_bytes": int(self.peak_bytes),
                 "early_bytes": int(self.early_bytes),
@@ -416,4 +424,9 @@ class StreamAgg:
                     self.early_bytes / folded if folded else 0.0
                 ),
                 "first_fold_unix": self.first_fold_unix,
+                "fold_engine": fold_ops.engine_name(),
+                "fold_s": float(fold_s),
+                "fold_throughput_gbps": (
+                    folded / fold_s / 1e9 if fold_s > 0 and folded else 0.0
+                ),
             }

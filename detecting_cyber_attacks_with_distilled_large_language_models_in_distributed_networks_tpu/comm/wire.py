@@ -27,6 +27,11 @@ quantization, each leading-axis row carrying its own fp32 scale
 (``max|row| / 127``) prepended to the tensor's payload segment. Worst-case
 per-weight error is half a quantization step (~0.4% of the row's max) —
 lossier than bf16; an opt-in bandwidth/fidelity trade for slow links.
+``compression="int8c"`` is the same 4x with the fp32 scales keyed to
+FIXED element chunks instead of tensor rows (comm/quant.py): uniform
+scale resolution for every leaf shape and a size computable from the
+element count alone, which is what the capability-negotiated
+``--wire-dtype int8`` streamed uploads ride (WIRE_DTYPE_META_KEY below).
 
 **Streamed uploads** (PR 5): a capability-negotiated alternative to the
 single ``FTPW`` frame for model-sized uploads. The server advertises
@@ -85,6 +90,7 @@ from typing import Any, Mapping
 import numpy as np
 
 from . import native
+from .quant import dequantize_int8c, int8c_nbytes, quantize_int8c
 
 MAGIC = b"FTPW"
 VERSION = 1
@@ -207,6 +213,21 @@ SUBTREE_IDS_META_KEY = "subtree_ids"
 #: active strategy (a split-brain fleet folding under two different
 #: aggregation rules). Plain meta: old peers ignore it.
 STRATEGY_META_KEY = "strategy"
+#: Reply-meta capability advert for QUANTIZED streamed uploads (the
+#: ``--wire-dtype`` negotiation): the list of lossy stream encodings this
+#: server will dequantize before folding (e.g. ``["bf16", "int8c"]``).
+#: Exactly the STREAM_META_KEY pattern — plain meta, one reply behind:
+#: a client configured with ``--wire-dtype int8`` keeps uploading fp32
+#: until a reply carries the advert (round 1, old servers, and every
+#: dense retry stay bit-identical to today's wire), then upgrades its
+#: streamed leaves to the negotiated encoding.
+WIRE_DTYPE_META_KEY = "wire_dtypes"
+#: ``--wire-dtype`` values -> the stream leaf encoding each negotiates.
+#: ``fp32`` is the identity (no advert needed, nothing changes on the
+#: wire); ``int8`` maps to the per-chunk-scale codec (comm/quant.py),
+#: NOT the per-row ``int8`` — fixed element chunks give every leaf shape
+#: uniform scale resolution and a plannable encoded size.
+WIRE_DTYPE_ENCS = {"fp32": "raw", "bf16": "bf16", "int8": "int8c"}
 DEFAULT_STREAM_CHUNK = 4 << 20  # 4 MiB: bounds receiver buffering
 #: Worst-case STRC frame bytes beyond the chunk data itself (magic + u64
 #: seq + auth tag). A configured/advertised chunk size must leave this
@@ -248,7 +269,7 @@ def _stream_domains(direction: str) -> tuple[bytes, bytes, bytes]:
 #: Leaf encodings a stream may carry: the fixed-size ones whose encoded
 #: byte count is computable from (dtype, shape) alone, so the header can
 #: be built before any leaf is gathered off-device.
-_STREAM_ENCS = ("raw", "bf16", "int8")
+_STREAM_ENCS = ("raw", "bf16", "int8", "int8c")
 _ALLOWED_DTYPES = {
     "float32", "float64", "float16", "bfloat16",
     "int8", "int16", "int32", "int64",
@@ -325,7 +346,7 @@ def parse_compression(spec: str) -> tuple[str, float | None]:
         if not 0.0 < frac <= 1.0:
             raise WireError(f"topk fraction {frac} outside (0, 1]")
         return "topk", frac
-    if spec not in ("none", "bf16", "int8"):
+    if spec not in ("none", "bf16", "int8", "int8c"):
         raise WireError(f"unknown compression {spec!r}")
     return spec, None
 
@@ -534,6 +555,9 @@ def encode(
         elif compression == "int8" and arr.dtype == np.float32:
             buf = quantize_int8(arr)
             enc = "int8"
+        elif compression == "int8c" and arr.dtype == np.float32:
+            buf = quantize_int8c(arr)
+            enc = "int8c"
         elif compression == "topk" and arr.dtype == np.float32:
             buf = sparsify_topk(arr, topk_frac)
             enc = "topk"
@@ -581,6 +605,8 @@ def decode_tensor_entry(t: Mapping[str, Any], raw) -> np.ndarray:
         return native.unpack_bf16(packed, shape=tuple(t["shape"]))
     if t["enc"] == "int8":
         return dequantize_int8(raw, tuple(t["shape"]))
+    if t["enc"] == "int8c":
+        return dequantize_int8c(raw, tuple(t["shape"]))
     if t["enc"] == "topk":
         return densify_topk(raw, tuple(t["shape"]))
     if t["enc"] == "raw":
@@ -729,6 +755,8 @@ def _leaf_plan(key: str, leaf: Any, compression: str) -> dict:
     elif compression == "int8" and dtype == "float32":
         rows = shape[0] if len(shape) >= 2 else 1
         enc, nbytes = "int8", 4 * rows + size
+    elif compression == "int8c" and dtype == "float32":
+        enc, nbytes = "int8c", int8c_nbytes(size)
     else:
         enc, nbytes = "raw", size * np.dtype(dtype).itemsize
     return {"key": key, "dtype": dtype, "shape": list(shape), "enc": enc,
@@ -764,6 +792,8 @@ def encode_stream_leaf(leaf: Any, enc: str) -> bytes:
         return np.ascontiguousarray(native.pack_bf16(arr)).tobytes()
     if enc == "int8":
         return quantize_int8(arr)
+    if enc == "int8c":
+        return quantize_int8c(arr)
     if enc == "raw":
         return np.ascontiguousarray(arr).tobytes()
     raise WireError(f"unknown stream leaf encoding {enc!r}")

@@ -329,6 +329,15 @@ class FedConfig:
     # factor >= 1 re-creates the stalled-root failure mode the relay
     # tier exists to remove.
     subtree_deadline_factor: float = 0.5
+    # Wire dtype for STREAMED client uploads (comm/wire.py): "fp32" is
+    # the exact historical encoding; "bf16" / "int8" quantize each
+    # streamed chunk (int8 with a per-4096-element fp32 scale, ~3.98x
+    # smaller uploads). Negotiated: the server adverts its decodable
+    # encodings in reply meta and the client upgrades one reply behind,
+    # so an old peer on either end keeps the fp32 wire. Lossy dtypes are
+    # refused alongside secure-agg or compressed uploads; under DP the
+    # server re-clips after dequantization (containment).
+    wire_dtype: str = "fp32"
 
     def server_opt_enabled(self) -> bool:
         return self.server_opt != "none"
@@ -410,6 +419,11 @@ class FedConfig:
                 f"subtree_deadline_factor={self.subtree_deadline_factor} "
                 "must be in (0, 1): the per-subtree straggler deadline "
                 "has to be strictly tighter than the round budget"
+            )
+        if self.wire_dtype not in ("fp32", "bf16", "int8"):
+            raise ValueError(
+                f"wire_dtype={self.wire_dtype!r} must be "
+                "'fp32', 'bf16' or 'int8'"
             )
         if self.participation < self.min_client_fraction:
             raise ValueError(

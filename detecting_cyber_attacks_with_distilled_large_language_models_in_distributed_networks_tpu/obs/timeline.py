@@ -346,6 +346,41 @@ def timeline_table(
                 + (f" ({frac:.0%} of fold input)" if frac is not None else "")
                 + (f", peak agg {peak / 1e6:.1f} MB" if peak else "")
             )
+        # Wire-efficiency row (PR 17): quantized-upload dtypes + fold
+        # engine/throughput ride the wire-overlap span; sparse upward
+        # hops stamp their bytes on relay-forward spans. One compressed
+        # line showing what the round's wire actually carried.
+        for s in groups[key]:
+            if s["span"] != "wire-overlap" or (
+                not s.get("wire_dtypes")
+                and not s.get("fold_engine")
+            ):
+                continue
+            dts = s.get("wire_dtypes") or ["fp32"]
+            gbps = s.get("fold_throughput_gbps")
+            out.append(
+                f"  wire-dtype     uploads {'+'.join(str(d) for d in dts)}"
+                + (f", fold {s['fold_engine']}" if s.get("fold_engine") else "")
+                + (f" @ {gbps:.2f} GB/s" if gbps else "")
+            )
+        up_spans = [
+            s
+            for s in groups[key]
+            if s["span"] == "relay-forward"
+            and s.get("upward_bytes") is not None
+        ]
+        if up_spans:
+            up_total = sum(int(s["upward_bytes"]) for s in up_spans)
+            n_sparse = sum(1 for s in up_spans if s.get("upward_sparse"))
+            out.append(
+                f"  relay-upward   {up_total / 1e6:>8.2f} MB over "
+                f"{len(up_spans)} hop(s)"
+                + (
+                    f" ({n_sparse} sparse topk)"
+                    if n_sparse
+                    else " (dense)"
+                )
+            )
         extra = [
             s
             for s in groups[key]

@@ -1,6 +1,21 @@
-from .attention import dot_product_attention, make_attention_bias  # noqa: F401
-from .metrics import (  # noqa: F401
-    BinaryCounts,
-    binary_counts,
-    finalize_metrics,
-)
+"""Kernel/device ops. Re-exports are lazy (PEP 562): ``ops.fold`` is
+imported by the jax-free comm server tier, and an eager ``from
+.attention import ...`` here would drag jax (seconds of import, a
+device runtime) into every aggregation-only process."""
+
+_ATTENTION = ("dot_product_attention", "make_attention_bias")
+_METRICS = ("BinaryCounts", "binary_counts", "finalize_metrics")
+
+__all__ = [*_ATTENTION, *_METRICS]
+
+
+def __getattr__(name):
+    if name in _ATTENTION:
+        from . import attention
+
+        return getattr(attention, name)
+    if name in _METRICS:
+        from . import metrics
+
+        return getattr(metrics, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

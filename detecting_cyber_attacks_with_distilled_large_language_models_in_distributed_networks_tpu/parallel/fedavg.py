@@ -95,7 +95,12 @@ def make_server_optimizer(fed_cfg) -> "optax.GradientTransformation | None":
 
     Shared by the SPMD mesh tier (FederatedTrainer) and the TCP tier's
     strategy registry (strategies/core.py), which wraps it around the
-    streamed fold's finalize-time mean."""
+    streamed fold's finalize-time mean. The transform's optimizer state
+    is checkpointable across server restarts via the strategy layer's
+    export_state/restore_state (``serve --strategy-state-file``): optax
+    states here are flat pytrees of arrays whose structure is a pure
+    function of the (sorted-key) fp32 param template, which is what lets
+    a restarted server rebuild the treedef and re-adopt the leaves."""
     import optax
 
     if fed_cfg.server_opt == "momentum":

@@ -68,6 +68,18 @@ class Strategy:
     def describe(self) -> dict[str, Any]:
         return {"name": self.name, "params": self.params()}
 
+    def export_state(self) -> "list[np.ndarray] | None":
+        """Optimizer-state leaves for server-restart checkpointing
+        (comm/server.py strategy_state_path); None = stateless."""
+        return None
+
+    def restore_state(
+        self, leaves: "list[np.ndarray]", template_params: Flat
+    ) -> bool:
+        """Rebuild optimizer state from exported leaves against the
+        restored global. False = leaves don't fit (start fresh)."""
+        return False
+
     def apply(
         self,
         prev: Flat | None,
@@ -166,6 +178,46 @@ class _ServerOptStrategy(Strategy):
 
     def reset(self):
         self._opt_state = None
+
+    def export_state(self):
+        """The optax state's leaves in tree order (counts, momenta,
+        second moments — all dense arrays), host-materialized so the
+        server's npz writer can persist them without touching jax."""
+        if self._opt_state is None:
+            return None
+        import jax
+
+        return [
+            np.asarray(leaf)
+            for leaf in jax.tree_util.tree_leaves(self._opt_state)
+        ]
+
+    def restore_state(self, leaves, template_params):
+        """Inverse of :func:`export_state`: build a fresh ``tx.init``
+        state over the restored global (the structure/treedef donor),
+        then substitute the persisted leaves. Leaf count or any
+        shape mismatch means the model or optimizer changed — refuse,
+        the caller starts with fresh optimizer memory."""
+        import jax
+
+        tx = self._transform()
+        template = tx.init(
+            {
+                k: np.asarray(template_params[k], np.float32)
+                for k in sorted(template_params)
+            }
+        )
+        t_leaves, treedef = jax.tree_util.tree_flatten(template)
+        if len(leaves) != len(t_leaves):
+            return False
+        cast = []
+        for got, want in zip(leaves, t_leaves):
+            w = np.asarray(want)
+            if np.shape(got) != w.shape:
+                return False
+            cast.append(np.asarray(got, w.dtype))
+        self._opt_state = jax.tree_util.tree_unflatten(treedef, cast)
+        return True
 
     def apply(self, prev, mean, *, round_no=0, client_stats=None):
         if not _compatible(prev, mean):

@@ -249,6 +249,7 @@ def _tiny_batch(cfg, clients, B=4):
     }
 
 
+@pytest.mark.slow
 def test_trainer_dp_round_replicates_and_stays_finite(eight_devices):
     from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.train import (
         FederatedTrainer,

@@ -833,6 +833,7 @@ def _run_rehome_tree(rng, *, kill, trace_dir=None, root_deadline=6.0):
     return models, results, clients, root_state, want, timings
 
 
+@pytest.mark.slow
 def test_rehome_on_dial_exhausted_converges_in_round(rng, tmp_path):
     """The victims' primary never answers: their seeded dial budget
     exhausts, they re-home to the sibling relay, and the degraded root

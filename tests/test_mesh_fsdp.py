@@ -365,6 +365,7 @@ def test_fsdp_checkpoint_round_trip_bit_exact(tok, client_data, tmp_path, eight_
 
 
 # ------------------------------------------------------- fedsteps parameterization
+@pytest.mark.slow
 def test_packed_step_spec_parameterization_matches_plain(tok, eight_devices):
     """make_packed_step(gather=, constrain=) — the FSDP-parameterized
     packed step — advances one client identically (to reduction-order

@@ -559,6 +559,7 @@ def test_profile_stride_config_flag_round_trip():
         ObsConfig(profile_stride=-1)
 
 
+@pytest.mark.slow
 def test_client_local_span_attrs_via_federated_fit(tmp_path):
     """The dense federated fit loop stamps sampled step attrs on its
     client-local span when a profiler is armed."""

@@ -409,6 +409,7 @@ def test_run_load_target_qps_paces_open_loop(shared_replica):
 
 
 # -------------------------------------------------------- rolling reload
+@pytest.mark.slow
 def test_rolling_reload_zero_drop_spans_and_audit(tiny_setup, tmp_path):
     """The acceptance-shaped promotion: a registry pointer move against
     a fleet under closed-loop load swaps every replica to the new round

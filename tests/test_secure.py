@@ -516,6 +516,7 @@ def _keyed_then_dead_client(port, cid, *, died, auth_key=None, tag_key=None):
         died.set()
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("auth", [False, True])
 def test_secure_round_survives_dropout_after_keys(rng, auth):
     """VERDICT r3 #3 done-criterion: one client dies mid-secure-round

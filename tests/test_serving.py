@@ -230,6 +230,7 @@ def test_engine_swap_changes_round_and_weights(tiny_setup):
 
 
 # ---------------------------------------------------------------------- e2e
+@pytest.mark.slow
 def test_scoring_service_end_to_end(tiny_setup, tmp_path):
     """The acceptance flow in one service lifetime: three concurrent
     clients coalesce into a shared bucket batch (telemetry batch_size >

@@ -373,6 +373,7 @@ def _batch(mcfg, rng, B=8):
     }
 
 
+@pytest.mark.slow
 def test_prox_step_vanishes_at_anchor_and_pulls_at_large_mu():
     """At params == anchor the proximal gradient mu*(p - anchor) is
     exactly zero, so the first prox step matches the plain step; a large
