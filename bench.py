@@ -2776,7 +2776,7 @@ MODES = (
     "train", "bert", "bertlarge", "eval", "fedavg", "flash", "ring",
     "fed2", "fedseq", "serve", "clientdp", "controller", "scenario",
     "fleet", "check", "router", "obs", "profile", "shadow", "fsdp",
-    "strategy", "wire",
+    "strategy", "wire", "labels",
 )
 
 
@@ -3416,6 +3416,206 @@ def bench_check() -> dict:
     _emit(record)
     return record
 
+def bench_labels() -> dict:
+    """Delayed ground-truth plane (ISSUE 18): three arms over the
+    labels/ journal + join + supervised gate, all pure host arithmetic
+    (no accelerator beyond the CPU backend the K-class arm's metric
+    kernels run on).
+
+    Arm 1 — supervised reject: a candidate that flips under the
+    unsupervised gate's flip-rate budget (clean PSI, ``evaluate_status``
+    PASSES) but whose every flip is serving-right -> candidate-WRONG
+    against the journal. The flip-rate/PSI rung would promote it; the
+    label gate must measure the error regression and refuse.
+
+    Arm 2 — coverage fail-closed: the same pairs joined against a
+    journal that covers almost none of them. A verdict over three flows
+    out of four hundred is noise; the gate must refuse on the coverage
+    floor, not rule.
+
+    Arm 3 — K-class bit-identity: the K = 2 route of the class-counts
+    data plane (``class_counts``/``finalize_class_metrics``) must render
+    a metrics dict crc-identical to the binary path's on the same
+    logits — the K-class generalization cannot move the binary numbers.
+
+    Headline fields (asserted present by the train-mode headline,
+    exit 3): ``labels_supervised_reject`` + ``labels_unsupervised_pass``
+    (the arm-1 pincer: BOTH must be 1.0 — a reject the unsupervised
+    rung would also have made proves nothing),
+    ``labels_coverage_fail_closed``, and ``labels_kclass_crc_exact``."""
+    import shutil
+    import tempfile
+    import zlib
+
+    import jax.numpy as jnp
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.labels import (
+        LabelGate,
+        LabelStore,
+        journal_path,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.ops.metrics import (
+        binary_counts,
+        class_counts,
+        finalize_class_metrics,
+        finalize_metrics,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.shadow.compare import (
+        ShadowCompare,
+        evaluate_status,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.shadow.gate import (
+        pairs_path,
+    )
+
+    n_pairs = int(os.environ.get("BENCH_LABELS_PAIRS", "400"))
+    # Flip budget chosen UNDER the unsupervised gate's 2% default: the
+    # candidate must pass flip-rate/PSI and still be caught supervised.
+    n_flips = max(1, n_pairs // 64)
+    tmp = tempfile.mkdtemp(prefix="fedtpu-bench-labels-")
+    t0 = time.perf_counter()
+    try:
+        rng = np.random.default_rng(1808)
+        aid = "cand-bench"
+        compare = ShadowCompare(
+            threshold=0.5, pairs_jsonl=pairs_path(tmp, aid)
+        )
+        # Alternating benign/attack truth; serving always on the correct
+        # side of the threshold (jitter never crosses 0.5).
+        truth = (np.arange(n_pairs) % 2).astype(np.int64)
+        serving = np.where(truth == 1, 0.9, 0.1) + rng.uniform(
+            -0.05, 0.05, n_pairs
+        )
+        # The candidate flips n_flips attack flows to benign — each one
+        # a serving-correct -> candidate-wrong decision — and agrees
+        # everywhere else.
+        cand = serving.copy()
+        flip_rows = [2 * i + 1 for i in range(n_flips)]
+        for i in flip_rows:
+            cand[i] = 0.08
+        for i in range(n_pairs):
+            compare.register_rid(i, f"r{i}")
+            compare.note_serving(i, float(serving[i]))
+            compare.note_shadow(i, float(cand[i]))
+        unsup_ok, unsup_reason = evaluate_status(
+            compare.snapshot(),
+            min_pairs=min(100, n_pairs),
+            max_flip_rate=0.02,
+            psi_threshold=0.25,
+        )
+        snap = compare.snapshot()
+
+        # Arm 1: journal covering 75% of the scored flows (delayed
+        # labels are always partial), every flip row inside the covered
+        # prefix; the supervised rung must measure the regression.
+        store = LabelStore(journal_path(tmp))
+        n_labeled = int(n_pairs * 0.75)
+        for i in range(n_labeled):
+            store.ingest(f"r{i}", int(truth[i]), ts=float(i))
+        store.advance_watermark(float(n_labeled))
+        sup_ok, sup = LabelGate(
+            tmp, min_joined=64, coverage_floor=0.05, max_regression=0.0
+        ).evaluate(aid)
+        supervised_reject = (not sup_ok) and (
+            "regression" in sup.get("reason", "")
+        )
+
+        # Arm 2: a journal that labels 8 of the same 400 pairs —
+        # coverage 2% under the 5% floor. min_joined is satisfied, so
+        # the refusal is the coverage clause, nothing else.
+        sparse_journal = os.path.join(tmp, "labels", "sparse.jsonl")
+        store_b = LabelStore(sparse_journal)
+        for i in range(min(8, n_pairs)):
+            store_b.ingest(f"r{i}", int(truth[i]), ts=float(i))
+        cov_ok, cov = LabelGate(
+            tmp,
+            journal=sparse_journal,
+            min_joined=4,
+            coverage_floor=0.05,
+            max_regression=0.0,
+        ).evaluate(aid)
+        coverage_fail_closed = (not cov_ok) and (
+            "coverage" in cov.get("reason", "")
+        )
+
+        # Arm 3: K = 2 class-counts path vs the binary path, same
+        # seeded logits — the rendered metric dicts must be crc-equal.
+        n = 512
+        logits = jnp.asarray(
+            rng.normal(size=(n, 2)).astype(np.float32)
+        )
+        y = jnp.asarray(rng.integers(0, 2, size=n).astype(np.int32))
+        loss = jnp.asarray(np.float32(0.693))
+
+        def _canon(v):
+            if isinstance(v, np.ndarray):
+                return v.tolist()
+            if isinstance(v, dict):
+                return {k: _canon(v[k]) for k in sorted(v)}
+            if isinstance(v, (np.floating, np.integer)):
+                return v.item()
+            return v
+
+        def _crc(metrics: dict) -> int:
+            return zlib.crc32(
+                json.dumps(_canon(metrics), sort_keys=True).encode()
+            )
+
+        crc_binary = _crc(finalize_metrics(binary_counts(logits, y, loss)))
+        crc_kclass = _crc(
+            finalize_class_metrics(class_counts(logits, y, loss))
+        )
+        kclass_exact = crc_binary == crc_kclass
+    except Exception as e:  # noqa: BLE001 - one parseable line, not a dump
+        record = {
+            "metric": "bench_error",
+            "error": "labels_arm_failed",
+            "detail": f"{type(e).__name__}: {str(e)[:300]}",
+        }
+        _emit(record)
+        return record
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    record = {
+        "metric": f"labels_delayed_truth_n{n_pairs}",
+        "value": int(sup.get("joined") or 0),
+        "unit": "joined_flows",
+        "labels_supervised_reject": 1.0 if supervised_reject else 0.0,
+        "labels_unsupervised_pass": 1.0 if unsup_ok else 0.0,
+        "labels_coverage_fail_closed": 1.0 if coverage_fail_closed else 0.0,
+        "labels_kclass_crc_exact": 1.0 if kclass_exact else 0.0,
+        "labels_kclass_crc": int(crc_binary),
+        "labels_flip_rate": round(float(snap["flip_rate"]), 6),
+        "labels_pair_psi": snap["psi"],
+        "labels_joined": int(sup.get("joined") or 0),
+        "labels_coverage": sup.get("coverage"),
+        "labels_serving_error": sup.get("serving_error"),
+        "labels_candidate_error": sup.get("candidate_error"),
+        "labels_sparse_coverage": cov.get("coverage"),
+        "labels_journal_watermark": sup.get("watermark"),
+        "labels_runtime_s": round(time.perf_counter() - t0, 3),
+        "unsup_reason": unsup_reason[:160],
+        "supervised_reason": sup.get("reason", "")[:160],
+        "coverage_reason": cov.get("reason", "")[:160],
+    }
+    _emit(record)
+    return record
+
+
+def _labels_broken(rec: dict) -> bool:
+    """The ground-truth plane's acceptance gates (exit 3): the
+    unsupervised rung must PASS the label-regressed candidate (else the
+    supervised reject proves nothing), the label gate must reject it,
+    the coverage floor must fail closed, and the K = 2 class path must
+    be crc-identical to the binary path."""
+    return (
+        rec.get("labels_supervised_reject", 0.0) < 1.0
+        or rec.get("labels_unsupervised_pass", 0.0) < 1.0
+        or rec.get("labels_coverage_fail_closed", 0.0) < 1.0
+        or rec.get("labels_kclass_crc_exact", 0.0) < 1.0
+    )
+
+
 #: Federated product-step MFU floor (fed2/fedseq): the driver-captured
 #: records sit at 0.585/0.56 (BENCH_r05); a regression below 0.50 exits
 #: nonzero so it cannot pass silently (VERDICT r5 weak #7).
@@ -3474,6 +3674,19 @@ def main() -> None:
         if rec.get("metric") == "bench_error" or _wire_broken(rec):
             raise SystemExit(3)
         return
+    if mode == "labels":
+        # Journal/join/gate arithmetic is pure host work; the K-class
+        # crc arm touches jnp, so pin the CPU backend before first use —
+        # this mode must never pay for (or depend on) the tunnel. Safe
+        # here only because nothing else runs in this process.
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        rec = bench_labels()
+        if rec.get("metric") == "bench_error" or _labels_broken(rec):
+            raise SystemExit(3)
+        return
     if (mode == "clientdp" and os.environ.get("BENCH_CLIENTDP_FORCE_CPU")) or (
         mode == "fsdp" and os.environ.get("BENCH_FSDP_FORCE_CPU")
     ):
@@ -3512,6 +3725,7 @@ def main() -> None:
             rec_fed2 = rec_fedseq = rec_ctrl = rec_resid = rec_scn = None
             rec_fleet = rec_check = rec_router = rec_obs = None
             rec_profile = rec_shadow = rec_fsdp = rec_wire = None
+            rec_labels = None
             if os.environ.get("BENCH_SECONDARY", "1").lower() not in (
                 "", "0", "false",
             ):
@@ -3538,6 +3752,7 @@ def main() -> None:
                 # at a warm site.
                 rec_profile = bench_profile()
                 rec_check = bench_check()
+                rec_labels = bench_labels()
             extra = {}
             for key, rec in (("fed2", rec_fed2), ("fedseq", rec_fedseq)):
                 if rec is not None and rec.get("mfu") is not None:
@@ -3948,6 +4163,48 @@ def main() -> None:
                 extra["check_findings_new"] = rec_check["check_findings_new"]
                 extra["check_runtime_s"] = rec_check["check_runtime_s"]
                 check_broken = rec_check["check_findings_new"] > 0
+            labels_broken_flag = False
+            if rec_labels is not None and (
+                rec_labels.get("metric") != "bench_error"
+            ):
+                # Ground-truth-plane headline fields (ISSUE 18):
+                # ASSERTED present — a refactor that drops the journal
+                # join, the supervised rung, or the K-class crc replay
+                # must fail the bench loudly — with the supervised
+                # reject, the coverage fail-closed, and the K = 2 crc
+                # identity all gated exit 3 (_labels_broken).
+                missing = [
+                    k
+                    for k in (
+                        "labels_supervised_reject",
+                        "labels_coverage_fail_closed",
+                        "labels_kclass_crc_exact",
+                    )
+                    if k not in rec_labels
+                ]
+                if missing:
+                    _emit(
+                        {
+                            "metric": "bench_error",
+                            "error": "labels_fields_missing",
+                            "detail": f"labels record lacks {missing} "
+                            "(labels/ journal/join/gate accounting "
+                            "broken?)",
+                        }
+                    )
+                    raise SystemExit(3)
+                for k in (
+                    "labels_supervised_reject",
+                    "labels_unsupervised_pass",
+                    "labels_coverage_fail_closed",
+                    "labels_kclass_crc_exact",
+                    "labels_joined",
+                    "labels_coverage",
+                    "labels_flip_rate",
+                ):
+                    if k in rec_labels:
+                        extra[k] = rec_labels[k]
+                labels_broken_flag = _labels_broken(rec_labels)
             broken = _check_mfu_floor(
                 {"fed2": rec_fed2, "fedseq": rec_fedseq}
             )
@@ -3965,6 +4222,7 @@ def main() -> None:
                 or profile_broken
                 or fsdp_broken
                 or check_broken
+                or labels_broken_flag
             ):
                 raise SystemExit(3)
         elif mode == "bert":

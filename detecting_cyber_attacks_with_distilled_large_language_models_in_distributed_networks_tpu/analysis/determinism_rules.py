@@ -61,6 +61,10 @@ SCOPE = (
     # match the ascending-id numpy accumulation bit-for-bit.
     "comm/quant.py",
     "ops/fold.py",
+    # Delayed ground-truth plane (ISSUE 18): journal replay and the
+    # scored-records join must rebuild bit-identical state from the
+    # same files — timestamps are caller-supplied, never clock-read.
+    "labels/",
 )
 
 _SEEDED_NP_CTORS = frozenset(

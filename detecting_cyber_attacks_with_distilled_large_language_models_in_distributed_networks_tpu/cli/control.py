@@ -89,12 +89,16 @@ def cmd_controller(args) -> int:
         ("round_deadline", "round_deadline_s"),
         ("max_artifacts", "max_artifacts"),
         ("slo_deadline_factor", "slo_deadline_factor"),
+        ("cohort_min_frac", "cohort_min_frac"),
+        ("cohort_max_frac", "cohort_max_frac"),
     ):
         v = getattr(args, flag, None)
         if v is not None:
             ctl_kw[field_name] = v
     if getattr(args, "adaptive_cadence", False):
         ctl_kw["adaptive_cadence"] = True
+    if getattr(args, "drift_cohort", False):
+        ctl_kw["drift_cohort"] = True
     try:
         ctl = dataclasses.replace(ctl, **ctl_kw) if ctl_kw else ctl
     except ValueError as e:
@@ -112,6 +116,21 @@ def cmd_controller(args) -> int:
             shw_kw[field_name] = v
     try:
         shw = dataclasses.replace(shw, **shw_kw) if shw_kw else shw
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    lbl = cfg.labels
+    lbl_kw = {}
+    for flag, field_name in (
+        ("label_journal", "journal"),
+        ("label_min_joined", "min_joined"),
+        ("label_coverage_floor", "coverage_floor"),
+        ("label_max_regression", "max_regression"),
+    ):
+        v = getattr(args, flag, None)
+        if v is not None:
+            lbl_kw[field_name] = v
+    try:
+        lbl = dataclasses.replace(lbl, **lbl_kw) if lbl_kw else lbl
     except ValueError as e:
         raise SystemExit(str(e)) from None
 
@@ -189,6 +208,39 @@ def cmd_controller(args) -> int:
                 f"{shw.max_flip_rate} and psi <= {shw.psi_threshold} "
                 f"(fail closed after {shw.timeout_s:.0f}s)"
             )
+        label_gate = None
+        error_monitor = None
+        if getattr(args, "label_gate", False):
+            from ..labels import LabelGate
+
+            label_gate = LabelGate(
+                args.registry_dir,
+                journal=lbl.journal,
+                threshold=lbl.threshold,
+                min_joined=lbl.min_joined,
+                coverage_floor=lbl.coverage_floor,
+                max_regression=lbl.max_regression,
+                tracer=tracer,
+            )
+            log.info(
+                f"[CONTROLLER] label gate armed: supervised rung over >= "
+                f"{lbl.min_joined} joined flow(s) at coverage >= "
+                f"{lbl.coverage_floor} (candidate error may exceed "
+                f"serving by <= {lbl.max_regression}; fails closed)"
+            )
+            if getattr(args, "error_drift", False):
+                from ..control import ErrorRateMonitor
+
+                error_monitor = ErrorRateMonitor(
+                    margin=lbl.error_margin,
+                    min_joined=lbl.error_min_joined,
+                )
+                log.info(
+                    f"[CONTROLLER] supervised drift armed: serving error "
+                    f"rising {lbl.error_margin} past its promoted "
+                    f"reference over >= {lbl.error_min_joined} joined "
+                    "flow(s) triggers a round"
+                )
         actuator = None
         if getattr(args, "slo_alerts_jsonl", None):
             from ..control import SloActuator
@@ -212,6 +264,8 @@ def cmd_controller(args) -> int:
             tracer=tracer,
             shadow_gate=shadow_gate,
             slo_actuator=actuator,
+            label_gate=label_gate,
+            error_monitor=error_monitor,
         )
         max_rounds = args.rounds if args.rounds and args.rounds > 0 else None
         log.info(

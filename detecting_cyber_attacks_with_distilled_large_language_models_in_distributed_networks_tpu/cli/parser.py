@@ -69,6 +69,7 @@ from .common import resolve_config
 from .control import cmd_controller, cmd_registry
 from .distill import cmd_distill
 from .federated import cmd_federated
+from .labels import cmd_labels
 from .local import cmd_local
 from .obs import cmd_obs
 from .predict import cmd_export_hf, cmd_predict
@@ -896,6 +897,13 @@ def build_parser() -> argparse.ArgumentParser:
         "carries sampled_batches so the timeline can re-scale). Default "
         "1.0 = every batch, the pre-sampling behavior",
     )
+    p.add_argument(
+        "--scored-jsonl",
+        help="append one {rid, prob, round} record per ANSWERED request "
+        "here — the join key against the delayed ground-truth journal "
+        "(fedtpu labels report --scored X). Off by default: the metrics "
+        "stream keeps exporting binned histograms, never raw scores",
+    )
     _add_flight_dir(p)
     p.set_defaults(fn=cmd_infer_serve)
 
@@ -1215,6 +1223,74 @@ def build_parser() -> argparse.ArgumentParser:
         "--max-interval by each drift verdict's magnitude (barely over "
         "threshold -> relaxed max; >= 2x threshold -> urgent min); the "
         "chosen interval rides the drift-trigger span",
+    )
+    p.add_argument(
+        "--label-gate",
+        action="store_true",
+        help="supervised promotion rung AFTER the shadow gate: join the "
+        "candidate's mirror pairs against the delayed ground-truth "
+        "journal (<registry>/labels/journal.jsonl, fedtpu labels "
+        "ingest) and reject any candidate whose supervised error "
+        "exceeds the incumbent's by more than --label-max-regression; "
+        "too few joined labels or coverage under --label-coverage-floor "
+        "fails closed",
+    )
+    p.add_argument(
+        "--label-journal",
+        help="ground-truth journal override (default: "
+        "<registry>/labels/journal.jsonl)",
+    )
+    p.add_argument(
+        "--label-min-joined",
+        type=int,
+        default=None,
+        help="joined (labeled) flows required before the label gate "
+        "rules (default: config labels.min_joined = 32)",
+    )
+    p.add_argument(
+        "--label-coverage-floor",
+        type=float,
+        default=None,
+        help="minimum joined/total coverage of the scored population "
+        "(default: config labels.coverage_floor = 0.05)",
+    )
+    p.add_argument(
+        "--label-max-regression",
+        type=float,
+        default=None,
+        help="max tolerated candidate-over-serving supervised error "
+        "excess (default: config labels.max_regression = 0)",
+    )
+    p.add_argument(
+        "--error-drift",
+        action="store_true",
+        help="with --label-gate: also trigger rounds when the SERVING "
+        "model's supervised error over joined ground truth rises "
+        "labels.error_margin past its promoted reference (the "
+        "regression score-histogram drift cannot see)",
+    )
+    p.add_argument(
+        "--drift-cohort",
+        action="store_true",
+        help="scale the corrective round's quorum by each drift "
+        "verdict's magnitude between --cohort-min-frac and "
+        "--cohort-max-frac of --min-clients (one round, then the base "
+        "quorum restores); the chosen quorum rides the drift-trigger "
+        "record",
+    )
+    p.add_argument(
+        "--cohort-min-frac",
+        type=float,
+        default=None,
+        help="quorum fraction at barely-over-threshold drift (default: "
+        "config control.cohort_min_frac = 0.5)",
+    )
+    p.add_argument(
+        "--cohort-max-frac",
+        type=float,
+        default=None,
+        help="quorum fraction at >= 2x-threshold drift (default: "
+        "config control.cohort_max_frac = 1.0)",
     )
     p.add_argument(
         "--slo-alerts-jsonl",
@@ -1609,6 +1685,74 @@ def build_parser() -> argparse.ArgumentParser:
         help="machine-readable output instead of the rendered summary",
     )
     p.set_defaults(fn=cmd_shadow)
+
+    p = sub.add_parser(
+        "labels",
+        help="delayed ground-truth plane: ingest | status | report — "
+        "append labeler verdicts to the journal and join them against "
+        "what the models answered",
+        epilog="Reads and appends under the registry directory only "
+        "(<registry>/labels/journal.jsonl plus the shadow plane's "
+        "paired records) — works from any host that mounts it, like "
+        "every other control-plane surface.",
+    )
+    p.add_argument("action", choices=["ingest", "status", "report"])
+    p.add_argument("--registry-dir", required=True)
+    p.add_argument(
+        "--journal",
+        help="ground-truth journal override (default: "
+        "<registry>/labels/journal.jsonl)",
+    )
+    p.add_argument(
+        "--file",
+        help='ingest: JSONL of {"rid", "label", "ts"} labeler records '
+        "(missing ts falls back to --ts, then 0.0)",
+    )
+    p.add_argument("--rid", help="ingest: one request id")
+    p.add_argument(
+        "--label",
+        type=int,
+        default=None,
+        help="ingest: the ground-truth class for --rid (0 = benign; "
+        "any other class is an attack)",
+    )
+    p.add_argument(
+        "--ts",
+        type=float,
+        default=None,
+        help="ingest: labeler timestamp for records that carry none "
+        "(last-writer-wins key; default 0.0)",
+    )
+    p.add_argument(
+        "--watermark",
+        type=float,
+        default=None,
+        help='ingest: advance the monotone "labels complete through T" '
+        "watermark after applying the records",
+    )
+    p.add_argument(
+        "--artifact",
+        help="report: join this artifact's mirror pairs (default: the "
+        "artifact currently under shadow evaluation)",
+    )
+    p.add_argument(
+        "--scored",
+        help="report: join a serving tier's scored-JSONL (infer-serve "
+        "--scored-jsonl) instead of mirror pairs",
+    )
+    p.add_argument(
+        "--threshold",
+        type=float,
+        default=0.5,
+        help="decision threshold the join applies to each model's "
+        "probability (default 0.5)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable output instead of the rendered summary",
+    )
+    p.set_defaults(fn=cmd_labels)
 
     p = sub.add_parser("distill", help="teacher -> student knowledge distillation")
     _add_common(p)

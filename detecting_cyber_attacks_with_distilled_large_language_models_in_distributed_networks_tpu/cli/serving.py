@@ -160,6 +160,7 @@ def cmd_infer_serve(args) -> int:
             else None
         ),
         metrics_jsonl=getattr(args, "metrics_jsonl", None),
+        scored_jsonl=getattr(args, "scored_jsonl", None),
         auth_key=auth_key,
         # The drift contract: serving-score histograms and the promoted
         # artifact's eval reference must bin identically (ControlConfig).

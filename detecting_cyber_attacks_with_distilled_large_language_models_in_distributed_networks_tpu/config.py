@@ -537,6 +537,15 @@ class ControlConfig:
     # already blowing its round SLO should cut stragglers loose sooner,
     # not wait the full budget on them. 1.0 disables the tightening.
     slo_deadline_factor: float = 0.5
+    # Drift-scaled cohort (control/drift.py drift_cohort_fraction): a
+    # fired drift verdict's MAGNITUDE picks the corrective round's
+    # quorum between cohort_min_frac (barely over threshold: a lean,
+    # fast cohort) and cohort_max_frac (>= 2x threshold: the full
+    # quorum's evidence) of the server's configured min_clients — for
+    # ONE round, then the base quorum restores.
+    drift_cohort: bool = False
+    cohort_min_frac: float = 0.5
+    cohort_max_frac: float = 1.0
 
     def __post_init__(self) -> None:
         if not 0.0 < self.slo_deadline_factor <= 1.0:
@@ -580,6 +589,72 @@ class ControlConfig:
             raise ValueError(
                 f"max_interval_s={self.max_interval_s} below "
                 f"min_interval_s={self.min_interval_s}"
+            )
+        if not 0.0 < self.cohort_min_frac <= 1.0:
+            raise ValueError(
+                f"cohort_min_frac={self.cohort_min_frac} must be in (0, 1]"
+            )
+        if not 0.0 < self.cohort_max_frac <= 1.0:
+            raise ValueError(
+                f"cohort_max_frac={self.cohort_max_frac} must be in (0, 1]"
+            )
+        if self.cohort_max_frac < self.cohort_min_frac:
+            raise ValueError(
+                f"cohort_max_frac={self.cohort_max_frac} below "
+                f"cohort_min_frac={self.cohort_min_frac}"
+            )
+
+
+@dataclass(frozen=True)
+class LabelsConfig:
+    """Delayed ground-truth plane (labels/): the journal of late-arriving
+    verdicts about what each scored flow actually WAS, the deterministic
+    join against what the models ANSWERED, and the supervised promotion
+    rung the join feeds. The reference has no feedback path at all once
+    a model serves — nothing ever tells it it was wrong."""
+
+    #: Ground-truth journal override (default:
+    #: ``<registry>/labels/journal.jsonl`` — labels/store.journal_path).
+    journal: str | None = None
+    #: Decision threshold the join applies to both models' probabilities.
+    threshold: float = 0.5
+    #: Minimum joined (labeled) flows before the supervised gate may
+    #: rule; fewer FAILS CLOSED.
+    min_joined: int = 32
+    #: Minimum joined/total coverage of the scored population; below it
+    #: the gate FAILS CLOSED (a verdict over a sliver is noise).
+    coverage_floor: float = 0.05
+    #: Max tolerated candidate-over-serving supervised error excess.
+    max_regression: float = 0.0
+    #: Supervised drift margin (control/drift.py ErrorRateMonitor): the
+    #: serving model's joined error rising this far past its promoted
+    #: reference fires a corrective round.
+    error_margin: float = 0.05
+    #: Joined observations the error monitor needs before it may fire.
+    error_min_joined: int = 64
+
+    def __post_init__(self) -> None:
+        if not 0.0 < self.threshold < 1.0:
+            raise ValueError(
+                f"threshold={self.threshold} must be in (0, 1)"
+            )
+        if self.min_joined < 1:
+            raise ValueError(f"min_joined={self.min_joined} must be >= 1")
+        if not 0.0 <= self.coverage_floor <= 1.0:
+            raise ValueError(
+                f"coverage_floor={self.coverage_floor} must be in [0, 1]"
+            )
+        if self.max_regression < 0.0:
+            raise ValueError(
+                f"max_regression={self.max_regression} must be >= 0"
+            )
+        if self.error_margin <= 0.0:
+            raise ValueError(
+                f"error_margin={self.error_margin} must be > 0"
+            )
+        if self.error_min_joined < 1:
+            raise ValueError(
+                f"error_min_joined={self.error_min_joined} must be >= 1"
             )
 
 
@@ -818,6 +893,7 @@ class ExperimentConfig:
     obs: ObsConfig = field(default_factory=ObsConfig)
     router: RouterConfig = field(default_factory=RouterConfig)
     shadow: ShadowConfig = field(default_factory=ShadowConfig)
+    labels: LabelsConfig = field(default_factory=LabelsConfig)
     output_dir: str = "outputs"
     checkpoint_dir: str | None = None
 
@@ -863,6 +939,7 @@ class ExperimentConfig:
             "obs": ObsConfig,
             "router": RouterConfig,
             "shadow": ShadowConfig,
+            "labels": LabelsConfig,
         }
         scalars = ("output_dir", "checkpoint_dir")
         unknown_top = set(d) - set(sections) - set(scalars)

@@ -25,14 +25,23 @@ objective. This package is that loop:
 """
 
 from .controller import Controller, ControllerStats, SloActuator
-from .drift import DriftMonitor, cadence_interval_s, ks_distance, psi
+from .drift import (
+    DriftMonitor,
+    ErrorRateMonitor,
+    cadence_interval_s,
+    drift_cohort_fraction,
+    ks_distance,
+    psi,
+)
 
 __all__ = [
     "Controller",
     "ControllerStats",
     "DriftMonitor",
+    "ErrorRateMonitor",
     "SloActuator",
     "cadence_interval_s",
+    "drift_cohort_fraction",
     "ks_distance",
     "psi",
 ]

@@ -41,7 +41,12 @@ from ..obs import metrics as obs_metrics
 from ..registry import ModelRegistry, RegistryError
 from ..train.fedeval import eval_gate, reference_histogram
 from ..utils.logging import get_logger
-from .drift import DriftMonitor, cadence_interval_s
+from .drift import (
+    DriftMonitor,
+    ErrorRateMonitor,
+    cadence_interval_s,
+    drift_cohort_fraction,
+)
 
 log = get_logger()
 
@@ -128,6 +133,9 @@ class ControllerStats:
     #: Candidates that passed offline eval but FAILED the live shadow
     #: disagreement gate (shadow/) — rejected with the verdict recorded.
     shadow_rejections: int = 0
+    #: Candidates that FAILED the supervised label gate (labels/) —
+    #: wrong against delayed ground truth where the incumbent was right.
+    label_rejections: int = 0
     drift_triggers: int = 0
     #: round-engine wall seconds (inside serve_round) vs full cycle wall:
     #: the orchestration overhead the bench record reports.
@@ -160,6 +168,8 @@ class Controller:
         tracer=None,
         shadow_gate=None,
         slo_actuator: SloActuator | None = None,
+        label_gate=None,
+        error_monitor: ErrorRateMonitor | None = None,
     ):
         if getattr(server, "dp_clip", 0.0) > 0.0:
             raise ValueError(
@@ -182,7 +192,24 @@ class Controller:
         # round-duration alert tightening the straggler deadline.
         self.shadow_gate = shadow_gate
         self.slo_actuator = slo_actuator
+        # Label gate (labels/join.py): the SUPERVISED rung after the
+        # shadow gate — candidate-vs-serving error over joined delayed
+        # ground truth, failing closed below the coverage floor. The
+        # error monitor (control/drift.py ErrorRateMonitor) turns the
+        # same joined evidence into a drift trigger: the serving model's
+        # supervised error rising past its promoted reference fires a
+        # corrective round even when score histograms look stable.
+        self.label_gate = label_gate
+        self.error_monitor = error_monitor
         self.stats = ControllerStats()
+        # Drift-scaled cohort: a drift verdict's magnitude picks the
+        # NEXT round's quorum between the configured fractions of the
+        # server's base min_clients (mild drift -> lean fast cohort,
+        # severe drift -> the full quorum's evidence).
+        self._base_min_clients: int | None = getattr(
+            server, "min_clients", None
+        )
+        self._cohort_override: int | None = None
         # Adaptive cadence: a drift verdict's magnitude sets the NEXT
         # inter-round throttle (None = the configured min_interval_s).
         self._interval_override: float | None = None
@@ -208,6 +235,10 @@ class Controller:
         self._m_shadow_rejections = m.counter(
             "fedtpu_controller_shadow_rejections_total",
             help="candidates rejected by the live shadow disagreement gate",
+        )
+        self._m_label_rejections = m.counter(
+            "fedtpu_controller_label_rejections_total",
+            help="candidates rejected by the supervised label gate",
         )
         self._m_drift_triggers = m.counter(
             "fedtpu_controller_drift_triggers_total",
@@ -246,6 +277,7 @@ class Controller:
                 "promoted",
                 "gate_rejected",
                 "shadow_rejected",
+                "label_rejected",
                 "promote_noop",
                 "round_noop",
                 "round_failed",
@@ -254,7 +286,7 @@ class Controller:
                 self.stats.rounds_attempted += 1
             if ev in (
                 "promoted", "gate_rejected", "shadow_rejected",
-                "promote_noop", "cycle_error",
+                "label_rejected", "promote_noop", "cycle_error",
             ):
                 self.stats.rounds_completed += 1
             if ev == "promoted":
@@ -263,6 +295,8 @@ class Controller:
                 self.stats.gate_rejections += 1
             elif ev == "shadow_rejected":
                 self.stats.shadow_rejections += 1
+            elif ev == "label_rejected":
+                self.stats.label_rejections += 1
             elif ev == "round_failed":
                 self.stats.rounds_failed += 1
             elif ev == "drift_trigger":
@@ -357,12 +391,36 @@ class Controller:
                         f"{verdict['drift']:.4f} -> next interval "
                         f"{next_interval:.1f}s"
                     )
+                # Drift-scaled cohort: the verdict's magnitude picks the
+                # corrective round's quorum (applied to the server for
+                # ONE round in run_cycle, then restored).
+                cohort = None
+                if c.drift_cohort and self._base_min_clients:
+                    frac = drift_cohort_fraction(
+                        verdict["drift"],
+                        threshold=self.drift.threshold,
+                        min_frac=c.cohort_min_frac,
+                        max_frac=c.cohort_max_frac,
+                    )
+                    base = int(self._base_min_clients)
+                    cohort = max(1, min(base, int(round(base * frac))))
+                    self._cohort_override = cohort
+                    log.info(
+                        f"[CONTROLLER] drift-scaled cohort: drift "
+                        f"{verdict['drift']:.4f} -> quorum {cohort}/{base} "
+                        "for the corrective round"
+                    )
                 self._record(
                     "drift_trigger",
                     **verdict,
                     **(
                         {"next_interval_s": round(next_interval, 3)}
                         if next_interval is not None
+                        else {}
+                    ),
+                    **(
+                        {"cohort_target": cohort}
+                        if cohort is not None
                         else {}
                     ),
                 )
@@ -387,6 +445,33 @@ class Controller:
                         ),
                     )
                 return "drift"
+            if self.error_monitor is not None:
+                # Supervised drift: the serving model's error over joined
+                # delayed ground truth rising past its promoted reference
+                # — the regression score histograms cannot see (the model
+                # can be confidently, stably WRONG).
+                sup = self.error_monitor.check()
+                if sup is not None:
+                    self.stats.drift_triggers += 1
+                    self._m_drift_triggers.inc()
+                    self._record("drift_trigger", **sup)
+                    if self.tracer is not None:
+                        self.tracer.record(
+                            "drift-trigger",
+                            t_start=time.time(),
+                            dur_s=0.0,
+                            round=self._next_round,
+                            drift=sup["drift"],
+                            method=sup["method"],
+                            scores=sup["scores"],
+                        )
+                    log.info(
+                        f"[CONTROLLER] supervised drift: serving error "
+                        f"{sup['error']:.4f} vs reference "
+                        f"{sup['reference_error']:.4f} over "
+                        f"{sup['scores']} joined flow(s)"
+                    )
+                    return "drift"
             if (
                 effective_max is not None
                 and time.monotonic() - start >= effective_max
@@ -425,6 +510,11 @@ class Controller:
                     "the alert clears"
                 )
                 deadline = tightened
+        cohort = self._cohort_override
+        if cohort is not None and self._base_min_clients:
+            # One corrective round at the drift-scaled quorum; the base
+            # quorum restores whatever the round's outcome.
+            self.server.min_clients = cohort
         try:
             t0 = time.monotonic()
             agg = self.server.serve_round(
@@ -442,6 +532,10 @@ class Controller:
             self._record("round_failed", **rec)
             log.info(f"[CONTROLLER] round {r} failed: {e}")
             return {"event": "round_failed", **rec}
+        finally:
+            if cohort is not None and self._base_min_clients:
+                self.server.min_clients = int(self._base_min_clients)
+                self._cohort_override = None
         self.stats.round_wall_s += round_wall
         if agg is None:
             rec = {"round": r, "trigger": trigger}
@@ -601,6 +695,47 @@ class Controller:
                     + (f" ({rec['incumbent']})" if rec["incumbent"] else "")
                 )
                 return {"event": "shadow_rejected", **rec}
+        sup_candidate_err: float | None = None
+        if self.label_gate is not None:
+            # The supervised rung (labels/join.py): the candidate's
+            # mirror pairs joined against delayed ground truth. A
+            # candidate that flips nothing (clean flip-rate/PSI) but is
+            # WRONG where the incumbent was right fails exactly here —
+            # and "not enough joined labels" fails closed, never open.
+            ok_sup, sup = self.label_gate.evaluate(aid)
+            rec["label_verdict"] = {
+                k: sup.get(k)
+                for k in (
+                    "joined", "coverage", "serving_error",
+                    "candidate_error", "reason",
+                )
+            }
+            if (
+                self.error_monitor is not None
+                and sup.get("serving_error") is not None
+            ):
+                # The same joined evidence doubles as the supervised
+                # drift monitor's observation of the SERVING model.
+                joined_n = int(sup.get("joined") or 0)
+                self.error_monitor.observe(
+                    int(round(float(sup["serving_error"]) * joined_n)),
+                    joined_n,
+                )
+            if not ok_sup:
+                self.stats.label_rejections += 1
+                self._m_label_rejections.inc()
+                self.registry.reject(aid, reason=sup["reason"], verdict=sup)
+                self._maybe_gc()
+                rec["incumbent"] = incumbent["id"] if incumbent else None
+                self._record("label_rejected", **rec)
+                log.info(
+                    f"[CONTROLLER] round {r}: candidate {aid} REJECTED by "
+                    f"the supervised label gate ({sup['reason']}); serving "
+                    "pointer unchanged"
+                    + (f" ({rec['incumbent']})" if rec["incumbent"] else "")
+                )
+                return {"event": "label_rejected", **rec}
+            sup_candidate_err = sup.get("candidate_error")
         try:
             self.registry.promote(aid, to="serving")
         except RegistryError as e:
@@ -623,6 +758,11 @@ class Controller:
         rec["promotion_latency_s"] = round(latency, 4)
         if self.drift is not None and eval_hist is not None:
             self.drift.set_reference(eval_hist)
+        if self.error_monitor is not None and sup_candidate_err is not None:
+            # The newly promoted model's supervised error anchors the
+            # error-rate drift reference (the analogue of re-anchoring
+            # the score-histogram reference above).
+            self.error_monitor.set_reference(float(sup_candidate_err))
         self._maybe_gc()
         self._record("promoted", **rec)
         log.info(

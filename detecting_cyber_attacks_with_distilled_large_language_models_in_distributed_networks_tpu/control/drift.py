@@ -142,6 +142,41 @@ def cadence_interval_s(
     return float(max_s) - (float(max_s) - min_s) * frac
 
 
+def drift_cohort_fraction(
+    drift: float,
+    *,
+    threshold: float,
+    min_frac: float,
+    max_frac: float,
+    urgency_span: float = 2.0,
+) -> float:
+    """Drift-scaled client sampling: map a fired verdict's drift
+    MAGNITUDE to the fraction of the fleet the next round must hear
+    from (ISSUE 18 — cadence already adapts via
+    :func:`cadence_interval_s`; cohort SIZE now does too).
+
+    The inverse shape of the cadence map: at the bare threshold the
+    round keeps the small steady-state quorum (``min_frac`` of the
+    fleet — a routine refresh), at ``urgency_span * threshold`` or
+    beyond it demands ``max_frac`` (a new attack family needs the
+    widest, most representative update the fleet can produce — exactly
+    when label-skewed non-IID cohorts mislead the most). Pure
+    arithmetic, same interpolation discipline as the cadence map, so
+    one unit test pins both ends and the midpoint.
+    """
+    min_frac = min(max(float(min_frac), 0.0), 1.0)
+    max_frac = min(max(float(max_frac), 0.0), 1.0)
+    if max_frac <= min_frac:
+        return min_frac
+    threshold = float(threshold)
+    hi = threshold * float(urgency_span)
+    if hi <= threshold:
+        return max_frac
+    frac = (float(drift) - threshold) / (hi - threshold)
+    frac = min(max(frac, 0.0), 1.0)
+    return min_frac + (max_frac - min_frac) * frac
+
+
 def ks_distance(expected: Any, observed: Any) -> float:
     """Max absolute CDF gap between two count histograms (same binning)."""
     e = _fractions(expected)
@@ -322,6 +357,103 @@ class DriftMonitor:
         log.info(
             f"[DRIFT] {self.method}={d:.4f} >= {self.threshold} over {n} "
             f"live scores — triggering a training round (moved: {where})"
+        )
+        self.reset_window()
+        return verdict
+
+
+class ErrorRateMonitor:
+    """Supervised drift: the serving model's measured error over joined
+    ground truth (labels/join.py) vs its reference error.
+
+    PSI/KS fire when the traffic stops LOOKING like the validation
+    split; they are blind to traffic that looks the same but is now
+    labeled differently (an attack family the model scores cold —
+    volatile encrypted-flow distributions make the score-only trigger
+    noisy in both directions). This monitor consumes the delayed
+    ground-truth plane instead: ingest joined ``(wrong, total)`` counts
+    — e.g. a join report's serving-side verdict — and fire once enough
+    joined flows accumulated AND the error rate exceeds the reference
+    by ``margin``. Same lifecycle as :class:`DriftMonitor`: a fired
+    verdict resets the window, and the controller re-references on each
+    promotion (the new model's error anchors the next comparison).
+    """
+
+    def __init__(
+        self,
+        *,
+        reference_error: float | None = None,
+        margin: float = 0.05,
+        min_joined: int = 64,
+    ):
+        if float(margin) <= 0.0:
+            raise ValueError(f"margin={margin} must be > 0")
+        if int(min_joined) < 1:
+            raise ValueError(f"min_joined={min_joined} must be >= 1")
+        self.margin = float(margin)
+        self.min_joined = int(min_joined)
+        self._ref: float | None = None
+        self._wrong = 0
+        self._total = 0
+        if reference_error is not None:
+            self.set_reference(reference_error)
+
+    # ------------------------------------------------------------ ingestion
+    def set_reference(self, error: float) -> None:
+        if not 0.0 <= float(error) <= 1.0:
+            raise ValueError(f"reference error {error} must be in [0, 1]")
+        self._ref = float(error)
+        self.reset_window()
+
+    def reset_window(self) -> None:
+        self._wrong = 0
+        self._total = 0
+
+    @property
+    def has_reference(self) -> bool:
+        return self._ref is not None
+
+    @property
+    def observed_joined(self) -> int:
+        return self._total
+
+    def observe(self, wrong: int, total: int) -> None:
+        if int(wrong) < 0 or int(total) < int(wrong):
+            raise ValueError(
+                f"need 0 <= wrong <= total, got wrong={wrong} total={total}"
+            )
+        self._wrong += int(wrong)
+        self._total += int(total)
+
+    def observe_verdict(self, verdict: Any) -> None:
+        """Ingest one supervised verdict dict (labels/join.py
+        ``supervised_verdict`` shape: ``n`` joined flows, ``error``)."""
+        n = int(verdict.get("n", 0) or 0)
+        err = verdict.get("error")
+        if n > 0 and err is not None:
+            self.observe(round(float(err) * n), n)
+
+    # -------------------------------------------------------------- verdict
+    def check(self) -> dict | None:
+        """Fire when >= min_joined flows joined and the measured error
+        exceeds reference + margin. A fired verdict resets the window."""
+        if self._ref is None or self._total < self.min_joined:
+            return None
+        err = self._wrong / self._total
+        if err < self._ref + self.margin:
+            return None
+        verdict = {
+            "drift": round(err - self._ref, 6),
+            "method": "error_rate",
+            "threshold": self.margin,
+            "scores": self._total,
+            "error": round(err, 6),
+            "reference_error": round(self._ref, 6),
+        }
+        log.info(
+            f"[DRIFT] supervised error {err:.4f} >= reference "
+            f"{self._ref:.4f} + {self.margin} over {self._total} joined "
+            "flow(s) — triggering a training round"
         )
         self.reset_window()
         return verdict

@@ -48,7 +48,7 @@ UNSW_TEMPLATE: tuple[tuple[str, str, str], ...] = (
 
 @dataclass(frozen=True)
 class DatasetSpec:
-    """One dataset's text template + binary-label semantics."""
+    """One dataset's text template + label semantics."""
 
     name: str
     template: tuple[tuple[str, str, str], ...]
@@ -56,9 +56,16 @@ class DatasetSpec:
     #: "positive"   — label == positive_value -> 1 (CICIDS2017 semantics)
     #: "not_benign" — label != benign_value  -> 1 (multi-attack-class sets)
     #: "int"        — label column already 0/1
+    #: "multiclass" — label -> index into ``classes`` (K-class plane;
+    #:                class 0 is benign by convention, so the binary map
+    #:                stays ``label != benign_value``)
     label_kind: str
     positive_value: str = "DDoS"
     benign_value: str = "BENIGN"
+    #: Ordered class vocabulary for the K-class plane (``multiclass``
+    #: specs only). Class 0 MUST be the benign value — every consumer
+    #: (serving score plane, supervised join) binarizes as ``!= 0``.
+    classes: tuple[str, ...] | None = None
 
     def render_texts(self, df: pd.DataFrame) -> list[str]:
         missing = [c for _, c, _ in self.template if c not in df.columns]
@@ -82,11 +89,46 @@ class DatasetSpec:
         if self.label_kind == "positive":
             pos = positive_value or self.positive_value
             return (df[col] == pos).to_numpy().astype(np.int32)
-        if self.label_kind == "not_benign":
+        if self.label_kind in ("not_benign", "multiclass"):
             return (df[col] != self.benign_value).to_numpy().astype(np.int32)
         if self.label_kind == "int":
             return df[col].to_numpy().astype(np.int32)
         raise ValueError(f"unknown label_kind {self.label_kind!r}")
+
+    def class_labels(self, df: pd.DataFrame) -> np.ndarray:
+        """K-class label indices into ``classes`` (``multiclass`` specs).
+
+        Strays fail loudly: a label value outside the declared vocabulary
+        silently mapped to some class would corrupt every per-class count
+        downstream."""
+        if self.label_kind != "multiclass" or not self.classes:
+            raise ValueError(
+                f"dataset {self.name!r} is not a multiclass spec"
+            )
+        col = self.label_column
+        if col not in df.columns:
+            raise KeyError(f"dataset {self.name!r}: no label column {col!r}")
+        index = {v: i for i, v in enumerate(self.classes)}
+        values = df[col].astype(str).to_numpy()
+        stray = sorted({v for v in values if v not in index})
+        if stray:
+            raise ValueError(
+                f"dataset {self.name!r}: labels {stray[:8]} not in the "
+                f"declared class vocabulary {list(self.classes)}"
+            )
+        return np.array([index[v] for v in values], dtype=np.int32)
+
+    def labels(self, df: pd.DataFrame) -> np.ndarray:
+        """The spec's native label array: K-class indices for multiclass
+        specs, 0/1 otherwise — what :func:`corpus_from_frame` feeds the
+        (K-generic) training pipeline."""
+        if self.label_kind == "multiclass":
+            return self.class_labels(df)
+        return self.binary_labels(df)
+
+    @property
+    def n_classes(self) -> int:
+        return len(self.classes) if self.classes else 2
 
     @property
     def feature_columns(self) -> tuple[str, ...]:
@@ -116,8 +158,30 @@ UNSWNB15 = DatasetSpec(
     label_kind="int",
 )
 
+#: The multi-class CICIDS attack-day preset (ISSUE 18): the CIC-DDoS2019
+#: day keeps per-attack labels instead of collapsing them to 0/1 — the
+#: K-class plane the generalized train/eval head consumes. Class 0 is
+#: BENIGN; the attack order matches data/synthetic.py DDOS2019_ATTACKS
+#: so the synthetic generator round-trips without a remap.
+CICDDOS2019_MC = DatasetSpec(
+    name="cicddos2019-mc",
+    template=CICIDS_TEMPLATE,
+    label_column="Label",
+    label_kind="multiclass",
+    benign_value="BENIGN",
+    classes=(
+        "BENIGN",
+        "DrDoS_DNS",
+        "DrDoS_LDAP",
+        "DrDoS_NTP",
+        "DrDoS_UDP",
+        "Syn",
+        "UDP-lag",
+    ),
+)
+
 DATASETS: dict[str, DatasetSpec] = {
-    s.name: s for s in (CICIDS2017, CICDDOS2019, UNSWNB15)
+    s.name: s for s in (CICIDS2017, CICDDOS2019, UNSWNB15, CICDDOS2019_MC)
 }
 
 
@@ -191,7 +255,7 @@ def corpus_from_frame(
 ) -> Corpus:
     return Corpus(
         texts=spec.render_texts(df),
-        labels=spec.binary_labels(df),
+        labels=spec.labels(df),
         source=np.full(len(df), source_id, np.int32),
         source_names=(spec.name,),
     )

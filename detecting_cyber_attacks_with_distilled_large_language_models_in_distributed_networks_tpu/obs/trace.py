@@ -69,6 +69,13 @@ Span vocabulary (names are the contract the timeline tool groups by)::
     shadow-gate   the controller's live disagreement verdict for a
                   shadow-state candidate (shadow/gate.py), with
                   ``artifact``/``passed``/``pairs``/``flip_rate``/``psi``
+    label-join    one deterministic join of scored-request records
+                  against the ground-truth journal (labels/join.py),
+                  with ``total``/``joined``/``coverage``
+    label-gate    the controller's SUPERVISED verdict for a shadow-state
+                  candidate over joined ground truth (labels/join.py),
+                  with ``artifact``/``passed``/``joined``/``coverage``/
+                  ``serving_error``/``candidate_error``
 
 Timestamps are wall-clock unix seconds (``ts``) with a separately
 measured monotonic duration (``dur_s``): cross-process correlation needs
@@ -113,6 +120,8 @@ SPAN_NAMES = (
     "shadow-mirror",
     "shadow-compare",
     "shadow-gate",
+    "label-join",
+    "label-gate",
 )
 
 #: Wire meta key the trace id rides under (comm/server.py reply meta,

@@ -36,6 +36,7 @@ from .client import (
     ScoreRejected,
     ScoringClient,
     fetch_stats,
+    load_arrival_trace,
     run_load,
 )
 from .engine import ScoreEngine
@@ -65,6 +66,7 @@ __all__ = [
     "build_reply",
     "build_request",
     "fetch_stats",
+    "load_arrival_trace",
     "parse_reject",
     "parse_reply",
     "parse_request",

@@ -577,6 +577,24 @@ def fetch_stats(
         return cli.stats()
 
 
+def load_arrival_trace(path: str) -> list[float]:
+    """Read a recorded inter-arrival trace: one non-negative gap (in
+    seconds) per line, blank lines and ``#`` comments skipped. The
+    bench fixtures ship a tiny bursty trace in this format."""
+    gaps: list[float] = []
+    with open(path) as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            gaps.append(float(line))
+    if not gaps:
+        raise ValueError(f"arrival trace {path!r} has no gaps")
+    if any(g < 0.0 for g in gaps):
+        raise ValueError(f"arrival trace {path!r} has negative gaps")
+    return gaps
+
+
 def run_load(
     host: str,
     port: int,
@@ -589,6 +607,7 @@ def run_load(
     auth_key: bytes | None = None,
     pipeline: int = 1,
     target_qps: float | None = None,
+    arrival_trace: Sequence[float] | None = None,
 ) -> dict:
     """Load generator: ``concurrency`` connections scoring the next text
     round-robin until ``requests`` total (default: one pass over
@@ -604,12 +623,38 @@ def run_load(
     replies come back, which is how you measure a latency distribution
     AT a load point instead of the closed loop's self-throttled
     equilibrium; pacing implies pipelining (a paced sender must not
-    block on the previous reply)."""
+    block on the previous reply).
+
+    ``arrival_trace`` replays a RECORDED inter-arrival pattern instead
+    of a constant rate: gap ``j`` (seconds) separates request ``j`` from
+    request ``j+1`` on the fleet-wide schedule, and the trace wraps
+    whole-cycle when ``requests`` outruns it — a bursty recording stays
+    bursty for the whole run. Open-loop like ``target_qps`` (the two are
+    mutually exclusive), so the tail the service shows under real burst
+    shapes is measurable, not the closed loop's smoothed-out version."""
     total = len(texts) if requests is None else int(requests)
     pipeline = max(1, int(pipeline))
     if target_qps is not None:
         if target_qps <= 0:
             raise ValueError(f"target_qps={target_qps} must be > 0")
+        pipeline = max(pipeline, 32)  # pacing must not block on replies
+    arrival_base: np.ndarray | None = None
+    arrival_cycle = 0.0
+    if arrival_trace is not None:
+        if target_qps is not None:
+            raise ValueError(
+                "arrival_trace and target_qps are mutually exclusive "
+                "(both fix the fleet-wide send schedule)"
+            )
+        gaps = np.asarray(list(arrival_trace), np.float64)
+        if gaps.size == 0:
+            raise ValueError("arrival_trace is empty")
+        if (gaps < 0.0).any():
+            raise ValueError("arrival_trace gaps must be >= 0")
+        # Request j fires at the cumulative offset of the gaps BEFORE
+        # it; past the recorded horizon the whole cycle repeats.
+        arrival_base = np.concatenate(([0.0], np.cumsum(gaps[:-1])))
+        arrival_cycle = float(gaps.sum())
         pipeline = max(pipeline, 32)  # pacing must not block on replies
     idx = iter(range(total))
     idx_lock = threading.Lock()
@@ -684,6 +729,17 @@ def run_load(
                     delay = (t_sched + i / target_qps) - time.monotonic()
                     if delay > 0:
                         time.sleep(delay)
+                elif arrival_base is not None:
+                    # Recorded schedule: request i fires at its trace
+                    # offset (whole cycles past the recorded horizon).
+                    n_base = len(arrival_base)
+                    offset = (
+                        (i // n_base) * arrival_cycle
+                        + arrival_base[i % n_base]
+                    )
+                    delay = (t_sched + offset) - time.monotonic()
+                    if delay > 0:
+                        time.sleep(delay)
                 t0 = time.monotonic()
                 fut = cli.submit(
                     text=texts[i % len(texts)], deadline_ms=deadline_ms
@@ -729,6 +785,12 @@ def run_load(
         "wall_s": wall,
         "flows_per_sec": len(latencies) / wall,
         "target_qps": target_qps,
+        "arrival_trace_len": (
+            len(arrival_base) if arrival_base is not None else None
+        ),
+        "arrival_cycle_s": (
+            arrival_cycle if arrival_base is not None else None
+        ),
         "pipeline": pipeline,
         "mean_batch": float(np.mean(batch_sizes)) if batch_sizes else 0.0,
         "max_batch": max(batch_sizes, default=0),

@@ -136,6 +136,7 @@ class ScoringServer:
         default_deadline_s: float | None = None,
         idle_tick_s: float = 0.05,
         metrics_jsonl: str | None = None,
+        scored_jsonl: str | None = None,
         warmup: bool = True,
         latency_window: int = 100_000,
         auth_key: bytes | None = None,
@@ -172,6 +173,14 @@ class ScoringServer:
         self.default_deadline_s = default_deadline_s
         self.idle_tick_s = float(idle_tick_s)
         self.metrics_jsonl = metrics_jsonl
+        # Opt-in scored-record export (labels/join.py's serving-side
+        # stream): one line per ANSWERED request carrying the request id
+        # and the raw probability — the join key against the delayed
+        # ground-truth journal. Off by default: the metrics stream's
+        # "binned counts, never raw scores" contract is unchanged; this
+        # channel exists precisely because supervised evaluation needs
+        # the per-request answer.
+        self.scored_jsonl = scored_jsonl
         self._warmup = warmup
         self._closed = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -694,6 +703,23 @@ class ScoringServer:
                     bucket=bucket,
                     queue_ms=(now - r.t_enqueue) * 1e3,
                 )
+            if self.scored_jsonl:
+                import json as _json
+
+                from ..obs.trace import append_jsonl_line
+
+                for r, p in zip(live, probs):
+                    append_jsonl_line(
+                        self.scored_jsonl,
+                        _json.dumps(
+                            {
+                                "schema": "fedtpu-scored-v1",
+                                "rid": str(r.req_id),
+                                "prob": round(float(p), 6),
+                                "round": round_id,
+                            }
+                        ),
+                    )
             if self.tracer is not None and (
                 # Counter-stride sampling: batch 1, 1+stride, 1+2*stride,
                 # ... (self._batches was already incremented above, so

@@ -113,6 +113,7 @@ class ShadowCompare:
         max_pending: int = 8192,
         tracer=None,
         span_stride: int = 64,
+        candidates: tuple[str, ...] = (),
     ):
         if not 0.0 < float(threshold) < 1.0:
             raise ValueError(f"threshold={threshold} must be in (0, 1)")
@@ -125,13 +126,26 @@ class ShadowCompare:
         self.max_pending = max(1, int(max_pending))
         self.tracer = tracer
         self._span_stride = max(1, int(span_stride))
+        # Ranked candidate list (ISSUE 18): rank 0 is the GATED candidate
+        # — the aggregate stats (what status.json and the gate rule on)
+        # cover rank 0 only, so striding mirrored traffic across extra
+        # candidates never dilutes the promotion verdict. Empty = the
+        # single-candidate shape, where every pair is rank 0.
+        self.candidates = tuple(str(c) for c in candidates)
         self._lock = threading.Lock()
         # Serializes write_status: two reply threads completing pairs
         # concurrently would share the per-pid tmp name, and the loser's
         # os.replace would find its tmp already consumed.
         self._status_lock = threading.Lock()
-        # mid -> (side, prob); insertion-ordered so overflow drops oldest.
-        self._open: dict[int, tuple[str, float]] = {}
+        # mid -> (side, prob, cand); insertion-ordered so overflow drops
+        # oldest.
+        self._open: dict[int, tuple[str, float, int]] = {}
+        # mid -> request id (the serving tier's stamp), registered at
+        # admission so completed pairs carry the join key the ground-
+        # truth plane (labels/join.py) matches on. Bounded like _open.
+        self._rids: dict[int, str] = {}
+        # rank -> [pairs, flips] — per-candidate accounting.
+        self._cand: dict[int, list[int]] = {}
         self._bins = int(bins)
         self._hist_serving = np.zeros(int(bins), np.int64)
         self._hist_shadow = np.zeros(int(bins), np.int64)
@@ -151,18 +165,32 @@ class ShadowCompare:
         )
 
     # -------------------------------------------------------------- ingestion
-    def note_serving(self, mid: int, prob: float) -> None:
-        self._note(mid, "serving", prob)
+    def register_rid(self, mid: int, rid: str) -> None:
+        """Attach the live request's id (the serving tier's stamp) to a
+        mirror id at admission, so the completed pair record carries the
+        ground-truth join key. Bounded like the half-open dict."""
+        with self._lock:
+            if len(self._rids) >= 2 * self.max_pending:
+                oldest = next(iter(self._rids))
+                del self._rids[oldest]
+            self._rids[int(mid)] = str(rid)
 
-    def note_shadow(self, mid: int, prob: float) -> None:
-        self._note(mid, "shadow", prob)
+    def note_serving(self, mid: int, prob: float) -> None:
+        self._note(mid, "serving", prob, 0)
+
+    def note_shadow(self, mid: int, prob: float, cand: int = 0) -> None:
+        """The shadow side of a pair; ``cand`` is the candidate's RANK
+        when the mirror strides across a ranked list (0 = the gated
+        candidate — the only rank the aggregate verdict counts)."""
+        self._note(mid, "shadow", prob, int(cand))
 
     def abandon(self, mid: int) -> None:
         with self._lock:
+            self._rids.pop(mid, None)
             if self._open.pop(mid, None) is not None:
                 self._abandoned += 1
 
-    def _note(self, mid: int, side: str, prob: float) -> None:
+    def _note(self, mid: int, side: str, prob: float, cand: int) -> None:
         p = float(prob)
         rec = None
         with self._lock:
@@ -173,33 +201,44 @@ class ShadowCompare:
                     # a one-sided flood must not grow memory unbounded.
                     oldest = next(iter(self._open))
                     del self._open[oldest]
+                    self._rids.pop(oldest, None)
                     self._pending_dropped += 1
-                self._open[mid] = (side, p)
+                self._open[mid] = (side, p, cand)
                 return
             if other[0] == side:
                 # Duplicate arrival on one side (a retried mirror send):
                 # keep the first value, stay half-open.
                 return
             del self._open[mid]
+            rid = self._rids.pop(mid, None)
             serving = p if side == "serving" else other[1]
             shadow = p if side == "shadow" else other[1]
+            # The pair's candidate rank rides the SHADOW side (the
+            # serving side has no candidate identity).
+            rank = cand if side == "shadow" else other[2]
             flip = (serving >= self.threshold) != (shadow >= self.threshold)
-            self._pairs += 1
+            cstat = self._cand.setdefault(rank, [0, 0])
+            cstat[0] += 1
             if flip:
-                self._flips += 1
-            self._abs_dprob_sum += abs(serving - shadow)
-            # Fixed [0, 1] bins: one multiply + clamp per scalar — the
-            # np.histogram machinery is array-sized overkill on a path
-            # that runs once per pair (p == 1.0 lands in the top bin,
-            # matching the closed right edge everywhere else).
-            self._hist_serving[
-                min(int(min(max(serving, 0.0), 1.0) * self._bins),
-                    self._bins - 1)
-            ] += 1
-            self._hist_shadow[
-                min(int(min(max(shadow, 0.0), 1.0) * self._bins),
-                    self._bins - 1)
-            ] += 1
+                cstat[1] += 1
+            primary = rank == 0
+            if primary:
+                self._pairs += 1
+                if flip:
+                    self._flips += 1
+                self._abs_dprob_sum += abs(serving - shadow)
+                # Fixed [0, 1] bins: one multiply + clamp per scalar — the
+                # np.histogram machinery is array-sized overkill on a path
+                # that runs once per pair (p == 1.0 lands in the top bin,
+                # matching the closed right edge everywhere else).
+                self._hist_serving[
+                    min(int(min(max(serving, 0.0), 1.0) * self._bins),
+                        self._bins - 1)
+                ] += 1
+                self._hist_shadow[
+                    min(int(min(max(shadow, 0.0), 1.0) * self._bins),
+                        self._bins - 1)
+                ] += 1
             pairs_now = self._pairs
             rec = {
                 "schema": PAIR_SCHEMA,
@@ -208,6 +247,10 @@ class ShadowCompare:
                 "shadow_prob": shadow,
                 "flip": int(flip),
             }
+            if rid is not None:
+                rec["rid"] = rid
+            if rank:
+                rec["cand"] = int(rank)
         self._m_pairs.inc()
         if rec["flip"]:
             self._m_flips.inc()
@@ -218,9 +261,11 @@ class ShadowCompare:
                 log.warning(
                     f"[SHADOW] paired-record append failed (non-fatal): {e}"
                 )
-        if self.status_path and pairs_now % self.status_every == 0:
+        if self.status_path and primary and (
+            pairs_now % self.status_every == 0
+        ):
             self.write_status()
-        if self.tracer is not None and (
+        if self.tracer is not None and primary and (
             (pairs_now - 1) % self._span_stride == 0
         ):
             s = self.snapshot()
@@ -248,6 +293,19 @@ class ShadowCompare:
             abandoned = self._abandoned
             pending = len(self._open)
             pending_dropped = self._pending_dropped
+            per_candidate = {
+                str(rank): {
+                    "candidate": (
+                        self.candidates[rank]
+                        if rank < len(self.candidates)
+                        else None
+                    ),
+                    "pairs": c[0],
+                    "flips": c[1],
+                    "flip_rate": (c[1] / c[0]) if c[0] else 0.0,
+                }
+                for rank, c in sorted(self._cand.items())
+            }
         d = None
         if pairs > 0 and hs.sum() > 0 and hd.sum() > 0:
             try:
@@ -271,6 +329,8 @@ class ShadowCompare:
             "abandoned": abandoned,
             "pending": pending,
             "pending_dropped": pending_dropped,
+            "candidates": list(self.candidates),
+            "per_candidate": per_candidate,
             "ts": time.time(),
         }
 

@@ -63,6 +63,13 @@ class ShadowMirror:
 
     ``sample`` is the stride: mirror one request in ``sample`` via the
     admission counter — deterministic, no RNG. 1 mirrors everything.
+
+    ``extra_targets`` (ISSUE 18) appends ranked secondary candidates:
+    mirrored requests stride across the target list by mirror id
+    (``(mid - 1) % n_targets`` — deterministic like the admission
+    stride), each target gets its own connection + redial backoff, and
+    replies land in the comparator tagged with the candidate's RANK so
+    the aggregate gate evidence stays rank-0-only.
     """
 
     def __init__(
@@ -78,11 +85,15 @@ class ShadowMirror:
         redial_interval_s: float = 1.0,
         tracer=None,
         span_stride: int = 64,
+        extra_targets: tuple = (),
     ):
         if int(sample) < 1:
             raise ValueError(f"sample={sample} must be >= 1 (the stride)")
         self.host = host
         self.port = int(port)
+        self.targets: tuple[tuple[str, int], ...] = (
+            (host, int(port)),
+        ) + tuple((h, int(p)) for h, p in extra_targets)
         self.sample = int(sample)
         self.compare = compare
         self.auth_key = auth_key
@@ -96,7 +107,10 @@ class ShadowMirror:
         self._mirrored = 0
         self._dropped = 0
         self._errors = 0
-        self._inflight: set[int] = set()
+        n_targets = len(self.targets)
+        self._inflight: list[set[int]] = [set() for _ in range(n_targets)]
+        self._socks: list[socket.socket | None] = [None] * n_targets
+        self._next_dials: list[float] = [0.0] * n_targets
         self._q: "queue.Queue[tuple[int, bytes] | None]" = queue.Queue(
             maxsize=max(1, int(max_queue))
         )
@@ -109,8 +123,6 @@ class ShadowMirror:
         self._cq: "queue.Queue[tuple[str, int, bytes | None] | None]" = (
             queue.Queue(maxsize=max(4 * int(max_queue), 1024))
         )
-        self._sock: socket.socket | None = None
-        self._next_dial = 0.0
         self._closed = threading.Event()
         self._threads: list[threading.Thread] = []
         m = obs_metrics.default_registry()
@@ -178,8 +190,9 @@ class ShadowMirror:
                 "mirrored": self._mirrored,
                 "dropped": self._dropped,
                 "errors": self._errors,
-                "inflight": len(self._inflight),
+                "inflight": sum(len(s) for s in self._inflight),
                 "sample": self.sample,
+                "targets": len(self.targets),
             }
 
     # ------------------------------------------------------- serving-path API
@@ -194,6 +207,16 @@ class ShadowMirror:
                 return None
             self._next_mid += 1
             mid = self._next_mid
+        # Thread the live request's id to the comparator BEFORE the
+        # rewrite erases it — the ground-truth plane joins on it. One
+        # header parse for sampled requests only; failures are ignored
+        # (the pair still works, it just can't be label-joined).
+        reg = getattr(self.compare, "register_rid", None)
+        if reg is not None:
+            try:
+                reg(mid, str(protocol.frame_id(frame)))
+            except (WireError, TypeError, ValueError):
+                pass
         try:
             self._q.put_nowait((mid, bytes(frame)))
         except queue.Full:
@@ -282,38 +305,43 @@ class ShadowMirror:
         if mid is not None:
             self.abandon(mid)
 
-    def _teardown_conn(self) -> None:
-        with self._lock:
-            sock, self._sock = self._sock, None
-            stranded = list(self._inflight)
-            self._inflight.clear()
-        if sock is not None:
-            try:
-                sock.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                sock.close()
-            except OSError:
-                pass
-        for mid in stranded:
-            self.abandon(mid)
+    def _teardown_conn(self, idx: int | None = None) -> None:
+        """Tear down one target's connection (all of them on close)."""
+        indices = range(len(self.targets)) if idx is None else (idx,)
+        for i in indices:
+            with self._lock:
+                sock, self._socks[i] = self._socks[i], None
+                stranded = list(self._inflight[i])
+                self._inflight[i].clear()
+            if sock is not None:
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            for mid in stranded:
+                self.abandon(mid)
 
-    def _ensure_conn(self) -> socket.socket | None:
-        """Dial the shadow backend lazily, at most once per
+    def _ensure_conn(self, idx: int) -> socket.socket | None:
+        """Dial one shadow target lazily, at most once per
         ``redial_interval_s`` — a DEAD shadow replica must cost the
         worker one bounded connect attempt per interval, not one per
-        mirrored request (pass-through, cheaply)."""
+        mirrored request (pass-through, cheaply). Each target backs off
+        independently: one dead secondary never throttles the rest."""
         with self._lock:
-            if self._sock is not None:
-                return self._sock
+            if self._socks[idx] is not None:
+                return self._socks[idx]
         now = time.monotonic()
-        if now < self._next_dial:
+        if now < self._next_dials[idx]:
             return None
-        self._next_dial = now + self.redial_interval_s
+        self._next_dials[idx] = now + self.redial_interval_s
+        host, port = self.targets[idx]
         try:
             sock = socket.create_connection(
-                (self.host, self.port), timeout=self.connect_timeout_s
+                (host, port), timeout=self.connect_timeout_s
             )
             sock.settimeout(None)
             _set_nodelay(sock)
@@ -322,18 +350,21 @@ class ShadowMirror:
                 answer_auth_challenge(sock, self.auth_key)
                 sock.settimeout(None)
         except (OSError, ConnectionError, WireError) as e:
-            log.debug(f"[SHADOW] shadow backend dial failed: {e}")
+            log.debug(f"[SHADOW] shadow backend {host}:{port} dial failed: {e}")
             return None
         with self._lock:
-            self._sock = sock
+            self._socks[idx] = sock
         threading.Thread(
-            target=self._reader, args=(sock,), daemon=True
+            target=self._reader, args=(sock, idx), daemon=True
         ).start()
         return sock
 
     def _worker(self) -> None:
-        """Drain the bounded queue onto the shadow connection. Only this
-        thread ever writes the socket, so frames cannot interleave."""
+        """Drain the bounded queue onto the shadow connections. Only this
+        thread ever writes a socket, so frames cannot interleave. With a
+        ranked target list, the mirror id picks the target — the same
+        deterministic stride discipline as admission sampling."""
+        n_targets = len(self.targets)
         while True:
             try:
                 item = self._q.get(timeout=0.2)
@@ -344,7 +375,8 @@ class ShadowMirror:
             if item is None or self._closed.is_set():
                 return
             mid, frame = item
-            sock = self._ensure_conn()
+            idx = (mid - 1) % n_targets
+            sock = self._ensure_conn(idx)
             if sock is None:
                 self._count_error(mid)
                 continue
@@ -354,19 +386,20 @@ class ShadowMirror:
                 self._count_error(mid)
                 continue
             with self._lock:
-                self._inflight.add(mid)
+                self._inflight[idx].add(mid)
             try:
                 framing.send_frame(sock, out, await_ack=False)
             except (OSError, ConnectionError):
                 self._count_error(None)
                 with self._lock:
-                    self._inflight.discard(mid)
+                    self._inflight[idx].discard(mid)
                 self.abandon(mid)
-                self._teardown_conn()
+                self._teardown_conn(idx)
 
-    def _reader(self, sock: socket.socket) -> None:
+    def _reader(self, sock: socket.socket, idx: int) -> None:
         """Resolve shadow replies by the protocol's id echo — the pair's
-        shadow side goes to the comparator; rejects abandon the pair."""
+        shadow side goes to the comparator (tagged with the candidate's
+        rank); rejects abandon the pair."""
         while not self._closed.is_set():
             try:
                 frame = bytes(
@@ -377,20 +410,26 @@ class ShadowMirror:
                 mid = protocol.frame_id(frame)
             except (OSError, ConnectionError, WireError):
                 with self._lock:
-                    lost = self._sock is sock
+                    lost = self._socks[idx] is sock
                 if lost:
                     self._count_error(None)
-                    self._teardown_conn()
+                    self._teardown_conn(idx)
                 return
             with self._lock:
-                known = mid in self._inflight
-                self._inflight.discard(mid)
+                known = mid in self._inflight[idx]
+                self._inflight[idx].discard(mid)
             if not known or self.compare is None:
                 continue
             try:
                 if protocol.is_reject(frame):
                     self.compare.abandon(mid)
+                elif idx:
+                    self.compare.note_shadow(
+                        mid, float(protocol.parse_reply(frame)["prob"]), idx
+                    )
                 else:
+                    # Two-arg form for rank 0: stub comparators predate
+                    # the candidate-rank parameter.
                     self.compare.note_shadow(
                         mid, float(protocol.parse_reply(frame)["prob"])
                     )

@@ -29,7 +29,14 @@ from ..obs.profile import (
     note_memory,
     profiled_step_iter,
 )
-from ..ops.metrics import BinaryCounts, binary_counts, finalize_metrics
+from ..ops.metrics import (
+    BinaryCounts,
+    ClassCounts,
+    binary_counts,
+    class_counts,
+    finalize_class_metrics,
+    finalize_metrics,
+)
 from .batches import PrefetchSlot
 from ..utils.logging import get_logger
 
@@ -154,10 +161,15 @@ def masked_loss_fn(model: DDoSClassifier, params, batch, rng) -> jnp.ndarray:
 
 def eval_counts(
     model: DDoSClassifier, params, batch, valid
-) -> tuple[BinaryCounts, jnp.ndarray]:
+) -> tuple[BinaryCounts | ClassCounts, jnp.ndarray]:
     """Shared eval body: masked batch-mean loss + sufficient statistics +
-    P(class 1) probs. Single source of truth for both the single-client and
-    the vmapped federated eval paths (their metrics must never diverge)."""
+    a scalar score per row. Single source of truth for both the
+    single-client and the vmapped federated eval paths (their metrics must
+    never diverge). The branch on the head width is STATIC (a trace-time
+    Python int), so K = 2 keeps the binary kernels verbatim — bit-identical
+    to the pre-K-class path — and K > 2 accumulates the [K, K] confusion
+    matrix with ``P(any attack) = 1 - P(class 0)`` as the scalar score the
+    serving/drift plane consumes (one [0, 1] score axis for every K)."""
     logits = model.apply(
         {"params": params}, batch["input_ids"], batch["attention_mask"], True
     )
@@ -168,8 +180,12 @@ def eval_counts(
     # Batch-mean over valid rows (reference averages per batch then over
     # batches, client1.py:135,144; padded rows must not contribute).
     loss = (per_example * v).sum() / jnp.maximum(v.sum(), 1.0)
-    counts = binary_counts(logits, batch["labels"], loss, valid)
-    probs = jax.nn.softmax(logits, axis=-1)[:, 1]
+    if int(logits.shape[-1]) == 2:
+        counts = binary_counts(logits, batch["labels"], loss, valid)
+        probs = jax.nn.softmax(logits, axis=-1)[:, 1]
+        return counts, probs
+    counts = class_counts(logits, batch["labels"], loss, valid)
+    probs = 1.0 - jax.nn.softmax(logits, axis=-1)[:, 0]
     return counts, probs
 
 
@@ -754,7 +770,10 @@ class Trainer:
         ROC & PR curves, the reference's evaluate_model return shape,
         client1.py:150)."""
         padded, valid = pad_split_to_batch(split, batch_size, pad_id=self.pad_id)
-        totals = BinaryCounts.zero()
+        # None-init: the first batch's counts type (BinaryCounts for K=2,
+        # ClassCounts for K>2) decides the accumulator — eval_counts'
+        # static branch keeps the binary path bit-identical.
+        totals: BinaryCounts | ClassCounts | None = None
         # Device arrays accumulate; host conversion happens once after the
         # loop so eval pipelines like fit() does.
         probs_dev: list[jnp.ndarray] = []
@@ -767,11 +786,17 @@ class Trainer:
                 "labels": padded.labels[sl],
             }
             counts, probs = self.eval_step(batch=batch, params=params, valid=valid[sl])
-            totals = totals + counts
+            totals = counts if totals is None else totals + counts
             if collect_probs:
                 probs_dev.append(probs)
                 valid_slices.append(valid[sl])
-        metrics = finalize_metrics(totals)
+        if totals is None:
+            totals = BinaryCounts.zero()
+        metrics = (
+            finalize_class_metrics(totals)
+            if isinstance(totals, ClassCounts)
+            else finalize_metrics(totals)
+        )
         if collect_probs:
             if probs_dev:
                 all_probs = np.asarray(jnp.concatenate(probs_dev))

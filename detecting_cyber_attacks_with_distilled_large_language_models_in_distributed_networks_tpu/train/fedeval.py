@@ -21,7 +21,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data.pipeline import TokenizedSplit, pad_split_to_batch
-from ..ops.metrics import BinaryCounts, finalize_metrics
+from ..ops.metrics import (
+    BinaryCounts,
+    ClassCounts,
+    finalize_class_metrics,
+    finalize_metrics,
+)
 
 
 def stack_eval_splits(
@@ -84,8 +89,10 @@ def evaluate_stacked(
     C = trainer.C
     M = stacked.labels.shape[1]
     # Accumulate the stacked [C] counts on device; one host sync after
-    # the loop (per-batch np.asarray would block async dispatch).
-    totals: BinaryCounts | None = None
+    # the loop (per-batch np.asarray would block async dispatch). The
+    # counts type follows the head width (BinaryCounts for K=2,
+    # ClassCounts for K>2 — eval_counts' static branch).
+    totals: BinaryCounts | ClassCounts | None = None
     probs_dev = []
     for i in range(M // bs):
         sl = slice(i * bs, (i + 1) * bs)
@@ -130,7 +137,12 @@ def evaluate_stacked(
                 multihost_utils.process_allgather(valid)
             ).reshape(-1, M_pad)
     for c in range(C):
-        m = finalize_metrics(BinaryCounts(*(v[c] for v in host)))
+        client_counts = type(host)(*(v[c] for v in host))
+        m = (
+            finalize_class_metrics(client_counts)
+            if isinstance(client_counts, ClassCounts)
+            else finalize_metrics(client_counts)
+        )
         if collect_probs and all_probs is not None:
             # Padding appends rows, so the valid-row subsequence IS the
             # original split order (pad_split_to_batch/stack_eval_splits).
