@@ -2776,7 +2776,7 @@ MODES = (
     "train", "bert", "bertlarge", "eval", "fedavg", "flash", "ring",
     "fed2", "fedseq", "serve", "clientdp", "controller", "scenario",
     "fleet", "check", "router", "obs", "profile", "shadow", "fsdp",
-    "strategy", "wire", "labels",
+    "strategy", "wire", "labels", "sentinel",
 )
 
 
@@ -3616,6 +3616,266 @@ def _labels_broken(rec: dict) -> bool:
     )
 
 
+def bench_sentinel() -> dict:
+    """Sentinel plane (ISSUE 19): the standing watch daemon judged
+    against a LIVE loopback serving fleet — canary probes ride the real
+    client/wire/scorer chain against the real registry pointer, the
+    journal tail replays delayed ground truth into the supervised drift
+    monitor, and the retention ring trends client-observed latency
+    against its pinned first-window baseline.
+
+    Choreography, every arm asserted (exit 3): (1) clean control ticks
+    fire NOTHING; (2) a legitimate promotion (registry pointer swap +
+    engine hot-swap together) re-keys the canaries — scores change,
+    nothing fires; (3) a stale-pointer replica (registry advances, the
+    engine does not) fires pointer mismatches; (4) a delayed-label
+    error ramp disagreeing with the live scores fires the supervised
+    drift verdict AND pokes a SentinelLink (the controller's corrective
+    round trigger, end to end through the verdicts journal); (5) a
+    genuine latency step (the running engine's score path slowed under
+    the live server) fires the long-horizon regression. Headline fields
+    (asserted present in train mode, exit 3): ``sentinel_canary_flips``
+    / ``sentinel_drift_fires`` / ``sentinel_regression_fires``."""
+    import shutil
+    import tempfile
+
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.control.drift import (
+        ErrorRateMonitor,
+        SentinelLink,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data import (
+        default_tokenizer,
+        make_synthetic,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.data.datasets import (
+        get_dataset,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs.sentinel import (
+        CanaryProber,
+        JournalTail,
+        RetentionRing,
+        Sentinel,
+        load_canary_flows,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.registry import (
+        ModelRegistry,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.serving import (
+        ScoreEngine,
+        ScoringServer,
+    )
+    from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.serving.client import (
+        probe_scores,
+    )
+
+    step_s = float(os.environ.get("BENCH_SENTINEL_STEP_S", "0.25"))
+    ramp_n = int(os.environ.get("BENCH_SENTINEL_RAMP", "80"))
+    out_dir = tempfile.mkdtemp(prefix="bench-sentinel-")
+    t_bench0 = time.perf_counter()
+    server = None
+    try:
+        tok = default_tokenizer()
+        model_cfg = ModelConfig.tiny(vocab_size=len(tok.vocab))
+        trainer = Trainer(model_cfg, TrainConfig(), pad_id=tok.pad_id)
+        params1 = trainer.init_state(seed=0).params
+        params2 = trainer.init_state(seed=1).params
+        params3 = trainer.init_state(seed=2).params
+
+        registry = ModelRegistry(os.path.join(out_dir, "registry"))
+        aid1 = registry.add(params1, round_index=1, model_config=model_cfg)
+        registry.promote(aid1, to="serving")
+
+        scored = os.path.join(out_dir, "scored.jsonl")
+        journal = os.path.join(out_dir, "journal.jsonl")
+        verdicts = os.path.join(out_dir, "verdicts.jsonl")
+        for p in (scored, journal):
+            open(p, "w").close()
+        spec = get_dataset("cicids2017")
+        engine = ScoreEngine(
+            model_cfg, params1, pad_id=tok.pad_id, buckets=(1, 8), round_id=1
+        )
+        server = ScoringServer(
+            engine, tok, spec=spec, scored_jsonl=scored, idle_tick_s=0.01
+        )
+        flows = load_canary_flows(
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tests", "data", "canary_flows.jsonl",
+            ),
+            preset="cicids2017",
+        )
+        with server:
+            prober = CanaryProber(
+                flows, "127.0.0.1", server.port, registry=registry
+            )
+            tail = JournalTail(
+                scored,
+                journal,
+                monitor=ErrorRateMonitor(
+                    reference_error=0.05, margin=0.2, min_joined=32
+                ),
+                verdicts_jsonl=verdicts,
+            )
+            # Latency is the only trended field here: the error ramp
+            # below would legitimately trip a supervised_error trend
+            # too, and the regression arm must count exactly the
+            # injected latency step.
+            ring = RetentionRing(
+                os.path.join(out_dir, "ring.jsonl"),
+                max_records=64,
+                baseline_n=3,
+                window_n=3,
+                trend_fields={"latency_p99_ms": (1.5, 5.0, "up")},
+            )
+            link = SentinelLink(verdicts)  # armed before any verdict
+            sentinel = Sentinel(
+                prober=prober,
+                tail=tail,
+                ring=ring,
+                alerts_jsonl=os.path.join(out_dir, "alerts.jsonl"),
+            )
+            # Warm sockets + jit paths off the clock so the pinned
+            # baseline window holds steady-state latency.
+            probe_scores("127.0.0.1", server.port, [f.text for f in flows])
+
+            # (1) clean control: fills the pinned baseline AND a full
+            # trend window at steady state — any fire here is false.
+            for _ in range(6):
+                sentinel.tick()
+            false_fires = (
+                sentinel.canary_flips
+                + sentinel.drift_fires
+                + sentinel.regression_fires
+            )
+
+            # (2) legitimate promotion: pointer and replica move
+            # together — the canary scores flip, the sentinel re-keys.
+            before = dict(prober._scores)
+            aid2 = registry.add(
+                params2, round_index=2, model_config=model_cfg
+            )
+            registry.promote(aid2, to="serving")
+            engine.swap(params2, round_id=2)
+            sentinel.tick()
+            after = dict(prober._scores)
+            promotion_flipped = any(
+                (aid2, f.id) in after
+                and after[(aid2, f.id)] != before.get((aid1, f.id))
+                for f in flows
+            )
+            promotion_quiet = (
+                sentinel.canary_flips
+                + sentinel.drift_fires
+                + sentinel.regression_fires
+            ) == false_fires
+
+            # (3) stale pointer: the registry advances, the replica
+            # keeps serving round 2 — every canary reports a mismatch.
+            aid3 = registry.add(
+                params3, round_index=3, model_config=model_cfg
+            )
+            registry.promote(aid3, to="serving")
+            canary_report = sentinel.tick()["canary"]
+            pointer_mismatches = canary_report["mismatches"]
+            engine.swap(params3, round_id=3)  # repair the fleet
+            sentinel.tick()  # re-keyed: quiet again
+
+            # (4) delayed ground truth disagreeing with the live
+            # scores: labels arrive as the exact opposite of what the
+            # server answered, the join error saturates, the monitor
+            # fires, and the verdict lands in the controller's journal.
+            texts = spec.render_texts(
+                make_synthetic("cicids2017", ramp_n, seed=1)
+            )
+            replies = probe_scores("127.0.0.1", server.port, texts)
+            with open(journal, "a") as f:
+                for reply, _lat in replies:
+                    f.write(
+                        json.dumps(
+                            {
+                                "schema": "fedtpu-label-v1",
+                                "rid": str(reply["id"]),
+                                "label": 1 - int(reply["prediction"]),
+                                "ts": time.time(),
+                            }
+                        )
+                        + "\n"
+                    )
+            sentinel.tick()
+            poke = link.poll()
+            link_poked = (
+                poke is not None and poke.get("method") == "error_rate"
+            )
+
+            # (5) latency step: slow the LIVE engine's score path (the
+            # sleep rides under the running server, so the step is
+            # client-observed through the real chain), then let the
+            # trend window fill past the pinned baseline.
+            real_score = engine.score
+
+            def slow_score(*a, **kw):
+                time.sleep(step_s)
+                return real_score(*a, **kw)
+
+            engine.score = slow_score
+            for _ in range(4):
+                sentinel.tick()
+        record = {
+            "metric": "sentinel_plane",
+            "value": sentinel.canary_flips
+            + sentinel.drift_fires
+            + sentinel.regression_fires,
+            "unit": "incidents_detected",
+            "vs_baseline": None,
+            "baseline_note": "reference: no standing watch at all — a "
+            "stale replica, a drifted model, or a latency regression "
+            "goes unnoticed until a human reruns an offline eval",
+            "sentinel_canary_flips": sentinel.canary_flips,
+            "sentinel_drift_fires": sentinel.drift_fires,
+            "sentinel_regression_fires": sentinel.regression_fires,
+            "sentinel_false_fires": false_fires,
+            "sentinel_pointer_mismatches": pointer_mismatches,
+            "sentinel_promotion_flipped": promotion_flipped,
+            "sentinel_promotion_quiet": promotion_quiet,
+            "sentinel_link_poked": link_poked,
+            "sentinel_drift_error": (
+                None if poke is None else poke.get("error")
+            ),
+            "sentinel_ticks": sentinel.ticks,
+            "sentinel_canaries": len(flows),
+            "wall_s": round(time.perf_counter() - t_bench0, 2),
+        }
+    except Exception as e:
+        record = {
+            "metric": "bench_error",
+            "error": "sentinel_plane_failed",
+            "detail": f"{type(e).__name__}: {str(e)[:300]}",
+        }
+    finally:
+        if server is not None:
+            server.close()
+        shutil.rmtree(out_dir, ignore_errors=True)
+    _emit(record)
+    return record
+
+
+def _sentinel_broken(rec: dict) -> bool:
+    """The sentinel plane's acceptance gates (exit 3): zero false fires
+    on the clean control, the legitimate promotion flips scores WITHOUT
+    firing, the stale pointer fires mismatches, the error ramp fires
+    the drift verdict and pokes the controller link, and the latency
+    step fires the long-horizon regression."""
+    return (
+        rec.get("sentinel_false_fires", 1) != 0
+        or rec.get("sentinel_canary_flips", 0) < 1
+        or rec.get("sentinel_drift_fires", 0) < 1
+        or rec.get("sentinel_regression_fires", 0) < 1
+        or not rec.get("sentinel_promotion_flipped", False)
+        or not rec.get("sentinel_promotion_quiet", False)
+        or not rec.get("sentinel_link_poked", False)
+    )
+
+
 #: Federated product-step MFU floor (fed2/fedseq): the driver-captured
 #: records sit at 0.585/0.56 (BENCH_r05); a regression below 0.50 exits
 #: nonzero so it cannot pass silently (VERDICT r5 weak #7).
@@ -3687,6 +3947,19 @@ def main() -> None:
         if rec.get("metric") == "bench_error" or _labels_broken(rec):
             raise SystemExit(3)
         return
+    if mode == "sentinel":
+        # Loopback fleet + watch daemon on the tiny model: the engine
+        # touches jnp, so pin the CPU backend before first use — this
+        # mode must never pay for (or depend on) the tunnel. Safe here
+        # only because nothing else runs in this process.
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        rec = bench_sentinel()
+        if rec.get("metric") == "bench_error" or _sentinel_broken(rec):
+            raise SystemExit(3)
+        return
     if (mode == "clientdp" and os.environ.get("BENCH_CLIENTDP_FORCE_CPU")) or (
         mode == "fsdp" and os.environ.get("BENCH_FSDP_FORCE_CPU")
     ):
@@ -3725,7 +3998,7 @@ def main() -> None:
             rec_fed2 = rec_fedseq = rec_ctrl = rec_resid = rec_scn = None
             rec_fleet = rec_check = rec_router = rec_obs = None
             rec_profile = rec_shadow = rec_fsdp = rec_wire = None
-            rec_labels = None
+            rec_labels = rec_sentinel = None
             if os.environ.get("BENCH_SECONDARY", "1").lower() not in (
                 "", "0", "false",
             ):
@@ -3753,6 +4026,7 @@ def main() -> None:
                 rec_profile = bench_profile()
                 rec_check = bench_check()
                 rec_labels = bench_labels()
+                rec_sentinel = bench_sentinel()
             extra = {}
             for key, rec in (("fed2", rec_fed2), ("fedseq", rec_fedseq)):
                 if rec is not None and rec.get("mfu") is not None:
@@ -4205,6 +4479,46 @@ def main() -> None:
                     if k in rec_labels:
                         extra[k] = rec_labels[k]
                 labels_broken_flag = _labels_broken(rec_labels)
+            sentinel_broken_flag = False
+            if rec_sentinel is not None and (
+                rec_sentinel.get("metric") != "bench_error"
+            ):
+                # Sentinel-plane headline fields (ISSUE 19): ASSERTED
+                # present — a refactor that drops the canary identity
+                # check, the journal-tail drift rung, or the retention-
+                # ring trend accounting must fail the bench loudly —
+                # with every injected incident class caught and zero
+                # false fires all gated exit 3 (_sentinel_broken).
+                missing = [
+                    k
+                    for k in (
+                        "sentinel_canary_flips",
+                        "sentinel_drift_fires",
+                        "sentinel_regression_fires",
+                    )
+                    if k not in rec_sentinel
+                ]
+                if missing:
+                    _emit(
+                        {
+                            "metric": "bench_error",
+                            "error": "sentinel_fields_missing",
+                            "detail": f"sentinel record lacks {missing} "
+                            "(obs/sentinel.py prober/tail/ring "
+                            "accounting broken?)",
+                        }
+                    )
+                    raise SystemExit(3)
+                for k in (
+                    "sentinel_canary_flips",
+                    "sentinel_drift_fires",
+                    "sentinel_regression_fires",
+                    "sentinel_false_fires",
+                    "sentinel_link_poked",
+                ):
+                    if k in rec_sentinel:
+                        extra[k] = rec_sentinel[k]
+                sentinel_broken_flag = _sentinel_broken(rec_sentinel)
             broken = _check_mfu_floor(
                 {"fed2": rec_fed2, "fedseq": rec_fedseq}
             )
@@ -4223,6 +4537,7 @@ def main() -> None:
                 or fsdp_broken
                 or check_broken
                 or labels_broken_flag
+                or sentinel_broken_flag
             ):
                 raise SystemExit(3)
         elif mode == "bert":

@@ -241,6 +241,16 @@ def cmd_controller(args) -> int:
                     f"reference over >= {lbl.error_min_joined} joined "
                     "flow(s) triggers a round"
                 )
+        sentinel_link = None
+        if getattr(args, "sentinel_jsonl", None):
+            from ..control import SentinelLink
+
+            sentinel_link = SentinelLink(args.sentinel_jsonl)
+            log.info(
+                f"[CONTROLLER] sentinel link armed: supervised-drift "
+                f"verdicts appended to {args.sentinel_jsonl} trigger "
+                "corrective rounds (existing verdicts skipped)"
+            )
         actuator = None
         if getattr(args, "slo_alerts_jsonl", None):
             from ..control import SloActuator
@@ -266,6 +276,7 @@ def cmd_controller(args) -> int:
             slo_actuator=actuator,
             label_gate=label_gate,
             error_monitor=error_monitor,
+            sentinel_link=sentinel_link,
         )
         max_rounds = args.rounds if args.rounds and args.rounds > 0 else None
         log.info(

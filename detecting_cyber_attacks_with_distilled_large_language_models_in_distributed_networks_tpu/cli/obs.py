@@ -26,6 +26,18 @@ controller, registry, infer-serve) appends spans to its own events-JSONL
         # device performance plane (obs/profile.py): compile ledger by
         # site, recompile flags, fenced host/dispatch/device step
         # split, memory watermarks, analytic-vs-XLA FLOPs cross-check
+    fedtpu obs sentinel --canaries tests/data/canary_flows.jsonl \\
+                        --serve 127.0.0.1:9000 --registry-dir runs/reg \\
+                        --scored-jsonl runs/scored.jsonl \\
+                        --labels-journal runs/reg/labels/journal.jsonl \\
+                        --reference-error 0.05 --ring-jsonl runs/ring.jsonl
+        # the sentinel watch daemon (obs/sentinel.py): known-truth
+        # canary probes through the live serving chain (pointer +
+        # bit-stability + latency), continuous journal-tailing
+        # supervised drift between gates (--verdicts-jsonl feeds the
+        # controller's --sentinel-jsonl poke), and the long-horizon
+        # retention ring's pinned-baseline regression verdicts
+        # (--json = ONE tick, machine-readable, exit 1 on any finding)
 """
 
 from __future__ import annotations
@@ -39,6 +51,7 @@ from ..obs import (
     Tracer,
     default_slos,
     export_chrome_trace,
+    health_verdict,
     list_bundles,
     load_bundle,
     load_spans,
@@ -147,6 +160,7 @@ def _build_hub(args) -> ScrapeHub:
             slos=slos,
             alerts_jsonl=getattr(args, "alerts_jsonl", None),
             snapshot_jsonl=getattr(args, "snapshot_jsonl", None),
+            snapshot_max_mb=getattr(args, "snapshot_max_mb", None),
             scrape_timeout_s=getattr(args, "scrape_timeout", None) or 2.0,
             tracer=tracer,
             recorder=recorder,
@@ -178,7 +192,10 @@ def _cmd_health(args) -> int:
     time.sleep(getattr(args, "interval", None) or 2.0)
     snapshot = hub.poll()
     if getattr(args, "json", False):
-        json.dump(snapshot, sys.stdout, indent=2)
+        # The schema-versioned VERDICT (fedtpu-health-v1), not the raw
+        # snapshot: cron/CI consumers parse one stable judgement shape;
+        # the raw per-poll records live in --snapshot-jsonl.
+        json.dump(health_verdict(snapshot), sys.stdout, indent=2)
         sys.stdout.write("\n")
     else:
         sys.stdout.write(hub.render_status(snapshot))
@@ -291,9 +308,145 @@ def _cmd_profile(args) -> int:
     return 1 if broken else 0
 
 
+def _cmd_sentinel(args) -> int:
+    """Assemble + run the sentinel daemon (obs/sentinel.py): canary
+    probes, journal-tailing supervised drift, long-horizon ring. Any
+    rung may be absent; at least one must be configured. ``--json``
+    runs ONE tick and prints the machine-readable report (exit 1 when
+    the tick surfaced any incident); default is the watch loop."""
+    from ..control.drift import ErrorRateMonitor
+    from ..obs.sentinel import (
+        CanaryProber,
+        JournalTail,
+        RetentionRing,
+        Sentinel,
+        load_canary_flows,
+    )
+
+    tracer = None
+    if getattr(args, "trace_jsonl", None):
+        tracer = Tracer(args.trace_jsonl, proc="sentinel")
+    recorder = None
+    if getattr(args, "flight_dir", None):
+        from ..obs import FlightRecorder
+
+        recorder = FlightRecorder(
+            args.flight_dir, proc="sentinel", tracer=tracer
+        )
+    prober = None
+    if getattr(args, "canaries", None):
+        serve = getattr(args, "serve", None)
+        if not serve or ":" not in serve:
+            raise SystemExit(
+                "sentinel --canaries needs --serve HOST:PORT (the "
+                "scoring endpoint the probes dial)"
+            )
+        host, _, port_s = serve.rpartition(":")
+        try:
+            flows = load_canary_flows(
+                args.canaries, preset=getattr(args, "canary_preset", None)
+            )
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"--canaries {args.canaries}: {e}") from None
+        registry = None
+        if getattr(args, "registry_dir", None):
+            from ..registry import ModelRegistry
+
+            registry = ModelRegistry(args.registry_dir)
+        prober = CanaryProber(
+            flows,
+            host,
+            int(port_s),
+            registry=registry,
+            tracer=tracer,
+            recorder=recorder,
+        )
+    tail = None
+    scored = getattr(args, "scored_jsonl", None)
+    journal = getattr(args, "labels_journal", None)
+    if scored or journal:
+        if not (scored and journal):
+            raise SystemExit(
+                "sentinel journal tailing needs BOTH --scored-jsonl and "
+                "--labels-journal (the join has two sides)"
+            )
+        ref = getattr(args, "reference_error", None)
+        if ref is None:
+            raise SystemExit(
+                "sentinel journal tailing needs --reference-error (the "
+                "promoted model's error the continuous monitor compares "
+                "against — the registry manifest's eval error)"
+            )
+        monitor = ErrorRateMonitor(
+            reference_error=ref,
+            margin=getattr(args, "error_margin", None) or 0.05,
+            min_joined=getattr(args, "error_min_joined", None) or 64,
+        )
+        tail = JournalTail(
+            scored,
+            journal,
+            monitor=monitor,
+            verdicts_jsonl=getattr(args, "verdicts_jsonl", None),
+            tracer=tracer,
+        )
+    ring = RetentionRing(
+        getattr(args, "ring_jsonl", None),
+        max_records=getattr(args, "ring_records", None) or 512,
+        stride=getattr(args, "ring_stride", None) or 1,
+        baseline_n=getattr(args, "baseline_n", None) or 8,
+        window_n=getattr(args, "window_n", None) or 8,
+    )
+    ratio = getattr(args, "regression_ratio", None)
+    if ratio is not None:
+        if ratio <= 1.0:
+            raise SystemExit(
+                f"--regression-ratio {ratio} must be > 1 (it multiplies "
+                "the baseline mean)"
+            )
+        ring.trend_fields = {
+            f: (float(ratio), floor, direction)
+            for f, (_, floor, direction) in ring.trend_fields.items()
+        }
+    hub = None
+    if getattr(args, "target", None):
+        hub = _build_hub(args)
+    if prober is None and tail is None:
+        raise SystemExit(
+            "fedtpu obs sentinel needs at least one rung: --canaries + "
+            "--serve (canary probes) and/or --scored-jsonl + "
+            "--labels-journal + --reference-error (supervised drift); "
+            "the retention ring rides whichever signals exist"
+        )
+    sentinel = Sentinel(
+        prober=prober,
+        tail=tail,
+        ring=ring,
+        hub=hub,
+        alerts_jsonl=getattr(args, "alerts_jsonl", None),
+        tracer=tracer,
+        recorder=recorder,
+    )
+    if getattr(args, "json", False):
+        report = sentinel.tick()
+        json.dump(report, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        c = report["counters"]
+        bad = (
+            c["canary_flips"] + c["drift_fires"] + c["regression_fires"]
+        ) or (report["canary"] or {}).get("failures", 0)
+        return 1 if bad else 0
+    sentinel.watch(
+        interval_s=getattr(args, "interval", None) or 5.0,
+        max_seconds=getattr(args, "max_seconds", None),
+    )
+    return 0
+
+
 def cmd_obs(args) -> int:
     if args.action in ("health", "watch"):
         return _cmd_health(args)
+    if args.action == "sentinel":
+        return _cmd_sentinel(args)
     if args.action == "postmortem":
         return _cmd_postmortem(args)
     if args.action == "profile":

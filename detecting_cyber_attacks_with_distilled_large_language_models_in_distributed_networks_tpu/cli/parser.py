@@ -1270,6 +1270,13 @@ def build_parser() -> argparse.ArgumentParser:
         "regression score-histogram drift cannot see)",
     )
     p.add_argument(
+        "--sentinel-jsonl",
+        help="tail this sentinel verdicts-JSONL (fedtpu obs sentinel "
+        "--verdicts-jsonl) and treat each new supervised-drift verdict "
+        "as a corrective-round trigger — the cross-process twin of "
+        "--error-drift (only verdicts appended AFTER startup count)",
+    )
+    p.add_argument(
         "--drift-cohort",
         action="store_true",
         help="scale the corrective round's quorum by each drift "
@@ -1430,7 +1437,7 @@ def build_parser() -> argparse.ArgumentParser:
         "action",
         choices=[
             "timeline", "export", "tail", "health", "watch", "postmortem",
-            "profile",
+            "profile", "sentinel",
         ],
     )
     p.add_argument(
@@ -1505,6 +1512,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--snapshot-jsonl",
         help="health/watch: append one merged fleet snapshot record "
         "per poll here, keyed by (tier, instance)",
+    )
+    p.add_argument(
+        "--snapshot-max-mb",
+        type=float,
+        default=None,
+        help="health/watch/sentinel: bound the snapshot JSONL — past "
+        "this size the live file atomically rolls to <path>.1 and a "
+        "fresh generation starts (at most ~2x the cap on disk; "
+        "default: unbounded, the pre-existing behavior)",
     )
     p.add_argument(
         "--watch",
@@ -1591,6 +1607,106 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="DIR",
         help="profile: additionally wrap jax.profiler around the "
         "profiled steps and write the trace here (xprof/tensorboard)",
+    )
+    p.add_argument(
+        "--canaries",
+        help="sentinel: canary-flows JSONL fixture (fedtpu-canary-v1 "
+        "lines: id, preset, label, text) scored through the live "
+        "serving chain every tick",
+    )
+    p.add_argument(
+        "--canary-preset",
+        default=None,
+        help="sentinel: only this preset's canaries from --canaries "
+        "(default: all)",
+    )
+    p.add_argument(
+        "--serve",
+        metavar="HOST:PORT",
+        help="sentinel: the scoring endpoint (router or replica) the "
+        "canary probes dial",
+    )
+    p.add_argument(
+        "--registry-dir",
+        help="sentinel: model registry root — canary replies must match "
+        "its promoted serving pointer (round + artifact identity)",
+    )
+    p.add_argument(
+        "--scored-jsonl",
+        help="sentinel: the serving tier's scored-request export "
+        "(fedtpu-scored-v1) to tail for the supervised-drift join",
+    )
+    p.add_argument(
+        "--labels-journal",
+        help="sentinel: the ground-truth labels journal "
+        "(fedtpu-label-v1) to tail against --scored-jsonl",
+    )
+    p.add_argument(
+        "--reference-error",
+        type=float,
+        default=None,
+        help="sentinel: the promoted model's reference error rate the "
+        "continuous supervised monitor compares against (required with "
+        "--scored-jsonl/--labels-journal)",
+    )
+    p.add_argument(
+        "--error-margin",
+        type=float,
+        default=None,
+        help="sentinel: supervised error margin over the reference "
+        "before a drift verdict fires (default 0.05)",
+    )
+    p.add_argument(
+        "--error-min-joined",
+        type=int,
+        default=None,
+        help="sentinel: joined flows required before a supervised "
+        "verdict may fire (default 64)",
+    )
+    p.add_argument(
+        "--verdicts-jsonl",
+        help="sentinel: append fired supervised-drift verdicts here — "
+        "the file the controller's --sentinel-jsonl tails for its "
+        "corrective-round poke",
+    )
+    p.add_argument(
+        "--ring-jsonl",
+        help="sentinel: the long-horizon retention ring's on-disk path "
+        "(downsampled per-tick rows; survives sentinel restarts)",
+    )
+    p.add_argument(
+        "--ring-records",
+        type=int,
+        default=None,
+        help="sentinel: ring rows retained (default 512)",
+    )
+    p.add_argument(
+        "--ring-stride",
+        type=int,
+        default=None,
+        help="sentinel: retain every Nth tick in the ring (default 1)",
+    )
+    p.add_argument(
+        "--baseline-n",
+        type=int,
+        default=None,
+        help="sentinel: ring rows pinned as the regression baseline "
+        "window (the first N retained; default 8)",
+    )
+    p.add_argument(
+        "--window-n",
+        type=int,
+        default=None,
+        help="sentinel: current-window rows a trend check averages "
+        "(default 8)",
+    )
+    p.add_argument(
+        "--regression-ratio",
+        type=float,
+        default=None,
+        help="sentinel: fire when a watched field's current-window mean "
+        "moves past baseline * ratio (default 1.5; round cadence fires "
+        "on the inverse drop)",
     )
     p.set_defaults(fn=cmd_obs)
 

@@ -811,6 +811,25 @@ class ObsConfig:
     #: fences, no timer reads). The matching CLI flag is
     #: ``--profile-stride``; a deterministic counter stride, no RNG.
     profile_stride: int = 0
+    #: Snapshot-JSONL retention cap in MB for the scrape hub / sentinel
+    #: (``--snapshot-max-mb``): past this size the live file atomically
+    #: rolls to ``<path>.1`` (at most ~2x the cap on disk). None
+    #: (default) = unbounded, the pre-existing behavior.
+    snapshot_max_mb: float | None = None
+    #: Sentinel cadence (obs/sentinel.py): seconds between ticks of the
+    #: ``fedtpu obs sentinel`` watch loop.
+    sentinel_interval_s: float = 5.0
+    #: Long-horizon retention ring rows kept (memory + --ring-jsonl).
+    sentinel_ring_records: int = 512
+    #: Ring rows pinned as the regression baseline window (the FIRST N
+    #: retained — "how the fleet looked when watching began").
+    sentinel_baseline_n: int = 8
+    #: Current-window rows a trend check averages against the baseline.
+    sentinel_window_n: int = 8
+    #: A watched field regresses when its current-window mean moves past
+    #: baseline * ratio (+ the field's absolute floor); round cadence
+    #: fires on the inverse drop.
+    sentinel_regression_ratio: float = 1.5
 
     def __post_init__(self) -> None:
         if not 0 <= self.metrics_port <= 65535:
@@ -830,6 +849,31 @@ class ObsConfig:
             raise ValueError(
                 f"profile_stride={self.profile_stride} must be >= 0 "
                 "(0 = off)"
+            )
+        if self.snapshot_max_mb is not None and self.snapshot_max_mb <= 0:
+            raise ValueError(
+                f"snapshot_max_mb={self.snapshot_max_mb} must be > 0 "
+                "(None = unbounded)"
+            )
+        if self.sentinel_interval_s <= 0:
+            raise ValueError(
+                f"sentinel_interval_s={self.sentinel_interval_s} must "
+                "be > 0"
+            )
+        if self.sentinel_ring_records < max(
+            self.sentinel_baseline_n, self.sentinel_window_n
+        ):
+            raise ValueError(
+                f"sentinel_ring_records={self.sentinel_ring_records} "
+                "must hold at least the baseline "
+                f"({self.sentinel_baseline_n}) and current "
+                f"({self.sentinel_window_n}) windows"
+            )
+        if self.sentinel_regression_ratio <= 1.0:
+            raise ValueError(
+                f"sentinel_regression_ratio="
+                f"{self.sentinel_regression_ratio} must be > 1 (it "
+                "multiplies the baseline mean)"
             )
 
 

@@ -28,6 +28,7 @@ from .controller import Controller, ControllerStats, SloActuator
 from .drift import (
     DriftMonitor,
     ErrorRateMonitor,
+    SentinelLink,
     cadence_interval_s,
     drift_cohort_fraction,
     ks_distance,
@@ -39,6 +40,7 @@ __all__ = [
     "ControllerStats",
     "DriftMonitor",
     "ErrorRateMonitor",
+    "SentinelLink",
     "SloActuator",
     "cadence_interval_s",
     "drift_cohort_fraction",

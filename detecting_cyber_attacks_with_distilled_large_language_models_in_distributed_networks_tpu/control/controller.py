@@ -170,6 +170,7 @@ class Controller:
         slo_actuator: SloActuator | None = None,
         label_gate=None,
         error_monitor: ErrorRateMonitor | None = None,
+        sentinel_link=None,
     ):
         if getattr(server, "dp_clip", 0.0) > 0.0:
             raise ValueError(
@@ -201,6 +202,11 @@ class Controller:
         # corrective round even when score histograms look stable.
         self.label_gate = label_gate
         self.error_monitor = error_monitor
+        # Sentinel link (control/drift.py SentinelLink): the tail of the
+        # standalone sentinel's verdicts-JSONL — supervised drift the
+        # sentinel detected BETWEEN gates, in another process, poking
+        # the same corrective-round path the in-process monitor uses.
+        self.sentinel_link = sentinel_link
         self.stats = ControllerStats()
         # Drift-scaled cohort: a drift verdict's magnitude picks the
         # NEXT round's quorum between the configured fractions of the
@@ -470,6 +476,41 @@ class Controller:
                         f"{sup['error']:.4f} vs reference "
                         f"{sup['reference_error']:.4f} over "
                         f"{sup['scores']} joined flow(s)"
+                    )
+                    return "drift"
+            if self.sentinel_link is not None:
+                # The standalone sentinel's between-gates verdict, same
+                # handling as the in-process monitor — the verdict shape
+                # is the ErrorRateMonitor's, journaled cross-process.
+                sup = self.sentinel_link.poll()
+                if sup is not None:
+                    self.stats.drift_triggers += 1
+                    self._m_drift_triggers.inc()
+                    self._record(
+                        "drift_trigger",
+                        **{
+                            k: sup.get(k)
+                            for k in (
+                                "drift", "method", "threshold",
+                                "scores", "error", "reference_error",
+                            )
+                        },
+                    )
+                    if self.tracer is not None:
+                        self.tracer.record(
+                            "drift-trigger",
+                            t_start=time.time(),
+                            dur_s=0.0,
+                            round=self._next_round,
+                            drift=sup["drift"],
+                            method=sup["method"],
+                            scores=sup.get("scores"),
+                        )
+                    log.info(
+                        f"[CONTROLLER] sentinel drift verdict: error "
+                        f"{sup.get('error')} vs reference "
+                        f"{sup.get('reference_error')} over "
+                        f"{sup.get('scores')} joined flow(s)"
                     )
                     return "drift"
             if (

@@ -457,3 +457,60 @@ class ErrorRateMonitor:
         )
         self.reset_window()
         return verdict
+
+
+class SentinelLink:
+    """The controller's tail of the sentinel's verdicts-JSONL — the
+    cross-process poke that turns a between-gates supervised-drift
+    verdict (obs/sentinel.py JournalTail) into a corrective round.
+
+    Same incremental discipline as :class:`DriftMonitor`'s metrics tail:
+    byte-offset resume, complete lines only, foreign lines skipped. The
+    offset initializes to the file's CURRENT end — a restarted
+    controller must not replay last week's verdicts as fresh triggers.
+    ``poll()`` returns the newest verdict since the last poll (one
+    trigger per poll even if several fired while training ran — the
+    corrective round answers all of them) or None."""
+
+    #: The verdict schema the sentinel journals (obs/sentinel.py).
+    SCHEMA = "fedtpu-sentinel-verdict-v1"
+
+    def __init__(self, path: str):
+        self.path = path
+        self._offset = 0
+        try:
+            self._offset = os.path.getsize(path)
+        except OSError:
+            pass  # not written yet — start from 0 when it appears
+        self.seen = 0
+
+    def poll(self) -> dict | None:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return None
+        if size < self._offset:
+            self._offset = 0  # rotated/truncated underneath us
+        if size == self._offset:
+            return None
+        with open(self.path, "rb") as f:
+            f.seek(self._offset)
+            chunk = f.read(size - self._offset)
+        # Only complete lines; a torn tail waits for the next poll.
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return None
+        self._offset += end + 1
+        latest: dict | None = None
+        for raw in chunk[: end + 1].splitlines():
+            try:
+                rec = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(rec, dict) or rec.get("schema") != self.SCHEMA:
+                continue
+            if "drift" not in rec or "method" not in rec:
+                continue
+            self.seen += 1
+            latest = rec
+        return latest

@@ -20,6 +20,10 @@ Seven pieces (see each module's docstring):
   (per-site compile/recompile accounting), strided fenced step-time
   attribution, device-memory watermarks, and the analytic-vs-XLA FLOPs
   cross-check behind ``fedtpu obs profile`` / ``BENCH_MODE=profile``.
+* :mod:`.sentinel` — the sentinel watch daemon behind ``fedtpu obs
+  sentinel``: known-truth canary probes through the live serving chain,
+  continuous journal-tailing supervised drift between gates, and a
+  long-horizon retention ring with pinned-baseline regression verdicts.
 """
 
 from .flight import (  # noqa: F401
@@ -61,7 +65,16 @@ from .slo import (  # noqa: F401
 from .fleet import (  # noqa: F401
     ScrapeHub,
     Target,
+    health_verdict,
     parse_target,
+)
+from .sentinel import (  # noqa: F401
+    CanaryFlow,
+    CanaryProber,
+    JournalTail,
+    RetentionRing,
+    Sentinel,
+    load_canary_flows,
 )
 from .timeline import (  # noqa: F401
     chrome_trace,

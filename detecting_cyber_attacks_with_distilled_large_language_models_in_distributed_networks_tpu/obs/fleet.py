@@ -46,6 +46,9 @@ from .trace import append_jsonl_line
 #: Schema tag on every fleet snapshot record.
 FLEET_SCHEMA = "fedtpu-fleet-v1"
 
+#: Schema tag on the ``obs health --json`` machine-readable verdict.
+HEALTH_SCHEMA = "fedtpu-health-v1"
+
 #: The daemon tiers the hub knows how to summarize (anything else still
 #: scrapes — it just renders the generic counter summary).
 KNOWN_TIERS = (
@@ -167,6 +170,7 @@ class ScrapeHub:
         slos: Iterable[SLO] | None = None,
         alerts_jsonl: str | None = None,
         snapshot_jsonl: str | None = None,
+        snapshot_max_mb: float | None = None,
         scrape_timeout_s: float = 2.0,
         tracer=None,
         recorder=None,
@@ -180,6 +184,13 @@ class ScrapeHub:
         if len(set(keys)) != len(keys):
             raise ValueError(f"duplicate scrape targets: {keys}")
         self.snapshot_jsonl = snapshot_jsonl
+        if snapshot_max_mb is not None and float(snapshot_max_mb) <= 0:
+            raise ValueError(
+                f"snapshot_max_mb={snapshot_max_mb} must be > 0"
+            )
+        self.snapshot_max_mb = (
+            float(snapshot_max_mb) if snapshot_max_mb is not None else None
+        )
         self.scrape_timeout_s = float(scrape_timeout_s)
         self.tracer = tracer
         self.alerts = AlertManager(
@@ -371,7 +382,7 @@ class ScrapeHub:
         }
         if self.snapshot_jsonl:
             try:
-                append_jsonl_line(self.snapshot_jsonl, json.dumps(snapshot))
+                self._write_snapshot(json.dumps(snapshot))
             except OSError:
                 pass  # a full disk costs the record, never the poll loop
         if self.tracer is not None:
@@ -385,6 +396,28 @@ class ScrapeHub:
                 scrape_lag_ms=self.last_scrape_lag_ms,
             )
         return snapshot
+
+    def _write_snapshot(self, line: str) -> None:
+        """Append one snapshot record, with bounded retention when
+        ``snapshot_max_mb`` is set: once the live file crosses the cap
+        it is atomically rolled to ``<path>.1`` (os.replace — a reader
+        sees the old file or the new, never a torn middle) and the
+        write starts a fresh generation, so an unattended ``--watch``
+        holds at most ~2x the cap on disk. The capped path deliberately
+        avoids append_jsonl_line's shared long-lived fd: a cached fd
+        would pin the rotated inode and keep growing it invisibly."""
+        if self.snapshot_max_mb is None:
+            append_jsonl_line(self.snapshot_jsonl, line)
+            return
+        path = self.snapshot_jsonl
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        try:
+            if os.path.getsize(path) >= self.snapshot_max_mb * 1024 * 1024:
+                os.replace(path, path + ".1")
+        except OSError:
+            pass  # no file yet — the append below creates it
+        with open(path, "a") as f:
+            f.write(line + "\n")
 
     @staticmethod
     def _row(target: Target, st: dict) -> dict:
@@ -568,3 +601,77 @@ class ScrapeHub:
         except KeyboardInterrupt:
             pass
         return n
+
+
+def health_verdict(snapshot: dict) -> dict:
+    """The machine-readable twin of :meth:`ScrapeHub.render_status` —
+    ``fedtpu obs health --json``'s contract for cron/CI consumers.
+
+    Same judgement the rendered screen (and the CLI's exit code) makes,
+    as one schema-versioned dict: ``healthy`` is False exactly when a
+    target is down or an SLO is firing. Raw per-target summaries stay
+    in the snapshot JSONL; this is the verdict layer."""
+    rows = snapshot.get("targets") or []
+    states = snapshot.get("slo") or []
+    down = [
+        {
+            "tier": r["tier"],
+            "instance": r["instance"],
+            "error": r.get("error"),
+        }
+        for r in rows
+        if not r["up"]
+    ]
+    firing = [
+        {
+            "slo": s["slo"],
+            "instance": s["instance"],
+            "severity": s.get("severity"),
+            "burn": s.get("burn"),
+        }
+        for s in states
+        if s["firing"]
+    ]
+    notable: list[dict] = []
+    for r in rows:
+        if r.get("last_drift"):
+            notable.append(
+                {
+                    "kind": "drift",
+                    "tier": r["tier"],
+                    "instance": r["instance"],
+                    **{
+                        k: r["last_drift"].get(k)
+                        for k in ("ts", "drift", "method")
+                    },
+                }
+            )
+        if r.get("postmortems"):
+            notable.append(
+                {
+                    "kind": "postmortems",
+                    "tier": r["tier"],
+                    "instance": r["instance"],
+                    "count": r["postmortems"],
+                }
+            )
+        if r.get("last_round_failed"):
+            notable.append(
+                {
+                    "kind": "round-failed",
+                    "tier": r["tier"],
+                    "instance": r["instance"],
+                }
+            )
+    return {
+        "schema": HEALTH_SCHEMA,
+        "ts": snapshot.get("ts"),
+        "healthy": not down and not firing,
+        "targets": len(rows),
+        "targets_up": sum(1 for r in rows if r["up"]),
+        "targets_down": down,
+        "slo_total": len(states),
+        "slo_firing": firing,
+        "scrape_lag_ms": snapshot.get("scrape_lag_ms"),
+        "notable": notable,
+    }

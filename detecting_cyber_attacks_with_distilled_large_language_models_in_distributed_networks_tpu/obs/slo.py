@@ -161,6 +161,17 @@ def default_slos() -> tuple[SLO, ...]:
             objective=0.999,
             windows=((600.0, PAGE_BURN_FACTOR), (60.0, PAGE_BURN_FACTOR)),
         ),
+        # Canary end-to-end: 99% of sentinel canary probes score through
+        # the full router->replica chain within 250 ms (obs/sentinel.py
+        # feeds the histogram — the known-truth proof of the live path).
+        SLO(
+            name="canary-latency-p99",
+            metric="fedtpu_canary_latency_seconds",
+            kind="latency",
+            le=0.25,
+            objective=0.99,
+            windows=((300.0, PAGE_BURN_FACTOR), (60.0, PAGE_BURN_FACTOR)),
+        ),
     )
 
 

@@ -427,6 +427,9 @@ def timeline_table(
             "shadow-mirror",
             "shadow-compare",
             "shadow-gate",
+            "canary-probe",
+            "sentinel-eval",
+            "regression-fire",
         )
     ]
     if unscoped and round_filter is None:
@@ -437,7 +440,8 @@ def timeline_table(
                 for k in (
                     "reason", "bundle", "drift", "firing", "up",
                     "site", "recompile", "pairs", "flip_rate", "passed",
-                    "artifact", "mirrored",
+                    "artifact", "mirrored", "mismatches", "flips",
+                    "drift_fired", "regressions", "field", "now_mean",
                 )
                 if s.get(k) is not None
             )

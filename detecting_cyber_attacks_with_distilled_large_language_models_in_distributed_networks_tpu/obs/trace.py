@@ -76,6 +76,17 @@ Span vocabulary (names are the contract the timeline tool groups by)::
                   candidate over joined ground truth (labels/join.py),
                   with ``artifact``/``passed``/``joined``/``coverage``/
                   ``serving_error``/``candidate_error``
+    canary-probe  one sentinel canary pass through the live serving
+                  chain (obs/sentinel.py), with ``probes``/``failures``/
+                  ``mismatches``/``flips``/``artifact``/
+                  ``latency_p99_ms``
+    sentinel-eval one full sentinel tick over every configured rung
+                  (obs/sentinel.py), with ``tick``/``canary_incidents``/
+                  ``drift_fired``/``regressions``
+    regression-fire  a long-horizon trend regression against the pinned
+                  baseline window (obs/sentinel.py RetentionRing), with
+                  ``field``/``baseline``/``now_mean``/``ratio``/
+                  ``direction``
 
 Timestamps are wall-clock unix seconds (``ts``) with a separately
 measured monotonic duration (``dur_s``): cross-process correlation needs
@@ -122,6 +133,9 @@ SPAN_NAMES = (
     "shadow-gate",
     "label-join",
     "label-gate",
+    "canary-probe",
+    "sentinel-eval",
+    "regression-fire",
 )
 
 #: Wire meta key the trace id rides under (comm/server.py reply meta,

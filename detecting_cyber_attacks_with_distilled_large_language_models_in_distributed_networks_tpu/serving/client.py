@@ -577,6 +577,49 @@ def fetch_stats(
         return cli.stats()
 
 
+def probe_scores(
+    host: str,
+    port: int,
+    texts: Sequence[str],
+    *,
+    timeout: float = 10.0,
+    deadline_ms: float | None = None,
+    trace: str | None = None,
+    auth_key: bytes | None = None,
+) -> list[tuple[dict, float]]:
+    """One canary pass: dial ONE connection, score every text in order,
+    close. Returns ``(reply, latency_s)`` per text, where the latency is
+    the per-request send->reply wall — the sentinel's end-to-end canary
+    measurement (obs/sentinel.py), deliberately the synchronous client
+    so each probe measures a full round trip, not pipelined overlap. An
+    explicit server reject still yields a measurement: the reply dict is
+    the reject body plus ``"rejected": True`` (a canary that cannot be
+    scored is a finding, not a crash); transport errors propagate to the
+    caller, who counts the pass unreachable."""
+    out: list[tuple[dict, float]] = []
+    with ScoringClient(
+        host, port, timeout=timeout, auth_key=auth_key
+    ) as cli:
+        for text in texts:
+            t0 = time.monotonic()
+            try:
+                reply = cli.score(
+                    text=text, deadline_ms=deadline_ms, trace=trace
+                )
+            except ScoreRejected as e:
+                reply = {
+                    "id": e.req_id,
+                    "rejected": True,
+                    "code": e.code,
+                    "reason": e.reason,
+                    "prob": float("nan"),
+                    "prediction": 0,
+                    "round": None,
+                }
+            out.append((reply, time.monotonic() - t0))
+    return out
+
+
 def load_arrival_trace(path: str) -> list[float]:
     """Read a recorded inter-arrival trace: one non-negative gap (in
     seconds) per line, blank lines and ``#`` comments skipped. The

@@ -610,9 +610,10 @@ def test_obs_health_cli_renders_and_exit_codes(live_fleet, capsys):
     )
     capsys.readouterr()
     assert rc == 1
-    # --json emits the machine-readable snapshot; --flight-dir arms the
-    # HUB's recorder (the process that evaluates SLOs is the one that
-    # can dump on a page).
+    # --json emits the schema-tagged health VERDICT (the raw snapshot
+    # stream lives in --snapshot-jsonl); --flight-dir arms the HUB's
+    # recorder (the process that evaluates SLOs is the one that can
+    # dump on a page).
     from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.cli import (
         obs as cli_obs,
     )
@@ -637,7 +638,9 @@ def test_obs_health_cli_renders_and_exit_codes(live_fleet, capsys):
         ]
     )
     doc = json.loads(capsys.readouterr().out)
-    assert rc == 0 and doc["schema"] == "fedtpu-fleet-v1"
+    assert rc == 0 and doc["schema"] == "fedtpu-health-v1"
+    assert doc["healthy"] is True and doc["targets_up"] == 1
+    assert doc["slo_firing"] == [] and doc["targets_down"] == []
     # Missing --target is an operator error.
     with pytest.raises(SystemExit):
         main(["obs", "health"])
