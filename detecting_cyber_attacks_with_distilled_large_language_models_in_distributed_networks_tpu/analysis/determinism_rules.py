@@ -65,6 +65,12 @@ SCOPE = (
     # scored-records join must rebuild bit-identical state from the
     # same files — timestamps are caller-supplied, never clock-read.
     "labels/",
+    # Sharded scorer (ISSUE 20): the serving engine's bucket programs
+    # and shard layout sit inside the crc contract too — a sharded
+    # replica must replay the replicated engine's probs bit-for-bit
+    # (bench's serve_fsdp_crc_exact), which any nondeterministic
+    # bucketing/padding/placement choice here would break.
+    "serving/engine.py",
 )
 
 _SEEDED_NP_CTORS = frozenset(

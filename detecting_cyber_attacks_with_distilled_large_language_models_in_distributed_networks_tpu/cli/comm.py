@@ -165,6 +165,7 @@ def cmd_serve(args) -> int:
         stream_chunk_bytes=stream_chunk_bytes,
         strategy=getattr(args, "strategy", None),
         strategy_state_path=getattr(args, "strategy_state_file", None),
+        reply_dtype=getattr(args, "reply_dtype", "fp32"),
     ) as server:
         log.info(f"[SERVER] listening on {args.host}:{server.port}")
         server.serve(rounds=rounds)

@@ -135,17 +135,15 @@ def _obs_setup(
 
 # ------------------------------------------------------------------ config
 def _preset_model(preset: str, vocab_size: int) -> ModelConfig:
-    if preset == "tiny":
-        return ModelConfig.tiny(vocab_size=vocab_size)
-    if preset == "distilbert":
-        return ModelConfig(vocab_size=vocab_size)
-    if preset == "bert":
-        return ModelConfig.bert_base(vocab_size=vocab_size)
-    if preset == "bert-large":
-        return ModelConfig.bert_large(vocab_size=vocab_size)
-    raise SystemExit(
-        f"unknown --preset {preset!r} (tiny|distilbert|bert|bert-large)"
-    )
+    # One registry (models/presets.py) behind every entrypoint's
+    # --preset; adding a scale point is a registry entry, not an
+    # if-chain edit here.
+    from ..models.presets import model_preset
+
+    try:
+        return model_preset(preset, vocab_size=vocab_size)
+    except ValueError as e:
+        raise SystemExit(f"--preset: {e}") from None
 
 
 def _resolve_mesh(args, cfg: ExperimentConfig, n: int) -> MeshConfig:

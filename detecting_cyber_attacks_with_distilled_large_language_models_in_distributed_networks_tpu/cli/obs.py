@@ -396,6 +396,17 @@ def _cmd_sentinel(args) -> int:
         baseline_n=getattr(args, "baseline_n", None) or 8,
         window_n=getattr(args, "window_n", None) or 8,
     )
+    # Per-deployment trend fields (--trend-field NAME[:direction]): merge
+    # BEFORE the --regression-ratio rewrite, so a custom ratio applies to
+    # the custom fields exactly as it does to the stock ones.
+    for spec in getattr(args, "trend_field", None) or ():
+        from ..obs.sentinel import parse_trend_field_spec
+
+        try:
+            name, entry = parse_trend_field_spec(spec)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
+        ring.trend_fields[name] = entry
     ratio = getattr(args, "regression_ratio", None)
     if ratio is not None:
         if ratio <= 1.0:

@@ -382,6 +382,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="reply encoding: none|bf16|int8 (topk is upload-side only)",
     )
     p.add_argument(
+        "--reply-dtype",
+        choices=["fp32", "bf16", "int8"],
+        default="fp32",
+        help="wire dtype for the STREAMED reply leg, capability-"
+        "negotiated like the upload leg's --wire-dtype: clients that "
+        "advertise the codec get the global streamed bf16 (2x) or "
+        "chunked-absmax int8 (~4x) instead of fp32; everyone else "
+        "(and dense replies) stays fp32. Lossy dtypes are refused "
+        "under --secure-agg and with --compression (one reply "
+        "encoding at a time)",
+    )
+    p.add_argument(
         "--secure-agg",
         action="store_true",
         help="secure aggregation: accept pairwise-masked uploads and "
@@ -903,6 +915,24 @@ def build_parser() -> argparse.ArgumentParser:
         "here — the join key against the delayed ground-truth journal "
         "(fedtpu labels report --scored X). Off by default: the metrics "
         "stream keeps exporting binned histograms, never raw scores",
+    )
+    p.add_argument(
+        "--data-parallel",
+        type=int,
+        default=None,
+        help="with --fsdp: shard the serving params over this many local "
+        "chips (N >= 2). Serves models bigger than one chip: per-chip "
+        "static bytes scale ~1/N and each warm bucket program gathers "
+        "the weights at use",
+    )
+    p.add_argument(
+        "--fsdp",
+        action="store_true",
+        default=None,
+        help="shard-at-rest serving (needs --data-parallel N): checkpoint "
+        "restore scatters leaves straight onto shards, hot reloads swap "
+        "without recompiling warm buckets, probs stay bit-identical to "
+        "the replicated engine",
     )
     _add_flight_dir(p)
     p.set_defaults(fn=cmd_infer_serve)
@@ -1707,6 +1737,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="sentinel: fire when a watched field's current-window mean "
         "moves past baseline * ratio (default 1.5; round cadence fires "
         "on the inverse drop)",
+    )
+    p.add_argument(
+        "--trend-field",
+        action="append",
+        default=None,
+        metavar="NAME[:direction]",
+        help="sentinel: ALSO run the retention-ring trend check on this "
+        "per-deployment field (repeatable). The value is read from the "
+        "scraped targets' metric snapshots (max across targets, like "
+        "eject rate); direction up (default) fires on a rise past "
+        "baseline * ratio, down on the inverse drop. --regression-ratio "
+        "applies to these too",
     )
     p.set_defaults(fn=cmd_obs)
 

@@ -15,12 +15,18 @@ from .common import _resolve_with_pretrained
 log = get_logger()
 
 
-def _restore_predict_params(cfg, tok, trainer, *, ckpt_dir=None, step=None):
+def _restore_predict_params(
+    cfg, tok, trainer, *, ckpt_dir=None, step=None, mesh=None
+):
     """Trained weights for inference from a checkpoint directory
     (``cfg.checkpoint_dir`` unless ``ckpt_dir`` overrides — distill's
     teacher restore points elsewhere; ``step`` pins a specific saved step
     — serving's hot reload needs params and round metadata read from ONE
-    snapshot, not whatever became latest between two reads).
+    snapshot, not whatever became latest between two reads; ``mesh`` is
+    the sharded-serving restore target: local-checkpoint leaves scatter
+    STRAIGHT onto their FSDP shards via the orbax sharding-aware template
+    — the full-size tree never materializes on one chip — and federated
+    replica-0 params are placed onto shards right after the collapse).
 
     Understands both checkpoint flavors: a ``local``/``client`` TrainState
     (restored against this trainer's template, or the checkpoint's own
@@ -68,6 +74,12 @@ def _restore_predict_params(cfg, tok, trainer, *, ckpt_dir=None, step=None):
             template = jax.eval_shape(lambda: ftr.init_state(seed=0))
             stacked = ckpt.restore_params(template, step=step)
             params = jax.tree.map(lambda x: np.asarray(x)[0], stacked)
+            if mesh is not None:
+                from ..parallel.mesh import fsdp_tree_shardings
+
+                params = jax.device_put(
+                    params, fsdp_tree_shardings(params, mesh)
+                )
             log.info(
                 f"[PREDICT] restored federated checkpoint (round "
                 f"{meta.get('round', '?')}, {fed_cfg.fed.num_clients} clients)"
@@ -95,6 +107,16 @@ def _restore_predict_params(cfg, tok, trainer, *, ckpt_dir=None, step=None):
             if model_cfg != trainer.model_cfg:
                 trainer = Trainer(model_cfg, cfg.train, pad_id=tok.pad_id)
         template = jax.eval_shape(lambda: trainer.init_state(seed=0))
+        if mesh is not None:
+            # Sharding-aware scatter-restore: the template's params leaves
+            # carry their fsdp_spec NamedShardings, so orbax lands each
+            # leaf directly on its shards (checkpoint.py _abstract passes
+            # template shardings through) — no full-size host/device copy.
+            from ..parallel.mesh import shard_template
+
+            template = template._replace(
+                params=shard_template(template.params, mesh)
+            )
         try:
             params = ckpt.restore_params(template, step=step)
         except Exception as e:

@@ -44,6 +44,28 @@ def cmd_infer_serve(args) -> int:
 
     tok, cfg, pretrained = _resolve_with_pretrained(args)
     buckets = _parse_buckets(args.buckets)
+    # Sharded scorer (--data-parallel N --fsdp): params live split
+    # per-leaf across this host's chips and every bucket program
+    # all-gathers them at use — serving a model bigger than one chip.
+    # The mesh is built BEFORE the restore so checkpoint leaves scatter
+    # straight onto their shards (never one full-size copy per chip).
+    mesh = None
+    n_dp = int(getattr(args, "data_parallel", None) or 0)
+    if getattr(args, "fsdp", None):
+        if n_dp < 2:
+            raise SystemExit(
+                "--fsdp shards the model over the serving mesh: pass "
+                "--data-parallel N with N >= 2"
+            )
+        from ..parallel.mesh import make_host_mesh
+
+        mesh = make_host_mesh(n_dp)
+    elif n_dp > 1:
+        raise SystemExit(
+            "infer-serve uses --data-parallel only for --fsdp sharding "
+            "(replicated data-parallel serving is the fleet tier: "
+            "`fedtpu fleet --replicas N`)"
+        )
     if args.max_queue < buckets[-1]:
         # Validate BEFORE the (slow) checkpoint restore, and as an
         # operator-facing message like every other flag check here.
@@ -118,7 +140,7 @@ def cmd_infer_serve(args) -> int:
         # One restore path for the initial load AND every hot reload —
         # the round-id derivation (meta "round", step fallback) must not
         # exist twice and drift.
-        restore = checkpoint_restorer(cfg, tok)
+        restore = checkpoint_restorer(cfg, tok, mesh=mesh)
         step = latest_finalized_step(cfg.checkpoint_dir)
         model_cfg, params, round_id = restore(step)
         watcher = CheckpointWatcher(
@@ -136,7 +158,13 @@ def cmd_infer_serve(args) -> int:
         pad_id=tok.pad_id,
         buckets=buckets,
         round_id=round_id,
+        mesh=mesh,
     )
+    if mesh is not None:
+        log.info(
+            f"[SERVE] sharded scorer: params split over {n_dp} chips "
+            "(gathered at use inside each warm bucket program)"
+        )
     batcher = MicroBatcher(
         max_batch=buckets[-1],
         max_queue=args.max_queue,

@@ -505,6 +505,14 @@ class FederatedClient:
             # Streamed-reply advert: plain meta, so an old server ignores
             # it and keeps sending the dense frame (interop unchanged).
             base_meta[wire.STREAM_REPLY_META_KEY] = 1
+            # Quantized-reply capability (server ``--reply-dtype``): the
+            # stream leaf encodings this client's decode path handles.
+            # The shared stream decode already dequantizes every codec in
+            # WIRE_DTYPE_ENCS, so advertise them all; the server picks at
+            # most its configured one per client.
+            base_meta[wire.REPLY_DTYPE_META_KEY] = sorted(
+                set(wire.WIRE_DTYPE_ENCS.values())
+            )
         dp_base_flat = dp_delta = None
         if self.dp:
             # ``round_base``: the params this round's local training

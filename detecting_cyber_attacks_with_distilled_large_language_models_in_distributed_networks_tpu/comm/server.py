@@ -133,6 +133,12 @@ class _Round:
     # (wire.STREAM_REPLY_META_KEY): their reply fan-out goes out as
     # STRH/STRC/STRT frames instead of one dense model-sized frame.
     stream_replies: set = field(default_factory=set)
+    # Per-client quantized-reply capability (wire.REPLY_DTYPE_META_KEY):
+    # the stream leaf encodings each stream-reply client said it can
+    # dequantize. A --reply-dtype server only sends its lossy encoding
+    # to clients whose advert includes it; everyone else gets the fp32
+    # stream (capability-negotiated, like the upload leg's wire_dtypes).
+    reply_dtype_encs: dict[int, tuple] = field(default_factory=dict)
     # Wire dtype each STREAMED upload actually arrived in ("fp32" /
     # "bf16" / "int8"), derived from its header's leaf encodings — the
     # wire-overlap span's wire_dtypes attr and the by-dtype /metrics
@@ -189,6 +195,7 @@ class AggregationServer:
         stream_chunk_bytes: int = wire.DEFAULT_STREAM_CHUNK,
         strategy: str | None = None,
         strategy_state_path: str | None = None,
+        reply_dtype: str = "fp32",
     ):
         if client_keys is not None and auth_key is None:
             raise ValueError(
@@ -241,6 +248,34 @@ class AggregationServer:
                 "topk is an upload-side (sparse round-delta) compression; "
                 "the reply is an absolute aggregate — use none/bf16/int8"
             )
+        # Quantized streamed replies (--reply-dtype): the downward mirror
+        # of the upload leg's --wire-dtype. Only the STREAMED reply leg
+        # quantizes — dense replies (old clients, non-advertisers, resync
+        # payloads) stay exactly self.compression — so the knob composes
+        # per client via capability negotiation, never by assumption.
+        if reply_dtype not in wire.WIRE_DTYPE_ENCS:
+            raise ValueError(
+                f"reply_dtype {reply_dtype!r} must be one of "
+                f"{sorted(wire.WIRE_DTYPE_ENCS)}"
+            )
+        if reply_dtype != "fp32":
+            if secure_agg:
+                # Mirror of the upload rule: the unmask protocol releases
+                # the exact masked sum; a lossy re-encode of that release
+                # would hand clients a DIFFERENT value than the protocol
+                # authorized (and break the bit-exact base agreement the
+                # masked rounds depend on).
+                raise ValueError(
+                    "lossy reply_dtype is refused under secure aggregation:"
+                    " the unmask release is bit-exact by contract"
+                )
+            if compression != "none":
+                raise ValueError(
+                    "reply_dtype and a reply compression are two encoders "
+                    "for the same leg; pass one (compression "
+                    f"{compression!r} already re-encodes the reply)"
+                )
+        self.reply_dtype = reply_dtype
         # Server aggregation strategy (strategies/): a pure transform of
         # (previous global, folded mean) applied at finalize — the fold
         # itself is untouched, so "fedavg" is bit-identical to the
@@ -1132,6 +1167,11 @@ class AggregationServer:
                     rnd.wants_delta = True
                 if bool(meta.get(wire.STREAM_REPLY_META_KEY, False)):
                     rnd.stream_replies.add(client_id)
+                    encs = meta.get(wire.REPLY_DTYPE_META_KEY)
+                    if isinstance(encs, (list, tuple)):
+                        rnd.reply_dtype_encs[client_id] = tuple(
+                            str(e) for e in encs
+                        )
                 if (
                     self.stream_chunk_bytes > 0
                     and not self.secure_agg
@@ -1678,6 +1718,11 @@ class AggregationServer:
                 rnd.wants_delta = True
             if bool(meta.get(wire.STREAM_REPLY_META_KEY, False)):
                 rnd.stream_replies.add(client_id)
+                encs = meta.get(wire.REPLY_DTYPE_META_KEY)
+                if isinstance(encs, (list, tuple)):
+                    rnd.reply_dtype_encs[client_id] = tuple(
+                        str(e) for e in encs
+                    )
             rnd.conns[client_id] = conn
             if nonce_hex is not None:
                 rnd.nonces[client_id] = nonce_hex
@@ -2989,12 +3034,31 @@ class AggregationServer:
             # (rare / not advertised).
             stream_ids: list[int] = []
             stream_plan = None
+            quant_plan = None
+            quant_ids: set[int] = set()
             if self.stream_chunk_bytes > 0 and not self.secure_agg:
                 stream_ids = [
                     cid for cid in ids if cid in rnd.stream_replies
                 ]
             if stream_ids:
-                stream_plan = self._plan_reply_stream(agg)
+                # Quantized replies (--reply-dtype): only clients whose
+                # upload meta advertised the configured encoding get the
+                # lossy plan; the rest share the base (self.compression)
+                # plan. At most two payload encodes per round, each
+                # shared across its cohort.
+                quant_enc = wire.WIRE_DTYPE_ENCS[self.reply_dtype]
+                if self.reply_dtype != "fp32":
+                    quant_ids = {
+                        cid
+                        for cid in stream_ids
+                        if quant_enc in rnd.reply_dtype_encs.get(cid, ())
+                    }
+                if quant_ids:
+                    quant_plan = self._plan_reply_stream(
+                        agg, compression=quant_enc
+                    )
+                if any(cid not in quant_ids for cid in stream_ids):
+                    stream_plan = self._plan_reply_stream(agg)
             dense_targets = [c for c in reply_targets if c not in stream_ids]
             if not dense_targets:
                 # All-streaming fleet: no dense blob to build — skipping
@@ -3018,9 +3082,12 @@ class AggregationServer:
             stream_jobs = {
                 cid: (
                     self._encode_stream_reply_header(
-                        stream_plan, reply_meta, nonces.get(cid)
+                        quant_plan if cid in quant_ids else stream_plan,
+                        reply_meta,
+                        nonces.get(cid),
                     ),
                     bytes.fromhex(nonces[cid]) if cid in nonces else b"",
+                    quant_plan if cid in quant_ids else stream_plan,
                 )
                 for cid in stream_ids
             }
@@ -3163,13 +3230,13 @@ class AggregationServer:
             )
         t_rep_unix = time.time()
         t_rep0 = time.monotonic()
-        self._reply_all(replies, all_conns, stream_plan, stream_jobs)
+        self._reply_all(replies, all_conns, stream_jobs)
         reply_s = time.monotonic() - t_rep0
         out_bytes = float(sum(len(b) for b in replies.values()))
         if stream_jobs:
             out_bytes += sum(
-                len(hdr) + stream_plan["payload_nbytes"]
-                for hdr, _ in stream_jobs.values()
+                len(hdr) + plan["payload_nbytes"]
+                for hdr, _, plan in stream_jobs.values()
             )
             with self._totals_lock:
                 self.stream_totals["stream_replies"] += len(stream_jobs)
@@ -3308,15 +3375,21 @@ class AggregationServer:
             auth_key=self.auth_key,
         )
 
-    def _plan_reply_stream(self, agg: dict) -> dict:
+    def _plan_reply_stream(self, agg: dict, compression: str | None = None) -> dict:
         """Build the round's shared streamed-reply payload ONCE: the
         tensor plan plus the chunk payload list every advertised client's
         fan-out references. Per-client state (header meta, auth tags) is
         layered on in :meth:`_encode_stream_reply_header` and
         :meth:`_send_stream_reply` — a 256-client fan-out never holds
-        more than one encoded copy of the model payload."""
+        more than one encoded copy of the model payload. ``compression``
+        overrides the server's reply compression for the QUANTIZED reply
+        plan (``--reply-dtype``): at most two plans exist per round — this
+        one for capability-advertising clients, the base plan for the
+        rest — each still shared across its cohort."""
+        if compression is None:
+            compression = self.compression
         flat = wire.flatten_lazy(agg)
-        tensors, payload_nbytes = wire.plan_stream(flat, self.compression)
+        tensors, payload_nbytes = wire.plan_stream(flat, compression)
         chunks: list[bytes] = []
         buf = bytearray()
         for t in tensors:
@@ -3388,20 +3461,21 @@ class AggregationServer:
         self,
         replies: dict[int, bytes],
         conns_map: dict[int, socket.socket],
-        stream_plan: dict | None = None,
-        stream_jobs: dict[int, tuple[bytes, bytes]] | None = None,
+        stream_jobs: dict[int, tuple[bytes, bytes, dict]] | None = None,
     ) -> None:
         """Parallel reply fan-out: send_frame blocks on the client's ACK,
         so a sequential loop would let one dead client stall every healthy
         one behind it for a full socket timeout. ``stream_jobs`` clients
-        get the chunk-streamed shape instead of their ``replies`` blob."""
+        get the chunk-streamed shape instead of their ``replies`` blob;
+        each job carries its own plan (base vs ``--reply-dtype`` quantized
+        — the plan OBJECTS are still shared per cohort)."""
         stream_jobs = stream_jobs or {}
 
         def _reply(cid: int, conn: socket.socket) -> None:
             try:
                 if cid in stream_jobs:
-                    header, nonce = stream_jobs[cid]
-                    self._send_stream_reply(conn, header, stream_plan, nonce)
+                    header, nonce, plan = stream_jobs[cid]
+                    self._send_stream_reply(conn, header, plan, nonce)
                 else:
                     framing.send_frame(conn, replies[cid])
             except (OSError, wire.WireError, ConnectionError) as e:

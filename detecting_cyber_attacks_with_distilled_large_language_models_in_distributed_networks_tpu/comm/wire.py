@@ -228,6 +228,15 @@ WIRE_DTYPE_META_KEY = "wire_dtypes"
 #: NOT the per-row ``int8`` — fixed element chunks give every leaf shape
 #: uniform scale resolution and a plannable encoded size.
 WIRE_DTYPE_ENCS = {"fp32": "raw", "bf16": "bf16", "int8": "int8c"}
+#: Upload-meta capability advert for QUANTIZED streamed *replies* (the
+#: server-side ``--reply-dtype`` negotiation — the mirror of
+#: WIRE_DTYPE_META_KEY's upload leg): the list of lossy stream encodings
+#: this client will dequantize when the server streams the round's
+#: global back down (e.g. ``["bf16", "int8c"]``). Plain meta: an old
+#: server ignores it and keeps replying fp32; a client that doesn't
+#: advertise keeps receiving fp32 from a ``--reply-dtype int8`` server
+#: (capability-negotiated per client, never assumed).
+REPLY_DTYPE_META_KEY = "reply_dtypes"
 DEFAULT_STREAM_CHUNK = 4 << 20  # 4 MiB: bounds receiver buffering
 #: Worst-case STRC frame bytes beyond the chunk data itself (magic + u64
 #: seq + auth tag). A configured/advertised chunk size must leave this

@@ -5,3 +5,4 @@ from .distilbert import (  # noqa: F401
     param_count,
 )
 from .hf_convert import flax_to_hf, hf_to_flax  # noqa: F401
+from .presets import PRESETS, model_preset, preset_names  # noqa: F401

@@ -1,0 +1,44 @@
+"""Named model-size presets — the single registry behind ``--preset``.
+
+Every entrypoint that sizes a model (train, federated, infer-serve,
+bench) resolves the name here, so adding a scale point is one registry
+entry instead of an if-chain edit per CLI. The ladder's top end exists
+for the sharded tiers: ``bert-large`` (~335 M params, ~1.3 GB fp32)
+does not fit a small accelerator's HBM next to its optimizer state —
+it is the demonstration scale for ``train --fsdp`` and the sharded
+scorer (``infer-serve --data-parallel N --fsdp``), where params live
+split per-leaf across the mesh and are gathered at use.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..config import ModelConfig
+
+#: name -> ModelConfig factory. Ordered small -> large so help strings
+#: and error messages read as the scale ladder.
+PRESETS: dict[str, Callable[..., ModelConfig]] = {
+    "tiny": ModelConfig.tiny,
+    "distilbert": ModelConfig.distilbert_base,
+    "bert": ModelConfig.bert_base,
+    "bert-large": ModelConfig.bert_large,
+}
+
+
+def preset_names() -> tuple[str, ...]:
+    """The registry's names in ladder order (for help/error strings)."""
+    return tuple(PRESETS)
+
+
+def model_preset(name: str, **kw: Any) -> ModelConfig:
+    """Resolve a preset name to its ModelConfig (ValueError on unknown —
+    CLI callers wrap it into their SystemExit idiom)."""
+    try:
+        factory = PRESETS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown model preset {name!r} "
+            f"(one of: {'|'.join(PRESETS)})"
+        ) from None
+    return factory(**kw)

@@ -86,6 +86,35 @@ DEFAULT_TREND_FIELDS: dict[str, tuple[float, float, str]] = {
 }
 
 
+def parse_trend_field_spec(spec: str) -> tuple[str, tuple[float, float, str]]:
+    """One ``--trend-field NAME[:direction]`` value -> a
+    ``(name, (ratio, floor, direction))`` trend-fields entry.
+
+    Per-deployment ring fields: the named counter's per-tick rate is
+    pulled from the fleet snapshot's cadence dicts (max across targets,
+    exactly how the stock ``round_cadence``/``eject_rate`` rows are
+    built) and judged by the same pure-arithmetic baseline/window check
+    as the stock fields. Direction defaults to "up" (a counter whose
+    RATE growing past baseline*ratio is the regression); ":down" watches
+    for the rate collapsing (a heartbeat counter going quiet). The
+    stock ratio/floor defaults (1.5, 0.0) apply — deployments needing
+    custom thresholds pair this with ``--regression-ratio``."""
+    name, sep, direction = spec.partition(":")
+    name = name.strip()
+    direction = direction.strip() if sep else "up"
+    if not name:
+        raise ValueError(
+            f"--trend-field {spec!r}: want NAME or NAME:direction "
+            "(e.g. fedtpu_server_stream_fallbacks_total:up)"
+        )
+    if direction not in ("up", "down"):
+        raise ValueError(
+            f"--trend-field {spec!r}: direction must be up|down "
+            f"(got {direction!r})"
+        )
+    return name, (1.5, 0.0, direction)
+
+
 # ------------------------------------------------------------------ canaries
 @dataclass(frozen=True)
 class CanaryFlow:
@@ -822,6 +851,20 @@ class Sentinel:
                 ),
                 "eject_rate": eject,
             }
+            # Custom --trend-field rows: any watched field that is not a
+            # stock row input is a per-deployment counter rate, pulled
+            # from the fleet snapshot's cadence dicts the same way the
+            # stock fleet-side inputs are (max across targets — the
+            # hottest instance is the one regressing).
+            for field in self.ring.trend_fields:
+                if field in row:
+                    continue
+                val = None
+                for t in (snapshot or {}).get("targets", ()):
+                    v = (t.get("cadence") or {}).get(field)
+                    if v is not None:
+                        val = max(val or 0.0, float(v))
+                row[field] = val
             self.ring.note(row, now=now)
             regressions = self.ring.trend()
             for reg in regressions:

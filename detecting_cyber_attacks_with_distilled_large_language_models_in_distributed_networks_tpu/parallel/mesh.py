@@ -208,6 +208,94 @@ def fsdp_tree_shardings(tree, mesh: Mesh, *, axis: str = "data"):
     return jax.tree.map(_leaf, tree)
 
 
+def shard_template(template, mesh: Mesh, *, axis: str = "data"):
+    """Attach each leaf's FSDP ``NamedSharding`` to a ``ShapeDtypeStruct``
+    restore template, so a sharding-aware checkpoint restore (orbax honors
+    template shardings — train/checkpoint.py ``_abstract``) scatters every
+    leaf straight onto its shard: the full-size array never materializes
+    on any single chip, which is the whole point of serving a model bigger
+    than one chip's memory."""
+    import jax
+
+    shardings = fsdp_tree_shardings(template, mesh, axis=axis)
+    return jax.tree.map(
+        lambda t, s: jax.ShapeDtypeStruct(
+            tuple(int(d) for d in np.shape(t)),
+            getattr(t, "dtype", np.float32),
+            sharding=s,
+        ),
+        template,
+        shardings,
+    )
+
+
+def fsdp_gather(mesh: Mesh):
+    """The gather-AT-USE callable (the ``gather=`` side of the
+    ``make_packed_step`` parameterization): constrain every leaf of a
+    sharded tree to replicated, so XLA inserts the all-gather inside the
+    jitted program right where the weights are consumed — full-size
+    weights exist only transiently, never at rest."""
+    replicated = NamedSharding(mesh, P())
+
+    def gather(tree):
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, replicated), tree
+        )
+
+    return gather
+
+
+def fsdp_gather_program(tree, mesh: Mesh, *, note=None):
+    """A SEPARATE jitted all-gather program: identity over ``tree`` with
+    replicated ``out_shardings``, so executing it reconstructs every
+    sharded leaf's exact full-size bytes on each chip.
+
+    Why a second program instead of :func:`fsdp_gather`'s in-body
+    constraint: a constraint gather splices 100+ all-gather ops into the
+    consumer's HLO module, and XLA's fusion/layout choices around those
+    collectives differ from the module it builds for the same math over
+    replicated inputs — a data-dependent 1-ulp drift, with zero
+    all-reduces or partitioned contractions in sight. The serving crc
+    contract (sharded probs bit-identical to the replicated engine's,
+    bench ``serve_fsdp_crc_exact``) needs the CONSUMER program compiled
+    clean; splitting the gather out gives it byte-exact replicated
+    inputs and an HLO module free of collectives. Gather-at-use
+    semantics are unchanged — the program runs per dispatch and its
+    output is dropped with the forward, so full-size weights still never
+    exist at rest. The train step keeps the constraint form (its
+    contract is replaying ITSELF, where one fused module is its own
+    baseline).
+
+    ``note``: optional trace-time callable (a
+    ``CompileLedger.hook`` note) — runs once per compilation, so the
+    caller's ledger flags a retrace of the gather program the same way
+    it flags a bucket retrace."""
+    replicated = NamedSharding(mesh, P())
+    out = jax.tree.map(lambda _: replicated, tree)
+
+    def _identity(t):
+        if note is not None:
+            note(("gather",))
+        return t
+
+    return jax.jit(_identity, out_shardings=out)
+
+
+def fsdp_constrain(mesh: Mesh, *, axis: str = "data"):
+    """The shard-at-rest callable (the ``constrain=`` side): pin every
+    leaf of a tree back onto its :func:`fsdp_spec` shard, so step outputs
+    (new params, optimizer moments, grads) land sharded instead of
+    inheriting the gathered replicated layout."""
+
+    def constrain(tree):
+        shardings = fsdp_tree_shardings(tree, mesh, axis=axis)
+        return jax.tree.map(
+            jax.lax.with_sharding_constraint, tree, shardings
+        )
+
+    return constrain
+
+
 def device_tree_bytes(tree) -> int:
     """Bytes ``tree``'s leaves occupy on ONE device (per leaf: the
     lowest-id device holding a shard of it) — the per-chip static-state

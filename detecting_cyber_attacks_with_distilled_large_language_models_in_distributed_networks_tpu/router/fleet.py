@@ -64,14 +64,21 @@ class FleetReplica:
         idle_tick_s: float = 0.02,
         tracer=None,
         trace_sample: float = 1.0,
+        mesh=None,
     ):
         self.replica_id = int(replica_id)
+        # ``mesh``: an FSDP host mesh makes this a SHARDED replica —
+        # params at rest split per-leaf across the mesh's chips, gathered
+        # at use inside each warm bucket program. adopt() (the rolling-
+        # reload swap target) re-places onto the same shape-deterministic
+        # layout, so a mid-traffic drain→swap never retraces a bucket.
         self.engine = ScoreEngine(
             model_cfg,
             params,
             pad_id=tok.pad_id,
             buckets=buckets,
             round_id=round_id,
+            mesh=mesh,
         )
         self.server = ScoringServer(
             self.engine,

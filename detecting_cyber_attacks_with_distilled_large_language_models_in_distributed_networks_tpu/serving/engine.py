@@ -9,10 +9,33 @@ compilations for its whole lifetime, all of them optionally paid at
 startup (``warmup()``), and every request thereafter hits a warm path.
 
 The probability math is exactly the eval path's (train/engine.py
-``eval_counts``): ``softmax(model.apply(...))[:, 1]`` with deterministic
-apply, and pad rows built the way ``pad_split_to_batch`` builds them —
-which is what makes served probabilities bit-for-bit equal to ``fedtpu
-predict``'s (pinned in tests/test_serving.py).
+``eval_counts``): ``softmax(model.apply(...))`` with deterministic
+apply — scalar score ``[:, 1]`` for K = 2, ``1 - [:, 0]`` for K > 2
+(the same STATIC head-width branch) — and pad rows built the way
+``pad_split_to_batch`` builds them — which is what makes served
+probabilities bit-for-bit equal to ``fedtpu predict``'s (pinned in
+tests/test_serving.py). The full per-class softmax rides along so the
+serving wire can carry K-class scores (serving/protocol.py
+``class_probs``).
+
+Sharded serving (``mesh=``): with an FSDP host mesh the engine holds
+params sharded per-leaf AT REST (parallel/mesh.py ``fsdp_tree_shardings``
+— per-chip static bytes ~1/N) and all-gathers the weights AT USE via a
+separate per-dispatch jitted program (``fsdp_gather_program`` — see its
+docstring for why the gather is NOT the train step's in-body constraint:
+inlined collectives shift XLA's fusion and drift the probs by 1 ulp,
+breaking the crc contract below), so full-size weights exist only
+transiently during a forward and every bucket program compiles the SAME
+collective-free module the replicated engine runs — served probabilities
+from a sharded replica are bit-identical to a replicated one's (bench
+``serve_fsdp_crc_exact``). ``swap`` re-places onto the SAME
+shape-deterministic layout (``fsdp_spec`` is a pure function of
+(shape, n_shards)), so a rolling hot-reload reuses every warm bucket
+program — the ledger's 0-recompile guarantee holds across reloads.
+The shard-layout derivation is inside the ``fedtpu check`` determinism
+scope: the layout must replay identically on every process, or a
+restore-scatter and a reply-leaf sink would disagree about where bytes
+live.
 
 Compile counting: the Python body of a jitted function runs once per
 traced shape — so a trace hook inside ``_probs`` IS a compile hook, not
@@ -59,6 +82,7 @@ class ScoreEngine:
         pad_id: int = 0,
         buckets: tuple[int, ...] = DEFAULT_BUCKETS,
         round_id: int = 0,
+        mesh: Any = None,
     ):
         import jax
 
@@ -68,6 +92,8 @@ class ScoreEngine:
         self.pad_id = int(pad_id)
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.seq_len = int(model_cfg.max_len)
+        self.mesh = mesh
+        self.n_shards = int(mesh.shape["data"]) if mesh is not None else 1
         # Private compile ledger (obs/profile.py): per-engine counts —
         # two engines in one process must not mix their compile-count
         # assertions — while the metric families it increments are the
@@ -78,8 +104,28 @@ class ScoreEngine:
         # process-wide (--profile-stride / ObsConfig.profile_stride).
         self.step_profiler = maybe_step_profiler("score")
         self._lock = threading.Lock()
-        self._params = jax.device_put(params)
+        self._params = self._place(params)
         self._round_id = int(round_id)
+        # Gather-at-use as its OWN jitted program (parallel/mesh.py
+        # fsdp_gather_program): executed per dispatch, output dropped
+        # with the forward — full-size weights still never exist at
+        # rest — but the bucket programs below compile over replicated
+        # inputs, collective-free. An in-body constraint gather (the
+        # train step's form) splices the all-gathers into the bucket
+        # module and XLA's fusion around them drifts the probs by 1 ulp
+        # vs the replicated engine, which the serving crc contract
+        # forbids. The gather program gets its own ledger site so a
+        # swap-induced retrace of IT is flagged like a bucket retrace.
+        if mesh is not None:
+            from ..parallel.mesh import fsdp_gather_program
+
+            self._gather_prog = fsdp_gather_program(
+                self._params,
+                mesh,
+                note=self.ledger.hook("serving.gather"),
+            )
+        else:
+            self._gather_prog = None
         model = DDoSClassifier(model_cfg)
 
         def _probs(p, input_ids, attention_mask):
@@ -90,9 +136,32 @@ class ScoreEngine:
             logits = model.apply(
                 {"params": p}, input_ids, attention_mask, True
             )
-            return jax.nn.softmax(logits, axis=-1)[:, 1]
+            class_probs = jax.nn.softmax(logits, axis=-1)
+            # STATIC head-width branch, mirroring eval_counts: K = 2
+            # keeps the binary scalar verbatim (bit-identical to the
+            # pre-K-class serving path); K > 2 scores P(any attack).
+            if int(logits.shape[-1]) == 2:
+                score = class_probs[:, 1]
+            else:
+                score = 1.0 - class_probs[:, 0]
+            return score, class_probs
 
         self._probs = self.ledger.timed("serving.probs", jax.jit(_probs))
+
+    def _place(self, params: Any) -> Any:
+        """Device placement honoring the engine's layout: replicated for
+        a plain engine, per-leaf ``fsdp_spec`` shardings for a sharded
+        one. Shape-deterministic, so every swap lands the new weights on
+        the exact layout the warm programs were compiled for."""
+        import jax
+
+        if self.mesh is None:
+            return jax.device_put(params)
+        from ..parallel.mesh import fsdp_tree_shardings
+
+        return jax.device_put(
+            params, fsdp_tree_shardings(params, self.mesh)
+        )
 
     @property
     def compile_counts(self) -> dict[tuple[int, int], int]:
@@ -110,10 +179,11 @@ class ScoreEngine:
         """Adopt a new checkpoint's params (same architecture — shapes are
         unchanged, so the compiled programs are reused as-is; a changed
         architecture needs a new engine, serving/reload.py handles that
-        distinction)."""
-        import jax
-
-        new = jax.device_put(params)
+        distinction). On a sharded engine the new params land on the SAME
+        per-leaf shard layout the warm programs were compiled against
+        (``fsdp_spec`` is shape-deterministic), so a rolling reload never
+        retraces a bucket — the ledger flags it if one ever does."""
+        new = self._place(params)
         with self._lock:
             self._params = new
             self._round_id = int(round_id)
@@ -142,7 +212,10 @@ class ScoreEngine:
                 np.full((b, self.seq_len), self.pad_id, np.int32),
                 np.zeros((b, self.seq_len), np.int32),
             )
-        self.ledger.mark_warm("serving.probs")
+        # Freeze every site — the bucket ladder AND (sharded engines)
+        # the gather program, whose retrace after a swap would be just
+        # as much a served-latency cliff as a bucket retrace.
+        self.ledger.mark_warm()
         log.info(
             f"[SERVE] warmed {len(self.buckets)} bucket programs "
             f"(batch in {self.buckets}, seq {self.seq_len})"
@@ -150,8 +223,9 @@ class ScoreEngine:
 
     def score(
         self, input_ids: np.ndarray, attention_mask: np.ndarray
-    ) -> tuple[np.ndarray, int, int]:
-        """Score ``[n, seq]`` rows -> (float32 probs [n], bucket, round).
+    ) -> tuple[np.ndarray, np.ndarray, int, int]:
+        """Score ``[n, seq]`` rows -> (float32 probs [n], per-class
+        softmax [n, K], bucket, round).
 
         Pads up to the bucket with PAD rows exactly as
         ``pad_split_to_batch`` does for eval (pad_id ids, zero mask) and
@@ -183,14 +257,24 @@ class ScoreEngine:
             input_ids = np.concatenate([input_ids, pad_ids])
             attention_mask = np.concatenate([attention_mask, pad_mask])
         params, round_id = self.snapshot()
+        if self._gather_prog is not None:
+            # Gather AT USE: reconstruct full-size weights for this
+            # dispatch only — ``params`` here is a local that dies with
+            # the call, so the gathered tree is freed after the forward.
+            params = self._gather_prog(params)
         ids = np.ascontiguousarray(input_ids, np.int32)
         mask = np.ascontiguousarray(attention_mask, np.int32)
         if sampled:
             prof.note_host(prof.clock() - t0)
             t1 = prof.clock()
-            probs = self._probs(params, ids, mask)
+            probs, class_probs = self._probs(params, ids, mask)
             prof.note_dispatch(prof.clock() - t1)
             prof.fence(probs)
         else:
-            probs = self._probs(params, ids, mask)
-        return np.asarray(probs)[:n], bucket, round_id
+            probs, class_probs = self._probs(params, ids, mask)
+        return (
+            np.asarray(probs)[:n],
+            np.asarray(class_probs)[:n],
+            bucket,
+            round_id,
+        )

@@ -131,11 +131,19 @@ def build_reply(
     bucket: int,
     queue_ms: float,
     trace: str | None = None,
+    class_probs: list | None = None,
 ) -> bytes:
     """P(attack) + the per-request telemetry that makes the service
     observable from the client side alone: which model round answered,
     how large the coalesced batch was, and how long the request queued.
-    ``trace`` echoes the request's obs trace id when it carried one."""
+    ``trace`` echoes the request's obs trace id when it carried one.
+
+    ``class_probs`` puts the per-class softmax on the wire (K-class
+    heads) as an OPTIONAL key after the pinned leading fields: old SDKs
+    keep reading the scalar ``prob`` (P(attack) = 1 - P(class 0) for
+    K > 2, the eval path's score) and never see the new key; K-aware
+    SDKs read the full distribution. Omitted when None, so a binary
+    deployment's replies are byte-identical to the pre-K-class wire."""
     body = {
         "id": int(req_id),
         "prob": float(prob),
@@ -145,6 +153,8 @@ def build_reply(
         "bucket": int(bucket),
         "queue_ms": round(float(queue_ms), 3),
     }
+    if class_probs is not None:
+        body["class_probs"] = [float(p) for p in class_probs]
     if trace is not None:
         body["trace"] = str(trace)
     return _build(SCORE_REP_MAGIC, body)

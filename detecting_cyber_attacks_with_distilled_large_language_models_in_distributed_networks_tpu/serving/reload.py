@@ -49,14 +49,19 @@ def latest_finalized_step(ckpt_dir: str) -> int | None:
     return max(steps, default=None)
 
 
-def checkpoint_restorer(cfg, tok) -> RestoreFn:
+def checkpoint_restorer(cfg, tok, *, mesh=None) -> RestoreFn:
     """Bind the predict-path restore to (config, tokenizer): returns a
     ``RestoreFn`` that restores the latest finalized checkpoint and reads
     its round id from the SAME step's metadata — the round number for
     federated checkpoints, the step id for local ones. One snapshot for
     params and round id: reading "latest" twice around a params restore
     would let a round finalized in between label old weights with the new
-    round id (replies must name the round that actually scored them)."""
+    round id (replies must name the round that actually scored them).
+
+    ``mesh`` (a sharded engine's FSDP host mesh) makes every restore —
+    the startup one AND each hot reload's — scatter checkpoint leaves
+    straight onto their shards, so a mid-traffic reload of a model bigger
+    than one chip never materializes the full tree on a single device."""
     from ..cli.predict import _restore_predict_params
     from ..train.checkpoint import Checkpointer
     from ..train.engine import Trainer
@@ -73,7 +78,7 @@ def checkpoint_restorer(cfg, tok) -> RestoreFn:
         # path raises its clean "no checkpoint found" — not a confusing
         # architecture-mismatch report against a step that never existed.
         model_cfg, params = _restore_predict_params(
-            cfg, tok, trainer, ckpt_dir=cfg.checkpoint_dir, step=pin
+            cfg, tok, trainer, ckpt_dir=cfg.checkpoint_dir, step=pin, mesh=mesh
         )
         return model_cfg, params, int(meta.get("round", pin))
 

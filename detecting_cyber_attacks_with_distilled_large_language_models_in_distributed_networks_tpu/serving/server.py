@@ -545,7 +545,9 @@ class ScoringServer:
     def _make_reply(
         self, writer: _ConnWriter, req_id: int, trace: str | None = None
     ):
-        def _reply(*, prob, round_id, batch_size, bucket, queue_ms):
+        def _reply(
+            *, prob, round_id, batch_size, bucket, queue_ms, class_probs=None
+        ):
             writer.send(
                 protocol.build_reply(
                     req_id,
@@ -556,6 +558,7 @@ class ScoringServer:
                     bucket=bucket,
                     queue_ms=queue_ms,
                     trace=trace,
+                    class_probs=class_probs,
                 )
             )
 
@@ -628,7 +631,7 @@ class ScoringServer:
             if not live:
                 continue
             try:
-                probs, bucket, round_id = self.engine.score(
+                probs, class_probs, bucket, round_id = self.engine.score(
                     np.stack([r.input_ids for r in live]),
                     np.stack([r.attention_mask for r in live]),
                 )
@@ -695,13 +698,20 @@ class ScoringServer:
             self._g_round.set(round_id)
             for r in live:
                 self._h_queue_ms.observe(now - r.t_enqueue)
-            for r, p in zip(live, probs):
+            # K-class heads put the full per-class softmax on the wire
+            # (optional reply key — old SDKs keep reading the scalar);
+            # binary replies stay byte-identical to the pre-K-class wire.
+            kclass = class_probs.shape[1] > 2
+            for i, (r, p) in enumerate(zip(live, probs)):
                 r.reply(
                     prob=float(p),
                     round_id=round_id,
                     batch_size=n,
                     bucket=bucket,
                     queue_ms=(now - r.t_enqueue) * 1e3,
+                    class_probs=(
+                        class_probs[i].tolist() if kclass else None
+                    ),
                 )
             if self.scored_jsonl:
                 import json as _json

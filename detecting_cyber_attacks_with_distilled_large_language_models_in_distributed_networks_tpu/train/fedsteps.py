@@ -132,13 +132,42 @@ class FedSteps(NamedTuple):
     build_packed_step: Callable = None
 
 
-def build_federated_steps(cfg, model, optimizer, sh) -> FedSteps:
+def build_federated_steps(
+    cfg,
+    model,
+    optimizer,
+    sh,
+    *,
+    gather: Callable | None = None,
+    constrain: Callable | None = None,
+) -> FedSteps:
     """Compile-ready step closures for one experiment configuration.
 
     ``sh``: parallel.mesh.FedShardings — fixes how every input/output lays
     over the ``clients x data`` mesh, so jit inserts the collectives (the
-    reference's entire TCP protocol, client1.py:246-336) at trace time."""
+    reference's entire TCP protocol, client1.py:246-336) at trace time.
+
+    ``gather``/``constrain`` spec-parameterize the STACKED steps for FSDP
+    shard-at-rest state — the same callable contract ``make_packed_step``
+    takes, lifted to the ``[C, ...]`` trees: ``gather(stacked_params)``
+    replicates every leaf over the fsdp axis (the all-gather AT USE,
+    tagged + rematted so the backward re-gathers instead of retaining
+    full-size weights), ``constrain(stacked_tree)`` pins grads and the
+    updated params/opt leaves back onto their shards. Both callables see
+    STACKED trees (they run outside the client vmap — per-lane sharding
+    constraints cannot express the stacked layout), so callers build them
+    from the stacked specs. None/None is the literal replicated program
+    — byte-identical construction to the pre-parameterized builder."""
     csh, bsh = sh.client, sh.batch
+    if (gather is None) != (constrain is None):
+        raise ValueError(
+            "gather and constrain parameterize the same FSDP layout — "
+            "pass both or neither"
+        )
+    if gather is not None:
+        from .engine import _tag_gather, fsdp_remat_loss
+
+        tagged = _tag_gather(gather)
     mu = float(cfg.fed.prox_mu)
     wsteps = cfg.train.warmup_steps
 
@@ -183,7 +212,56 @@ def build_federated_steps(cfg, model, optimizer, sh) -> FedSteps:
             losses,  # [C]
         )
 
-    if mu > 0.0:
+    def _fsdp_step_body(state: FedState, batch, anchor):
+        """The gather/constrain-parameterized stacked step: grads come
+        from ONE rematted stacked objective (per-client losses depend
+        only on their own lane, so grad of the sum IS the stacked
+        per-client grads), gathered at use and reduce-scattered back,
+        with the optimizer update vmapped over the constrained grads —
+        the same math as ``_step_body``, laid out for shard-at-rest."""
+        note_train(tuple(batch["input_ids"].shape))
+        step_rngs = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+            state.rngs, state.step
+        )
+
+        def stacked_objective(sp, b, r, a):
+            totals, tasks = jax.vmap(
+                local_loss, in_axes=(0, 0, 0, 0 if mu > 0.0 else None)
+            )(tagged(sp), b, r, a)
+            return totals.sum(), tasks
+
+        (_, losses), grads = jax.value_and_grad(
+            fsdp_remat_loss(stacked_objective), has_aux=True
+        )(state.params, batch, step_rngs, anchor)
+        grads = constrain(grads)
+        updates, opt_state = jax.vmap(optimizer.update)(
+            grads, state.opt_state, state.params
+        )
+        updates = apply_warmup(updates, state.step, wsteps)
+        params = optax.apply_updates(state.params, updates)
+        params = constrain(params)
+        opt_state = constrain(opt_state)
+        return (
+            state._replace(
+                params=params, opt_state=opt_state, step=state.step + 1
+            ),
+            losses,  # [C]
+        )
+
+    if gather is not None:
+        # No explicit in/out shardings: the constrain calls pin the FSDP
+        # layout inside the program and inputs carry the caller's
+        # placements — an out_shardings of ``csh`` here would force a
+        # full re-gather at every step boundary.
+        body = _fsdp_step_body
+        if mu > 0.0:
+            train_step = jax.jit(body, donate_argnums=(0,))
+        else:
+            train_step = jax.jit(
+                lambda state, batch: body(state, batch, None),
+                donate_argnums=(0,),
+            )
+    elif mu > 0.0:
         # FedProx signature: (state, batch, anchor). The anchor is the
         # stacked round-start params — a separate buffer, NOT the
         # donated state.params.
@@ -269,14 +347,85 @@ def build_federated_steps(cfg, model, optimizer, sh) -> FedSteps:
         under threefry dropout keys (pinned by
         test_federated.py::test_packed_fit_matches_vmapped) — the default
         rbg impl generates layout-dependent bitstreams, so there the two
-        paths draw different, equally distributed dropout masks."""
+        paths draw different, equally distributed dropout masks.
+
+        NOTE: the packed step runs SINGLE-client state — the stacked
+        gather/constrain callables do not apply to its lane-shaped trees,
+        so the FSDP-parameterized builder keeps the packed path
+        replicated (single-device packing and shard-at-rest are disjoint
+        deployments; a packed FSDP step is built directly via
+        ``make_packed_step(gather=, constrain=)`` with lane-level
+        callables)."""
         return make_packed_step(local_loss, optimizer, wsteps, mu)
+
+    def _fsdp_ragged_body(state: FedState, batch, anchor):
+        """Row-masked stacked step under gather/constrain: same sum-trick
+        stacked objective as ``_fsdp_step_body`` over the masked loss,
+        with the all-padding-client freeze (where-merge) riding inside
+        the vmapped update and the outputs pinned back onto shards."""
+        note_ragged(tuple(batch["input_ids"].shape))
+        step_rngs = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+            state.rngs, state.step
+        )
+
+        def lane_loss(p, b, r, a):
+            task = masked_loss_fn(model, p, b, r)
+            total = task
+            if mu > 0.0:
+                total = task + 0.5 * mu * prox_sq(p, a)
+            return total, task
+
+        def stacked_objective(sp, b, r, a):
+            totals, tasks = jax.vmap(
+                lane_loss, in_axes=(0, 0, 0, 0 if mu > 0.0 else None)
+            )(tagged(sp), b, r, a)
+            return totals.sum(), tasks
+
+        (_, losses), grads = jax.value_and_grad(
+            fsdp_remat_loss(stacked_objective), has_aux=True
+        )(state.params, batch, step_rngs, anchor)
+        grads = constrain(grads)
+
+        def upd(g, o, p, b):
+            updates, new_opt = optimizer.update(g, o, p)
+            updates = apply_warmup(updates, b["warmup_step"][0], wsteps)
+            new_params = optax.apply_updates(p, updates)
+            has = b["valid"].sum() > 0
+            new_params = jax.tree.map(
+                lambda n, old: jnp.where(has, n, old), new_params, p
+            )
+            new_opt = jax.tree.map(
+                lambda n, old: jnp.where(has, n, old), new_opt, o
+            )
+            return new_params, new_opt, has.astype(jnp.float32)
+
+        params, opt_state, has = jax.vmap(upd)(
+            grads, state.opt_state, state.params, batch
+        )
+        params = constrain(params)
+        opt_state = constrain(opt_state)
+        return (
+            state._replace(
+                params=params, opt_state=opt_state, step=state.step + 1
+            ),
+            (losses, has),
+        )
 
     @lru_cache(maxsize=1)
     def build_ragged_step():
         """Built on first ragged fit_local (equal-client runs never pay
         the extra compilation); memoized so same-config trainers share the
         compiled executable."""
+        if gather is not None:
+            body = _fsdp_ragged_body
+            if mu > 0.0:
+                jitted = jax.jit(body, donate_argnums=(0,))
+            else:
+                jitted = jax.jit(
+                    lambda state, batch: body(state, batch, None),
+                    donate_argnums=(0,),
+                )
+            return ledger.timed("fed.ragged_step", jitted)
         if mu > 0.0:
             jitted = partial(
                 jax.jit,

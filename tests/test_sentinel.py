@@ -24,11 +24,13 @@ from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed
     RING_SCHEMA,
     SENTINEL_SCHEMA,
     VERDICT_SCHEMA,
+    DEFAULT_TREND_FIELDS,
     CanaryProber,
     JournalTail,
     RetentionRing,
     Sentinel,
     load_canary_flows,
+    parse_trend_field_spec,
 )
 from detecting_cyber_attacks_with_distilled_large_language_models_in_distributed_networks_tpu.obs.trace import (
     SPAN_NAMES,
@@ -710,3 +712,62 @@ class TestSentinelComposition:
         # The verdicts file now feeds a SentinelLink end to end.
         link_path_had_content = os.path.getsize(verdicts) > 0
         assert link_path_had_content
+
+
+# -------------------------------------------------------- custom trend fields
+class TestCustomTrendFields:
+    def test_parse_trend_field_spec(self):
+        assert parse_trend_field_spec("my_counter") == (
+            "my_counter", (1.5, 0.0, "up"),
+        )
+        assert parse_trend_field_spec(
+            "fedtpu_server_stream_fallbacks_total:down"
+        ) == ("fedtpu_server_stream_fallbacks_total", (1.5, 0.0, "down"))
+        with pytest.raises(ValueError, match="NAME"):
+            parse_trend_field_spec(":up")
+        with pytest.raises(ValueError, match="up.down"):
+            parse_trend_field_spec("x:sideways")
+
+    def test_custom_field_rides_snapshot_cadence_and_fires(self, tmp_path):
+        """A --trend-field counter is pulled from the fleet snapshot's
+        per-target cadence dicts (max across targets) into the ring row
+        and judged by the same baseline/window arithmetic as the stock
+        fields — a rate step past baseline*ratio fires exactly once."""
+        name, entry = parse_trend_field_spec(
+            "fedtpu_server_stream_fallbacks_total"
+        )
+        ring = RetentionRing(
+            max_records=32, baseline_n=3, window_n=3,
+            trend_fields={**DEFAULT_TREND_FIELDS, name: entry},
+        )
+        rate = [1.0]
+
+        class FakeHub:
+            def poll(self, *, now):
+                return {
+                    "targets": [
+                        {"up": True, "cadence": {name: rate[0] / 2}},
+                        # Hottest instance wins the row.
+                        {"up": True, "cadence": {name: rate[0]}},
+                        {"up": True, "cadence": {}},  # quiet: no sample
+                    ],
+                    "slo": [],
+                }
+
+        s = Sentinel(
+            ring=ring, hub=FakeHub(),
+            alerts_jsonl=str(tmp_path / "alerts.jsonl"),
+        )
+        for i in range(6):
+            assert s.tick(now=float(i))["regressions"] == []
+        rate[0] = 100.0
+        fired = []
+        for i in range(6, 10):
+            fired += s.tick(now=float(i))["regressions"]
+        assert [f["field"] for f in fired] == [name]
+        assert fired[0]["direction"] == "up"
+        # Baseline mean is the hottest target's 1.0 (max across targets
+        # — the half-rate sibling never drags it to 0.5), and the fire
+        # crossed baseline * ratio.
+        assert fired[0]["baseline"] == 1.0
+        assert fired[0]["now"] > 1.5

@@ -145,6 +145,63 @@ def test_protocol_prob_crosses_bit_exact():
         assert body["prob"] == float(p32)
 
 
+def test_protocol_class_probs_optional_and_backcompat():
+    """K-class serving scores: ``class_probs`` is an OPTIONAL reply key
+    — present only when the server passes it, absent replies are
+    byte-identical to the pre-K-class wire, and old readers (which only
+    look at ``prob``) parse both frames unchanged."""
+    kw = dict(
+        prob=0.25, threshold=0.5, round_id=3, batch_size=4, bucket=8,
+        queue_ms=1.5,
+    )
+    plain = protocol.build_reply(7, **kw)
+    kclass = protocol.build_reply(7, class_probs=[0.75, 0.05, 0.2], **kw)
+    assert plain == protocol.build_reply(7, class_probs=None, **kw)
+    old_view = protocol.parse_reply(plain)
+    assert "class_probs" not in old_view
+    new_view = protocol.parse_reply(kclass)
+    assert new_view["class_probs"] == [0.75, 0.05, 0.2]
+    assert new_view["prob"] == old_view["prob"] == 0.25
+
+
+def test_kclass_scores_ride_the_serving_wire(tiny_setup):
+    """A K=3 head puts the full per-class softmax on the scoring wire:
+    the reply's ``class_probs`` sums to 1, its scalar ``prob`` is
+    1 - P(class 0) (the eval path's P(any attack)), and the binary
+    engine's replies carry no ``class_probs`` key at all."""
+    tok, model_cfg, _trainer, params2 = tiny_setup
+    cfg3 = model_cfg.replace(n_classes=3)
+    trainer3 = Trainer(cfg3, TrainConfig(), pad_id=tok.pad_id)
+    eng = ScoreEngine(
+        cfg3, trainer3.init_state(seed=0).params, pad_id=tok.pad_id,
+        buckets=(1, 4), round_id=1,
+    )
+    server = ScoringServer(
+        eng, tok, batcher=MicroBatcher(
+            max_batch=4, max_queue=16, gather_window_s=0.002
+        ),
+    )
+    with server:
+        with ScoringClient("127.0.0.1", server.port, timeout=30) as c:
+            reply = c.score(text=TEXTS[0])
+    cp = reply["class_probs"]
+    assert len(cp) == 3
+    assert abs(sum(cp) - 1.0) < 1e-6
+    assert reply["prob"] == pytest.approx(1.0 - cp[0], abs=1e-9)
+
+    eng2 = ScoreEngine(
+        model_cfg, params2, pad_id=tok.pad_id, buckets=(1, 4), round_id=1
+    )
+    server2 = ScoringServer(
+        eng2, tok, batcher=MicroBatcher(
+            max_batch=4, max_queue=16, gather_window_s=0.002
+        ),
+    )
+    with server2:
+        with ScoringClient("127.0.0.1", server2.port, timeout=30) as c:
+            assert "class_probs" not in c.score(text=TEXTS[0])
+
+
 # ------------------------------------------------------------------ batcher
 def _req(i, deadline_s=None):
     return ScoreRequest(
@@ -195,10 +252,11 @@ def test_engine_bucketing_and_single_compile_per_shape(tiny_setup):
     # Mixed-size storm: sizes map onto buckets 1/4/4/8, repeated — only
     # the first hit of each bucket may trace.
     for n in (1, 3, 4, 6, 1, 2, 5, 6, 3, 1):
-        probs, bucket, rid = eng.score(
+        probs, class_probs, bucket, rid = eng.score(
             enc["input_ids"][:n], enc["attention_mask"][:n]
         )
         assert probs.shape == (n,) and rid == 1
+        assert class_probs.shape == (n, model_cfg.n_classes)
         assert bucket == min(b for b in (1, 4, 8) if b >= n)
     assert eng.compile_counts == {(1, L): 1, (4, L): 1, (8, L): 1}
     with pytest.raises(ValueError):
@@ -210,7 +268,7 @@ def test_engine_probs_match_predict_pipeline_bitwise(tiny_setup):
     tok, model_cfg, trainer, params = tiny_setup
     eng = ScoreEngine(model_cfg, params, pad_id=tok.pad_id, buckets=(1, 4, 8))
     enc = tok.batch_encode(TEXTS[:3], max_len=model_cfg.max_len)
-    got, _, _ = eng.score(enc["input_ids"], enc["attention_mask"])
+    got, _, _, _ = eng.score(enc["input_ids"], enc["attention_mask"])
     want = _expected_probs(tok, trainer, params, TEXTS[:3])
     np.testing.assert_array_equal(got, want)
 
@@ -219,10 +277,10 @@ def test_engine_swap_changes_round_and_weights(tiny_setup):
     tok, model_cfg, trainer, params = tiny_setup
     eng = ScoreEngine(model_cfg, params, pad_id=tok.pad_id, buckets=(4,))
     enc = tok.batch_encode(TEXTS[:2], max_len=model_cfg.max_len)
-    before, _, rid0 = eng.score(enc["input_ids"], enc["attention_mask"])
+    before, _, _, rid0 = eng.score(enc["input_ids"], enc["attention_mask"])
     new_params = trainer.init_state(seed=1).params
     eng.swap(new_params, round_id=rid0 + 1)
-    after, _, rid1 = eng.score(enc["input_ids"], enc["attention_mask"])
+    after, _, _, rid1 = eng.score(enc["input_ids"], enc["attention_mask"])
     assert rid1 == rid0 + 1
     assert not np.array_equal(before, after)
     # Same shapes: the swap must not retrace.

@@ -354,6 +354,62 @@ def test_mixed_fleet_quantized_round_crc_pinned(rng):
         assert server.stream_totals["fold_engine"] == fold.engine_name()
 
 
+def test_reply_dtype_refusal_matrix():
+    """The reply leg mirrors the upload leg's composition rules: lossy
+    reply dtypes refuse secure-agg (the unmask release is bit-exact by
+    contract) and any reply compression (one encoder per leg)."""
+    with pytest.raises(ValueError, match="reply_dtype"):
+        AggregationServer(port=0, num_clients=1, reply_dtype="fp16")
+    with pytest.raises(ValueError, match="secure"):
+        AggregationServer(
+            port=0, num_clients=2, secure_agg=True, reply_dtype="bf16"
+        )
+    with pytest.raises(ValueError, match="two encoders"):
+        AggregationServer(
+            port=0, num_clients=1, compression="bf16", reply_dtype="int8"
+        )
+    # fp32 (the default) composes with everything.
+    with AggregationServer(
+        port=0, num_clients=1, secure_agg=False, reply_dtype="fp32"
+    ):
+        pass
+
+
+def test_reply_dtype_quantizes_streamed_replies_capability_gated(rng):
+    """``serve --reply-dtype bf16``: a streaming client that adverts
+    decodable reply encodings gets the quantized streamed reply (its
+    aggregate is the bf16 round-trip of the fold — deterministic
+    dequantization replay), while an old peer that never streams keeps
+    the dense fp32 reply, exact — in the SAME round."""
+    models = [_leaves(rng, n=3), _leaves(rng, n=3)]
+    results = {}
+    with AggregationServer(
+        port=0, num_clients=2, timeout=30,
+        stream_chunk_bytes=1 << 10, reply_dtype="bf16",
+    ) as server:
+        clients = {
+            0: FederatedClient(
+                "127.0.0.1", server.port, client_id=0, timeout=30,
+            ),
+            # "Old SDK": never streams, so it neither adverts reply
+            # encodings nor receives a streamed (quantizable) reply.
+            1: FederatedClient(
+                "127.0.0.1", server.port, client_id=1, timeout=30,
+                stream=False,
+            ),
+        }
+        t = _serve_rounds(server, 1, results)
+        aggs, errors = _run_clients(clients, models)
+        t.join(timeout=60)
+        assert not errors, errors
+    exact = aggregate_flat(models)
+    # Streaming client: every reply leaf rode the wire as bf16.
+    assert wire.flat_crc32(aggs[0]) == wire.flat_crc32(_rt_bf16(exact))
+    assert wire.flat_crc32(aggs[0]) != wire.flat_crc32(exact)
+    # Dense client: byte-exact fp32, byte-identical to a quant-less round.
+    assert wire.flat_crc32(aggs[1]) == wire.flat_crc32(exact)
+
+
 def test_quantized_dp_upload_is_reclipped(rng):
     """int8 + central DP: the server holds the lossy streamed delta
     until the trailer, dequantizes, re-clips, and only then folds —
